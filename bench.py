@@ -1,0 +1,145 @@
+"""Single-chip training throughput benchmark.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: model FLOPs utilization (MFU) of a jitted train step on the largest
+GPT-2-family config that fits the local chip. The reference's headline is
+Llama2-7B FSDP at 65.6% HFU on A100s (BASELINE.md #8); ``vs_baseline`` is
+our MFU / 0.656 — a hardware-neutral comparison of how well each framework
+drives its accelerator.
+
+Each candidate config runs in a subprocess with its own timeout, so a hung
+compile or OOM on the big config cannot eat the whole bench budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# bf16 peak TFLOP/s per chip by device kind
+_PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+    "cpu": 0.1,  # placeholder so the bench still runs off-TPU
+}
+
+_REFERENCE_HFU = 0.656  # BASELINE.md #8
+
+# (config, batch, seq, remat, subprocess timeout seconds)
+_ATTEMPTS = [
+    ("gpt2-1.5b", 8, 1024, "full", 420),
+    ("gpt2-355m", 16, 1024, "full", 300),
+    ("gpt2-124m", 16, 512, "none", 240),
+    ("tiny", 8, 128, "none", 120),
+]
+
+
+def peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in _PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197.0
+
+
+def run_config(name, batch, seq, remat, steps=10, warmup=3):
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import get_config
+    from dlrover_tpu.parallel.mesh import single_device_mesh
+    from dlrover_tpu.train import (
+        TrainStepBuilder,
+        init_train_state,
+        make_optimizer,
+    )
+
+    cfg = get_config(
+        name, max_seq=seq, remat=remat, param_dtype="bfloat16"
+    )
+    mesh = single_device_mesh()
+    opt = make_optimizer(
+        learning_rate=1e-4,
+        warmup_steps=10,
+        decay_steps=1000,
+        state_dtype="bfloat16",
+    )
+    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+    step = TrainStepBuilder(cfg, mesh, opt).build()
+
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, 1000)
+    batch_data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = steps * batch * seq / dt
+    model_tflops = cfg.flops_per_token(seq) * tokens_per_s / 1e12
+    dev = jax.devices()[0]
+    mfu = model_tflops / peak_tflops(dev)
+    return {
+        "metric": f"train_mfu[{cfg.name},b{batch}x{seq},{dev.device_kind}]",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / _REFERENCE_HFU, 4),
+        "tokens_per_sec": round(tokens_per_s, 1),
+        "model_tflops_per_sec": round(model_tflops, 2),
+    }
+
+
+def main():
+    if len(sys.argv) >= 5 and sys.argv[1] == "--single":
+        name, batch, seq, remat = (
+            sys.argv[2],
+            int(sys.argv[3]),
+            int(sys.argv[4]),
+            sys.argv[5] if len(sys.argv) > 5 else "none",
+        )
+        print(json.dumps(run_config(name, batch, seq, remat)))
+        return
+
+    for name, batch, seq, remat, budget_s in _ATTEMPTS:
+        try:
+            out = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.abspath(__file__),
+                    "--single",
+                    name,
+                    str(batch),
+                    str(seq),
+                    remat,
+                ],
+                capture_output=True,
+                timeout=budget_s,
+                text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                line = out.stdout.strip().splitlines()[-1]
+                json.loads(line)  # validate
+                print(line)
+                return
+            sys.stderr.write(
+                f"bench config {name} rc={out.returncode}: "
+                f"{out.stderr[-800:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"bench config {name} timed out ({budget_s}s)\n")
+    raise SystemExit("all bench configs failed")
+
+
+if __name__ == "__main__":
+    main()

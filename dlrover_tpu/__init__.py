@@ -1,0 +1,24 @@
+"""dlrover_tpu: a TPU-native elastic-training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of DLRover
+(elastic job master, master-driven rendezvous, dynamic data sharding,
+node health/straggler checks, flash checkpoint) and its acceleration
+stack (ATorch-style ``auto_accelerate``; DP/FSDP/TP/SP/EP/PP and
+ring-attention context parallelism over ICI/DCN device meshes).
+
+Layer map (bottom-up), mirroring the reference's structure
+(see SURVEY.md §1; reference: dlrover/python, atorch/atorch):
+
+- ``dlrover_tpu.common``    — node model, typed messages, config, logging
+- ``dlrover_tpu.parallel``  — device meshes, sharding rules, SP/EP/PP
+- ``dlrover_tpu.ops``       — Pallas TPU kernels (flash/ring attention, quant)
+- ``dlrover_tpu.models``    — flagship model zoo (GPT/LLaMA-style decoders)
+- ``dlrover_tpu.train``     — train-step builder, optimizers
+- ``dlrover_tpu.accelerate``— strategy engine (auto_accelerate equivalent)
+- ``dlrover_tpu.checkpoint``— flash checkpoint (HBM→host shm→storage)
+- ``dlrover_tpu.elastic``   — elastic sampler/dataloader/trainer
+- ``dlrover_tpu.master``    — per-job master: rendezvous, sharding, scaling
+- ``dlrover_tpu.agent``     — per-host elastic agent + launcher
+"""
+
+__version__ = "0.1.0"

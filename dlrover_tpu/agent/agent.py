@@ -1,0 +1,269 @@
+"""Per-host elastic agent: rendezvous → spawn worker → supervise → recover.
+
+Reference: ElasticTrainingAgent (elastic_agent/torch/training.py:362-729).
+TPU differences: one worker *process per host* drives all local chips (jax
+owns them), so there is no per-GPU fork; membership changes and failures are
+handled by re-rendezvous + process restart, with flash-checkpoint persist
+hooks before restarts.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    DefaultValues,
+    GraftEnv,
+    NodeStatus,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.rendezvous import (
+    MasterRendezvousHandler,
+    RendezvousOutcome,
+)
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class ElasticLaunchConfig:
+    """Reference: ElasticLaunchConfig (training.py:117)."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    node_id: int = 0
+    local_chips: int = 1
+    max_restarts: int = DefaultValues.RELAUNCH_BUDGET
+    monitor_interval_s: float = 2.0
+    heartbeat_interval_s: float = DefaultValues.HEARTBEAT_INTERVAL_S
+    rdzv_timeout_s: float = DefaultValues.RDZV_TIMEOUT_S
+    network_check: bool = False
+    comm_perf_test: bool = False
+    node_unit: int = 1
+    coordinator_port: int = 7010
+    entrypoint: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+
+    def auto_configure(self):
+        """Fill node/chip counts from the environment when unset."""
+        if GraftEnv.NODE_NUM in os.environ:
+            n = int(os.environ[GraftEnv.NODE_NUM])
+            self.min_nodes = self.max_nodes = n
+        if GraftEnv.NODE_ID in os.environ:
+            self.node_id = int(os.environ[GraftEnv.NODE_ID])
+        if GraftEnv.LOCAL_CHIPS in os.environ:
+            self.local_chips = int(os.environ[GraftEnv.LOCAL_CHIPS])
+
+
+class WorkerProcess:
+    """The single training process on this host."""
+
+    def __init__(self, cmd: List[str], env: Dict[str, str]):
+        self._cmd = cmd
+        full_env = dict(os.environ)
+        full_env.update(env)
+        self._proc = subprocess.Popen(cmd, env=full_env)
+
+    @property
+    def pid(self) -> int:
+        return self._proc.pid
+
+    def poll(self) -> Optional[int]:
+        return self._proc.poll()
+
+    def terminate(self, grace_s: float = 10.0):
+        if self._proc.poll() is not None:
+            return
+        self._proc.send_signal(signal.SIGTERM)
+        try:
+            self._proc.wait(grace_s)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait()
+
+
+class ElasticTrainingAgent:
+    def __init__(self, config: ElasticLaunchConfig, client: MasterClient):
+        self.config = config
+        self.client = client
+        self._worker: Optional[WorkerProcess] = None
+        self._outcome: Optional[RendezvousOutcome] = None
+        self._remaining_restarts = config.max_restarts
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._ckpt_saver = None  # AsyncCheckpointSaver, attached by launcher
+
+    def attach_ckpt_saver(self, saver):
+        self._ckpt_saver = saver
+
+    # ---- setup -----------------------------------------------------------
+
+    def _start_heartbeats(self):
+        def loop():
+            while not self._stop.wait(self.config.heartbeat_interval_s):
+                try:
+                    self.client.report_heartbeat()
+                except Exception:  # noqa: BLE001 — master may be restarting
+                    logger.warning("heartbeat failed", exc_info=True)
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="agent-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    def _rendezvous(self) -> RendezvousOutcome:
+        handler = MasterRendezvousHandler(
+            self.client,
+            self.client.node_rank,
+            self.config.local_chips,
+            timeout_s=self.config.rdzv_timeout_s,
+        )
+        outcome = handler.next_rendezvous()
+        logger.info(
+            "rendezvous round %d: %d processes, %d chips, coordinator=%s",
+            outcome.round,
+            outcome.num_processes,
+            outcome.global_chips,
+            outcome.coordinator,
+        )
+        return outcome
+
+    def _worker_env(self, outcome: RendezvousOutcome) -> Dict[str, str]:
+        env = {
+            GraftEnv.MASTER_ADDR: self.client._t.addr,
+            GraftEnv.NODE_ID: str(self.config.node_id),
+            GraftEnv.NODE_RANK: str(self.client.node_rank),
+            GraftEnv.NODE_NUM: str(outcome.num_processes),
+            # jax.distributed bootstrap — consumed by
+            # dlrover_tpu.train.distributed.init_distributed()
+            "DLROVER_TPU_COORDINATOR": outcome.coordinator,
+            "DLROVER_TPU_NUM_PROCESSES": str(outcome.num_processes),
+            "DLROVER_TPU_PROCESS_ID": str(outcome.process_id),
+            "DLROVER_TPU_RDZV_ROUND": str(outcome.round),
+            "DLROVER_TPU_RESTART_COUNT": str(
+                self.config.max_restarts - self._remaining_restarts
+            ),
+            # the entrypoint script must resolve the framework (and the
+            # user's project) the same way the agent did
+            "PYTHONPATH": os.pathsep.join(
+                p
+                for p in (
+                    os.getcwd(),
+                    os.environ.get("PYTHONPATH", ""),
+                )
+                if p
+            ),
+        }
+        env.update(self.config.env)
+        return env
+
+    def _initialize_worker(self):
+        self._outcome = self._rendezvous()
+        env = self._worker_env(self._outcome)
+        self._worker = WorkerProcess(self.config.entrypoint, env)
+        logger.info(
+            "spawned worker pid=%d round=%d",
+            self._worker.pid,
+            self._outcome.round,
+        )
+
+    # ---- supervision hot loop -------------------------------------------
+
+    def run(self) -> int:
+        """Supervise until success, fatal failure, or restart exhaustion."""
+        self.client.register_node(
+            local_chips=self.config.local_chips, tpu_type=_local_tpu_type()
+        )
+        self._start_heartbeats()
+        self._initialize_worker()
+        try:
+            return self._invoke_run()
+        finally:
+            self._stop.set()
+            if self._worker:
+                self._worker.terminate()
+
+    def _safe_report(self, fn, *args, **kwargs):
+        """Status reports must not crash the agent if the master is gone
+        (the master legitimately exits first when the dataset finishes)."""
+        try:
+            return fn(*args, **kwargs)
+        except Exception:  # noqa: BLE001
+            logger.warning("master unreachable for %s", fn.__name__)
+            return None
+
+    def _invoke_run(self) -> int:
+        while True:
+            time.sleep(self.config.monitor_interval_s)
+            rc = self._worker.poll()
+            if rc is None:
+                if self._membership_changed():
+                    logger.info(
+                        "membership changed; checkpoint + restart workers"
+                    )
+                    self._save_ckpt_to_storage()
+                    self._restart_worker()
+                continue
+            if rc == 0:
+                logger.info("worker succeeded")
+                self._safe_report(
+                    self.client.report_node_status, NodeStatus.SUCCEEDED
+                )
+                return 0
+            # failure path (reference: training.py:687,665,704)
+            logger.warning("worker exited rc=%d", rc)
+            self._safe_report(
+                self.client.report_failure,
+                f"worker exit code {rc}",
+                level=TrainingExceptionLevel.PROCESS_ERROR,
+                restart_count=self.config.max_restarts
+                - self._remaining_restarts,
+            )
+            self._save_ckpt_to_storage()
+            if self._remaining_restarts > 0:
+                self._remaining_restarts -= 1
+                self._restart_worker()
+            else:
+                self._safe_report(
+                    self.client.report_node_status,
+                    NodeStatus.FAILED,
+                    exit_reason="fatal_error",
+                )
+                return rc
+
+    def _membership_changed(self) -> bool:
+        """A node is waiting to join (scale-up) or the world shrank."""
+        try:
+            return self.client.num_nodes_waiting() > 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _restart_worker(self):
+        if self._worker:
+            self._worker.terminate()
+        self._initialize_worker()
+
+    def _save_ckpt_to_storage(self):
+        """Persist any staged in-memory checkpoint before losing the world."""
+        if self._ckpt_saver is not None:
+            try:
+                self._ckpt_saver.save_shm_to_storage()
+            except Exception:  # noqa: BLE001
+                logger.exception("emergency checkpoint persist failed")
+
+
+def _local_tpu_type() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001
+        return "unknown"

@@ -1,0 +1,185 @@
+"""``dlrover-tpu-run``: torchrun-style elastic launcher for TPU hosts.
+
+Reference: dlrover/trainer/torch/elastic_run.py (parse_args:125, run:342,
+_launch_dlrover_local_master:237). Single-host runs spawn an in-process
+LocalJobMaster automatically; multi-host runs point every agent at the job
+master's address.
+
+Usage:
+    python -m dlrover_tpu.agent.launcher --nnodes 1:2 --node-id 0 \
+        [--network-check] [--max-restarts 3] -- python train.py ...
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from dlrover_tpu.common.constants import GraftEnv, NodeStatus
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.agent.agent import ElasticLaunchConfig, ElasticTrainingAgent
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.monitor import ResourceMonitor
+from dlrover_tpu.agent.node_check import run_node_check
+
+logger = get_logger(__name__)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="dlrover-tpu-run")
+    p.add_argument(
+        "--nnodes",
+        default="1",
+        help="N or MIN:MAX node count (elastic range)",
+    )
+    p.add_argument("--node-id", type=int, default=None)
+    p.add_argument(
+        "--nproc",
+        type=int,
+        default=0,
+        help="local chip count (0 = autodetect via jax)",
+    )
+    p.add_argument("--master-addr", default="", help="job master host:port")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument(
+        "--network-check",
+        action="store_true",
+        help="run the matmul+collective health check before training",
+    )
+    p.add_argument("--node-unit", type=int, default=1)
+    p.add_argument("--monitor-interval", type=float, default=2.0)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if args.entrypoint and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    return args
+
+
+def _parse_nnodes(spec: str):
+    if ":" in spec:
+        lo, hi = spec.split(":")
+        return int(lo), int(hi)
+    return int(spec), int(spec)
+
+
+def _detect_local_chips() -> int:
+    try:
+        import jax
+
+        return len(jax.local_devices())
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def _launch_local_master(num_workers: int, max_workers: int, node_unit: int):
+    """Spin an in-process LocalJobMaster (reference: :237)."""
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    master = LocalJobMaster(
+        port=0,
+        num_workers=num_workers,
+        max_workers=max_workers,
+        node_unit=node_unit,
+    )
+    master.prepare()
+    threading.Thread(
+        target=master.run, name="local-master", daemon=True
+    ).start()
+    logger.info("local master started at %s", master.addr)
+    return master
+
+
+def _run_network_check(client: MasterClient, config: ElasticLaunchConfig):
+    """Two paired check rounds; abort if this node is declared faulty."""
+    from dlrover_tpu.common.constants import RendezvousName
+    from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler
+
+    for _ in range(2):
+        handler = MasterRendezvousHandler(
+            client,
+            client.node_rank,
+            config.local_chips,
+            rdzv_name=RendezvousName.NETWORK_CHECK,
+            timeout_s=config.rdzv_timeout_s,
+        )
+        handler.next_rendezvous()
+        ok, elapsed = run_node_check()
+        client.report_network_check_result(elapsed, ok)
+        time.sleep(1.0)
+    status = client.get_network_check_status()
+    if not status.normal:
+        logger.error(
+            "this node failed the network check (faults=%s); exiting",
+            status.fault_nodes,
+        )
+        client.report_node_status(NodeStatus.CHECK_FAILED)
+        sys.exit(3)
+    if status.stragglers:
+        logger.warning("stragglers detected: %s", status.stragglers)
+
+
+def run(args: argparse.Namespace) -> int:
+    min_nodes, max_nodes = _parse_nnodes(args.nnodes)
+    node_id = (
+        args.node_id
+        if args.node_id is not None
+        else int(os.environ.get(GraftEnv.NODE_ID, "0"))
+    )
+    local_chips = args.nproc or _detect_local_chips()
+
+    master = None
+    master_addr = args.master_addr or os.environ.get(GraftEnv.MASTER_ADDR, "")
+    if not master_addr:
+        if min_nodes > 1:
+            logger.error("multi-node runs need --master-addr")
+            return 2
+        master = _launch_local_master(min_nodes, max_nodes, args.node_unit)
+        master_addr = master.addr
+
+    config = ElasticLaunchConfig(
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        node_id=node_id,
+        local_chips=local_chips,
+        max_restarts=args.max_restarts,
+        monitor_interval_s=args.monitor_interval,
+        network_check=args.network_check,
+        node_unit=args.node_unit,
+        entrypoint=args.entrypoint,
+    )
+    config.auto_configure()
+    if not config.entrypoint:
+        logger.error("no training entrypoint given")
+        return 2
+
+    client = MasterClient(master_addr, node_id=node_id)
+    client.register_node(local_chips=local_chips)
+
+    monitor = ResourceMonitor(client)
+    monitor.start()
+    try:
+        if config.network_check:
+            _run_network_check(client, config)
+        agent = ElasticTrainingAgent(config, client)
+        try:
+            from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+            saver = AsyncCheckpointSaver.start_async_saving_ckpt()
+            agent.attach_ckpt_saver(saver)
+        except Exception:  # noqa: BLE001 — ckpt daemon is best-effort
+            logger.warning("checkpoint saver daemon unavailable", exc_info=True)
+        return agent.run()
+    finally:
+        monitor.stop()
+        if master is not None:
+            master.request_stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""Agent-side resource monitor (reference: elastic_agent/monitor/resource.py:86).
+
+psutil host stats + TPU HBM stats (via jax memory_stats when available),
+reported to the master on an interval.
+"""
+
+import threading
+from typing import Optional
+
+import psutil
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def get_tpu_stats() -> dict:
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        stats = dev.memory_stats() or {}
+        return {
+            "hbm_used_mb": stats.get("bytes_in_use", 0) / 1e6,
+        }
+    except Exception:  # noqa: BLE001
+        return {"hbm_used_mb": 0.0}
+
+
+class ResourceMonitor:
+    def __init__(self, client, interval_s: float = 30.0):
+        self._client = client
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval_s):
+            self.report_once()
+
+    def report_once(self) -> bool:
+        try:
+            mem = psutil.virtual_memory()
+            cpu = psutil.cpu_percent(interval=None)
+            tpu = get_tpu_stats()
+            return self._client.report_resource_stats(
+                cpu_percent=cpu,
+                used_memory_mb=mem.used / 1e6,
+                hbm_used_mb=tpu["hbm_used_mb"],
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning("resource report failed", exc_info=True)
+            return False

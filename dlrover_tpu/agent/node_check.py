@@ -1,0 +1,91 @@
+"""Pre-flight node health check: matmul + collective micro-benchmark.
+
+Reference: NodeCheckElasticAgent (training.py:864) running
+trainer/torch/node_check/utils.py:58,88,149 (matmul + 16M-element
+allreduce) on each rank, with the master pairing nodes per round to
+isolate faulty hosts. TPU version: a bf16 MXU matmul loop on every local
+chip plus a psum across all local chips (and across hosts when
+jax.distributed is up) — exercising HBM, MXU, and ICI.
+"""
+
+import time
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def matmul_bench(
+    size: int = 4096, iters: int = 8, device=None
+) -> float:
+    """Time a chain of bf16 matmuls on one chip; returns seconds."""
+    device = device or jax.devices()[0]
+    x = jax.device_put(
+        jnp.ones((size, size), jnp.bfloat16), device
+    )
+
+    @jax.jit
+    def chain(x):
+        def body(_, a):
+            return (a @ a) * (1.0 / size)
+
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    chain(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    chain(x).block_until_ready()
+    return time.perf_counter() - t0
+
+
+def collective_bench(n_elems: int = 1 << 24, iters: int = 4) -> float:
+    """Time psum over every visible device (ICI within a host/slice)."""
+    devices = jax.devices()
+    n = len(devices)
+    if n == 1:
+        return 0.0
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(devices), ("x",))
+    x = jax.device_put(
+        jnp.ones((n, n_elems // n), jnp.bfloat16),
+        NamedSharding(mesh, P("x", None)),
+    )
+
+    @jax.jit
+    def allreduce(x):
+        def body(_, a):
+            s = jnp.sum(a, axis=0, keepdims=True)  # cross-device reduce
+            return jnp.broadcast_to(s / n, a.shape)
+
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    allreduce(x).block_until_ready()
+    t0 = time.perf_counter()
+    allreduce(x).block_until_ready()
+    return time.perf_counter() - t0
+
+
+def run_node_check(mock_error: bool = False) -> Tuple[bool, float]:
+    """Returns (succeeded, elapsed_seconds)."""
+    try:
+        if mock_error:
+            raise RuntimeError("mock node-check error")
+        t0 = time.perf_counter()
+        mm = matmul_bench()
+        coll = collective_bench()
+        elapsed = time.perf_counter() - t0
+        logger.info(
+            "node check ok: matmul=%.3fs collective=%.3fs total=%.3fs",
+            mm,
+            coll,
+            elapsed,
+        )
+        return True, elapsed
+    except Exception:  # noqa: BLE001
+        logger.exception("node check failed")
+        return False, 0.0

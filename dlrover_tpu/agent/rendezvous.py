@@ -1,0 +1,92 @@
+"""Master-driven rendezvous handler for jax.distributed bootstrap.
+
+Reference: MasterRendezvousHandler (elastic_agent/torch/training.py:179) —
+join via master RPC, poll the sealed world, derive ranks, hand torch a
+Store. TPU-native: instead of a c10d Store, the sealed world yields the
+``jax.distributed`` coordinator address + (process_id, num_processes), which
+is everything XLA needs to form the global device mesh.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Dict
+
+from dlrover_tpu.common.constants import DefaultValues, RendezvousName
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.agent.master_client import MasterClient
+
+logger = get_logger(__name__)
+
+
+class RendezvousTimeoutError(Exception):
+    pass
+
+
+@dataclass
+class RendezvousOutcome:
+    round: int = 0
+    group: int = 0
+    # node_rank -> local chip count, sorted ascending
+    world: Dict[int, int] = None
+    coordinator: str = ""
+    process_id: int = -1
+    num_processes: int = 0
+    global_chips: int = 0
+
+    @property
+    def is_first(self) -> bool:
+        return self.process_id == 0
+
+
+class MasterRendezvousHandler:
+    def __init__(
+        self,
+        client: MasterClient,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str = RendezvousName.TRAINING,
+        timeout_s: float = DefaultValues.RDZV_TIMEOUT_S,
+        poll_interval_s: float = 0.5,
+    ):
+        self._client = client
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._rdzv_name = rdzv_name
+        self._timeout_s = timeout_s
+        self._poll_interval_s = poll_interval_s
+
+    def next_rendezvous(self) -> RendezvousOutcome:
+        rdzv_round = self._client.join_rendezvous(
+            self._local_world_size, rdzv_name=self._rdzv_name
+        )
+        logger.info(
+            "node %d joined %s round %s",
+            self._node_rank,
+            self._rdzv_name,
+            rdzv_round,
+        )
+        deadline = time.time() + self._timeout_s
+        while time.time() < deadline:
+            rnd, group, world, coordinator = self._client.get_comm_world(
+                rdzv_name=self._rdzv_name
+            )
+            if world and self._node_rank in world:
+                ranks = sorted(world.keys())
+                return RendezvousOutcome(
+                    round=rnd,
+                    group=group,
+                    world=world,
+                    coordinator=coordinator,
+                    process_id=ranks.index(self._node_rank),
+                    num_processes=len(ranks),
+                    global_chips=sum(world.values()),
+                )
+            if world and self._node_rank not in world:
+                # sealed without us (e.g. max_nodes reached): re-join
+                rdzv_round = self._client.join_rendezvous(
+                    self._local_world_size, rdzv_name=self._rdzv_name
+                )
+            time.sleep(self._poll_interval_s)
+        raise RendezvousTimeoutError(
+            f"rendezvous {self._rdzv_name} timed out after {self._timeout_s}s"
+        )

@@ -1,0 +1,90 @@
+"""Worker-side dynamic data sharding client.
+
+Reference: dlrover/python/elastic_agent/sharding/client.py:29
+(ShardingClient / IndexShardingClient): fetch shard tasks from the master's
+TaskManager, report completion, checkpoint/restore the dataset position.
+"""
+
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.agent.master_client import MasterClient
+
+logger = get_logger(__name__)
+
+
+class ShardingClient:
+    def __init__(
+        self,
+        client: MasterClient,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+    ):
+        self._client = client
+        self.dataset_name = dataset_name
+        self._lock = threading.Lock()
+        self._current_task = None
+        client.report_dataset_shard_params(
+            dataset_name,
+            dataset_size,
+            shard_size,
+            num_epochs=num_epochs,
+            shuffle=shuffle,
+            storage_type=storage_type,
+        )
+
+    def fetch_shard(
+        self, poll_interval_s: float = 2.0
+    ) -> Optional[Tuple[int, int, List[int]]]:
+        """Next (start, end, record_indices); None when the dataset is done.
+
+        A WAIT task (all shards in flight on other workers) polls — those
+        shards may be re-queued if their worker dies.
+        """
+        import time as _time
+
+        while True:
+            task = self._client.get_task(self.dataset_name)
+            if task.task_type == "wait":
+                _time.sleep(poll_interval_s)
+                continue
+            if task.task_id < 0:
+                return None
+            with self._lock:
+                self._current_task = task
+            return task.shard_start, task.shard_end, task.record_indices
+
+    def report_shard_done(self, success: bool = True):
+        with self._lock:
+            task = self._current_task
+            self._current_task = None
+        if task is not None:
+            self._client.report_task_result(
+                self.dataset_name, task.task_id, success=success
+            )
+
+    def iter_shards(self) -> Iterator[Tuple[int, int, List[int]]]:
+        while True:
+            shard = self.fetch_shard()
+            if shard is None:
+                return
+            yield shard
+            self.report_shard_done()
+
+    # ---- dataset-position checkpoint ------------------------------------
+
+    def checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def restore(self, content: str) -> bool:
+        return self._client.report_shard_checkpoint(
+            self.dataset_name, content
+        )
+
+    def get_epoch(self) -> int:
+        return self._client.get_dataset_epoch(self.dataset_name)

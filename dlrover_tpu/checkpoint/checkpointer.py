@@ -1,0 +1,75 @@
+"""User-facing Flash Checkpoint API.
+
+Reference: trainer/torch/flash_checkpoint/checkpointer.py:18 —
+``save_checkpoint(step, state, path, storage_type=MEMORY|DISK)`` — plus the
+per-framework subclasses (ddp.py/fsdp.py/megatron.py). One class suffices
+here: state is any pytree of (sharded) jax arrays, and the pack format is
+sharding-aware, so DDP/FSDP/TP layouts are all "the same checkpoint".
+"""
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from dlrover_tpu.common.constants import CheckpointStorageType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.storage import read_tracker
+
+logger = get_logger(__name__)
+
+
+class StorageType:
+    MEMORY = CheckpointStorageType.MEMORY
+    DISK = CheckpointStorageType.DISK
+
+
+class Checkpointer:
+    def __init__(
+        self,
+        ckpt_dir: str,
+        master_client=None,
+        use_agent: Optional[bool] = None,
+    ):
+        self.ckpt_dir = ckpt_dir
+        self.engine = CheckpointEngine(
+            ckpt_dir, master_client=master_client, use_agent=use_agent
+        )
+
+    def save_checkpoint(
+        self,
+        step: int,
+        state: Any,
+        storage_type: str = StorageType.DISK,
+    ) -> bool:
+        """Stage to memory; DISK additionally persists asynchronously."""
+        if storage_type == StorageType.MEMORY:
+            return self.engine.save_to_memory(step, state)
+        return self.engine.save_to_storage(step, state)
+
+    def load_checkpoint(
+        self,
+        target: Any,
+        shardings: Any = None,
+        step: Optional[int] = None,
+    ) -> Optional[Any]:
+        """Restore into ``target``'s structure; shm-first, storage fallback.
+
+        ``shardings`` may describe a *different* mesh than the one the
+        checkpoint was saved under — the pack format reshard-restores.
+        """
+        return self.engine.load(target, shardings=shardings, step=step)
+
+    def latest_committed_step(self) -> Optional[int]:
+        return read_tracker(self.ckpt_dir, self.engine._storage)
+
+    def wait_for_persist(self, timeout: float = 300.0):
+        self.engine.wait_for_persist(timeout)
+
+
+def state_template(state: Any) -> Any:
+    """Abstract (shape, dtype) template of a live state pytree."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )

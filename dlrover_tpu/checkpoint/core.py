@@ -1,0 +1,320 @@
+"""Shard-pack format: sharded pytrees ⇄ one contiguous buffer per host.
+
+The unit of checkpoint IO. Each host packs the *replica-0 addressable
+shards* of every array in the state pytree into a single buffer:
+
+    [u64 header_len][header JSON][shard payload | shard payload | ...]
+
+The header records, per leaf: its pytree path, dtype, global shape, and the
+global index (slice per dim) + offset of every shard in the payload. Because
+indices are global, restore can assemble ANY target sharding from the union
+of packs — the resharding path the reference implements by hand for each
+framework (fsdp_save_util.py, megatron_dist_ckpt.py) falls out of the
+format here.
+
+Same bytes live in shared memory (staging) and on disk (persisted), so the
+agent's async persist is a raw copy.
+"""
+
+import dataclasses
+import json
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+HEADER_LEN_BYTES = 8
+ALIGN = 128
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _slice_to_json(s: slice, dim: int) -> List[int]:
+    start = 0 if s.start is None else int(s.start)
+    stop = dim if s.stop is None else int(s.stop)
+    return [start, stop]
+
+
+@dataclasses.dataclass
+class ShardEntry:
+    index: List[List[int]]  # [[start, stop], ...] per dim (global coords)
+    offset: int
+    nbytes: int
+
+
+@dataclasses.dataclass
+class LeafEntry:
+    path: str
+    dtype: str
+    global_shape: List[int]
+    shards: List[ShardEntry]
+
+
+def plan_pack(state: Any) -> Tuple[List[LeafEntry], int]:
+    """Compute the header + total payload size for a pytree of jax arrays."""
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(state)[0]
+    entries: List[LeafEntry] = []
+    offset = 0
+    for path, leaf in leaves_with_path:
+        arr = leaf
+        dtype = np.dtype(arr.dtype)
+        gshape = list(arr.shape)
+        shards: List[ShardEntry] = []
+        for shard in _replica0_shards(arr):
+            idx = [
+                _slice_to_json(s, d)
+                for s, d in zip(shard.index, gshape)
+            ] if gshape else []
+            nbytes = int(
+                dtype.itemsize
+                * (math.prod(b - a for a, b in idx) if idx else 1)
+            )
+            offset = (offset + ALIGN - 1) // ALIGN * ALIGN
+            shards.append(ShardEntry(index=idx, offset=offset, nbytes=nbytes))
+            offset += nbytes
+        entries.append(
+            LeafEntry(
+                path=_path_str(path),
+                dtype=dtype.name,
+                global_shape=gshape,
+                shards=shards,
+            )
+        )
+    return entries, offset
+
+
+def _replica0_shards(arr):
+    if hasattr(arr, "addressable_shards"):
+        return [s for s in arr.addressable_shards if s.replica_id == 0]
+
+    class _Whole:
+        index = ()
+        data = arr
+
+    w = _Whole()
+    w.index = tuple(slice(0, d) for d in np.shape(arr))
+    w.data = np.asarray(arr)
+    return [w]
+
+
+def header_bytes(step: int, entries: List[LeafEntry], extra: Dict = None) -> bytes:
+    doc = {
+        "version": 1,
+        "step": step,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "extra": extra or {},
+        "leaves": [
+            {
+                "path": e.path,
+                "dtype": e.dtype,
+                "global_shape": e.global_shape,
+                "shards": [dataclasses.asdict(s) for s in e.shards],
+            }
+            for e in entries
+        ],
+    }
+    return json.dumps(doc).encode("utf-8")
+
+
+def pack_size(header: bytes, payload_size: int) -> int:
+    base = HEADER_LEN_BYTES + len(header)
+    base = (base + ALIGN - 1) // ALIGN * ALIGN
+    return base + payload_size
+
+
+def payload_start(header: bytes) -> int:
+    base = HEADER_LEN_BYTES + len(header)
+    return (base + ALIGN - 1) // ALIGN * ALIGN
+
+
+def write_pack(
+    buf: memoryview,
+    step: int,
+    state: Any,
+    entries: List[LeafEntry],
+    extra: Dict = None,
+) -> int:
+    """Write header + all shard payloads into ``buf``; returns bytes used.
+
+    Device→host copies are started async for every shard first, then
+    consumed — overlapping DMA with serialization.
+    """
+    header = header_bytes(step, entries, extra)
+    n = len(header)
+    buf[:HEADER_LEN_BYTES] = n.to_bytes(HEADER_LEN_BYTES, "little")
+    buf[HEADER_LEN_BYTES : HEADER_LEN_BYTES + n] = header
+    start = payload_start(header)
+
+    leaves = [leaf for _, leaf in jax.tree_util.tree_flatten_with_path(state)[0]]
+    # kick off async D2H for everything first
+    for leaf in leaves:
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
+    used = start
+    for leaf, entry in zip(leaves, entries):
+        shards = _replica0_shards(leaf)
+        for shard, sentry in zip(shards, entry.shards):
+            data = np.asarray(shard.data)
+            raw = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+            lo = start + sentry.offset
+            hi = lo + sentry.nbytes
+            buf[lo:hi] = raw.tobytes()
+            used = max(used, hi)
+    return used
+
+
+def read_header(buf: memoryview) -> Dict:
+    n = int.from_bytes(buf[:HEADER_LEN_BYTES], "little")
+    return json.loads(bytes(buf[HEADER_LEN_BYTES : HEADER_LEN_BYTES + n]))
+
+
+class PackIndex:
+    """Random access over one or more packs (shm buffers or mmapped files)."""
+
+    def __init__(self):
+        # path -> list of (index, np_view)
+        self._shards: Dict[str, List[Tuple[Tuple[slice, ...], np.ndarray]]] = {}
+        self._meta: Dict[str, Tuple[str, Tuple[int, ...]]] = {}
+        self.step: Optional[int] = None
+        self.process_count: int = 0
+
+    def add_pack(self, buf: memoryview):
+        n = int.from_bytes(buf[:HEADER_LEN_BYTES], "little")
+        doc = json.loads(bytes(buf[HEADER_LEN_BYTES : HEADER_LEN_BYTES + n]))
+        if self.step is None:
+            self.step = doc["step"]
+            self.process_count = doc.get("process_count", 1)
+        base = HEADER_LEN_BYTES + n
+        start = (base + ALIGN - 1) // ALIGN * ALIGN
+        for leaf in doc["leaves"]:
+            path = leaf["path"]
+            dtype = np.dtype(leaf["dtype"])
+            gshape = tuple(leaf["global_shape"])
+            self._meta[path] = (leaf["dtype"], gshape)
+            for s in leaf["shards"]:
+                idx = tuple(slice(a, b) for a, b in s["index"])
+                shape = tuple(b - a for a, b in s["index"])
+                lo = start + s["offset"]
+                view = np.frombuffer(
+                    buf, dtype=dtype, count=max(1, math.prod(shape)) if shape else 1,
+                    offset=lo,
+                ).reshape(shape)
+                self._shards.setdefault(path, []).append((idx, view))
+
+    def paths(self) -> List[str]:
+        return list(self._meta.keys())
+
+    def global_shape(self, path: str) -> Tuple[int, ...]:
+        return self._meta[path][1]
+
+    def dtype(self, path: str) -> np.dtype:
+        return np.dtype(self._meta[path][0])
+
+    def read_slice(self, path: str, index: Tuple[slice, ...]) -> np.ndarray:
+        """Assemble an arbitrary global slice from stored shards."""
+        dtype, gshape = np.dtype(self._meta[path][0]), self._meta[path][1]
+        want = tuple(
+            slice(
+                0 if s.start is None else s.start,
+                dim if s.stop is None else s.stop,
+            )
+            for s, dim in zip(index, gshape)
+        ) if gshape else ()
+        if not gshape:
+            shards = self._shards.get(path, [])
+            if not shards:
+                raise KeyError(f"no shards for {path}")
+            return shards[0][1].reshape(())
+        shape = tuple(s.stop - s.start for s in want)
+        out = np.empty(shape, dtype)
+        filled = np.zeros(shape, bool) if not _covers(want, self._shards.get(path, [])) else None
+        for idx, view in self._shards.get(path, []):
+            inter = []
+            ok = True
+            for w, h in zip(want, idx):
+                lo = max(w.start, h.start)
+                hi = min(w.stop, h.stop)
+                if lo >= hi:
+                    ok = False
+                    break
+                inter.append((lo, hi))
+            if not ok:
+                continue
+            dst = tuple(
+                slice(lo - w.start, hi - w.start)
+                for (lo, hi), w in zip(inter, want)
+            )
+            src = tuple(
+                slice(lo - h.start, hi - h.start)
+                for (lo, hi), h in zip(inter, idx)
+            )
+            out[dst] = view[src]
+            if filled is not None:
+                filled[dst] = True
+        if filled is not None and not filled.all():
+            raise KeyError(
+                f"pack set does not cover requested slice of {path}"
+            )
+        return out
+
+
+def _covers(want, shards) -> bool:
+    # fast path: a single shard covering the whole request
+    for idx, _ in shards:
+        if all(
+            h.start <= w.start and h.stop >= w.stop
+            for w, h in zip(want, idx)
+        ):
+            return True
+    return False
+
+
+def restore_tree(
+    target: Any,
+    pack_index: PackIndex,
+    shardings: Any = None,
+) -> Any:
+    """Build a pytree of (sharded) jax arrays matching ``target``'s structure.
+
+    ``target`` is a pytree of ShapeDtypeStruct/arrays providing structure;
+    ``shardings`` an optional matching pytree of NamedSharding for the NEW
+    mesh — this is the resharded-restore path after an elastic re-election.
+    """
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(target)
+    shard_leaves = (
+        jax.tree_util.tree_flatten(shardings)[0]
+        if shardings is not None
+        else [None] * len(leaves_with_path)
+    )
+    out = []
+    for (path, leaf), sharding in zip(leaves_with_path, shard_leaves):
+        pstr = _path_str(path)
+        gshape = pack_index.global_shape(pstr)
+        dtype = pack_index.dtype(pstr)
+        if sharding is None:
+            full = pack_index.read_slice(
+                pstr, tuple(slice(0, d) for d in gshape)
+            )
+            out.append(jax.numpy.asarray(full.astype(dtype)))
+        else:
+            arr = jax.make_array_from_callback(
+                gshape,
+                sharding,
+                lambda idx, p=pstr: pack_index.read_slice(p, idx),
+            )
+            out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,181 @@
+"""Agent-side async checkpoint saver daemon.
+
+Reference: AsyncCheckpointSaver (elastic_agent/torch/ckpt_saver.py:345-763):
+a daemon in the agent process consuming checkpoint events from the worker,
+persisting shared-memory packs to storage, committing with done-files +
+tracker, and doing an emergency persist on worker failure or SIGTERM.
+"""
+
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+    attach_shared_memory,
+)
+from dlrover_tpu.checkpoint.storage import (
+    CheckpointStorage,
+    DeletionStrategy,
+    PosixStorage,
+    write_tracker,
+)
+
+logger = get_logger(__name__)
+
+
+def persist_pack(
+    buf: memoryview,
+    ckpt_dir: str,
+    step: int,
+    process_index: int,
+    process_count: int,
+    storage: CheckpointStorage,
+):
+    """Write one host's pack + done marker; commit tracker when all done.
+
+    Commit protocol (reference: ckpt_saver.py:864 commit_checkpoint): every
+    host writes ``host_i.pack`` then ``done/host_i.done`` into the step dir
+    on the shared filesystem; whichever host observes the full done set
+    writes the tracker file. Idempotent across hosts.
+    """
+    step_dir = os.path.join(ckpt_dir, f"step_{step}")
+    storage.makedirs(step_dir)
+    storage.write_bytes(
+        buf, os.path.join(step_dir, f"host_{process_index}.pack")
+    )
+    done_dir = os.path.join(step_dir, "done")
+    storage.makedirs(done_dir)
+    storage.write_bytes(
+        memoryview(b"1"), os.path.join(done_dir, f"host_{process_index}.done")
+    )
+    done = len(
+        [f for f in storage.listdir(done_dir) if f.endswith(".done")]
+    )
+    if done >= process_count:
+        write_tracker(ckpt_dir, step, storage)
+        logger.info("committed checkpoint step %d (%d hosts)", step, done)
+
+
+class AsyncCheckpointSaver:
+    """Singleton daemon owning the ckpt IPC endpoints in the agent."""
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, storage: Optional[CheckpointStorage] = None):
+        self.storage = storage or PosixStorage()
+        self.queue = SharedQueue("ckpt")
+        self.meta = SharedDict("ckpt_meta")
+        self.shm_lock = SharedLock("ckpt")
+        self.deletion_strategy: Optional[DeletionStrategy] = None
+        self._stop = threading.Event()
+        self._last_persisted_step = -1
+        self._thread = threading.Thread(
+            target=self._persist_loop, name="ckpt-saver", daemon=True
+        )
+        self._thread.start()
+        self._install_signal_handler()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    @classmethod
+    def start_async_saving_ckpt(cls) -> "AsyncCheckpointSaver":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def get(cls) -> Optional["AsyncCheckpointSaver"]:
+        return cls._instance
+
+    def close(self):
+        self._stop.set()
+        self.queue.close()
+        self.meta.close()
+        self.shm_lock.close()
+        with AsyncCheckpointSaver._lock:
+            if AsyncCheckpointSaver._instance is self:
+                AsyncCheckpointSaver._instance = None
+
+    def _install_signal_handler(self):
+        if threading.current_thread() is not threading.main_thread():
+            return
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            logger.info("SIGTERM: persisting staged checkpoint before exit")
+            try:
+                self.save_shm_to_storage()
+            finally:
+                if callable(prev):
+                    prev(signum, frame)
+
+        try:
+            signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            pass
+
+    # ---- persist ---------------------------------------------------------
+
+    def _persist_loop(self):
+        while not self._stop.is_set():
+            event = self.queue.get(timeout=1.0)
+            if not event:
+                continue
+            if event.get("type") == "persist":
+                try:
+                    self._persist_latest()
+                except Exception:  # noqa: BLE001
+                    logger.exception("async persist failed")
+
+    def _persist_latest(self) -> bool:
+        meta = self.meta.get("latest")
+        if not meta:
+            return False
+        step = meta["step"]
+        if step <= self._last_persisted_step:
+            return False
+        # lock out the worker from re-staging while we read the segment
+        self.shm_lock.acquire(owner="saver")
+        try:
+            shm = attach_shared_memory(meta["shm"])
+            try:
+                persist_pack(
+                    memoryview(shm.buf)[: meta["used"]],
+                    meta["dir"],
+                    step,
+                    meta["process_index"],
+                    meta["process_count"],
+                    self.storage,
+                )
+            finally:
+                shm.close()
+        finally:
+            self.shm_lock.release(owner="saver")
+        self._last_persisted_step = step
+        if self.deletion_strategy is not None:
+            try:
+                self.deletion_strategy.clean_up(meta["dir"], self.storage)
+            except Exception:  # noqa: BLE001
+                logger.warning("checkpoint cleanup failed", exc_info=True)
+        return True
+
+    def save_shm_to_storage(self):
+        """Emergency persist (worker died / SIGTERM / membership change)."""
+        if self._persist_latest():
+            logger.info("emergency checkpoint persist done")
+
+    def wait_idle(self, timeout: float = 60.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            meta = self.meta.get("latest")
+            if not meta or meta["step"] <= self._last_persisted_step:
+                return
+            time.sleep(0.05)

@@ -1,0 +1,153 @@
+"""gRPC control-plane transport without codegen.
+
+The reference defines a two-RPC proto (``report``/``get``,
+dlrover/proto/elastic_training.proto:27-28) and pickles dataclasses into it.
+We keep the identical two-RPC shape but use grpc *generic method handlers*
+with the typed JSON codec from ``messages.py`` — no protoc step, no pickle.
+
+Service: ``/dlrover_tpu.Master/report`` (fire-and-forget, returns Response)
+         ``/dlrover_tpu.Master/get``    (request → typed response message)
+"""
+
+import threading
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+SERVICE_NAME = "dlrover_tpu.Master"
+
+_GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", 64 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+]
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class MasterTransportServer:
+    """Wraps a user servicer exposing ``report(msg)`` and ``get(msg)``."""
+
+    def __init__(self, servicer, port: int = 0, max_workers: int = 16):
+        self._servicer = servicer
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=_GRPC_OPTIONS,
+        )
+        handlers = {
+            "report": grpc.unary_unary_rpc_method_handler(
+                self._handle_report,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+            "get": grpc.unary_unary_rpc_method_handler(
+                self._handle_get,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+
+    def _handle_report(self, request: bytes, context) -> bytes:
+        try:
+            req = msgs.deserialize(request)
+            success = bool(self._servicer.report(req))
+            return msgs.serialize(msgs.Response(success=success))
+        except Exception as e:  # noqa: BLE001 — fault barrier at RPC edge
+            logger.exception("report failed")
+            return msgs.serialize(msgs.Response(success=False, reason=str(e)))
+
+    def _handle_get(self, request: bytes, context) -> bytes:
+        try:
+            req = msgs.deserialize(request)
+            resp = self._servicer.get(req)
+            if resp is None:
+                return msgs.serialize(msgs.Empty())
+            return msgs.serialize(resp)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("get failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            raise AssertionError  # unreachable; abort raises
+
+    def start(self):
+        self._server.start()
+        logger.info("master transport listening on port %s", self.port)
+
+    def stop(self, grace: Optional[float] = 1.0):
+        self._server.stop(grace)
+
+    def wait(self):
+        self._server.wait_for_termination()
+
+
+class MasterTransportClient:
+    """Typed client for the two-RPC surface, with retry."""
+
+    def __init__(self, addr: str, timeout_s: float = 30.0, retries: int = 10):
+        self._addr = addr
+        self._timeout = timeout_s
+        self._retries = retries
+        self._lock = threading.Lock()
+        self._channel = grpc.insecure_channel(addr, options=_GRPC_OPTIONS)
+        self._report = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/report",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        self._get = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/get",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def _call(self, fn: Callable, payload: bytes) -> bytes:
+        last_err = None
+        for attempt in range(self._retries):
+            try:
+                return fn(payload, timeout=self._timeout)
+            except grpc.RpcError as e:
+                last_err = e
+                if e.code() in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                ):
+                    # master may be restarting / re-electing
+                    threading.Event().wait(min(2.0 * (attempt + 1), 15.0))
+                    continue
+                raise
+        raise last_err  # type: ignore[misc]
+
+    def report(self, msg) -> bool:
+        resp = msgs.deserialize(self._call(self._report, msgs.serialize(msg)))
+        return bool(resp and resp.success)
+
+    def get(self, msg):
+        resp = msgs.deserialize(self._call(self._get, msgs.serialize(msg)))
+        if isinstance(resp, msgs.Empty):
+            return None
+        return resp
+
+    def close(self):
+        self._channel.close()
+
+
+def find_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

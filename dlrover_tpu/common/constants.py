@@ -1,0 +1,141 @@
+"""Framework-wide constants.
+
+TPU-native analog of the reference's ``dlrover/python/common/constants.py``
+(node types/status, default tunables). Node types differ from the reference's
+PS/worker/chief split: a TPU job is a set of *hosts* grouped into *slices*
+connected by ICI, with DCN across slices.
+"""
+
+
+class NodeType:
+    """Roles a node (TPU host) can play in a job."""
+
+    MASTER = "master"
+    WORKER = "worker"          # a TPU host driving its local chips
+    COWORKER = "coworker"      # CPU-only data preprocessing host
+
+
+class NodeStatus:
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    CHECK_FAILED = "check_failed"
+
+    ALL = (INITIAL, PENDING, RUNNING, SUCCEEDED, FAILED, DELETED, CHECK_FAILED)
+    TERMINAL = (SUCCEEDED, FAILED, DELETED)
+
+
+class NodeEventType:
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+    HEARTBEAT_TIMEOUT = "heartbeat_timeout"
+
+
+class NodeExitReason:
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"            # preemption / eviction
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"  # un-relaunchable user error
+    HARDWARE_ERROR = "hardware_error"  # chip / ICI failure
+    UNKNOWN = "unknown"
+
+    # Exit reasons that should NOT consume a relaunch budget: the node was
+    # taken from us, it did not fail on its own.
+    NO_BUDGET = (KILLED,)
+    # Exit reasons that should never be relaunched.
+    NEVER_RELAUNCH = (FATAL_ERROR, SUCCEEDED)
+
+
+class JobStage:
+    CREATE = "create"
+    PENDING = "pending"
+    RUNNING = "running"
+    SCALING = "scaling"
+    SUSPENDED = "suspended"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+
+class JobExitReason:
+    SUCCEEDED = "succeeded"
+    NODE_CHECK_FAILED = "node_check_failed"
+    PENDING_TIMEOUT = "pending_timeout"
+    RELAUNCH_BUDGET_EXHAUSTED = "relaunch_budget_exhausted"
+    HANG = "hang"
+    UNKNOWN = "unknown"
+
+
+class RendezvousName:
+    TRAINING = "elastic-training"
+    NETWORK_CHECK = "network-check"
+
+
+class TaskType:
+    """Data-shard task flavours (reference: proto elastic_training TaskType)."""
+
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+    WAIT = "wait"
+    NONE = "none"
+
+
+class CheckpointStorageType:
+    MEMORY = "memory"
+    DISK = "disk"
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "kubernetes"
+    RAY = "ray"
+
+
+class TrainingExceptionLevel:
+    PROCESS_ERROR = "process_error"
+    NODE_ERROR = "node_error"
+    RDZV_ERROR = "rdzv_error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class DefaultValues:
+    """Default tunables (reference: constants.py DefaultValues)."""
+
+    SERVICE_PORT = 0                 # 0 → pick a free port
+    RPC_TIMEOUT_S = 30.0
+    RPC_RETRY = 10
+    HEARTBEAT_INTERVAL_S = 15.0
+    HEARTBEAT_TIMEOUT_S = 300.0
+    SUPERVISE_INTERVAL_S = 5.0
+    RDZV_TIMEOUT_S = 600.0
+    RDZV_WAIT_EXTRA_NODES_S = 30.0   # grace period past min_nodes
+    NODE_CHECK_TIMEOUT_S = 300.0
+    RELAUNCH_BUDGET = 3
+    PENDING_TIMEOUT_S = 900.0
+    SHARD_TIMEOUT_S = 1800.0         # re-queue a dispatched shard after this
+    SPEED_MONITOR_WINDOW = 30
+    STRAGGLER_RATIO = 1.6            # step-time ratio over median → straggler
+    SAVE_SHM_MAX_GB = 64.0
+    AUTOSCALE_INTERVAL_S = 60.0
+    SECONDS_TO_WAIT_PENDING_POD = 900
+    MAX_METRIC_RECORDS = 4096
+
+
+class GraftEnv:
+    """Environment variable names used across master/agent/worker."""
+
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    COORDINATOR_PORT = "DLROVER_TPU_COORDINATOR_PORT"
+    LOCAL_CHIPS = "DLROVER_TPU_LOCAL_CHIPS"
+    CKPT_SHM_PREFIX = "DLROVER_TPU_CKPT_SHM"
+    PARAL_CONFIG_PATH = "DLROVER_TPU_PARAL_CONFIG"
+    RUN_ID = "DLROVER_TPU_RUN_ID"

@@ -1,0 +1,75 @@
+"""Singleton runtime configuration (reference: common/global_context.py).
+
+Every tunable has a ``DefaultValues`` default and may be overridden from the
+environment or programmatically (the reference additionally lets the Brain
+service override; our auto-tuner can do the same through ``set_param``).
+"""
+
+import os
+import threading
+from typing import Any, Dict
+
+from dlrover_tpu.common.constants import DefaultValues
+
+
+class Context:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.master_service_port = DefaultValues.SERVICE_PORT
+        self.rpc_timeout_s = DefaultValues.RPC_TIMEOUT_S
+        self.rpc_retry = DefaultValues.RPC_RETRY
+        self.heartbeat_interval_s = DefaultValues.HEARTBEAT_INTERVAL_S
+        self.heartbeat_timeout_s = DefaultValues.HEARTBEAT_TIMEOUT_S
+        self.supervise_interval_s = DefaultValues.SUPERVISE_INTERVAL_S
+        self.rdzv_timeout_s = DefaultValues.RDZV_TIMEOUT_S
+        self.rdzv_wait_extra_nodes_s = DefaultValues.RDZV_WAIT_EXTRA_NODES_S
+        self.relaunch_budget = DefaultValues.RELAUNCH_BUDGET
+        self.pending_timeout_s = DefaultValues.PENDING_TIMEOUT_S
+        self.shard_timeout_s = DefaultValues.SHARD_TIMEOUT_S
+        self.straggler_ratio = DefaultValues.STRAGGLER_RATIO
+        self.autoscale_interval_s = DefaultValues.AUTOSCALE_INTERVAL_S
+        self.seconds_to_wait_pending_pod = (
+            DefaultValues.SECONDS_TO_WAIT_PENDING_POD
+        )
+        self._extra: Dict[str, Any] = {}
+        self._load_env_overrides()
+
+    def _load_env_overrides(self):
+        """`DLROVER_TPU_CTX_<NAME>=value` overrides attribute `<name>`."""
+        prefix = "DLROVER_TPU_CTX_"
+        for key, value in os.environ.items():
+            if not key.startswith(prefix):
+                continue
+            attr = key[len(prefix):].lower()
+            if hasattr(self, attr):
+                cur = getattr(self, attr)
+                cast = type(cur) if cur is not None else str
+                try:
+                    setattr(self, attr, cast(value))
+                except (TypeError, ValueError):
+                    setattr(self, attr, value)
+
+    def set_param(self, name: str, value: Any):
+        if hasattr(self, name):
+            setattr(self, name, value)
+        else:
+            self._extra[name] = value
+
+    def get_param(self, name: str, default: Any = None) -> Any:
+        if hasattr(self, name):
+            return getattr(self, name)
+        return self._extra.get(name, default)
+
+    @classmethod
+    def singleton_instance(cls) -> "Context":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+
+def get_context() -> Context:
+    return Context.singleton_instance()

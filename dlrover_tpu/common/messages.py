@@ -1,0 +1,384 @@
+"""Typed control-plane messages.
+
+The reference carries *pickled* dataclasses over a generic two-RPC gRPC
+service (reference: dlrover/python/common/grpc.py:115-131, servicer demux at
+master/servicer.py:98). Pickle is unsafe and version-brittle; we keep the
+same design — one dataclass per message type, demuxed on type — but encode
+them as a JSON envelope ``{"t": <type-name>, "d": {fields}}`` with a strict
+registry, so only registered message classes can ever be instantiated.
+"""
+
+import dataclasses
+import json
+import typing
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def message(cls):
+    """Register a dataclass as a wire message type."""
+    cls = dataclass(cls)
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _to_jsonable(value):
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {
+            "__msg__": type(value).__name__,
+            **{
+                f.name: _to_jsonable(getattr(value, f.name))
+                for f in dataclasses.fields(value)
+            },
+        }
+    if isinstance(value, dict):
+        return {str(k): _to_jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    return value
+
+
+def _from_jsonable(value):
+    if isinstance(value, dict):
+        if "__msg__" in value:
+            cls = _REGISTRY[value["__msg__"]]
+            kwargs = {
+                k: _from_jsonable(v) for k, v in value.items() if k != "__msg__"
+            }
+            return cls(**kwargs)
+        return {k: _from_jsonable(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_from_jsonable(v) for v in value]
+    return value
+
+
+def serialize(msg) -> bytes:
+    if not dataclasses.is_dataclass(msg):
+        raise TypeError(f"not a message dataclass: {type(msg)}")
+    name = type(msg).__name__
+    if name not in _REGISTRY:
+        raise TypeError(f"unregistered message type: {name}")
+    payload = _to_jsonable(msg)
+    payload.pop("__msg__", None)
+    return json.dumps({"t": name, "d": payload}).encode("utf-8")
+
+
+def deserialize(data: bytes):
+    if not data:
+        return None
+    obj = json.loads(data.decode("utf-8"))
+    name = obj["t"]
+    if name not in _REGISTRY:
+        raise TypeError(f"unregistered message type: {name}")
+    return _from_jsonable({"__msg__": name, **obj["d"]})
+
+
+# ---------------------------------------------------------------------------
+# Generic responses
+# ---------------------------------------------------------------------------
+
+
+@message
+class Response:
+    success: bool = True
+    reason: str = ""
+
+
+@message
+class Empty:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Node lifecycle (reference grpc.py: NodeMeta / NodeEvent / heartbeats)
+# ---------------------------------------------------------------------------
+
+
+@message
+class NodeMeta:
+    node_type: str = "worker"
+    node_id: int = 0
+    node_rank: int = -1
+    host_name: str = ""
+    host_addr: str = ""
+    local_chips: int = 0
+    tpu_type: str = ""
+    slice_id: str = ""
+    slice_index: int = 0
+
+
+@message
+class NodeRegisterRequest:
+    meta: Optional[NodeMeta] = None
+    restart_count: int = 0
+
+
+@message
+class NodeRegisterResponse:
+    success: bool = True
+    node_rank: int = -1
+    node_num: int = 0
+
+
+@message
+class HeartbeatReport:
+    node_id: int = 0
+    node_type: str = "worker"
+    timestamp: float = 0.0
+
+
+@message
+class HeartbeatResponse:
+    # Diagnosis actions for the agent to execute (e.g. "restart_workers").
+    actions: List[str] = field(default_factory=list)
+
+
+@message
+class NodeStatusReport:
+    node_id: int = 0
+    node_type: str = "worker"
+    status: str = ""
+    exit_reason: str = ""
+
+
+@message
+class NodeFailureReport:
+    node_id: int = 0
+    node_rank: int = -1
+    error_data: str = ""
+    level: str = "process_error"
+    restart_count: int = 0
+
+
+@message
+class ResourceStats:
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    used_memory_mb: float = 0.0
+    tpu_duty_cycle: float = 0.0
+    hbm_used_mb: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous (reference: rdzv_manager.py + master_client.py:300-360)
+# ---------------------------------------------------------------------------
+
+
+@message
+class JoinRendezvousRequest:
+    node_id: int = 0
+    node_rank: int = -1
+    local_world_size: int = 1
+    rdzv_name: str = "elastic-training"
+    node_unit: int = 1
+
+
+@message
+class JoinRendezvousResponse:
+    round: int = 0
+
+
+@message
+class CommWorldRequest:
+    node_id: int = 0
+    rdzv_name: str = "elastic-training"
+
+
+@message
+class CommWorldResponse:
+    rdzv_round: int = 0
+    group: int = 0
+    # node_rank -> local world size (chips) for every node in the world;
+    # empty until the rendezvous completes.
+    world: Dict[str, int] = field(default_factory=dict)
+    # jax.distributed coordinator (host:port of process 0), filled once the
+    # world is sealed.
+    coordinator: str = ""
+
+
+@message
+class NetworkReadyRequest:
+    node_id: int = 0
+
+
+@message
+class NumNodesWaitingRequest:
+    rdzv_name: str = "elastic-training"
+
+
+@message
+class NumNodesWaitingResponse:
+    waiting_num: int = 0
+
+
+@message
+class NetworkCheckResult:
+    node_id: int = 0
+    elapsed_time: float = 0.0
+    succeeded: bool = True
+
+
+@message
+class NetworkCheckStatusRequest:
+    node_id: int = 0
+
+
+@message
+class NetworkCheckStatusResponse:
+    normal: bool = True
+    # nodes the master decided are faulty / straggling
+    fault_nodes: List[int] = field(default_factory=list)
+    stragglers: List[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Data sharding (reference: task_manager.py + sharding/client.py)
+# ---------------------------------------------------------------------------
+
+
+@message
+class DatasetShardParams:
+    dataset_name: str = ""
+    dataset_size: int = 0
+    shard_size: int = 0          # samples per shard (= batches × batch size)
+    batch_size: int = 0
+    num_epochs: int = 1
+    shuffle: bool = False
+    storage_type: str = "table"  # table | text | stream
+    task_type: str = "training"
+
+
+@message
+class TaskRequest:
+    dataset_name: str = ""
+    worker_id: int = 0
+
+
+@message
+class Task:
+    task_id: int = -1
+    task_type: str = "none"
+    dataset_name: str = ""
+    shard_start: int = 0
+    shard_end: int = 0
+    epoch: int = 0
+    # record indices inside the shard when shuffling
+    record_indices: List[int] = field(default_factory=list)
+
+
+@message
+class TaskResult:
+    dataset_name: str = ""
+    task_id: int = -1
+    worker_id: int = 0
+    success: bool = True
+    elapsed_time: float = 0.0
+
+
+@message
+class ShardCheckpointRequest:
+    dataset_name: str = ""
+
+
+@message
+class ShardCheckpoint:
+    dataset_name: str = ""
+    content: str = ""  # JSON payload of the dataset manager's checkpoint
+
+
+# ---------------------------------------------------------------------------
+# Training telemetry (reference: master_client.py report_global_step etc.)
+# ---------------------------------------------------------------------------
+
+
+@message
+class GlobalStepRecord:
+    global_step: int = 0
+    timestamp: float = 0.0
+    worker_num: int = 0
+
+
+@message
+class DatasetEpochRequest:
+    dataset_name: str = ""
+
+
+@message
+class DatasetEpochResponse:
+    epoch: int = 0
+
+
+# ---------------------------------------------------------------------------
+# KV store + sync service (reference: kv_store_service.py, sync_service.py)
+# ---------------------------------------------------------------------------
+
+
+@message
+class KeyValuePair:
+    key: str = ""
+    value: str = ""   # base64 for binary payloads
+
+
+@message
+class KeyRequest:
+    key: str = ""
+
+
+@message
+class SyncJoin:
+    sync_name: str = ""
+    node_id: int = 0
+    node_rank: int = -1
+
+
+@message
+class SyncRequest:
+    sync_name: str = ""
+
+
+@message
+class SyncResponse:
+    success: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint coordination (reference: master_client.py ckpt sync)
+# ---------------------------------------------------------------------------
+
+
+@message
+class CheckpointStepSync:
+    node_rank: int = -1
+    step: int = 0
+
+
+@message
+class CheckpointStepRequest:
+    pass
+
+
+@message
+class CheckpointStepResponse:
+    step: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Runtime re-config (reference: paral_config_tuner.py)
+# ---------------------------------------------------------------------------
+
+
+@message
+class ParallelConfig:
+    # dataloader
+    batch_size: int = 0
+    num_workers: int = 0
+    # grad accumulation (elastic trainer keeps global batch fixed)
+    grad_accum_steps: int = 1
+    version: int = 0
+
+
+@message
+class ParallelConfigRequest:
+    node_id: int = 0

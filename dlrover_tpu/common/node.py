@@ -1,0 +1,143 @@
+"""Node model: a TPU host participating in a job.
+
+TPU-native analog of the reference's ``dlrover/python/common/node.py``
+(Node/NodeResource/NodeGroupResource). The unit of scheduling here is a
+*TPU host* (a VM with N locally-attached chips); hosts group into *slices*
+wired by ICI, and slices connect over DCN. The reference schedules free-form
+GPU pods; we carry slice/topology metadata so the scaler can request whole
+slices.
+"""
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+
+
+@dataclass
+class NodeResource:
+    """Resources of one host (reference: node.py NodeResource)."""
+
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    # TPU-specific: chips on this host and their generation.
+    tpu_chips: int = 0
+    tpu_type: str = ""       # e.g. "v5p", "v5e"
+
+    @classmethod
+    def resource_str(cls, res: "NodeResource") -> str:
+        return (
+            f"cpu={res.cpu},mem={res.memory_mb}MB,"
+            f"chips={res.tpu_chips}({res.tpu_type})"
+        )
+
+
+@dataclass
+class NodeGroupResource:
+    """Resource config of a node group (count × per-node resource)."""
+
+    count: int = 0
+    node_resource: NodeResource = field(default_factory=NodeResource)
+
+
+@dataclass
+class SliceTopology:
+    """ICI topology metadata of the slice a host belongs to.
+
+    The reference has only a stub net-topology module
+    (master/elastic_training/net_topology.py); on TPU the topology is
+    load-bearing: hosts in one slice share ICI, cross-slice traffic rides DCN.
+    """
+
+    slice_id: str = ""
+    slice_index: int = 0          # index of the slice within the job
+    hosts_per_slice: int = 1
+    host_index: int = 0           # index of this host within its slice
+    mesh_shape: str = ""          # e.g. "2x2x1" physical chip topology
+
+
+class Node:
+    """Mutable bookkeeping record of one node (reference: node.py Node)."""
+
+    def __init__(
+        self,
+        node_type: str = NodeType.WORKER,
+        node_id: int = 0,
+        rank_index: Optional[int] = None,
+        name: str = "",
+        status: str = NodeStatus.INITIAL,
+        config_resource: Optional[NodeResource] = None,
+        max_relaunch_count: int = 3,
+    ):
+        self.type = node_type
+        self.id = node_id
+        self.rank_index = rank_index if rank_index is not None else node_id
+        self.name = name or f"{node_type}-{node_id}"
+        self.status = status
+        self.config_resource = config_resource or NodeResource()
+        self.used_resource = NodeResource()
+        self.topology = SliceTopology()
+
+        self.create_time: Optional[float] = None
+        self.start_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.heartbeat_time: float = 0.0
+
+        self.exit_reason: str = ""
+        self.relaunch_count = 0
+        self.max_relaunch_count = max_relaunch_count
+        self.relaunchable = True
+        self.is_released = False
+        self.paral_config: Dict = {}
+        self.host_addr: str = ""
+
+    # ---- status helpers -------------------------------------------------
+
+    def update_status(self, status: str):
+        self.status = status
+        if status == NodeStatus.RUNNING and self.start_time is None:
+            self.start_time = time.time()
+        if status in NodeStatus.TERMINAL and self.finish_time is None:
+            self.finish_time = time.time()
+
+    def is_alive(self) -> bool:
+        return self.status in (NodeStatus.PENDING, NodeStatus.RUNNING)
+
+    def is_exited(self) -> bool:
+        return self.status in NodeStatus.TERMINAL
+
+    def should_relaunch(self) -> bool:
+        if not self.relaunchable:
+            return False
+        if self.exit_reason in NodeExitReason.NEVER_RELAUNCH:
+            return False
+        if self.exit_reason in NodeExitReason.NO_BUDGET:
+            return True
+        return self.relaunch_count < self.max_relaunch_count
+
+    def inc_relaunch_count(self):
+        if self.exit_reason not in NodeExitReason.NO_BUDGET:
+            self.relaunch_count += 1
+
+    def new_incarnation(self) -> "Node":
+        """Clone bookkeeping for a relaunched incarnation of this node."""
+        node = copy.copy(self)
+        node.status = NodeStatus.INITIAL
+        node.start_time = None
+        node.finish_time = None
+        node.exit_reason = ""
+        node.is_released = False
+        node.create_time = time.time()
+        return node
+
+    def __repr__(self):
+        return (
+            f"Node({self.name} status={self.status} rank={self.rank_index} "
+            f"relaunch={self.relaunch_count}/{self.max_relaunch_count})"
+        )

@@ -1,0 +1,156 @@
+"""Job masters: compose managers + transport; supervision loop.
+
+Reference: dlrover/python/master/dist_master.py:86 (DistributedJobMaster),
+local_master.py:38 (LocalJobMaster for single-node ``run`` CLI). One master
+process per job; agents talk to it over the typed gRPC transport.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.comm import MasterTransportServer
+from dlrover_tpu.common.constants import (
+    DefaultValues,
+    JobExitReason,
+    RendezvousName,
+)
+from dlrover_tpu.common.global_context import get_context
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.node_manager import JobManager, Scaler
+from dlrover_tpu.master.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.sync_service import SyncService
+from dlrover_tpu.master.task_manager import TaskManager
+
+logger = get_logger(__name__)
+
+
+class JobMaster:
+    """Composition root; subclasses pick scaler/watcher flavors."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        num_workers: int = 1,
+        max_workers: Optional[int] = None,
+        node_unit: int = 1,
+        scaler: Optional[Scaler] = None,
+    ):
+        ctx = get_context()
+        self.speed_monitor = SpeedMonitor()
+        self.job_manager = JobManager(
+            num_workers=num_workers,
+            relaunch_budget=ctx.relaunch_budget,
+            heartbeat_timeout_s=ctx.heartbeat_timeout_s,
+            pending_timeout_s=ctx.pending_timeout_s,
+            scaler=scaler,
+        )
+        self.task_manager = TaskManager(shard_timeout_s=ctx.shard_timeout_s)
+        self.task_manager.speed_monitor = self.speed_monitor
+        self.rdzv_managers: Dict[str, object] = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        max_w = max_workers or num_workers
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                min_nodes=num_workers,
+                max_nodes=max_w,
+                waiting_timeout=ctx.rdzv_wait_extra_nodes_s,
+                node_unit=node_unit,
+            )
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.sync_service.set_world_size_fn(
+            lambda: len(self.job_manager.running_nodes()) or 1
+        )
+        self.diagnosis_manager = None  # wired when diagnosis is enabled
+        self.servicer = MasterServicer(
+            job_manager=self.job_manager,
+            task_manager=self.task_manager,
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            speed_monitor=self.speed_monitor,
+            diagnosis_manager=self.diagnosis_manager,
+        )
+        self.server = MasterTransportServer(self.servicer, port=port)
+        self._stop = threading.Event()
+        self.exit_reason = ""
+
+        # wire elastic event callbacks: a dead node's shards re-queue and
+        # its rendezvous membership drops (reference: event_callback.py:42)
+        self.job_manager.node_failed_callbacks.append(self._on_node_down)
+
+    def _on_node_down(self, node):
+        self.task_manager.recover_worker_tasks(node.id)
+        for mgr in self.rdzv_managers.values():
+            mgr.remove_alive_node(node.rank_index)
+        self.speed_monitor.reset_running_speed()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def addr(self) -> str:
+        return f"localhost:{self.server.port}"
+
+    def prepare(self):
+        self.server.start()
+        self.task_manager.start()
+        self.job_manager.start()
+
+    def run(self, poll_interval_s: Optional[float] = None) -> str:
+        """Supervision loop (reference: dist_master.py:211)."""
+        ctx = get_context()
+        interval = poll_interval_s or ctx.supervise_interval_s
+        try:
+            while not self._stop.wait(interval):
+                if self.task_manager.finished():
+                    self.exit_reason = JobExitReason.SUCCEEDED
+                    break
+                if self.job_manager.all_workers_exited():
+                    if self.job_manager.all_workers_succeeded():
+                        self.exit_reason = JobExitReason.SUCCEEDED
+                    else:
+                        self.exit_reason = (
+                            JobExitReason.RELAUNCH_BUDGET_EXHAUSTED
+                        )
+                    break
+                if self.job_manager.pending_timeout():
+                    self.exit_reason = JobExitReason.PENDING_TIMEOUT
+                    break
+        finally:
+            self.stop()
+        logger.info("master exiting: %s", self.exit_reason)
+        return self.exit_reason
+
+    def request_stop(self, reason: str = ""):
+        self.exit_reason = reason or self.exit_reason
+        self._stop.set()
+
+    def stop(self):
+        self._stop.set()
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self.server.stop()
+
+
+class LocalJobMaster(JobMaster):
+    """In-process/subprocess master for single-host ``dlrover-tpu-run``."""
+
+
+class DistributedJobMaster(JobMaster):
+    """Multi-host master; platform scaler/watcher attach here."""
+
+
+def run_master_forever(master: JobMaster):
+    master.prepare()
+    return master.run()

@@ -1,0 +1,309 @@
+"""Job-level node lifecycle management.
+
+Reference: dlrover/python/master/node/dist_job_manager.py:88 (monitor loops,
+relaunch decisions), node/training_node.py, event_callback.py. The platform
+watcher/scaler pair is pluggable: tests use in-memory fakes, production uses
+the pod-slice scaler (``master/scaler.py``).
+"""
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    DefaultValues,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.messages import NodeMeta
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.status_flow import transition
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class NodeEvent:
+    event_type: str
+    node_id: int
+    status: str = ""
+    exit_reason: str = ""
+
+
+class ScalePlan:
+    """What the scaler must do (reference: scaler/base_scaler.py ScalePlan)."""
+
+    def __init__(self):
+        self.launch_nodes: List[Node] = []
+        self.remove_nodes: List[Node] = []
+        self.worker_num: Optional[int] = None
+
+    def empty(self) -> bool:
+        return not self.launch_nodes and not self.remove_nodes and (
+            self.worker_num is None
+        )
+
+    def __repr__(self):
+        return (
+            f"ScalePlan(launch={[n.name for n in self.launch_nodes]}, "
+            f"remove={[n.name for n in self.remove_nodes]}, "
+            f"worker_num={self.worker_num})"
+        )
+
+
+class Scaler:
+    """Executes ScalePlans on the platform."""
+
+    def scale(self, plan: ScalePlan):
+        raise NotImplementedError
+
+
+class NoopScaler(Scaler):
+    def __init__(self):
+        self.plans: List[ScalePlan] = []
+
+    def scale(self, plan: ScalePlan):
+        self.plans.append(plan)
+
+
+class JobManager:
+    """Track nodes, consume events, decide relaunches."""
+
+    def __init__(
+        self,
+        num_workers: int = 1,
+        relaunch_budget: int = DefaultValues.RELAUNCH_BUDGET,
+        heartbeat_timeout_s: float = DefaultValues.HEARTBEAT_TIMEOUT_S,
+        pending_timeout_s: float = DefaultValues.PENDING_TIMEOUT_S,
+        scaler: Optional[Scaler] = None,
+    ):
+        self._lock = threading.Lock()
+        self._nodes: Dict[int, Node] = {}
+        self._num_workers = num_workers
+        self._relaunch_budget = relaunch_budget
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+        self._pending_timeout_s = pending_timeout_s
+        self._scaler = scaler or NoopScaler()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._start_time = time.time()
+        # callbacks: fn(node) fired on terminal transitions
+        self.node_failed_callbacks: List[Callable[[Node], None]] = []
+        self.node_succeeded_callbacks: List[Callable[[Node], None]] = []
+        self._init_nodes()
+
+    def _init_nodes(self):
+        for i in range(self._num_workers):
+            self._nodes[i] = Node(
+                node_type=NodeType.WORKER,
+                node_id=i,
+                rank_index=i,
+                max_relaunch_count=self._relaunch_budget,
+            )
+            self._nodes[i].create_time = time.time()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self):
+        t = threading.Thread(
+            target=self._monitor_heartbeats, name="hb-monitor", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+
+    # ---- RPC-surface handlers -------------------------------------------
+
+    def register_node(self, meta: NodeMeta, restart_count: int = 0) -> Node:
+        with self._lock:
+            node = self._nodes.get(meta.node_id)
+            if node is None:
+                node = Node(
+                    node_type=meta.node_type or NodeType.WORKER,
+                    node_id=meta.node_id,
+                    rank_index=(
+                        meta.node_rank if meta.node_rank >= 0 else meta.node_id
+                    ),
+                    max_relaunch_count=self._relaunch_budget,
+                )
+                self._nodes[meta.node_id] = node
+            node.host_addr = meta.host_addr
+            node.config_resource = NodeResource(
+                tpu_chips=meta.local_chips, tpu_type=meta.tpu_type
+            )
+            node.topology.slice_id = meta.slice_id
+            node.topology.slice_index = meta.slice_index
+            node.heartbeat_time = time.time()
+            self._apply_status(node, NodeStatus.RUNNING)
+            logger.info("registered %s from %s", node, meta.host_addr)
+            return node
+
+    def handle_heartbeat(self, node_id: int) -> List[str]:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return []
+            node.heartbeat_time = time.time()
+            return []
+
+    def handle_status_report(
+        self, node_id: int, status: str, exit_reason: str = ""
+    ):
+        self.process_event(
+            NodeEvent(
+                NodeEventType.MODIFIED,
+                node_id,
+                status=status,
+                exit_reason=exit_reason,
+            )
+        )
+
+    # ---- event processing ------------------------------------------------
+
+    def process_event(self, event: NodeEvent):
+        with self._lock:
+            node = self._nodes.get(event.node_id)
+            if node is None:
+                return
+            if event.event_type == NodeEventType.HEARTBEAT_TIMEOUT:
+                status = NodeStatus.FAILED
+                node.exit_reason = NodeExitReason.KILLED
+            elif event.event_type == NodeEventType.DELETED:
+                status = NodeStatus.DELETED
+                node.exit_reason = event.exit_reason or NodeExitReason.KILLED
+            else:
+                status = event.status
+                if event.exit_reason:
+                    node.exit_reason = event.exit_reason
+            flow = transition(node.status, status)
+            if not flow.allowed:
+                return
+            self._apply_status(node, status)
+
+        if status in (NodeStatus.FAILED, NodeStatus.DELETED):
+            self._on_node_down(node)
+        elif status == NodeStatus.SUCCEEDED:
+            for cb in self.node_succeeded_callbacks:
+                cb(node)
+
+    def _apply_status(self, node: Node, status: str):
+        flow = transition(node.status, status)
+        if flow.allowed:
+            node.update_status(status)
+
+    def _on_node_down(self, node: Node):
+        for cb in self.node_failed_callbacks:
+            cb(node)
+        if node.should_relaunch():
+            node.inc_relaunch_count()
+            self._relaunch_node(node)
+        else:
+            logger.warning(
+                "%s exhausted relaunch budget (reason=%s)",
+                node,
+                node.exit_reason,
+            )
+
+    def _relaunch_node(self, node: Node):
+        logger.info(
+            "relaunching %s (attempt %d/%d, reason=%s)",
+            node.name,
+            node.relaunch_count,
+            node.max_relaunch_count,
+            node.exit_reason,
+        )
+        with self._lock:
+            new_node = node.new_incarnation()
+            self._nodes[node.id] = new_node
+        plan = ScalePlan()
+        plan.launch_nodes.append(new_node)
+        self._scaler.scale(plan)
+
+    # ---- monitors --------------------------------------------------------
+
+    def _monitor_heartbeats(self):
+        interval = min(30.0, self._heartbeat_timeout_s / 4)
+        while not self._stop.wait(interval):
+            now = time.time()
+            dead: List[int] = []
+            with self._lock:
+                for node in self._nodes.values():
+                    if node.status != NodeStatus.RUNNING:
+                        continue
+                    last = node.heartbeat_time or node.create_time or now
+                    if now - last > self._heartbeat_timeout_s:
+                        dead.append(node.id)
+            for node_id in dead:
+                logger.warning("node %d heartbeat timeout", node_id)
+                self.process_event(
+                    NodeEvent(NodeEventType.HEARTBEAT_TIMEOUT, node_id)
+                )
+
+    # ---- job-level queries ----------------------------------------------
+
+    def get_node(self, node_id: int) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def running_nodes(self) -> List[Node]:
+        with self._lock:
+            return [
+                n
+                for n in self._nodes.values()
+                if n.status == NodeStatus.RUNNING
+            ]
+
+    def all_workers_exited(self) -> bool:
+        with self._lock:
+            return all(n.is_exited() for n in self._nodes.values())
+
+    def all_workers_succeeded(self) -> bool:
+        with self._lock:
+            return all(
+                n.status == NodeStatus.SUCCEEDED for n in self._nodes.values()
+            )
+
+    def any_node_failed_fatally(self) -> bool:
+        with self._lock:
+            return any(
+                n.is_exited()
+                and n.status == NodeStatus.FAILED
+                and not n.should_relaunch()
+                for n in self._nodes.values()
+            )
+
+    def pending_timeout(self) -> bool:
+        now = time.time()
+        with self._lock:
+            for n in self._nodes.values():
+                if n.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
+                    created = n.create_time or self._start_time
+                    if now - created > self._pending_timeout_s:
+                        return True
+            return False
+
+    def set_worker_num(self, n: int):
+        """Elastic scale target; new node slots get fresh bookkeeping."""
+        with self._lock:
+            self._num_workers = n
+            for i in range(n):
+                if i not in self._nodes:
+                    node = Node(
+                        node_type=NodeType.WORKER,
+                        node_id=i,
+                        rank_index=i,
+                        max_relaunch_count=self._relaunch_budget,
+                    )
+                    node.create_time = time.time()
+                    self._nodes[i] = node
+
+    @property
+    def worker_num(self) -> int:
+        with self._lock:
+            return self._num_workers

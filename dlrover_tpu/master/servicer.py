@@ -1,0 +1,281 @@
+"""MasterServicer: demux the two-RPC surface onto the managers.
+
+Reference: dlrover/python/master/servicer.py:71 (single report/get pair
+demuxed on message type). Exceptions never cross the RPC edge — the
+transport returns Response(success=False).
+"""
+
+import time
+from typing import Optional
+
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        job_manager=None,
+        task_manager=None,
+        rdzv_managers=None,
+        kv_store=None,
+        sync_service=None,
+        speed_monitor=None,
+        diagnosis_manager=None,
+    ):
+        self.job_manager = job_manager
+        self.task_manager = task_manager
+        self.rdzv_managers = rdzv_managers or {}
+        self.kv_store = kv_store
+        self.sync_service = sync_service
+        self.speed_monitor = speed_monitor
+        self.diagnosis_manager = diagnosis_manager
+        self._ckpt_steps = {}  # node_rank -> step (flash-ckpt rank sync)
+
+    # ---- report: fire-and-forget ----------------------------------------
+
+    def report(self, msg) -> bool:
+        handler = self._REPORT_HANDLERS.get(type(msg).__name__)
+        if handler is None:
+            logger.warning("no report handler for %s", type(msg).__name__)
+            return False
+        return bool(handler(self, msg))
+
+    def _report_heartbeat(self, m: msgs.HeartbeatReport) -> bool:
+        if self.job_manager:
+            self.job_manager.handle_heartbeat(m.node_id)
+        return True
+
+    def _report_node_status(self, m: msgs.NodeStatusReport) -> bool:
+        if self.job_manager:
+            self.job_manager.handle_status_report(
+                m.node_id, m.status, m.exit_reason
+            )
+        return True
+
+    def _report_node_failure(self, m: msgs.NodeFailureReport) -> bool:
+        if self.diagnosis_manager:
+            self.diagnosis_manager.collect_failure(m)
+        # the restarting worker lost its in-flight shards — re-queue them
+        # (at-least-once delivery; reference: task_manager re-queue on death)
+        if self.task_manager:
+            self.task_manager.recover_worker_tasks(m.node_id)
+        logger.warning(
+            "node %d failure (level=%s restart=%d): %s",
+            m.node_id,
+            m.level,
+            m.restart_count,
+            m.error_data[:500],
+        )
+        return True
+
+    def _report_resource(self, m: msgs.ResourceStats) -> bool:
+        if self.diagnosis_manager:
+            self.diagnosis_manager.collect_resource(m)
+        return True
+
+    def _report_task_result(self, m: msgs.TaskResult) -> bool:
+        if self.task_manager:
+            self.task_manager.report_task_status(
+                m.dataset_name, m.task_id, m.success, m.worker_id
+            )
+        return True
+
+    def _report_dataset(self, m: msgs.DatasetShardParams) -> bool:
+        if self.task_manager:
+            self.task_manager.new_dataset(
+                m.dataset_name,
+                m.dataset_size,
+                m.shard_size,
+                num_epochs=m.num_epochs,
+                shuffle=m.shuffle,
+                storage_type=m.storage_type,
+                task_type=m.task_type,
+            )
+        return True
+
+    def _report_global_step(self, m: msgs.GlobalStepRecord) -> bool:
+        if self.speed_monitor:
+            self.speed_monitor.collect_global_step(
+                m.global_step, m.timestamp or time.time()
+            )
+        return True
+
+    def _report_network_check(self, m: msgs.NetworkCheckResult) -> bool:
+        mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if mgr:
+            mgr.report_network_check_result(
+                m.node_id, m.succeeded, m.elapsed_time
+            )
+        return True
+
+    def _report_kv(self, m: msgs.KeyValuePair) -> bool:
+        if self.kv_store:
+            self.kv_store.set(m.key, m.value)
+        return True
+
+    def _report_sync_join(self, m: msgs.SyncJoin) -> bool:
+        if self.sync_service:
+            return self.sync_service.join_sync(m.sync_name, m.node_rank)
+        return False
+
+    def _report_ckpt_step(self, m: msgs.CheckpointStepSync) -> bool:
+        self._ckpt_steps[m.node_rank] = m.step
+        return True
+
+    def _report_shard_ckpt(self, m: msgs.ShardCheckpoint) -> bool:
+        if self.task_manager:
+            self.task_manager.restore_checkpoint(m.dataset_name, m.content)
+        return True
+
+    _REPORT_HANDLERS = {
+        "HeartbeatReport": _report_heartbeat,
+        "NodeStatusReport": _report_node_status,
+        "NodeFailureReport": _report_node_failure,
+        "ResourceStats": _report_resource,
+        "TaskResult": _report_task_result,
+        "DatasetShardParams": _report_dataset,
+        "GlobalStepRecord": _report_global_step,
+        "NetworkCheckResult": _report_network_check,
+        "KeyValuePair": _report_kv,
+        "SyncJoin": _report_sync_join,
+        "CheckpointStepSync": _report_ckpt_step,
+        "ShardCheckpoint": _report_shard_ckpt,
+    }
+
+    # ---- get: request → response ----------------------------------------
+
+    def get(self, msg):
+        handler = self._GET_HANDLERS.get(type(msg).__name__)
+        if handler is None:
+            logger.warning("no get handler for %s", type(msg).__name__)
+            return None
+        return handler(self, msg)
+
+    def _get_register(self, m: msgs.NodeRegisterRequest):
+        if self.job_manager and m.meta:
+            node = self.job_manager.register_node(m.meta, m.restart_count)
+            for mgr in self.rdzv_managers.values():
+                mgr.add_alive_node(node.rank_index)
+            return msgs.NodeRegisterResponse(
+                success=True,
+                node_rank=node.rank_index,
+                node_num=self.job_manager.worker_num,
+            )
+        return msgs.NodeRegisterResponse(success=False)
+
+    def _get_join_rdzv(self, m: msgs.JoinRendezvousRequest):
+        mgr = self.rdzv_managers.get(m.rdzv_name)
+        if mgr is None:
+            return None
+        node = (
+            self.job_manager.get_node(m.node_id) if self.job_manager else None
+        )
+        host = node.host_addr if node else ""
+        rdzv_round = mgr.join_rendezvous(
+            m.node_id, m.node_rank, m.local_world_size, host_addr=host
+        )
+        return msgs.JoinRendezvousResponse(round=rdzv_round)
+
+    def _get_comm_world(self, m: msgs.CommWorldRequest):
+        mgr = self.rdzv_managers.get(m.rdzv_name)
+        if mgr is None:
+            return None
+        rdzv_round, group, world, coord = mgr.get_comm_world(m.node_id)
+        return msgs.CommWorldResponse(
+            rdzv_round=rdzv_round,
+            group=group,
+            world={str(k): v for k, v in world.items()},
+            coordinator=coord,
+        )
+
+    def _get_num_nodes_waiting(self, m: msgs.NumNodesWaitingRequest):
+        mgr = self.rdzv_managers.get(m.rdzv_name)
+        n = mgr.num_nodes_waiting() if mgr else 0
+        return msgs.NumNodesWaitingResponse(waiting_num=n)
+
+    def _get_network_status(self, m: msgs.NetworkCheckStatusRequest):
+        mgr = self.rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if mgr is None:
+            return msgs.NetworkCheckStatusResponse()
+        fault, _ = mgr.check_fault_node()
+        stragglers, _ = mgr.get_stragglers()
+        return msgs.NetworkCheckStatusResponse(
+            normal=m.node_id not in fault,
+            fault_nodes=fault,
+            stragglers=stragglers,
+        )
+
+    def _get_task(self, m: msgs.TaskRequest):
+        if self.task_manager is None:
+            return msgs.Task()
+        task = self.task_manager.get_task(m.dataset_name, m.worker_id)
+        return msgs.Task(
+            task_id=task.task_id,
+            task_type=task.task_type,
+            dataset_name=m.dataset_name,
+            shard_start=task.shard.start,
+            shard_end=task.shard.end,
+            epoch=task.epoch,
+            record_indices=list(task.shard.record_indices),
+        )
+
+    def _get_shard_ckpt(self, m: msgs.ShardCheckpointRequest):
+        if self.task_manager is None:
+            return msgs.ShardCheckpoint()
+        return msgs.ShardCheckpoint(
+            dataset_name=m.dataset_name,
+            content=self.task_manager.checkpoint(m.dataset_name),
+        )
+
+    def _get_epoch(self, m: msgs.DatasetEpochRequest):
+        epoch = (
+            self.task_manager.get_epoch(m.dataset_name)
+            if self.task_manager
+            else 0
+        )
+        return msgs.DatasetEpochResponse(epoch=epoch)
+
+    def _get_kv(self, m: msgs.KeyRequest):
+        value = self.kv_store.get(m.key) if self.kv_store else ""
+        return msgs.KeyValuePair(key=m.key, value=value)
+
+    def _get_sync(self, m: msgs.SyncRequest):
+        ok = (
+            self.sync_service.sync_finished(m.sync_name)
+            if self.sync_service
+            else False
+        )
+        return msgs.SyncResponse(success=ok)
+
+    def _get_ckpt_step(self, m: msgs.CheckpointStepRequest):
+        if not self._ckpt_steps:
+            return msgs.CheckpointStepResponse(step=0)
+        return msgs.CheckpointStepResponse(
+            step=min(self._ckpt_steps.values())
+        )
+
+    def _get_paral_config(self, m: msgs.ParallelConfigRequest):
+        node = (
+            self.job_manager.get_node(m.node_id) if self.job_manager else None
+        )
+        cfg = node.paral_config if node else {}
+        return msgs.ParallelConfig(**cfg) if cfg else msgs.ParallelConfig()
+
+    _GET_HANDLERS = {
+        "NodeRegisterRequest": _get_register,
+        "JoinRendezvousRequest": _get_join_rdzv,
+        "CommWorldRequest": _get_comm_world,
+        "NetworkCheckStatusRequest": _get_network_status,
+        "NumNodesWaitingRequest": _get_num_nodes_waiting,
+        "TaskRequest": _get_task,
+        "ShardCheckpointRequest": _get_shard_ckpt,
+        "DatasetEpochRequest": _get_epoch,
+        "KeyRequest": _get_kv,
+        "SyncRequest": _get_sync,
+        "CheckpointStepRequest": _get_ckpt_step,
+        "ParallelConfigRequest": _get_paral_config,
+    }

@@ -1,0 +1,63 @@
+"""Training speed monitor (reference: monitor/speed_monitor.py:43).
+
+Collects (timestamp, global_step) reports and derives samples/sec; provides
+the straggler baseline and the goodput numerator (steps while healthy).
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+from dlrover_tpu.common.constants import DefaultValues
+
+
+class SpeedMonitor:
+    def __init__(self, window: int = DefaultValues.SPEED_MONITOR_WINDOW):
+        self._lock = threading.Lock()
+        self._records: Deque[Tuple[float, int]] = deque(maxlen=window)
+        self._global_step = 0
+        self._start_time = time.time()
+        self._worker_num = 0
+        self._init_step = 0
+        self._first_report: Optional[float] = None
+
+    def set_worker_num(self, n: int):
+        with self._lock:
+            self._worker_num = n
+
+    def collect_global_step(self, step: int, timestamp: float = 0.0):
+        ts = timestamp or time.time()
+        with self._lock:
+            if self._first_report is None:
+                self._first_report = ts
+                self._init_step = step
+            self._global_step = step
+            self._records.append((ts, step))
+
+    @property
+    def global_step(self) -> int:
+        with self._lock:
+            return self._global_step
+
+    @property
+    def running_speed(self) -> float:
+        """steps/sec over the sliding window."""
+        with self._lock:
+            if len(self._records) < 2:
+                return 0.0
+            (t0, s0), (t1, s1) = self._records[0], self._records[-1]
+            if t1 <= t0:
+                return 0.0
+            return (s1 - s0) / (t1 - t0)
+
+    def all_time_speed(self) -> float:
+        with self._lock:
+            if self._first_report is None:
+                return 0.0
+            dt = time.time() - self._first_report
+            return (self._global_step - self._init_step) / dt if dt > 0 else 0.0
+
+    def reset_running_speed(self):
+        with self._lock:
+            self._records.clear()

@@ -1,0 +1,6 @@
+from dlrover_tpu.models.config import (  # noqa: F401
+    ModelConfig,
+    CONFIGS,
+    get_config,
+)
+from dlrover_tpu.models import decoder  # noqa: F401

@@ -1,0 +1,112 @@
+"""Model configurations for the flagship decoder family.
+
+Sizes mirror the models the reference benchmarks with
+(GPT-2 1.5B for flash-checkpoint, Llama2-7B for ATorch throughput —
+BASELINE.md #3-#11), plus small configs for tests and CI.
+"""
+
+from dataclasses import dataclass, field, replace
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny"
+    vocab_size: int = 50304          # padded to a multiple of 128 for the MXU
+    n_layer: int = 2
+    n_head: int = 4
+    n_kv_head: Optional[int] = None  # GQA; None = n_head
+    d_model: int = 128
+    d_ff: int = 512
+    max_seq: int = 256
+    # architecture family
+    norm: str = "rmsnorm"            # rmsnorm | layernorm
+    act: str = "swiglu"              # swiglu | gelu
+    pos: str = "rope"                # rope | learned
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    # numerics
+    dtype: str = "bfloat16"          # activation/compute dtype
+    param_dtype: str = "float32"
+    # rematerialisation policy: none | full | dots_saveable
+    remat: str = "none"
+    # MoE (0 = dense)
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    def num_params(self) -> int:
+        """Approximate parameter count (dense part)."""
+        d, f, v, L = self.d_model, self.d_ff, self.vocab_size, self.n_layer
+        attn = d * d + 2 * d * self.kv_heads * self.head_dim + d * d
+        mlp = (3 if self.act == "swiglu" else 2) * d * f
+        per_layer = attn + mlp + 2 * d
+        embed = v * d * (1 if self.tie_embeddings else 2)
+        pos = self.max_seq * d if self.pos == "learned" else 0
+        return L * per_layer + embed + pos + d
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token ≈ 6·N + attention term (fwd+bwd)."""
+        n = self.num_params()
+        attn_flops = 12 * self.n_layer * self.d_model * seq_len
+        return 6.0 * n + attn_flops
+
+
+def _gpt2(name, n_layer, n_head, d_model, max_seq=1024):
+    return ModelConfig(
+        name=name,
+        vocab_size=50304,
+        n_layer=n_layer,
+        n_head=n_head,
+        d_model=d_model,
+        d_ff=4 * d_model,
+        max_seq=max_seq,
+        norm="layernorm",
+        act="gelu",
+        pos="learned",
+        tie_embeddings=True,
+    )
+
+
+def _llama(name, n_layer, n_head, d_model, d_ff, max_seq=4096, n_kv_head=None):
+    return ModelConfig(
+        name=name,
+        vocab_size=32000,
+        n_layer=n_layer,
+        n_head=n_head,
+        n_kv_head=n_kv_head,
+        d_model=d_model,
+        d_ff=d_ff,
+        max_seq=max_seq,
+        norm="rmsnorm",
+        act="swiglu",
+        pos="rope",
+        tie_embeddings=False,
+    )
+
+
+CONFIGS = {
+    "tiny": ModelConfig(),
+    "tiny-moe": replace(ModelConfig(name="tiny-moe"), n_experts=4),
+    "gpt2-124m": _gpt2("gpt2-124m", 12, 12, 768),
+    "gpt2-355m": _gpt2("gpt2-355m", 24, 16, 1024),
+    "gpt2-1.5b": _gpt2("gpt2-1.5b", 48, 25, 1600),
+    "llama2-7b": _llama("llama2-7b", 32, 32, 4096, 11008),
+    "llama2-13b": _llama("llama2-13b", 40, 40, 5120, 13824),
+    "llama3-8b": _llama(
+        "llama3-8b", 32, 32, 4096, 14336, max_seq=8192, n_kv_head=8
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    cfg = CONFIGS[name]
+    return replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,314 @@
+"""Flagship decoder-only transformer, TPU-first.
+
+One functional model covers the GPT-2 and LLaMA families (configs in
+``models/config.py``). Design choices driven by XLA/TPU:
+
+- **scan over layers**: per-layer params are stacked on a leading axis and
+  the block is a ``lax.scan`` body — one compilation of the layer regardless
+  of depth (the reference re-traces per module; atorch
+  modules/distributed_modules/transformer.py builds per-layer graphs).
+- **parallelism by PartitionSpec, not module swap**: parameters carry
+  logical axes (``dlrover_tpu/parallel/sharding.py``); FSDP/TP/SP are rule
+  changes, the model code never branches on parallelism (contrast
+  atorch layers.py:239 RowParallelLinear module replacement).
+- **mixed precision**: params in fp32, compute in bf16, loss/logits fp32 —
+  keeps the MXU on bf16 without loss-scale bookkeeping (the reference needs
+  GradScaler, atorch amp_optimization.py:28).
+- **remat**: ``jax.checkpoint`` over the scan body trades FLOPs for HBM
+  (reference: checkpoint_optimization.py:15).
+"""
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.ad_checkpoint import checkpoint_policies as cp
+
+from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.ops.attention import mha_reference
+from dlrover_tpu.parallel import sharding as shd
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(key, shape, in_axis_size, dtype):
+    scale = 1.0 / np.sqrt(in_axis_size)
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Initialise parameters; per-layer tensors stacked on axis 0."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    hd, nh, nkv, L = cfg.head_dim, cfg.n_head, cfg.kv_heads, cfg.n_layer
+    keys = jax.random.split(rng, 16)
+
+    def stack(key, shape, fan_in):
+        # one RNG draw for all layers: tiny init graph, fast remote compile
+        scale = 1.0 / np.sqrt(fan_in)
+        return (jax.random.normal(key, (L,) + shape) * scale).astype(pdt)
+
+    params: Params = {
+        "embed": {
+            "tokens": (jax.random.normal(keys[0], (v, d)) * 0.02).astype(pdt)
+        },
+        "layers": {
+            "attn": {
+                "wq": stack(keys[1], (d, nh * hd), d),
+                "wk": stack(keys[2], (d, nkv * hd), d),
+                "wv": stack(keys[3], (d, nkv * hd), d),
+                "wo": stack(keys[4], (nh * hd, d), nh * hd),
+            },
+            "ln1": {"scale": jnp.ones((L, d), pdt)},
+            "ln2": {"scale": jnp.ones((L, d), pdt)},
+        },
+        "final_norm": {"scale": jnp.ones((d,), pdt)},
+    }
+    if cfg.act == "swiglu":
+        params["layers"]["mlp"] = {
+            "w_gate": stack(keys[5], (d, f), d),
+            "w_up": stack(keys[6], (d, f), d),
+            "w_down": stack(keys[7], (f, d), f),
+        }
+    else:
+        params["layers"]["mlp"] = {
+            "w_up": stack(keys[6], (d, f), d),
+            "w_down": stack(keys[7], (f, d), f),
+        }
+    if cfg.norm == "layernorm":
+        params["layers"]["ln1"]["bias"] = jnp.zeros((L, d), pdt)
+        params["layers"]["ln2"]["bias"] = jnp.zeros((L, d), pdt)
+        params["final_norm"]["bias"] = jnp.zeros((d,), pdt)
+    if cfg.pos == "learned":
+        params["pos_embed"] = {
+            "table": (
+                jax.random.normal(keys[8], (cfg.max_seq, d)) * 0.01
+            ).astype(pdt)
+        }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"w": _dense_init(keys[9], (d, v), d, pdt)}
+    if cfg.n_experts > 0:
+        from dlrover_tpu.parallel.moe import init_moe_params
+
+        params["layers"]["moe"] = init_moe_params(keys[10], cfg)
+    return params
+
+
+def logical_axes(cfg: ModelConfig) -> Params:
+    """Pytree of logical-axis tuples, same structure as ``init``'s output."""
+    ax: Params = {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": {
+            "attn": {
+                "wq": ("layers", "embed", "heads"),
+                "wk": ("layers", "embed", "kv"),
+                "wv": ("layers", "embed", "kv"),
+                "wo": ("layers", "heads", "embed"),
+            },
+            "ln1": {"scale": ("layers", "norm")},
+            "ln2": {"scale": ("layers", "norm")},
+        },
+        "final_norm": {"scale": ("norm",)},
+    }
+    if cfg.act == "swiglu":
+        ax["layers"]["mlp"] = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
+    else:
+        ax["layers"]["mlp"] = {
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
+    if cfg.norm == "layernorm":
+        ax["layers"]["ln1"]["bias"] = ("layers", "norm")
+        ax["layers"]["ln2"]["bias"] = ("layers", "norm")
+        ax["final_norm"]["bias"] = ("norm",)
+    if cfg.pos == "learned":
+        ax["pos_embed"] = {"table": ("seq", "embed")}
+    if not cfg.tie_embeddings:
+        ax["lm_head"] = {"w": ("embed", "vocab")}
+    if cfg.n_experts > 0:
+        from dlrover_tpu.parallel.moe import moe_logical_axes
+
+        ax["layers"]["moe"] = moe_logical_axes(cfg)
+    return ax
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _norm(x, scale, bias, kind: str):
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        rms = jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6)
+        out = x32 * rms * scale.astype(jnp.float32)
+    else:
+        mean = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.var(x32, -1, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+        out = out * scale.astype(jnp.float32)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x:[B,S,H,D], positions:[B,S]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+def _attention_block(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
+    b, s, d = x.shape
+    nh, nkv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    q = (x @ layer["attn"]["wq"].astype(x.dtype)).reshape(b, s, nh, hd)
+    k = (x @ layer["attn"]["wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
+    v = (x @ layer["attn"]["wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
+    if cfg.pos == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    if mesh is not None:
+        q = shd.constrain(q, mesh, "batch", "seq", "heads", None)
+        k = shd.constrain(k, mesh, "batch", "seq", "kv", None)
+        v = shd.constrain(v, mesh, "batch", "seq", "kv", None)
+    out = attn_fn(q, k, v)
+    out = out.reshape(b, s, nh * hd)
+    return out @ layer["attn"]["wo"].astype(x.dtype)
+
+
+def _mlp_block(x, layer, cfg: ModelConfig, mesh):
+    mlp = layer["mlp"]
+    if cfg.act == "swiglu":
+        gate = x @ mlp["w_gate"].astype(x.dtype)
+        up = x @ mlp["w_up"].astype(x.dtype)
+        h = jax.nn.silu(gate) * up
+    else:
+        h = jax.nn.gelu(x @ mlp["w_up"].astype(x.dtype))
+    if mesh is not None:
+        h = shd.constrain(h, mesh, "batch", "seq", "mlp")
+    return h @ mlp["w_down"].astype(x.dtype)
+
+
+def _layer_body(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
+    ln1, ln2 = layer["ln1"], layer["ln2"]
+    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+    x = x + _attention_block(h, layer, cfg, mesh, positions, attn_fn)
+    h = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+    if cfg.n_experts > 0:
+        from dlrover_tpu.parallel.moe import moe_block
+
+        x = x + moe_block(h, layer["moe"], cfg, mesh)
+    else:
+        x = x + _mlp_block(h, layer, cfg, mesh)
+    if mesh is not None:
+        x = shd.constrain(x, mesh, "batch", "seq", None)
+    return x
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    mesh=None,
+    positions: Optional[jax.Array] = None,
+    attn_impl: str = "auto",
+) -> jax.Array:
+    """tokens:[B,S] int32 → logits:[B,S,vocab] float32."""
+    dt = jnp.dtype(cfg.dtype)
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+    if mesh is not None:
+        x = shd.constrain(x, mesh, "batch", "seq", None)
+
+    if attn_impl == "auto" and jax.default_backend() == "cpu":
+        attn_impl = "reference"
+
+    def attn_fn(q, k, v):
+        if attn_impl in ("reference", "auto"):
+            return mha_reference(q, k, v, causal=True)
+        from dlrover_tpu.ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+
+    body = functools.partial(
+        _layer_body, cfg=cfg, mesh=mesh, positions=positions, attn_fn=attn_fn
+    )
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+    elif cfg.remat == "dots_saveable":
+        body = jax.checkpoint(body, policy=cp.dots_saveable)
+
+    def scan_fn(carry, layer):
+        return body(carry, layer), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt), preferred_element_type=jnp.float32
+    )
+    return logits
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    cfg: ModelConfig,
+    mesh=None,
+    z_loss: float = 0.0,
+    attn_impl: str = "auto",
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S]}."""
+    logits = forward(
+        params, batch["tokens"], cfg, mesh=mesh, attn_impl=attn_impl
+    )
+    targets = batch["targets"]
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones_like(targets, dtype=jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    )[..., 0]
+    nll = (logz - tgt_logit) * mask
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = nll.sum() / denom
+    metrics = {"loss": loss, "tokens": mask.sum()}
+    if z_loss > 0.0:
+        zl = z_loss * jnp.sum((logz * mask) ** 2) / denom
+        loss = loss + zl
+        metrics["z_loss"] = zl
+    acc = (jnp.argmax(logits, -1) == targets).astype(jnp.float32) * mask
+    metrics["accuracy"] = acc.sum() / denom
+    return loss, metrics

@@ -1,0 +1,102 @@
+"""Multi-head attention ops.
+
+TPU counterpart of the reference's flash-attention integrations
+(atorch modules/transformer/layers.py:538 FlashMHA wrappers; tfplus
+flash_attn C++/CUDA glue). Here the op surface is one function,
+``mha(q, k, v, causal=...)``:
+
+- ``mha_reference`` — plain jnp einsum softmax attention (always available;
+  XLA already fuses it well on small/medium sequences).
+- ``flash_attention`` — Pallas TPU kernel (ops/pallas_attention.py), used
+  automatically on TPU backends for long sequences.
+
+All inputs are ``[batch, seq, heads, head_dim]``; GQA is expressed by
+passing k/v with fewer heads (they are repeated on the fly).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain attention. q:[B,S,H,D], k/v:[B,S,Hkv,D] → [B,S,H,D]."""
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hkv != h:
+        k = _repeat_kv(k, h // hkv)
+        v = _repeat_kv(v, h // hkv)
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = q_pos >= k_pos - (sk - sq)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None, :sq, :sk], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "softmax_scale", "impl")
+)
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching attention entry point.
+
+    ``impl``: "auto" picks the Pallas flash kernel on TPU for seq >= 1024,
+    plain jnp otherwise. "reference" / "flash" force a path.
+    """
+    use_flash = False
+    if impl == "flash":
+        use_flash = True
+    elif impl == "auto":
+        on_tpu = jax.default_backend() not in ("cpu", "gpu")
+        use_flash = on_tpu and q.shape[1] >= 1024 and segment_ids is None
+    if use_flash:
+        from dlrover_tpu.ops.pallas_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, softmax_scale=softmax_scale
+        )
+    return mha_reference(
+        q,
+        k,
+        v,
+        causal=causal,
+        segment_ids=segment_ids,
+        softmax_scale=softmax_scale,
+    )

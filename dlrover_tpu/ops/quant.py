@@ -1,0 +1,90 @@
+"""Block-wise int8 quantization for optimizer state.
+
+Reference: atorch's CUDA quantization kernels + low-bit optimizer
+(atorch/ops/csrc/quantization/*.cu, optimizers/low_bit/functional.py:543L).
+TPU-native: the quantize/dequantize math is plain jnp — XLA fuses it into
+the optimizer update so there is no extra HBM round-trip, which is what the
+hand-written CUDA kernels existed to avoid.
+
+``quantize_optimizer_state(opt)`` wraps any optax transformation so its
+large float32 state leaves (Adam moments etc.) live as int8 + per-block
+scales — a ~3.5× optimizer-memory cut.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 256
+MIN_QUANT_SIZE = 4096  # leave small leaves (scalars, counts) untouched
+
+
+class QuantizedArray(NamedTuple):
+    """int8 payload + per-block scales; shape/dtype kept for dequant."""
+
+    q: jax.Array          # int8 [n_blocks, BLOCK]
+    scale: jax.Array      # f32 [n_blocks, 1]
+    meta: Any             # jax.ShapeDtypeStruct of the original
+
+
+def quantize(x: jax.Array) -> QuantizedArray:
+    meta = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % BLOCK
+    flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+    return QuantizedArray(q=q, scale=scale, meta=meta)
+
+
+def dequantize(qa: QuantizedArray) -> jax.Array:
+    flat = (qa.q.astype(jnp.float32) * qa.scale).reshape(-1)
+    size = 1
+    for d in qa.meta.shape:
+        size *= d
+    return flat[:size].reshape(qa.meta.shape).astype(qa.meta.dtype)
+
+
+def _should_quantize(leaf) -> bool:
+    return (
+        isinstance(leaf, (jax.Array, jnp.ndarray))
+        and jnp.issubdtype(leaf.dtype, jnp.floating)
+        and leaf.size >= MIN_QUANT_SIZE
+    )
+
+
+def _quantize_tree(state):
+    return jax.tree.map(
+        lambda leaf: quantize(leaf) if _should_quantize(leaf) else leaf,
+        state,
+    )
+
+
+def _dequantize_tree(state):
+    return jax.tree.map(
+        lambda leaf: dequantize(leaf)
+        if isinstance(leaf, QuantizedArray)
+        else leaf,
+        state,
+        is_leaf=lambda x: isinstance(x, QuantizedArray),
+    )
+
+
+def quantize_optimizer_state(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Keep ``inner``'s large state leaves as block-quantized int8."""
+
+    def init_fn(params):
+        return _quantize_tree(inner.init(params))
+
+    def update_fn(updates, state, params=None):
+        full = _dequantize_tree(state)
+        updates, new_state = inner.update(updates, full, params)
+        return updates, _quantize_tree(new_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,10 @@
+from dlrover_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES,
+    MeshConfig,
+    build_mesh,
+)
+from dlrover_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    logical_to_mesh_axes,
+    shardings_for_tree,
+)

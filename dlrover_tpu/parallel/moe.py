@@ -1,0 +1,108 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+Reference: atorch/atorch/modules/moe/moe_layer.py (MOELayer with explicit
+``_AllToAll`` autograd ops and expert process groups) and grouped_gemm_moe.py.
+TPU-native design: token-choice top-k gating lowered to dense one-hot
+dispatch/combine einsums; sharding the expert axis over ``ep`` makes XLA
+emit the all-to-alls on ICI — no hand-written collectives, and the expert
+FFN is a single batched matmul on the MXU (the grouped-GEMM equivalent).
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.parallel import sharding as shd
+
+
+def init_moe_params(rng, cfg) -> Dict:
+    """Stacked per-layer MoE params: experts on axis 1, layers on axis 0."""
+    d, f, e, L = cfg.d_model, cfg.d_ff, cfg.n_experts, cfg.n_layer
+    pdt = jnp.dtype(cfg.param_dtype)
+    k = jax.random.split(rng, 4)
+    s_in = 1.0 / jnp.sqrt(d)
+    s_out = 1.0 / jnp.sqrt(f)
+    return {
+        "w_gate": (jax.random.normal(k[0], (L, d, e)) * s_in).astype(pdt),
+        "w_up": (jax.random.normal(k[1], (L, e, d, f)) * s_in).astype(pdt),
+        "w_gate_proj": (
+            jax.random.normal(k[2], (L, e, d, f)) * s_in
+        ).astype(pdt),
+        "w_down": (jax.random.normal(k[3], (L, e, f, d)) * s_out).astype(pdt),
+    }
+
+
+def moe_logical_axes(cfg) -> Dict:
+    return {
+        "w_gate": ("layers", "embed", None),
+        "w_up": ("layers", "expert", "embed", "mlp"),
+        "w_gate_proj": ("layers", "expert", "embed", "mlp"),
+        "w_down": ("layers", "expert", "mlp", "embed"),
+    }
+
+
+def top_k_gating(gate_logits: jax.Array, k: int, capacity: int):
+    """Token-choice top-k routing with per-sequence capacity.
+
+    gate_logits: [B, S, E] → (dispatch [B,S,E,C] bool, combine [B,S,E,C]).
+    Tokens overflowing an expert's capacity are dropped (standard GShard
+    behavior; the residual connection carries them through).
+    """
+    b, s, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [B,S,k]
+    # one-hot expert assignment per choice: [B, S, k, E]
+    assign = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
+    # position of each (token, choice) in its expert's buffer, counted over
+    # the flattened (S, k) order.
+    flat = assign.reshape(b, s * k, e)
+    pos = jnp.cumsum(flat, axis=1) - flat  # [B, S*k, E]
+    pos = pos.reshape(b, s, k, e)
+    in_cap = pos < capacity
+    assign = assign * in_cap
+    pos = jnp.einsum("bske,bske->bsk", pos, assign)  # chosen slot per choice
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    kept = assign.sum(-1)  # [B,S,k] 1 if kept
+    # renormalise combine weights over kept choices
+    denom = jnp.maximum((gate_vals * kept).sum(-1, keepdims=True), 1e-9)
+    weights = gate_vals * kept / denom
+    dispatch = jnp.einsum("bske,bskc->bsec", assign, slot)
+    combine = jnp.einsum("bsk,bske,bskc->bsec", weights, assign, slot)
+    return dispatch, combine, probs
+
+
+def load_balancing_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
+    """GShard aux loss: E · Σ_e f_e · p_e (probs [B,S,E], dispatch [B,S,E,C])."""
+    e = probs.shape[-1]
+    frac_tokens = dispatch.sum(-1).mean(axis=(0, 1))  # [E]
+    frac_probs = probs.mean(axis=(0, 1))  # [E]
+    return e * jnp.sum(frac_tokens * frac_probs)
+
+
+def moe_block(x: jax.Array, moe: Dict, cfg, mesh=None) -> jax.Array:
+    """x: [B,S,D] → [B,S,D]. Expert FFN sharded over the ``ep`` axis."""
+    b, s, d = x.shape
+    e, k = cfg.n_experts, cfg.expert_top_k
+    capacity = max(1, int(cfg.capacity_factor * s * k / e))
+    gate_logits = x @ moe["w_gate"].astype(x.dtype)
+    dispatch, combine, _probs = top_k_gating(gate_logits, k, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    # [E, B, C, D]: this einsum is the all-to-all when x is dp-sharded and
+    # expert tensors are ep-sharded.
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+    if mesh is not None:
+        expert_in = shd.constrain(expert_in, mesh, "expert", "batch", None, None)
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, moe["w_up"].astype(x.dtype))
+    gate_p = jnp.einsum(
+        "ebcd,edf->ebcf", expert_in, moe["w_gate_proj"].astype(x.dtype)
+    )
+    h = jax.nn.silu(gate_p) * up
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, moe["w_down"].astype(x.dtype))
+    if mesh is not None:
+        expert_out = shd.constrain(
+            expert_out, mesh, "expert", "batch", None, None
+        )
+    return jnp.einsum("ebcd,bsec->bsd", expert_out, combine)

@@ -1,0 +1,95 @@
+"""Logical-axis sharding rules.
+
+The reference achieves TP by *swapping modules* for Megatron-style parallel
+layers (atorch opt_lib/tensor_parallel_optimization.py:23, layers.py:239) and
+FSDP by wrapping. On TPU neither is needed: model code stays the same and
+parallelism is a *pytree of PartitionSpecs* computed from per-parameter
+logical axis names (t5x-style rules). Changing strategy = changing rules,
+not the model.
+
+Each parameter carries logical axes, e.g. ``("vocab", "embed")`` for the
+embedding table; rules map logical axis → mesh axis (or None = replicate).
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+# rules: logical axis name -> mesh axis (or tuple, or None)
+Rules = Dict[str, MeshAxes]
+
+# The default "3D + sequence" ruleset:
+#  - batch over (dp, fsdp): standard fsdp data sharding
+#  - seq over sp: sequence/context parallelism
+#  - embed over fsdp: ZeRO-3 parameter sharding along the model dim
+#  - heads/mlp/vocab over tp: Megatron-style tensor parallelism
+#  - experts over ep; layers (scan axis) over pp when pipelining
+DEFAULT_RULES: Rules = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "vocab": "tp",
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "expert": "ep",
+    "layers": None,
+    "norm": None,
+}
+
+
+def logical_to_mesh_axes(
+    logical_axes: Optional[Sequence[Optional[str]]],
+    rules: Rules,
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    if logical_axes is None:
+        return P()
+    spec: List[MeshAxes] = []
+    used: set = set()
+    for name in logical_axes:
+        axis = rules.get(name) if name is not None else None
+        # One mesh axis may shard at most one tensor dim.
+        if axis is not None:
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            if any(a in used for a in axes):
+                axis = None
+            else:
+                used.update(axes)
+        spec.append(axis)
+    while spec and spec[-1] is None:
+        spec.pop()
+    return P(*spec)
+
+
+def shardings_for_tree(
+    mesh: Mesh,
+    logical_tree,
+    rules: Optional[Rules] = None,
+):
+    """Pytree of logical-axes tuples → pytree of NamedSharding."""
+    rules = dict(DEFAULT_RULES, **(rules or {}))
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_mesh_axes(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: x is None or isinstance(x, tuple),
+    )
+
+
+def specs_for_tree(logical_tree, rules: Optional[Rules] = None):
+    rules = dict(DEFAULT_RULES, **(rules or {}))
+    return jax.tree.map(
+        lambda axes: logical_to_mesh_axes(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: x is None or isinstance(x, tuple),
+    )
+
+
+def constrain(x, mesh: Mesh, *logical_axes: Optional[str], rules=None):
+    """``with_sharding_constraint`` by logical axis names."""
+    rules = dict(DEFAULT_RULES, **(rules or {}))
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

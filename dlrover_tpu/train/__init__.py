@@ -1,0 +1,6 @@
+from dlrover_tpu.train.optimizer import make_optimizer  # noqa: F401
+from dlrover_tpu.train.train_step import (  # noqa: F401
+    TrainStepBuilder,
+    batch_sharding,
+    init_train_state,
+)

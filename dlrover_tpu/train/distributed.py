@@ -1,0 +1,68 @@
+"""Worker-side distributed bootstrap.
+
+The agent hands the sealed rendezvous world to the worker via env vars;
+``init_distributed()`` turns them into a ``jax.distributed`` cluster so
+every host's chips join one global device mesh. Reference analog: the
+torch-elastic worker picking up MASTER_ADDR/RANK env and NCCL init
+(elastic_agent/torch/training.py worker spec), replaced by XLA's
+coordination service over DCN.
+"""
+
+import os
+from typing import Optional
+
+import jax
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def init_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialise jax.distributed from agent-provided env. Idempotent.
+
+    Returns True if a multi-process cluster was formed.
+    """
+    coordinator = coordinator or os.environ.get("DLROVER_TPU_COORDINATOR", "")
+    num_processes = num_processes or int(
+        os.environ.get("DLROVER_TPU_NUM_PROCESSES", "1")
+    )
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("DLROVER_TPU_PROCESS_ID", "0"))
+    )
+    if num_processes <= 1 or not coordinator:
+        logger.info("single-process run; skipping jax.distributed")
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed up: process %d/%d, %d global devices",
+        process_id,
+        num_processes,
+        len(jax.devices()),
+    )
+    return True
+
+
+def shutdown_distributed():
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def global_chip_count() -> int:
+    return len(jax.devices())
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -1,0 +1,134 @@
+"""Optimizer factory (optax).
+
+Covers the reference's optimizer surface (atorch/atorch/optimizers: AdamW
+paths, AGD agd.py, WSAM wsam.py, BF16/low-bit optimizer states) with optax
+transforms. Low-bit (int8) optimizer state lives in
+``dlrover_tpu/ops/quant.py`` and is applied as an optax wrapper.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def warmup_cosine(
+    peak_lr: float,
+    warmup_steps: int = 100,
+    decay_steps: int = 10000,
+    end_lr_ratio: float = 0.1,
+) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=decay_steps,
+        end_value=peak_lr * end_lr_ratio,
+    )
+
+
+def agd(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    delta: float = 1e-5,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    """AGD optimizer (reference: atorch/optimizers/agd.py, NeurIPS'23).
+
+    Auto-switches between gradient descent and adaptive step by comparing
+    the gradient-difference preconditioner against ``delta``.
+    """
+
+    def init_fn(params):
+        return {
+            "step": jnp.zeros([], jnp.int32),
+            "m": jax.tree.map(jnp.zeros_like, params),
+            "v": jax.tree.map(jnp.zeros_like, params),
+            "prev_g": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update_fn(updates, state, params=None):
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+
+        def upd(g, m, v, pg):
+            # gradient difference replaces the raw gradient in the second
+            # moment — the AGD preconditioner.
+            diff = g - b1 * pg
+            m2 = b1 * m + (1 - b1) * g
+            v2 = b2 * v + (1 - b2) * (diff * diff)
+            mhat = m2 / (1 - b1**t)
+            vhat = v2 / (1 - b2**t)
+            denom = jnp.maximum(jnp.sqrt(vhat) / delta, 1.0)
+            return -mhat / (denom * delta + eps), m2, v2, g
+
+        flat = jax.tree.map(
+            upd, updates, state["m"], state["v"], state["prev_g"]
+        )
+        out = jax.tree.map(lambda x: x[0], flat, is_leaf=lambda x: isinstance(x, tuple))
+        m = jax.tree.map(lambda x: x[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        v = jax.tree.map(lambda x: x[2], flat, is_leaf=lambda x: isinstance(x, tuple))
+        pg = jax.tree.map(lambda x: x[3], flat, is_leaf=lambda x: isinstance(x, tuple))
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+        out = jax.tree.map(lambda u: lr * u, out)
+        return out, {"step": step, "m": m, "v": v, "prev_g": pg}
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def make_optimizer(
+    name: str = "adamw",
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+    warmup_steps: int = 100,
+    decay_steps: int = 100000,
+    schedule: str = "warmup_cosine",
+    state_dtype: Optional[str] = None,
+) -> optax.GradientTransformation:
+    """Build the training optimizer.
+
+    ``state_dtype="bfloat16"`` keeps first/second moments in bf16
+    (reference: atorch BF16Optimizer); ``"int8"`` uses the block-quantized
+    states from ``ops/quant.py`` (reference: low_bit/functional.py).
+    """
+    if schedule == "warmup_cosine":
+        lr = warmup_cosine(learning_rate, warmup_steps, decay_steps)
+    else:
+        lr = learning_rate
+
+    chain = []
+    if grad_clip and grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+
+    if name == "adamw":
+        mu_dtype = None
+        if state_dtype == "bfloat16":
+            mu_dtype = jnp.bfloat16
+        chain.append(
+            optax.adamw(
+                lr, b1=b1, b2=b2, weight_decay=weight_decay, mu_dtype=mu_dtype
+            )
+        )
+    elif name == "adam":
+        chain.append(optax.adam(lr, b1=b1, b2=b2))
+    elif name == "agd":
+        chain.append(agd(lr if callable(lr) else (lambda s: lr), b1=b1, b2=b2))
+        if weight_decay:
+            chain.append(optax.add_decayed_weights(-weight_decay))
+    elif name == "sgd":
+        chain.append(optax.sgd(lr, momentum=0.9))
+    elif name == "lion":
+        chain.append(optax.lion(lr, weight_decay=weight_decay))
+    else:
+        raise ValueError(f"unknown optimizer {name}")
+
+    if state_dtype == "int8":
+        from dlrover_tpu.ops.quant import quantize_optimizer_state
+
+        return quantize_optimizer_state(optax.chain(*chain))
+    return optax.chain(*chain)

@@ -1,0 +1,43 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+Mirrors the reference's keystone test trick (SURVEY.md §4): everything
+distributed is testable on one host — the master runs in-process and the
+device mesh comes from XLA's forced host platform.
+
+The container's sitecustomize imports jax at interpreter startup (to
+register the TPU PJRT plugin), which latches ``JAX_PLATFORMS`` from the
+environment before this file runs — so we must override through
+``jax.config`` rather than ``os.environ``. ``XLA_FLAGS`` is still read
+lazily at first backend creation, which has not happened yet.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, (
+    "tests need the 8-device virtual CPU platform, got: " + str(jax.devices())
+)
+
+import glob  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _cleanup_shm():
+    """Remove checkpoint shm segments staged during tests."""
+    yield
+    for path in glob.glob("/dev/shm/dlrover_tpu_ckpt_*"):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass

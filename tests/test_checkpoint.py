@@ -1,0 +1,145 @@
+"""Flash Checkpoint tests: shm staging, persist/commit, resharded restore."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint import Checkpointer, StorageType
+from dlrover_tpu.checkpoint import core
+from dlrover_tpu.checkpoint.checkpointer import state_template
+from dlrover_tpu.checkpoint.storage import (
+    KeepLatestStepStrategy,
+    PosixStorage,
+    read_tracker,
+)
+from dlrover_tpu.parallel import MeshConfig, build_mesh
+from dlrover_tpu.parallel import sharding as shd
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+@pytest.fixture(autouse=True)
+def _run_id(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_RUN_ID", f"test{os.getpid()}_{time.time_ns()}")
+
+
+def _state(mesh=None):
+    a = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    b = jnp.ones((16,), jnp.bfloat16)
+    if mesh is not None:
+        a = jax.device_put(a, NamedSharding(mesh, P(("dp", "fsdp"), "tp")))
+        b = jax.device_put(b, NamedSharding(mesh, P("tp")))
+    return {"params": {"w": a, "b": b}, "step": jnp.asarray(3)}
+
+
+def test_pack_roundtrip_unsharded():
+    state = _state()
+    entries, payload = core.plan_pack(state)
+    header = core.header_bytes(7, entries)
+    buf = memoryview(bytearray(core.pack_size(header, payload)))
+    used = core.write_pack(buf, 7, state, entries)
+    idx = core.PackIndex()
+    idx.add_pack(buf[:used])
+    assert idx.step == 7
+    out = core.restore_tree(state_template(state), idx)
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+    assert out["params"]["b"].dtype == jnp.bfloat16
+    assert int(out["step"]) == 3
+
+
+def test_pack_roundtrip_sharded():
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    state = _state(mesh)
+    entries, payload = core.plan_pack(state)
+    header = core.header_bytes(1, entries)
+    buf = memoryview(bytearray(core.pack_size(header, payload)))
+    used = core.write_pack(buf, 1, state, entries)
+    idx = core.PackIndex()
+    idx.add_pack(buf[:used])
+    # restore onto a DIFFERENT sharding (resharded restore)
+    new_shardings = {
+        "params": {
+            "w": NamedSharding(mesh, P("tp", None)),
+            "b": NamedSharding(mesh, P(None)),
+        },
+        "step": NamedSharding(mesh, P()),
+    }
+    out = core.restore_tree(state_template(state), idx, new_shardings)
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+    assert out["params"]["w"].sharding.spec == P("tp", None)
+
+
+def test_checkpointer_disk_roundtrip(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), use_agent=False)
+    state = _state()
+    assert ckpt.save_checkpoint(10, state, StorageType.DISK)
+    ckpt.wait_for_persist()
+    assert ckpt.latest_committed_step() == 10
+    out = ckpt.load_checkpoint(state_template(state))
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+
+
+def test_checkpointer_memory_then_load(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), use_agent=False)
+    state = _state()
+    assert ckpt.save_checkpoint(5, state, StorageType.MEMORY)
+    # nothing persisted to disk
+    assert ckpt.latest_committed_step() is None
+    out = ckpt.load_checkpoint(state_template(state))
+    assert out is not None
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+
+
+def test_agent_saver_flow(tmp_path):
+    """Worker stages via shm IPC; agent daemon persists + commits."""
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+    saver = AsyncCheckpointSaver.start_async_saving_ckpt()
+    try:
+        ckpt = Checkpointer(str(tmp_path / "ckpt"), use_agent=True)
+        state = _state()
+        assert ckpt.save_checkpoint(20, state, StorageType.DISK)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if read_tracker(str(tmp_path / "ckpt"), PosixStorage()) == 20:
+                break
+            time.sleep(0.05)
+        assert ckpt.latest_committed_step() == 20
+
+        # memory-only stage + emergency persist (worker-failure path)
+        state2 = jax.tree.map(lambda x: x + 1, state)
+        assert ckpt.save_checkpoint(21, state2, StorageType.MEMORY)
+        saver.save_shm_to_storage()
+        assert ckpt.latest_committed_step() == 21
+        out = ckpt.engine.load_from_storage(state_template(state))
+        np.testing.assert_array_equal(
+            np.asarray(out["params"]["w"]),
+            np.asarray(state2["params"]["w"]),
+        )
+    finally:
+        saver.close()
+
+
+def test_deletion_strategy(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = Checkpointer(ckpt_dir, use_agent=False)
+    state = _state()
+    for step in (1, 2, 3, 4):
+        ckpt.save_checkpoint(step, state, StorageType.DISK)
+        ckpt.wait_for_persist()
+    KeepLatestStepStrategy(max_to_keep=2).clean_up(ckpt_dir, PosixStorage())
+    remaining = sorted(
+        d for d in os.listdir(ckpt_dir) if d.startswith("step_")
+    )
+    assert remaining == ["step_3", "step_4"]

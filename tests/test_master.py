@@ -1,0 +1,187 @@
+"""Master control-plane tests with an in-process master.
+
+Replicates the reference's keystone fixture (SURVEY.md §4): a real gRPC
+master in-process, real clients, no cluster.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeStatus,
+    RendezvousName,
+)
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.master.rdzv_manager import NetworkCheckRendezvousManager
+from dlrover_tpu.master.status_flow import transition
+from dlrover_tpu.master.task_manager import TaskManager
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(port=0, num_workers=2)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _client(master, node_id):
+    c = MasterClient(master.addr, node_id=node_id)
+    c.register_node(local_chips=4, tpu_type="v5e")
+    return c
+
+
+def test_register_and_heartbeat(master):
+    c = _client(master, 0)
+    assert c.node_rank == 0
+    assert c.report_heartbeat()
+    node = master.job_manager.get_node(0)
+    assert node.status == NodeStatus.RUNNING
+    assert node.config_resource.tpu_chips == 4
+
+
+def test_rendezvous_two_nodes(master):
+    c0, c1 = _client(master, 0), _client(master, 1)
+    assert c0.join_rendezvous(local_world_size=4) >= 1
+    # world not sealed until min nodes joined
+    _, _, world, _ = c0.get_comm_world()
+    assert world == {}
+    c1.join_rendezvous(local_world_size=4)
+    _, _, world, coord = c0.get_comm_world()
+    assert world == {0: 4, 1: 4}
+    assert coord
+    # both nodes see the same sealed world
+    _, _, world1, coord1 = c1.get_comm_world()
+    assert world1 == world and coord1 == coord
+
+
+def test_rendezvous_restart_bumps_round(master):
+    c0, c1 = _client(master, 0), _client(master, 1)
+    r1 = c0.join_rendezvous(4)
+    c1.join_rendezvous(4)
+    _, _, world, _ = c0.get_comm_world()
+    assert len(world) == 2
+    # node 1 dies: master event callback removes it from the world
+    c1.report_node_status(NodeStatus.FAILED, exit_reason="killed")
+    time.sleep(0.1)
+    # both nodes re-join (the agent restarts its worker) → new round seals
+    r2 = c0.join_rendezvous(4)
+    c1.join_rendezvous(4)
+    assert r2 > r1
+    _, _, world, _ = c0.get_comm_world()
+    assert world == {0: 4, 1: 4}
+
+
+def test_data_sharding_dispatch_and_requeue(master):
+    c0, c1 = _client(master, 0), _client(master, 1)
+    c0.report_dataset_shard_params(
+        "train", dataset_size=100, shard_size=10, num_epochs=1
+    )
+    t0 = c0.get_task("train")
+    t1 = c1.get_task("train")
+    assert t0.shard_end - t0.shard_start == 10
+    assert (t0.shard_start, t0.shard_end) != (t1.shard_start, t1.shard_end)
+    assert c0.report_task_result("train", t0.task_id, success=True)
+
+    # worker 1 dies with a task in flight → its shard is re-dispatched
+    c1.report_node_status(NodeStatus.FAILED, exit_reason="killed")
+    time.sleep(0.1)
+    seen = set()
+    while True:
+        t = c0.get_task("train")
+        if t.task_id < 0:
+            break
+        seen.add((t.shard_start, t.shard_end))
+        c0.report_task_result("train", t.task_id, success=True)
+    assert (t1.shard_start, t1.shard_end) in seen
+
+
+def test_shard_checkpoint_roundtrip(master):
+    c0 = _client(master, 0)
+    c0.report_dataset_shard_params(
+        "ds", dataset_size=40, shard_size=10, num_epochs=1
+    )
+    got = c0.get_task("ds")
+    assert got.task_id >= 0
+    ckpt = c0.get_shard_checkpoint("ds")
+    assert ckpt
+    # restore re-queues the in-flight shard
+    assert c0.report_shard_checkpoint("ds", ckpt)
+    ranges = []
+    while True:
+        t = c0.get_task("ds")
+        if t.task_id < 0:
+            break
+        ranges.append((t.shard_start, t.shard_end))
+        c0.report_task_result("ds", t.task_id)
+    assert (got.shard_start, got.shard_end) in ranges
+    assert len(ranges) == 4
+
+
+def test_kv_and_sync(master):
+    c0, c1 = _client(master, 0), _client(master, 1)
+    assert c0.kv_store_set("coord", "h0:1234")
+    assert c1.kv_store_get("coord") == "h0:1234"
+    assert not c0.sync_finished("step-sync")
+    c0.join_sync("step-sync")
+    c1.join_sync("step-sync")
+    assert c0.sync_finished("step-sync")
+
+
+def test_speed_monitor_and_ckpt_sync(master):
+    c0 = _client(master, 0)
+    now = time.time()
+    master.speed_monitor.collect_global_step(0, now - 10)
+    master.speed_monitor.collect_global_step(100, now)
+    assert master.speed_monitor.running_speed == pytest.approx(10.0, rel=0.1)
+    c0.report_ckpt_step(120)
+    assert c0.get_min_ckpt_step() == 120
+
+
+def test_status_flow():
+    assert transition(NodeStatus.PENDING, NodeStatus.RUNNING).allowed
+    assert not transition(NodeStatus.FAILED, NodeStatus.RUNNING).allowed
+    assert not transition(NodeStatus.RUNNING, NodeStatus.RUNNING).allowed
+
+
+def test_network_check_grouping_and_fault():
+    mgr = NetworkCheckRendezvousManager()
+    groups = mgr._group_nodes([0, 1, 2, 3])
+    assert groups == [[0, 1], [2, 3]]
+    mgr._check_round = 1
+    groups2 = mgr._group_nodes([0, 1, 2, 3])
+    assert groups2 != groups
+
+    # node 2 fails both rounds → fault; node 3 only once → not fault
+    mgr._check_round = 0
+    for rank in (0, 1, 3):
+        mgr.report_network_check_result(rank, True, 1.0)
+    mgr.report_network_check_result(2, False, 0.0)
+    mgr.next_check_round()
+    for rank in (0, 1):
+        mgr.report_network_check_result(rank, True, 1.0)
+    mgr.report_network_check_result(2, False, 0.0)
+    mgr.report_network_check_result(3, False, 0.0)
+    fault, _ = mgr.check_fault_node()
+    assert fault == [2]
+
+
+def test_straggler_detection():
+    mgr = NetworkCheckRendezvousManager()
+    for rank in range(3):
+        mgr.report_network_check_result(rank, True, 1.0)
+    mgr.report_network_check_result(3, True, 5.0)
+    stragglers, _ = mgr.get_stragglers(ratio=1.6)
+    assert stragglers == [3]
+
+
+def test_task_manager_timeout_requeue():
+    tm = TaskManager(shard_timeout_s=0.05)
+    tm.new_dataset("d", 20, 10)
+    t = tm.get_task("d", worker_id=0)
+    assert t.task_id >= 0
+    time.sleep(0.1)
+    n = tm._datasets["d"].recover_timeout_tasks(0.05)
+    assert n == 1

@@ -1,0 +1,94 @@
+"""Wire-codec and transport tests (reference analog: tests of common/grpc.py)."""
+
+import threading
+
+import pytest
+
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.common.comm import (
+    MasterTransportClient,
+    MasterTransportServer,
+)
+
+
+def test_roundtrip_simple():
+    m = msgs.HeartbeatReport(node_id=3, node_type="worker", timestamp=1.5)
+    out = msgs.deserialize(msgs.serialize(m))
+    assert out == m
+
+
+def test_roundtrip_nested():
+    m = msgs.NodeRegisterRequest(
+        meta=msgs.NodeMeta(node_id=7, host_addr="10.0.0.1", local_chips=4),
+        restart_count=2,
+    )
+    out = msgs.deserialize(msgs.serialize(m))
+    assert isinstance(out.meta, msgs.NodeMeta)
+    assert out.meta.host_addr == "10.0.0.1"
+    assert out == m
+
+
+def test_roundtrip_collections():
+    m = msgs.CommWorldResponse(
+        rdzv_round=2, world={"0": 4, "1": 4}, coordinator="h0:1234"
+    )
+    out = msgs.deserialize(msgs.serialize(m))
+    assert out.world == {"0": 4, "1": 4}
+
+
+def test_unregistered_type_rejected():
+    with pytest.raises(TypeError):
+        msgs.deserialize(b'{"t": "os.system", "d": {}}')
+
+
+class _EchoServicer:
+    def __init__(self):
+        self.reported = []
+
+    def report(self, msg):
+        self.reported.append(msg)
+        return True
+
+    def get(self, msg):
+        if isinstance(msg, msgs.CommWorldRequest):
+            return msgs.CommWorldResponse(rdzv_round=5, world={"0": 8})
+        return None
+
+
+def test_grpc_transport_roundtrip():
+    servicer = _EchoServicer()
+    server = MasterTransportServer(servicer, port=0)
+    server.start()
+    try:
+        client = MasterTransportClient(f"localhost:{server.port}")
+        assert client.report(msgs.HeartbeatReport(node_id=1))
+        resp = client.get(msgs.CommWorldRequest(node_id=1))
+        assert resp.rdzv_round == 5 and resp.world == {"0": 8}
+        assert client.get(msgs.KeyRequest(key="missing")) is None
+        assert servicer.reported[0].node_id == 1
+    finally:
+        server.stop()
+
+
+def test_grpc_transport_concurrent():
+    servicer = _EchoServicer()
+    server = MasterTransportServer(servicer, port=0)
+    server.start()
+    try:
+        client = MasterTransportClient(f"localhost:{server.port}")
+        errs = []
+
+        def hammer(i):
+            try:
+                for _ in range(20):
+                    assert client.report(msgs.HeartbeatReport(node_id=i))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errs
+        assert len(servicer.reported) == 160
+    finally:
+        server.stop()
