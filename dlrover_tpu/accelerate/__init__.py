@@ -1,0 +1,9 @@
+from dlrover_tpu.accelerate.api import (  # noqa: F401
+    AccelerateResult,
+    auto_accelerate,
+)
+from dlrover_tpu.accelerate.strategy import (  # noqa: F401
+    AccelerationPlan,
+    Strategy,
+    OPTIMIZATION_LIBRARY,
+)

@@ -1,0 +1,107 @@
+"""Static model/plan analysis: parameter counts, memory feasibility.
+
+Reference: atorch auto/analyser/analyser.py:14 (num params, module types)
++ device_context.py (GPU capability/memory). On TPU the analyser can be
+exact about sharded memory: bytes = Σ params·dtype / (fsdp·tp shards) etc.,
+so infeasible strategies are rejected before any compilation.
+"""
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+
+from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.accelerate.strategy import AccelerationPlan
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+
+# optimizer state slots per param (mu, nu for adam family)
+_OPT_SLOTS = {"adamw": 2, "adam": 2, "agd": 3, "sgd": 1, "lion": 1}
+
+
+@dataclass
+class AnalysisResult:
+    num_params: int
+    param_bytes_per_chip: float
+    opt_bytes_per_chip: float
+    grad_bytes_per_chip: float
+    act_bytes_per_chip: float
+    total_bytes_per_chip: float
+    flops_per_token: float
+    fits: bool
+    hbm_bytes: float
+
+
+def device_hbm_bytes() -> float:
+    try:
+        dev = jax.devices()[0]
+        stats = dev.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return float(limit)
+    except Exception:  # noqa: BLE001
+        pass
+    kind = ""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001
+        pass
+    for key, gb in (
+        ("v5p", 95),
+        ("v5 lite", 16),
+        ("v5e", 16),
+        ("v6", 32),
+        ("v4", 32),
+    ):
+        if key in kind:
+            return gb * 1e9
+    return 16e9
+
+
+def analyse(
+    cfg: ModelConfig,
+    plan: AccelerationPlan,
+    n_devices: int,
+    batch_per_chip: int,
+    seq: int,
+    hbm_bytes: float = 0.0,
+) -> AnalysisResult:
+    sizes = plan.mesh.resolved_sizes(n_devices)
+    n = cfg.num_params()
+    pbytes = _DTYPE_BYTES.get(plan.param_dtype, 4)
+    param_shards = max(1, sizes["fsdp"] * sizes["tp"] * sizes["pp"])
+
+    param_b = n * pbytes / param_shards
+    slots = _OPT_SLOTS.get(plan.optimizer, 2)
+    opt_dtype_b = _DTYPE_BYTES.get(
+        plan.optimizer_state_dtype or plan.param_dtype, pbytes
+    )
+    opt_b = n * slots * opt_dtype_b / param_shards
+    grad_b = n * pbytes / param_shards
+
+    act_dtype_b = _DTYPE_BYTES.get(plan.compute_dtype, 2)
+    tokens = batch_per_chip * seq
+    if plan.remat == "full":
+        # only layer-boundary activations are kept
+        act_b = tokens * cfg.d_model * act_dtype_b * cfg.n_layer
+    else:
+        # rough: ~12 activation tensors per layer survive to the backward
+        act_b = tokens * cfg.d_model * act_dtype_b * cfg.n_layer * 12
+    act_b /= max(1, sizes["tp"] * sizes["sp"])
+    # logits in f32 dominate for big vocabs
+    act_b += tokens * cfg.vocab_size * 4 / max(1, sizes["tp"])
+
+    hbm = hbm_bytes or device_hbm_bytes()
+    total = (param_b + opt_b + grad_b + act_b) * 1.15  # fragmentation slack
+    return AnalysisResult(
+        num_params=n,
+        param_bytes_per_chip=param_b,
+        opt_bytes_per_chip=opt_b,
+        grad_bytes_per_chip=grad_b,
+        act_bytes_per_chip=act_b,
+        total_bytes_per_chip=total,
+        flops_per_token=cfg.flops_per_token(seq),
+        fits=total < hbm * 0.92,
+        hbm_bytes=hbm,
+    )

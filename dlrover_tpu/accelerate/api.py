@@ -1,0 +1,84 @@
+"""``auto_accelerate``: one call from model config to an optimized,
+sharded, jitted training setup.
+
+Reference: atorch auto_accelerate (auto/accelerate.py:406) returning
+(model, optim, dataloader, loss_func) after strategy search. TPU version
+returns the mesh + jitted train step + state-init closure; the strategy is
+serializable for the semi-automatic path (load_strategy ≡ pass
+``strategy=`` explicitly).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.accelerate.dry_runner import build_from_plan
+from dlrover_tpu.accelerate.engine import search_strategy
+from dlrover_tpu.accelerate.strategy import (
+    AccelerationPlan,
+    Strategy,
+    apply_strategy,
+    strategy_from_json,
+)
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class AccelerateResult:
+    mesh: Any
+    model_config: ModelConfig
+    strategy: Strategy
+    plan: AccelerationPlan
+    train_step: Callable          # (state, batch) -> (state, metrics)
+    init_state: Callable          # (rng) -> sharded TrainState
+    batch_sharding: Any
+    eval_step: Optional[Callable] = None
+
+
+def auto_accelerate(
+    cfg: ModelConfig,
+    global_batch: int,
+    seq: int,
+    strategy: Optional[Strategy] = None,
+    strategy_json: Optional[str] = None,
+    search_mode: str = "heuristic",
+    devices=None,
+) -> AccelerateResult:
+    devices = devices if devices is not None else jax.devices()
+    if strategy_json is not None:
+        strategy = strategy_from_json(strategy_json)
+    if strategy is not None:
+        plan = apply_strategy(strategy)
+        logger.info("using provided strategy: %s", strategy)
+    else:
+        strategy, plan = search_strategy(
+            cfg,
+            len(devices),
+            global_batch,
+            seq,
+            mode=search_mode,
+            devices=devices,
+        )
+
+    mesh, builder, opt, bsh, cfg2 = build_from_plan(cfg, plan, devices)
+
+    from dlrover_tpu.train import init_train_state
+    from dlrover_tpu.train.train_step import build_eval_step
+
+    def init_state(rng):
+        return init_train_state(rng, cfg2, mesh, opt)
+
+    return AccelerateResult(
+        mesh=mesh,
+        model_config=cfg2,
+        strategy=strategy,
+        plan=plan,
+        train_step=builder.build(),
+        init_state=init_state,
+        batch_sharding=bsh,
+        eval_step=build_eval_step(cfg2, mesh, attn_impl=plan.attn_impl),
+    )

@@ -1,0 +1,123 @@
+"""DryRunner: measure a strategy's actual step time.
+
+Reference: atorch auto/dry_runner/dry_runner.py:12 (short profiled runs).
+Additionally exposes XLA's compiled cost analysis — an analytic signal the
+reference lacked — so candidate ranking can be done without running at all
+(``cost_only=True``).
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models.config import ModelConfig
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class DryRunResult:
+    strategy_json: str
+    ok: bool
+    steps_per_sec: float = 0.0
+    tokens_per_sec: float = 0.0
+    compile_s: float = 0.0
+    cost_flops: float = 0.0
+    cost_bytes: float = 0.0
+    error: str = ""
+
+
+def build_from_plan(cfg: ModelConfig, plan, devices=None):
+    """Lower a plan to (mesh, train_step, state, batch_sharding)."""
+    import dataclasses as dc
+
+    from dlrover_tpu.parallel.mesh import build_mesh
+    from dlrover_tpu.train import (
+        TrainStepBuilder,
+        batch_sharding,
+        init_train_state,
+        make_optimizer,
+    )
+
+    devices = devices if devices is not None else jax.devices()
+    mesh = build_mesh(plan.mesh, devices=devices)
+    cfg = dc.replace(
+        cfg,
+        dtype=plan.compute_dtype,
+        param_dtype=plan.param_dtype,
+        remat=plan.remat,
+    )
+    opt = make_optimizer(
+        name=plan.optimizer,
+        state_dtype=plan.optimizer_state_dtype,
+    )
+    attn_impl = plan.attn_impl
+    if plan.sp_mode in ("ring", "ulysses") and plan.mesh.sp != 1:
+        attn_impl = plan.sp_mode
+    builder = TrainStepBuilder(
+        cfg,
+        mesh,
+        opt,
+        grad_accum=plan.grad_accum,
+        attn_impl=attn_impl,
+    )
+    return mesh, builder, opt, batch_sharding(mesh), cfg
+
+
+def dry_run(
+    cfg: ModelConfig,
+    plan,
+    global_batch: int,
+    seq: int,
+    steps: int = 5,
+    warmup: int = 2,
+    cost_only: bool = False,
+    devices=None,
+) -> DryRunResult:
+    from dlrover_tpu.train import init_train_state
+
+    sj = plan.to_json()
+    try:
+        mesh, builder, opt, bsh, cfg2 = build_from_plan(cfg, plan, devices)
+        step_fn = builder.build()
+        tokens = jnp.zeros((global_batch, seq), jnp.int32)
+        batch = jax.device_put({"tokens": tokens, "targets": tokens}, bsh)
+
+        t0 = time.perf_counter()
+        state = init_train_state(jax.random.key(0), cfg2, mesh, opt)
+        if cost_only:
+            lowered = jax.jit(builder.step_fn).lower(state, batch)
+            compiled = lowered.compile()
+            cost = compiled.cost_analysis() or {}
+            return DryRunResult(
+                strategy_json=sj,
+                ok=True,
+                compile_s=time.perf_counter() - t0,
+                cost_flops=float(cost.get("flops", 0.0)),
+                cost_bytes=float(cost.get("bytes accessed", 0.0)),
+            )
+        for _ in range(warmup):
+            state, metrics = step_fn(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        compile_s = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t1
+        sps = steps / dt
+        return DryRunResult(
+            strategy_json=sj,
+            ok=True,
+            steps_per_sec=sps,
+            tokens_per_sec=sps * global_batch * seq,
+            compile_s=compile_s,
+        )
+    except Exception as e:  # noqa: BLE001 — infeasible strategies land here
+        logger.info("dry run failed for %s: %s", sj, e)
+        return DryRunResult(strategy_json=sj, ok=False, error=str(e)[:500])

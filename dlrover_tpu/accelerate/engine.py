@@ -1,0 +1,156 @@
+"""Strategy search engine.
+
+Reference: atorch AccelerationEngine (auto/engine/acceleration_engine.py:13)
+with Planner → candidate strategies, Executor → dryrun tasks, and HEBO
+Bayesian optimisation over measured throughput.
+
+TPU version: candidates are axis factorizations of the device count plus
+remat/precision choices; infeasible ones are rejected analytically
+(``analyser``), survivors are ranked either by a locality-aware heuristic
+score (free), XLA compiled cost (cheap), or measured dry runs (exact).
+"""
+
+import itertools
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.accelerate.analyser import analyse, device_hbm_bytes
+from dlrover_tpu.accelerate.dry_runner import dry_run
+from dlrover_tpu.accelerate.strategy import (
+    AccelerationPlan,
+    Strategy,
+    apply_strategy,
+)
+
+logger = get_logger(__name__)
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def generate_candidates(
+    cfg: ModelConfig,
+    n_devices: int,
+    seq: int,
+    max_candidates: int = 32,
+) -> List[Strategy]:
+    """Enumerate (tp, sp, fsdp, dp) factorizations + remat choices."""
+    candidates: List[Strategy] = []
+    for tp, sp in itertools.product(_divisors(n_devices), repeat=2):
+        if tp * sp > n_devices:
+            continue
+        if cfg.n_head % tp or cfg.kv_heads % tp:
+            continue
+        if seq % max(1, sp):
+            continue
+        if sp > 1 and cfg.n_head % sp:
+            continue  # ulysses shards heads across sp
+        rest = n_devices // (tp * sp)
+        for fsdp in _divisors(rest):
+            dp = rest // fsdp
+            base: Strategy = [
+                ("amp_bf16", {}),
+                (
+                    "mixed_parallel",
+                    {"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp},
+                ),
+            ]
+            if sp > 1:
+                base.append(("sequence_parallel", {"size": sp}))
+            candidates.append(base + [("checkpoint", {"policy": "none"})])
+            candidates.append(base + [("checkpoint", {"policy": "full"})])
+    # dedupe, keep stable order
+    seen = set()
+    out = []
+    for c in candidates:
+        key = str(c)
+        if key not in seen:
+            seen.add(key)
+            out.append(c)
+    return out[:max_candidates]
+
+
+def _heuristic_score(
+    cfg: ModelConfig, plan: AccelerationPlan, n_devices: int
+) -> float:
+    """Cheap locality-aware preference: less model parallelism is better
+    unless memory forces it; remat costs ~30% extra FLOPs."""
+    sizes = plan.mesh.resolved_sizes(n_devices)
+    score = 1.0
+    score /= 1.0 + 0.15 * (sizes["tp"] - 1)   # tp all-reduces per layer
+    score /= 1.0 + 0.10 * (sizes["sp"] - 1)   # sp all-to-alls
+    score /= 1.0 + 0.02 * (sizes["fsdp"] - 1)  # fsdp all-gathers overlap well
+    if plan.remat == "full":
+        score *= 0.75
+    return score
+
+
+def search_strategy(
+    cfg: ModelConfig,
+    n_devices: int,
+    global_batch: int,
+    seq: int,
+    mode: str = "heuristic",  # heuristic | cost | measure
+    max_measured: int = 6,
+    devices=None,
+) -> Tuple[Strategy, AccelerationPlan]:
+    hbm = device_hbm_bytes()
+    batch_per_chip = max(1, global_batch // n_devices)
+    feasible: List[Tuple[float, Strategy, AccelerationPlan]] = []
+    for strat in generate_candidates(cfg, n_devices, seq):
+        plan = apply_strategy(strat)
+        try:
+            a = analyse(cfg, plan, n_devices, batch_per_chip, seq, hbm)
+        except ValueError:
+            continue
+        if not a.fits:
+            continue
+        feasible.append((_heuristic_score(cfg, plan, n_devices), strat, plan))
+    if not feasible:
+        # nothing fits: force max sharding + remat + bf16 params
+        strat = [
+            ("half", {}),
+            ("mixed_parallel", {"dp": 1, "fsdp": n_devices, "tp": 1, "sp": 1}),
+            ("checkpoint", {"policy": "full"}),
+            ("bf16_optim", {}),
+        ]
+        logger.warning("no analytically-feasible strategy; forcing %s", strat)
+        return strat, apply_strategy(strat)
+
+    feasible.sort(key=lambda t: -t[0])
+    if mode == "heuristic":
+        score, strat, plan = feasible[0]
+        logger.info("heuristic strategy (score %.3f): %s", score, strat)
+        return strat, plan
+
+    best = None
+    for score, strat, plan in feasible[:max_measured]:
+        res = dry_run(
+            cfg,
+            plan,
+            global_batch,
+            seq,
+            cost_only=(mode == "cost"),
+            devices=devices,
+        )
+        if not res.ok:
+            continue
+        metric = (
+            -res.cost_flops - res.cost_bytes
+            if mode == "cost"
+            else res.tokens_per_sec
+        )
+        logger.info(
+            "measured %s → %.3g (%s)",
+            strat,
+            metric,
+            "cost" if mode == "cost" else "tokens/s",
+        )
+        if best is None or metric > best[0]:
+            best = (metric, strat, plan)
+    if best is None:
+        _, strat, plan = feasible[0]
+        return strat, plan
+    return best[1], best[2]

@@ -1,0 +1,168 @@
+"""Acceleration strategies: named optimization methods over a plan.
+
+Reference: atorch's OptimizationLibrary (auto/opt_lib/optimization_library.py:18
+— 16 methods: amp_native, fsdp, tensor_parallel, pipeline_parallel,
+sequence_parallel, checkpoint, module_replace, zero1/2, mixed_parallel …).
+
+TPU-native difference: a method does not wrap or swap modules — it edits an
+``AccelerationPlan`` (mesh axis sizes, sharding rules, model numerics,
+optimizer settings). The plan lowers to one jitted train step; XLA does the
+rest. A Strategy is the serializable list of (method, config) pairs, same
+shape as the reference's strategy objects (auto/accelerate.py:246-305).
+"""
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dlrover_tpu.parallel.mesh import MeshConfig
+
+Strategy = List[Tuple[str, Dict[str, Any]]]
+
+
+@dataclass
+class AccelerationPlan:
+    """Everything needed to build the train step for one strategy."""
+
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    rules: Dict[str, Any] = field(default_factory=dict)
+    # model overrides
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    remat: str = "none"
+    attn_impl: str = "auto"
+    # optimizer
+    optimizer: str = "adamw"
+    optimizer_state_dtype: Optional[str] = None
+    # data
+    grad_accum: int = 1
+    # sequence parallelism flavour: none | ulysses | ring
+    sp_mode: str = "none"
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "AccelerationPlan":
+        d = json.loads(s)
+        d["mesh"] = MeshConfig(**d["mesh"])
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# Optimization methods
+# ---------------------------------------------------------------------------
+
+
+def _amp_bf16(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.compute_dtype = cfg.get("dtype", "bfloat16")
+
+
+def _half(plan: AccelerationPlan, cfg: Dict) -> None:
+    """Blanket half precision incl. params (reference: half_optimization)."""
+    plan.compute_dtype = "bfloat16"
+    plan.param_dtype = "bfloat16"
+
+
+def _fsdp(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.mesh.fsdp = int(cfg.get("size", -1))
+
+
+def _tensor_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.mesh.tp = int(cfg.get("size", 1))
+
+
+def _pipeline_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.mesh.pp = int(cfg.get("size", 1))
+
+
+def _expert_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.mesh.ep = int(cfg.get("size", 1))
+
+
+def _sequence_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.mesh.sp = int(cfg.get("size", 1))
+    plan.sp_mode = cfg.get("mode", "ulysses")
+
+
+def _ring_attention(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.mesh.sp = int(cfg.get("size", 1))
+    plan.sp_mode = "ring"
+
+
+def _checkpoint(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.remat = cfg.get("policy", "full")
+
+
+def _module_replace(plan: AccelerationPlan, cfg: Dict) -> None:
+    """Fused-attention swap (reference: module_replace_optimization)."""
+    plan.attn_impl = cfg.get("attn_impl", "flash")
+
+
+def _low_bit_optim(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.optimizer_state_dtype = cfg.get("dtype", "int8")
+
+
+def _bf16_optim(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.optimizer_state_dtype = "bfloat16"
+
+
+def _grad_accum(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.grad_accum = int(cfg.get("steps", 1))
+
+
+def _optimizer(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.optimizer = cfg.get("name", "adamw")
+
+
+def _data_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
+    plan.mesh.dp = int(cfg.get("size", -1))
+
+
+def _mixed_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
+    """Arbitrary axis combination in one method (reference:
+    mixed_parallel_optimization.py:32)."""
+    for axis in ("dp", "pp", "ep", "fsdp", "sp", "tp"):
+        if axis in cfg:
+            setattr(plan.mesh, axis, int(cfg[axis]))
+
+
+OPTIMIZATION_LIBRARY: Dict[str, Callable[[AccelerationPlan, Dict], None]] = {
+    "amp_bf16": _amp_bf16,
+    "half": _half,
+    "fsdp": _fsdp,
+    "zero3": _fsdp,  # alias: fully-sharded params ≡ fsdp axis
+    "tensor_parallel": _tensor_parallel,
+    "pipeline_parallel": _pipeline_parallel,
+    "expert_parallel": _expert_parallel,
+    "sequence_parallel": _sequence_parallel,
+    "ring_attention": _ring_attention,
+    "checkpoint": _checkpoint,
+    "module_replace": _module_replace,
+    "low_bit_optim": _low_bit_optim,
+    "bf16_optim": _bf16_optim,
+    "grad_accum": _grad_accum,
+    "optimizer": _optimizer,
+    "data_parallel": _data_parallel,
+    "mixed_parallel": _mixed_parallel,
+}
+
+
+def apply_strategy(strategy: Strategy) -> AccelerationPlan:
+    plan = AccelerationPlan()
+    for name, cfg in strategy:
+        method = OPTIMIZATION_LIBRARY.get(name)
+        if method is None:
+            raise ValueError(f"unknown optimization method: {name}")
+        method(plan, cfg or {})
+    return plan
+
+
+def strategy_to_json(strategy: Strategy) -> str:
+    return json.dumps(strategy)
+
+
+def strategy_from_json(s: str) -> Strategy:
+    return [(name, cfg) for name, cfg in json.loads(s)]

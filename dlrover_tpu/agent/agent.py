@@ -97,6 +97,9 @@ class ElasticTrainingAgent:
         self._worker: Optional[WorkerProcess] = None
         self._outcome: Optional[RendezvousOutcome] = None
         self._remaining_restarts = config.max_restarts
+        self._pending_restart = threading.Event()
+        self._pending_abort = threading.Event()
+        self._pending_relaunch = threading.Event()
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._ckpt_saver = None  # AsyncCheckpointSaver, attached by launcher
@@ -110,7 +113,16 @@ class ElasticTrainingAgent:
         def loop():
             while not self._stop.wait(self.config.heartbeat_interval_s):
                 try:
-                    self.client.report_heartbeat()
+                    actions = self.client.heartbeat_with_actions()
+                    if "restart_worker" in actions:
+                        logger.info("master prescribed worker restart")
+                        self._pending_restart.set()
+                    if "abort_job" in actions:
+                        logger.error("master prescribed job abort")
+                        self._pending_abort.set()
+                    if "relaunch_node" in actions:
+                        logger.warning("master prescribed node relaunch")
+                        self._pending_relaunch.set()
                 except Exception:  # noqa: BLE001 — master may be restarting
                     logger.warning("heartbeat failed", exc_info=True)
 
@@ -193,9 +205,10 @@ class ElasticTrainingAgent:
 
     def _safe_report(self, fn, *args, **kwargs):
         """Status reports must not crash the agent if the master is gone
-        (the master legitimately exits first when the dataset finishes)."""
+        (the master legitimately exits first when the dataset finishes).
+        Per-call retry cap so shutdown isn't held up by a dead master."""
         try:
-            return fn(*args, **kwargs)
+            return fn(*args, retries=2, **kwargs)
         except Exception:  # noqa: BLE001
             logger.warning("master unreachable for %s", fn.__name__)
             return None
@@ -204,8 +217,35 @@ class ElasticTrainingAgent:
         while True:
             time.sleep(self.config.monitor_interval_s)
             rc = self._worker.poll()
+            if self._pending_abort.is_set():
+                # diagnosis decided the workload is unrecoverable
+                # (user error / OOM): stop burning the restart budget
+                self._save_ckpt_to_storage()
+                self._worker.terminate()
+                self._safe_report(
+                    self.client.report_node_status,
+                    NodeStatus.FAILED,
+                    exit_reason="fatal_error",
+                )
+                return 1
+            if self._pending_relaunch.is_set():
+                # hardware fault: exit so the platform reschedules this
+                # node; "killed" keeps the relaunch budget intact
+                self._save_ckpt_to_storage()
+                self._worker.terminate()
+                self._safe_report(
+                    self.client.report_node_status,
+                    NodeStatus.FAILED,
+                    exit_reason="killed",
+                )
+                return 2
             if rc is None:
-                if self._membership_changed():
+                if self._pending_restart.is_set():
+                    self._pending_restart.clear()
+                    logger.info("diagnosis action: restarting worker")
+                    self._save_ckpt_to_storage()
+                    self._restart_worker()
+                elif self._membership_changed():
                     logger.info(
                         "membership changed; checkpoint + restart workers"
                     )

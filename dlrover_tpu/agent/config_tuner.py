@@ -1,0 +1,70 @@
+"""ParalConfigTuner: master-tuned runtime config → local JSON file.
+
+Reference: dlrover/python/elastic_agent/config/paral_config_tuner.py:30 —
+polls the master for a ParallelConfig and writes it where the
+ElasticDataLoader picks it up (dataloader.py load_config).
+"""
+
+import json
+import os
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.constants import GraftEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class ParalConfigTuner:
+    def __init__(
+        self,
+        client,
+        config_path: Optional[str] = None,
+        interval_s: float = 30.0,
+    ):
+        self._client = client
+        self.config_path = config_path or os.environ.get(
+            GraftEnv.PARAL_CONFIG_PATH,
+            "/tmp/dlrover_tpu_paral_config.json",
+        )
+        os.environ[GraftEnv.PARAL_CONFIG_PATH] = self.config_path
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_version = -1
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="paral-config-tuner", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval_s):
+            self.poll_once()
+
+    def poll_once(self) -> bool:
+        try:
+            cfg = self._client.get_parallel_config()
+        except Exception:  # noqa: BLE001
+            logger.warning("parallel config poll failed", exc_info=True)
+            return False
+        if cfg.version == self._last_version:
+            return False
+        self._last_version = cfg.version
+        doc = {
+            "version": cfg.version,
+            "batch_size": cfg.batch_size,
+            "num_workers": cfg.num_workers,
+            "grad_accum_steps": cfg.grad_accum_steps,
+        }
+        tmp = self.config_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.config_path)
+        logger.info("wrote parallel config v%d: %s", cfg.version, doc)
+        return True

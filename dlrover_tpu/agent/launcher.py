@@ -159,6 +159,10 @@ def run(args: argparse.Namespace) -> int:
 
     monitor = ResourceMonitor(client)
     monitor.start()
+    from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+
+    tuner = ParalConfigTuner(client)
+    tuner.start()
     try:
         if config.network_check:
             _run_network_check(client, config)
@@ -173,6 +177,7 @@ def run(args: argparse.Namespace) -> int:
         return agent.run()
     finally:
         monitor.stop()
+        tuner.stop()
         if master is not None:
             master.request_stop()
 

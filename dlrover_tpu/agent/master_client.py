@@ -68,15 +68,32 @@ class MasterClient:
             )
         )
 
-    def report_node_status(self, status: str, exit_reason: str = "") -> bool:
+    def heartbeat_with_actions(self) -> List[str]:
+        """Heartbeat that returns queued diagnosis actions for this node."""
+        resp = self._t.get(
+            msgs.HeartbeatReport(node_id=self.node_id, timestamp=time.time())
+        )
+        return list(resp.actions) if resp else []
+
+    def report_node_status(
+        self,
+        status: str,
+        exit_reason: str = "",
+        retries: Optional[int] = None,
+    ) -> bool:
         return self._t.report(
             msgs.NodeStatusReport(
                 node_id=self.node_id, status=status, exit_reason=exit_reason
-            )
+            ),
+            retries=retries,
         )
 
     def report_failure(
-        self, error_data: str, level: str = "process_error", restart_count=0
+        self,
+        error_data: str,
+        level: str = "process_error",
+        restart_count=0,
+        retries: Optional[int] = None,
     ) -> bool:
         return self._t.report(
             msgs.NodeFailureReport(
@@ -85,7 +102,8 @@ class MasterClient:
                 error_data=error_data,
                 level=level,
                 restart_count=restart_count,
-            )
+            ),
+            retries=retries,
         )
 
     def report_resource_stats(

@@ -114,9 +114,12 @@ class MasterTransportClient:
     def addr(self) -> str:
         return self._addr
 
-    def _call(self, fn: Callable, payload: bytes) -> bytes:
+    def _call(
+        self, fn: Callable, payload: bytes, retries: Optional[int] = None
+    ) -> bytes:
         last_err = None
-        for attempt in range(self._retries):
+        retries = retries if retries is not None else self._retries
+        for attempt in range(retries):
             try:
                 return fn(payload, timeout=self._timeout)
             except grpc.RpcError as e:
@@ -131,12 +134,16 @@ class MasterTransportClient:
                 raise
         raise last_err  # type: ignore[misc]
 
-    def report(self, msg) -> bool:
-        resp = msgs.deserialize(self._call(self._report, msgs.serialize(msg)))
+    def report(self, msg, retries: Optional[int] = None) -> bool:
+        resp = msgs.deserialize(
+            self._call(self._report, msgs.serialize(msg), retries)
+        )
         return bool(resp and resp.success)
 
-    def get(self, msg):
-        resp = msgs.deserialize(self._call(self._get, msgs.serialize(msg)))
+    def get(self, msg, retries: Optional[int] = None):
+        resp = msgs.deserialize(
+            self._call(self._get, msgs.serialize(msg), retries)
+        )
         if isinstance(resp, msgs.Empty):
             return None
         return resp

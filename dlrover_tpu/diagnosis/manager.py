@@ -1,0 +1,162 @@
+"""Failure diagnosis: collect, classify, prescribe.
+
+Reference: dlrover/python/master/diagnosis/ (DiagnosisManager
+diagnosis.py:31, diagnostician.py) + monitor/error_monitor.py:22 (failure
+classification) + the hang detection in dist_job_manager.py:802.
+
+Collects agent-reported failures and resource stats, classifies them into
+known TPU failure modes, and emits actions the master/agents execute
+(restart process, relaunch node, abort job).
+"""
+
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class DiagnosisAction:
+    NONE = "none"
+    RESTART_WORKER = "restart_worker"
+    RELAUNCH_NODE = "relaunch_node"
+    ABORT_JOB = "abort_job"
+
+
+# error-signature → (classification, action)
+_FAILURE_RULES = [
+    # XLA/TPU level
+    (r"RESOURCE_EXHAUSTED|out of memory|OOM", "oom", DiagnosisAction.ABORT_JOB),
+    (
+        r"(slice|ICI|interconnect).*(fail|error|down)",
+        "hardware_error",
+        DiagnosisAction.RELAUNCH_NODE,
+    ),
+    (
+        r"(DEADLINE_EXCEEDED|barrier timeout|heartbeat)",
+        "hang",
+        DiagnosisAction.RESTART_WORKER,
+    ),
+    (
+        r"(UNAVAILABLE|coordination service|preempt)",
+        "preempted",
+        DiagnosisAction.RELAUNCH_NODE,
+    ),
+    (
+        r"(SyntaxError|ImportError|ModuleNotFoundError|TypeError)",
+        "user_error",
+        DiagnosisAction.ABORT_JOB,
+    ),
+]
+
+
+@dataclass
+class FailureRecord:
+    node_id: int
+    error_data: str
+    level: str
+    classification: str = "unknown"
+    action: str = DiagnosisAction.NONE
+    timestamp: float = field(default_factory=time.time)
+
+
+def classify_failure(error_data: str) -> tuple:
+    for pattern, cls, action in _FAILURE_RULES:
+        if re.search(pattern, error_data, re.IGNORECASE):
+            return cls, action
+    return "unknown", DiagnosisAction.RESTART_WORKER
+
+
+class DiagnosisManager:
+    def __init__(self, hang_cpu_percent: float = 5.0, window: int = 512):
+        self._lock = threading.Lock()
+        self.failures: Deque[FailureRecord] = deque(maxlen=window)
+        self.resource_history: Dict[int, Deque] = {}
+        self._hang_cpu_percent = hang_cpu_percent
+        self._window = window
+        # node_id → actions queued for that node's next heartbeat
+        self._pending_actions: Dict[int, List[str]] = {}
+
+    # ---- collection ------------------------------------------------------
+
+    def collect_failure(self, msg, worker_alive: bool = False) -> FailureRecord:
+        cls, action = classify_failure(msg.error_data)
+        # RESTART_WORKER is the agent's own default reaction to a dead
+        # worker; queueing it again would double-restart. Only queue it when
+        # the worker is still alive (hang reports), and always queue the
+        # stronger actions (abort / node relaunch).
+        queue_action = action
+        if action == DiagnosisAction.RESTART_WORKER and not worker_alive:
+            queue_action = DiagnosisAction.NONE
+        rec = FailureRecord(
+            node_id=msg.node_id,
+            error_data=msg.error_data,
+            level=msg.level,
+            classification=cls,
+            action=action,
+        )
+        with self._lock:
+            self.failures.append(rec)
+            if queue_action != DiagnosisAction.NONE:
+                self._pending_actions.setdefault(msg.node_id, []).append(
+                    queue_action
+                )
+        logger.info(
+            "diagnosed node %d failure as %s → %s",
+            msg.node_id,
+            cls,
+            action,
+        )
+        return rec
+
+    def collect_resource(self, msg):
+        with self._lock:
+            hist = self.resource_history.setdefault(
+                msg.node_id, deque(maxlen=64)
+            )
+            hist.append(
+                {
+                    "t": time.time(),
+                    "cpu": msg.cpu_percent,
+                    "mem_mb": msg.used_memory_mb,
+                    "hbm_mb": msg.hbm_used_mb,
+                }
+            )
+
+    # ---- queries ---------------------------------------------------------
+
+    def take_actions(self, node_id: int) -> List[str]:
+        """Drain queued actions; delivered via heartbeat responses."""
+        with self._lock:
+            return self._pending_actions.pop(node_id, [])
+
+    def all_nodes_hanged(self, min_duration_s: float = 600.0) -> bool:
+        """Every node's CPU has been ~idle for the window → job hang
+        (reference: dist_job_manager.py:802 all_running_node_hanged)."""
+        now = time.time()
+        with self._lock:
+            if not self.resource_history:
+                return False
+            for hist in self.resource_history.values():
+                recent = [
+                    h for h in hist if now - h["t"] <= min_duration_s
+                ]
+                if not recent or any(
+                    h["cpu"] > self._hang_cpu_percent for h in recent
+                ):
+                    return False
+                if hist and now - hist[0]["t"] < min_duration_s:
+                    return False
+            return True
+
+    def failure_summary(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for rec in self.failures:
+                out[rec.classification] = out.get(rec.classification, 0) + 1
+            return out

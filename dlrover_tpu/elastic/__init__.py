@@ -1,0 +1,3 @@
+from dlrover_tpu.elastic.sampler import ElasticDistributedSampler  # noqa: F401
+from dlrover_tpu.elastic.dataloader import ElasticDataLoader  # noqa: F401
+from dlrover_tpu.elastic.trainer import ElasticTrainer  # noqa: F401

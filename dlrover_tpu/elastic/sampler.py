@@ -1,0 +1,88 @@
+"""Elastic distributed sampler with mid-epoch resume.
+
+Reference: ElasticDistributedSampler
+(dlrover/trainer/torch/elastic/sampler.py:25,118,130): a distributed
+sampler whose ``state_dict``/``load_state_dict`` survive a *different*
+world size on resume — completed samples are skipped and the remainder is
+re-partitioned over the new workers.
+"""
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class ElasticDistributedSampler:
+    def __init__(
+        self,
+        dataset_size: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if rank >= num_replicas:
+            raise ValueError("rank must be < num_replicas")
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        # samples of this epoch already consumed (across ALL replicas)
+        self.completed = 0
+
+    # ---- iteration -------------------------------------------------------
+
+    def _epoch_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            return rng.permutation(self.dataset_size)
+        return np.arange(self.dataset_size)
+
+    def __iter__(self) -> Iterator[int]:
+        indices = self._epoch_indices()[self.completed :]
+        n = len(indices)
+        if self.drop_last:
+            n = n - (n % self.num_replicas)
+            indices = indices[:n]
+        else:
+            pad = (-n) % self.num_replicas
+            if pad:
+                indices = np.concatenate([indices, indices[:pad]])
+        return iter(indices[self.rank :: self.num_replicas].tolist())
+
+    def __len__(self) -> int:
+        remaining = self.dataset_size - self.completed
+        if self.drop_last:
+            return remaining // self.num_replicas
+        return (remaining + self.num_replicas - 1) // self.num_replicas
+
+    # ---- elasticity ------------------------------------------------------
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.completed = 0
+
+    def record_batch(self, batch_size_per_replica: int):
+        """Advance the consumed counter by one global step."""
+        self.completed += batch_size_per_replica * self.num_replicas
+        self.completed = min(self.completed, self.dataset_size)
+
+    def state_dict(self) -> Dict:
+        return {
+            "epoch": self.epoch,
+            "completed": self.completed,
+            "seed": self.seed,
+            "shuffle": self.shuffle,
+            "dataset_size": self.dataset_size,
+        }
+
+    def load_state_dict(self, state: Dict):
+        """Resume — possibly under a different (num_replicas, rank)."""
+        self.epoch = state["epoch"]
+        self.completed = int(state["completed"])
+        self.seed = state.get("seed", self.seed)
+        self.shuffle = state.get("shuffle", self.shuffle)

@@ -1,0 +1,110 @@
+"""JobAutoScaler: periodic auto-scaling loop.
+
+Reference: dlrover/python/master/node/job_auto_scaler.py:40
+(AllreduceTrainingAutoScaler._periodic_adjust_worker:288). Consumes the
+resource optimizer's plans, pushes ScalePlans to the scaler, and updates
+the rendezvous bounds so the next re-mesh admits the new world.
+"""
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.constants import DefaultValues
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.node_manager import JobManager, ScalePlan, Scaler
+from dlrover_tpu.master.resource_optimizer import (
+    LocalHeuristicOptimizer,
+    ResourceOptimizer,
+)
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+logger = get_logger(__name__)
+
+
+class JobAutoScaler:
+    def __init__(
+        self,
+        job_manager: JobManager,
+        speed_monitor: SpeedMonitor,
+        scaler: Scaler,
+        rdzv_managers=None,
+        optimizer: Optional[ResourceOptimizer] = None,
+        interval_s: float = DefaultValues.AUTOSCALE_INTERVAL_S,
+        min_workers: int = 1,
+        max_workers: int = 1,
+        node_unit: int = 1,
+    ):
+        self.job_manager = job_manager
+        self.speed_monitor = speed_monitor
+        self.scaler = scaler
+        self.rdzv_managers = rdzv_managers or {}
+        self.optimizer = optimizer or LocalHeuristicOptimizer(
+            min_workers=min_workers,
+            max_workers=max_workers,
+            node_unit=node_unit,
+        )
+        self.interval_s = interval_s
+        # grace before treating unregistered nodes as unplaceable — newly
+        # requested hosts legitimately take minutes to schedule and join
+        self.pending_grace_s = DefaultValues.SECONDS_TO_WAIT_PENDING_POD
+        self._last_scale_time = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.adjust_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("auto-scale iteration failed")
+
+    def adjust_once(self):
+        import time
+
+        running = self.job_manager.running_nodes()
+        pending = max(0, self.job_manager.worker_num - len(running))
+        # while inside the grace window after a scale event, booting nodes
+        # are not "unplaceable" — don't flap back down
+        in_grace = (
+            time.time() - self._last_scale_time < self.pending_grace_s
+        )
+        stats = {
+            "worker_num": self.job_manager.worker_num,
+            "speed": self.speed_monitor.running_speed,
+            "pending_nodes": 0 if in_grace else pending,
+        }
+        if in_grace and pending > 0:
+            return  # wait for the last scale event to settle
+        plan = self.optimizer.generate_plan("running", stats)
+        if plan.empty():
+            return
+        self.execute_plan(plan)
+
+    def execute_plan(self, plan):
+        import time
+
+        target = plan.worker_num
+        if target is None:
+            return
+        logger.info(
+            "auto-scale: %d → %d workers", self.job_manager.worker_num, target
+        )
+        self.job_manager.set_worker_num(target)
+        self._last_scale_time = time.time()
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                min_nodes=min(target, mgr._min_nodes or target),
+                max_nodes=target,
+            )
+        sp = ScalePlan()
+        sp.worker_num = target
+        self.scaler.scale(sp)

@@ -1,0 +1,169 @@
+"""Job metrics collection + export.
+
+Reference: dlrover/python/master/stats/ (JobMetricCollector
+job_collector.py:185, reporter.py, training_metrics.py) and the
+xpu_timer Prometheus export. Collects model/runtime/speed records and
+serves them as a Prometheus text endpoint + JSON dump — the master-side
+observability surface.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Deque, Dict, Optional
+
+
+@dataclass
+class RuntimeRecord:
+    timestamp: float
+    global_step: int
+    speed_steps_per_s: float
+    worker_num: int
+    cpu_percent_avg: float = 0.0
+    hbm_used_mb_avg: float = 0.0
+
+
+@dataclass
+class JobMeta:
+    job_name: str = ""
+    model_name: str = ""
+    num_params: int = 0
+    flops_per_token: float = 0.0
+    global_batch_size: int = 0
+    seq_len: int = 0
+    strategy_json: str = ""
+
+
+class JobMetricCollector:
+    def __init__(self, max_records: int = 4096):
+        self._lock = threading.Lock()
+        self.meta = JobMeta()
+        self.records: Deque[RuntimeRecord] = deque(maxlen=max_records)
+        self.counters: Dict[str, float] = {
+            "node_failures_total": 0,
+            "worker_restarts_total": 0,
+            "rdzv_rounds_total": 0,
+            "ckpt_commits_total": 0,
+        }
+
+    def set_job_meta(self, **kw):
+        with self._lock:
+            for k, v in kw.items():
+                if hasattr(self.meta, k):
+                    setattr(self.meta, k, v)
+
+    def collect_runtime(
+        self,
+        global_step: int,
+        speed: float,
+        worker_num: int,
+        cpu_percent_avg: float = 0.0,
+        hbm_used_mb_avg: float = 0.0,
+    ):
+        with self._lock:
+            self.records.append(
+                RuntimeRecord(
+                    timestamp=time.time(),
+                    global_step=global_step,
+                    speed_steps_per_s=speed,
+                    worker_num=worker_num,
+                    cpu_percent_avg=cpu_percent_avg,
+                    hbm_used_mb_avg=hbm_used_mb_avg,
+                )
+            )
+
+    def inc(self, counter: str, delta: float = 1.0):
+        with self._lock:
+            self.counters[counter] = self.counters.get(counter, 0) + delta
+
+    # ---- export ----------------------------------------------------------
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps(
+                {
+                    "meta": asdict(self.meta),
+                    "counters": dict(self.counters),
+                    "records": [asdict(r) for r in list(self.records)[-100:]],
+                }
+            )
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (xpu_timer-style export surface)."""
+        with self._lock:
+            lines = []
+            for name, value in self.counters.items():
+                lines.append(f"# TYPE dlrover_tpu_{name} counter")
+                lines.append(f"dlrover_tpu_{name} {value}")
+            if self.records:
+                last = self.records[-1]
+                gauges = {
+                    "global_step": last.global_step,
+                    "speed_steps_per_second": last.speed_steps_per_s,
+                    "worker_num": last.worker_num,
+                    "hbm_used_mb_avg": last.hbm_used_mb_avg,
+                }
+                for name, value in gauges.items():
+                    lines.append(f"# TYPE dlrover_tpu_{name} gauge")
+                    lines.append(f"dlrover_tpu_{name} {value}")
+            return "\n".join(lines) + "\n"
+
+
+class MetricsHTTPServer:
+    """Tiny /metrics + /json endpoint (Prometheus scrape target).
+
+    The socket binds in ``start()`` (not __init__) so constructing a master
+    that never runs doesn't hold a port, and ``stop()`` before ``start()``
+    is a safe no-op.
+    """
+
+    def __init__(self, collector: JobMetricCollector, port: int = 0):
+        self._collector = collector
+        self._requested_port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self.port = 0
+
+    def start(self):
+        import http.server
+
+        collector_ref = self._collector
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    body = collector_ref.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/json"):
+                    body = collector_ref.to_json().encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence request logging
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("", self._requested_port), Handler
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None

@@ -1,0 +1,47 @@
+"""Standalone job-master entry point.
+
+Reference: dlrover/python/master/main.py:43. Run one per job:
+
+    python -m dlrover_tpu.master.main --port 7000 --num-workers 4
+"""
+
+import argparse
+import sys
+from typing import List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.master import DistributedJobMaster
+
+logger = get_logger(__name__)
+
+
+def parse_master_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="dlrover-tpu-master")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--max-workers", type=int, default=0)
+    p.add_argument("--node-unit", type=int, default=1)
+    p.add_argument("--job-name", default="job")
+    return p.parse_args(argv)
+
+
+def run(args: argparse.Namespace) -> str:
+    master = DistributedJobMaster(
+        port=args.port,
+        num_workers=args.num_workers,
+        max_workers=args.max_workers or args.num_workers,
+        node_unit=args.node_unit,
+    )
+    master.prepare()
+    # print the bound address for launchers/operators to scrape
+    print(f"DLROVER_TPU_MASTER_ADDR={master.addr}", flush=True)
+    return master.run()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    reason = run(parse_master_args(argv))
+    return 0 if reason == "succeeded" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,7 @@ class JobMaster:
         max_workers: Optional[int] = None,
         node_unit: int = 1,
         scaler: Optional[Scaler] = None,
+        enable_auto_scaling: Optional[bool] = None,
     ):
         ctx = get_context()
         self.speed_monitor = SpeedMonitor()
@@ -70,7 +71,15 @@ class JobMaster:
         self.sync_service.set_world_size_fn(
             lambda: len(self.job_manager.running_nodes()) or 1
         )
-        self.diagnosis_manager = None  # wired when diagnosis is enabled
+        from dlrover_tpu.diagnosis.manager import DiagnosisManager
+        from dlrover_tpu.master.job_metrics import (
+            JobMetricCollector,
+            MetricsHTTPServer,
+        )
+
+        self.diagnosis_manager = DiagnosisManager()
+        self.metric_collector = JobMetricCollector()
+        self.metrics_server = MetricsHTTPServer(self.metric_collector, port=0)
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
             task_manager=self.task_manager,
@@ -81,6 +90,24 @@ class JobMaster:
             diagnosis_manager=self.diagnosis_manager,
         )
         self.server = MasterTransportServer(self.servicer, port=port)
+
+        # auto-scaler runs whenever the job declared an elastic range
+        from dlrover_tpu.master.auto_scaler import JobAutoScaler
+
+        if enable_auto_scaling is None:
+            enable_auto_scaling = max_w > num_workers
+        self.auto_scaler: Optional[JobAutoScaler] = None
+        if enable_auto_scaling:
+            self.auto_scaler = JobAutoScaler(
+                self.job_manager,
+                self.speed_monitor,
+                self.job_manager._scaler,
+                rdzv_managers=self.rdzv_managers,
+                min_workers=num_workers,
+                max_workers=max_w,
+                node_unit=node_unit,
+                interval_s=ctx.autoscale_interval_s,
+            )
         self._stop = threading.Event()
         self.exit_reason = ""
 
@@ -93,6 +120,7 @@ class JobMaster:
         for mgr in self.rdzv_managers.values():
             mgr.remove_alive_node(node.rank_index)
         self.speed_monitor.reset_running_speed()
+        self.metric_collector.inc("node_failures_total")
 
     @property
     def port(self) -> int:
@@ -104,8 +132,12 @@ class JobMaster:
 
     def prepare(self):
         self.server.start()
+        self.metrics_server.start()
+        logger.info("metrics endpoint on port %d", self.metrics_server.port)
         self.task_manager.start()
         self.job_manager.start()
+        if self.auto_scaler is not None:
+            self.auto_scaler.start()
 
     def run(self, poll_interval_s: Optional[float] = None) -> str:
         """Supervision loop (reference: dist_master.py:211)."""
@@ -113,6 +145,11 @@ class JobMaster:
         interval = poll_interval_s or ctx.supervise_interval_s
         try:
             while not self._stop.wait(interval):
+                self.metric_collector.collect_runtime(
+                    self.speed_monitor.global_step,
+                    self.speed_monitor.running_speed,
+                    len(self.job_manager.running_nodes()),
+                )
                 if self.task_manager.finished():
                     self.exit_reason = JobExitReason.SUCCEEDED
                     break
@@ -138,8 +175,11 @@ class JobMaster:
 
     def stop(self):
         self._stop.set()
+        if self.auto_scaler is not None:
+            self.auto_scaler.stop()
         self.task_manager.stop()
         self.job_manager.stop()
+        self.metrics_server.stop()
         self.server.stop()
 
 

@@ -53,18 +53,25 @@ class RendezvousManager:
 
     def update_rdzv_params(
         self,
-        min_nodes: int,
-        max_nodes: int,
-        waiting_timeout: float = DefaultValues.RDZV_WAIT_EXTRA_NODES_S,
-        node_unit: int = 1,
-        rdzv_timeout: float = DefaultValues.RDZV_TIMEOUT_S,
+        min_nodes: Optional[int] = None,
+        max_nodes: Optional[int] = None,
+        waiting_timeout: Optional[float] = None,
+        node_unit: Optional[int] = None,
+        rdzv_timeout: Optional[float] = None,
     ):
+        """Partial update: None keeps the current value (auto-scaling must
+        not silently reset node_unit/timeouts to defaults)."""
         with self._lock:
-            self._min_nodes = min_nodes
-            self._max_nodes = max_nodes
-            self._waiting_timeout = waiting_timeout
-            self._node_unit = max(1, node_unit)
-            self._rdzv_timeout = rdzv_timeout
+            if min_nodes is not None:
+                self._min_nodes = min_nodes
+            if max_nodes is not None:
+                self._max_nodes = max_nodes
+            if waiting_timeout is not None:
+                self._waiting_timeout = waiting_timeout
+            if node_unit is not None:
+                self._node_unit = max(1, node_unit)
+            if rdzv_timeout is not None:
+                self._rdzv_timeout = rdzv_timeout
 
     def add_alive_node(self, node_rank: int):
         with self._lock:

@@ -49,6 +49,17 @@ class MasterServicer:
             self.job_manager.handle_heartbeat(m.node_id)
         return True
 
+    def _get_heartbeat(self, m: msgs.HeartbeatReport):
+        """Heartbeat via get: response carries queued diagnosis actions."""
+        if self.job_manager:
+            self.job_manager.handle_heartbeat(m.node_id)
+        actions = (
+            self.diagnosis_manager.take_actions(m.node_id)
+            if self.diagnosis_manager
+            else []
+        )
+        return msgs.HeartbeatResponse(actions=actions)
+
     def _report_node_status(self, m: msgs.NodeStatusReport) -> bool:
         if self.job_manager:
             self.job_manager.handle_status_report(
@@ -266,6 +277,7 @@ class MasterServicer:
         return msgs.ParallelConfig(**cfg) if cfg else msgs.ParallelConfig()
 
     _GET_HANDLERS = {
+        "HeartbeatReport": _get_heartbeat,
         "NodeRegisterRequest": _get_register,
         "JoinRendezvousRequest": _get_join_rdzv,
         "CommWorldRequest": _get_comm_world,

@@ -248,6 +248,14 @@ def forward(
         attn_impl = "reference"
 
     def attn_fn(q, k, v):
+        if attn_impl == "ring":
+            from dlrover_tpu.parallel.sequence import ring_attention
+
+            return ring_attention(q, k, v, mesh, causal=True)
+        if attn_impl == "ulysses":
+            from dlrover_tpu.parallel.sequence import ulysses_attention
+
+            return ulysses_attention(q, k, v, mesh, causal=True)
         if attn_impl in ("reference", "auto"):
             return mha_reference(q, k, v, causal=True)
         from dlrover_tpu.ops.pallas_attention import flash_attention

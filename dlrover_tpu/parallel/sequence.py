@@ -1,0 +1,197 @@
+"""Sequence/context parallelism: Ulysses all-to-all and ring attention.
+
+Reference: atorch's Ulysses-like SequenceParallelOptimization
+(auto/opt_lib/sequence_parallel_optimization.py:9-103) — attention becomes
+head-parallel, everything else sequence-parallel, via explicit all-to-all
+process groups. **The reference has no ring/blockwise context parallelism
+at all** (SURVEY.md §5) — ring attention here exceeds it.
+
+TPU-native:
+- Ulysses: ``jax.lax.all_to_all`` over the ``sp`` mesh axis inside
+  ``shard_map`` — seq-sharded activations become head-sharded for exact
+  attention, then return. All-to-alls ride ICI.
+- Ring: k/v blocks rotate around the sp axis with ``ppermute`` while each
+  device accumulates online-softmax partial attention for its local q
+  block — O(S/sp) memory, exact causal attention for any sequence length.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.ops.attention import _repeat_kv, mha_reference
+
+NEG_INF = -1e30
+
+
+def _match_heads(q, k, v):
+    """GQA: repeat k/v heads up to q's head count."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = _repeat_kv(k, rep)
+        v = _repeat_kv(v, rep)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S, H, D] — S sharded over sp outside shard_map
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    axis: str = "sp",
+    attn_fn=None,
+) -> jax.Array:
+    """Exact attention with seq-sharded inputs/outputs.
+
+    Inside: all-to-all turns [B, S/sp, H, D] into [B, S, H/sp, D]
+    (full sequence, sharded heads), runs normal attention, and reverses.
+    """
+    attn_fn = attn_fn or functools.partial(mha_reference, causal=causal)
+    sp = mesh.shape[axis]
+    if sp == 1:
+        return attn_fn(q, k, v)
+
+    def local(q, k, v):
+        k, v = _match_heads(q, k, v)
+
+        # [B, S/sp, H, D] → [B, S, H/sp, D]
+        def scatter_heads(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def gather_seq(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        out = attn_fn(qh, kh, vh)
+        return gather_seq(out)
+
+    # batch stays sharded over (dp, fsdp) — replicating it here would
+    # all-gather the full batch and duplicate attention per dp group
+    spec = P(("dp", "fsdp"), axis, None, None)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (blockwise context parallelism over ppermute)
+# ---------------------------------------------------------------------------
+
+
+def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
+    """Partial attention of local q against one k/v block.
+
+    Returns (unnormalised out [B,Sq,H,D], row max m [B,H,Sq], row sum l).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: zero contribution, not NaN
+    p = jnp.where((m == NEG_INF)[..., None], 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(jnp.float32), m, l
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] — S sharded over sp outside shard_map
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    axis: str = "sp",
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over the full (sharded) sequence via a k/v ring.
+
+    Each of the sp devices holds one contiguous sequence block; k/v rotate
+    around the ring (ppermute over ICI) for sp steps while the local q
+    accumulates online-softmax statistics. Communication overlaps the next
+    block's compute under XLA's latency-hiding scheduler.
+    """
+    sp = mesh.shape[axis]
+    scale = (
+        softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    )
+    if sp == 1:
+        return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
+
+    def local(q, k, v):
+        k, v = _match_heads(q, k, v)
+        idx = jax.lax.axis_index(axis)
+        b, sq, h, d = q.shape
+        q_offset = idx * sq
+
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def body(carry, _):
+            k_blk, v_blk, src, acc, m_run, l_run = carry
+            k_offset = src * sq
+            out, m_blk, l_blk = _block_attend(
+                q, k_blk, v_blk, scale, q_offset, k_offset, causal
+            )
+            m_new = jnp.maximum(m_run, m_blk)
+            alpha_run = jnp.exp(m_run - m_new)
+            alpha_blk = jnp.exp(m_blk - m_new)
+            alpha_run = jnp.where(
+                (m_run == NEG_INF), 0.0, alpha_run
+            )
+            alpha_blk = jnp.where((m_blk == NEG_INF), 0.0, alpha_blk)
+            acc = (
+                acc * alpha_run.transpose(0, 2, 1)[..., None]
+                + out * alpha_blk.transpose(0, 2, 1)[..., None]
+            )
+            l_run = l_run * alpha_run + l_blk * alpha_blk
+            # rotate k/v to the next device on the ring
+            k_next = jax.lax.ppermute(k_blk, axis, perm)
+            v_next = jax.lax.ppermute(v_blk, axis, perm)
+            src_next = jax.lax.rem(src - 1 + sp, sp)
+            return (k_next, v_next, src_next, acc, m_new, l_run), None
+
+        acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+        m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, sq), jnp.float32)
+        (_, _, _, acc, _, l_run), _ = jax.lax.scan(
+            body, (k, v, idx, acc0, m0, l0), None, length=sp
+        )
+        l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+        out = acc / l_safe.transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    # batch stays sharded over (dp, fsdp); only seq rides the sp ring
+    spec = P(("dp", "fsdp"), axis, None, None)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,36 @@
+"""Multi-controller batch formation.
+
+In SPMD multi-host JAX every process must participate in one *global*
+batch; each host loads only its data-parallel slice (its shard from the
+master's TaskManager) and contributes it as the addressable part of the
+global array. Reference analog: the per-worker DataLoader + DistributedSampler
+split — here the split is the batch axis sharding itself.
+"""
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+def form_global_batch(
+    local_batch: Dict[str, Any], sharding: NamedSharding
+) -> Dict[str, Any]:
+    """Local per-host arrays → global sharded arrays.
+
+    ``local_batch`` holds this host's rows (global_rows / num_processes).
+    Single-process: a plain device_put. Multi-process: every host passes its
+    local rows and JAX assembles the global array without any data exchange.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+
+    def put(x):
+        x = np.asarray(x)
+        global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape
+        )
+
+    return jax.tree.map(put, local_batch)

@@ -32,6 +32,7 @@ from dlrover_tpu.train import (
     init_train_state,
     make_optimizer,
 )
+from dlrover_tpu.train.data_utils import form_global_batch
 from dlrover_tpu.train.distributed import init_distributed
 
 
@@ -65,16 +66,21 @@ def main():
 
     state = init_train_state(jax.random.key(0), cfg, mesh, opt)
     ckpt = Checkpointer(args.ckpt_dir, master_client=client)
-    restored = ckpt.load_checkpoint(state_template(state))
+    restored = ckpt.load_checkpoint(
+        state_template(state),
+        shardings=jax.tree.map(lambda x: x.sharding, state),
+    )
     if restored is not None:
         state = restored
         print(f"[worker] resumed from step {int(state['step'])}", flush=True)
 
     step_fn = TrainStepBuilder(cfg, mesh, opt).build()
+    # SPMD: every process consumes one shard per global step, so the
+    # dataset holds steps × processes shards of batch rows each.
     sharding = ShardingClient(
         client,
         "train",
-        dataset_size=args.steps * args.batch,
+        dataset_size=args.steps * args.batch * jax.process_count(),
         shard_size=args.batch,
     )
 
@@ -89,7 +95,7 @@ def main():
         ):
             print(f"[worker] simulating crash at step {step}", flush=True)
             os._exit(17)
-        batch = jax.device_put(
+        batch = form_global_batch(
             synthetic_batch(start, end, args.batch, args.seq, cfg.vocab_size),
             bsh,
         )

@@ -1,0 +1,104 @@
+"""auto_accelerate strategy engine tests."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlrover_tpu.accelerate import auto_accelerate
+from dlrover_tpu.accelerate.analyser import analyse
+from dlrover_tpu.accelerate.engine import generate_candidates, search_strategy
+from dlrover_tpu.accelerate.strategy import (
+    AccelerationPlan,
+    apply_strategy,
+    strategy_from_json,
+    strategy_to_json,
+)
+from dlrover_tpu.models import get_config
+
+
+def test_apply_strategy_builds_plan():
+    plan = apply_strategy(
+        [
+            ("amp_bf16", {}),
+            ("mixed_parallel", {"dp": 2, "fsdp": 2, "tp": 2}),
+            ("checkpoint", {"policy": "full"}),
+            ("low_bit_optim", {}),
+        ]
+    )
+    assert plan.mesh.tp == 2 and plan.mesh.fsdp == 2 and plan.mesh.dp == 2
+    assert plan.remat == "full"
+    assert plan.optimizer_state_dtype == "int8"
+    # round-trip
+    plan2 = AccelerationPlan.from_json(plan.to_json())
+    assert plan2 == plan
+
+
+def test_strategy_json_roundtrip():
+    s = [("fsdp", {"size": 4}), ("checkpoint", {"policy": "full"})]
+    assert strategy_from_json(strategy_to_json(s)) == s
+
+
+def test_candidates_respect_head_divisibility():
+    cfg = get_config("tiny")  # 4 heads
+    cands = generate_candidates(cfg, 8, seq=256)
+    assert cands
+    for strat in cands:
+        plan = apply_strategy(strat)
+        sizes = plan.mesh.resolved_sizes(8)
+        assert cfg.n_head % sizes["tp"] == 0
+
+
+def test_analyser_memory_scaling():
+    cfg = get_config("gpt2-1.5b")
+    plan1 = apply_strategy([("mixed_parallel", {"dp": 1, "fsdp": 1})])
+    plan8 = apply_strategy([("mixed_parallel", {"dp": 1, "fsdp": 8})])
+    a1 = analyse(cfg, plan1, 1, 8, 1024, hbm_bytes=16e9)
+    a8 = analyse(cfg, plan8, 8, 8, 1024, hbm_bytes=16e9)
+    assert a8.param_bytes_per_chip * 7 < a1.param_bytes_per_chip * 8
+    assert a1.num_params == pytest.approx(1.56e9, rel=0.1)
+
+
+def test_search_returns_feasible(monkeypatch):
+    cfg = get_config("tiny")
+    strat, plan = search_strategy(cfg, 8, global_batch=16, seq=256)
+    sizes = plan.mesh.resolved_sizes(8)
+    assert (
+        sizes["dp"] * sizes["fsdp"] * sizes["tp"] * sizes["sp"] * sizes["pp"]
+        * sizes["ep"] == 8
+    )
+
+
+def test_auto_accelerate_end_to_end():
+    cfg = get_config("tiny")
+    result = auto_accelerate(cfg, global_batch=16, seq=64)
+    state = result.init_state(jax.random.key(0))
+    tokens = jnp.zeros((16, 64), jnp.int32)
+    batch = jax.device_put(
+        {"tokens": tokens, "targets": tokens}, result.batch_sharding
+    )
+    state, metrics = result.train_step(state, batch)
+    assert float(metrics["loss"]) > 0
+    em = result.eval_step(state["params"], batch)
+    assert float(em["loss"]) > 0
+
+
+def test_auto_accelerate_with_explicit_strategy():
+    cfg = get_config("tiny")
+    result = auto_accelerate(
+        cfg,
+        global_batch=8,
+        seq=64,
+        strategy=[
+            ("half", {}),
+            ("mixed_parallel", {"dp": 2, "fsdp": 2, "tp": 2}),
+            ("grad_accum", {"steps": 2}),
+        ],
+    )
+    assert result.plan.param_dtype == "bfloat16"
+    state = result.init_state(jax.random.key(0))
+    tokens = jnp.zeros((8, 64), jnp.int32)
+    batch = jax.device_put(
+        {"tokens": tokens, "targets": tokens}, result.batch_sharding
+    )
+    state, metrics = result.train_step(state, batch)
+    assert int(state["step"]) == 1

@@ -1,0 +1,124 @@
+"""Two-host end-to-end test: master + 2 agents + jax.distributed workers.
+
+The full distributed stack on one machine (SURVEY.md §4's
+multi-node-without-a-cluster tier): a standalone master process, two
+launcher/agent processes that rendezvous through it, and two worker
+processes forming a real 2-process jax.distributed cluster over CPU.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(run_id, extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "",  # workers: 1 local CPU device each
+            "DLROVER_TPU_RUN_ID": run_id,
+            "DLROVER_TPU_HOST_ADDR": "localhost",
+        }
+    )
+    if extra:
+        env.update(extra)
+    return env
+
+
+def test_two_node_elastic_training(tmp_path):
+    run_id = f"mn{os.getpid()}"
+    master = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--port",
+            "0",
+            "--num-workers",
+            "2",
+        ],
+        cwd=REPO,
+        env=_env(run_id),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    addr = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = master.stdout.readline()
+        if not line:
+            time.sleep(0.1)
+            continue
+        m = re.match(r"DLROVER_TPU_MASTER_ADDR=(.+)", line.strip())
+        if m:
+            addr = m.group(1)
+            break
+    assert addr, "master did not print its address"
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    agents = []
+    for node_id in range(2):
+        agents.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "dlrover_tpu.agent.launcher",
+                    "--nnodes",
+                    "2",
+                    "--node-id",
+                    str(node_id),
+                    "--nproc",
+                    "1",
+                    "--master-addr",
+                    addr,
+                    "--",
+                    sys.executable,
+                    "examples/train_gpt_elastic.py",
+                    "--steps",
+                    "4",
+                    "--batch",
+                    "4",
+                    "--seq",
+                    "32",
+                    "--ckpt-dir",
+                    ckpt_dir,
+                    "--ckpt-every",
+                    "2",
+                ],
+                cwd=REPO,
+                env=_env(
+                    f"{run_id}_n{node_id}",
+                    {"DLROVER_TPU_COORDINATOR_PORT": "0"},
+                ),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+
+    outs = []
+    try:
+        for agent in agents:
+            out, _ = agent.communicate(timeout=420)
+            outs.append(out)
+        for i, agent in enumerate(agents):
+            assert agent.returncode == 0, f"agent {i} failed:\n{outs[i][-4000:]}"
+        assert any("done at step 4" in o for o in outs), outs[0][-2000:]
+        # both workers joined one jax.distributed cluster of 2 processes
+        assert any("2 global devices" in o for o in outs), outs[0][-2000:]
+    finally:
+        for agent in agents:
+            if agent.poll() is None:
+                agent.kill()
+        master.kill()
+        master.wait()

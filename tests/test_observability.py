@@ -1,0 +1,131 @@
+"""Auto-scaler, diagnosis, config tuner, metrics tests."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.diagnosis.manager import (
+    DiagnosisAction,
+    DiagnosisManager,
+    classify_failure,
+)
+from dlrover_tpu.master.auto_scaler import JobAutoScaler
+from dlrover_tpu.master.job_metrics import (
+    JobMetricCollector,
+    MetricsHTTPServer,
+)
+from dlrover_tpu.master.node_manager import JobManager, NoopScaler
+from dlrover_tpu.master.resource_optimizer import LocalHeuristicOptimizer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+
+def test_classify_failures():
+    assert classify_failure("RESOURCE_EXHAUSTED: out of memory")[0] == "oom"
+    assert classify_failure("ICI link failure on chip 2")[0] == (
+        "hardware_error"
+    )
+    assert classify_failure("ModuleNotFoundError: no module")[1] == (
+        DiagnosisAction.ABORT_JOB
+    )
+    cls, action = classify_failure("something weird")
+    assert action == DiagnosisAction.RESTART_WORKER
+
+
+def test_diagnosis_actions_queue():
+    dm = DiagnosisManager()
+    # hang report with the worker still alive → restart is prescribed
+    dm.collect_failure(
+        msgs.NodeFailureReport(node_id=3, error_data="barrier timeout"),
+        worker_alive=True,
+    )
+    assert dm.take_actions(3) == [DiagnosisAction.RESTART_WORKER]
+    assert dm.take_actions(3) == []
+    assert dm.failure_summary() == {"hang": 1}
+
+    # dead-worker failure → the agent restarts it itself; no duplicate
+    # restart action is queued, but stronger actions still are
+    dm.collect_failure(
+        msgs.NodeFailureReport(node_id=4, error_data="worker exit code 1")
+    )
+    assert dm.take_actions(4) == []
+    dm.collect_failure(
+        msgs.NodeFailureReport(node_id=5, error_data="ImportError: x")
+    )
+    assert dm.take_actions(5) == [DiagnosisAction.ABORT_JOB]
+
+
+def test_autoscaler_scale_out_and_in():
+    jm = JobManager(num_workers=2)
+    sm = SpeedMonitor()
+    scaler = NoopScaler()
+    opt = LocalHeuristicOptimizer(min_workers=2, max_workers=8, node_unit=2)
+    asc = JobAutoScaler(
+        jm,
+        sm,
+        scaler,
+        optimizer=opt,
+        min_workers=2,
+        max_workers=8,
+        node_unit=2,
+    )
+    # both workers running & speed healthy → scale out by node_unit
+    for i in range(2):
+        jm.register_node(msgs.NodeMeta(node_id=i, node_rank=i))
+    now = time.time()
+    sm.collect_global_step(0, now - 10)
+    sm.collect_global_step(50, now)
+    asc.adjust_once()
+    assert jm.worker_num == 4
+    assert scaler.plans and scaler.plans[-1].worker_num == 4
+
+    # within the grace window booting nodes don't trigger scale-in
+    asc.adjust_once()
+    assert jm.worker_num == 4
+
+    # after the grace expires, still-unplaced nodes force scale-in
+    asc.pending_grace_s = 0.0
+    asc.adjust_once()
+    assert jm.worker_num == 2
+
+
+def test_config_tuner_writes_file(tmp_path):
+    class FakeClient:
+        def get_parallel_config(self):
+            return msgs.ParallelConfig(batch_size=32, version=2)
+
+    from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+
+    path = tmp_path / "cfg.json"
+    tuner = ParalConfigTuner(FakeClient(), config_path=str(path))
+    assert tuner.poll_once()
+    doc = json.loads(path.read_text())
+    assert doc["batch_size"] == 32 and doc["version"] == 2
+    # same version → no rewrite
+    assert not tuner.poll_once()
+
+
+def test_metrics_export_http():
+    col = JobMetricCollector()
+    col.set_job_meta(job_name="j", model_name="tiny", num_params=123)
+    col.collect_runtime(10, 2.5, 4, hbm_used_mb_avg=1000.0)
+    col.inc("node_failures_total")
+    server = MetricsHTTPServer(col, port=0)
+    server.start()
+    try:
+        text = urllib.request.urlopen(
+            f"http://localhost:{server.port}/metrics", timeout=5
+        ).read().decode()
+        assert "dlrover_tpu_global_step 10" in text
+        assert "dlrover_tpu_node_failures_total 1" in text
+        doc = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{server.port}/json", timeout=5
+            ).read()
+        )
+        assert doc["meta"]["model_name"] == "tiny"
+        assert doc["records"][-1]["speed_steps_per_s"] == 2.5
+    finally:
+        server.stop()
