@@ -102,7 +102,7 @@ def dry_run(
             )
         for _ in range(warmup):
             state, metrics = step_fn(state, batch)
-        jax.block_until_ready(metrics["loss"])
+        jax.block_until_ready(state)
         compile_s = time.perf_counter() - t0
 
         t1 = time.perf_counter()

@@ -39,14 +39,14 @@ def generate_candidates(
     """Enumerate (tp, sp, fsdp, dp) factorizations + remat choices."""
     candidates: List[Strategy] = []
     for tp, sp in itertools.product(_divisors(n_devices), repeat=2):
-        if tp * sp > n_devices:
+        if n_devices % (tp * sp):
             continue
         if cfg.n_head % tp or cfg.kv_heads % tp:
             continue
         if seq % max(1, sp):
             continue
-        if sp > 1 and cfg.n_head % sp:
-            continue  # ulysses shards heads across sp
+        if sp > 1 and cfg.n_head % (sp * tp):
+            continue  # ulysses shards the tp-sharded heads across sp too
         rest = n_devices // (tp * sp)
         for fsdp in _divisors(rest):
             dp = rest // fsdp

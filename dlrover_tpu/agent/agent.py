@@ -13,6 +13,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -64,13 +65,43 @@ class ElasticLaunchConfig:
 
 
 class WorkerProcess:
-    """The single training process on this host."""
+    """The single training process on this host.
+
+    stderr is teed: echoed through to the agent's stderr AND kept as a tail
+    ring so failure reports carry the actual traceback — the master's
+    diagnosis rules classify on it (OOM/ICI/hang/user-error)."""
 
     def __init__(self, cmd: List[str], env: Dict[str, str]):
         self._cmd = cmd
         full_env = dict(os.environ)
         full_env.update(env)
-        self._proc = subprocess.Popen(cmd, env=full_env)
+        self._tail: "deque[str]" = deque(maxlen=200)
+        self._proc = subprocess.Popen(
+            cmd, env=full_env, stderr=subprocess.PIPE, text=True
+        )
+        self._pump = threading.Thread(
+            target=self._pump_stderr, name="worker-stderr", daemon=True
+        )
+        self._pump.start()
+
+    def _pump_stderr(self):
+        try:
+            for line in self._proc.stderr:
+                self._tail.append(line)
+                try:
+                    sys.stderr.write(line)
+                except OSError:
+                    # agent stderr gone (EPIPE): keep draining the pipe so
+                    # the worker never blocks on a full buffer
+                    pass
+        except ValueError:  # stream closed during shutdown
+            pass
+
+    def stderr_tail(self, max_chars: int = 4000) -> str:
+        # the pump races the exit we just observed — wait for it to drain
+        # the pipe so the final traceback makes it into the report
+        self._pump.join(timeout=5.0)
+        return "".join(self._tail)[-max_chars:]
 
     @property
     def pid(self) -> int:
@@ -244,13 +275,15 @@ class ElasticTrainingAgent:
                     self._pending_restart.clear()
                     logger.info("diagnosis action: restarting worker")
                     self._save_ckpt_to_storage()
-                    self._restart_worker()
+                    if not self._restart_worker():
+                        return 1
                 elif self._membership_changed():
                     logger.info(
                         "membership changed; checkpoint + restart workers"
                     )
                     self._save_ckpt_to_storage()
-                    self._restart_worker()
+                    if not self._restart_worker():
+                        return 1
                 continue
             if rc == 0:
                 logger.info("worker succeeded")
@@ -262,7 +295,7 @@ class ElasticTrainingAgent:
             logger.warning("worker exited rc=%d", rc)
             self._safe_report(
                 self.client.report_failure,
-                f"worker exit code {rc}",
+                f"worker exit code {rc}\n{self._worker.stderr_tail()}",
                 level=TrainingExceptionLevel.PROCESS_ERROR,
                 restart_count=self.config.max_restarts
                 - self._remaining_restarts,
@@ -270,7 +303,8 @@ class ElasticTrainingAgent:
             self._save_ckpt_to_storage()
             if self._remaining_restarts > 0:
                 self._remaining_restarts -= 1
-                self._restart_worker()
+                if not self._restart_worker():
+                    return rc
             else:
                 self._safe_report(
                     self.client.report_node_status,
@@ -286,10 +320,21 @@ class ElasticTrainingAgent:
         except Exception:  # noqa: BLE001
             return False
 
-    def _restart_worker(self):
+    def _restart_worker(self) -> bool:
+        """Re-rendezvous + respawn. False when the master is gone (job over
+        or master crashed) — the caller exits instead of raising."""
+        # a restart satisfies any restart prescription that raced with it
+        self._pending_restart.clear()
         if self._worker:
             self._worker.terminate()
-        self._initialize_worker()
+        try:
+            self._initialize_worker()
+            return True
+        except Exception:  # noqa: BLE001
+            logger.exception(
+                "restart rendezvous failed; master unreachable — exiting"
+            )
+            return False
 
     def _save_ckpt_to_storage(self):
         """Persist any staged in-memory checkpoint before losing the world."""

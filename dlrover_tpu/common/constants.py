@@ -124,6 +124,8 @@ class DefaultValues:
     AUTOSCALE_INTERVAL_S = 60.0
     SECONDS_TO_WAIT_PENDING_POD = 900
     MAX_METRIC_RECORDS = 4096
+    WORKER_DRAIN_TIMEOUT_S = 120.0   # keep serving RPCs after tasks finish
+    HANG_KICK_COOLDOWN_S = 600.0     # min gap between job-wide hang kicks
 
 
 class GraftEnv:

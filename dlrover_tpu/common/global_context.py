@@ -33,6 +33,8 @@ class Context:
         self.seconds_to_wait_pending_pod = (
             DefaultValues.SECONDS_TO_WAIT_PENDING_POD
         )
+        self.worker_drain_timeout_s = DefaultValues.WORKER_DRAIN_TIMEOUT_S
+        self.hang_kick_cooldown_s = DefaultValues.HANG_KICK_COOLDOWN_S
         self._extra: Dict[str, Any] = {}
         self._load_env_overrides()
 

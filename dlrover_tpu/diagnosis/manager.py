@@ -130,6 +130,14 @@ class DiagnosisManager:
 
     # ---- queries ---------------------------------------------------------
 
+    def queue_action_for(self, node_ids, action: str):
+        """Queue an action for a set of nodes (abort fan-out, hang kick)."""
+        with self._lock:
+            for nid in node_ids:
+                pending = self._pending_actions.setdefault(nid, [])
+                if action not in pending:
+                    pending.append(action)
+
     def take_actions(self, node_id: int) -> List[str]:
         """Drain queued actions; delivered via heartbeat responses."""
         with self._lock:

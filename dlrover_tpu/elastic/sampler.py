@@ -50,8 +50,13 @@ class ElasticDistributedSampler:
             indices = indices[:n]
         else:
             pad = (-n) % self.num_replicas
-            if pad:
-                indices = np.concatenate([indices, indices[:pad]])
+            if pad and n:
+                # tail may hold fewer than ``pad`` indices — tile so every
+                # rank still yields the same count (lockstep SPMD needs it)
+                reps = -(-pad // n)
+                indices = np.concatenate(
+                    [indices, np.tile(indices, reps)[:pad]]
+                )
         return iter(indices[self.rank :: self.num_replicas].tolist())
 
     def __len__(self) -> int:

@@ -109,6 +109,7 @@ class JobMaster:
                 interval_s=ctx.autoscale_interval_s,
             )
         self._stop = threading.Event()
+        self._last_hang_kick = 0.0
         self.exit_reason = ""
 
         # wire elastic event callbacks: a dead node's shards re-queue and
@@ -152,6 +153,10 @@ class JobMaster:
                 )
                 if self.task_manager.finished():
                     self.exit_reason = JobExitReason.SUCCEEDED
+                    # Drain: workers still run their final step, persist
+                    # checkpoints, and report status after the last shard is
+                    # done — keep serving RPCs until they exit (bounded).
+                    self._wait_workers_drain(ctx.worker_drain_timeout_s)
                     break
                 if self.job_manager.all_workers_exited():
                     if self.job_manager.all_workers_succeeded():
@@ -164,10 +169,33 @@ class JobMaster:
                 if self.job_manager.pending_timeout():
                     self.exit_reason = JobExitReason.PENDING_TIMEOUT
                     break
+                if (
+                    self.diagnosis_manager is not None
+                    and time.time() - self._last_hang_kick
+                    > ctx.hang_kick_cooldown_s
+                    and self.diagnosis_manager.all_nodes_hanged()
+                ):
+                    # job-wide hang (reference: dist_job_manager.py:802):
+                    # kick every node to checkpoint-restart its worker.
+                    # Cooldown: ckpt + re-rendezvous takes a while before
+                    # fresh CPU samples land — don't re-kick every tick.
+                    self._last_hang_kick = time.time()
+                    logger.warning("all nodes idle — prescribing restart")
+                    self.diagnosis_manager.queue_action_for(
+                        [n.id for n in self.job_manager.running_nodes()],
+                        "restart_worker",
+                    )
         finally:
             self.stop()
         logger.info("master exiting: %s", self.exit_reason)
         return self.exit_reason
+
+    def _wait_workers_drain(self, timeout_s: float):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and not self._stop.is_set():
+            if self.job_manager.all_workers_exited():
+                return
+            time.sleep(1.0)
 
     def request_stop(self, reason: str = ""):
         self.exit_reason = reason or self.exit_reason

@@ -69,7 +69,17 @@ class MasterServicer:
 
     def _report_node_failure(self, m: msgs.NodeFailureReport) -> bool:
         if self.diagnosis_manager:
-            self.diagnosis_manager.collect_failure(m)
+            rec = self.diagnosis_manager.collect_failure(m)
+            # an abort is a job-level verdict — every node must stop, not
+            # just the one that reported (the others would otherwise churn
+            # in re-rendezvous forever)
+            if rec.action == "abort_job":
+                ids = {m.node_id}
+                if self.job_manager:
+                    ids.update(
+                        n.id for n in self.job_manager.running_nodes()
+                    )
+                self.diagnosis_manager.queue_action_for(ids, rec.action)
         # the restarting worker lost its in-flight shards — re-queue them
         # (at-least-once delivery; reference: task_manager re-queue on death)
         if self.task_manager:

@@ -80,9 +80,9 @@ def ulysses_attention(
         out = attn_fn(qh, kh, vh)
         return gather_seq(out)
 
-    # batch stays sharded over (dp, fsdp) — replicating it here would
-    # all-gather the full batch and duplicate attention per dp group
-    spec = P(("dp", "fsdp"), axis, None, None)
+    # batch stays sharded over (dp, fsdp) and heads over tp — declaring
+    # either replicated would all-gather it and duplicate attention work
+    spec = P(("dp", "fsdp"), axis, _head_axis(mesh, q, k), None)
     return shard_map(
         local,
         mesh=mesh,
@@ -90,6 +90,18 @@ def ulysses_attention(
         out_specs=spec,
         check_vma=False,
     )(q, k, v)
+
+
+def _head_axis(mesh: Mesh, q, k) -> Optional[str]:
+    """Keep heads tp-sharded inside sp shard_maps when the mesh has tp.
+
+    Only when tp divides BOTH q heads and kv heads: contiguous head blocks
+    then align across shards, so the per-shard GQA repeat in
+    ``_match_heads`` maps each q head to its correct kv group."""
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and q.shape[2] % tp == 0 and k.shape[2] % tp == 0:
+        return "tp"
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -186,8 +198,8 @@ def ring_attention(
         out = acc / l_safe.transpose(0, 2, 1)[..., None]
         return out.astype(q.dtype)
 
-    # batch stays sharded over (dp, fsdp); only seq rides the sp ring
-    spec = P(("dp", "fsdp"), axis, None, None)
+    # batch stays sharded over (dp, fsdp), heads over tp; seq rides the ring
+    spec = P(("dp", "fsdp"), axis, _head_axis(mesh, q, k), None)
     return shard_map(
         local,
         mesh=mesh,

@@ -7,7 +7,7 @@ global array. Reference analog: the per-worker DataLoader + DistributedSampler
 split — here the split is the batch axis sharding itself.
 """
 
-from typing import Any, Dict
+from typing import Any, Dict, Iterator, Tuple
 
 import jax
 import numpy as np
@@ -34,3 +34,41 @@ def form_global_batch(
         )
 
     return jax.tree.map(put, local_batch)
+
+
+def iter_shards_spmd(
+    sharding_client, poll_interval_s: float = 2.0
+) -> Iterator[Tuple[int, int]]:
+    """Lockstep shard iteration for multi-host SPMD.
+
+    In SPMD every process must run the same number of (collective-bearing)
+    train steps. A per-process pull from the master's dynamic shard queue
+    (reference: sharding/client.py per-worker loop) can desync processes by
+    one shard at the end of the dataset, deadlocking the final collectives.
+    Here only process 0 talks to the master; each (start, end | done) is
+    broadcast so every process sees an identical shard sequence. Each shard
+    is one *global* step: callers slice their per-process rows out of
+    [start, end).
+    """
+    if jax.process_count() == 1:
+        for start, end, _idx in sharding_client.iter_shards():
+            yield start, end
+        return
+
+    from jax.experimental import multihost_utils
+
+    while True:
+        if jax.process_index() == 0:
+            shard = sharding_client.fetch_shard(poll_interval_s)
+            msg = np.asarray(
+                [0, 0, 1] if shard is None else [shard[0], shard[1], 0],
+                dtype=np.int64,
+            )
+        else:
+            msg = np.zeros(3, dtype=np.int64)
+        msg = multihost_utils.broadcast_one_to_all(msg)
+        if int(msg[2]):
+            return
+        yield int(msg[0]), int(msg[1])
+        if jax.process_index() == 0:
+            sharding_client.report_shard_done()

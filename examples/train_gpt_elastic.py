@@ -32,7 +32,7 @@ from dlrover_tpu.train import (
     init_train_state,
     make_optimizer,
 )
-from dlrover_tpu.train.data_utils import form_global_batch
+from dlrover_tpu.train.data_utils import form_global_batch, iter_shards_spmd
 from dlrover_tpu.train.distributed import init_distributed
 
 
@@ -75,18 +75,21 @@ def main():
         print(f"[worker] resumed from step {int(state['step'])}", flush=True)
 
     step_fn = TrainStepBuilder(cfg, mesh, opt).build()
-    # SPMD: every process consumes one shard per global step, so the
-    # dataset holds steps × processes shards of batch rows each.
+    # SPMD: one shard = one GLOBAL step (batch rows × processes); rank 0
+    # fetches from the master and broadcasts so all processes stay in
+    # lockstep; each process slices its own rows out of the shard.
+    nproc = jax.process_count()
     sharding = ShardingClient(
         client,
         "train",
-        dataset_size=args.steps * args.batch * jax.process_count(),
-        shard_size=args.batch,
+        dataset_size=args.steps * args.batch * nproc,
+        shard_size=args.batch * nproc,
     )
 
     bsh = batch_sharding(mesh)
     t0 = time.time()
-    for start, end, _idx in sharding.iter_shards():
+    for start, end in iter_shards_spmd(sharding):
+        local_start = start + jax.process_index() * args.batch
         step = int(state["step"])
         if (
             args.crash_at >= 0
@@ -96,7 +99,13 @@ def main():
             print(f"[worker] simulating crash at step {step}", flush=True)
             os._exit(17)
         batch = form_global_batch(
-            synthetic_batch(start, end, args.batch, args.seq, cfg.vocab_size),
+            synthetic_batch(
+                local_start,
+                local_start + args.batch,
+                args.batch,
+                args.seq,
+                cfg.vocab_size,
+            ),
             bsh,
         )
         state, metrics = step_fn(state, batch)

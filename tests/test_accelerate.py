@@ -102,3 +102,20 @@ def test_auto_accelerate_with_explicit_strategy():
     )
     state, metrics = result.train_step(state, batch)
     assert int(state["step"]) == 1
+
+
+def test_candidates_axes_multiply_to_device_count():
+    """tp*sp that merely fits (but does not divide) n_devices must be
+    skipped — resolved sizes always multiply out to the device count."""
+    from dlrover_tpu.accelerate.engine import generate_candidates
+    from dlrover_tpu.accelerate.strategy import apply_strategy
+    from dlrover_tpu.models import get_config
+
+    cfg = get_config("tiny", n_head=8)
+    for strat in generate_candidates(cfg, 12, seq=128, max_candidates=64):
+        plan = apply_strategy(strat)
+        sizes = plan.mesh.resolved_sizes(12)
+        prod = 1
+        for v in sizes.values():
+            prod *= v
+        assert prod == 12, (strat, sizes)

@@ -87,3 +87,18 @@ def test_elastic_trainer_grad_accum_follows_world():
     t.on_membership_change()
     assert t.grad_accum == 8  # 64 / (2*4)
     assert built == [4, 8]
+
+
+def test_sampler_short_tail_pads_equally():
+    """Tail shorter than the pad: every rank must still yield the same
+    count (lockstep SPMD deadlocks otherwise)."""
+    from dlrover_tpu.elastic.sampler import ElasticDistributedSampler
+
+    counts = []
+    for rank in range(4):
+        s = ElasticDistributedSampler(
+            dataset_size=10, num_replicas=4, rank=rank, shuffle=False
+        )
+        s.load_state_dict({"epoch": 0, "completed": 9})
+        counts.append(len(list(iter(s))))
+    assert len(set(counts)) == 1 and counts[0] >= 1

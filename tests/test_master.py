@@ -185,3 +185,27 @@ def test_task_manager_timeout_requeue():
     time.sleep(0.1)
     n = tm._datasets["d"].recover_timeout_tasks(0.05)
     assert n == 1
+
+
+def test_abort_fans_out_to_all_nodes(master):
+    """An OOM (abort-classified) failure on one node must stop every node."""
+    c0 = _client(master, 0)
+    c1 = _client(master, 1)
+    c0.report_heartbeat()
+    c1.report_heartbeat()
+    c0.report_failure(
+        "Traceback ...\nRESOURCE_EXHAUSTED: out of memory allocating ...",
+        level="process_error",
+    )
+    assert "abort_job" in c0.heartbeat_with_actions()
+    assert "abort_job" in c1.heartbeat_with_actions()
+    # actions drain: second heartbeat is clean
+    assert c1.heartbeat_with_actions() == []
+
+
+def test_unknown_failure_does_not_restart_dead_worker(master):
+    """Plain exit-code reports must not queue a duplicate restart (the
+    agent already restarts a dead worker itself)."""
+    c0 = _client(master, 0)
+    c0.report_failure("worker exit code 1", level="process_error")
+    assert c0.heartbeat_with_actions() == []
