@@ -43,7 +43,10 @@ def build_from_plan(cfg: ModelConfig, plan, devices=None):
         make_optimizer,
     )
 
+    from dlrover_tpu.parallel.pipeline import validate_pipeline_config
+
     devices = devices if devices is not None else jax.devices()
+    validate_pipeline_config(cfg, plan.mesh)
     mesh = build_mesh(plan.mesh, devices=devices)
     cfg = dc.replace(
         cfg,

@@ -48,19 +48,29 @@ def generate_candidates(
         if sp > 1 and cfg.n_head % (sp * tp):
             continue  # ulysses shards the tp-sharded heads across sp too
         rest = n_devices // (tp * sp)
-        for fsdp in _divisors(rest):
-            dp = rest // fsdp
-            base: Strategy = [
-                ("amp_bf16", {}),
-                (
-                    "mixed_parallel",
-                    {"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp},
-                ),
-            ]
-            if sp > 1:
-                base.append(("sequence_parallel", {"size": sp}))
-            candidates.append(base + [("checkpoint", {"policy": "none"})])
-            candidates.append(base + [("checkpoint", {"policy": "full"})])
+        for pp in _divisors(rest):
+            if pp > 1 and (sp > 1 or cfg.n_layer % pp):
+                continue  # pipeline can't nest sp shard_maps / split layers
+            rest2 = rest // pp
+            for fsdp in _divisors(rest2):
+                dp = rest2 // fsdp
+                base: Strategy = [
+                    ("amp_bf16", {}),
+                    (
+                        "mixed_parallel",
+                        {
+                            "dp": dp,
+                            "fsdp": fsdp,
+                            "tp": tp,
+                            "sp": sp,
+                            "pp": pp,
+                        },
+                    ),
+                ]
+                if sp > 1:
+                    base.append(("sequence_parallel", {"size": sp}))
+                candidates.append(base + [("checkpoint", {"policy": "none"})])
+                candidates.append(base + [("checkpoint", {"policy": "full"})])
     # dedupe, keep stable order
     seen = set()
     out = []
@@ -69,7 +79,36 @@ def generate_candidates(
         if key not in seen:
             seen.add(key)
             out.append(c)
-    return out[:max_candidates]
+    if len(out) <= max_candidates:
+        return out
+    # Over the cap: truncate diversity-first, not prefix-first (a prefix
+    # cut silently drops whole regions — e.g. every tp>1 plan at 16+
+    # devices). Keep the best-scoring plan of every (tp, sp, pp) group,
+    # then fill remaining slots by score.
+    def model_axes(c):
+        for name, cfg_d in c:
+            if name == "mixed_parallel":
+                return (
+                    cfg_d.get("tp", 1),
+                    cfg_d.get("sp", 1),
+                    cfg_d.get("pp", 1),
+                )
+        return (1, 1, 1)
+
+    def score(c):
+        return _heuristic_score(cfg, apply_strategy(c), n_devices)
+
+    groups = {}
+    for c in out:
+        groups.setdefault(model_axes(c), []).append(c)
+    picked = []
+    for group in groups.values():
+        group.sort(key=score, reverse=True)
+        picked.append(group[0])
+    rest = [c for g in groups.values() for c in g[1:]]
+    rest.sort(key=score, reverse=True)
+    picked.extend(rest)
+    return picked[:max_candidates]
 
 
 def _heuristic_score(
@@ -82,6 +121,11 @@ def _heuristic_score(
     score /= 1.0 + 0.15 * (sizes["tp"] - 1)   # tp all-reduces per layer
     score /= 1.0 + 0.10 * (sizes["sp"] - 1)   # sp all-to-alls
     score /= 1.0 + 0.02 * (sizes["fsdp"] - 1)  # fsdp all-gathers overlap well
+    pp = sizes["pp"]
+    if pp > 1:
+        from dlrover_tpu.parallel.pipeline import pipeline_bubble_fraction
+
+        score *= 1.0 - pipeline_bubble_fraction(pp, pp)  # GPipe fill/drain
     if plan.remat == "full":
         score *= 0.75
     return score

@@ -34,6 +34,8 @@ class ModelConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.25
+    # pipeline microbatches when the mesh has pp > 1 (0 → one per stage)
+    pp_microbatches: int = 0
 
     @property
     def kv_heads(self) -> int:

@@ -206,7 +206,7 @@ def _mlp_block(x, layer, cfg: ModelConfig, mesh):
     return h @ mlp["w_down"].astype(x.dtype)
 
 
-def _layer_body(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
+def _layer_body(x, layer, positions, cfg: ModelConfig, mesh, attn_fn):
     ln1, ln2 = layer["ln1"], layer["ln2"]
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
     x = x + _attention_block(h, layer, cfg, mesh, positions, attn_fn)
@@ -263,17 +263,31 @@ def forward(
         return flash_attention(q, k, v, causal=True)
 
     body = functools.partial(
-        _layer_body, cfg=cfg, mesh=mesh, positions=positions, attn_fn=attn_fn
+        _layer_body, cfg=cfg, mesh=mesh, attn_fn=attn_fn
     )
     if cfg.remat == "full":
         body = jax.checkpoint(body)
     elif cfg.remat == "dots_saveable":
         body = jax.checkpoint(body, policy=cp.dots_saveable)
 
-    def scan_fn(carry, layer):
-        return body(carry, layer), None
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
 
-    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+        x = pipeline_apply(
+            body,
+            params["layers"],
+            x,
+            positions,
+            mesh,
+            num_microbatches=cfg.pp_microbatches or None,
+        )
+    else:
+
+        def scan_fn(carry, layer):
+            return body(carry, layer, positions), None
+
+        x, _ = jax.lax.scan(scan_fn, x, params["layers"])
 
     fn = params["final_norm"]
     x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)

@@ -1,0 +1,143 @@
+"""Pipeline parallelism: collective-permute microbatching over the pp axis.
+
+Reference: atorch's PiPPy-based pipeline
+(auto/opt_lib/pipeline_parallel_optimization.py:56, compilers/pipe_compiler/
+distributed_pippy_compiler.py) — stage graphs executed over torch RPC with
+an interleaved schedule. None of that maps to TPU: XLA compiles one SPMD
+program, so the pipeline here is the *collective* formulation (scaling-book
+style): layer parameters are sharded over the ``pp`` mesh axis, microbatch
+activations rotate stage→stage with ``ppermute``, and the whole schedule is
+a ``lax.scan`` inside one ``shard_map`` that is manual over ``pp`` only —
+every other axis (dp/fsdp/tp/sp/ep) stays visible to GSPMD, so FSDP/TP
+sharding constraints inside the stage body keep working unchanged.
+
+Schedule: GPipe-style fill-drain over M microbatches and P stages
+(M + P - 1 ticks, bubble fraction (P-1)/(M+P-1)). Gradients come from
+plain ``jax.grad`` through the scan — ``ppermute``'s transpose is the
+reverse permute, which *is* the backward pipeline.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.parallel import sharding as shd
+
+
+def pipeline_apply(
+    body_fn: Callable,  # (x_mb [b,S,D], layer_tree, pos_mb [b,S]) -> x_mb
+    layers: Any,  # pytree, leaves [L, ...] — leading axis sharded over pp
+    x: jax.Array,  # [B, S, D]
+    positions: jax.Array,  # [B, S]
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run the layer stack as a pp-stage pipeline; returns [B, S, D].
+
+    Each pp rank owns a contiguous block of L/pp layers (the ``layers``
+    logical axis maps to ``pp`` in the sharding rules). Stage 0 feeds a new
+    microbatch every tick; activations hop one stage per tick over ICI.
+    """
+    pp = mesh.shape[axis]
+    if pp == 1:
+        raise ValueError("pipeline_apply requires a pp axis > 1")
+    b_global = x.shape[0]
+    m = num_microbatches or pp
+    if b_global % m:
+        raise ValueError(
+            f"global batch {b_global} not divisible by {m} microbatches"
+        )
+
+    compute_dtype = x.dtype
+
+    def local(layers_blk, x_all, pos_all):
+        stage = jax.lax.axis_index(axis)
+
+        # Split batch into microbatches WITHOUT concentrating a microbatch
+        # on one dp/fsdp shard: reshape so the (auto-)sharded row dim stays
+        # outermost within each microbatch.
+        def to_mb(t):
+            r = t.reshape((b_global // m, m) + t.shape[1:])
+            return r.swapaxes(0, 1)  # [M, B/M, ...]
+
+        xs, pos = to_mb(x_all), to_mb(pos_all)
+
+        def stage_apply(act, p):
+            def scan_body(c, layer):
+                return body_fn(c, layer, p), None
+
+            out, _ = jax.lax.scan(
+                scan_body, act.astype(compute_dtype), layers_blk
+            )
+            # activations cross carry/collective boundaries in f32: the
+            # transpose of a bf16 psum/collective crashes XLA ("Invalid
+            # binary instruction opcode copy"); compute stays bf16 inside
+            return out.astype(jnp.float32)
+
+        # fill-drain: no wraparound edge — stage pp-1's output exits
+        perm = [(i, i + 1) for i in range(pp - 1)]
+
+        def step(carry, t):
+            buf, outs = carry
+            # stage s processes microbatch t - s (garbage outside [0, m),
+            # clipped — those ticks are the fill/drain bubble)
+            my_mb = jnp.clip(t - stage, 0, m - 1)
+            inp = jax.lax.dynamic_index_in_dim(xs, my_mb, 0, keepdims=False)
+            p_cur = jax.lax.dynamic_index_in_dim(
+                pos, my_mb, 0, keepdims=False
+            )
+            cur = jnp.where(stage == 0, inp, buf)
+            out = stage_apply(cur, p_cur)
+            oidx = t - (pp - 1)
+            outs_upd = jax.lax.dynamic_update_index_in_dim(
+                outs, out, jnp.clip(oidx, 0, m - 1), 0
+            )
+            outs = jnp.where((stage == pp - 1) & (oidx >= 0), outs_upd, outs)
+            buf = jax.lax.ppermute(out, axis, perm)
+            return (buf, outs), None
+
+        init = jax.lax.pcast(
+            (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)), (axis,), to="varying"
+        )
+        (_, outs), _ = jax.lax.scan(step, init, jnp.arange(m + pp - 1))
+        # results accumulate on the last stage only; psum replicates them
+        # back across pp (zeros elsewhere contribute nothing)
+        outs = jax.lax.psum(outs, axis)
+        return outs.swapaxes(0, 1).reshape(x_all.shape)
+
+    layer_specs = jax.tree.map(lambda _: P(axis), layers)
+    out = jax.shard_map(
+        local,
+        mesh=mesh,
+        axis_names={axis},
+        in_specs=(layer_specs, P(), P()),
+        out_specs=P(),
+    )(layers, x.astype(jnp.float32), positions)
+    return out.astype(compute_dtype)
+
+
+def pipeline_bubble_fraction(pp: int, num_microbatches: int) -> float:
+    """Idle fraction of the GPipe fill-drain schedule."""
+    if pp <= 1:
+        return 0.0
+    return (pp - 1) / (num_microbatches + pp - 1)
+
+
+def validate_pipeline_config(cfg, mesh_cfg) -> None:
+    """Raise early on configs the pipeline cannot run."""
+    pp = mesh_cfg.pp
+    if pp <= 1:
+        return
+    if cfg.n_layer % pp:
+        raise ValueError(
+            f"n_layer={cfg.n_layer} not divisible by pp={pp}"
+        )
+    if mesh_cfg.sp > 1:
+        raise ValueError(
+            "pp>1 with sp>1 is unsupported: sequence-parallel attention "
+            "uses its own shard_map which cannot nest under the pipeline's "
+            "manual pp region"
+        )

@@ -41,6 +41,16 @@ DEFAULT_RULES: Rules = {
 }
 
 
+def rules_for_mesh(mesh: Mesh, rules: Optional[Rules] = None) -> Rules:
+    """DEFAULT_RULES specialised to a mesh: the stacked-layer axis shards
+    over pp when the mesh pipelines (each stage holds its layer block)."""
+    out = dict(DEFAULT_RULES)
+    if mesh.shape.get("pp", 1) > 1:
+        out["layers"] = "pp"
+    out.update(rules or {})
+    return out
+
+
 def logical_to_mesh_axes(
     logical_axes: Optional[Sequence[Optional[str]]],
     rules: Rules,
@@ -71,7 +81,7 @@ def shardings_for_tree(
     rules: Optional[Rules] = None,
 ):
     """Pytree of logical-axes tuples → pytree of NamedSharding."""
-    rules = dict(DEFAULT_RULES, **(rules or {}))
+    rules = rules_for_mesh(mesh, rules)
     return jax.tree.map(
         lambda axes: NamedSharding(mesh, logical_to_mesh_axes(axes, rules)),
         logical_tree,
@@ -79,17 +89,31 @@ def shardings_for_tree(
     )
 
 
-def specs_for_tree(logical_tree, rules: Optional[Rules] = None):
-    rules = dict(DEFAULT_RULES, **(rules or {}))
-    return jax.tree.map(
-        lambda axes: logical_to_mesh_axes(axes, rules),
-        logical_tree,
-        is_leaf=lambda x: x is None or isinstance(x, tuple),
-    )
-
-
 def constrain(x, mesh: Mesh, *logical_axes: Optional[str], rules=None):
-    """``with_sharding_constraint`` by logical axis names."""
-    rules = dict(DEFAULT_RULES, **(rules or {}))
+    """``with_sharding_constraint`` by logical axis names.
+
+    Works both at top level and inside a partial-manual ``shard_map`` (the
+    pipeline's pp region): there the constraint must be built against the
+    ambient abstract mesh, with any manual axes stripped from the spec.
+    """
+    rules = rules_for_mesh(mesh, rules)
     spec = logical_to_mesh_axes(logical_axes, rules)
+    am = jax.sharding.get_abstract_mesh()
+    manual = {
+        name
+        for name, t in zip(am.axis_names, am.axis_types)
+        if t == jax.sharding.AxisType.Manual
+    }
+    if manual:
+        spec = P(*[_drop_axes(entry, manual) for entry in spec])
+        return jax.lax.with_sharding_constraint(x, NamedSharding(am, spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _drop_axes(entry: MeshAxes, names: set) -> MeshAxes:
+    if entry is None:
+        return None
+    if isinstance(entry, tuple):
+        kept = tuple(a for a in entry if a not in names)
+        return kept or None
+    return None if entry in names else entry
