@@ -91,6 +91,11 @@ def analyse(
     act_b /= max(1, sizes["tp"] * sizes["sp"])
     # logits in f32 dominate for big vocabs
     act_b += tokens * cfg.vocab_size * 4 / max(1, sizes["tp"])
+    if sizes["pp"] > 1:
+        # pipeline_apply keeps the full per-stage batch (all microbatches)
+        # as fp32 input + output accumulator on every pp stage — these
+        # buffers do not shrink with pp
+        act_b += 2 * tokens * cfg.d_model * 4
 
     hbm = hbm_bytes or device_hbm_bytes()
     total = (param_b + opt_b + grad_b + act_b) * 1.15  # fragmentation slack

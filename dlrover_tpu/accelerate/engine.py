@@ -36,7 +36,7 @@ def generate_candidates(
     seq: int,
     max_candidates: int = 32,
 ) -> List[Strategy]:
-    """Enumerate (tp, sp, fsdp, dp) factorizations + remat choices."""
+    """Enumerate (tp, sp, pp, fsdp, dp) factorizations + remat choices."""
     candidates: List[Strategy] = []
     for tp, sp in itertools.product(_divisors(n_devices), repeat=2):
         if n_devices % (tp * sp):
@@ -125,7 +125,8 @@ def _heuristic_score(
     if pp > 1:
         from dlrover_tpu.parallel.pipeline import pipeline_bubble_fraction
 
-        score *= 1.0 - pipeline_bubble_fraction(pp, pp)  # GPipe fill/drain
+        n_micro = cfg.pp_microbatches or pp
+        score *= 1.0 - pipeline_bubble_fraction(pp, n_micro)  # fill/drain
     if plan.remat == "full":
         score *= 0.75
     return score

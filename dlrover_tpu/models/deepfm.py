@@ -1,0 +1,197 @@
+"""DeepFM on the sparse embedding tier.
+
+Reference analog: the criteo DeepFM system test
+(.github/actions/dlrover-system-test-deepfm, examples built on TFPlus
+KvVariable embeddings). TPU-native split: the FM + MLP compute is a pure
+jitted function over (embedding rows, dense params); the unbounded
+vocabulary lives host-side in C++ KvTables (dlrover_tpu.sparse).
+
+Model: y = sigmoid(first_order + fm_second_order + mlp(concat(embs, dense)))
+  - first-order: 1-dim "wide" embedding per categorical id
+  - second-order: 0.5 * ((Σ e)² − Σ e²) over field embeddings
+  - deep: MLP over concatenated field embeddings + dense features
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.sparse import (
+    EmbeddingCollection,
+    EmbeddingSpec,
+    GroupAdam,
+    SparseOptimizer,
+)
+from dlrover_tpu.sparse.embedding import take_rows
+
+
+@dataclass(frozen=True)
+class DeepFMConfig:
+    n_fields: int = 26            # criteo: 26 categorical fields
+    n_dense: int = 13             # criteo: 13 numeric features
+    emb_dim: int = 16
+    mlp_dims: Tuple[int, ...] = (256, 128)
+    enter_threshold: int = 0
+    seed: int = 0
+
+    @property
+    def field_names(self) -> List[str]:
+        return [f"cat_{i}" for i in range(self.n_fields)]
+
+
+def _field_key(field_idx: int, ids: np.ndarray) -> np.ndarray:
+    """Disambiguate ids across fields inside the shared tables."""
+    return (np.asarray(ids, dtype=np.int64) << 5) | np.int64(field_idx % 32)
+
+
+class DeepFM:
+    """Host-side orchestration + jitted compute.
+
+    Two KvTables: ``emb`` ([emb_dim] second-order/deep vectors) and
+    ``wide`` ([1] first-order weights), both keyed by (field, id).
+    """
+
+    def __init__(self, cfg: DeepFMConfig,
+                 optimizer: Optional[SparseOptimizer] = None,
+                 dense_lr: float = 1e-3):
+        self.cfg = cfg
+        self.coll = EmbeddingCollection(
+            [
+                EmbeddingSpec("emb", cfg.emb_dim, initializer="normal",
+                              init_scale=0.01, seed=cfg.seed,
+                              enter_threshold=cfg.enter_threshold),
+                EmbeddingSpec("wide", 1, initializer="zeros",
+                              enter_threshold=cfg.enter_threshold),
+            ],
+            optimizer=optimizer or GroupAdam(lr=1e-3),
+        )
+        self.dense_params = self._init_dense(jax.random.key(cfg.seed))
+        import optax
+
+        self.dense_opt = optax.adam(dense_lr)
+        self.dense_opt_state = self.dense_opt.init(self.dense_params)
+        self._step = jax.jit(self._make_step())
+
+    def _init_dense(self, key):
+        cfg = self.cfg
+        dims = [cfg.n_fields * cfg.emb_dim + cfg.n_dense, *cfg.mlp_dims, 1]
+        params = []
+        for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+            key, sub = jax.random.split(key)
+            params.append({
+                "w": jax.random.normal(sub, (a, b), jnp.float32)
+                * jnp.sqrt(2.0 / a),
+                "b": jnp.zeros((b,), jnp.float32),
+            })
+        return params
+
+    @staticmethod
+    def forward(dense_params, emb_rows, emb_inv, wide_rows, wide_inv,
+                dense_x, cfg: DeepFMConfig):
+        """Pure function: logits [B]. emb_inv/wide_inv: [B, n_fields]."""
+        emb = take_rows(emb_rows, emb_inv)        # [B, F, D]
+        first = take_rows(wide_rows, wide_inv)[..., 0].sum(-1)  # [B]
+        s = emb.sum(axis=1)                       # [B, D]
+        fm = 0.5 * (s * s - (emb * emb).sum(axis=1)).sum(-1)    # [B]
+        h = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1), dense_x], axis=-1
+        )
+        for i, layer in enumerate(dense_params):
+            h = h @ layer["w"] + layer["b"]
+            if i < len(dense_params) - 1:
+                h = jax.nn.relu(h)
+        return first + fm + h[..., 0]
+
+    def _make_step(self):
+        cfg = self.cfg
+        opt = self.dense_opt
+
+        def step(dense_params, opt_state, emb_rows, emb_inv, wide_rows,
+                 wide_inv, dense_x, labels):
+            def loss_fn(dense_params, emb_rows, wide_rows):
+                logits = DeepFM.forward(
+                    dense_params, emb_rows, emb_inv, wide_rows, wide_inv,
+                    dense_x, cfg,
+                )
+                # numerically-stable BCE-with-logits
+                loss = jnp.mean(
+                    jnp.maximum(logits, 0) - logits * labels
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                )
+                return loss, logits
+
+            (loss, logits), grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2), has_aux=True
+            )(dense_params, emb_rows, wide_rows)
+            d_dense, d_emb, d_wide = grads
+            updates, opt_state = opt.update(d_dense, opt_state, dense_params)
+            import optax
+
+            dense_params = optax.apply_updates(dense_params, updates)
+            auc_pairs = (logits, labels)
+            return dense_params, opt_state, loss, d_emb, d_wide, auc_pairs
+
+        return step
+
+    def train_step(self, cat_ids: np.ndarray, dense_x: np.ndarray,
+                   labels: np.ndarray) -> float:
+        """cat_ids [B, n_fields] int64, dense_x [B, n_dense], labels [B]."""
+        keyed = np.stack(
+            [_field_key(i, cat_ids[:, i]) for i in range(self.cfg.n_fields)],
+            axis=1,
+        )
+        dev, host = self.coll.pull({"emb": keyed, "wide": keyed})
+        emb_rows, emb_inv = dev["emb"]
+        wide_rows, wide_inv = dev["wide"]
+        (self.dense_params, self.dense_opt_state, loss, d_emb, d_wide,
+         _) = self._step(
+            self.dense_params, self.dense_opt_state, emb_rows, emb_inv,
+            wide_rows, wide_inv, jnp.asarray(dense_x, jnp.float32),
+            jnp.asarray(labels, jnp.float32),
+        )
+        self.coll.push(host, {"emb": d_emb, "wide": d_wide})
+        return float(loss)
+
+    def predict(self, cat_ids: np.ndarray, dense_x: np.ndarray) -> np.ndarray:
+        keyed = np.stack(
+            [_field_key(i, cat_ids[:, i]) for i in range(self.cfg.n_fields)],
+            axis=1,
+        )
+        dev, _ = self.coll.pull({"emb": keyed, "wide": keyed})
+        emb_rows, emb_inv = dev["emb"]
+        wide_rows, wide_inv = dev["wide"]
+        logits = DeepFM.forward(
+            self.dense_params, emb_rows, emb_inv, wide_rows, wide_inv,
+            jnp.asarray(dense_x, jnp.float32), self.cfg,
+        )
+        return np.asarray(jax.nn.sigmoid(logits))
+
+    # -- checkpoint -------------------------------------------------------
+    def save(self, dir_path: str, *, delta_only: bool = False) -> None:
+        import os
+        import pickle
+
+        os.makedirs(dir_path, exist_ok=True)
+        self.coll.save(dir_path, delta_only=delta_only)
+        with open(os.path.join(dir_path, "dense.pkl"), "wb") as f:
+            pickle.dump(
+                jax.tree.map(np.asarray,
+                             (self.dense_params, self.dense_opt_state)), f)
+
+    def restore(self, dir_path: str) -> None:
+        import os
+        import pickle
+
+        self.coll.restore(dir_path)
+        with open(os.path.join(dir_path, "dense.pkl"), "rb") as f:
+            dense, opt_state = pickle.load(f)
+        self.dense_params = jax.tree.map(jnp.asarray, dense)
+        self.dense_opt_state = jax.tree.map(jnp.asarray, opt_state)
+
+    def close(self):
+        self.coll.close()

@@ -1,0 +1,12 @@
+"""Native (C++) runtime components.
+
+The reference ships C++/CUDA for its sparse-embedding tier and kernels
+(tfplus/tfplus/kv_variable, atorch/atorch/ops/csrc). Here the TPU compute
+path is JAX/XLA/Pallas; the host-side runtime pieces that benefit from
+native code — the KV embedding store and its sparse optimizers — are C++
+compiled on first use into a shared library loaded via ctypes.
+"""
+
+from dlrover_tpu.native.build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,107 @@
+"""Build + load the native library.
+
+JIT-compiles the C++ sources with g++ on first import and caches the .so
+next to the sources, keyed by a hash of their contents — the same
+compile-on-demand approach as the reference's op_builder
+(atorch/atorch/ops/op_builder/builder.py), minus the CUDA toolchain.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["src/kv_store.cc", "src/sparse_optimizers.cc"]
+_HEADERS = ["src/kv_store.h"]
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for rel in _SOURCES + _HEADERS:
+        with open(os.path.join(_SRC_DIR, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build(so_path: str) -> None:
+    srcs = [os.path.join(_SRC_DIR, rel) for rel in _SOURCES]
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-march=native",
+        "-I", os.path.join(_SRC_DIR, "src"),
+        *srcs, "-o", so_path, "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:  # retry without -march
+        cmd.remove("-march=native")
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load_library() -> ctypes.CDLL:
+    """Return the loaded native library, building it if needed."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        so_path = os.path.join(_SRC_DIR, f"_dlrover_native_{_source_hash()}.so")
+        if not os.path.exists(so_path):
+            # build into a temp file then rename: concurrent processes race
+            # benignly (last rename wins, both files identical)
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
+            os.close(fd)
+            try:
+                _build(tmp)
+                os.replace(tmp, so_path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        lib = ctypes.CDLL(so_path)
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    i64, i32, u32, u64, f32 = c.c_int64, c.c_int, c.c_uint32, c.c_uint64, c.c_float
+    pi64 = c.POINTER(c.c_int64)
+    pu32 = c.POINTER(c.c_uint32)
+    pf32 = c.POINTER(c.c_float)
+
+    lib.kv_create.restype = i64
+    lib.kv_create.argtypes = [c.c_char_p, i32, i32, i32, u32]
+    lib.kv_destroy.argtypes = [i64]
+    lib.kv_set_init.argtypes = [i64, i32, f32, u64]
+    lib.kv_size.restype = i64
+    lib.kv_size.argtypes = [i64]
+    for fn in ("kv_dim", "kv_width", "kv_n_slots"):
+        getattr(lib, fn).restype = i32
+        getattr(lib, fn).argtypes = [i64]
+    lib.kv_gather_or_zeros.argtypes = [i64, pi64, i32, pf32]
+    lib.kv_gather_or_insert.argtypes = [i64, pi64, i32, pf32, u32]
+    lib.kv_gather_full.argtypes = [i64, pi64, i32, pf32, u32]
+    lib.kv_insert.argtypes = [i64, pi64, i32, pf32, u32]
+    lib.kv_scatter.argtypes = [i64, pi64, i32, pf32, i32, u32]
+    lib.kv_get_frequency.argtypes = [i64, pi64, i32, pu32]
+    lib.kv_get_timestamp.argtypes = [i64, pi64, i32, pu32]
+    lib.kv_increase_count.argtypes = [i64, pi64, i32, u32]
+    lib.kv_delete.restype = i64
+    lib.kv_delete.argtypes = [i64, pi64, i32]
+    lib.kv_delete_before_ts.restype = i64
+    lib.kv_delete_before_ts.argtypes = [i64, u32]
+    lib.kv_count_export.restype = i64
+    lib.kv_count_export.argtypes = [i64, i32]
+    lib.kv_export.restype = i64
+    lib.kv_export.argtypes = [i64, i32, i32, pi64, pf32, pu32, pu32]
+    lib.kv_import.argtypes = [i64, pi64, i64, pf32, pu32, pu32, i32]
+    lib.kv_opt_slots.restype = i32
+    lib.kv_opt_slots.argtypes = [i32]
+    lib.kv_sparse_apply.restype = i64
+    lib.kv_sparse_apply.argtypes = [i64, i32, pi64, i32, pf32, pf32, u32]

@@ -1,0 +1,436 @@
+// KvTable implementation + C ABI for ctypes.
+//
+// Reference behavior being matched (not copied):
+//   tfplus/tfplus/kv_variable/kernels/kv_variable_ops.cc (1164L) — gather /
+//   gather-or-zeros / gather-or-insert, insert, scatter add/sub/mul/div/
+//   min/max/update, size/frequency, import/export, full-or-delta export,
+//   delete-with-timestamp. See kv_store.h for the design notes.
+
+#include "kv_store.h"
+
+#include <algorithm>
+#include <cmath>
+#include <mutex>
+
+namespace dlrover_tpu {
+
+namespace {
+
+// splitmix64 over (seed, key) — stateless per-key RNG stream.
+inline uint64_t mix(uint64_t a, uint64_t b) {
+  uint64_t x = a ^ (b + 0x9e3779b97f4a7c15ull + (a << 6) + (a >> 2));
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+inline float u01(uint64_t bits) {
+  return static_cast<float>(bits >> 40) * (1.0f / 16777216.0f);  // 24-bit
+}
+
+inline void saturating_add(uint32_t& x, uint32_t d) {
+  uint64_t v = static_cast<uint64_t>(x) + d;
+  x = v > 0xffffffffull ? 0xffffffffu : static_cast<uint32_t>(v);
+}
+
+}  // namespace
+
+void KvTable::init_row(Key k, float* dst) const {
+  if (init_.kind == 0) {
+    std::memset(dst, 0, sizeof(float) * dim_);
+    return;
+  }
+  uint64_t state = mix(init_.seed, static_cast<uint64_t>(k));
+  if (init_.kind == 1) {  // uniform(-scale, scale)
+    for (int i = 0; i < dim_; ++i) {
+      state = mix(state, i + 1);
+      dst[i] = (2.0f * u01(state) - 1.0f) * init_.scale;
+    }
+  } else {  // normal(0, scale) via Box-Muller on paired uniforms
+    for (int i = 0; i < dim_; ++i) {
+      state = mix(state, i + 1);
+      float u1 = u01(state) + 1e-12f;
+      state = mix(state, 0x5bd1e995);
+      float u2 = u01(state);
+      dst[i] = init_.scale * std::sqrt(-2.0f * std::log(u1)) *
+               std::cos(6.28318530718f * u2);
+    }
+  }
+}
+
+void KvTable::GatherOrZeros(const Key* keys, int n, float* out) const {
+  for (int i = 0; i < n; ++i) {
+    const KvShard& s = *shards_[shard_id(keys[i])];
+    std::shared_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    if (it == s.index.end()) {
+      std::memset(out + size_t(i) * dim_, 0, sizeof(float) * dim_);
+    } else {
+      std::memcpy(out + size_t(i) * dim_, s.row(it->second),
+                  sizeof(float) * dim_);
+    }
+  }
+}
+
+void KvTable::GatherOrInsert(const Key* keys, int n, float* out,
+                             uint32_t now_ts) {
+  for (int i = 0; i < n; ++i) {
+    KvShard& s = shard_for(keys[i]);
+    std::unique_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    uint32_t slot;
+    if (it == s.index.end()) {
+      slot = s.alloc_slot();
+      s.index.emplace(keys[i], slot);
+      s.slot_keys[slot] = keys[i];
+      init_row(keys[i], s.row(slot));
+    } else {
+      slot = it->second;
+    }
+    RowMeta& m = s.meta[slot];
+    saturating_add(m.frequency, 1);
+    m.last_ts = now_ts;
+    if (m.frequency >= enter_threshold_) m.admitted = 1;
+    std::memcpy(out + size_t(i) * dim_, s.row(slot), sizeof(float) * dim_);
+  }
+}
+
+void KvTable::GatherFull(const Key* keys, int n, float* out,
+                         uint32_t now_ts) {
+  for (int i = 0; i < n; ++i) {
+    KvShard& s = shard_for(keys[i]);
+    std::unique_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    uint32_t slot;
+    if (it == s.index.end()) {
+      slot = s.alloc_slot();
+      s.index.emplace(keys[i], slot);
+      s.slot_keys[slot] = keys[i];
+      init_row(keys[i], s.row(slot));
+      s.meta[slot].last_ts = now_ts;
+    } else {
+      slot = it->second;
+    }
+    std::memcpy(out + size_t(i) * width_, s.row(slot),
+                sizeof(float) * width_);
+  }
+}
+
+void KvTable::Insert(const Key* keys, int n, const float* values,
+                     uint32_t now_ts) {
+  for (int i = 0; i < n; ++i) {
+    KvShard& s = shard_for(keys[i]);
+    std::unique_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    uint32_t slot;
+    if (it == s.index.end()) {
+      slot = s.alloc_slot();
+      s.index.emplace(keys[i], slot);
+      s.slot_keys[slot] = keys[i];
+    } else {
+      slot = it->second;
+    }
+    std::memcpy(s.row(slot), values + size_t(i) * dim_,
+                sizeof(float) * dim_);
+    RowMeta& m = s.meta[slot];
+    m.last_ts = now_ts;
+    m.dirty = 1;
+  }
+}
+
+void KvTable::Scatter(const Key* keys, int n, const float* updates, int op,
+                      uint32_t now_ts) {
+  for (int i = 0; i < n; ++i) {
+    KvShard& s = shard_for(keys[i]);
+    std::unique_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    uint32_t slot;
+    if (it == s.index.end()) {
+      slot = s.alloc_slot();
+      s.index.emplace(keys[i], slot);
+      s.slot_keys[slot] = keys[i];
+      init_row(keys[i], s.row(slot));
+    } else {
+      slot = it->second;
+    }
+    float* dst = s.row(slot);
+    const float* u = updates + size_t(i) * dim_;
+    switch (op) {
+      case 0: for (int d = 0; d < dim_; ++d) dst[d] += u[d]; break;
+      case 1: for (int d = 0; d < dim_; ++d) dst[d] -= u[d]; break;
+      case 2: for (int d = 0; d < dim_; ++d) dst[d] *= u[d]; break;
+      case 3: for (int d = 0; d < dim_; ++d) dst[d] /= u[d]; break;
+      case 4: for (int d = 0; d < dim_; ++d) dst[d] = std::min(dst[d], u[d]); break;
+      case 5: for (int d = 0; d < dim_; ++d) dst[d] = std::max(dst[d], u[d]); break;
+      case 6: std::memcpy(dst, u, sizeof(float) * dim_); break;
+    }
+    RowMeta& m = s.meta[slot];
+    m.last_ts = now_ts;
+    m.dirty = 1;
+  }
+}
+
+void KvTable::GetFrequency(const Key* keys, int n, uint32_t* out) const {
+  for (int i = 0; i < n; ++i) {
+    const KvShard& s = *shards_[shard_id(keys[i])];
+    std::shared_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    out[i] = it == s.index.end() ? 0 : s.meta[it->second].frequency;
+  }
+}
+
+void KvTable::GetTimestamp(const Key* keys, int n, uint32_t* out) const {
+  for (int i = 0; i < n; ++i) {
+    const KvShard& s = *shards_[shard_id(keys[i])];
+    std::shared_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    out[i] = it == s.index.end() ? 0 : s.meta[it->second].last_ts;
+  }
+}
+
+void KvTable::IncreaseCount(const Key* keys, int n, uint32_t delta) {
+  for (int i = 0; i < n; ++i) {
+    KvShard& s = shard_for(keys[i]);
+    std::unique_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    if (it == s.index.end()) continue;
+    RowMeta& m = s.meta[it->second];
+    saturating_add(m.frequency, delta);
+    if (m.frequency >= enter_threshold_) m.admitted = 1;
+  }
+}
+
+int64_t KvTable::Delete(const Key* keys, int n) {
+  int64_t removed = 0;
+  for (int i = 0; i < n; ++i) {
+    KvShard& s = shard_for(keys[i]);
+    std::unique_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    if (it == s.index.end()) continue;
+    s.release_slot(it->second);
+    s.index.erase(it);
+    ++removed;
+  }
+  return removed;
+}
+
+int64_t KvTable::DeleteBeforeTimestamp(uint32_t ts) {
+  // TTL eviction (reference: KvVariableDeleteWithTimestamp,
+  // ops/kv_variable_ops.cc:698).
+  int64_t removed = 0;
+  for (auto& sp : shards_) {
+    KvShard& s = *sp;
+    std::unique_lock l(s.mu);
+    for (auto it = s.index.begin(); it != s.index.end();) {
+      if (s.meta[it->second].last_ts < ts) {
+        s.release_slot(it->second);
+        it = s.index.erase(it);
+        ++removed;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return removed;
+}
+
+int64_t KvTable::CountExport(bool delta_only) const {
+  int64_t n = 0;
+  for (auto& sp : shards_) {
+    const KvShard& s = *sp;
+    std::shared_lock l(s.mu);
+    if (!delta_only) {
+      n += s.live();
+    } else {
+      for (auto& kv : s.index)
+        if (s.meta[kv.second].dirty) ++n;
+    }
+  }
+  return n;
+}
+
+int64_t KvTable::Export(bool delta_only, bool clear_dirty, Key* keys,
+                        float* values, uint32_t* freqs, uint32_t* ts) {
+  // Rows are exported with their full width (value + optimizer slots) so a
+  // restore resumes training exactly (the reference reaches this through
+  // separate slot-variable exports; inline slots make it one scan).
+  int64_t w = 0;
+  for (auto& sp : shards_) {
+    KvShard& s = *sp;
+    std::unique_lock l(s.mu);
+    for (auto& kv : s.index) {
+      RowMeta& m = s.meta[kv.second];
+      if (delta_only && !m.dirty) continue;
+      keys[w] = kv.first;
+      std::memcpy(values + size_t(w) * width_, s.row(kv.second),
+                  sizeof(float) * width_);
+      freqs[w] = m.frequency;
+      ts[w] = m.last_ts;
+      if (clear_dirty) m.dirty = 0;
+      ++w;
+    }
+  }
+  return w;
+}
+
+void KvTable::Import(const Key* keys, int64_t n, const float* values,
+                     const uint32_t* freqs, const uint32_t* ts,
+                     bool clear_table) {
+  if (clear_table) {
+    for (auto& sp : shards_) {
+      std::unique_lock l(sp->mu);
+      sp->index.clear();
+      sp->slab.clear();
+      sp->slot_keys.clear();
+      sp->meta.clear();
+      sp->free_slots.clear();
+    }
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    KvShard& s = shard_for(keys[i]);
+    std::unique_lock l(s.mu);
+    auto it = s.index.find(keys[i]);
+    uint32_t slot;
+    if (it == s.index.end()) {
+      slot = s.alloc_slot();
+      s.index.emplace(keys[i], slot);
+      s.slot_keys[slot] = keys[i];
+    } else {
+      slot = it->second;
+    }
+    std::memcpy(s.row(slot), values + size_t(i) * width_,
+                sizeof(float) * width_);
+    RowMeta& m = s.meta[slot];
+    m.frequency = freqs ? freqs[i] : 0;
+    m.last_ts = ts ? ts[i] : 0;
+    m.admitted = m.frequency >= enter_threshold_ ? 1 : 0;
+    m.dirty = 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// C ABI (ctypes surface). Handles are indices into a global registry.
+// ---------------------------------------------------------------------------
+
+namespace {
+std::mutex g_registry_mu;
+std::vector<std::unique_ptr<KvTable>> g_tables;
+}  // namespace
+
+// Shared with sparse_optimizers.cc.
+KvTable* kv_registry_get(int64_t h) {
+  std::lock_guard<std::mutex> l(g_registry_mu);
+  if (h < 0 || h >= static_cast<int64_t>(g_tables.size())) return nullptr;
+  return g_tables[h].get();
+}
+
+extern "C" {
+
+int64_t kv_create(const char* name, int dim, int n_slots, int n_shards,
+                  uint32_t enter_threshold) {
+  std::lock_guard<std::mutex> l(g_registry_mu);
+  g_tables.emplace_back(std::make_unique<KvTable>(
+      name ? name : "", dim, n_slots, n_shards, enter_threshold));
+  return static_cast<int64_t>(g_tables.size() - 1);
+}
+
+static KvTable* get(int64_t h) { return kv_registry_get(h); }
+
+void kv_destroy(int64_t h) {
+  std::lock_guard<std::mutex> l(g_registry_mu);
+  if (h >= 0 && h < static_cast<int64_t>(g_tables.size()))
+    g_tables[h].reset();
+}
+
+void kv_set_init(int64_t h, int kind, float scale, uint64_t seed) {
+  KvTable* t = get(h);
+  if (t) t->set_init(InitSpec{kind, scale, seed});
+}
+
+int64_t kv_size(int64_t h) {
+  KvTable* t = get(h);
+  return t ? static_cast<int64_t>(t->size()) : -1;
+}
+
+int kv_dim(int64_t h) { KvTable* t = get(h); return t ? t->dim() : -1; }
+int kv_width(int64_t h) { KvTable* t = get(h); return t ? t->width() : -1; }
+int kv_n_slots(int64_t h) { KvTable* t = get(h); return t ? t->n_slots() : -1; }
+
+void kv_gather_or_zeros(int64_t h, const int64_t* keys, int n, float* out) {
+  KvTable* t = get(h);
+  if (t) t->GatherOrZeros(keys, n, out);
+}
+
+void kv_gather_or_insert(int64_t h, const int64_t* keys, int n, float* out,
+                         uint32_t now_ts) {
+  KvTable* t = get(h);
+  if (t) t->GatherOrInsert(keys, n, out, now_ts);
+}
+
+void kv_gather_full(int64_t h, const int64_t* keys, int n, float* out,
+                    uint32_t now_ts) {
+  KvTable* t = get(h);
+  if (t) t->GatherFull(keys, n, out, now_ts);
+}
+
+void kv_insert(int64_t h, const int64_t* keys, int n, const float* values,
+               uint32_t now_ts) {
+  KvTable* t = get(h);
+  if (t) t->Insert(keys, n, values, now_ts);
+}
+
+void kv_scatter(int64_t h, const int64_t* keys, int n, const float* updates,
+                int op, uint32_t now_ts) {
+  KvTable* t = get(h);
+  if (t) t->Scatter(keys, n, updates, op, now_ts);
+}
+
+void kv_get_frequency(int64_t h, const int64_t* keys, int n, uint32_t* out) {
+  KvTable* t = get(h);
+  if (t) t->GetFrequency(keys, n, out);
+}
+
+void kv_get_timestamp(int64_t h, const int64_t* keys, int n, uint32_t* out) {
+  KvTable* t = get(h);
+  if (t) t->GetTimestamp(keys, n, out);
+}
+
+void kv_increase_count(int64_t h, const int64_t* keys, int n,
+                       uint32_t delta) {
+  KvTable* t = get(h);
+  if (t) t->IncreaseCount(keys, n, delta);
+}
+
+int64_t kv_delete(int64_t h, const int64_t* keys, int n) {
+  KvTable* t = get(h);
+  return t ? t->Delete(keys, n) : -1;
+}
+
+int64_t kv_delete_before_ts(int64_t h, uint32_t ts) {
+  KvTable* t = get(h);
+  return t ? t->DeleteBeforeTimestamp(ts) : -1;
+}
+
+int64_t kv_count_export(int64_t h, int delta_only) {
+  KvTable* t = get(h);
+  return t ? t->CountExport(delta_only != 0) : -1;
+}
+
+int64_t kv_export(int64_t h, int delta_only, int clear_dirty, int64_t* keys,
+                  float* values, uint32_t* freqs, uint32_t* ts) {
+  KvTable* t = get(h);
+  return t ? t->Export(delta_only != 0, clear_dirty != 0, keys, values,
+                       freqs, ts)
+           : -1;
+}
+
+void kv_import(int64_t h, const int64_t* keys, int64_t n,
+               const float* values, const uint32_t* freqs,
+               const uint32_t* ts, int clear_table) {
+  KvTable* t = get(h);
+  if (t) t->Import(keys, n, values, freqs, ts, clear_table != 0);
+}
+
+}  // extern "C"
+
+}  // namespace dlrover_tpu

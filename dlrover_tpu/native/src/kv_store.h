@@ -1,0 +1,183 @@
+// Host-side sparse embedding store ("KvTable").
+//
+// TPU-native analog of the reference's KvVariable
+// (tfplus/tfplus/kv_variable/kernels/kv_variable.h:89,
+//  kernels/hashmap.h:87-172, embedding_value.h): a dynamically sized
+// sparse embedding variable living in host RAM, keyed by int64 ids, with
+// per-key frequency/timestamp metadata, low-frequency admission filtering
+// (enter_threshold), TTL eviction, and full/delta export for incremental
+// checkpoints (ops/kv_variable_ops.cc:361-708).
+//
+// Design differences from the reference (deliberate, TPU-first):
+// - The device never sees the hash map. Dense gather/scatter batches cross
+//   the JAX boundary via io_callback; everything here is host code, so we
+//   use a flat open-addressing-free design: N shards, each an
+//   unordered_map<int64, uint32 slot> plus a slab arena of
+//   (1 + n_slots) * dim floats per key. Optimizer state (Adam m/v, etc.)
+//   lives inline after the embedding row — one cache walk per key per
+//   optimizer step, where the reference keeps separate slot variables.
+// - Per-shard shared_mutex instead of a global tbb map: gathers take read
+//   locks, inserts/scatters write locks; bulk ops group keys by shard.
+// - C ABI (kv_store.cc) instead of TF resource ops; ctypes on the Python
+//   side, io_callback on the JAX side.
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <random>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace dlrover_tpu {
+
+using Key = int64_t;
+
+// Row metadata, kept separate from the float slab so exports can scan it
+// without touching embedding cache lines.
+struct RowMeta {
+  uint32_t frequency = 0;   // saturating update count (kv_variable.h freq)
+  uint32_t last_ts = 0;     // seconds; for TTL eviction (DeleteWithTimestamp)
+  uint8_t dirty = 0;        // touched since last delta export
+  uint8_t admitted = 0;     // passed enter_threshold (low-freq filtering)
+};
+
+class KvShard {
+ public:
+  KvShard(int width) : width_(width) {}
+
+  mutable std::shared_mutex mu;
+  std::unordered_map<Key, uint32_t> index;  // key -> slot
+  std::vector<float> slab;                  // slot * width_ floats
+  std::vector<Key> slot_keys;               // slot -> key (for export scans)
+  std::vector<RowMeta> meta;                // slot -> metadata
+  std::vector<uint32_t> free_slots;         // recycled by deletions
+
+  float* row(uint32_t slot) { return slab.data() + size_t(slot) * width_; }
+  const float* row(uint32_t slot) const {
+    return slab.data() + size_t(slot) * width_;
+  }
+
+  uint32_t alloc_slot() {
+    if (!free_slots.empty()) {
+      uint32_t s = free_slots.back();
+      free_slots.pop_back();
+      std::memset(row(s), 0, sizeof(float) * width_);
+      meta[s] = RowMeta();
+      return s;
+    }
+    uint32_t s = static_cast<uint32_t>(slot_keys.size());
+    slab.resize(slab.size() + width_, 0.0f);
+    slot_keys.push_back(0);
+    meta.push_back(RowMeta());
+    return s;
+  }
+
+  void release_slot(uint32_t slot) { free_slots.push_back(slot); }
+
+  size_t live() const { return index.size(); }
+
+ private:
+  int width_;
+};
+
+// Random-init spec for gather_or_insert (reference: random_init_table_,
+// kv_variable.h:93 — it materialises a table of random rows; we generate
+// per-key deterministically from (seed, key) so restores are reproducible).
+struct InitSpec {
+  int kind = 0;        // 0 = zeros, 1 = uniform(-scale, scale), 2 = normal(0, scale)
+  float scale = 0.05f;
+  uint64_t seed = 0;
+};
+
+class KvTable {
+ public:
+  KvTable(std::string name, int dim, int n_slots, int n_shards,
+          uint32_t enter_threshold)
+      : name_(std::move(name)),
+        dim_(dim),
+        n_slots_(n_slots),
+        width_((1 + n_slots) * dim),
+        enter_threshold_(enter_threshold) {
+    shards_.reserve(n_shards);
+    for (int i = 0; i < n_shards; ++i)
+      shards_.emplace_back(std::make_unique<KvShard>(width_));
+  }
+
+  const std::string& name() const { return name_; }
+  int dim() const { return dim_; }
+  int n_slots() const { return n_slots_; }
+  int width() const { return width_; }
+  int n_shards() const { return static_cast<int>(shards_.size()); }
+  uint32_t enter_threshold() const { return enter_threshold_; }
+  void set_init(const InitSpec& spec) { init_ = spec; }
+
+  KvShard& shard_for(Key k) { return *shards_[shard_id(k)]; }
+  int shard_id(Key k) const {
+    // splitmix64 finalizer — cheap, well-mixed (vs the reference's murmur).
+    uint64_t x = static_cast<uint64_t>(k) + 0x9e3779b97f4a7c15ull;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+    x ^= x >> 31;
+    return static_cast<int>(x % shards_.size());
+  }
+
+  size_t size() const {
+    size_t n = 0;
+    for (auto& s : shards_) {
+      std::shared_lock l(s->mu);
+      n += s->live();
+    }
+    return n;
+  }
+
+  // --- core ops (defined in kv_store.cc) -------------------------------
+  // All take key batches; values buffers are row-major [n, dim].
+  void GatherOrZeros(const Key* keys, int n, float* out) const;
+  void GatherOrInsert(const Key* keys, int n, float* out, uint32_t now_ts);
+  void Insert(const Key* keys, int n, const float* values, uint32_t now_ts);
+  // op: 0 add 1 sub 2 mul 3 div 4 min 5 max 6 update
+  void Scatter(const Key* keys, int n, const float* updates, int op,
+               uint32_t now_ts);
+  void GetFrequency(const Key* keys, int n, uint32_t* out) const;
+  void GetTimestamp(const Key* keys, int n, uint32_t* out) const;
+  void IncreaseCount(const Key* keys, int n, uint32_t delta);
+  int64_t Delete(const Key* keys, int n);
+  int64_t DeleteBeforeTimestamp(uint32_t ts);  // TTL eviction
+
+  // Optimizer-slot access: gathers/updates row + slots together.
+  // layout per row in `out`: [value(dim), slot0(dim), ... slotS-1(dim)]
+  void GatherFull(const Key* keys, int n, float* out, uint32_t now_ts);
+
+  // Export/import. Full export returns everything; delta export returns
+  // rows dirty since the last delta-clear (incremental checkpoints,
+  // ops/kv_variable_ops.cc:576-680 FullOrDeltaImport/Export).
+  int64_t CountExport(bool delta_only) const;
+  // Caller sizes buffers from CountExport; returns rows written.
+  int64_t Export(bool delta_only, bool clear_dirty, Key* keys, float* values,
+                 uint32_t* freqs, uint32_t* ts);
+  void Import(const Key* keys, int64_t n, const float* values,
+              const uint32_t* freqs, const uint32_t* ts, bool clear_table);
+
+  // Per-key deterministic random init from (seed, key).
+  void init_row(Key k, float* dst) const;
+
+  std::vector<std::unique_ptr<KvShard>>& shards() { return shards_; }
+
+ private:
+  std::string name_;
+  int dim_;
+  int n_slots_;
+  int width_;
+  uint32_t enter_threshold_;
+  InitSpec init_;
+  std::vector<std::unique_ptr<KvShard>> shards_;
+};
+
+}  // namespace dlrover_tpu

@@ -23,8 +23,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dlrover_tpu.parallel import sharding as shd
-
 
 def pipeline_apply(
     body_fn: Callable,  # (x_mb [b,S,D], layer_tree, pos_mb [b,S]) -> x_mb

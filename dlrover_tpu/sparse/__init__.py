@@ -1,0 +1,41 @@
+"""Sparse embedding tier (TFPlus-equivalent).
+
+KvTable: dynamic sparse embedding store (C++ host runtime) — the
+reference's KvVariable (tfplus/tfplus/kv_variable). Group sparse
+optimizers run host-side in the same native library; the JAX integration
+(embedding lookup inside jitted train steps) lives in
+``dlrover_tpu.sparse.embedding``.
+"""
+
+from dlrover_tpu.sparse.kv_table import (
+    KvTable,
+    ScatterOp,
+    SparseOptimizer,
+    GroupAdam,
+    GroupAdagrad,
+    GroupAMSGrad,
+    GroupAdaBelief,
+    SparseGroupFtrl,
+    SparseMomentum,
+    SparseAdadelta,
+    SparseLamb,
+    SparseSGD,
+)
+from dlrover_tpu.sparse.embedding import EmbeddingSpec, EmbeddingCollection
+
+__all__ = [
+    "KvTable",
+    "SparseOptimizer",
+    "ScatterOp",
+    "GroupAdam",
+    "GroupAdagrad",
+    "GroupAMSGrad",
+    "GroupAdaBelief",
+    "SparseGroupFtrl",
+    "SparseMomentum",
+    "SparseAdadelta",
+    "SparseLamb",
+    "SparseSGD",
+    "EmbeddingSpec",
+    "EmbeddingCollection",
+]

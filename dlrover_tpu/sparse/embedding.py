@@ -1,0 +1,155 @@
+"""JAX integration for the sparse embedding tier.
+
+The reference wires KvVariable into the TF graph as custom resource ops
+(tfplus python/ops/embedding_ops.py); a TPU-native design must keep the
+jitted step pure, so the host↔device contract is explicit:
+
+  train path (``EmbeddingCollection.pull`` / ``push``):
+    1. host: np.unique(ids) → gather_or_insert unique rows from the C++
+       KvTable (device never sees the hash map),
+    2. device: the jitted step takes ``rows[[n_unique, dim]]`` as a
+       DIFFERENTIABLE input, indexes them with the inverse map (a cheap
+       one-hot-free ``take``), and returns ``d loss / d rows``,
+    3. host: the C++ group sparse optimizer applies the per-key update.
+
+  inference path (``lookup_callback``): a ``jax.pure_callback`` gather
+  (gather_or_zeros) usable inside jit when no gradient is needed.
+
+This is the same split the reference achieves with resource variables
+living outside the dataflow graph — here the boundary is a function
+argument instead of a side-effecting op, which is what XLA can optimize.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.sparse.kv_table import KvTable, SparseOptimizer, GroupAdam
+
+
+@dataclass
+class EmbeddingSpec:
+    name: str
+    dim: int
+    initializer: str = "uniform"
+    init_scale: float = 0.05
+    enter_threshold: int = 0
+    n_shards: int = 16
+    seed: int = 0
+
+
+class EmbeddingCollection:
+    """A set of named KvTables + one sparse optimizer, with the
+    pull → step → push choreography around a jitted train step."""
+
+    def __init__(self, specs, optimizer: Optional[SparseOptimizer] = None):
+        self.optimizer = optimizer or GroupAdam(lr=1e-3)
+        n_slots = self.optimizer.required_slots
+        self.tables: Dict[str, KvTable] = {}
+        for spec in specs:
+            self.tables[spec.name] = KvTable(
+                spec.name,
+                spec.dim,
+                n_slots=n_slots,
+                n_shards=spec.n_shards,
+                enter_threshold=spec.enter_threshold,
+                initializer=spec.initializer,
+                init_scale=spec.init_scale,
+                seed=spec.seed,
+            )
+
+    # -- train-path host side --------------------------------------------
+    def pull(self, batch_ids: Dict[str, np.ndarray]):
+        """Gather unique rows for each feature.
+
+        Returns (device_inputs, host_state):
+          device_inputs[name] = (rows [n_unique, dim] f32,
+                                 inverse [same shape as ids] i32)
+          host_state[name] = unique ids (int64), for ``push``.
+        """
+        device_inputs = {}
+        host_state = {}
+        for name, ids in batch_ids.items():
+            table = self.tables[name]
+            flat = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            rows = table.gather_or_insert(uniq)
+            device_inputs[name] = (
+                jnp.asarray(rows),
+                jnp.asarray(inverse.reshape(np.shape(ids)), dtype=jnp.int32),
+            )
+            host_state[name] = uniq
+        return device_inputs, host_state
+
+    def push(self, host_state: Dict[str, np.ndarray],
+             row_grads: Dict[str, jax.Array]) -> None:
+        """Apply d loss/d rows to each table (rows are already unique, so
+        no segment-sum is needed — ``take``'s VJP accumulated duplicates
+        on device, where it's a scatter-add the MXU pipeline hides)."""
+        for name, uniq in host_state.items():
+            g = np.asarray(row_grads[name], dtype=np.float32)
+            self.optimizer.apply(self.tables[name], uniq, g)
+
+    # -- checkpoint -------------------------------------------------------
+    def save(self, dir_path: str, *, delta_only: bool = False) -> Dict[str, int]:
+        import os
+
+        os.makedirs(dir_path, exist_ok=True)
+        written = {}
+        for name, table in self.tables.items():
+            suffix = "delta" if delta_only else "full"
+            written[name] = table.save(
+                os.path.join(dir_path, f"{name}.{suffix}.npz"),
+                delta_only=delta_only,
+            )
+        return written
+
+    def restore(self, dir_path: str) -> Dict[str, int]:
+        """Restore latest full snapshot then apply any delta on top."""
+        import glob
+        import os
+
+        loaded = {}
+        for name, table in self.tables.items():
+            full = os.path.join(dir_path, f"{name}.full.npz")
+            if os.path.exists(full):
+                loaded[name] = table.restore(full)
+            delta = os.path.join(dir_path, f"{name}.delta.npz")
+            if os.path.exists(delta):
+                loaded[name] = loaded.get(name, 0) + table.restore(
+                    delta, clear_table=False
+                )
+        return loaded
+
+    def close(self):
+        for t in self.tables.values():
+            t.close()
+
+
+# ---------------------------------------------------------------------------
+# In-jit inference lookup
+# ---------------------------------------------------------------------------
+
+
+def lookup_callback(table: KvTable, ids: jax.Array) -> jax.Array:
+    """Embedding lookup inside jit via pure_callback (inference only —
+    stops gradients). Output shape: ids.shape + (dim,)."""
+    out_shape = jax.ShapeDtypeStruct(ids.shape + (table.dim,), jnp.float32)
+
+    def host_fn(ids_np):
+        flat = np.asarray(ids_np, dtype=np.int64).reshape(-1)
+        rows = table.gather_or_zeros(flat)
+        return rows.reshape(ids_np.shape + (table.dim,))
+
+    out = jax.pure_callback(host_fn, out_shape, ids, vmap_method="sequential")
+    return jax.lax.stop_gradient(out)
+
+
+def take_rows(rows: jax.Array, inverse: jax.Array) -> jax.Array:
+    """Device-side expansion of unique rows back to batch positions."""
+    return jnp.take(rows, inverse, axis=0)

@@ -1,0 +1,379 @@
+"""KvTable: Python surface over the native sparse embedding store.
+
+Reference parity (behavior, not code): KvVariable python wrapper
+(tfplus/tfplus/kv_variable/python/ops/kv_variable_ops.py:539) and the
+sparse "group" optimizers (python/training/group_adam.py, adagrad.py,
+sparse_group_ftrl.py). Ops covered: gather-or-zeros / gather-or-insert,
+insert, scatter add/sub/mul/div/min/max/update
+(ops/kv_variable_ops.cc:272-575), frequency/timestamp, TTL delete
+(:681-707), full-or-delta export/import for incremental checkpoints
+(:576-680).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.native import load_library
+
+
+class ScatterOp(IntEnum):
+    ADD = 0
+    SUB = 1
+    MUL = 2
+    DIV = 3
+    MIN = 4
+    MAX = 5
+    UPDATE = 6
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def _keys(arr) -> np.ndarray:
+    out = np.ascontiguousarray(arr, dtype=np.int64)
+    if out.ndim != 1:
+        out = out.reshape(-1)
+    return out
+
+
+class KvTable:
+    """Dynamically-sized sparse embedding variable in host RAM.
+
+    ``n_slots`` reserves inline optimizer-state rows (2 for Adam, …);
+    ``enter_threshold`` gates training updates on key frequency (the
+    reference's low-frequency feature filtering, kv_variable.h:89
+    ``enter_threshold``).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        dim: int,
+        *,
+        n_slots: int = 2,
+        n_shards: int = 16,
+        enter_threshold: int = 0,
+        initializer: str = "uniform",
+        init_scale: float = 0.05,
+        seed: int = 0,
+    ):
+        self._lib = load_library()
+        self.name = name
+        self.dim = int(dim)
+        self.n_slots = int(n_slots)
+        self.width = (1 + self.n_slots) * self.dim
+        self._h = self._lib.kv_create(
+            name.encode(), self.dim, self.n_slots, n_shards, enter_threshold
+        )
+        kind = {"zeros": 0, "uniform": 1, "normal": 2}[initializer]
+        self._lib.kv_set_init(
+            self._h, kind, ctypes.c_float(init_scale), ctypes.c_uint64(seed)
+        )
+        self.initializer = initializer
+        self.init_scale = init_scale
+        self.seed = seed
+
+    # -- introspection ----------------------------------------------------
+    def __len__(self) -> int:
+        return int(self._lib.kv_size(self._h))
+
+    def close(self) -> None:
+        if self._h >= 0:
+            self._lib.kv_destroy(self._h)
+            self._h = -1
+
+    # -- lookups ----------------------------------------------------------
+    def _ptr(self, a: np.ndarray, typ):
+        return a.ctypes.data_as(ctypes.POINTER(typ))
+
+    def gather_or_zeros(self, keys) -> np.ndarray:
+        k = _keys(keys)
+        out = np.empty((k.size, self.dim), dtype=np.float32)
+        self._lib.kv_gather_or_zeros(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(out, ctypes.c_float),
+        )
+        return out
+
+    def gather_or_insert(self, keys, now_ts: Optional[int] = None) -> np.ndarray:
+        k = _keys(keys)
+        out = np.empty((k.size, self.dim), dtype=np.float32)
+        self._lib.kv_gather_or_insert(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(out, ctypes.c_float), now_ts if now_ts is not None else _now(),
+        )
+        return out
+
+    def gather_full(self, keys, now_ts: Optional[int] = None) -> np.ndarray:
+        """Rows with inline optimizer slots: [n, (1+n_slots)*dim]."""
+        k = _keys(keys)
+        out = np.empty((k.size, self.width), dtype=np.float32)
+        self._lib.kv_gather_full(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(out, ctypes.c_float), now_ts if now_ts is not None else _now(),
+        )
+        return out
+
+    # -- mutation ---------------------------------------------------------
+    def insert(self, keys, values, now_ts: Optional[int] = None) -> None:
+        k = _keys(keys)
+        v = np.ascontiguousarray(values, dtype=np.float32).reshape(k.size, self.dim)
+        self._lib.kv_insert(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(v, ctypes.c_float), now_ts if now_ts is not None else _now(),
+        )
+
+    def scatter(self, keys, updates, op: ScatterOp = ScatterOp.ADD,
+                now_ts: Optional[int] = None) -> None:
+        k = _keys(keys)
+        u = np.ascontiguousarray(updates, dtype=np.float32).reshape(k.size, self.dim)
+        self._lib.kv_scatter(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(u, ctypes.c_float), int(op),
+            now_ts if now_ts is not None else _now(),
+        )
+
+    def delete(self, keys) -> int:
+        k = _keys(keys)
+        return int(self._lib.kv_delete(self._h, self._ptr(k, ctypes.c_int64), k.size))
+
+    def delete_before_timestamp(self, ts: int) -> int:
+        """TTL eviction: drop keys not touched since ``ts``."""
+        return int(self._lib.kv_delete_before_ts(self._h, ts))
+
+    # -- metadata ---------------------------------------------------------
+    def frequency(self, keys) -> np.ndarray:
+        k = _keys(keys)
+        out = np.empty(k.size, dtype=np.uint32)
+        self._lib.kv_get_frequency(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(out, ctypes.c_uint32),
+        )
+        return out
+
+    def timestamp(self, keys) -> np.ndarray:
+        k = _keys(keys)
+        out = np.empty(k.size, dtype=np.uint32)
+        self._lib.kv_get_timestamp(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(out, ctypes.c_uint32),
+        )
+        return out
+
+    def increase_count(self, keys, delta: int = 1) -> None:
+        k = _keys(keys)
+        self._lib.kv_increase_count(
+            self._h, self._ptr(k, ctypes.c_int64), k.size, delta
+        )
+
+    # -- export / import (full + delta, incremental checkpoints) ---------
+    def export(self, *, delta_only: bool = False, clear_dirty: bool = True):
+        """Returns (keys, full_rows[n, width], freqs, ts)."""
+        n = int(self._lib.kv_count_export(self._h, int(delta_only)))
+        keys = np.empty(n, dtype=np.int64)
+        values = np.empty((n, self.width), dtype=np.float32)
+        freqs = np.empty(n, dtype=np.uint32)
+        ts = np.empty(n, dtype=np.uint32)
+        written = int(self._lib.kv_export(
+            self._h, int(delta_only), int(clear_dirty),
+            self._ptr(keys, ctypes.c_int64), self._ptr(values, ctypes.c_float),
+            self._ptr(freqs, ctypes.c_uint32), self._ptr(ts, ctypes.c_uint32),
+        ))
+        return keys[:written], values[:written], freqs[:written], ts[:written]
+
+    def import_(self, keys, values, freqs=None, ts=None, *,
+                clear_table: bool = False) -> None:
+        k = _keys(keys)
+        v = np.ascontiguousarray(values, dtype=np.float32).reshape(k.size, self.width)
+        f = (np.ascontiguousarray(freqs, dtype=np.uint32)
+             if freqs is not None else None)
+        t = (np.ascontiguousarray(ts, dtype=np.uint32)
+             if ts is not None else None)
+        self._lib.kv_import(
+            self._h, self._ptr(k, ctypes.c_int64), k.size,
+            self._ptr(v, ctypes.c_float),
+            self._ptr(f, ctypes.c_uint32) if f is not None else None,
+            self._ptr(t, ctypes.c_uint32) if t is not None else None,
+            int(clear_table),
+        )
+
+    def save(self, path: str, *, delta_only: bool = False) -> int:
+        """Write a (full or delta) snapshot; returns rows written."""
+        keys, values, freqs, ts = self.export(delta_only=delta_only)
+        np.savez(
+            path, keys=keys, values=values, freqs=freqs, ts=ts,
+            dim=self.dim, n_slots=self.n_slots,
+            delta=int(delta_only),
+        )
+        return keys.size
+
+    def restore(self, path: str, *, clear_table: Optional[bool] = None) -> int:
+        with np.load(path if path.endswith(".npz") else path + ".npz") as z:
+            if int(z["dim"]) != self.dim or int(z["n_slots"]) != self.n_slots:
+                raise ValueError(
+                    f"snapshot layout ({int(z['dim'])},{int(z['n_slots'])}) != "
+                    f"table ({self.dim},{self.n_slots})"
+                )
+            is_delta = bool(z["delta"])
+            clear = (not is_delta) if clear_table is None else clear_table
+            self.import_(z["keys"], z["values"], z["freqs"], z["ts"],
+                         clear_table=clear)
+            return int(z["keys"].size)
+
+
+# ---------------------------------------------------------------------------
+# Sparse optimizers (host-side applies over KvTable rows)
+# ---------------------------------------------------------------------------
+
+_OPT_IDS = {
+    "sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3, "amsgrad": 4,
+    "adabelief": 5, "ftrl": 6, "adadelta": 7, "lamb": 8,
+}
+
+
+@dataclass
+class SparseOptimizer:
+    """Base: builds the 10-float hyper block consumed by kv_sparse_apply."""
+
+    lr: float = 1e-2
+    l1: float = 0.0
+    l2: float = 0.0
+    l21: float = 0.0
+    _kind: str = field(default="sgd", init=False, repr=False)
+    _step: int = field(default=0, init=False, repr=False)
+
+    def _specific(self) -> Tuple[float, ...]:
+        return (0.0, 0.0, 0.0, 0.0, 0.0)
+
+    @property
+    def required_slots(self) -> int:
+        return int(load_library().kv_opt_slots(_OPT_IDS[self._kind]))
+
+    def apply(self, table: KvTable, keys, grads,
+              now_ts: Optional[int] = None) -> int:
+        """Apply one update. Duplicate keys must be pre-combined
+        (segment-sum) by the caller; EmbeddingCollection does this."""
+        if table.n_slots < self.required_slots:
+            raise ValueError(
+                f"{self._kind} needs {self.required_slots} slots; table "
+                f"{table.name!r} has {table.n_slots}"
+            )
+        self._step += 1
+        k = _keys(keys)
+        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
+            k.size, table.dim
+        )
+        spec = self._specific()
+        hyper = np.array(
+            [self.lr, *spec, self.l1, self.l2, self.l21, float(self._step)],
+            dtype=np.float32,
+        )
+        lib = table._lib
+        return int(lib.kv_sparse_apply(
+            table._h, _OPT_IDS[self._kind],
+            table._ptr(k, ctypes.c_int64), k.size,
+            table._ptr(g, ctypes.c_float),
+            table._ptr(hyper, ctypes.c_float),
+            now_ts if now_ts is not None else _now(),
+        ))
+
+    def state_dict(self) -> Dict:
+        return {"step": self._step}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self._step = int(sd.get("step", 0))
+
+
+@dataclass
+class SparseSGD(SparseOptimizer):
+    def __post_init__(self):
+        self._kind = "sgd"
+
+
+@dataclass
+class SparseMomentum(SparseOptimizer):
+    momentum: float = 0.9
+    nesterov: bool = False
+
+    def __post_init__(self):
+        self._kind = "momentum"
+
+    def _specific(self):
+        return (self.momentum, 1.0 if self.nesterov else 0.0, 0.0, 0.0, 0.0)
+
+
+@dataclass
+class GroupAdagrad(SparseOptimizer):
+    """Group Adagrad (reference: tfplus python/training/adagrad.py)."""
+
+    def __post_init__(self):
+        self._kind = "adagrad"
+
+
+@dataclass
+class GroupAdam(SparseOptimizer):
+    """Group Adam: Adam + sparse-group-lasso prox (tfplus group_adam.py)."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+
+    def __post_init__(self):
+        self._kind = "adam"
+
+    def _specific(self):
+        return (self.beta1, self.beta2, self.eps, 0.0, 0.0)
+
+
+@dataclass
+class GroupAMSGrad(GroupAdam):
+    def __post_init__(self):
+        self._kind = "amsgrad"
+
+
+@dataclass
+class GroupAdaBelief(GroupAdam):
+    def __post_init__(self):
+        self._kind = "adabelief"
+
+
+@dataclass
+class SparseGroupFtrl(SparseOptimizer):
+    """FTRL-prox with l1/l2 in closed form + l21 group prox
+    (tfplus sparse_group_ftrl.py)."""
+
+    lr_power: float = -0.5
+    l2_shrinkage: float = 0.0
+
+    def __post_init__(self):
+        self._kind = "ftrl"
+
+    def _specific(self):
+        return (self.lr_power, self.l2_shrinkage, 0.0, 0.0, 0.0)
+
+
+@dataclass
+class SparseAdadelta(SparseOptimizer):
+    rho: float = 0.95
+    eps: float = 1e-6
+
+    def __post_init__(self):
+        self._kind = "adadelta"
+
+    def _specific(self):
+        return (self.rho, self.eps, 0.0, 0.0, 0.0)
+
+
+@dataclass
+class SparseLamb(GroupAdam):
+    def __post_init__(self):
+        self._kind = "lamb"
