@@ -1,0 +1,40 @@
+"""Worker-side observability: profiler, kernel census, loss-spike, numerics.
+
+TPU-native analog of the reference's xpu_timer (atorch/dev/xpu_timer —
+LD_PRELOAD CUDA hook timing GEMMs clustered by B/M/N/K and NCCL collectives,
+exported via Prometheus) and of atorch/atorch/utils/{prof.py AProfiler,
+loss_spike_utils.py, numberic_checker.py}.
+
+On TPU there is nothing to LD_PRELOAD: every kernel is compiled by XLA from
+a traced program, so the census comes from the compiled HLO itself
+(exact, ahead of time) and step timing comes from host wall-clock around
+the dispatched step plus the XLA profiler for deep dives.
+"""
+
+from dlrover_tpu.observability.loss_spike import LossSpikeDetector
+from dlrover_tpu.observability.numeric import (
+    GradSanitizer,
+    NumericChecker,
+    check_finite,
+    sanitize_grads,
+)
+from dlrover_tpu.observability.profiler import (
+    KernelCensus,
+    StepTimer,
+    WorkerMetrics,
+    profile_compiled,
+    xla_trace,
+)
+
+__all__ = [
+    "KernelCensus",
+    "StepTimer",
+    "WorkerMetrics",
+    "profile_compiled",
+    "xla_trace",
+    "LossSpikeDetector",
+    "NumericChecker",
+    "GradSanitizer",
+    "check_finite",
+    "sanitize_grads",
+]

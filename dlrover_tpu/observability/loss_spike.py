@@ -1,0 +1,123 @@
+"""Loss-spike capture: record spiking iterations + the samples that caused them.
+
+Reference: atorch/atorch/utils/loss_spike_utils.py (LossSpikeBase /
+TokenLossSpike) — when a step's loss exceeds a threshold past a warmup
+iteration, append ``iter, loss, sample-ids`` to a dated file so the bad
+samples can be decoded and inspected offline.
+
+TPU-first differences: losses arrive as jax arrays (possibly per-sequence
+vectors from a vmapped loss); detection adds a rolling z-score mode on top
+of the reference's absolute threshold so slow loss decay doesn't need
+manual threshold retuning.
+"""
+
+import os
+import time
+from collections import deque
+from typing import Deque, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class LossSpikeDetector:
+    """Detect + persist loss spikes.
+
+    Args:
+        save_dir: where spike records are appended (one file per day,
+            reference layout). ``None`` disables persistence.
+        min_iter: ignore the first N iterations (warmup noise).
+        min_loss: absolute floor — a loss below this is never a spike.
+        zscore: if set (and the window is warm), a loss above the floor
+            must ALSO exceed ``mean + zscore * std`` of the trailing
+            window, so a run that merely plateaus above the floor does
+            not flag every step.
+        window: trailing window length for the rolling statistics.
+    """
+
+    def __init__(
+        self,
+        save_dir: Optional[str] = None,
+        min_iter: int = 100,
+        min_loss: float = 4.0,
+        zscore: Optional[float] = 4.0,
+        window: int = 200,
+    ):
+        self.save_dir = save_dir
+        if save_dir:
+            os.makedirs(save_dir, exist_ok=True)
+        self.min_iter = min_iter
+        self.min_loss = min_loss
+        self.zscore = zscore
+        self._window: Deque[float] = deque(maxlen=window)
+        self.spikes: List[Tuple[int, float]] = []
+
+    def _is_spike(self, it: int, loss: float) -> bool:
+        if it < self.min_iter or loss < self.min_loss:
+            return False
+        # past the floor, the z-score gate separates a genuinely high
+        # plateau from a spike above it; it needs a warm baseline, so no
+        # spikes are declared until the window has filled enough
+        if self.zscore is not None:
+            if len(self._window) < 20:
+                return False
+            xs = np.asarray(self._window)
+            mu, sd = float(xs.mean()), float(xs.std())
+            return sd > 0 and loss > mu + self.zscore * sd
+        return True
+
+    def update(
+        self,
+        it: int,
+        loss,
+        sample_ids: Optional[Sequence[int]] = None,
+        per_sample_losses=None,
+    ) -> bool:
+        """Record one step; returns True when the step is a spike.
+
+        ``per_sample_losses`` (e.g. per-sequence CE from the loss fn)
+        narrows the record to the worst offenders, mirroring the
+        reference's sample decoding path.
+        """
+        loss = float(loss)
+        spike = self._is_spike(it, loss)
+        if not spike:
+            # spikes are kept out of the rolling baseline so one outlier
+            # does not inflate the std and mask the next one
+            self._window.append(loss)
+            return False
+        self.spikes.append((it, loss))
+        if self.save_dir:
+            culprits = ""
+            if per_sample_losses is not None:
+                ps = np.asarray(per_sample_losses).reshape(-1)
+                order = np.argsort(-ps)[: min(8, ps.size)]
+                ids = (
+                    [int(sample_ids[i]) for i in order]
+                    if sample_ids is not None
+                    else [int(i) for i in order]
+                )
+                culprits = ",".join(
+                    f"{i}:{ps_i:.4f}" for i, ps_i in zip(ids, ps[order])
+                )
+            elif sample_ids is not None:
+                culprits = ",".join(str(int(i)) for i in sample_ids)
+            fname = os.path.join(
+                self.save_dir,
+                time.strftime("loss_spike_%Y%m%d.txt"),
+            )
+            with open(fname, "a") as f:
+                f.write(f"{int(time.time())}\t{it}\t{loss:.6f}\t{culprits}\n")
+        return True
+
+    @staticmethod
+    def decode(path: str, min_loss: float = 0.0):
+        """Read back spike records: [(ts, iter, loss, culprit_str), ...]."""
+        out = []
+        with open(path) as f:
+            for line in f:
+                ts, it, loss, culprits = (line.rstrip("\n").split("\t") + [""])[
+                    :4
+                ]
+                if float(loss) >= min_loss:
+                    out.append((int(ts), int(it), float(loss), culprits))
+        return out
