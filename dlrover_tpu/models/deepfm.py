@@ -162,7 +162,8 @@ class DeepFM:
             [_field_key(i, cat_ids[:, i]) for i in range(self.cfg.n_fields)],
             axis=1,
         )
-        dev, _ = self.coll.pull({"emb": keyed, "wide": keyed})
+        # frozen pull: inference must not insert rows or bump frequencies
+        dev = self.coll.pull_frozen({"emb": keyed, "wide": keyed})
         emb_rows, emb_inv = dev["emb"]
         wide_rows, wide_inv = dev["wide"]
         logits = DeepFM.forward(

@@ -39,9 +39,15 @@ def _build(so_path: str) -> None:
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except subprocess.CalledProcessError as e:  # retry without -march
+    except subprocess.CalledProcessError:  # retry without -march
         cmd.remove("-march=native")
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                "native library build failed:\n"
+                f"$ {' '.join(cmd)}\n{e.stderr}"
+            ) from e
 
 
 def load_library() -> ctypes.CDLL:
@@ -99,7 +105,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.kv_count_export.restype = i64
     lib.kv_count_export.argtypes = [i64, i32]
     lib.kv_export.restype = i64
-    lib.kv_export.argtypes = [i64, i32, i32, pi64, pf32, pu32, pu32]
+    lib.kv_export.argtypes = [i64, i32, i32, pi64, pf32, pu32, pu32, i64]
+    lib.kv_count_deleted.restype = i64
+    lib.kv_count_deleted.argtypes = [i64]
+    lib.kv_export_deleted.restype = i64
+    lib.kv_export_deleted.argtypes = [i64, pi64, i64]
     lib.kv_import.argtypes = [i64, pi64, i64, pf32, pu32, pu32, i32]
     lib.kv_opt_slots.restype = i32
     lib.kv_opt_slots.argtypes = [i32]

@@ -84,6 +84,8 @@ void KvTable::GatherOrInsert(const Key* keys, int n, float* out,
       s.index.emplace(keys[i], slot);
       s.slot_keys[slot] = keys[i];
       init_row(keys[i], s.row(slot));
+      s.meta[slot].dirty = 1;  // new row must reach the next delta export
+      s.tombstones.erase(keys[i]);
     } else {
       slot = it->second;
     }
@@ -107,7 +109,10 @@ void KvTable::GatherFull(const Key* keys, int n, float* out,
       s.index.emplace(keys[i], slot);
       s.slot_keys[slot] = keys[i];
       init_row(keys[i], s.row(slot));
-      s.meta[slot].last_ts = now_ts;
+      RowMeta& m = s.meta[slot];
+      m.last_ts = now_ts;
+      m.dirty = 1;
+      s.tombstones.erase(keys[i]);
     } else {
       slot = it->second;
     }
@@ -127,6 +132,7 @@ void KvTable::Insert(const Key* keys, int n, const float* values,
       slot = s.alloc_slot();
       s.index.emplace(keys[i], slot);
       s.slot_keys[slot] = keys[i];
+      s.tombstones.erase(keys[i]);
     } else {
       slot = it->second;
     }
@@ -150,6 +156,7 @@ void KvTable::Scatter(const Key* keys, int n, const float* updates, int op,
       s.index.emplace(keys[i], slot);
       s.slot_keys[slot] = keys[i];
       init_row(keys[i], s.row(slot));
+      s.tombstones.erase(keys[i]);
     } else {
       slot = it->second;
     }
@@ -209,6 +216,7 @@ int64_t KvTable::Delete(const Key* keys, int n) {
     if (it == s.index.end()) continue;
     s.release_slot(it->second);
     s.index.erase(it);
+    s.tombstones.insert(keys[i]);
     ++removed;
   }
   return removed;
@@ -224,6 +232,7 @@ int64_t KvTable::DeleteBeforeTimestamp(uint32_t ts) {
     for (auto it = s.index.begin(); it != s.index.end();) {
       if (s.meta[it->second].last_ts < ts) {
         s.release_slot(it->second);
+        s.tombstones.insert(it->first);
         it = s.index.erase(it);
         ++removed;
       } else {
@@ -250,10 +259,13 @@ int64_t KvTable::CountExport(bool delta_only) const {
 }
 
 int64_t KvTable::Export(bool delta_only, bool clear_dirty, Key* keys,
-                        float* values, uint32_t* freqs, uint32_t* ts) {
+                        float* values, uint32_t* freqs, uint32_t* ts,
+                        int64_t capacity) {
   // Rows are exported with their full width (value + optimizer slots) so a
   // restore resumes training exactly (the reference reaches this through
   // separate slot-variable exports; inline slots make it one scan).
+  // `capacity` bounds the writes: rows inserted between CountExport and
+  // here are skipped rather than overflowing the caller's buffers.
   int64_t w = 0;
   for (auto& sp : shards_) {
     KvShard& s = *sp;
@@ -261,6 +273,7 @@ int64_t KvTable::Export(bool delta_only, bool clear_dirty, Key* keys,
     for (auto& kv : s.index) {
       RowMeta& m = s.meta[kv.second];
       if (delta_only && !m.dirty) continue;
+      if (w >= capacity) return w;
       keys[w] = kv.first;
       std::memcpy(values + size_t(w) * width_, s.row(kv.second),
                   sizeof(float) * width_);
@@ -268,6 +281,30 @@ int64_t KvTable::Export(bool delta_only, bool clear_dirty, Key* keys,
       ts[w] = m.last_ts;
       if (clear_dirty) m.dirty = 0;
       ++w;
+    }
+    // a full export that clears dirty bits also retires the tombstones:
+    // the snapshot no longer contains the deleted keys
+    if (!delta_only && clear_dirty) s.tombstones.clear();
+  }
+  return w;
+}
+
+int64_t KvTable::CountDeleted() const {
+  int64_t n = 0;
+  for (auto& sp : shards_) {
+    std::shared_lock l(sp->mu);
+    n += static_cast<int64_t>(sp->tombstones.size());
+  }
+  return n;
+}
+
+int64_t KvTable::ExportDeleted(Key* keys, int64_t capacity) const {
+  int64_t w = 0;
+  for (auto& sp : shards_) {
+    std::shared_lock l(sp->mu);
+    for (Key k : sp->tombstones) {
+      if (w >= capacity) return w;
+      keys[w++] = k;
     }
   }
   return w;
@@ -284,6 +321,7 @@ void KvTable::Import(const Key* keys, int64_t n, const float* values,
       sp->slot_keys.clear();
       sp->meta.clear();
       sp->free_slots.clear();
+      sp->tombstones.clear();
     }
   }
   for (int64_t i = 0; i < n; ++i) {
@@ -298,6 +336,7 @@ void KvTable::Import(const Key* keys, int64_t n, const float* values,
     } else {
       slot = it->second;
     }
+    s.tombstones.erase(keys[i]);
     std::memcpy(s.row(slot), values + size_t(i) * width_,
                 sizeof(float) * width_);
     RowMeta& m = s.meta[slot];
@@ -417,11 +456,22 @@ int64_t kv_count_export(int64_t h, int delta_only) {
 }
 
 int64_t kv_export(int64_t h, int delta_only, int clear_dirty, int64_t* keys,
-                  float* values, uint32_t* freqs, uint32_t* ts) {
+                  float* values, uint32_t* freqs, uint32_t* ts,
+                  int64_t capacity) {
   KvTable* t = get(h);
   return t ? t->Export(delta_only != 0, clear_dirty != 0, keys, values,
-                       freqs, ts)
+                       freqs, ts, capacity)
            : -1;
+}
+
+int64_t kv_count_deleted(int64_t h) {
+  KvTable* t = get(h);
+  return t ? t->CountDeleted() : -1;
+}
+
+int64_t kv_export_deleted(int64_t h, int64_t* keys, int64_t capacity) {
+  KvTable* t = get(h);
+  return t ? t->ExportDeleted(keys, capacity) : -1;
 }
 
 void kv_import(int64_t h, const int64_t* keys, int64_t n,

@@ -58,6 +58,7 @@ class KvShard {
   std::vector<Key> slot_keys;               // slot -> key (for export scans)
   std::vector<RowMeta> meta;                // slot -> metadata
   std::vector<uint32_t> free_slots;         // recycled by deletions
+  std::unordered_set<Key> tombstones;       // deleted since last full export
 
   float* row(uint32_t slot) { return slab.data() + size_t(slot) * width_; }
   const float* row(uint32_t slot) const {
@@ -155,13 +156,21 @@ class KvTable {
   // layout per row in `out`: [value(dim), slot0(dim), ... slotS-1(dim)]
   void GatherFull(const Key* keys, int n, float* out, uint32_t now_ts);
 
-  // Export/import. Full export returns everything; delta export returns
-  // rows dirty since the last delta-clear (incremental checkpoints,
-  // ops/kv_variable_ops.cc:576-680 FullOrDeltaImport/Export).
+  // Export/import (incremental checkpoints, ops/kv_variable_ops.cc:576-680
+  // FullOrDeltaImport/Export). Dirty bits and tombstones mean "changed /
+  // deleted since the last full export", so a delta is CUMULATIVE: one
+  // full snapshot + the latest delta restores the complete table. A full
+  // export with clear_dirty resets both.
   int64_t CountExport(bool delta_only) const;
-  // Caller sizes buffers from CountExport; returns rows written.
+  // Caller sizes buffers from CountExport and passes that as `capacity`;
+  // concurrent inserts between the two calls cannot overflow the buffers.
+  // Returns rows written.
   int64_t Export(bool delta_only, bool clear_dirty, Key* keys, float* values,
-                 uint32_t* freqs, uint32_t* ts);
+                 uint32_t* freqs, uint32_t* ts, int64_t capacity);
+  // Keys deleted since the last full export (restore applies these after
+  // importing a delta so TTL eviction survives full+delta restores).
+  int64_t CountDeleted() const;
+  int64_t ExportDeleted(Key* keys, int64_t capacity) const;
   void Import(const Key* keys, int64_t n, const float* values,
               const uint32_t* freqs, const uint32_t* ts, bool clear_table);
 

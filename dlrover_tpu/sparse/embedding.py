@@ -86,6 +86,23 @@ class EmbeddingCollection:
             host_state[name] = uniq
         return device_inputs, host_state
 
+    def pull_frozen(self, batch_ids: Dict[str, np.ndarray]):
+        """Inference-path pull: gather_or_zeros, so unseen ids get the
+        cold-start zero row and NOTHING is mutated — no inserts, no
+        frequency bumps (evaluation must not pollute admission counters
+        or delta checkpoints)."""
+        device_inputs = {}
+        for name, ids in batch_ids.items():
+            table = self.tables[name]
+            flat = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            rows = table.gather_or_zeros(uniq)
+            device_inputs[name] = (
+                jnp.asarray(rows),
+                jnp.asarray(inverse.reshape(np.shape(ids)), dtype=jnp.int32),
+            )
+        return device_inputs
+
     def push(self, host_state: Dict[str, np.ndarray],
              row_grads: Dict[str, jax.Array]) -> None:
         """Apply d loss/d rows to each table (rows are already unique, so

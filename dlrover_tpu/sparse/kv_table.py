@@ -176,8 +176,18 @@ class KvTable:
         )
 
     # -- export / import (full + delta, incremental checkpoints) ---------
-    def export(self, *, delta_only: bool = False, clear_dirty: bool = True):
-        """Returns (keys, full_rows[n, width], freqs, ts)."""
+    def export(self, *, delta_only: bool = False,
+               clear_dirty: Optional[bool] = None):
+        """Returns (keys, full_rows[n, width], freqs, ts).
+
+        Dirty bits mean "changed since the last FULL export", so deltas
+        are cumulative: full + latest delta restores the whole table.
+        ``clear_dirty`` therefore defaults to True for full exports and
+        False for deltas (clearing on a delta would make later deltas
+        incomplete once earlier delta files are overwritten).
+        """
+        if clear_dirty is None:
+            clear_dirty = not delta_only
         n = int(self._lib.kv_count_export(self._h, int(delta_only)))
         keys = np.empty(n, dtype=np.int64)
         values = np.empty((n, self.width), dtype=np.float32)
@@ -187,8 +197,18 @@ class KvTable:
             self._h, int(delta_only), int(clear_dirty),
             self._ptr(keys, ctypes.c_int64), self._ptr(values, ctypes.c_float),
             self._ptr(freqs, ctypes.c_uint32), self._ptr(ts, ctypes.c_uint32),
+            n,
         ))
         return keys[:written], values[:written], freqs[:written], ts[:written]
+
+    def export_deleted(self) -> np.ndarray:
+        """Keys deleted since the last full export (delta tombstones)."""
+        n = int(self._lib.kv_count_deleted(self._h))
+        keys = np.empty(n, dtype=np.int64)
+        written = int(self._lib.kv_export_deleted(
+            self._h, self._ptr(keys, ctypes.c_int64), n
+        ))
+        return keys[:written]
 
     def import_(self, keys, values, freqs=None, ts=None, *,
                 clear_table: bool = False) -> None:
@@ -207,10 +227,20 @@ class KvTable:
         )
 
     def save(self, path: str, *, delta_only: bool = False) -> int:
-        """Write a (full or delta) snapshot; returns rows written."""
+        """Write a (full or delta) snapshot; returns rows written.
+
+        Delta snapshots are cumulative since the last full snapshot and
+        carry tombstones, so restoring full + latest delta reproduces
+        the table exactly, including TTL evictions.
+        """
+        deleted = (
+            self.export_deleted() if delta_only
+            else np.empty(0, dtype=np.int64)
+        )
         keys, values, freqs, ts = self.export(delta_only=delta_only)
         np.savez(
             path, keys=keys, values=values, freqs=freqs, ts=ts,
+            deleted=deleted,
             dim=self.dim, n_slots=self.n_slots,
             delta=int(delta_only),
         )
@@ -227,6 +257,8 @@ class KvTable:
             clear = (not is_delta) if clear_table is None else clear_table
             self.import_(z["keys"], z["values"], z["freqs"], z["ts"],
                          clear_table=clear)
+            if "deleted" in z.files and z["deleted"].size:
+                self.delete(z["deleted"])
             return int(z["keys"].size)
 
 
@@ -249,7 +281,10 @@ class SparseOptimizer:
     l2: float = 0.0
     l21: float = 0.0
     _kind: str = field(default="sgd", init=False, repr=False)
-    _step: int = field(default=0, init=False, repr=False)
+    # one optimizer instance may serve several tables (EmbeddingCollection);
+    # Adam-style bias correction needs each table's own step count
+    _steps: Dict[str, int] = field(default_factory=dict, init=False,
+                                   repr=False)
 
     def _specific(self) -> Tuple[float, ...]:
         return (0.0, 0.0, 0.0, 0.0, 0.0)
@@ -267,14 +302,15 @@ class SparseOptimizer:
                 f"{self._kind} needs {self.required_slots} slots; table "
                 f"{table.name!r} has {table.n_slots}"
             )
-        self._step += 1
+        step = self._steps.get(table.name, 0) + 1
+        self._steps[table.name] = step
         k = _keys(keys)
         g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
             k.size, table.dim
         )
         spec = self._specific()
         hyper = np.array(
-            [self.lr, *spec, self.l1, self.l2, self.l21, float(self._step)],
+            [self.lr, *spec, self.l1, self.l2, self.l21, float(step)],
             dtype=np.float32,
         )
         lib = table._lib
@@ -287,10 +323,13 @@ class SparseOptimizer:
         ))
 
     def state_dict(self) -> Dict:
-        return {"step": self._step}
+        return {"steps": dict(self._steps)}
 
     def load_state_dict(self, sd: Dict) -> None:
-        self._step = int(sd.get("step", 0))
+        if "steps" in sd:
+            self._steps = {k: int(v) for k, v in sd["steps"].items()}
+        elif "step" in sd:  # legacy single-counter checkpoints
+            self._steps = {}
 
 
 @dataclass

@@ -118,6 +118,82 @@ class TestKvTable:
         assert len(other) == 4
         other.close()
 
+    def test_delta_is_cumulative_since_full(self, table, tmp_path):
+        """Overwriting the delta file between saves must lose nothing:
+        each delta carries ALL changes since the last full snapshot."""
+        table.insert([1, 2], np.ones((2, 4)))
+        full = str(tmp_path / "full.npz")
+        table.save(full)
+        delta = str(tmp_path / "delta.npz")
+        table.insert([3], np.full((1, 4), 3.0))
+        assert table.save(delta, delta_only=True) == 1
+        table.insert([4], np.full((1, 4), 4.0))
+        # second delta OVERWRITES the first; key 3 must still be in it
+        assert table.save(delta, delta_only=True) == 2
+        other = KvTable("cum", 4, n_slots=2, initializer="zeros")
+        other.restore(full)
+        other.restore(delta, clear_table=False)
+        np.testing.assert_allclose(other.gather_or_zeros([3])[0], 3.0)
+        np.testing.assert_allclose(other.gather_or_zeros([4])[0], 4.0)
+        assert len(other) == 4
+        other.close()
+
+    def test_delta_carries_deletions(self, table, tmp_path):
+        """TTL eviction / deletes must survive a full+delta restore
+        (the reference's full-or-delta export tracks deleted keys)."""
+        table.insert([1, 2, 3], np.ones((3, 4)), now_ts=100)
+        full = str(tmp_path / "full.npz")
+        table.save(full)
+        table.insert([9], np.full((1, 4), 9.0), now_ts=300)
+        assert table.delete_before_timestamp(200) == 3  # evict 1,2,3
+        delta = str(tmp_path / "delta.npz")
+        table.save(delta, delta_only=True)
+        other = KvTable("tomb", 4, n_slots=2, initializer="zeros")
+        other.restore(full)
+        other.restore(delta, clear_table=False)
+        assert len(other) == 1  # 1,2,3 stay dead
+        np.testing.assert_allclose(other.gather_or_zeros([1])[0], 0.0)
+        np.testing.assert_allclose(other.gather_or_zeros([9])[0], 9.0)
+        other.close()
+        # a re-inserted key is not resurrection-deleted by the tombstone
+        table.insert([2], np.full((1, 4), 2.0), now_ts=400)
+        delta2 = str(tmp_path / "delta2.npz")
+        table.save(delta2, delta_only=True)
+        other2 = KvTable("tomb2", 4, n_slots=2, initializer="zeros")
+        other2.restore(full)
+        other2.restore(delta2, clear_table=False)
+        np.testing.assert_allclose(other2.gather_or_zeros([2])[0], 2.0)
+        assert len(other2) == 2  # keys 2 and 9
+        other2.close()
+
+    def test_gather_or_insert_rows_reach_delta(self, table, tmp_path):
+        """Rows created by gather_or_insert (the train-path insert) must
+        be dirty, else delta checkpoints silently drop new features."""
+        table.save(str(tmp_path / "full.npz"))  # clears dirty
+        table.gather_or_insert([7, 8])
+        keys, _, _, _ = table.export(delta_only=True)
+        assert set(keys.tolist()) == {7, 8}
+
+    def test_export_capacity_bound(self, table):
+        """kv_export never writes past the caller's buffer size."""
+        import ctypes
+
+        table.insert(np.arange(10, dtype=np.int64), np.ones((10, 4)))
+        cap = 4
+        keys = np.empty(cap, dtype=np.int64)
+        values = np.empty((cap, table.width), dtype=np.float32)
+        freqs = np.empty(cap, dtype=np.uint32)
+        ts = np.empty(cap, dtype=np.uint32)
+        written = int(table._lib.kv_export(
+            table._h, 0, 0,
+            table._ptr(keys, ctypes.c_int64),
+            table._ptr(values, ctypes.c_float),
+            table._ptr(freqs, ctypes.c_uint32),
+            table._ptr(ts, ctypes.c_uint32),
+            cap,
+        ))
+        assert written == cap
+
     def test_import_layout_mismatch_raises(self, table, tmp_path):
         table.insert([1], np.ones((1, 4)))
         path = str(tmp_path / "snap.npz")
@@ -238,6 +314,46 @@ class TestEmbeddingCollection:
             coll.push(host, {"feat": gr})
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.05
+        coll.close()
+
+    def test_per_table_optimizer_steps(self):
+        """One optimizer over two tables: each table's bias correction
+        must see its own step count, not the interleaved total."""
+        from dlrover_tpu.sparse.kv_table import GroupAdam, KvTable
+
+        shared = GroupAdam(lr=0.1)
+        solo = GroupAdam(lr=0.1)
+        ta = KvTable("ta", 4, n_slots=2, initializer="zeros")
+        tb = KvTable("tb", 4, n_slots=2, initializer="zeros")
+        tc = KvTable("tc", 4, n_slots=2, initializer="zeros")
+        g = np.full((1, 4), 0.5, dtype=np.float32)
+        for _ in range(3):
+            shared.apply(ta, [1], g)   # interleaved: ta, tb, ta, tb, ...
+            shared.apply(tb, [1], g)
+            solo.apply(tc, [1], g)     # tc sees steps 1,2,3
+        np.testing.assert_allclose(
+            ta.gather_or_zeros([1]), tc.gather_or_zeros([1]), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            tb.gather_or_zeros([1]), tc.gather_or_zeros([1]), rtol=1e-6
+        )
+        assert shared.state_dict()["steps"] == {"ta": 3, "tb": 3}
+        for t in (ta, tb, tc):
+            t.close()
+
+    def test_pull_frozen_does_not_mutate(self):
+        coll = EmbeddingCollection([EmbeddingSpec("f", dim=4)])
+        coll.pull({"f": np.array([1, 2])})
+        n0 = len(coll.tables["f"])
+        f0 = coll.tables["f"].frequency([1, 2]).copy()
+        dev = coll.pull_frozen({"f": np.array([1, 2, 777])})
+        rows, inv = dev["f"]
+        assert len(coll.tables["f"]) == n0          # no insert of 777
+        np.testing.assert_array_equal(
+            coll.tables["f"].frequency([1, 2]), f0  # no freq bump
+        )
+        # unseen id gets the cold-start zero row
+        np.testing.assert_allclose(np.asarray(rows)[int(inv[2])], 0.0)
         coll.close()
 
     def test_save_restore_roundtrip(self, tmp_path):
