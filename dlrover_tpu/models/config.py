@@ -36,6 +36,10 @@ class ModelConfig:
     capacity_factor: float = 1.25
     # pipeline microbatches when the mesh has pp > 1 (0 → one per stage)
     pp_microbatches: int = 0
+    # muP (train/mup.py): width of the base model hyperparams were tuned
+    # at; None = standard parametrization. When set, attention uses 1/d
+    # scaling and tied logits get the 1/width_mult MuReadout multiplier.
+    mup_base_width: Optional[int] = None
 
     @property
     def kv_heads(self) -> int:
@@ -60,6 +64,29 @@ class ModelConfig:
         n = self.num_params()
         attn_flops = 12 * self.n_layer * self.d_model * seq_len
         return 6.0 * n + attn_flops
+
+
+def mup_base_config(cfg: "ModelConfig") -> "ModelConfig":
+    """The base-width twin of ``cfg`` for muP infshape computation.
+
+    Width dims (d_model, d_ff, heads) shrink to ``mup_base_width``
+    proportionally with head_dim held constant — depth, vocab and seq are
+    muP-invariant and stay put.
+    """
+    if not cfg.mup_base_width:
+        raise ValueError("cfg.mup_base_width is not set")
+    ratio = cfg.mup_base_width / cfg.d_model
+    return replace(
+        cfg,
+        d_model=cfg.mup_base_width,
+        d_ff=max(int(cfg.d_ff * ratio), 1),
+        n_head=max(int(cfg.n_head * ratio), 1),
+        n_kv_head=(
+            max(int(cfg.kv_heads * ratio), 1)
+            if cfg.n_kv_head is not None
+            else None
+        ),
+    )
 
 
 def _gpt2(name, n_layer, n_head, d_model, max_seq=1024):

@@ -184,6 +184,10 @@ def _attention_block(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
     if cfg.pos == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+    if cfg.mup_base_width:
+        # muP attention: 1/d_head total scaling (the attn impls apply
+        # 1/sqrt(d_head); fold the rest into q)
+        q = q * (hd ** -0.5)
     if mesh is not None:
         q = shd.constrain(q, mesh, "batch", "seq", "heads", None)
         k = shd.constrain(k, mesh, "batch", "seq", "kv", None)
@@ -298,6 +302,13 @@ def forward(
     logits = jnp.einsum(
         "bsd,dv->bsv", x, w_out.astype(dt), preferred_element_type=jnp.float32
     )
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        # MuReadout multiplier — ONLY for tied embeddings, where the
+        # readout weight is the (input-class) embedding and cannot carry
+        # the output-class init/lr scaling itself. An untied lm_head gets
+        # that scaling from rescale_init + mu_adam instead; giving it the
+        # multiplier too would doubly suppress the logits.
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
     return logits
 
 

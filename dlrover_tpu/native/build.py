@@ -110,7 +110,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.kv_count_deleted.argtypes = [i64]
     lib.kv_export_deleted.restype = i64
     lib.kv_export_deleted.argtypes = [i64, pi64, i64]
-    lib.kv_import.argtypes = [i64, pi64, i64, pf32, pu32, pu32, i32]
+    lib.kv_import.argtypes = [i64, pi64, i64, pf32, pu32, pu32, i32, i32]
     lib.kv_opt_slots.restype = i32
     lib.kv_opt_slots.argtypes = [i32]
     lib.kv_sparse_apply.restype = i64

@@ -312,7 +312,7 @@ int64_t KvTable::ExportDeleted(Key* keys, int64_t capacity) const {
 
 void KvTable::Import(const Key* keys, int64_t n, const float* values,
                      const uint32_t* freqs, const uint32_t* ts,
-                     bool clear_table) {
+                     bool clear_table, bool mark_dirty) {
   if (clear_table) {
     for (auto& sp : shards_) {
       std::unique_lock l(sp->mu);
@@ -343,7 +343,10 @@ void KvTable::Import(const Key* keys, int64_t n, const float* values,
     m.frequency = freqs ? freqs[i] : 0;
     m.last_ts = ts ? ts[i] : 0;
     m.admitted = m.frequency >= enter_threshold_ ? 1 : 0;
-    m.dirty = 0;
+    // Rows imported from a DELTA snapshot must stay dirty: they are not
+    // in the last full snapshot, so the next (cumulative) delta export
+    // still has to carry them. Full-snapshot imports start clean.
+    m.dirty = mark_dirty ? 1 : 0;
   }
 }
 
@@ -476,9 +479,10 @@ int64_t kv_export_deleted(int64_t h, int64_t* keys, int64_t capacity) {
 
 void kv_import(int64_t h, const int64_t* keys, int64_t n,
                const float* values, const uint32_t* freqs,
-               const uint32_t* ts, int clear_table) {
+               const uint32_t* ts, int clear_table, int mark_dirty) {
   KvTable* t = get(h);
-  if (t) t->Import(keys, n, values, freqs, ts, clear_table != 0);
+  if (t)
+    t->Import(keys, n, values, freqs, ts, clear_table != 0, mark_dirty != 0);
 }
 
 }  // extern "C"

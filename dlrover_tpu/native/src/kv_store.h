@@ -171,8 +171,12 @@ class KvTable {
   // importing a delta so TTL eviction survives full+delta restores).
   int64_t CountDeleted() const;
   int64_t ExportDeleted(Key* keys, int64_t capacity) const;
+  // mark_dirty: set when importing a delta snapshot — its rows are absent
+  // from the last full snapshot, so later cumulative deltas must include
+  // them.
   void Import(const Key* keys, int64_t n, const float* values,
-              const uint32_t* freqs, const uint32_t* ts, bool clear_table);
+              const uint32_t* freqs, const uint32_t* ts, bool clear_table,
+              bool mark_dirty);
 
   // Per-key deterministic random init from (seed, key).
   void init_row(Key k, float* dst) const;

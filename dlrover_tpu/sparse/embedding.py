@@ -75,33 +75,34 @@ class EmbeddingCollection:
         device_inputs = {}
         host_state = {}
         for name, ids in batch_ids.items():
-            table = self.tables[name]
-            flat = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
-            uniq, inverse = np.unique(flat, return_inverse=True)
-            rows = table.gather_or_insert(uniq)
-            device_inputs[name] = (
-                jnp.asarray(rows),
-                jnp.asarray(inverse.reshape(np.shape(ids)), dtype=jnp.int32),
-            )
+            dev, uniq = self._pull_one(name, ids, train=True)
+            device_inputs[name] = dev
             host_state[name] = uniq
         return device_inputs, host_state
+
+    def _pull_one(self, name: str, ids, train: bool):
+        table = self.tables[name]
+        flat = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows = (
+            table.gather_or_insert(uniq) if train
+            else table.gather_or_zeros(uniq)
+        )
+        dev = (
+            jnp.asarray(rows),
+            jnp.asarray(inverse.reshape(np.shape(ids)), dtype=jnp.int32),
+        )
+        return dev, uniq
 
     def pull_frozen(self, batch_ids: Dict[str, np.ndarray]):
         """Inference-path pull: gather_or_zeros, so unseen ids get the
         cold-start zero row and NOTHING is mutated — no inserts, no
         frequency bumps (evaluation must not pollute admission counters
         or delta checkpoints)."""
-        device_inputs = {}
-        for name, ids in batch_ids.items():
-            table = self.tables[name]
-            flat = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
-            uniq, inverse = np.unique(flat, return_inverse=True)
-            rows = table.gather_or_zeros(uniq)
-            device_inputs[name] = (
-                jnp.asarray(rows),
-                jnp.asarray(inverse.reshape(np.shape(ids)), dtype=jnp.int32),
-            )
-        return device_inputs
+        return {
+            name: self._pull_one(name, ids, train=False)[0]
+            for name, ids in batch_ids.items()
+        }
 
     def push(self, host_state: Dict[str, np.ndarray],
              row_grads: Dict[str, jax.Array]) -> None:

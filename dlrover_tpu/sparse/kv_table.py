@@ -211,7 +211,7 @@ class KvTable:
         return keys[:written]
 
     def import_(self, keys, values, freqs=None, ts=None, *,
-                clear_table: bool = False) -> None:
+                clear_table: bool = False, mark_dirty: bool = False) -> None:
         k = _keys(keys)
         v = np.ascontiguousarray(values, dtype=np.float32).reshape(k.size, self.width)
         f = (np.ascontiguousarray(freqs, dtype=np.uint32)
@@ -223,7 +223,7 @@ class KvTable:
             self._ptr(v, ctypes.c_float),
             self._ptr(f, ctypes.c_uint32) if f is not None else None,
             self._ptr(t, ctypes.c_uint32) if t is not None else None,
-            int(clear_table),
+            int(clear_table), int(mark_dirty),
         )
 
     def save(self, path: str, *, delta_only: bool = False) -> int:
@@ -255,8 +255,11 @@ class KvTable:
                 )
             is_delta = bool(z["delta"])
             clear = (not is_delta) if clear_table is None else clear_table
+            # delta rows stay dirty after a restore: they are not in the
+            # last full snapshot, so the next cumulative delta must still
+            # carry them (and restore's delete() re-seeds the tombstones)
             self.import_(z["keys"], z["values"], z["freqs"], z["ts"],
-                         clear_table=clear)
+                         clear_table=clear, mark_dirty=is_delta)
             if "deleted" in z.files and z["deleted"].size:
                 self.delete(z["deleted"])
             return int(z["keys"].size)
@@ -285,6 +288,9 @@ class SparseOptimizer:
     # Adam-style bias correction needs each table's own step count
     _steps: Dict[str, int] = field(default_factory=dict, init=False,
                                    repr=False)
+    # starting count for tables first seen after load_state_dict (legacy
+    # single-counter checkpoints)
+    _default_step: int = field(default=0, init=False, repr=False)
 
     def _specific(self) -> Tuple[float, ...]:
         return (0.0, 0.0, 0.0, 0.0, 0.0)
@@ -302,7 +308,7 @@ class SparseOptimizer:
                 f"{self._kind} needs {self.required_slots} slots; table "
                 f"{table.name!r} has {table.n_slots}"
             )
-        step = self._steps.get(table.name, 0) + 1
+        step = self._steps.get(table.name, self._default_step) + 1
         self._steps[table.name] = step
         k = _keys(keys)
         g = np.ascontiguousarray(grads, dtype=np.float32).reshape(
@@ -328,8 +334,12 @@ class SparseOptimizer:
     def load_state_dict(self, sd: Dict) -> None:
         if "steps" in sd:
             self._steps = {k: int(v) for k, v in sd["steps"].items()}
-        elif "step" in sd:  # legacy single-counter checkpoints
+        elif "step" in sd:
+            # legacy single-counter checkpoints: seed every table not yet
+            # seen with the old count so restored Adam moments keep their
+            # mature bias correction instead of resetting to t=1
             self._steps = {}
+            self._default_step = int(sd["step"])
 
 
 @dataclass

@@ -1,0 +1,206 @@
+"""muP — Maximal Update Parametrization (Tensor Programs V).
+
+Reference surface being matched: atorch/atorch/mup/ (shape.py
+get_shapes/zip_infshapes/set_base_shapes, init.py width-adjusted
+initializers, optim.py MuAdam/MuSGD). The reference mutates torch modules
+in place; the TPU-native shape is functional — infshapes are a pytree
+computed from (base_params, params), inits are rescaled pure pytrees, and
+the optimizers are optax transforms with per-leaf lr multipliers, which
+jit/pjit compile away entirely.
+
+Recipe (hidden = both dims grow with width, input = only fan_out grows,
+output = only fan_in grows, vector = ≤1 dim):
+
+               init std mult          Adam lr mult     SGD lr mult
+  hidden       1/sqrt(fan_in_mult)    1/fan_in_mult    1
+  input/vector 1                      1                fan_out_mult
+  output       1/fan_in_mult          1/fan_in_mult    1/fan_in_mult
+
+plus model-side rules (see models/decoder.py): attention scale 1/d_head
+instead of 1/sqrt(d_head), and logits multiplied by 1/width_mult when
+embeddings are tied (MuReadout).
+"""
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class InfShape(NamedTuple):
+    """Per-leaf shape annotated with its base (small-model) shape."""
+
+    shape: Tuple[int, ...]
+    base_shape: Tuple[int, ...]
+
+    @property
+    def inf_dims(self) -> Tuple[bool, ...]:
+        return tuple(d != b for d, b in zip(self.shape, self.base_shape))
+
+    @property
+    def ninf(self) -> int:
+        return sum(self.inf_dims)
+
+    @property
+    def kind(self) -> str:
+        """hidden | input | output | vector (the muP weight classes).
+
+        Matrix structure is read off the LAST two dims ([..., fan_in,
+        fan_out] in the JAX kernel convention); leading dims (layer
+        stacks, expert stacks) are batch dims and ignored.
+        """
+        if len(self.shape) < 2 or self.ninf == 0:
+            return "vector"
+        in_inf, out_inf = self.inf_dims[-2], self.inf_dims[-1]
+        if in_inf and out_inf:
+            return "hidden"
+        if in_inf:
+            return "output"
+        if out_inf:
+            return "input"
+        return "vector"
+
+    @property
+    def fan_in_mult(self) -> float:
+        if len(self.shape) < 2 or not self.inf_dims[-2]:
+            return 1.0
+        base = max(self.base_shape[-2], 1)
+        return self.shape[-2] / base
+
+
+def get_shapes(params) -> Any:
+    """Pytree of shapes, savable as the base-shape spec (mup shape.py:20)."""
+    return jax.tree.map(lambda p: tuple(jnp.shape(p)), params)
+
+
+def zip_infshapes(base_shapes, params) -> Any:
+    """Pair each leaf's shape with its base shape (mup shape.py:115).
+
+    ``base_shapes`` is either a params pytree of the base-width model or
+    the output of :func:`get_shapes` on it.
+    """
+
+    def make(b, p):
+        bs = b if isinstance(b, tuple) else tuple(jnp.shape(b))
+        ps = tuple(jnp.shape(p))
+        if len(bs) != len(ps):
+            raise ValueError(f"rank mismatch: base {bs} vs target {ps}")
+        return InfShape(ps, bs)
+
+    return jax.tree.map(make, base_shapes, params,
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+
+def rescale_init(params, infshapes, *, readout_zero_init: bool = False):
+    """Rescale a standard (1/sqrt(fan_in)-style) init to muP.
+
+    A width-naive standard init already gives hidden matrices the right
+    1/sqrt(fan_in) scaling, so hidden/input/vector leaves pass through;
+    output-class leaves get the extra 1/sqrt(fan_in_mult) (taking their
+    effective std from 1/sqrt(fan_in) to 1/fan_in at large width), or
+    zeros when ``readout_zero_init`` (the paper's recommended readout).
+    """
+
+    def scale(p, s: InfShape):
+        if s.kind != "output":
+            return p
+        if readout_zero_init:
+            return jnp.zeros_like(p)
+        return p / jnp.sqrt(jnp.asarray(s.fan_in_mult, p.dtype))
+
+    return jax.tree.map(scale, params, infshapes,
+                        is_leaf=lambda x: isinstance(x, InfShape))
+
+
+def _lr_mults(infshapes, rule: str):
+    def mult(s: InfShape) -> float:
+        if rule == "adam":
+            if s.kind in ("hidden", "output"):
+                return 1.0 / s.fan_in_mult
+            return 1.0
+        # sgd
+        if s.kind == "output":
+            return 1.0 / s.fan_in_mult
+        if s.kind in ("input", "vector"):
+            # fan_out mult: the growth ratio of the last infinite dim
+            for d, b, inf in zip(reversed(s.shape), reversed(s.base_shape),
+                                 reversed(s.inf_dims)):
+                if inf:
+                    return d / max(b, 1)
+            return 1.0
+        return 1.0
+
+    return jax.tree.map(mult, infshapes,
+                        is_leaf=lambda x: isinstance(x, InfShape))
+
+
+def scale_by_infshape(infshapes, rule: str = "adam"):
+    """Optax transform applying per-leaf muP lr multipliers."""
+    mults = _lr_mults(infshapes, rule)
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        return (
+            jax.tree.map(lambda u, m: u * m, updates, mults),
+            state,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def mu_adam(
+    learning_rate,
+    infshapes,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """MuAdam (mup optim.py): Adam with muP per-leaf lr scaling.
+
+    Hyperparameters tuned at the base width transfer unchanged to any
+    target width.
+    """
+    txs = [
+        optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+        scale_by_infshape(infshapes, "adam"),
+    ]
+    if weight_decay:
+        # decoupled wd AFTER the infshape scaling: muP wd is
+        # width-independent for Adam, so it must not be divided by
+        # fan_in_mult along with the Adam update
+        txs.append(optax.add_decayed_weights(weight_decay))
+    txs.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*txs)
+
+
+def mu_sgd(
+    learning_rate,
+    infshapes,
+    momentum: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """MuSGD (mup optim.py): SGD with muP per-leaf lr scaling."""
+    txs = []
+    if momentum:
+        txs.append(optax.trace(decay=momentum))
+    txs.append(scale_by_infshape(infshapes, "sgd"))
+    txs.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*txs)
+
+
+def coord_check_stats(activations) -> Dict[str, float]:
+    """Mean |activation| per leaf — the muP 'coordinate check' metric.
+
+    Run at several widths: under muP these stay O(1) in width; under
+    standard parametrization they grow/shrink with width.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(activations)
+    return {
+        jax.tree_util.keystr(path): float(jnp.abs(leaf).mean())
+        for path, leaf in flat
+    }

@@ -166,6 +166,30 @@ class TestKvTable:
         assert len(other2) == 2  # keys 2 and 9
         other2.close()
 
+    def test_delta_survives_restart_cycle(self, table, tmp_path):
+        """Restored delta rows must stay dirty: after a crash+restore,
+        the next cumulative delta still carries them."""
+        table.insert([1], np.ones((1, 4)))
+        full = str(tmp_path / "full.npz")
+        table.save(full)
+        table.insert([2], np.full((1, 4), 2.0))
+        delta = str(tmp_path / "delta.npz")
+        table.save(delta, delta_only=True)
+        # "restart": fresh table restores full + delta
+        t2 = KvTable("restart", 4, n_slots=2, initializer="zeros")
+        t2.restore(full)
+        t2.restore(delta, clear_table=False)
+        # train on, touching only key 3; overwrite the delta file
+        t2.insert([3], np.full((1, 4), 3.0))
+        t2.save(delta, delta_only=True)
+        # second restart: key 2 must still be recoverable from full+delta
+        t3 = KvTable("restart2", 4, n_slots=2, initializer="zeros")
+        t3.restore(full)
+        t3.restore(delta, clear_table=False)
+        np.testing.assert_allclose(t3.gather_or_zeros([2])[0], 2.0)
+        np.testing.assert_allclose(t3.gather_or_zeros([3])[0], 3.0)
+        t2.close(); t3.close()
+
     def test_gather_or_insert_rows_reach_delta(self, table, tmp_path):
         """Rows created by gather_or_insert (the train-path insert) must
         be dirty, else delta checkpoints silently drop new features."""
