@@ -2,3 +2,7 @@ from dlrover_tpu.checkpoint.checkpointer import (  # noqa: F401
     Checkpointer,
     StorageType,
 )
+from dlrover_tpu.checkpoint.replica import (  # noqa: F401
+    ReplicaConfig,
+    ReplicaManager,
+)

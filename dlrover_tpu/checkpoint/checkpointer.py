@@ -31,10 +31,26 @@ class Checkpointer:
         ckpt_dir: str,
         master_client=None,
         use_agent: Optional[bool] = None,
+        replicate: bool = False,
+        replica_config=None,
     ):
         self.ckpt_dir = ckpt_dir
+        replica = None
+        if replicate and jax.process_count() > 1:
+            from dlrover_tpu.checkpoint.replica import ReplicaManager
+
+            # peers resolve through the master KV store at first backup
+            replica = ReplicaManager(
+                jax.process_index(),
+                jax.process_count(),
+                master_client=master_client,
+                config=replica_config,
+            )
         self.engine = CheckpointEngine(
-            ckpt_dir, master_client=master_client, use_agent=use_agent
+            ckpt_dir,
+            master_client=master_client,
+            use_agent=use_agent,
+            replica=replica,
         )
 
     def save_checkpoint(

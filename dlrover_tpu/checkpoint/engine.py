@@ -45,10 +45,12 @@ class CheckpointEngine:
         master_client=None,
         use_agent: Optional[bool] = None,
         storage=None,
+        replica=None,
     ):
         self.ckpt_dir = ckpt_dir
         self._client = master_client
         self._storage = storage or PosixStorage()
+        self._replica = replica  # Optional[replica.ReplicaManager]
         self._shm = None
         self._local_step = -1
         if use_agent is None:
@@ -103,6 +105,10 @@ class CheckpointEngine:
             self._local_step = step
         finally:
             self._release()
+        if self._replica is not None:
+            # stream the fresh pack to ring peers off the critical path
+            # (reference: replica.py backup hooked at engine.py:328)
+            self._replica.backup_async(meta, shm_lock=self._lock)
         if self._client is not None:
             try:
                 self._client.report_ckpt_step(step)
@@ -174,6 +180,9 @@ class CheckpointEngine:
         state = self._load_from_memory(target, shardings, step)
         if state is not None:
             return state
+        state = self._load_from_replica(target, shardings, step)
+        if state is not None:
+            return state
         return self.load_from_storage(target, shardings, step)
 
     def _load_from_memory(self, target, shardings, step):
@@ -203,6 +212,34 @@ class CheckpointEngine:
             return None
         except Exception:  # noqa: BLE001
             logger.warning("memory restore failed", exc_info=True)
+            return None
+
+    def _load_from_replica(self, target, shardings, step):
+        """Local shm lost (host replaced): pull our pack from a ring peer.
+
+        Reference: engine.py:349 _restore_memory_from_replica.
+        """
+        if self._replica is None:
+            return None
+        try:
+            if step is None and self._client is not None:
+                # pin to the cluster-consistent step: a peer may hold a step
+                # the other ranks skipped ("saver busy"), and restoring it
+                # would silently diverge this rank from the rest
+                min_step = self._client.get_min_ckpt_step()
+                if min_step > 0:
+                    step = min_step
+            hit = self._replica.fetch(step=step)
+            if hit is None:
+                return None
+            got_step, pack = hit
+            idx = core.PackIndex()
+            idx.add_pack(memoryview(pack))
+            state = core.restore_tree(target, idx, shardings)
+            logger.info("restored step %d from peer replica", got_step)
+            return state
+        except Exception:  # noqa: BLE001
+            logger.warning("replica restore failed", exc_info=True)
             return None
 
     def load_from_storage(self, target, shardings=None, step=None):

@@ -1,0 +1,449 @@
+"""Cross-node in-memory checkpoint redundancy.
+
+Reference: dlrover/python/trainer/torch/flash_checkpoint replica.py
+(CkptReplicaManger:28, ShardCkptReplicaManager:73, FullCkptReplicaManager:245)
+— each node backs up its staged in-memory checkpoint shard to a peer node, so
+that when a node dies and its shared memory is lost, the relaunched
+replacement restores the shard from the peer's RAM instead of falling back to
+(slow) persistent storage.
+
+TPU-native design: checkpoint staging is a *host-side* concern (the pack
+bytes already live in host shared memory, see core.py), so replication is
+plain host networking — a small TCP service in each agent holding the latest
+pack per source rank, and a ring backup scheme (rank i backs up to
+(i+1) mod n, fetches from any peer that has its rank). No device collectives
+are spent on redundancy, unlike the reference's process-group broadcast
+(replica.py:118) which burns NCCL bandwidth mid-training.
+
+Peer discovery rides the master KV store (MasterClient.kv_store_set/get),
+the same channel the reference uses to bootstrap process groups.
+"""
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import attach_shared_memory
+
+logger = get_logger(__name__)
+
+_LEN_BYTES = 8
+_CHUNK = 16 << 20
+_KV_PREFIX = "ckpt_replica_addr_"
+
+
+def _default_advertise_host() -> str:
+    """Best-effort routable address for this host.
+
+    ``gethostbyname(gethostname())`` resolves to 127.0.1.1 on stock
+    Debian/Ubuntu (or raises), which would make every rank advertise
+    loopback and silently void cross-node replication — so prefer the
+    kernel's outbound-route source address.
+    """
+    env = os.environ.get("DLROVER_TPU_REPLICA_HOST")
+    if env:
+        return env
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # no packets sent
+            return s.getsockname()[0]
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _send_frame(sock: socket.socket, header: Dict, payload=None):
+    raw = json.dumps(header).encode("utf-8")
+    sock.sendall(len(raw).to_bytes(_LEN_BYTES, "little"))
+    sock.sendall(raw)
+    if payload is not None:
+        mv = memoryview(payload)
+        for lo in range(0, len(mv), _CHUNK):
+            sock.sendall(mv[lo : lo + _CHUNK])
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(n - got, _CHUNK))
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[Dict, Optional[bytearray]]:
+    n = int.from_bytes(_recv_exact(sock, _LEN_BYTES), "little")
+    header = json.loads(bytes(_recv_exact(sock, n)))
+    payload = None
+    size = header.get("size", 0)
+    if size:
+        payload = _recv_exact(sock, size)
+    return header, payload
+
+
+class _ReplicaStore:
+    """Latest pack per source rank, with a byte budget."""
+
+    def __init__(self, max_bytes: int):
+        self._lock = threading.Lock()
+        self._packs: Dict[int, Tuple[int, bytes]] = {}  # src -> (step, pack)
+        self._max_bytes = max_bytes
+
+    def put(self, src: int, step: int, pack: bytes) -> bool:
+        with self._lock:
+            cur = self._packs.get(src)
+            if cur and cur[0] >= step:
+                return True  # stale resend
+            other = sum(
+                len(p) for s, (_, p) in self._packs.items() if s != src
+            )
+            if other + len(pack) > self._max_bytes:
+                logger.warning(
+                    "replica store over budget (%d + %d > %d), dropping "
+                    "backup from rank %d",
+                    other,
+                    len(pack),
+                    self._max_bytes,
+                    src,
+                )
+                return False
+            self._packs[src] = (step, pack)
+            return True
+
+    def get(self, src: int) -> Optional[Tuple[int, bytes]]:
+        with self._lock:
+            return self._packs.get(src)
+
+    def steps(self) -> Dict[int, int]:
+        with self._lock:
+            return {s: step for s, (step, _) in self._packs.items()}
+
+    def drop(self, src: int):
+        with self._lock:
+            self._packs.pop(src, None)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        store: _ReplicaStore = self.server.store  # type: ignore[attr-defined]
+        try:
+            header, payload = _recv_frame(self.request)
+        except (ConnectionError, json.JSONDecodeError, OSError):
+            return
+        op = header.get("op")
+        if op == "put":
+            ok = store.put(
+                int(header["src"]), int(header["step"]), bytes(payload or b"")
+            )
+            _send_frame(self.request, {"ok": ok})
+        elif op == "get":
+            hit = store.get(int(header["src"]))
+            if hit is None:
+                _send_frame(self.request, {"ok": False, "size": 0})
+            else:
+                step, pack = hit
+                _send_frame(
+                    self.request,
+                    {"ok": True, "step": step, "size": len(pack)},
+                    pack,
+                )
+        elif op == "steps":
+            # JSON coerces int keys to strings; receiver decodes back
+            _send_frame(self.request, {"ok": True, "steps": store.steps()})
+        else:
+            _send_frame(self.request, {"ok": False, "error": "bad op"})
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+@dataclass
+class ReplicaConfig:
+    """num_replicas: how many ring successors receive a copy (0 disables)."""
+
+    num_replicas: int = 1
+    bind_host: str = "0.0.0.0"
+    advertise_host: str = field(default_factory=_default_advertise_host)
+    port: int = 0  # 0 → ephemeral
+    max_store_bytes: int = 8 << 30
+    timeout: float = 60.0
+
+
+class ReplicaManager:
+    """Ring backup of staged checkpoint packs across hosts.
+
+    ``peers`` maps node rank → "host:port" and may be given directly (tests,
+    static clusters) or resolved lazily through the master KV store.
+    """
+
+    def __init__(
+        self,
+        process_index: int,
+        process_count: int,
+        peers: Optional[Dict[int, str]] = None,
+        master_client=None,
+        config: Optional[ReplicaConfig] = None,
+    ):
+        self.process_index = process_index
+        self.process_count = process_count
+        self.config = config or ReplicaConfig()
+        self._peers = dict(peers or {})
+        self._client = master_client
+        self._store = _ReplicaStore(self.config.max_store_bytes)
+        self._server = _Server(
+            (self.config.bind_host, self.config.port), _Handler
+        )
+        self._server.store = self._store  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="ckpt-replica",
+            daemon=True,
+        )
+        self._thread.start()
+        self._backup_thread: Optional[threading.Thread] = None
+        self.register()
+
+    # ---- discovery -------------------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        port = self._server.server_address[1]
+        return f"{self.config.advertise_host}:{port}"
+
+    def register(self):
+        if self._client is None:
+            return
+        try:
+            self._client.kv_store_set(
+                f"{_KV_PREFIX}{self.process_index}", self.addr
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning("replica addr registration failed", exc_info=True)
+
+    def _resolve(self, rank: int) -> Optional[str]:
+        if rank in self._peers:
+            return self._peers[rank]
+        if self._client is None:
+            return None
+        try:
+            addr = self._client.kv_store_get(f"{_KV_PREFIX}{rank}")
+        except Exception:  # noqa: BLE001
+            return None
+        if addr:
+            self._peers[rank] = addr
+            return addr
+        return None
+
+    def _backup_targets(self):
+        n = self.process_count
+        r = min(self.config.num_replicas, n - 1)
+        return [(self.process_index + i) % n for i in range(1, r + 1)]
+
+    # ---- backup (sender side) --------------------------------------------
+
+    def backup(self, meta: Dict, shm_lock=None) -> int:
+        """Send this host's staged pack to its ring successors.
+
+        ``meta`` is the engine's staging record ({shm, used, step}). One
+        host copy of the pack is made under ``shm_lock`` (the engine's
+        staging lock) so the slow network sends happen lock-free; the pack
+        header's step is re-checked under the lock, so if the worker
+        restaged a newer step before we got the lock, this (stale) backup
+        aborts and the newer step's own backup supersedes it. Returns the
+        number of peers updated.
+        """
+        from dlrover_tpu.checkpoint import core
+
+        targets = self._backup_targets()
+        if not targets:
+            return 0
+        if shm_lock is not None and not shm_lock.acquire(blocking=True):
+            return 0
+        try:
+            shm = attach_shared_memory(meta["shm"])
+            try:
+                view = memoryview(shm.buf)
+                staged_step = core.read_header(view).get("step")
+                if staged_step != meta["step"]:
+                    logger.info(
+                        "skipping replica backup of step %s: shm now holds "
+                        "step %s",
+                        meta["step"],
+                        staged_step,
+                    )
+                    return 0
+                pack = bytes(view[: meta["used"]])
+            finally:
+                del view
+                shm.close()
+        except FileNotFoundError:
+            return 0
+        finally:
+            if shm_lock is not None:
+                shm_lock.release()
+        sent = 0
+        for rank in targets:
+            addr = self._resolve(rank)
+            if addr is None:
+                logger.warning("no replica addr for rank %d", rank)
+                continue
+            if self._put(addr, meta["step"], pack):
+                sent += 1
+        return sent
+
+    def backup_async(self, meta: Dict, shm_lock=None):
+        """Schedule a backup without ever blocking the caller.
+
+        If the previous send is still in flight (slow or dead peer), this
+        step's backup is skipped — the next checkpoint retries, and the
+        stale-step guard in backup() keeps skipped steps from being
+        mislabeled. Joining here would put a hung peer's 60s socket
+        timeout on the training critical path.
+        """
+        if self._backup_thread and self._backup_thread.is_alive():
+            logger.warning(
+                "replica backup of step %s skipped: previous backup still "
+                "in flight",
+                meta.get("step"),
+            )
+            return
+        self._backup_thread = threading.Thread(
+            target=self._safe_backup, args=(meta, shm_lock), daemon=True
+        )
+        self._backup_thread.start()
+
+    def _safe_backup(self, meta, shm_lock):
+        try:
+            self.backup(meta, shm_lock)
+        except Exception:  # noqa: BLE001
+            logger.warning("checkpoint replica backup failed", exc_info=True)
+
+    def wait_backup(self, timeout: float = 120.0):
+        if self._backup_thread:
+            self._backup_thread.join(timeout)
+
+    def _put(self, addr: str, step: int, pack: bytes) -> bool:
+        try:
+            with self._connect(addr) as sock:
+                _send_frame(
+                    sock,
+                    {
+                        "op": "put",
+                        "src": self.process_index,
+                        "step": step,
+                        "size": len(pack),
+                    },
+                    pack,
+                )
+                resp, _ = _recv_frame(sock)
+                return bool(resp.get("ok"))
+        except OSError:
+            logger.warning("replica backup to %s failed", addr, exc_info=True)
+            return False
+
+    # ---- restore (fetch side) --------------------------------------------
+
+    def fetch(
+        self, src: Optional[int] = None, step: Optional[int] = None
+    ) -> Optional[Tuple[int, bytes]]:
+        """Recover rank ``src``'s pack from whichever ring peer holds it.
+
+        The holders of rank i's pack are its ring successors, so a replaced
+        host asks the nodes that rank i backed up onto. Returns
+        (step, pack bytes) or None.
+        """
+        src = self.process_index if src is None else src
+        n = self.process_count
+        r = min(self.config.num_replicas, n - 1)
+        holders = [(src + i) % n for i in range(1, r + 1)]
+        for rank in holders:
+            if rank == self.process_index:
+                hit = self._store.get(src)
+            else:
+                addr = self._resolve(rank)
+                if addr is None:
+                    continue
+                hit = self._get(addr, src)
+            if hit is None:
+                continue
+            got_step, pack = hit
+            if step is not None and got_step != step:
+                continue
+            logger.info(
+                "recovered rank %d step %d pack (%.1f MB) from peer rank %d",
+                src,
+                got_step,
+                len(pack) / 1e6,
+                rank,
+            )
+            return got_step, pack
+        return None
+
+    def peer_steps(self, rank: int) -> Dict[int, int]:
+        """{src: step} held by ``rank``'s store (diagnosis/monitoring)."""
+        addr = self._resolve(rank)
+        if addr is None:
+            return {}
+        try:
+            with self._connect(addr) as sock:
+                _send_frame(sock, {"op": "steps"})
+                resp, _ = _recv_frame(sock)
+                return {int(k): int(v) for k, v in resp.get("steps", {}).items()}
+        except OSError:
+            return {}
+
+    def _get(self, addr: str, src: int) -> Optional[Tuple[int, bytes]]:
+        try:
+            with self._connect(addr) as sock:
+                _send_frame(sock, {"op": "get", "src": src})
+                resp, payload = _recv_frame(sock)
+                if not resp.get("ok"):
+                    return None
+                return int(resp["step"]), bytes(payload or b"")
+        except OSError:
+            return None
+
+    def _connect(self, addr: str) -> socket.socket:
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection(
+            (host, int(port)), timeout=self.config.timeout
+        )
+        return sock
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def local_steps(self) -> Dict[int, int]:
+        """Steps of packs this node holds for others (for tests/diagnosis)."""
+        return self._store.steps()
+
+    def close(self):
+        self.wait_backup(timeout=5.0)
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def wait_peer_steps(
+    manager: ReplicaManager, want: Dict[int, int], timeout: float = 30.0
+) -> bool:
+    """Block until this node's store holds at least ``want`` {src: step}."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        have = manager.local_steps()
+        if all(have.get(s, -1) >= st for s, st in want.items()):
+            return True
+        time.sleep(0.02)
+    return False

@@ -329,11 +329,15 @@ class SparseOptimizer:
         ))
 
     def state_dict(self) -> Dict:
-        return {"steps": dict(self._steps)}
+        # _default_step must survive the round-trip: a table restored from a
+        # legacy checkpoint that takes no step before the next save would
+        # otherwise reset its Adam bias correction to t=1
+        return {"steps": dict(self._steps), "default_step": self._default_step}
 
     def load_state_dict(self, sd: Dict) -> None:
         if "steps" in sd:
             self._steps = {k: int(v) for k, v in sd["steps"].items()}
+            self._default_step = int(sd.get("default_step", 0))
         elif "step" in sd:
             # legacy single-counter checkpoints: seed every table not yet
             # seen with the old count so restored Adam moments keep their

@@ -1,0 +1,152 @@
+"""Cross-node in-memory checkpoint replica tests.
+
+Reference behavior: replica.py ShardCkptReplicaManager — back up staged
+shards to a peer; a replaced node restores from the peer's RAM
+(engine.py:349 _restore_memory_from_replica).
+"""
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.checkpointer import state_template
+from dlrover_tpu.checkpoint.replica import (
+    ReplicaConfig,
+    ReplicaManager,
+    wait_peer_steps,
+)
+
+
+@pytest.fixture(autouse=True)
+def _run_id(monkeypatch):
+    monkeypatch.setenv(
+        "DLROVER_TPU_RUN_ID", f"rep{os.getpid()}_{time.time_ns()}"
+    )
+
+
+def _mk_manager(rank, count, peers=None, num_replicas=1):
+    cfg = ReplicaConfig(
+        num_replicas=num_replicas,
+        bind_host="127.0.0.1",
+        advertise_host="127.0.0.1",
+    )
+    return ReplicaManager(rank, count, peers=peers or {}, config=cfg)
+
+
+def _state():
+    return {
+        "w": jnp.arange(32, dtype=jnp.float32).reshape(4, 8),
+        "step": jnp.asarray(5),
+    }
+
+
+def test_backup_and_peer_fetch(monkeypatch):
+    m1 = _mk_manager(1, 2)
+    m0 = _mk_manager(0, 2, peers={1: m1.addr})
+    try:
+        engine = CheckpointEngine("/tmp/unused", use_agent=False, replica=m0)
+        state = _state()
+        assert engine.save_to_memory(11, state)
+        m0.wait_backup()
+        assert wait_peer_steps(m1, {0: 11}, timeout=10)
+
+        # "host 0 dies": a replacement with fresh shm restores from peer 1
+        monkeypatch.setenv("DLROVER_TPU_RUN_ID", f"new{time.time_ns()}")
+        m0b = _mk_manager(0, 2, peers={1: m1.addr})
+        try:
+            engine2 = CheckpointEngine(
+                "/tmp/unused", use_agent=False, replica=m0b
+            )
+            out = engine2.load(state_template(state))
+            assert out is not None
+            np.testing.assert_array_equal(
+                np.asarray(out["w"]), np.asarray(state["w"])
+            )
+            assert int(out["step"]) == 5
+        finally:
+            m0b.close()
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_newer_step_replaces_stale(monkeypatch):
+    m1 = _mk_manager(1, 2)
+    m0 = _mk_manager(0, 2, peers={1: m1.addr})
+    try:
+        engine = CheckpointEngine("/tmp/unused", use_agent=False, replica=m0)
+        engine.save_to_memory(1, _state())
+        m0.wait_backup()
+        state2 = {"w": jnp.ones((4, 8)), "step": jnp.asarray(9)}
+        engine.save_to_memory(2, state2)
+        m0.wait_backup()
+        assert wait_peer_steps(m1, {0: 2}, timeout=10)
+        got_step, _ = m1._store.get(0)
+        assert got_step == 2
+        # stale re-put is a no-op
+        assert m1._store.put(0, 1, b"old")
+        assert m1._store.get(0)[0] == 2
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_multi_replica_ring():
+    m1 = _mk_manager(1, 3, num_replicas=2)
+    m2 = _mk_manager(2, 3, num_replicas=2)
+    m0 = _mk_manager(
+        0, 3, peers={1: m1.addr, 2: m2.addr}, num_replicas=2
+    )
+    try:
+        engine = CheckpointEngine("/tmp/unused", use_agent=False, replica=m0)
+        assert engine.save_to_memory(7, _state())
+        m0.wait_backup()
+        assert wait_peer_steps(m1, {0: 7}, timeout=10)
+        assert wait_peer_steps(m2, {0: 7}, timeout=10)
+        # even if holder 1 vanished, holder 2 serves the pack
+        m0c = _mk_manager(0, 3, peers={2: m2.addr}, num_replicas=2)
+        try:
+            hit = m0c.fetch()
+            assert hit is not None and hit[0] == 7
+        finally:
+            m0c.close()
+    finally:
+        m0.close()
+        m1.close()
+        m2.close()
+
+
+def test_store_budget_rejects_oversize():
+    cfg = ReplicaConfig(
+        bind_host="127.0.0.1",
+        advertise_host="127.0.0.1",
+        max_store_bytes=64,
+    )
+    holder = ReplicaManager(1, 2, config=cfg)
+    sender = _mk_manager(0, 2, peers={1: holder.addr})
+    try:
+        assert holder._store.put(0, 1, b"x" * 32)
+        # second source pushing 64B would exceed the 64B budget
+        assert not holder._store.put(5, 1, b"y" * 64)
+    finally:
+        sender.close()
+        holder.close()
+
+
+def test_fetch_wrong_step_returns_none():
+    m1 = _mk_manager(1, 2)
+    m0 = _mk_manager(0, 2, peers={1: m1.addr})
+    try:
+        engine = CheckpointEngine("/tmp/unused", use_agent=False, replica=m0)
+        engine.save_to_memory(3, _state())
+        m0.wait_backup()
+        assert wait_peer_steps(m1, {0: 3}, timeout=10)
+        assert m0.fetch(step=99) is None
+        assert m0.fetch(step=3) is not None
+    finally:
+        m0.close()
+        m1.close()
