@@ -7,3 +7,10 @@ from dlrover_tpu.accelerate.strategy import (  # noqa: F401
     Strategy,
     OPTIMIZATION_LIBRARY,
 )
+from dlrover_tpu.accelerate.hpsearch import (  # noqa: F401
+    BayesianOptimizer,
+    Choice,
+    Float,
+    Int,
+    SearchSpace,
+)

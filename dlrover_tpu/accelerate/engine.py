@@ -132,12 +132,84 @@ def _heuristic_score(
     return score
 
 
+def _bo_search(
+    cfg: ModelConfig,
+    feasible: List[Tuple[float, Strategy, AccelerationPlan]],
+    n_devices: int,
+    global_batch: int,
+    seq: int,
+    budget: int,
+    devices,
+) -> Optional[Tuple[float, Strategy, AccelerationPlan]]:
+    """Bayesian-opt over the feasible set, measured by dry runs.
+
+    Reference: ATorch's HEBO BO over dryrun throughput
+    (auto/engine/sg_algo/bayes_opt_sg.py). The BO space is the strategy's
+    knobs (log2 of each mesh axis + remat); each suggestion is projected
+    onto the nearest feasible candidate, so the surrogate learns over a
+    smooth space while only real plans get measured.
+    """
+    import math
+
+    import numpy as np
+
+    from dlrover_tpu.accelerate.hpsearch import (
+        BayesianOptimizer,
+        Choice,
+        Int,
+        SearchSpace,
+    )
+
+    def knobs(plan: AccelerationPlan) -> dict:
+        sizes = plan.mesh.resolved_sizes(n_devices)
+        return {
+            "log2_tp": int(math.log2(sizes["tp"])),
+            "log2_sp": int(math.log2(sizes["sp"])),
+            "log2_pp": int(math.log2(sizes["pp"])),
+            "log2_fsdp": int(math.log2(sizes["fsdp"])),
+            "remat": plan.remat,
+        }
+
+    max_log2 = max(1, int(math.log2(n_devices)))
+    space = SearchSpace(
+        {
+            "log2_tp": Int(0, max_log2),
+            "log2_sp": Int(0, max_log2),
+            "log2_pp": Int(0, max_log2),
+            "log2_fsdp": Int(0, max_log2),
+            "remat": Choice(["none", "full"]),
+        }
+    )
+    encoded = [space.encode(knobs(plan)) for _, _, plan in feasible]
+    opt = BayesianOptimizer(space, n_init=max(2, budget // 3))
+    measured: dict = {}
+    best = None
+    for _ in range(budget):
+        want = space.encode(opt.suggest())
+        # project onto the nearest not-yet-measured feasible candidate
+        order = np.argsort(
+            [float(np.sum((e - want) ** 2)) for e in encoded]
+        )
+        idx = next((int(i) for i in order if int(i) not in measured), None)
+        if idx is None:
+            break  # feasible set exhausted
+        _, strat, plan = feasible[idx]
+        res = dry_run(cfg, plan, global_batch, seq, devices=devices)
+        metric = res.tokens_per_sec if res.ok else 0.0
+        measured[idx] = metric
+        opt.observe(knobs(plan), metric)
+        logger.info("BO measured %s → %.3g tokens/s", strat, metric)
+        if res.ok and (best is None or metric > best[0]):
+            best = (metric, strat, plan)
+    return best
+
+
 def search_strategy(
     cfg: ModelConfig,
     n_devices: int,
     global_batch: int,
     seq: int,
-    mode: str = "heuristic",  # heuristic | cost | measure
+    mode: str = "heuristic",  # heuristic | cost | measure | bo
     max_measured: int = 6,
     devices=None,
 ) -> Tuple[Strategy, AccelerationPlan]:
@@ -169,6 +241,15 @@ def search_strategy(
         score, strat, plan = feasible[0]
         logger.info("heuristic strategy (score %.3f): %s", score, strat)
         return strat, plan
+
+    if mode == "bo":
+        best = _bo_search(
+            cfg, feasible, n_devices, global_batch, seq, max_measured, devices
+        )
+        if best is None:
+            _, strat, plan = feasible[0]
+            return strat, plan
+        return best[1], best[2]
 
     best = None
     for score, strat, plan in feasible[:max_measured]:

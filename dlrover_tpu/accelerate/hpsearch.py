@@ -1,0 +1,230 @@
+"""Bayesian-optimization hyperparameter search.
+
+Reference: dlrover/python/brain/hpsearch/bo.py (BayesianOptimizer:30, base
+RecommendationAlgorithm hpsearch/base.py:21) and ATorch's HEBO-backed
+strategy tuning (auto/engine/sg_algo/bayes_opt_sg.py) — suggest/observe
+loops over a mixed search space, maximizing a measured objective.
+
+Self-contained numpy implementation: Gaussian-process surrogate (RBF
+kernel, median-heuristic lengthscale) + expected-improvement acquisition
+maximized over random candidates. No scipy/sklearn dependency — the whole
+fit is a Cholesky solve, which is plenty for the tens-of-observations
+regime strategy search lives in.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass(frozen=True)
+class Float:
+    lo: float
+    hi: float
+    log: bool = False
+
+
+@dataclass(frozen=True)
+class Int:
+    lo: int
+    hi: int
+    log: bool = False
+
+
+@dataclass(frozen=True)
+class Choice:
+    options: Tuple[Any, ...]
+
+    def __init__(self, options: Sequence[Any]):
+        object.__setattr__(self, "options", tuple(options))
+
+
+@dataclass
+class SearchSpace:
+    """Named mixed-type box: Float / Int / Choice per parameter."""
+
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    def dim(self) -> int:
+        return sum(
+            len(p.options) if isinstance(p, Choice) else 1
+            for p in self.params.values()
+        )
+
+    # ---- encoding: config dict ⇄ unit hypercube ------------------------
+
+    def encode(self, conf: Dict[str, Any]) -> np.ndarray:
+        xs: List[float] = []
+        for name, p in self.params.items():
+            v = conf[name]
+            if isinstance(p, Choice):
+                onehot = [0.0] * len(p.options)
+                onehot[p.options.index(v)] = 1.0
+                xs.extend(onehot)
+            elif isinstance(p, (Float, Int)):
+                lo, hi = float(p.lo), float(p.hi)
+                if p.log:
+                    lo, hi, v = math.log(lo), math.log(hi), math.log(v)
+                xs.append(0.0 if hi == lo else (float(v) - lo) / (hi - lo))
+            else:
+                raise TypeError(f"bad param {name}: {p!r}")
+        return np.asarray(xs, dtype=np.float64)
+
+    def decode(self, x: np.ndarray) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        i = 0
+        for name, p in self.params.items():
+            if isinstance(p, Choice):
+                k = len(p.options)
+                out[name] = p.options[int(np.argmax(x[i : i + k]))]
+                i += k
+                continue
+            lo, hi = float(p.lo), float(p.hi)
+            if p.log:
+                lo, hi = math.log(lo), math.log(hi)
+            v = lo + float(np.clip(x[i], 0.0, 1.0)) * (hi - lo)
+            if p.log:
+                v = math.exp(v)
+            if isinstance(p, Int):
+                out[name] = int(min(p.hi, max(p.lo, round(v))))
+            else:
+                out[name] = v
+            i += 1
+        return out
+
+    def sample(self, rng: np.random.Generator) -> Dict[str, Any]:
+        return self.decode(rng.random(self.dim()))
+
+
+def _rbf(a: np.ndarray, b: np.ndarray, ls: float) -> np.ndarray:
+    d2 = (
+        np.sum(a * a, 1)[:, None]
+        + np.sum(b * b, 1)[None, :]
+        - 2.0 * (a @ b.T)
+    )
+    return np.exp(-0.5 * np.maximum(d2, 0.0) / (ls * ls))
+
+
+class GaussianProcess:
+    """Zero-mean GP on standardized targets, RBF kernel."""
+
+    def __init__(self, noise: float = 1e-6):
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        self._x = np.atleast_2d(x)
+        y = np.asarray(y, dtype=np.float64)
+        self._mu, self._sd = float(y.mean()), float(y.std() or 1.0)
+        self._y = (y - self._mu) / self._sd
+        n = len(self._x)
+        if n > 1:
+            d2 = (
+                np.sum(self._x * self._x, 1)[:, None]
+                + np.sum(self._x * self._x, 1)[None, :]
+                - 2.0 * (self._x @ self._x.T)
+            )
+            med = np.median(np.sqrt(np.maximum(d2, 0.0))[~np.eye(n, dtype=bool)])
+            self.ls = max(float(med), 1e-3)
+        else:
+            self.ls = 1.0
+        k = _rbf(self._x, self._x, self.ls) + (
+            self.noise + 1e-8
+        ) * np.eye(n)
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, self._y)
+        )
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(x)
+        ks = _rbf(x, self._x, self.ls)
+        mean = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.maximum(1.0 - np.sum(v * v, 0), 1e-12)
+        return mean * self._sd + self._mu, np.sqrt(var) * self._sd
+
+
+def _norm_cdf(z: np.ndarray) -> np.ndarray:
+    return 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+
+
+def _norm_pdf(z: np.ndarray) -> np.ndarray:
+    return np.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+
+
+def expected_improvement(
+    mean: np.ndarray, std: np.ndarray, best: float, xi: float = 0.01
+) -> np.ndarray:
+    z = (mean - best - xi) / std
+    return (mean - best - xi) * _norm_cdf(z) + std * _norm_pdf(z)
+
+
+class BayesianOptimizer:
+    """suggest()/observe() loop maximizing a black-box objective.
+
+    First ``n_init`` suggestions are quasi-random exploration; afterwards a
+    GP surrogate is refit on every observation and suggestions maximize
+    expected improvement over ``n_candidates`` random probes (plus local
+    perturbations of the incumbent).
+    """
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        seed: int = 0,
+        n_init: int = 5,
+        n_candidates: int = 512,
+    ):
+        self.space = space
+        self.rng = np.random.default_rng(seed)
+        self.n_init = n_init
+        self.n_candidates = n_candidates
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+        self._gp = GaussianProcess()
+
+    def suggest(self) -> Dict[str, Any]:
+        if len(self._ys) < self.n_init:
+            return self.space.sample(self.rng)
+        x = np.array(self._xs)
+        self._gp.fit(x, np.array(self._ys))
+        d = self.space.dim()
+        cands = self.rng.random((self.n_candidates, d))
+        # local candidates around the incumbent sharpen exploitation
+        inc = self._xs[int(np.argmax(self._ys))]
+        local = np.clip(
+            inc[None, :]
+            + self.rng.normal(0.0, 0.1, (self.n_candidates // 4, d)),
+            0.0,
+            1.0,
+        )
+        cands = np.vstack([cands, local])
+        mean, std = self._gp.predict(cands)
+        ei = expected_improvement(mean, std, max(self._ys))
+        return self.space.decode(cands[int(np.argmax(ei))])
+
+    def observe(self, conf: Dict[str, Any], value: float):
+        self._xs.append(self.space.encode(conf))
+        self._ys.append(float(value))
+
+    @property
+    def num_observations(self) -> int:
+        return len(self._ys)
+
+    def best(self) -> Tuple[Dict[str, Any], float]:
+        if not self._ys:
+            raise RuntimeError("no observations yet")
+        i = int(np.argmax(self._ys))
+        return self.space.decode(self._xs[i]), self._ys[i]
+
+
+def minimize_to_maximize(value: float) -> float:
+    """Convenience for minimization problems: observe(-value)."""
+    return -value

@@ -37,6 +37,14 @@ class Checkpointer:
         self.ckpt_dir = ckpt_dir
         replica = None
         if replicate and jax.process_count() > 1:
+            if master_client is None:
+                # without the KV store there is no peer discovery: the
+                # manager would silently replicate nothing
+                raise ValueError(
+                    "replicate=True requires a master_client for peer "
+                    "discovery; pass one or construct the ReplicaManager "
+                    "with an explicit peers map"
+                )
             from dlrover_tpu.checkpoint.replica import ReplicaManager
 
             # peers resolve through the master KV store at first backup

@@ -83,14 +83,24 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     return buf
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[Dict, Optional[bytearray]]:
+def _recv_frame(
+    sock: socket.socket, max_bytes: Optional[int] = None
+) -> Tuple[Dict, Optional[bytearray]]:
     n = int.from_bytes(_recv_exact(sock, _LEN_BYTES), "little")
+    if n > (1 << 20):
+        raise ValueError(f"oversize frame header ({n} bytes)")
     header = json.loads(bytes(_recv_exact(sock, n)))
     payload = None
-    size = header.get("size", 0)
+    size = int(header.get("size", 0))
+    if max_bytes is not None and size > max_bytes:
+        # reject before allocating an attacker-controlled buffer
+        raise ValueError(f"oversize payload ({size} > {max_bytes})")
     if size:
         payload = _recv_exact(sock, size)
     return header, payload
+
+
+_MAX_STEP = 1 << 40
 
 
 class _ReplicaStore:
@@ -98,10 +108,11 @@ class _ReplicaStore:
 
     def __init__(self, max_bytes: int):
         self._lock = threading.Lock()
-        self._packs: Dict[int, Tuple[int, bytes]] = {}  # src -> (step, pack)
+        # src -> (step, pack); pack is any bytes-like, stored un-copied
+        self._packs: Dict[int, Tuple[int, bytes]] = {}
         self._max_bytes = max_bytes
 
-    def put(self, src: int, step: int, pack: bytes) -> bool:
+    def put(self, src: int, step: int, pack) -> bool:
         with self._lock:
             cur = self._packs.get(src)
             if cur and cur[0] >= step:
@@ -138,15 +149,24 @@ class _ReplicaStore:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         store: _ReplicaStore = self.server.store  # type: ignore[attr-defined]
+        token = self.server.token  # type: ignore[attr-defined]
+        max_bytes = self.server.max_frame_bytes  # type: ignore[attr-defined]
         try:
-            header, payload = _recv_frame(self.request)
-        except (ConnectionError, json.JSONDecodeError, OSError):
+            header, payload = _recv_frame(self.request, max_bytes)
+        except (ConnectionError, json.JSONDecodeError, OSError, ValueError):
+            return
+        if token and header.get("token") != token:
+            _send_frame(self.request, {"ok": False, "error": "bad token"})
             return
         op = header.get("op")
         if op == "put":
-            ok = store.put(
-                int(header["src"]), int(header["step"]), bytes(payload or b"")
-            )
+            step = int(header["step"])
+            if not (0 <= step < _MAX_STEP):
+                _send_frame(self.request, {"ok": False, "error": "bad step"})
+                return
+            # payload (a bytearray) is stored as-is; a bytes() copy here
+            # would transiently double host RAM for multi-GB packs
+            ok = store.put(int(header["src"]), step, payload or bytearray())
             _send_frame(self.request, {"ok": ok})
         elif op == "get":
             hit = store.get(int(header["src"]))
@@ -171,6 +191,12 @@ class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+def _default_token() -> str:
+    # every host of a run shares RUN_ID, so it doubles as a wire token
+    # keeping strays (other runs, port scanners) out of the store
+    return os.environ.get("DLROVER_TPU_RUN_ID", "")
+
+
 @dataclass
 class ReplicaConfig:
     """num_replicas: how many ring successors receive a copy (0 disables)."""
@@ -181,6 +207,7 @@ class ReplicaConfig:
     port: int = 0  # 0 → ephemeral
     max_store_bytes: int = 8 << 30
     timeout: float = 60.0
+    token: str = field(default_factory=_default_token)
 
 
 class ReplicaManager:
@@ -208,6 +235,10 @@ class ReplicaManager:
             (self.config.bind_host, self.config.port), _Handler
         )
         self._server.store = self._store  # type: ignore[attr-defined]
+        self._server.token = self.config.token  # type: ignore[attr-defined]
+        self._server.max_frame_bytes = (  # type: ignore[attr-defined]
+            self.config.max_store_bytes
+        )
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             name="ckpt-replica",
@@ -271,6 +302,9 @@ class ReplicaManager:
         targets = self._backup_targets()
         if not targets:
             return 0
+        # re-register each backup: one cheap KV set, and it heals a missed
+        # registration (master briefly unreachable during our own relaunch)
+        self.register()
         if shm_lock is not None and not shm_lock.acquire(blocking=True):
             return 0
         try:
@@ -346,6 +380,7 @@ class ReplicaManager:
                         "src": self.process_index,
                         "step": step,
                         "size": len(pack),
+                        "token": self.config.token,
                     },
                     pack,
                 )
@@ -353,7 +388,22 @@ class ReplicaManager:
                 return bool(resp.get("ok"))
         except OSError:
             logger.warning("replica backup to %s failed", addr, exc_info=True)
+            self._forget(addr)
             return False
+
+    def _forget(self, addr: str):
+        """Drop a dead peer address so the next call re-resolves it.
+
+        A relaunched peer binds a fresh ephemeral port and re-registers in
+        the master KV store; without invalidation we would dial the stale
+        addr forever. Static peer maps (no KV client) are kept — there is
+        nothing to re-resolve from.
+        """
+        if self._client is None:
+            return
+        for rank, a in list(self._peers.items()):
+            if a == addr:
+                del self._peers[rank]
 
     # ---- restore (fetch side) --------------------------------------------
 
@@ -400,7 +450,9 @@ class ReplicaManager:
             return {}
         try:
             with self._connect(addr) as sock:
-                _send_frame(sock, {"op": "steps"})
+                _send_frame(
+                    sock, {"op": "steps", "token": self.config.token}
+                )
                 resp, _ = _recv_frame(sock)
                 return {int(k): int(v) for k, v in resp.get("steps", {}).items()}
         except OSError:
@@ -409,12 +461,16 @@ class ReplicaManager:
     def _get(self, addr: str, src: int) -> Optional[Tuple[int, bytes]]:
         try:
             with self._connect(addr) as sock:
-                _send_frame(sock, {"op": "get", "src": src})
+                _send_frame(
+                    sock,
+                    {"op": "get", "src": src, "token": self.config.token},
+                )
                 resp, payload = _recv_frame(sock)
                 if not resp.get("ok"):
                     return None
-                return int(resp["step"]), bytes(payload or b"")
+                return int(resp["step"]), payload or bytearray()
         except OSError:
+            self._forget(addr)
             return None
 
     def _connect(self, addr: str) -> socket.socket:

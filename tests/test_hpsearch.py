@@ -1,0 +1,128 @@
+"""Bayesian-optimization HP search tests.
+
+Reference behavior: brain/hpsearch/bo.py BayesianOptimizer — suggest/observe
+over a mixed space, converging faster than random search.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.accelerate.hpsearch import (
+    BayesianOptimizer,
+    Choice,
+    Float,
+    GaussianProcess,
+    Int,
+    SearchSpace,
+    expected_improvement,
+)
+
+
+def _space2d():
+    return SearchSpace({"x": Float(-2.0, 2.0), "y": Float(-2.0, 2.0)})
+
+
+def test_encode_decode_roundtrip():
+    space = SearchSpace(
+        {
+            "lr": Float(1e-5, 1e-1, log=True),
+            "layers": Int(1, 12),
+            "accum": Int(1, 64, log=True),
+            "remat": Choice(["none", "full", "selective"]),
+        }
+    )
+    conf = {"lr": 3e-4, "layers": 7, "accum": 8, "remat": "full"}
+    out = space.decode(space.encode(conf))
+    assert out["layers"] == 7
+    assert out["accum"] == 8
+    assert out["remat"] == "full"
+    assert math.isclose(out["lr"], 3e-4, rel_tol=1e-6)
+
+
+def test_decode_respects_bounds():
+    space = SearchSpace({"n": Int(2, 5), "c": Choice([10, 20])})
+    lo = space.decode(np.zeros(space.dim()))
+    hi = space.decode(np.ones(space.dim()))
+    assert lo["n"] == 2 and hi["n"] == 5
+    assert lo["c"] in (10, 20) and hi["c"] in (10, 20)
+
+
+def test_gp_interpolates_training_points():
+    rng = np.random.default_rng(0)
+    x = rng.random((12, 2))
+    y = np.sin(3 * x[:, 0]) + x[:, 1] ** 2
+    gp = GaussianProcess()
+    gp.fit(x, y)
+    mean, std = gp.predict(x)
+    np.testing.assert_allclose(mean, y, atol=1e-3)
+    assert (std < 0.1).all()
+
+
+def test_gp_uncertainty_grows_off_data():
+    x = np.array([[0.1, 0.1], [0.2, 0.2]])
+    gp = GaussianProcess()
+    gp.fit(x, np.array([1.0, 2.0]))
+    _, std_near = gp.predict(np.array([[0.15, 0.15]]))
+    _, std_far = gp.predict(np.array([[0.9, 0.9]]))
+    assert std_far[0] > std_near[0]
+
+
+def test_ei_prefers_high_mean_and_high_std():
+    mean = np.array([0.0, 1.0, 0.0])
+    std = np.array([0.1, 0.1, 1.0])
+    ei = expected_improvement(mean, std, best=0.5)
+    assert ei[1] > ei[0]
+    assert ei[2] > ei[0]
+
+
+def _objective(conf):
+    # maximum at (0.5, -0.3); categorical bonus for "b"
+    base = -((conf["x"] - 0.5) ** 2) - (conf["y"] + 0.3) ** 2
+    return base + (0.5 if conf.get("kind") == "b" else 0.0)
+
+
+def test_bo_beats_random_search():
+    space = SearchSpace(
+        {
+            "x": Float(-2.0, 2.0),
+            "y": Float(-2.0, 2.0),
+            "kind": Choice(["a", "b", "c"]),
+        }
+    )
+    budget = 30
+    bo_bests, rnd_bests = [], []
+    for seed in range(3):
+        opt = BayesianOptimizer(space, seed=seed, n_init=8)
+        for _ in range(budget):
+            conf = opt.suggest()
+            opt.observe(conf, _objective(conf))
+        bo_bests.append(opt.best()[1])
+        rng = np.random.default_rng(1000 + seed)
+        rnd_bests.append(
+            max(_objective(space.sample(rng)) for _ in range(budget))
+        )
+    assert np.mean(bo_bests) >= np.mean(rnd_bests) - 1e-9
+    assert np.mean(bo_bests) > 0.2  # near the optimum (max 0.5)
+
+
+def test_bo_best_raises_without_observations():
+    opt = BayesianOptimizer(_space2d())
+    with pytest.raises(RuntimeError):
+        opt.best()
+
+
+@pytest.mark.slow
+def test_engine_bo_mode_returns_feasible():
+    from dlrover_tpu.accelerate.engine import search_strategy
+    from dlrover_tpu.models import get_config
+
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=64, n_head=4, vocab_size=256, max_seq=128
+    )
+    strat, plan = search_strategy(
+        cfg, 8, global_batch=8, seq=128, mode="bo", max_measured=3
+    )
+    sizes = plan.mesh.resolved_sizes(8)
+    assert np.prod(list(sizes.values())) == 8

@@ -29,10 +29,14 @@ def _run_id(monkeypatch):
 
 
 def _mk_manager(rank, count, peers=None, num_replicas=1):
+    # explicit token: hosts of one run share RUN_ID; the test's simulated
+    # replacement host keeps it even though we rotate RUN_ID to get
+    # fresh shm segments
     cfg = ReplicaConfig(
         num_replicas=num_replicas,
         bind_host="127.0.0.1",
         advertise_host="127.0.0.1",
+        token="test-run",
     )
     return ReplicaManager(rank, count, peers=peers or {}, config=cfg)
 
@@ -134,6 +138,32 @@ def test_store_budget_rejects_oversize():
         assert not holder._store.put(5, 1, b"y" * 64)
     finally:
         sender.close()
+        holder.close()
+
+
+def test_wrong_token_rejected():
+    from dlrover_tpu.checkpoint.replica import ReplicaConfig, ReplicaManager
+
+    holder = ReplicaManager(
+        1,
+        2,
+        config=ReplicaConfig(
+            bind_host="127.0.0.1", advertise_host="127.0.0.1", token="good"
+        ),
+    )
+    intruder = ReplicaManager(
+        0,
+        2,
+        peers={1: holder.addr},
+        config=ReplicaConfig(
+            bind_host="127.0.0.1", advertise_host="127.0.0.1", token="evil"
+        ),
+    )
+    try:
+        assert not intruder._put(holder.addr, 1, b"poison")
+        assert holder.local_steps() == {}
+    finally:
+        intruder.close()
         holder.close()
 
 
