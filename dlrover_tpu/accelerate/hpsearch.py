@@ -223,8 +223,3 @@ class BayesianOptimizer:
             raise RuntimeError("no observations yet")
         i = int(np.argmax(self._ys))
         return self.space.decode(self._xs[i]), self._ys[i]
-
-
-def minimize_to_maximize(value: float) -> float:
-    """Convenience for minimization problems: observe(-value)."""
-    return -value

@@ -83,21 +83,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     return buf
 
 
-def _recv_frame(
-    sock: socket.socket, max_bytes: Optional[int] = None
-) -> Tuple[Dict, Optional[bytearray]]:
+def _recv_header(sock: socket.socket) -> Dict:
     n = int.from_bytes(_recv_exact(sock, _LEN_BYTES), "little")
     if n > (1 << 20):
         raise ValueError(f"oversize frame header ({n} bytes)")
-    header = json.loads(bytes(_recv_exact(sock, n)))
-    payload = None
+    return json.loads(bytes(_recv_exact(sock, n)))
+
+
+def _recv_payload(
+    sock: socket.socket, header: Dict, max_bytes: Optional[int] = None
+) -> Optional[bytearray]:
     size = int(header.get("size", 0))
     if max_bytes is not None and size > max_bytes:
         # reject before allocating an attacker-controlled buffer
         raise ValueError(f"oversize payload ({size} > {max_bytes})")
-    if size:
-        payload = _recv_exact(sock, size)
-    return header, payload
+    return _recv_exact(sock, size) if size else None
+
+
+def _recv_frame(
+    sock: socket.socket, max_bytes: Optional[int] = None
+) -> Tuple[Dict, Optional[bytearray]]:
+    header = _recv_header(sock)
+    return header, _recv_payload(sock, header, max_bytes)
 
 
 _MAX_STEP = 1 << 40
@@ -152,11 +159,14 @@ class _Handler(socketserver.BaseRequestHandler):
         token = self.server.token  # type: ignore[attr-defined]
         max_bytes = self.server.max_frame_bytes  # type: ignore[attr-defined]
         try:
-            header, payload = _recv_frame(self.request, max_bytes)
+            header = _recv_header(self.request)
+            # authenticate before touching the payload: an unauthenticated
+            # 'put' must not be able to force a multi-GB allocation
+            if token and header.get("token") != token:
+                _send_frame(self.request, {"ok": False, "error": "bad token"})
+                return
+            payload = _recv_payload(self.request, header, max_bytes)
         except (ConnectionError, json.JSONDecodeError, OSError, ValueError):
-            return
-        if token and header.get("token") != token:
-            _send_frame(self.request, {"ok": False, "error": "bad token"})
             return
         op = header.get("op")
         if op == "put":

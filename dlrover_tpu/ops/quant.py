@@ -11,7 +11,6 @@ large float32 state leaves (Adam moments etc.) live as int8 + per-block
 scales — a ~3.5× optimizer-memory cut.
 """
 
-from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -21,32 +20,82 @@ BLOCK = 256
 MIN_QUANT_SIZE = 4096  # leave small leaves (scalars, counts) untouched
 
 
-class QuantizedArray(NamedTuple):
-    """int8 payload + per-block scales; shape/dtype kept for dequant."""
+@jax.tree_util.register_pytree_node_class
+class QuantizedArray:
+    """int payload + per-block scales; shape/dtype kept for dequant.
 
-    q: jax.Array          # int8 [n_blocks, BLOCK]
-    scale: jax.Array      # f32 [n_blocks, 1]
-    meta: Any             # jax.ShapeDtypeStruct of the original
+    ``bits=8``: one value per int8 byte. ``bits=4``: two values packed per
+    byte (low/high nibble), halving state memory again — the reference's
+    4-bit optimizer (low_bit/functional.py) packing scheme, minus the CUDA.
+
+    Registered as a pytree whose children are only (q, scale); shape/dtype/
+    bits are static aux data, so instances flow through jit/scan/pjit as
+    optimizer-state leaves (a ShapeDtypeStruct leaf would not trace).
+    """
+
+    __slots__ = ("q", "scale", "shape", "dtype", "bits")
+
+    def __init__(self, q, scale, shape, dtype, bits: int = 8):
+        self.q = q
+        self.scale = scale
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.bits = int(bits)
+
+    @property
+    def meta(self):
+        return jax.ShapeDtypeStruct(self.shape, self.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.shape, str(self.dtype), self.bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scale = children
+        shape, dtype, bits = aux
+        return cls(q, scale, shape, dtype, bits)
+
+    def __repr__(self):
+        return (
+            f"QuantizedArray(shape={self.shape}, dtype={self.dtype}, "
+            f"bits={self.bits})"
+        )
 
 
-def quantize(x: jax.Array) -> QuantizedArray:
-    meta = jax.ShapeDtypeStruct(x.shape, x.dtype)
+def quantize(x: jax.Array, bits: int = 8) -> QuantizedArray:
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    shape, dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     pad = (-flat.size) % BLOCK
     flat = jnp.pad(flat, (0, pad))
     blocks = flat.reshape(-1, BLOCK)
-    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    qmax = 127.0 if bits == 8 else 7.0
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / qmax
     scale = jnp.maximum(scale, 1e-12)
-    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
-    return QuantizedArray(q=q, scale=scale, meta=meta)
+    q = jnp.clip(jnp.round(blocks / scale), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        # two's-complement nibbles packed pairwise into one byte
+        lo = q[:, 0::2] & 0xF
+        hi = (q[:, 1::2] & 0xF) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return QuantizedArray(q=q, scale=scale, shape=shape, dtype=dtype, bits=bits)
+
+
+def _unpack4(q: jax.Array) -> jax.Array:
+    # sign-extend each nibble: shift into the high bits, arithmetic-shift back
+    lo = (q.astype(jnp.int8) << 4) >> 4
+    hi = q.astype(jnp.int8) >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
 
 
 def dequantize(qa: QuantizedArray) -> jax.Array:
-    flat = (qa.q.astype(jnp.float32) * qa.scale).reshape(-1)
+    q = _unpack4(qa.q) if qa.bits == 4 else qa.q
+    flat = (q.astype(jnp.float32) * qa.scale).reshape(-1)
     size = 1
-    for d in qa.meta.shape:
+    for d in qa.shape:
         size *= d
-    return flat[:size].reshape(qa.meta.shape).astype(qa.meta.dtype)
+    return flat[:size].reshape(qa.shape).astype(qa.dtype)
 
 
 def _should_quantize(leaf) -> bool:
@@ -57,9 +106,9 @@ def _should_quantize(leaf) -> bool:
     )
 
 
-def _quantize_tree(state):
+def _quantize_tree(state, bits: int = 8):
     return jax.tree.map(
-        lambda leaf: quantize(leaf) if _should_quantize(leaf) else leaf,
+        lambda leaf: quantize(leaf, bits) if _should_quantize(leaf) else leaf,
         state,
     )
 
@@ -76,15 +125,16 @@ def _dequantize_tree(state):
 
 def quantize_optimizer_state(
     inner: optax.GradientTransformation,
+    bits: int = 8,
 ) -> optax.GradientTransformation:
-    """Keep ``inner``'s large state leaves as block-quantized int8."""
+    """Keep ``inner``'s large state leaves as block-quantized int8/int4."""
 
     def init_fn(params):
-        return _quantize_tree(inner.init(params))
+        return _quantize_tree(inner.init(params), bits)
 
     def update_fn(updates, state, params=None):
         full = _dequantize_tree(state)
         updates, new_state = inner.update(updates, full, params)
-        return updates, _quantize_tree(new_state)
+        return updates, _quantize_tree(new_state, bits)
 
     return optax.GradientTransformation(init_fn, update_fn)

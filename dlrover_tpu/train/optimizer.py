@@ -78,6 +78,77 @@ def agd(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def wsam(
+    base: optax.GradientTransformation,
+    rho: float = 0.05,
+    gamma: float = 0.9,
+) -> optax.GradientTransformation:
+    """Weighted Sharpness-Aware Minimization (reference:
+    atorch/optimizers/wsam.py, KDD'23).
+
+    Minimizes ``L + γ/(1-γ)·(L_sam − L)`` — γ interpolates vanilla descent
+    (γ=0) through SAM (γ=0.5) to sharpness-dominated (γ→1). Implemented as
+    an alternating two-phase transform (the optax-contrib SAM pattern):
+
+    - even phase: cache params-point gradient, move to the adversarial
+      point ``w + ρ·g/‖g‖`` (base state untouched);
+    - odd phase: combine the cached and adversarial gradients into the
+      WSAM gradient, step ``base`` with it from the *original* point
+      (undoing the ascent offset in the same update).
+
+    Each optimizer "step" therefore consumes two train-loop iterations /
+    gradient evaluations, like the reference's closure-based torch impl.
+    """
+    if not 0.0 <= gamma < 1.0:
+        raise ValueError(f"wsam gamma must be in [0, 1), got {gamma}")
+    coef = gamma / (1.0 - gamma)
+
+    def init_fn(params):
+        return {
+            "phase": jnp.zeros([], jnp.int32),
+            "grad_cache": jax.tree.map(jnp.zeros_like, params),
+            "ascent": jax.tree.map(jnp.zeros_like, params),
+            "base": base.init(params),
+        }
+
+    def ascent_phase(updates, state, params):
+        gnorm = optax.global_norm(updates)
+        scale = rho / (gnorm + 1e-12)
+        ascent = jax.tree.map(lambda g: g * scale, updates)
+        return ascent, {
+            "phase": state["phase"] + 1,
+            "grad_cache": updates,
+            "ascent": ascent,
+            "base": state["base"],
+        }
+
+    def descent_phase(updates, state, params):
+        g_w = jax.tree.map(
+            lambda gs, g: g + coef * (gs - g), updates, state["grad_cache"]
+        )
+        step, base_state = base.update(g_w, state["base"], params)
+        # net move: undo the ascent offset, then apply the base step
+        out = jax.tree.map(lambda s, a: s - a, step, state["ascent"])
+        return out, {
+            "phase": state["phase"] + 1,
+            "grad_cache": jax.tree.map(jnp.zeros_like, updates),
+            "ascent": jax.tree.map(jnp.zeros_like, updates),
+            "base": base_state,
+        }
+
+    def update_fn(updates, state, params=None):
+        return jax.lax.cond(
+            state["phase"] % 2 == 0,
+            ascent_phase,
+            descent_phase,
+            updates,
+            state,
+            params,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(
     name: str = "adamw",
     learning_rate: float = 3e-4,
@@ -124,11 +195,28 @@ def make_optimizer(
         chain.append(optax.sgd(lr, momentum=0.9))
     elif name == "lion":
         chain.append(optax.lion(lr, weight_decay=weight_decay))
+    elif name == "wsam":
+        chain.append(
+            wsam(
+                optax.adamw(
+                    lr, b1=b1, b2=b2, weight_decay=weight_decay
+                )
+            )
+        )
     else:
         raise ValueError(f"unknown optimizer {name}")
 
-    if state_dtype == "int8":
+    if state_dtype in ("int8", "int4"):
+        if name == "wsam":
+            # quantizing wsam's ascent/grad_cache leaves would subtract a
+            # lossy ascent from the exact one applied to params, leaking
+            # quantization error straight into the weights every 2 steps
+            raise ValueError(
+                "wsam is incompatible with low-bit optimizer state; use "
+                "state_dtype=None or 'bfloat16'"
+            )
         from dlrover_tpu.ops.quant import quantize_optimizer_state
 
-        return quantize_optimizer_state(optax.chain(*chain))
+        bits = 8 if state_dtype == "int8" else 4
+        return quantize_optimizer_state(optax.chain(*chain), bits=bits)
     return optax.chain(*chain)

@@ -80,3 +80,122 @@ def test_quantized_optimizer_trains():
         updates, state = opt.update(g, state, params)
         params = optax.apply_updates(params, updates)
     assert float(loss(params)) < 128 * 64  # moved toward the minimum
+
+
+def test_quant4_roundtrip():
+    x = jax.random.normal(jax.random.key(1), (200, 33)) * 2.0
+    qa = quantize(x, bits=4)
+    # packed: half the bytes of the 8-bit payload
+    assert qa.q.shape[-1] == 128  # BLOCK // 2
+    out = dequantize(qa)
+    assert out.shape == x.shape and out.dtype == x.dtype
+    # blockwise int4: error bounded by scale/2 = blockmax/14
+    err = np.abs(np.asarray(out - x)).max()
+    assert err <= float(jnp.abs(x).max()) / 14.0 + 1e-6
+
+
+def test_quant4_exact_levels():
+    # values on the int4 grid survive the roundtrip exactly
+    x = jnp.array([-7.0, -3.0, 0.0, 1.0, 5.0, 7.0] * 100)
+    out = dequantize(quantize(x, bits=4))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+
+
+def test_quantized4_optimizer_trains():
+    import optax
+
+    opt = quantize_optimizer_state(optax.adam(1e-2), bits=4)
+    params = {"w": jnp.ones((128, 64))}
+    state = opt.init(params)
+
+    def loss(p):
+        return jnp.sum(p["w"] ** 2)
+
+    for _ in range(5):
+        g = jax.grad(loss)(params)
+        updates, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+    assert float(loss(params)) < 128 * 64
+
+
+def test_wsam_converges_and_matches_sam_at_half_gamma():
+    import optax
+
+    from dlrover_tpu.train.optimizer import wsam
+
+    def loss(p):
+        return jnp.sum((p["w"] - 2.0) ** 2)
+
+    # gamma=0.5 → coef=1 → pure SAM gradient at the perturbed point
+    opt = wsam(optax.sgd(0.05), rho=0.01, gamma=0.5)
+    params = {"w": jnp.zeros((8,))}
+    state = opt.init(params)
+    step = jax.jit(opt.update)
+    for _ in range(200):  # 100 effective steps (2 phases each)
+        g = jax.grad(loss)(params)
+        updates, state = step(g, state, params)
+        params = optax.apply_updates(params, updates)
+    assert float(loss(params)) < 1e-3
+
+
+def test_wsam_gamma_zero_is_vanilla():
+    import optax
+
+    from dlrover_tpu.train.optimizer import wsam
+
+    def loss(p):
+        return jnp.sum(p["w"] ** 2)
+
+    opt = wsam(optax.sgd(0.1), rho=0.05, gamma=0.0)
+    ref = optax.sgd(0.1)
+    params = {"w": jnp.full((4,), 3.0)}
+    rparams = {"w": jnp.full((4,), 3.0)}
+    state, rstate = opt.init(params), ref.init(rparams)
+    for _ in range(40):  # 20 effective steps
+        g = jax.grad(loss)(params)
+        updates, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+    # one vanilla step per two wsam phases: at gamma=0 the descent applies
+    # the cached params-point gradient and undoes the ascent exactly, so
+    # the net trajectory IS vanilla sgd
+    for _ in range(20):
+        rg = jax.grad(loss)(rparams)
+        rupd, rstate = ref.update(rg, rstate, rparams)
+        rparams = optax.apply_updates(rparams, rupd)
+    np.testing.assert_allclose(
+        np.asarray(params["w"]), np.asarray(rparams["w"]), rtol=1e-5
+    )
+
+
+def test_wsam_gamma_bounds():
+    import optax
+
+    from dlrover_tpu.train.optimizer import make_optimizer, wsam
+
+    with pytest.raises(ValueError):
+        wsam(optax.sgd(0.1), gamma=1.0)
+    with pytest.raises(ValueError):
+        make_optimizer(name="wsam", state_dtype="int8")
+
+
+def test_make_optimizer_wsam_and_int4():
+    from dlrover_tpu.train.optimizer import make_optimizer
+
+    opt = make_optimizer(name="wsam", learning_rate=1e-2)
+    params = {"w": jnp.ones((16,))}
+    state = opt.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    updates, state = opt.update(g, state, params)
+    assert jax.tree.structure(updates) == jax.tree.structure(params)
+
+    opt4 = make_optimizer(state_dtype="int4")
+    state4 = opt4.init({"w": jnp.ones((128, 64))})
+    from dlrover_tpu.ops.quant import QuantizedArray
+
+    leaves = jax.tree.leaves(
+        state4, is_leaf=lambda x: isinstance(x, QuantizedArray)
+    )
+    assert any(
+        isinstance(leaf, QuantizedArray) and leaf.bits == 4
+        for leaf in leaves
+    )
