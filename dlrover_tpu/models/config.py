@@ -34,6 +34,11 @@ class ModelConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.25
+    moe_gating: str = "topk"         # topk | switch (top-1 w/ jitter)
+    moe_jitter: float = 0.0          # switch-gating router noise (train only)
+    moe_aux_coef: float = 0.0        # load-balancing loss coefficient
+    moe_z_coef: float = 0.0          # router z-loss coefficient
+    moe_alltoall: bool = False       # explicit shard_map all-to-all dispatch
     # pipeline microbatches when the mesh has pp > 1 (0 → one per stage)
     pp_microbatches: int = 0
     # muP (train/mup.py): width of the base model hyperparams were tuned

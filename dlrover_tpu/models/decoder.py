@@ -210,20 +210,27 @@ def _mlp_block(x, layer, cfg: ModelConfig, mesh):
     return h @ mlp["w_down"].astype(x.dtype)
 
 
-def _layer_body(x, layer, positions, cfg: ModelConfig, mesh, attn_fn):
+def _layer_body(x, layer, positions, cfg: ModelConfig, mesh, attn_fn, rng=None):
     ln1, ln2 = layer["ln1"], layer["ln2"]
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
     x = x + _attention_block(h, layer, cfg, mesh, positions, attn_fn)
     h = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+    aux = {
+        "moe_lb_loss": jnp.zeros([], jnp.float32),
+        "moe_z_loss": jnp.zeros([], jnp.float32),
+    }
     if cfg.n_experts > 0:
         from dlrover_tpu.parallel.moe import moe_block
 
-        x = x + moe_block(h, layer["moe"], cfg, mesh)
+        out, aux = moe_block(
+            h, layer["moe"], cfg, mesh, rng=rng, return_aux=True
+        )
+        x = x + out
     else:
         x = x + _mlp_block(h, layer, cfg, mesh)
     if mesh is not None:
         x = shd.constrain(x, mesh, "batch", "seq", None)
-    return x
+    return x, aux
 
 
 def forward(
@@ -233,8 +240,15 @@ def forward(
     mesh=None,
     positions: Optional[jax.Array] = None,
     attn_impl: str = "auto",
-) -> jax.Array:
-    """tokens:[B,S] int32 → logits:[B,S,vocab] float32."""
+    rng: Optional[jax.Array] = None,
+    return_aux: bool = False,
+):
+    """tokens:[B,S] int32 → logits:[B,S,vocab] float32.
+
+    ``return_aux=True`` additionally returns per-model MoE router losses
+    summed over layers ({moe_lb_loss, moe_z_loss}); ``rng`` enables
+    switch-gating jitter during training.
+    """
     dt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
     if positions is None:
@@ -274,12 +288,18 @@ def forward(
     elif cfg.remat == "dots_saveable":
         body = jax.checkpoint(body, policy=cp.dots_saveable)
 
+    zero_aux = {
+        "moe_lb_loss": jnp.zeros([], jnp.float32),
+        "moe_z_loss": jnp.zeros([], jnp.float32),
+    }
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     if pp > 1:
         from dlrover_tpu.parallel.pipeline import pipeline_apply
 
+        # router aux losses are not collected across pipeline stages
+        aux = zero_aux
         x = pipeline_apply(
-            body,
+            lambda c, layer, pos: body(c, layer, pos)[0],
             params["layers"],
             x,
             positions,
@@ -287,11 +307,18 @@ def forward(
             num_microbatches=cfg.pp_microbatches or None,
         )
     else:
+        n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
 
-        def scan_fn(carry, layer):
-            return body(carry, layer, positions), None
+        def scan_fn(carry, inp):
+            layer, idx = inp
+            r = jax.random.fold_in(rng, idx) if rng is not None else None
+            out, aux = body(carry, layer, positions, rng=r)
+            return out, aux
 
-        x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+        x, auxs = jax.lax.scan(
+            scan_fn, x, (params["layers"], jnp.arange(n_layers))
+        )
+        aux = jax.tree.map(lambda a: a.sum(), auxs)
 
     fn = params["final_norm"]
     x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
@@ -309,7 +336,7 @@ def forward(
         # that scaling from rescale_init + mu_adam instead; giving it the
         # multiplier too would doubly suppress the logits.
         logits = logits * (cfg.mup_base_width / cfg.d_model)
-    return logits
+    return (logits, aux) if return_aux else logits
 
 
 def loss_fn(
@@ -319,10 +346,17 @@ def loss_fn(
     mesh=None,
     z_loss: float = 0.0,
     attn_impl: str = "auto",
+    rng: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S]}."""
-    logits = forward(
-        params, batch["tokens"], cfg, mesh=mesh, attn_impl=attn_impl
+    logits, moe_aux = forward(
+        params,
+        batch["tokens"],
+        cfg,
+        mesh=mesh,
+        attn_impl=attn_impl,
+        rng=rng,
+        return_aux=True,
     )
     targets = batch["targets"]
     mask = batch.get("mask")
@@ -342,6 +376,12 @@ def loss_fn(
         zl = z_loss * jnp.sum((logz * mask) ** 2) / denom
         loss = loss + zl
         metrics["z_loss"] = zl
+    if cfg.n_experts > 0 and (cfg.moe_aux_coef or cfg.moe_z_coef):
+        lb = cfg.moe_aux_coef * moe_aux["moe_lb_loss"]
+        rz = cfg.moe_z_coef * moe_aux["moe_z_loss"]
+        loss = loss + lb + rz
+        metrics["moe_lb_loss"] = lb
+        metrics["moe_z_loss"] = rz
     acc = (jnp.argmax(logits, -1) == targets).astype(jnp.float32) * mask
     metrics["accuracy"] = acc.sum() / denom
     return loss, metrics

@@ -72,6 +72,29 @@ def top_k_gating(gate_logits: jax.Array, k: int, capacity: int):
     return dispatch, combine, probs
 
 
+def switch_gating(
+    gate_logits: jax.Array,
+    capacity: int,
+    jitter_eps: float = 0.0,
+    rng=None,
+):
+    """Switch-Transformer top-1 routing (reference: moe/switch_gating.py).
+
+    Multiplicative jitter noise on the router logits during training
+    (``rng`` given) decorrelates expert assignment, per the Switch paper.
+    """
+    if jitter_eps > 0.0 and rng is not None:
+        noise = jax.random.uniform(
+            rng,
+            gate_logits.shape,
+            minval=1.0 - jitter_eps,
+            maxval=1.0 + jitter_eps,
+            dtype=gate_logits.dtype,
+        )
+        gate_logits = gate_logits * noise
+    return top_k_gating(gate_logits, 1, capacity)
+
+
 def load_balancing_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
     """GShard aux loss: E · Σ_e f_e · p_e (probs [B,S,E], dispatch [B,S,E,C])."""
     e = probs.shape[-1]
@@ -80,29 +103,137 @@ def load_balancing_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
     return e * jnp.sum(frac_tokens * frac_probs)
 
 
-def moe_block(x: jax.Array, moe: Dict, cfg, mesh=None) -> jax.Array:
-    """x: [B,S,D] → [B,S,D]. Expert FFN sharded over the ``ep`` axis."""
+def router_z_loss(gate_logits: jax.Array) -> jax.Array:
+    """ST-MoE router z-loss: mean logsumexp² keeps router logits small."""
+    logz = jax.nn.logsumexp(gate_logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(logz**2)
+
+
+def _gate(x, moe, cfg, rng):
     b, s, d = x.shape
-    e, k = cfg.n_experts, cfg.expert_top_k
+    e = cfg.n_experts
+    k = 1 if cfg.moe_gating == "switch" else cfg.expert_top_k
     capacity = max(1, int(cfg.capacity_factor * s * k / e))
     gate_logits = x @ moe["w_gate"].astype(x.dtype)
-    dispatch, combine, _probs = top_k_gating(gate_logits, k, capacity)
-    dispatch = dispatch.astype(x.dtype)
-    combine = combine.astype(x.dtype)
+    if cfg.moe_gating == "switch":
+        dispatch, combine, probs = switch_gating(
+            gate_logits, capacity, cfg.moe_jitter, rng
+        )
+    else:
+        dispatch, combine, probs = top_k_gating(gate_logits, k, capacity)
+    aux = {
+        "moe_lb_loss": load_balancing_loss(probs, dispatch),
+        "moe_z_loss": router_z_loss(gate_logits),
+    }
+    return dispatch.astype(x.dtype), combine.astype(x.dtype), aux
 
+
+def _expert_ffn(expert_in, moe, dtype):
+    """[E_local, T, C, D] → [E_local, T, C, D], batched over experts (the
+    grouped-GEMM equivalent: one MXU matmul per projection)."""
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, moe["w_up"].astype(dtype))
+    gate_p = jnp.einsum(
+        "ebcd,edf->ebcf", expert_in, moe["w_gate_proj"].astype(dtype)
+    )
+    h = jax.nn.silu(gate_p) * up
+    return jnp.einsum("ebcf,efd->ebcd", h, moe["w_down"].astype(dtype))
+
+
+def moe_block(
+    x: jax.Array,
+    moe: Dict,
+    cfg,
+    mesh=None,
+    rng=None,
+    return_aux: bool = False,
+):
+    """x: [B,S,D] → [B,S,D]. Expert FFN sharded over the ``ep`` axis.
+
+    Two dispatch lowerings:
+    - dense einsum (default): dispatch/combine einsums + sharding
+      constraints; XLA inserts the expert all-to-alls on ICI.
+    - explicit all-to-all (``cfg.moe_alltoall``): shard_map over ``ep``
+      with ``lax.all_to_all``, the direct analog of the reference's
+      ``_AllToAll`` autograd op (moe_layer.py:22) — tokens are sharded
+      over ``ep`` too, so each rank routes B/ep of the batch.
+    """
+    if (
+        cfg.moe_alltoall
+        and mesh is not None
+        and mesh.shape.get("ep", 1) > 1
+    ):
+        out, aux = _moe_block_alltoall(x, moe, cfg, mesh, rng)
+        return (out, aux) if return_aux else out
+
+    dispatch, combine, aux = _gate(x, moe, cfg, rng)
     # [E, B, C, D]: this einsum is the all-to-all when x is dp-sharded and
     # expert tensors are ep-sharded.
     expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
     if mesh is not None:
         expert_in = shd.constrain(expert_in, mesh, "expert", "batch", None, None)
-    up = jnp.einsum("ebcd,edf->ebcf", expert_in, moe["w_up"].astype(x.dtype))
-    gate_p = jnp.einsum(
-        "ebcd,edf->ebcf", expert_in, moe["w_gate_proj"].astype(x.dtype)
-    )
-    h = jax.nn.silu(gate_p) * up
-    expert_out = jnp.einsum("ebcf,efd->ebcd", h, moe["w_down"].astype(x.dtype))
+    expert_out = _expert_ffn(expert_in, moe, x.dtype)
     if mesh is not None:
         expert_out = shd.constrain(
             expert_out, mesh, "expert", "batch", None, None
         )
-    return jnp.einsum("ebcd,bsec->bsd", expert_out, combine)
+    out = jnp.einsum("ebcd,bsec->bsd", expert_out, combine)
+    return (out, aux) if return_aux else out
+
+
+def _moe_block_alltoall(x, moe, cfg, mesh, rng):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape["ep"]
+    e = cfg.n_experts
+    if e % ep:
+        raise ValueError(f"n_experts {e} not divisible by ep {ep}")
+    batch_axes = ("dp", "fsdp", "ep")
+
+    def body(xl, w_gate, w_up, w_gp, w_down):
+        # xl: [B/(dp·fsdp·ep), S, D] — this rank's token slice.
+        local = {
+            "w_gate": w_gate,
+            "w_up": w_up,
+            "w_gate_proj": w_gp,
+            "w_down": w_down,
+        }
+        dispatch, combine, aux = _gate(xl, local, cfg, rng)
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xl)  # [E,b,C,D]
+        # exchange: every rank sends each expert-owner its slice of tokens
+        expert_in = jax.lax.all_to_all(
+            expert_in, "ep", split_axis=0, concat_axis=1, tiled=True
+        )  # [E/ep, b·ep, C, D]
+        expert_out = _expert_ffn(expert_in, local, xl.dtype)
+        expert_out = jax.lax.all_to_all(
+            expert_out, "ep", split_axis=1, concat_axis=0, tiled=True
+        )  # [E, b, C, D]
+        out = jnp.einsum("ebcd,bsec->bsd", expert_out, combine)
+        # aux losses averaged over every axis the tokens were sharded on —
+        # out_specs declares them replicated, so they must actually agree
+        # across dp/fsdp ranks too, not just within the ep group
+        aux = jax.tree.map(
+            lambda v: jax.lax.pmean(v, axis_name=batch_axes), aux
+        )
+        return out, aux
+
+    out, aux = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(batch_axes, None, None),
+            P(None, None),       # w_gate replicated
+            P("ep", None, None),  # expert-sharded FFN weights
+            P("ep", None, None),
+            P("ep", None, None),
+        ),
+        out_specs=(P(batch_axes, None, None), P()),
+        check_vma=False,
+    )(
+        x,
+        moe["w_gate"].astype(x.dtype),
+        moe["w_up"].astype(x.dtype),
+        moe["w_gate_proj"].astype(x.dtype),
+        moe["w_down"].astype(x.dtype),
+    )
+    return out, aux

@@ -139,3 +139,22 @@ def validate_pipeline_config(cfg, mesh_cfg) -> None:
             "uses its own shard_map which cannot nest under the pipeline's "
             "manual pp region"
         )
+    if getattr(cfg, "n_experts", 0) > 0:
+        if getattr(cfg, "moe_alltoall", False) and mesh_cfg.ep > 1:
+            raise ValueError(
+                "pp>1 with moe_alltoall is unsupported: the explicit "
+                "all-to-all dispatch is a shard_map which cannot nest "
+                "under the pipeline's manual pp region; use the dense "
+                "einsum dispatch (moe_alltoall=False)"
+            )
+        if (
+            getattr(cfg, "moe_aux_coef", 0.0)
+            or getattr(cfg, "moe_z_coef", 0.0)
+            or getattr(cfg, "moe_jitter", 0.0)
+        ):
+            raise ValueError(
+                "pp>1 does not collect MoE router aux losses (or jitter "
+                "rng) across pipeline stages; set moe_aux_coef, "
+                "moe_z_coef and moe_jitter to 0 under pipeline "
+                "parallelism"
+            )

@@ -87,22 +87,36 @@ class TrainStepBuilder:
         self.rules = rules
         self.grad_accum = grad_accum
         self.attn_impl = attn_impl
+        # switch-gating jitter needs a per-step rng; only the built-in
+        # loss_fn accepts one (a custom loss_fn owns its rng handling)
+        self._needs_rng = (
+            loss_fn is None
+            and cfg.n_experts > 0
+            and cfg.moe_gating == "switch"
+            and cfg.moe_jitter > 0.0
+        )
         self._loss_fn = loss_fn or functools.partial(
             decoder.loss_fn, cfg=cfg, mesh=mesh, attn_impl=attn_impl
         )
 
-    def _grads(self, params, batch):
-        grad_fn = jax.value_and_grad(self._loss_fn, has_aux=True)
+    def _grads(self, params, batch, rng=None):
+        if self._needs_rng and rng is not None:
+            loss_fn = functools.partial(self._loss_fn, rng=rng)
+        else:
+            loss_fn = self._loss_fn
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (loss, metrics), grads = grad_fn(params, batch)
         return loss, metrics, grads
 
-    def _accumulated_grads(self, params, batch):
+    def _accumulated_grads(self, params, batch, rng=None):
         """Microbatch scan: batch leading dim is [accum, micro_b, ...]."""
         a = self.grad_accum
 
-        def micro(carry, mb):
+        def micro(carry, inp):
+            mb, idx = inp
             g_acc, loss_acc = carry
-            loss, _, g = self._grads(params, mb)
+            r = jax.random.fold_in(rng, idx) if rng is not None else None
+            loss, _, g = self._grads(params, mb, rng=r)
             g_acc = jax.tree.map(jnp.add, g_acc, g)
             return (g_acc, loss_acc + loss), None
 
@@ -111,7 +125,9 @@ class TrainStepBuilder:
             lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]), batch
         )
         (grads, loss), _ = jax.lax.scan(
-            micro, (zeros, jnp.zeros([], jnp.float32)), mb_batch
+            micro,
+            (zeros, jnp.zeros([], jnp.float32)),
+            (mb_batch, jnp.arange(a)),
         )
         grads = jax.tree.map(lambda g: g / a, grads)
         return loss / a, {"loss": loss / a}, grads
@@ -125,12 +141,19 @@ class TrainStepBuilder:
             else x,
             batch,
         )
+        rng = None
+        if self._needs_rng:
+            # deterministic per-step jitter key: same across hosts (SPMD
+            # lockstep), different every step
+            rng = jax.random.fold_in(jax.random.key(17), state["step"])
         if self.grad_accum > 1:
             loss, metrics, grads = self._accumulated_grads(
-                state["params"], batch
+                state["params"], batch, rng=rng
             )
         else:
-            loss, metrics, grads = self._grads(state["params"], batch)
+            loss, metrics, grads = self._grads(
+                state["params"], batch, rng=rng
+            )
         updates, new_opt = self.optimizer.update(
             grads, state["opt_state"], state["params"]
         )
