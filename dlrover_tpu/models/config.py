@@ -46,6 +46,13 @@ class ModelConfig:
     # scaling and tied logits get the 1/width_mult MuReadout multiplier.
     mup_base_width: Optional[int] = None
 
+    def __post_init__(self):
+        if self.moe_gating not in ("topk", "switch"):
+            raise ValueError(
+                f"moe_gating must be 'topk' or 'switch', got "
+                f"{self.moe_gating!r}"
+            )
+
     @property
     def kv_heads(self) -> int:
         return self.n_kv_head or self.n_head

@@ -8,3 +8,8 @@ from dlrover_tpu.parallel.sharding import (  # noqa: F401
     logical_to_mesh_axes,
     shardings_for_tree,
 )
+from dlrover_tpu.parallel.local_sgd import (  # noqa: F401
+    LocalSGDConfig,
+    LocalSGDSynchronizer,
+    OuterOptimizer,
+)

@@ -42,12 +42,22 @@ def moe_logical_axes(cfg) -> Dict:
     }
 
 
-def top_k_gating(gate_logits: jax.Array, k: int, capacity: int):
+def top_k_gating(
+    gate_logits: jax.Array,
+    k: int,
+    capacity: int,
+    renormalize: bool = True,
+):
     """Token-choice top-k routing with per-sequence capacity.
 
     gate_logits: [B, S, E] → (dispatch [B,S,E,C] bool, combine [B,S,E,C]).
     Tokens overflowing an expert's capacity are dropped (standard GShard
     behavior; the residual connection carries them through).
+
+    ``renormalize``: rescale combine weights to sum to 1 over kept
+    choices (Mixtral-style). MUST be False for k=1: renormalizing a
+    single choice yields the constant 1.0, which has zero derivative
+    w.r.t. the router logits — the router would never train.
     """
     b, s, e = gate_logits.shape
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
@@ -64,9 +74,14 @@ def top_k_gating(gate_logits: jax.Array, k: int, capacity: int):
     pos = jnp.einsum("bske,bske->bsk", pos, assign)  # chosen slot per choice
     slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
     kept = assign.sum(-1)  # [B,S,k] 1 if kept
-    # renormalise combine weights over kept choices
-    denom = jnp.maximum((gate_vals * kept).sum(-1, keepdims=True), 1e-9)
-    weights = gate_vals * kept / denom
+    if renormalize:
+        # renormalise combine weights over kept choices
+        denom = jnp.maximum((gate_vals * kept).sum(-1, keepdims=True), 1e-9)
+        weights = gate_vals * kept / denom
+    else:
+        # raw router probability (Switch: y = p_i(x)·E_i(x)) keeps the
+        # router differentiable through the combine path
+        weights = gate_vals * kept
     dispatch = jnp.einsum("bske,bskc->bsec", assign, slot)
     combine = jnp.einsum("bsk,bske,bskc->bsec", weights, assign, slot)
     return dispatch, combine, probs
@@ -92,7 +107,7 @@ def switch_gating(
             dtype=gate_logits.dtype,
         )
         gate_logits = gate_logits * noise
-    return top_k_gating(gate_logits, 1, capacity)
+    return top_k_gating(gate_logits, 1, capacity, renormalize=False)
 
 
 def load_balancing_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
@@ -121,11 +136,12 @@ def _gate(x, moe, cfg, rng):
         )
     else:
         dispatch, combine, probs = top_k_gating(gate_logits, k, capacity)
-    aux = {
-        "moe_lb_loss": load_balancing_loss(probs, dispatch),
-        "moe_z_loss": router_z_loss(gate_logits),
-    }
-    return dispatch.astype(x.dtype), combine.astype(x.dtype), aux
+    return (
+        dispatch.astype(x.dtype),
+        combine.astype(x.dtype),
+        probs,
+        gate_logits,
+    )
 
 
 def _expert_ffn(expert_in, moe, dtype):
@@ -165,7 +181,11 @@ def moe_block(
         out, aux = _moe_block_alltoall(x, moe, cfg, mesh, rng)
         return (out, aux) if return_aux else out
 
-    dispatch, combine, aux = _gate(x, moe, cfg, rng)
+    dispatch, combine, probs, gate_logits = _gate(x, moe, cfg, rng)
+    aux = {
+        "moe_lb_loss": load_balancing_loss(probs, dispatch),
+        "moe_z_loss": router_z_loss(gate_logits),
+    }
     # [E, B, C, D]: this einsum is the all-to-all when x is dp-sharded and
     # expert tensors are ep-sharded.
     expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
@@ -198,7 +218,7 @@ def _moe_block_alltoall(x, moe, cfg, mesh, rng):
             "w_gate_proj": w_gp,
             "w_down": w_down,
         }
-        dispatch, combine, aux = _gate(xl, local, cfg, rng)
+        dispatch, combine, probs, gate_logits = _gate(xl, local, cfg, rng)
         expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, xl)  # [E,b,C,D]
         # exchange: every rank sends each expert-owner its slice of tokens
         expert_in = jax.lax.all_to_all(
@@ -209,12 +229,27 @@ def _moe_block_alltoall(x, moe, cfg, mesh, rng):
             expert_out, "ep", split_axis=1, concat_axis=0, tiled=True
         )  # [E, b, C, D]
         out = jnp.einsum("ebcd,bsec->bsd", expert_out, combine)
-        # aux losses averaged over every axis the tokens were sharded on —
-        # out_specs declares them replicated, so they must actually agree
-        # across dp/fsdp ranks too, not just within the ep group
-        aux = jax.tree.map(
-            lambda v: jax.lax.pmean(v, axis_name=batch_axes), aux
+        # the lb loss must use GLOBAL expert statistics: pmean the per-rank
+        # [E] fractions first, THEN take the product — mean-of-products
+        # over ranks would be a systematically different (upward-biased)
+        # loss than the dense lowering computes over the full batch
+        e_count = probs.shape[-1]
+        frac_tokens = jax.lax.pmean(
+            dispatch.sum(-1).mean(axis=(0, 1)), axis_name=batch_axes
         )
+        frac_probs = jax.lax.pmean(
+            probs.mean(axis=(0, 1)), axis_name=batch_axes
+        )
+        aux = {
+            "moe_lb_loss": (
+                e_count * jnp.sum(frac_tokens * frac_probs)
+            ).astype(jnp.float32),
+            # z-loss is a plain mean over tokens: mean of equal-sized
+            # per-rank means is the global mean
+            "moe_z_loss": jax.lax.pmean(
+                router_z_loss(gate_logits), axis_name=batch_axes
+            ),
+        }
         return out, aux
 
     out, aux = shard_map(

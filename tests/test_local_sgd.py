@@ -1,0 +1,182 @@
+"""Local SGD / HSDP reducer tests.
+
+Reference behaviors: atorch/local_sgd reduce_methods (linear, GTA sign
+consensus, sparsify) and the HSDP outer-optimizer sync cadence.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.parallel.local_sgd import (
+    InProcessTransport,
+    LocalSGDConfig,
+    LocalSGDSynchronizer,
+    OuterOptimizer,
+    SocketTransport,
+    consensus_mask,
+    gta_merge,
+    linear_merge,
+    socket_exchange,
+    sparsify_magnitude,
+    sparsify_random,
+)
+
+
+def test_linear_merge_is_weighted_mean():
+    stacked = jnp.stack([jnp.ones((4,)), 3 * jnp.ones((4,))])
+    np.testing.assert_allclose(np.asarray(linear_merge(stacked)), 2.0)
+    out = linear_merge(stacked, weights=[3.0, 1.0])
+    np.testing.assert_allclose(np.asarray(out), 1.5)
+
+
+def test_consensus_mask_sum_vs_count():
+    # replica deltas: [+10, -1, -1] → sum majority +, count majority −
+    stacked = jnp.array([[10.0], [-1.0], [-1.0]])
+    m_sum = consensus_mask(stacked, "sum")
+    m_cnt = consensus_mask(stacked, "count")
+    np.testing.assert_array_equal(np.asarray(m_sum[:, 0]), [1.0, 0.0, 0.0])
+    np.testing.assert_array_equal(np.asarray(m_cnt[:, 0]), [0.0, 1.0, 1.0])
+
+
+def test_gta_merge_drops_minority_sign():
+    # 2 replicas agree (+1), 1 disagrees (−1): merged = mean of agreeing
+    stacked = jnp.array([[1.0], [1.0], [-1.0]])
+    out = gta_merge(stacked, consensus="count")
+    np.testing.assert_allclose(np.asarray(out), [1.0])
+
+
+def test_gta_merge_no_consensus_is_mean():
+    stacked = jnp.stack([jnp.full((8,), 2.0), jnp.full((8,), 4.0)])
+    out = gta_merge(stacked, consensus=None)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+def test_sparsify_magnitude_keeps_topk():
+    x = jnp.array([0.1, -5.0, 0.2, 3.0])
+    out = sparsify_magnitude(x, density=0.5)
+    np.testing.assert_allclose(np.asarray(out), [0.0, -5.0, 0.0, 3.0])
+
+
+def test_sparsify_random_unbiased():
+    x = jnp.ones((10000,))
+    out = sparsify_random(x, 0.25, jax.random.key(0), rescale=True)
+    assert abs(float(out.mean()) - 1.0) < 0.1
+    kept = float((out != 0).mean())
+    assert abs(kept - 0.25) < 0.05
+
+
+def test_outer_optimizer_momentum_accumulates():
+    opt = OuterOptimizer(lr=1.0, momentum=0.9)
+    base = {"w": jnp.zeros((2,))}
+    delta = {"w": jnp.ones((2,))}
+    p1 = opt.apply(base, delta)
+    p2 = opt.apply(p1, delta)
+    # second step: velocity = 0.9*1 + 1 = 1.9
+    np.testing.assert_allclose(np.asarray(p2["w"]), 1.0 + 1.9)
+
+
+def _run_slices(world, cfg, steps, lr=0.1, target=2.0):
+    """N threads, each descending sum((w−target)²) locally with different
+    data noise, syncing through an InProcessTransport."""
+    transport = InProcessTransport(world)
+    results = [None] * world
+
+    def slice_main(rank):
+        rng = jax.random.key(rank)
+        params = {"w": jnp.zeros((16,))}
+        sync = LocalSGDSynchronizer(cfg, transport.make_exchange(rank))
+        sync.maybe_sync(0, params)  # records initial synced point
+        for step in range(1, steps + 1):
+            noise = jax.random.normal(
+                jax.random.fold_in(rng, step), (16,)
+            ) * 0.1
+            g = 2 * (params["w"] - target) + noise
+            params = {"w": params["w"] - lr * g}
+            params = sync.maybe_sync(step, params)
+        results[rank] = params
+
+    threads = [
+        threading.Thread(target=slice_main, args=(r,)) for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+@pytest.mark.parametrize("reducer", ["mean", "gta"])
+def test_local_sgd_converges_and_stays_in_sync(reducer):
+    cfg = LocalSGDConfig(sync_interval=4, reducer=reducer)
+    results = _run_slices(world=3, cfg=cfg, steps=24)
+    # all slices hold identical params after a sync boundary
+    for r in results[1:]:
+        np.testing.assert_allclose(
+            np.asarray(r["w"]), np.asarray(results[0]["w"]), rtol=1e-5
+        )
+    # and they converged near the target
+    np.testing.assert_allclose(np.asarray(results[0]["w"]), 2.0, atol=0.3)
+
+
+def test_local_sgd_interval_respected():
+    calls = []
+
+    def exchange(delta):
+        calls.append(1)
+        return [delta]
+
+    cfg = LocalSGDConfig(sync_interval=5)
+    sync = LocalSGDSynchronizer(cfg, exchange)
+    params = {"w": jnp.zeros((2,))}
+    sync.maybe_sync(0, params)
+    for step in range(1, 21):
+        params = sync.maybe_sync(step, {"w": jnp.full((2,), float(step))})
+    assert len(calls) == 4  # steps 5, 10, 15, 20
+
+
+def test_local_sgd_warmup_syncs_every_step():
+    calls = []
+
+    def exchange(delta):
+        calls.append(1)
+        return [delta]
+
+    cfg = LocalSGDConfig(sync_interval=5, warmup_steps=3)
+    sync = LocalSGDSynchronizer(cfg, exchange)
+    sync.maybe_sync(0, {"w": jnp.zeros((2,))})
+    for step in range(1, 4):
+        sync.maybe_sync(step, {"w": jnp.ones((2,))})
+    assert len(calls) == 3
+
+
+def test_socket_transport_allgather():
+    t0 = SocketTransport(0, {}, bind_host="127.0.0.1", token="t")
+    t1 = SocketTransport(1, {}, bind_host="127.0.0.1", token="t")
+    peers = {0: f"127.0.0.1:{t0.port}", 1: f"127.0.0.1:{t1.port}"}
+    t0.peers = dict(peers)
+    t1.peers = dict(peers)
+    try:
+        out = [None, None]
+
+        def run(rank, t):
+            ex = socket_exchange(t)
+            out[rank] = ex({"w": jnp.full((4,), float(rank + 1))})
+
+        th = [
+            threading.Thread(target=run, args=(r, t))
+            for r, t in ((0, t0), (1, t1))
+        ]
+        for t in th:
+            t.start()
+        for t in th:
+            t.join()
+        for rank in (0, 1):
+            np.testing.assert_allclose(np.asarray(out[rank][0]["w"]), 1.0)
+            np.testing.assert_allclose(np.asarray(out[rank][1]["w"]), 2.0)
+    finally:
+        t0.close()
+        t1.close()

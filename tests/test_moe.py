@@ -47,9 +47,25 @@ def test_switch_gating_is_top1():
     # each token routed to at most one expert slot
     per_token = np.asarray(dispatch.sum(axis=(2, 3)))
     assert (per_token <= 1.0 + 1e-6).all()
-    # kept tokens have combine weight 1 (renormalized single choice)
-    kept = np.asarray(combine.sum(axis=(2, 3)))
-    np.testing.assert_allclose(kept[per_token > 0.5], 1.0, atol=1e-5)
+    # kept tokens carry the RAW router probability (Switch: y = p_i·E_i),
+    # not a renormalized 1.0 — that constant would zero the router grad
+    w = np.asarray(combine.sum(axis=(2, 3)))
+    p_top = np.asarray(probs.max(-1))
+    np.testing.assert_allclose(
+        w[per_token > 0.5], p_top[per_token > 0.5], atol=1e-5
+    )
+    assert (w[per_token > 0.5] < 1.0).all()
+
+
+def test_switch_router_receives_gradient():
+    """The combine path must be differentiable w.r.t. router logits."""
+
+    def f(logits):
+        _, combine, _ = switch_gating(logits, capacity=8)
+        return jnp.sum(combine * 1.7)
+
+    g = jax.grad(f)(jax.random.normal(jax.random.key(0), (2, 16, 4)))
+    assert float(jnp.abs(g).max()) > 1e-3
 
 
 def test_switch_gating_jitter_changes_assignment():
