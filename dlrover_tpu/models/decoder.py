@@ -242,12 +242,15 @@ def forward(
     attn_impl: str = "auto",
     rng: Optional[jax.Array] = None,
     return_aux: bool = False,
+    features_only: bool = False,
 ):
     """tokens:[B,S] int32 → logits:[B,S,vocab] float32.
 
     ``return_aux=True`` additionally returns per-model MoE router losses
     summed over layers ({moe_lb_loss, moe_z_loss}); ``rng`` enables
-    switch-gating jitter during training.
+    switch-gating jitter during training. ``features_only=True`` returns
+    the final-norm hidden states [B,S,D] instead of logits (value/reward
+    heads attach here).
     """
     dt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
@@ -322,6 +325,8 @@ def forward(
 
     fn = params["final_norm"]
     x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if features_only:
+        return (x, aux) if return_aux else x
     if cfg.tie_embeddings:
         w_out = params["embed"]["tokens"].T
     else:

@@ -1,0 +1,78 @@
+"""Autoregressive sampling for the decoder.
+
+Reference: the actor generation step of atorch's RL pipeline
+(rl/model_engine + transformers .generate). Implemented as one jitted
+``lax.scan`` over decode positions with a fixed-size token buffer, so the
+whole rollout compiles once. No KV cache yet — each step re-runs the full
+prefix (fine at experience-generation scale; a paged cache is the obvious
+later optimization).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import decoder
+
+
+def sample(
+    params,
+    cfg,
+    prompts: jax.Array,       # [B, P] int32
+    max_new_tokens: int,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    mesh=None,
+    attn_impl: str = "auto",
+    pad_id: int = 0,
+) -> jax.Array:
+    """Sample continuations; returns [B, P + max_new_tokens].
+
+    ``temperature=0`` is greedy. The scan carries the growing buffer at
+    fixed shape (prompt padded to full length) — XLA-friendly: no dynamic
+    shapes, one compilation for the whole rollout.
+    """
+    b, p = prompts.shape
+    total = p + max_new_tokens
+    buf = jnp.full((b, total), pad_id, dtype=jnp.int32)
+    buf = buf.at[:, :p].set(prompts)
+    positions = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), (b, total))
+
+    def step(carry, i):
+        buf, rng = carry
+        logits = decoder.forward(
+            params, buf, cfg, mesh=mesh, positions=positions,
+            attn_impl=attn_impl,
+        )
+        # logits at position i-1 predict token i
+        step_logits = jax.lax.dynamic_slice_in_dim(
+            logits, i - 1, 1, axis=1
+        )[:, 0, :]
+        rng, sub = jax.random.split(rng)
+        if temperature > 0.0:
+            tok = jax.random.categorical(sub, step_logits / temperature)
+        else:
+            tok = jnp.argmax(step_logits, axis=-1)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, tok[:, None].astype(jnp.int32), i, axis=1
+        )
+        return (buf, rng), None
+
+    (buf, _), _ = jax.lax.scan(
+        step, (buf, rng), jnp.arange(p, total)
+    )
+    return buf
+
+
+def greedy(params, cfg, prompts, max_new_tokens, mesh=None, **kw):
+    return sample(
+        params,
+        cfg,
+        prompts,
+        max_new_tokens,
+        rng=jax.random.key(0),
+        temperature=0.0,
+        mesh=mesh,
+        **kw,
+    )

@@ -246,6 +246,7 @@ class SocketTransport:
 
         self.rank = rank
         self.peers = dict(peers)
+        self._validate_peers()
         self.timeout = timeout
         self.token = token
         self._wire = wire
@@ -285,7 +286,22 @@ class SocketTransport:
     def port(self) -> int:
         return self._server.server_address[1]
 
+    def _validate_peers(self):
+        """Ranks must be the contiguous set 0..world-1: the reassembly
+        loop and the wait threshold both index by dense rank."""
+        if not self.peers:
+            return  # filled in later (tests set .peers post-construction)
+        ranks = set(self.peers) | {self.rank}
+        world = len(ranks)
+        if ranks != set(range(world)):
+            raise ValueError(
+                f"peer ranks must be contiguous 0..{world - 1}, got "
+                f"{sorted(ranks)}; re-number slices after membership "
+                "changes"
+            )
+
     def allgather(self, blob: bytes) -> List[bytes]:
+        self._validate_peers()
         import socket as pysocket
 
         rnd = self._round
@@ -399,11 +415,14 @@ class LocalSGDSynchronizer:
         self,
         config: LocalSGDConfig,
         exchange: Callable[[Any], List[Any]],
-        rng=None,
     ):
         self.config = config
         self.exchange = exchange
-        self.rng = rng if rng is not None else jax.random.key(42)
+        # every slice merges the allgathered deltas LOCALLY, so the merge
+        # (incl. random sparsification masks) must be bit-identical on all
+        # slices — the rng is derived from a fixed key and the sync-round
+        # counter, never from anything per-slice
+        self._round = 0
         self._last_synced: Any = None
         self._outer = OuterOptimizer(
             lr=config.outer_lr,
@@ -460,7 +479,8 @@ class LocalSGDSynchronizer:
         stacked = jax.tree.map(
             lambda *ds: jnp.stack([jnp.asarray(d) for d in ds]), *all_deltas
         )
-        self.rng, sub = jax.random.split(self.rng)
+        sub = jax.random.fold_in(jax.random.key(42), self._round)
+        self._round += 1
         merged = self._merge(stacked, sub)
         new_params = self._outer.apply(self._last_synced, merged)
         self._last_synced = self._own(new_params)

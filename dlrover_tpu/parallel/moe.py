@@ -111,10 +111,16 @@ def switch_gating(
 
 
 def load_balancing_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
-    """GShard aux loss: E · Σ_e f_e · p_e (probs [B,S,E], dispatch [B,S,E,C])."""
+    """GShard aux loss: E · Σ_e f_e · p_e (probs [B,S,E], dispatch [B,S,E,C]).
+
+    Reduced in float32: a bf16 dispatch tensor summed over thousands of
+    tokens would round the per-expert counts (bf16 only represents
+    integers exactly up to 256) and bias the loss.
+    """
     e = probs.shape[-1]
+    dispatch = dispatch.astype(jnp.float32)
     frac_tokens = dispatch.sum(-1).mean(axis=(0, 1))  # [E]
-    frac_probs = probs.mean(axis=(0, 1))  # [E]
+    frac_probs = probs.astype(jnp.float32).mean(axis=(0, 1))  # [E]
     return e * jnp.sum(frac_tokens * frac_probs)
 
 
@@ -235,10 +241,12 @@ def _moe_block_alltoall(x, moe, cfg, mesh, rng):
         # loss than the dense lowering computes over the full batch
         e_count = probs.shape[-1]
         frac_tokens = jax.lax.pmean(
-            dispatch.sum(-1).mean(axis=(0, 1)), axis_name=batch_axes
+            dispatch.astype(jnp.float32).sum(-1).mean(axis=(0, 1)),
+            axis_name=batch_axes,
         )
         frac_probs = jax.lax.pmean(
-            probs.mean(axis=(0, 1)), axis_name=batch_axes
+            probs.astype(jnp.float32).mean(axis=(0, 1)),
+            axis_name=batch_axes,
         )
         aux = {
             "moe_lb_loss": (

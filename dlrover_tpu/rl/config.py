@@ -1,0 +1,36 @@
+"""PPO / RLHF configuration.
+
+Reference: atorch/atorch/rl/config.py (AtorchRLConfig: model types,
+generation, train, ppo_config sections driving ModelEngine + RLTrainer).
+"""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class PPOConfig:
+    # GAE
+    gamma: float = 1.0
+    lam: float = 0.95
+    # PPO clipping
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    # loss coefficients
+    entropy_coef: float = 0.0
+    # KL shaping against the frozen reference policy
+    kl_coef: float = 0.1
+    # optimisation (NOTE: optimizer hyperparameters — learning rates,
+    # grad clip — live on ModelEngine, which owns the optimizers)
+    ppo_epochs: int = 4
+    minibatches: int = 1
+    # generation; temperature must be > 0 (PPO needs a stochastic
+    # behavior policy with well-defined logprobs)
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+
+    def __post_init__(self):
+        if self.temperature <= 0.0:
+            raise ValueError(
+                "PPO requires temperature > 0: greedy rollouts have a "
+                "degenerate behavior policy with undefined logprobs"
+            )

@@ -1,0 +1,146 @@
+"""RL model engine: actor / critic / reference / reward over one mesh.
+
+Reference: atorch/atorch/rl/model_engine/model_engine.py (ModelEngine:35 —
+builds the four models, applies per-model acceleration strategies, owns
+optimizers and save/load). TPU version: all four share the decoder
+architecture; actor+critic carry optax states, ref+reward are frozen; the
+shared mesh means one set of shardings and no DeepSpeed hybrid-engine
+module surgery — jit recompiles specialize train vs. rollout instead
+(the role the ds_hybrid_engine/ directory plays in the reference).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models import decoder
+from dlrover_tpu.models.config import ModelConfig
+
+logger = get_logger(__name__)
+
+ROLES = ("actor", "critic", "ref", "reward")
+TRAINABLE = ("actor", "critic")
+
+
+def init_value_head(rng, cfg: ModelConfig) -> Dict:
+    w = jax.random.normal(rng, (cfg.d_model, 1)) * (cfg.d_model**-0.5)
+    return {"w": w.astype(jnp.float32), "b": jnp.zeros((1,), jnp.float32)}
+
+
+def value_forward(params: Dict, tokens, cfg, mesh=None) -> jax.Array:
+    """Scalar-per-position head on the decoder trunk → [B, S]."""
+    h = decoder.forward(
+        params["backbone"], tokens, cfg, mesh=mesh, features_only=True
+    )
+    out = h.astype(jnp.float32) @ params["v_head"]["w"] + params["v_head"]["b"]
+    return out[..., 0]
+
+
+def reward_score(params: Dict, tokens, cfg, mesh=None, mask=None) -> jax.Array:
+    """Sequence score = value head at each row's last valid token → [B].
+
+    The index is positional (last set bit of ``mask``), so prefix and
+    suffix masks both work.
+    """
+    values = value_forward(params, tokens, cfg, mesh=mesh)
+    if mask is None:
+        return values[:, -1]
+    t = mask.shape[1]
+    idx = jnp.argmax(
+        mask * jnp.arange(1, t + 1, dtype=mask.dtype), axis=1
+    ).astype(jnp.int32)
+    return jnp.take_along_axis(values, idx[:, None], axis=1)[:, 0]
+
+
+class ModelEngine:
+    """Holds params + optimizer states for the four PPO roles."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        mesh=None,
+        rng: Optional[jax.Array] = None,
+        learning_rate: float = 1e-5,
+        critic_learning_rate: float = 1e-5,
+        grad_clip: float = 1.0,
+        actor_params: Optional[Any] = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        rng = rng if rng is not None else jax.random.key(0)
+        keys = jax.random.split(rng, 6)
+        actor = actor_params or decoder.init(keys[0], cfg)
+        # ref aliases the actor's initial arrays (standard RLHF frozen
+        # snapshot): jax arrays are immutable and optimizer updates rebind
+        # rather than mutate, so no copy — no second weight set in HBM
+        ref = actor
+        critic = {
+            "backbone": decoder.init(keys[1], cfg),
+            "v_head": init_value_head(keys[2], cfg),
+        }
+        reward = {
+            "backbone": decoder.init(keys[3], cfg),
+            "v_head": init_value_head(keys[4], cfg),
+        }
+        self.params: Dict[str, Any] = {
+            "actor": actor,
+            "critic": critic,
+            "ref": ref,
+            "reward": reward,
+        }
+        self.optimizers = {
+            "actor": optax.chain(
+                optax.clip_by_global_norm(grad_clip),
+                optax.adamw(learning_rate),
+            ),
+            "critic": optax.chain(
+                optax.clip_by_global_norm(grad_clip),
+                optax.adamw(critic_learning_rate),
+            ),
+        }
+        self.opt_states = {
+            role: self.optimizers[role].init(self.params[role])
+            for role in TRAINABLE
+        }
+
+    # ---- role application ------------------------------------------------
+
+    def actor_logits(self, params, tokens):
+        return decoder.forward(params, tokens, self.cfg, mesh=self.mesh)
+
+    def critic_values(self, params, tokens):
+        return value_forward(params, tokens, self.cfg, mesh=self.mesh)
+
+    def ref_logits(self, tokens):
+        return decoder.forward(
+            self.params["ref"], tokens, self.cfg, mesh=self.mesh
+        )
+
+    def score(self, tokens, mask=None):
+        return reward_score(
+            self.params["reward"], tokens, self.cfg, mesh=self.mesh, mask=mask
+        )
+
+    # ---- updates ---------------------------------------------------------
+
+    def apply_gradients(self, role: str, grads) -> None:
+        opt = self.optimizers[role]
+        updates, self.opt_states[role] = opt.update(
+            grads, self.opt_states[role], self.params[role]
+        )
+        self.params[role] = optax.apply_updates(self.params[role], updates)
+
+    # ---- checkpoint ------------------------------------------------------
+
+    def state_dict(self) -> Dict:
+        return {
+            "params": self.params,
+            "opt_states": self.opt_states,
+        }
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.params = sd["params"]
+        self.opt_states = sd["opt_states"]

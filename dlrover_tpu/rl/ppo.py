@@ -1,0 +1,127 @@
+"""PPO math: GAE, clipped policy/value losses, KL-shaped rewards.
+
+Reference: atorch/atorch/rl/trainer/ppo_utils.py-style loss computation
+(clipped surrogate + clipped value loss + entropy bonus, trlX lineage) —
+re-derived here as pure jnp functions usable inside one jitted step.
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gae_advantages(
+    rewards: jax.Array,   # [B, T]
+    values: jax.Array,    # [B, T]
+    mask: jax.Array,      # [B, T] 1.0 on response tokens
+    gamma: float,
+    lam: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over the response span.
+
+    Bootstrap value after the last valid token is 0 (episodic: the
+    response ends the episode). Returns (advantages, returns), both
+    zeroed outside ``mask``.
+    """
+    b, t = rewards.shape
+    # next-step values, masked so the bootstrap past the end is 0
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros((b, 1), values.dtype)], axis=1
+    )
+    next_mask = jnp.concatenate(
+        [mask[:, 1:], jnp.zeros((b, 1), mask.dtype)], axis=1
+    )
+    deltas = rewards + gamma * next_values * next_mask - values
+
+    def scan_back(carry, xs):
+        delta, m = xs
+        adv = delta + gamma * lam * carry * m
+        return adv, adv
+
+    # scan over time reversed; carry is [B]
+    _, adv_rev = jax.lax.scan(
+        scan_back,
+        jnp.zeros((b,), values.dtype),
+        (deltas.T[::-1], next_mask.T[::-1]),
+    )
+    advantages = adv_rev[::-1].T * mask
+    returns = advantages + values * mask
+    return advantages, returns
+
+
+def masked_whiten(x: jax.Array, mask: jax.Array, eps: float = 1e-8):
+    n = jnp.maximum(mask.sum(), 1.0)
+    mean = (x * mask).sum() / n
+    var = ((x - mean) ** 2 * mask).sum() / n
+    return (x - mean) * jax.lax.rsqrt(var + eps) * mask
+
+
+def ppo_policy_loss(
+    logprobs: jax.Array,      # [B, T] new policy logprobs of taken actions
+    old_logprobs: jax.Array,  # [B, T] behavior policy logprobs
+    advantages: jax.Array,    # [B, T]
+    mask: jax.Array,          # [B, T]
+    clip_ratio: float,
+) -> Tuple[jax.Array, Dict]:
+    ratio = jnp.exp(logprobs - old_logprobs)
+    clipped = jnp.clip(ratio, 1.0 - clip_ratio, 1.0 + clip_ratio)
+    surrogate = jnp.minimum(ratio * advantages, clipped * advantages)
+    n = jnp.maximum(mask.sum(), 1.0)
+    loss = -(surrogate * mask).sum() / n
+    clip_frac = ((jnp.abs(ratio - 1.0) > clip_ratio) * mask).sum() / n
+    approx_kl = ((old_logprobs - logprobs) * mask).sum() / n
+    return loss, {"clip_frac": clip_frac, "approx_kl": approx_kl}
+
+
+def ppo_value_loss(
+    values: jax.Array,      # [B, T] new value predictions
+    old_values: jax.Array,  # [B, T] behavior-time values
+    returns: jax.Array,     # [B, T]
+    mask: jax.Array,
+    value_clip: float,
+) -> jax.Array:
+    """Clipped value loss (PPO2 style)."""
+    clipped = old_values + jnp.clip(
+        values - old_values, -value_clip, value_clip
+    )
+    l1 = (values - returns) ** 2
+    l2 = (clipped - returns) ** 2
+    n = jnp.maximum(mask.sum(), 1.0)
+    return 0.5 * (jnp.maximum(l1, l2) * mask).sum() / n
+
+
+def shaped_rewards(
+    score: jax.Array,        # [B] sequence-level reward-model score
+    logprobs: jax.Array,     # [B, T] actor logprobs at rollout time
+    ref_logprobs: jax.Array, # [B, T] frozen reference logprobs
+    mask: jax.Array,         # [B, T]
+    kl_coef: float,
+) -> jax.Array:
+    """Per-token rewards: −β·KL everywhere + score on the last token.
+
+    The standard RLHF shaping: the sequence score lands on the final
+    response token; every response token pays the per-token KL penalty
+    against the reference policy.
+    """
+    kl = (logprobs - ref_logprobs) * mask
+    rewards = -kl_coef * kl
+    # positional last-valid index: works for suffix (response) masks too,
+    # where a count-based mask.sum()-1 would land the score early or off
+    # the mask entirely
+    t = mask.shape[1]
+    idx = jnp.argmax(mask * jnp.arange(1, t + 1, dtype=mask.dtype), axis=1)
+    last = jax.nn.one_hot(idx, t, dtype=rewards.dtype) * mask
+    return rewards + last * score[:, None]
+
+
+def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """[B,T,V] logits for positions predicting tokens[:, :] → [B,T]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
+
+
+def entropy(logits: jax.Array, mask: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ent = -(jnp.exp(logp) * logp).sum(-1)
+    return (ent * mask).sum() / jnp.maximum(mask.sum(), 1.0)

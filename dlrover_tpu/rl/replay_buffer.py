@@ -1,0 +1,46 @@
+"""Experience replay buffer for PPO.
+
+Reference: atorch/atorch/rl/replay_buffer/replay_buffer.py — host-side
+store of rollout elements, drained into training minibatches each PPO
+round. Host numpy keeps HBM free for the four models.
+"""
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = capacity
+        self._items: List[Dict[str, np.ndarray]] = []
+
+    def add(self, item: Dict) -> None:
+        """item: dict of per-sequence arrays (tokens, logprobs, values,
+        rewards, mask, ...), leading dim = batch."""
+        arrays = {k: np.asarray(v) for k, v in item.items()}
+        n = next(iter(arrays.values())).shape[0]
+        for i in range(n):
+            self._items.append({k: v[i] for k, v in arrays.items()})
+        if self.capacity is not None and len(self._items) > self.capacity:
+            self._items = self._items[-self.capacity:]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def clear(self) -> None:
+        self._items.clear()
+
+    def batches(
+        self, batch_size: int, rng: Optional[np.random.Generator] = None
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Shuffled full-coverage minibatches (drops the ragged tail)."""
+        idx = np.arange(len(self._items))
+        if rng is not None:
+            rng.shuffle(idx)
+        for lo in range(0, len(idx) - batch_size + 1, batch_size):
+            sel = idx[lo : lo + batch_size]
+            keys = self._items[0].keys()
+            yield {
+                k: np.stack([self._items[i][k] for i in sel]) for k in keys
+            }

@@ -1,0 +1,218 @@
+"""PPO trainer: make-experience → replay buffer → clipped updates.
+
+Reference: atorch/atorch/rl/trainer/rl_trainer.py + ppo_trainer lineage —
+generate rollouts with the actor, score with the reward model, shape
+per-token rewards with the KL-vs-reference penalty, then several PPO
+epochs of clipped policy/value updates from the replay buffer.
+
+The two update steps (actor, critic) are each one jitted function over
+the shared mesh; experience generation reuses models/generate.sample.
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models import generate
+from dlrover_tpu.rl import ppo
+from dlrover_tpu.rl.config import PPOConfig
+from dlrover_tpu.rl.model_engine import ModelEngine
+from dlrover_tpu.rl.replay_buffer import ReplayBuffer
+
+logger = get_logger(__name__)
+
+
+class RLTrainer:
+    def __init__(
+        self,
+        engine: ModelEngine,
+        config: Optional[PPOConfig] = None,
+        reward_fn: Optional[Callable] = None,
+    ):
+        """``reward_fn(tokens [B,T] np, mask [B,T-1] np) -> [B] scores``
+        overrides the learned reward model (programmatic rewards — the
+        path toy tasks and unit tests use; reference analog: custom
+        reward models plugged into ModelEngine). NOTE: ``mask`` is the
+        shifted response mask aligned with ``tokens[:, 1:]`` — mask[i, j]
+        flags tokens[i, j+1] as a response token."""
+        self.engine = engine
+        self.config = config or PPOConfig()
+        self.reward_fn = reward_fn
+        self.buffer = ReplayBuffer()
+        self._np_rng = np.random.default_rng(0)
+        cfg = self.config
+
+        # the behavior policy samples at cfg.temperature, so every logprob
+        # (rollout-time old_logprobs, update-time new logprobs, and the
+        # ref policy for the KL penalty) must be of the SAME tempered
+        # distribution, or the importance ratios are biased
+        inv_temp = 1.0 / cfg.temperature
+
+        @jax.jit
+        def actor_step(params, opt_state, batch):
+            def loss_fn(p):
+                logits = self.engine.actor_logits(p, batch["tokens"]) * (
+                    inv_temp
+                )
+                # logits at t predict token t+1: align to response tokens
+                logprobs = ppo.token_logprobs(
+                    logits[:, :-1], batch["tokens"][:, 1:]
+                )
+                pg_loss, stats = ppo.ppo_policy_loss(
+                    logprobs,
+                    batch["old_logprobs"],
+                    batch["advantages"],
+                    batch["mask"],
+                    cfg.clip_ratio,
+                )
+                ent = ppo.entropy(logits[:, :-1], batch["mask"])
+                loss = pg_loss - cfg.entropy_coef * ent
+                return loss, {**stats, "pg_loss": pg_loss, "entropy": ent}
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = self.engine.optimizers["actor"].update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {**stats, "actor_loss": loss}
+
+        @jax.jit
+        def critic_step(params, opt_state, batch):
+            def loss_fn(p):
+                values = self.engine.critic_values(p, batch["tokens"])[:, :-1]
+                return ppo.ppo_value_loss(
+                    values,
+                    batch["old_values"],
+                    batch["returns"],
+                    batch["mask"],
+                    cfg.value_clip,
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.engine.optimizers["critic"].update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"value_loss": loss}
+
+        self._actor_step = actor_step
+        self._critic_step = critic_step
+
+        @jax.jit
+        def rollout_stats(actor_p, critic_p, ref_p, tokens):
+            logits = self.engine.actor_logits(actor_p, tokens) * inv_temp
+            logprobs = ppo.token_logprobs(logits[:, :-1], tokens[:, 1:])
+            ref_logits = (
+                self.engine.actor_logits(ref_p, tokens) * inv_temp
+            )
+            ref_logprobs = ppo.token_logprobs(
+                ref_logits[:, :-1], tokens[:, 1:]
+            )
+            values = self.engine.critic_values(critic_p, tokens)[:, :-1]
+            return logprobs, ref_logprobs, values
+
+        @jax.jit
+        def postprocess(score, logprobs, ref_logprobs, values, mask):
+            rewards = ppo.shaped_rewards(
+                score, logprobs, ref_logprobs, mask, cfg.kl_coef
+            )
+            advantages, returns = ppo.gae_advantages(
+                rewards, values, mask, cfg.gamma, cfg.lam
+            )
+            return ppo.masked_whiten(advantages, mask), returns
+
+        self._rollout_stats = rollout_stats
+        self._postprocess = postprocess
+
+    # ---- experience ------------------------------------------------------
+
+    def make_experience(self, prompts: jax.Array, rng: jax.Array) -> Dict:
+        """Roll out the actor on ``prompts`` [B,P]; fill the buffer."""
+        eng, cfg = self.engine, self.config
+        b, p = prompts.shape
+        tokens = generate.sample(
+            eng.params["actor"],
+            eng.cfg,
+            prompts,
+            cfg.max_new_tokens,
+            rng=rng,
+            temperature=cfg.temperature,
+            mesh=eng.mesh,
+        )
+        t = tokens.shape[1]
+        # response mask over the shifted (predicting) positions [B, T-1]:
+        # position i predicts token i+1, responses start at index p
+        pos = jnp.arange(t - 1)
+        mask = jnp.broadcast_to((pos >= p - 1), (b, t - 1)).astype(
+            jnp.float32
+        )
+        # one compiled pass for the three model forwards, one for the
+        # reward shaping + GAE — no per-op dispatch in the rollout path
+        logprobs, ref_logprobs, values = self._rollout_stats(
+            eng.params["actor"],
+            eng.params["critic"],
+            eng.params["ref"],
+            tokens,
+        )
+        if self.reward_fn is not None:
+            score = jnp.asarray(
+                self.reward_fn(np.asarray(tokens), np.asarray(mask)),
+                dtype=jnp.float32,
+            )
+        else:
+            score = eng.score(tokens, mask=None)
+        advantages, returns = self._postprocess(
+            score, logprobs, ref_logprobs, values, mask
+        )
+        exp = {
+            "tokens": tokens,
+            "old_logprobs": logprobs,
+            "old_values": values,
+            "advantages": advantages,
+            "returns": returns,
+            "mask": mask,
+        }
+        self.buffer.add(exp)
+        return {"score_mean": float(score.mean())}
+
+    # ---- updates ---------------------------------------------------------
+
+    def train_on_buffer(self, batch_size: Optional[int] = None) -> Dict:
+        eng, cfg = self.engine, self.config
+        batch_size = batch_size or max(1, len(self.buffer) // cfg.minibatches)
+        stats = {}
+        for _ in range(cfg.ppo_epochs):
+            for batch in self.buffer.batches(batch_size, self._np_rng):
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+                (
+                    eng.params["actor"],
+                    eng.opt_states["actor"],
+                    astats,
+                ) = self._actor_step(
+                    eng.params["actor"], eng.opt_states["actor"], jbatch
+                )
+                (
+                    eng.params["critic"],
+                    eng.opt_states["critic"],
+                    cstats,
+                ) = self._critic_step(
+                    eng.params["critic"], eng.opt_states["critic"], jbatch
+                )
+                stats = {
+                    **{k: float(v) for k, v in astats.items()},
+                    **{k: float(v) for k, v in cstats.items()},
+                }
+        self.buffer.clear()
+        return stats
+
+    def step(self, prompts: jax.Array, rng: jax.Array) -> Dict:
+        """One full PPO round: rollout + buffer train."""
+        roll = self.make_experience(prompts, rng)
+        stats = self.train_on_buffer()
+        return {**roll, **stats}

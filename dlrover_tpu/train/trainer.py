@@ -1,0 +1,225 @@
+"""High-level trainer: the AtorchTrainer analog.
+
+Reference: atorch/atorch/trainer/atorch_trainer.py (AtorchTrainer:136 —
+HF-Trainer-shaped loop owning train/eval/save/log cadences, flash-ckpt
+integration, and master metric reporting). TPU version: one jitted step
+from TrainStepBuilder over a mesh, Flash Checkpoint resume + cadenced
+saves, loss-spike detection and step timing from the observability tier,
+global-step reports to the elastic master when one is present.
+"""
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.observability.loss_spike import LossSpikeDetector
+from dlrover_tpu.observability.profiler import StepTimer
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.train.train_step import (
+    TrainStepBuilder,
+    batch_sharding,
+    build_eval_step,
+    init_train_state,
+)
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class TrainerArgs:
+    """Reference: TrainingArguments consumed by AtorchTrainer."""
+
+    output_dir: str = "/tmp/dlrover_tpu_out"
+    max_steps: int = 1000
+    log_interval: int = 10
+    save_interval: int = 100          # async disk persist cadence (steps)
+    memory_save_interval: int = 0     # extra shm-only staging cadence; 0=off
+    eval_interval: int = 0            # 0 = no eval during training
+    eval_steps: int = 8
+    seed: int = 0
+    resume: bool = True
+    grad_accum: int = 1
+    attn_impl: str = "auto"
+    detect_loss_spikes: bool = True
+    report_to_master: bool = True
+
+
+class Trainer:
+    """Own the whole training loop for one model + mesh + optimizer.
+
+    ``train_iter`` yields batch dicts ({"tokens", "targets", ...}) of
+    GLOBAL batch size; the trainer handles device placement/sharding.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        args: TrainerArgs,
+        train_iter: Iterable[Dict],
+        optimizer: optax.GradientTransformation,
+        mesh=None,
+        eval_iter_fn: Optional[Callable[[], Iterable[Dict]]] = None,
+        master_client=None,
+        loss_fn: Optional[Callable] = None,
+        rules=None,
+    ):
+        self.cfg = cfg
+        self.args = args
+        self.mesh = mesh if mesh is not None else build_mesh(
+            MeshConfig(dp=-1)
+        )
+        self.optimizer = optimizer
+        self.train_iter = iter(train_iter)
+        self.eval_iter_fn = eval_iter_fn
+        self.client = master_client
+        self._builder = TrainStepBuilder(
+            cfg,
+            self.mesh,
+            optimizer,
+            rules=rules,
+            grad_accum=args.grad_accum,
+            loss_fn=loss_fn,
+            attn_impl=args.attn_impl,
+        )
+        self._step_fn = None
+        self._eval_fn = None
+        self._batch_sharding = batch_sharding(self.mesh, rules)
+        self.state: Any = None
+        self.timer = StepTimer(
+            flops_per_step=0.0, peak_flops=0.0
+        )
+        self.spike_detector = (
+            LossSpikeDetector(
+                save_dir=os.path.join(args.output_dir, "loss_spikes")
+            )
+            if args.detect_loss_spikes
+            else None
+        )
+        self._ckpt = None
+
+    # ---- checkpointing ---------------------------------------------------
+
+    @property
+    def checkpointer(self):
+        if self._ckpt is None:
+            from dlrover_tpu.checkpoint import Checkpointer
+
+            self._ckpt = Checkpointer(
+                os.path.join(self.args.output_dir, "checkpoints"),
+                master_client=self.client if self.args.report_to_master
+                else None,
+            )
+        return self._ckpt
+
+    def _init_state(self):
+        self.state = init_train_state(
+            jax.random.key(self.args.seed),
+            self.cfg,
+            self.mesh,
+            self.optimizer,
+        )
+        if not self.args.resume:
+            return
+        from dlrover_tpu.checkpoint.checkpointer import state_template
+
+        restored = self.checkpointer.load_checkpoint(
+            state_template(self.state),
+            shardings=jax.tree.map(lambda x: x.sharding, self.state),
+        )
+        if restored is not None:
+            self.state = restored
+            logger.info("resumed from step %d", int(self.state["step"]))
+
+    # ---- loops -----------------------------------------------------------
+
+    def train(self) -> Any:
+        args = self.args
+        if self.state is None:
+            self._init_state()
+        if self._step_fn is None:
+            self._step_fn = self._builder.build()
+        start = int(self.state["step"])
+        window_loss = 0.0
+        window_n = 0
+        t_log = time.perf_counter()
+        for step in range(start + 1, args.max_steps + 1):
+            try:
+                batch = next(self.train_iter)
+            except StopIteration:
+                logger.info("data exhausted at step %d", step - 1)
+                break
+            batch = jax.device_put(batch, self._batch_sharding)
+            self.timer.start()
+            self.state, metrics = self._step_fn(self.state, batch)
+            self.timer.stop(outputs=metrics["loss"])
+            loss = float(metrics["loss"])
+            window_loss += loss
+            window_n += 1
+            if self.spike_detector is not None:
+                self.spike_detector.update(step, loss)
+            if args.log_interval and step % args.log_interval == 0:
+                dt = time.perf_counter() - t_log
+                t_log = time.perf_counter()
+                logger.info(
+                    "step %d | loss %.4f | %.2f steps/s",
+                    step,
+                    window_loss / max(window_n, 1),
+                    window_n / max(dt, 1e-9),
+                )
+                window_loss, window_n = 0.0, 0
+            if self.client is not None and args.report_to_master:
+                try:
+                    self.client.report_global_step(
+                        step, jax.process_count()
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.warning("global-step report failed", exc_info=True)
+            if (
+                args.memory_save_interval
+                and step % args.memory_save_interval == 0
+            ):
+                from dlrover_tpu.checkpoint import StorageType
+
+                self.checkpointer.save_checkpoint(
+                    step, self.state, storage_type=StorageType.MEMORY
+                )
+            if args.save_interval and step % args.save_interval == 0:
+                self.checkpointer.save_checkpoint(step, self.state)
+            if args.eval_interval and step % args.eval_interval == 0:
+                eval_metrics = self.evaluate()
+                if eval_metrics:
+                    logger.info(
+                        "eval @ step %d | loss %.4f",
+                        step,
+                        eval_metrics["loss"],
+                    )
+        # final checkpoint so a clean exit is always resumable
+        if args.save_interval:
+            final_step = int(self.state["step"])
+            self.checkpointer.save_checkpoint(final_step, self.state)
+            self.checkpointer.wait_for_persist()
+        return self.state
+
+    def evaluate(self) -> Dict[str, float]:
+        if self.eval_iter_fn is None:
+            return {}
+        if self._eval_fn is None:
+            self._eval_fn = build_eval_step(
+                self.cfg, self.mesh, attn_impl=self.args.attn_impl
+            )
+        total, n = 0.0, 0
+        for i, batch in enumerate(self.eval_iter_fn()):
+            if i >= self.args.eval_steps:
+                break
+            batch = jax.device_put(batch, self._batch_sharding)
+            metrics = self._eval_fn(self.state["params"], batch)
+            total += float(metrics["loss"])
+            n += 1
+        return {"loss": total / max(n, 1), "batches": float(n)}

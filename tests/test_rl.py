@@ -1,0 +1,197 @@
+"""RL (PPO) tier tests.
+
+Reference behaviors: atorch/rl model_engine (4-role engine), replay
+buffer, PPO losses/GAE (trlX lineage), actor generation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import decoder, generate, get_config
+from dlrover_tpu.rl import ModelEngine, PPOConfig, ReplayBuffer, RLTrainer
+from dlrover_tpu.rl import ppo
+
+
+def _cfg(**kw):
+    base = dict(
+        n_layer=2,
+        d_model=32,
+        d_ff=64,
+        n_head=4,
+        vocab_size=32,
+        max_seq=32,
+    )
+    base.update(kw)
+    return get_config("tiny", **base)
+
+
+def test_gae_matches_closed_form():
+    # single step episode: advantage = reward − value
+    rewards = jnp.array([[1.0, 0.0]])
+    values = jnp.array([[0.3, 0.0]])
+    mask = jnp.array([[1.0, 0.0]])
+    adv, ret = ppo.gae_advantages(rewards, values, mask, gamma=1.0, lam=1.0)
+    np.testing.assert_allclose(float(adv[0, 0]), 0.7, rtol=1e-6)
+    np.testing.assert_allclose(float(ret[0, 0]), 1.0, rtol=1e-6)
+
+
+def test_gae_two_step_discounting():
+    rewards = jnp.array([[0.0, 1.0]])
+    values = jnp.array([[0.5, 0.25]])
+    mask = jnp.ones((1, 2))
+    gamma, lam = 0.9, 0.8
+    adv, _ = ppo.gae_advantages(rewards, values, mask, gamma, lam)
+    d1 = 1.0 - 0.25                      # delta_t1 (terminal)
+    d0 = 0.0 + gamma * 0.25 - 0.5        # delta_t0
+    np.testing.assert_allclose(float(adv[0, 1]), d1, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(adv[0, 0]), d0 + gamma * lam * d1, rtol=1e-5
+    )
+
+
+def test_policy_loss_clipping():
+    old_lp = jnp.zeros((1, 1))
+    adv = jnp.ones((1, 1))
+    mask = jnp.ones((1, 1))
+
+    def loss_at(new_lp):
+        l, _ = ppo.ppo_policy_loss(
+            jnp.full((1, 1), new_lp), old_lp, adv, mask, clip_ratio=0.2
+        )
+        return float(l)
+
+    # within clip: loss = −ratio; beyond clip: saturates at −1.2
+    assert abs(loss_at(0.0) + 1.0) < 1e-6
+    assert abs(loss_at(np.log(1.1)) + 1.1) < 1e-6
+    assert abs(loss_at(np.log(2.0)) + 1.2) < 1e-6
+
+
+def test_value_loss_clips_large_moves():
+    old_v = jnp.zeros((1, 1))
+    returns = jnp.ones((1, 1))
+    mask = jnp.ones((1, 1))
+    # new value jumped +10 beyond the clip window of 0.2: the clipped
+    # branch (0.2 − 1)² dominates max(l1, l2)... l1=(10−1)²=81 > l2
+    l = ppo.ppo_value_loss(
+        jnp.full((1, 1), 10.0), old_v, returns, mask, value_clip=0.2
+    )
+    np.testing.assert_allclose(float(l), 0.5 * 81.0, rtol=1e-6)
+
+
+def test_shaped_rewards_places_score_on_last_token():
+    score = jnp.array([2.0])
+    lp = jnp.zeros((1, 4))
+    ref_lp = jnp.zeros((1, 4))
+    mask = jnp.array([[1.0, 1.0, 1.0, 0.0]])
+    r = ppo.shaped_rewards(score, lp, ref_lp, mask, kl_coef=0.1)
+    np.testing.assert_allclose(np.asarray(r[0]), [0.0, 0.0, 2.0, 0.0])
+
+
+def test_shaped_rewards_suffix_mask():
+    """Response (suffix) masks — the shape RLTrainer actually passes —
+    must land the score on the LAST response token."""
+    score = jnp.array([5.0])
+    lp = jnp.zeros((1, 5))
+    ref_lp = jnp.zeros((1, 5))
+    mask = jnp.array([[0.0, 0.0, 0.0, 1.0, 1.0]])  # prompt 4, response 2
+    r = ppo.shaped_rewards(score, lp, ref_lp, mask, kl_coef=0.0)
+    np.testing.assert_allclose(np.asarray(r[0]), [0, 0, 0, 0, 5.0])
+
+
+def test_shaped_rewards_kl_penalty():
+    score = jnp.zeros((1,))
+    lp = jnp.full((1, 2), -1.0)
+    ref_lp = jnp.full((1, 2), -2.0)  # actor more confident than ref
+    mask = jnp.ones((1, 2))
+    r = ppo.shaped_rewards(score, lp, ref_lp, mask, kl_coef=0.5)
+    np.testing.assert_allclose(np.asarray(r[0]), [-0.5, -0.5])
+
+
+def test_replay_buffer_batches_cover_all():
+    buf = ReplayBuffer()
+    buf.add({"x": np.arange(6).reshape(6, 1)})
+    assert len(buf) == 6
+    seen = []
+    for b in buf.batches(2, np.random.default_rng(0)):
+        assert b["x"].shape == (2, 1)
+        seen.extend(b["x"][:, 0].tolist())
+    assert sorted(seen) == list(range(6))
+
+
+def test_generate_shapes_and_greedy_determinism():
+    cfg = _cfg()
+    params = decoder.init(jax.random.key(0), cfg)
+    prompts = jnp.ones((2, 4), jnp.int32)
+    out1 = generate.greedy(params, cfg, prompts, max_new_tokens=6)
+    out2 = generate.greedy(params, cfg, prompts, max_new_tokens=6)
+    assert out1.shape == (2, 10)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompts))
+
+
+def test_model_engine_roles_and_update():
+    cfg = _cfg()
+    eng = ModelEngine(cfg, learning_rate=1e-2)
+    toks = jnp.ones((2, 8), jnp.int32)
+    assert eng.actor_logits(eng.params["actor"], toks).shape == (
+        2, 8, cfg.vocab_size,
+    )
+    assert eng.critic_values(eng.params["critic"], toks).shape == (2, 8)
+    assert eng.score(toks).shape == (2,)
+    # ref is a frozen copy of actor at init
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(eng.params["actor"])[0]),
+        np.asarray(jax.tree.leaves(eng.params["ref"])[0]),
+    )
+    before = jax.tree.leaves(eng.params["actor"])[0]
+    grads = jax.tree.map(jnp.ones_like, eng.params["actor"])
+    eng.apply_gradients("actor", grads)
+    after = jax.tree.leaves(eng.params["actor"])[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+    # state dict roundtrip
+    sd = eng.state_dict()
+    eng2 = ModelEngine(cfg)
+    eng2.load_state_dict(sd)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(eng2.params["actor"])[0]),
+        np.asarray(after),
+    )
+
+
+@pytest.mark.slow
+def test_ppo_increases_rewarded_token_probability():
+    """Toy RLHF: reward = fraction of response tokens equal to TARGET.
+    After a few PPO rounds the actor's probability of TARGET must rise."""
+    TARGET = 7
+    cfg = _cfg(vocab_size=16, n_layer=1, d_model=32)
+    eng = ModelEngine(cfg, learning_rate=2e-2, rng=jax.random.key(1))
+
+    def reward_fn(tokens, mask):
+        resp = tokens[:, 1:] == TARGET
+        return (resp * mask).sum(-1) / np.maximum(mask.sum(-1), 1.0)
+
+    ppo_cfg = PPOConfig(
+        max_new_tokens=8,
+        kl_coef=0.0,
+        ppo_epochs=2,
+        temperature=1.0,
+        clip_ratio=0.2,
+    )
+    trainer = RLTrainer(eng, ppo_cfg, reward_fn=reward_fn)
+    prompts = jnp.ones((32, 2), jnp.int32)
+
+    def target_prob(params):
+        logits = eng.actor_logits(params, prompts)
+        return float(jax.nn.softmax(logits[:, -1, :], -1)[:, TARGET].mean())
+
+    p0 = target_prob(eng.params["actor"])
+    scores = []
+    for i in range(12):
+        stats = trainer.step(prompts, jax.random.key(100 + i))
+        scores.append(stats["score_mean"])
+    p1 = target_prob(eng.params["actor"])
+    assert p1 > p0 * 1.5, (p0, p1, scores)
+    # rollout scores trend upward
+    assert np.mean(scores[-3:]) > np.mean(scores[:3]), scores

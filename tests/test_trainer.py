@@ -1,0 +1,157 @@
+"""High-level Trainer tests (AtorchTrainer analog)."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import get_config
+from dlrover_tpu.train import Trainer, TrainerArgs, make_optimizer
+from dlrover_tpu.parallel import MeshConfig, build_mesh
+
+
+@pytest.fixture(autouse=True)
+def _run_id(monkeypatch):
+    monkeypatch.setenv(
+        "DLROVER_TPU_RUN_ID", f"tr{os.getpid()}_{time.time_ns()}"
+    )
+
+
+def _cfg():
+    return get_config(
+        "tiny", n_layer=2, d_model=64, d_ff=128, n_head=4,
+        vocab_size=128, max_seq=32,
+    )
+
+
+def _data_iter(batch=8, seq=32, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    while True:
+        # low-entropy data so a few steps visibly reduce loss
+        base = rng.randint(0, 8, size=(batch, seq + 1))
+        yield {
+            "tokens": jnp.asarray(base[:, :-1], jnp.int32),
+            "targets": jnp.asarray(base[:, 1:], jnp.int32),
+        }
+
+
+def test_trainer_trains_and_checkpoints(tmp_path):
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=4))
+    args = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=12,
+        log_interval=4,
+        save_interval=6,
+        report_to_master=False,
+    )
+    opt = make_optimizer(learning_rate=3e-3, warmup_steps=2, decay_steps=100)
+    trainer = Trainer(cfg, args, _data_iter(), opt, mesh=mesh)
+    state = trainer.train()
+    assert int(state["step"]) == 12
+    # final checkpoint committed
+    assert trainer.checkpointer.latest_committed_step() == 12
+    step_dir = os.path.join(str(tmp_path), "checkpoints", "step_12")
+    assert any(f.endswith(".pack") for f in os.listdir(step_dir))
+
+
+def test_trainer_resumes_from_checkpoint(tmp_path, monkeypatch):
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=4))
+    opt = make_optimizer(learning_rate=3e-3, warmup_steps=2, decay_steps=100)
+    args = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=6,
+        save_interval=3,
+        report_to_master=False,
+    )
+    t1 = Trainer(cfg, args, _data_iter(), opt, mesh=mesh)
+    s1 = t1.train()
+    assert int(s1["step"]) == 6
+    w1 = np.asarray(jax.tree.leaves(s1["params"])[0])
+
+    # fresh shm namespace: the "restarted worker" must restore from disk
+    monkeypatch.setenv("DLROVER_TPU_RUN_ID", f"tr2_{time.time_ns()}")
+    args2 = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=9,
+        save_interval=3,
+        report_to_master=False,
+    )
+    t2 = Trainer(cfg, args2, _data_iter(seed=1), opt, mesh=mesh)
+    t2._init_state()
+    assert int(t2.state["step"]) == 6  # resumed, not fresh
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(t2.state["params"])[0]), w1
+    )
+    s2 = t2.train()
+    assert int(s2["step"]) == 9
+
+
+def test_trainer_loss_decreases(tmp_path):
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig(dp=8))
+    opt = make_optimizer(learning_rate=5e-3, warmup_steps=2, decay_steps=200)
+    args = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=20,
+        save_interval=0,
+        report_to_master=False,
+        eval_interval=0,
+    )
+    trainer = Trainer(cfg, args, _data_iter(), opt, mesh=mesh)
+    trainer._init_state()
+    eval_fn = lambda: _data_iter(seed=7)  # noqa: E731
+    trainer.eval_iter_fn = eval_fn
+    before = trainer.evaluate()["loss"]
+    trainer.train()
+    after = trainer.evaluate()["loss"]
+    assert after < before - 0.3, (before, after)
+
+
+def test_trainer_eval_only_counts_eval_steps(tmp_path):
+    cfg = _cfg()
+    args = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=1,
+        eval_steps=3,
+        save_interval=0,
+        report_to_master=False,
+    )
+    opt = make_optimizer(learning_rate=1e-3)
+    trainer = Trainer(
+        cfg,
+        args,
+        _data_iter(),
+        opt,
+        mesh=build_mesh(MeshConfig(dp=8)),
+        eval_iter_fn=lambda: _data_iter(seed=3),
+    )
+    trainer._init_state()
+    m = trainer.evaluate()
+    assert m["batches"] == 3.0
+
+
+def test_trainer_data_exhaustion_stops_cleanly(tmp_path):
+    cfg = _cfg()
+    args = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=50,
+        save_interval=0,
+        report_to_master=False,
+    )
+    opt = make_optimizer(learning_rate=1e-3)
+
+    def finite():
+        it = _data_iter()
+        for _ in range(4):
+            yield next(it)
+
+    trainer = Trainer(
+        cfg, args, finite(), opt, mesh=build_mesh(MeshConfig(dp=8))
+    )
+    state = trainer.train()
+    assert int(state["step"]) == 4
