@@ -1,0 +1,9 @@
+from dlrover_tpu.cluster.crd import (  # noqa: F401
+    ElasticJob,
+    ElasticJobSpec,
+    ReplicaSpec,
+    ScalePlanCRD,
+    TPUSliceSpec,
+)
+from dlrover_tpu.cluster.scaler import SliceScaler  # noqa: F401
+from dlrover_tpu.cluster.brain import BrainService  # noqa: F401

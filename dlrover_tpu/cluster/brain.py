@@ -1,0 +1,189 @@
+"""Brain-style resource optimization service.
+
+Reference: dlrover/go/brain — a cluster-level gRPC service with three
+RPCs (persist_metrics / optimize / get_job_metrics, proto/brain.proto:
+196-199), a MySQL datastore and pluggable opt algorithms (e.g.
+optimize_job_worker_resource.go). Consumed by the master when
+``optimize_mode=cluster`` (resource/brain_optimizer.py).
+
+Python-native equivalent: an in-process (or jsonl-persisted) metrics
+store + the same two core optimize algorithms — first-allocation from
+historical jobs of the same kind, and running-job adjustment from
+observed throughput/memory — behind the ResourceOptimizer interface the
+master already consumes, so LocalHeuristicOptimizer and BrainService are
+drop-in alternatives.
+"""
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.resource_optimizer import (
+    ResourceOptimizer,
+    ResourcePlan,
+)
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class JobMetrics:
+    """One observation of a running job (reference: brain.proto JobMetrics)."""
+
+    job_name: str
+    job_kind: str = ""            # user-declared workload family
+    timestamp: float = field(default_factory=time.time)
+    worker_num: int = 0
+    steps_per_sec: float = 0.0
+    samples_per_sec: float = 0.0
+    hbm_used_bytes: int = 0
+    host_mem_used_bytes: int = 0
+    finished: bool = False
+    oom: bool = False
+
+
+class MetricsStore:
+    """Append-only metrics log, optionally persisted as jsonl."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._lock = threading.Lock()
+        self._rows: List[JobMetrics] = []
+        if path and os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    try:
+                        self._rows.append(JobMetrics(**json.loads(line)))
+                    except (TypeError, json.JSONDecodeError):
+                        continue
+
+    def append(self, m: JobMetrics):
+        with self._lock:
+            self._rows.append(m)
+            if self._path:
+                with open(self._path, "a") as f:
+                    f.write(json.dumps(asdict(m)) + "\n")
+
+    def job_rows(self, job_name: str) -> List[JobMetrics]:
+        with self._lock:
+            return [r for r in self._rows if r.job_name == job_name]
+
+    def kind_rows(self, job_kind: str) -> List[JobMetrics]:
+        with self._lock:
+            return [r for r in self._rows if r.job_kind == job_kind]
+
+
+class BrainService(ResourceOptimizer):
+    """persist_metrics / optimize, cluster-memory backed."""
+
+    def __init__(
+        self,
+        store: Optional[MetricsStore] = None,
+        min_workers: int = 1,
+        max_workers: int = 64,
+        node_unit: int = 1,
+        efficiency_floor: float = 0.7,
+    ):
+        self.store = store or MetricsStore()
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.node_unit = max(1, node_unit)
+        self.efficiency_floor = efficiency_floor
+        self._job_name = ""
+        self._job_kind = ""
+
+    def bind_job(self, job_name: str, job_kind: str = ""):
+        self._job_name = job_name
+        self._job_kind = job_kind
+
+    # ---- brain.proto persist_metrics --------------------------------------
+
+    def persist_metrics(self, m: JobMetrics):
+        self.store.append(m)
+
+    def get_job_metrics(self, job_name: str) -> List[JobMetrics]:
+        return self.store.job_rows(job_name)
+
+    # ---- brain.proto optimize (ResourceOptimizer interface) ---------------
+
+    def generate_plan(self, stage: str, stats: Dict) -> ResourcePlan:
+        if stage == "create":
+            return self._first_allocation()
+        return self._adjust_running(stats)
+
+    def _first_allocation(self) -> ResourcePlan:
+        """Cold-start worker count from completed jobs of the same kind
+        (reference: optimize_job_worker_create_resource.go)."""
+        plan = ResourcePlan()
+        history = [
+            r
+            for r in self.store.kind_rows(self._job_kind)
+            if r.finished and r.worker_num > 0 and not r.oom
+        ]
+        if not history:
+            return plan
+        # pick the worker count with the best observed samples/sec/worker
+        by_n: Dict[int, List[float]] = {}
+        for r in history:
+            if r.samples_per_sec > 0:
+                by_n.setdefault(r.worker_num, []).append(
+                    r.samples_per_sec / r.worker_num
+                )
+        if not by_n:
+            return plan
+        best = max(by_n, key=lambda n: sum(by_n[n]) / len(by_n[n]))
+        plan.worker_num = self._clamp(best)
+        logger.info(
+            "brain first-allocation for kind %r: %d workers "
+            "(from %d history rows)",
+            self._job_kind,
+            plan.worker_num,
+            len(history),
+        )
+        return plan
+
+    def _adjust_running(self, stats: Dict) -> ResourcePlan:
+        """Running-job adjustment (reference:
+        optimize_job_worker_resource.go): grow while marginal throughput
+        holds; on OOM raise per-host memory hints instead of count."""
+        plan = ResourcePlan()
+        rows = self.store.job_rows(self._job_name)
+        if stats.get("oom") or any(r.oom for r in rows[-3:]):
+            plan.node_resources["worker"] = {"memory_scale": 1.5}
+            return plan
+        speeds: Dict[int, float] = {}
+        for r in rows:
+            if r.worker_num > 0 and r.steps_per_sec > 0:
+                speeds[r.worker_num] = max(
+                    speeds.get(r.worker_num, 0.0), r.steps_per_sec
+                )
+        cur_n = int(stats.get("worker_num", 0))
+        cur_speed = float(stats.get("steps_per_sec", 0.0))
+        if cur_n <= 0 or cur_speed <= 0.0:
+            return plan
+        speeds[cur_n] = max(speeds.get(cur_n, 0.0), cur_speed)
+        smaller = [n for n in speeds if n < cur_n]
+        if smaller:
+            base = max(smaller)
+            # scaling efficiency vs the smaller observed config
+            eff = (speeds[cur_n] / cur_speed_safe(speeds[base])) * (
+                base / cur_n
+            )
+            if eff < self.efficiency_floor:
+                plan.worker_num = self._clamp(cur_n - self.node_unit)
+                return plan
+        if cur_n < self.max_workers:
+            plan.worker_num = self._clamp(cur_n + self.node_unit)
+        return plan
+
+    def _clamp(self, n: int) -> int:
+        n = max(self.min_workers, min(self.max_workers, n))
+        return (n // self.node_unit) * self.node_unit or self.node_unit
+
+
+def cur_speed_safe(v: float) -> float:
+    return v if v > 0 else 1e-9

@@ -1,0 +1,206 @@
+"""ElasticJob / ScalePlan CRD shapes for TPU pod slices.
+
+Reference: dlrover/go/operator/api/v1alpha1/elasticjob_types.go:29,108 and
+scaleplan_types.go:29 — the two CRDs the Go operator reconciles. The
+shapes are kept (group/version/kind, replica specs, scale spec) but the
+scheduling unit is a **TPU pod slice**: pods request ``google.com/tpu``
+chips and pin onto a slice via the GKE TPU node selectors
+(``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``), and
+worker counts move in whole-slice units because ICI only exists inside a
+slice.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+GROUP = "elastic.iml.github.io"
+VERSION = "v1alpha1"
+
+
+@dataclass
+class TPUSliceSpec:
+    """One slice flavor: accelerator + physical topology."""
+
+    accelerator: str = "tpu-v5p-slice"   # gke-tpu-accelerator label value
+    topology: str = "2x2x1"              # gke-tpu-topology label value
+    chips_per_host: int = 4
+    hosts_per_slice: int = 1
+
+    @property
+    def chips_per_slice(self) -> int:
+        return self.chips_per_host * self.hosts_per_slice
+
+
+@dataclass
+class ReplicaSpec:
+    """Reference: ReplicaSpec in elasticjob_types.go (replicas + template)."""
+
+    replicas: int = 1                     # in HOSTS
+    image: str = "dlrover-tpu:latest"
+    command: List[str] = field(default_factory=list)
+    cpu: str = "8"
+    memory: str = "32Gi"
+    env: Dict[str, str] = field(default_factory=dict)
+    slice: TPUSliceSpec = field(default_factory=TPUSliceSpec)
+
+
+@dataclass
+class ElasticJobSpec:
+    distribution_strategy: str = "AllreduceStrategy"
+    optimize_mode: str = "single-job"    # single-job | cluster (brain)
+    replica_specs: Dict[str, ReplicaSpec] = field(default_factory=dict)
+    min_hosts: int = 1
+    max_hosts: int = 1
+    suspend: bool = False
+
+
+@dataclass
+class ElasticJob:
+    name: str
+    namespace: str = "default"
+    spec: ElasticJobSpec = field(default_factory=ElasticJobSpec)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def to_manifest(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "ElasticJob",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": dict(self.labels),
+            },
+            "spec": {
+                "distributionStrategy": self.spec.distribution_strategy,
+                "optimizeMode": self.spec.optimize_mode,
+                "minHosts": self.spec.min_hosts,
+                "maxHosts": self.spec.max_hosts,
+                "suspend": self.spec.suspend,
+                "replicaSpecs": {
+                    role: {
+                        "replicas": rs.replicas,
+                        "template": pod_template(self.name, role, rs),
+                    }
+                    for role, rs in self.spec.replica_specs.items()
+                },
+            },
+        }
+
+    def render_yaml(self) -> str:
+        return yaml.safe_dump(self.to_manifest(), sort_keys=False)
+
+
+@dataclass
+class ScalePlanCRD:
+    """Reference: ScalePlanSpec (scaleplan_types.go:29) — desired replica
+    counts plus explicit create/remove pod lists, owned by a job."""
+
+    job_name: str
+    name: str = ""
+    namespace: str = "default"
+    replica_counts: Dict[str, int] = field(default_factory=dict)  # hosts
+    create_pods: List[Dict] = field(default_factory=list)
+    remove_pods: List[str] = field(default_factory=list)
+    manual_scaling: bool = False
+
+    def to_manifest(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "ScalePlan",
+            "metadata": {
+                "name": self.name or f"{self.job_name}-scaleplan",
+                "namespace": self.namespace,
+                "labels": {"elasticjob.dlrover/name": self.job_name},
+            },
+            "spec": {
+                "ownerJob": self.job_name,
+                "replicaCounts": dict(self.replica_counts),
+                "createPods": list(self.create_pods),
+                "removePods": list(self.remove_pods),
+                "manualScaling": self.manual_scaling,
+            },
+        }
+
+    def render_yaml(self) -> str:
+        return yaml.safe_dump(self.to_manifest(), sort_keys=False)
+
+
+def pod_template(
+    job_name: str, role: str, rs: ReplicaSpec
+) -> Dict[str, Any]:
+    """Pod template for one TPU host of a slice."""
+    sl = rs.slice
+    return {
+        "metadata": {
+            "labels": {
+                "elasticjob.dlrover/name": job_name,
+                "elasticjob.dlrover/replica-type": role,
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeSelector": {
+                "cloud.google.com/gke-tpu-accelerator": sl.accelerator,
+                "cloud.google.com/gke-tpu-topology": sl.topology,
+            },
+            "containers": [
+                {
+                    "name": "main",
+                    "image": rs.image,
+                    "command": list(rs.command),
+                    "env": [
+                        {"name": k, "value": v} for k, v in rs.env.items()
+                    ],
+                    "resources": {
+                        "requests": {
+                            "cpu": rs.cpu,
+                            "memory": rs.memory,
+                            "google.com/tpu": str(sl.chips_per_host),
+                        },
+                        "limits": {
+                            "google.com/tpu": str(sl.chips_per_host),
+                        },
+                    },
+                }
+            ],
+        },
+    }
+
+
+def pod_manifest(
+    job_name: str,
+    role: str,
+    rs: ReplicaSpec,
+    host_index: int,
+    slice_index: int,
+    master_addr: str = "",
+) -> Dict[str, Any]:
+    """Concrete pod for host ``host_index`` (global), slice-annotated so
+    the master's rendezvous can build ICI-contiguous process groups."""
+    tpl = pod_template(job_name, role, rs)
+    name = f"{job_name}-{role}-{host_index}"
+    tpl["metadata"]["name"] = name
+    tpl["metadata"]["labels"].update(
+        {
+            "elasticjob.dlrover/rank-index": str(host_index),
+            "elasticjob.dlrover/slice-index": str(slice_index),
+        }
+    )
+    env = tpl["spec"]["containers"][0]["env"]
+    env.extend(
+        [
+            {"name": "DLROVER_TPU_NODE_RANK", "value": str(host_index)},
+            {"name": "DLROVER_TPU_SLICE_INDEX", "value": str(slice_index)},
+            {
+                "name": "DLROVER_TPU_HOSTS_PER_SLICE",
+                "value": str(rs.slice.hosts_per_slice),
+            },
+        ]
+    )
+    if master_addr:
+        env.append(
+            {"name": "DLROVER_TPU_MASTER_ADDR", "value": master_addr}
+        )
+    return {"apiVersion": "v1", "kind": "Pod", **tpl}

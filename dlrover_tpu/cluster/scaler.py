@@ -1,0 +1,148 @@
+"""Slice-aware scaler: ScalePlan → TPU pod creates/deletes.
+
+Reference: PodScaler (master/scaler/pod_scaler.py:77 — `_periodic_create_pod`
+:372, `_create_pod`:399) and ElasticJobScaler (scaler/elasticjob_scaler.py:23,
+which writes ScalePlan CRDs for the Go operator). TPU twist: worker counts
+snap to whole slices — a partial slice has no ICI connectivity to the rest,
+so it is never schedulable as part of the same data-parallel ring.
+
+The k8s API is injected as two callables (submit/delete), so the scaler is
+fully testable without a cluster (the reference mocks its k8sClient the
+same way, tests/test_utils.py:268).
+"""
+
+import math
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.cluster.crd import (
+    ElasticJob,
+    ReplicaSpec,
+    ScalePlanCRD,
+    pod_manifest,
+)
+from dlrover_tpu.master.node_manager import ScalePlan, Scaler
+
+logger = get_logger(__name__)
+
+
+def snap_to_slices(hosts: int, hosts_per_slice: int, minimum: int = 0) -> int:
+    """Round a host count UP to whole slices (≥ minimum)."""
+    if hosts_per_slice <= 1:
+        return max(hosts, minimum)
+    slices = math.ceil(max(hosts, minimum) / hosts_per_slice)
+    return slices * hosts_per_slice
+
+
+class SliceScaler(Scaler):
+    """Executes master ScalePlans as slice-aligned pod creates/deletes."""
+
+    def __init__(
+        self,
+        job: ElasticJob,
+        role: str = "worker",
+        submit_fn: Optional[Callable[[Dict], None]] = None,
+        delete_fn: Optional[Callable[[str], None]] = None,
+        master_addr: str = "",
+    ):
+        self.job = job
+        self.role = role
+        self.rs: ReplicaSpec = job.spec.replica_specs[role]
+        self.submit_fn = submit_fn or (lambda manifest: None)
+        self.delete_fn = delete_fn or (lambda name: None)
+        self.master_addr = master_addr
+        self._lock = threading.Lock()
+        # host_index -> pod name, the scaler's view of live pods
+        self._pods: Dict[int, str] = {}
+
+    # ---- Scaler interface -------------------------------------------------
+
+    def scale(self, plan: ScalePlan):
+        with self._lock:
+            if plan.worker_num is not None:
+                self._scale_to(plan.worker_num)
+            for node in plan.remove_nodes:
+                self._remove_host(node.id)
+            for _ in plan.launch_nodes:
+                self._add_host()
+
+    # ---- internals --------------------------------------------------------
+
+    def _scale_to(self, hosts: int):
+        hps = self.rs.slice.hosts_per_slice
+        target = snap_to_slices(
+            hosts, hps, minimum=self.job.spec.min_hosts
+        )
+        target = min(
+            target,
+            snap_to_slices(self.job.spec.max_hosts, hps) if hps > 1
+            else self.job.spec.max_hosts,
+        )
+        if target != hosts:
+            logger.info(
+                "snapped host target %d → %d (%d hosts/slice)",
+                hosts,
+                target,
+                hps,
+            )
+        # scale in: drop highest-indexed slices first (keeps rank-0 stable)
+        while len(self._pods) > target:
+            self._remove_host(max(self._pods))
+        while len(self._pods) < target:
+            self._add_host()
+
+    def _next_index(self) -> int:
+        i = 0
+        while i in self._pods:
+            i += 1
+        return i
+
+    def _add_host(self):
+        idx = self._next_index()
+        hps = self.rs.slice.hosts_per_slice
+        manifest = pod_manifest(
+            self.job.name,
+            self.role,
+            self.rs,
+            host_index=idx,
+            slice_index=idx // max(hps, 1),
+            master_addr=self.master_addr,
+        )
+        self.submit_fn(manifest)
+        self._pods[idx] = manifest["metadata"]["name"]
+        logger.info("created pod %s", self._pods[idx])
+
+    def _remove_host(self, idx: int):
+        name = self._pods.pop(idx, None)
+        if name is None:
+            return
+        self.delete_fn(name)
+        logger.info("deleted pod %s", name)
+
+    # ---- CRD mode (reference: ElasticJobScaler) ---------------------------
+
+    def to_scale_plan_crd(self, plan: ScalePlan) -> ScalePlanCRD:
+        """Render the plan as a ScalePlan CRD for an external operator
+        instead of acting directly."""
+        counts = {}
+        if plan.worker_num is not None:
+            counts[self.role] = snap_to_slices(
+                plan.worker_num,
+                self.rs.slice.hosts_per_slice,
+                minimum=self.job.spec.min_hosts,
+            )
+        return ScalePlanCRD(
+            job_name=self.job.name,
+            namespace=self.job.namespace,
+            replica_counts=counts,
+            remove_pods=[
+                self._pods[n.id]
+                for n in plan.remove_nodes
+                if n.id in self._pods
+            ],
+        )
+
+    @property
+    def live_hosts(self) -> List[int]:
+        return sorted(self._pods)

@@ -48,10 +48,9 @@ def reward_score(params: Dict, tokens, cfg, mesh=None, mask=None) -> jax.Array:
     values = value_forward(params, tokens, cfg, mesh=mesh)
     if mask is None:
         return values[:, -1]
-    t = mask.shape[1]
-    idx = jnp.argmax(
-        mask * jnp.arange(1, t + 1, dtype=mask.dtype), axis=1
-    ).astype(jnp.int32)
+    from dlrover_tpu.rl.ppo import last_valid_index
+
+    idx = last_valid_index(mask)
     return jnp.take_along_axis(values, idx[:, None], axis=1)[:, 0]
 
 
@@ -67,7 +66,11 @@ class ModelEngine:
         critic_learning_rate: float = 1e-5,
         grad_clip: float = 1.0,
         actor_params: Optional[Any] = None,
+        init_reward: bool = True,
     ):
+        """``init_reward=False`` skips the learned reward backbone — use
+        it when RLTrainer gets a programmatic ``reward_fn``, so a full
+        model's worth of HBM is not wasted on unread weights."""
         self.cfg = cfg
         self.mesh = mesh
         rng = rng if rng is not None else jax.random.key(0)
@@ -81,10 +84,14 @@ class ModelEngine:
             "backbone": decoder.init(keys[1], cfg),
             "v_head": init_value_head(keys[2], cfg),
         }
-        reward = {
-            "backbone": decoder.init(keys[3], cfg),
-            "v_head": init_value_head(keys[4], cfg),
-        }
+        reward = (
+            {
+                "backbone": decoder.init(keys[3], cfg),
+                "v_head": init_value_head(keys[4], cfg),
+            }
+            if init_reward
+            else None
+        )
         self.params: Dict[str, Any] = {
             "actor": actor,
             "critic": critic,
@@ -120,6 +127,11 @@ class ModelEngine:
         )
 
     def score(self, tokens, mask=None):
+        if self.params["reward"] is None:
+            raise RuntimeError(
+                "ModelEngine was built with init_reward=False; supply a "
+                "reward_fn to RLTrainer or rebuild with init_reward=True"
+            )
         return reward_score(
             self.params["reward"], tokens, self.cfg, mesh=self.mesh, mask=mask
         )

@@ -11,6 +11,18 @@ import jax
 import jax.numpy as jnp
 
 
+def last_valid_index(mask: jax.Array) -> jax.Array:
+    """Index of each row's last set position in ``mask`` [B, T] → [B].
+
+    Positional (argmax of position-weighted mask), so prefix and suffix
+    masks both work; all-zero rows map to 0.
+    """
+    t = mask.shape[1]
+    return jnp.argmax(
+        mask * jnp.arange(1, t + 1, dtype=mask.dtype), axis=1
+    ).astype(jnp.int32)
+
+
 def gae_advantages(
     rewards: jax.Array,   # [B, T]
     values: jax.Array,    # [B, T]
@@ -106,12 +118,8 @@ def shaped_rewards(
     """
     kl = (logprobs - ref_logprobs) * mask
     rewards = -kl_coef * kl
-    # positional last-valid index: works for suffix (response) masks too,
-    # where a count-based mask.sum()-1 would land the score early or off
-    # the mask entirely
-    t = mask.shape[1]
-    idx = jnp.argmax(mask * jnp.arange(1, t + 1, dtype=mask.dtype), axis=1)
-    last = jax.nn.one_hot(idx, t, dtype=rewards.dtype) * mask
+    idx = last_valid_index(mask)
+    last = jax.nn.one_hot(idx, mask.shape[1], dtype=rewards.dtype) * mask
     return rewards + last * score[:, None]
 
 

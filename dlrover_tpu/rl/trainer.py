@@ -186,7 +186,8 @@ class RLTrainer:
     def train_on_buffer(self, batch_size: Optional[int] = None) -> Dict:
         eng, cfg = self.engine, self.config
         batch_size = batch_size or max(1, len(self.buffer) // cfg.minibatches)
-        stats = {}
+        sums: Dict[str, float] = {}
+        n_updates = 0
         for _ in range(cfg.ppo_epochs):
             for batch in self.buffer.batches(batch_size, self._np_rng):
                 jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
@@ -204,12 +205,12 @@ class RLTrainer:
                 ) = self._critic_step(
                     eng.params["critic"], eng.opt_states["critic"], jbatch
                 )
-                stats = {
-                    **{k: float(v) for k, v in astats.items()},
-                    **{k: float(v) for k, v in cstats.items()},
-                }
+                for k, v in {**astats, **cstats}.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                n_updates += 1
         self.buffer.clear()
-        return stats
+        # mean over all minibatch updates, not the last one's snapshot
+        return {k: v / max(n_updates, 1) for k, v in sums.items()}
 
     def step(self, prompts: jax.Array, rng: jax.Array) -> Dict:
         """One full PPO round: rollout + buffer train."""

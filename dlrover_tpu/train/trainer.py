@@ -148,6 +148,7 @@ class Trainer:
         start = int(self.state["step"])
         window_loss = 0.0
         window_n = 0
+        last_saved = -1
         t_log = time.perf_counter()
         for step in range(start + 1, args.max_steps + 1):
             try:
@@ -192,6 +193,7 @@ class Trainer:
                 )
             if args.save_interval and step % args.save_interval == 0:
                 self.checkpointer.save_checkpoint(step, self.state)
+                last_saved = step
             if args.eval_interval and step % args.eval_interval == 0:
                 eval_metrics = self.evaluate()
                 if eval_metrics:
@@ -200,10 +202,12 @@ class Trainer:
                         step,
                         eval_metrics["loss"],
                     )
-        # final checkpoint so a clean exit is always resumable
+        # final checkpoint so a clean exit is always resumable (skipped
+        # when the loop's cadence already saved this exact step)
         if args.save_interval:
             final_step = int(self.state["step"])
-            self.checkpointer.save_checkpoint(final_step, self.state)
+            if final_step != last_saved:
+                self.checkpointer.save_checkpoint(final_step, self.state)
             self.checkpointer.wait_for_persist()
         return self.state
 

@@ -1,0 +1,182 @@
+"""Cluster scheduler analog tests: CRDs, slice scaler, brain service.
+
+Reference behaviors: go/operator ElasticJob/ScalePlan CRDs, PodScaler,
+go/brain optimize algorithms.
+"""
+
+import yaml
+
+from dlrover_tpu.cluster import (
+    BrainService,
+    ElasticJob,
+    ElasticJobSpec,
+    ReplicaSpec,
+    SliceScaler,
+    TPUSliceSpec,
+)
+from dlrover_tpu.cluster.brain import JobMetrics, MetricsStore
+from dlrover_tpu.cluster.scaler import snap_to_slices
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.node_manager import ScalePlan
+
+
+def _job(hosts_per_slice=4, min_hosts=4, max_hosts=16):
+    return ElasticJob(
+        name="gpt-train",
+        spec=ElasticJobSpec(
+            min_hosts=min_hosts,
+            max_hosts=max_hosts,
+            replica_specs={
+                "worker": ReplicaSpec(
+                    replicas=min_hosts,
+                    command=["python", "train.py"],
+                    slice=TPUSliceSpec(
+                        accelerator="tpu-v5p-slice",
+                        topology="2x2x1",
+                        chips_per_host=4,
+                        hosts_per_slice=hosts_per_slice,
+                    ),
+                )
+            },
+        ),
+    )
+
+
+def test_elasticjob_manifest_shape():
+    m = _job().to_manifest()
+    assert m["kind"] == "ElasticJob"
+    tpl = m["spec"]["replicaSpecs"]["worker"]["template"]
+    sel = tpl["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x1"
+    req = tpl["spec"]["containers"][0]["resources"]["requests"]
+    assert req["google.com/tpu"] == "4"
+    # yaml renders round-trip
+    assert yaml.safe_load(_job().render_yaml())["kind"] == "ElasticJob"
+
+
+def test_snap_to_slices():
+    assert snap_to_slices(5, 4) == 8
+    assert snap_to_slices(8, 4) == 8
+    assert snap_to_slices(0, 4, minimum=4) == 4
+    assert snap_to_slices(3, 1) == 3
+
+
+def test_scaler_creates_slice_aligned_pods():
+    created, deleted = [], []
+    scaler = SliceScaler(
+        _job(),
+        submit_fn=created.append,
+        delete_fn=deleted.append,
+        master_addr="10.0.0.2:5001",
+    )
+    plan = ScalePlan()
+    plan.worker_num = 5  # snaps up to 8 (2 slices)
+    scaler.scale(plan)
+    assert len(created) == 8
+    assert scaler.live_hosts == list(range(8))
+    # slice index annotated for ICI-aware rendezvous
+    labels = created[5]["metadata"]["labels"]
+    assert labels["elasticjob.dlrover/slice-index"] == "1"
+    env = {
+        e["name"]: e["value"]
+        for e in created[0]["spec"]["containers"][0]["env"]
+    }
+    assert env["DLROVER_TPU_MASTER_ADDR"] == "10.0.0.2:5001"
+    assert env["DLROVER_TPU_HOSTS_PER_SLICE"] == "4"
+
+    # scale in to one slice: drops the highest-indexed hosts
+    plan2 = ScalePlan()
+    plan2.worker_num = 4
+    scaler.scale(plan2)
+    assert len(deleted) == 4
+    assert scaler.live_hosts == [0, 1, 2, 3]
+
+
+def test_scaler_respects_max_hosts():
+    created = []
+    scaler = SliceScaler(_job(max_hosts=8), submit_fn=created.append)
+    plan = ScalePlan()
+    plan.worker_num = 100
+    scaler.scale(plan)
+    assert len(created) == 8
+
+
+def test_scaler_remove_specific_node():
+    created, deleted = [], []
+    scaler = SliceScaler(
+        _job(hosts_per_slice=1, min_hosts=1),
+        submit_fn=created.append,
+        delete_fn=deleted.append,
+    )
+    plan = ScalePlan()
+    plan.worker_num = 3
+    scaler.scale(plan)
+    plan2 = ScalePlan()
+    plan2.remove_nodes = [Node(node_type="worker", node_id=1, name="w-1")]
+    scaler.scale(plan2)
+    assert deleted == ["gpt-train-worker-1"]
+    assert scaler.live_hosts == [0, 2]
+
+
+def test_scale_plan_crd_render():
+    scaler = SliceScaler(_job())
+    plan = ScalePlan()
+    plan.worker_num = 6
+    crd = scaler.to_scale_plan_crd(plan)
+    m = crd.to_manifest()
+    assert m["kind"] == "ScalePlan"
+    assert m["spec"]["replicaCounts"]["worker"] == 8  # snapped
+    assert m["spec"]["ownerJob"] == "gpt-train"
+
+
+def test_brain_first_allocation_from_history(tmp_path):
+    store = MetricsStore(str(tmp_path / "metrics.jsonl"))
+    # historical finished jobs of the same kind at different sizes:
+    # 8 workers had the best per-worker throughput
+    for n, sps in ((4, 40.0), (8, 96.0), (16, 128.0)):
+        store.append(
+            JobMetrics(
+                job_name=f"old-{n}",
+                job_kind="gpt-pretrain",
+                worker_num=n,
+                samples_per_sec=sps,
+                finished=True,
+            )
+        )
+    brain = BrainService(store, min_workers=1, max_workers=64)
+    brain.bind_job("new-job", "gpt-pretrain")
+    plan = brain.generate_plan("create", {})
+    assert plan.worker_num == 8
+    # persists across restarts (jsonl reload)
+    store2 = MetricsStore(str(tmp_path / "metrics.jsonl"))
+    assert len(store2.kind_rows("gpt-pretrain")) == 3
+
+
+def test_brain_oom_bumps_memory_not_count():
+    brain = BrainService()
+    brain.bind_job("j", "k")
+    plan = brain.generate_plan("running", {"oom": True, "worker_num": 4})
+    assert plan.worker_num is None
+    assert plan.node_resources["worker"]["memory_scale"] == 1.5
+
+
+def test_brain_grows_then_shrinks_on_poor_scaling():
+    brain = BrainService(node_unit=2, max_workers=16, min_workers=2)
+    brain.bind_job("j", "k")
+    # healthy: no smaller config observed → grow by node_unit
+    brain.persist_metrics(
+        JobMetrics(job_name="j", worker_num=4, steps_per_sec=10.0)
+    )
+    plan = brain.generate_plan(
+        "running", {"worker_num": 4, "steps_per_sec": 10.0}
+    )
+    assert plan.worker_num == 6
+    # poor scaling: 8 workers barely faster than 4 → shrink
+    brain.persist_metrics(
+        JobMetrics(job_name="j", worker_num=8, steps_per_sec=11.0)
+    )
+    plan2 = brain.generate_plan(
+        "running", {"worker_num": 8, "steps_per_sec": 11.0}
+    )
+    assert plan2.worker_num == 6  # 8 − node_unit
