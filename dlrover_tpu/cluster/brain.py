@@ -170,20 +170,28 @@ class BrainService(ResourceOptimizer):
         if smaller:
             base = max(smaller)
             # scaling efficiency vs the smaller observed config
-            eff = (speeds[cur_n] / cur_speed_safe(speeds[base])) * (
-                base / cur_n
-            )
+            eff = (speeds[cur_n] / speeds[base]) * (base / cur_n)
             if eff < self.efficiency_floor:
                 plan.worker_num = self._clamp(cur_n - self.node_unit)
                 return plan
         if cur_n < self.max_workers:
-            plan.worker_num = self._clamp(cur_n + self.node_unit)
+            cand = self._clamp(cur_n + self.node_unit)
+            # don't grow back into a size already observed to scale
+            # poorly vs the current one — that would thrash pods between
+            # grow and shrink forever
+            for n2, s2 in speeds.items():
+                if cur_n < n2 <= cand:
+                    eff2 = (s2 / speeds[cur_n]) * (cur_n / n2)
+                    if eff2 < self.efficiency_floor:
+                        return plan
+            if cand > cur_n:
+                plan.worker_num = cand
         return plan
 
     def _clamp(self, n: int) -> int:
         n = max(self.min_workers, min(self.max_workers, n))
-        return (n // self.node_unit) * self.node_unit or self.node_unit
-
-
-def cur_speed_safe(v: float) -> float:
-    return v if v > 0 else 1e-9
+        n = (n // self.node_unit) * self.node_unit or self.node_unit
+        # the unit floor may have dropped below min_workers — restore it
+        while n < self.min_workers:
+            n += self.node_unit
+        return min(n, max(self.max_workers, self.min_workers))

@@ -49,6 +49,12 @@ class SliceScaler(Scaler):
         self.job = job
         self.role = role
         self.rs: ReplicaSpec = job.spec.replica_specs[role]
+        hps = self.rs.slice.hosts_per_slice
+        if hps > 1 and job.spec.max_hosts < hps:
+            raise ValueError(
+                f"max_hosts={job.spec.max_hosts} cannot fit one slice of "
+                f"{hps} hosts"
+            )
         self.submit_fn = submit_fn or (lambda manifest: None)
         self.delete_fn = delete_fn or (lambda name: None)
         self.master_addr = master_addr
@@ -69,16 +75,24 @@ class SliceScaler(Scaler):
 
     # ---- internals --------------------------------------------------------
 
-    def _scale_to(self, hosts: int):
+    def _clamp_hosts(self, hosts: int) -> int:
+        """Snap UP to whole slices, then clamp to max_hosts rounded DOWN
+        to whole slices — rounding the cap up would exceed the operator's
+        declared quota."""
         hps = self.rs.slice.hosts_per_slice
         target = snap_to_slices(
             hosts, hps, minimum=self.job.spec.min_hosts
         )
-        target = min(
-            target,
-            snap_to_slices(self.job.spec.max_hosts, hps) if hps > 1
-            else self.job.spec.max_hosts,
+        cap = (
+            (self.job.spec.max_hosts // hps) * hps
+            if hps > 1
+            else self.job.spec.max_hosts
         )
+        return min(target, cap)
+
+    def _scale_to(self, hosts: int):
+        hps = self.rs.slice.hosts_per_slice
+        target = self._clamp_hosts(hosts)
         if target != hosts:
             logger.info(
                 "snapped host target %d → %d (%d hosts/slice)",
@@ -127,11 +141,9 @@ class SliceScaler(Scaler):
         instead of acting directly."""
         counts = {}
         if plan.worker_num is not None:
-            counts[self.role] = snap_to_slices(
-                plan.worker_num,
-                self.rs.slice.hosts_per_slice,
-                minimum=self.job.spec.min_hosts,
-            )
+            # same clamp as the direct path: the CRD must not instruct the
+            # operator to exceed max_hosts either
+            counts[self.role] = self._clamp_hosts(plan.worker_num)
         return ScalePlanCRD(
             job_name=self.job.name,
             namespace=self.job.namespace,

@@ -1,0 +1,4 @@
+from dlrover_tpu.data.coworker import (  # noqa: F401
+    BatchRing,
+    CoworkerPool,
+)

@@ -1,0 +1,210 @@
+"""Coworker data plane: CPU preprocessing processes → shm batch ring.
+
+Reference: atorch's coworker subsystem — `data/shm_context.py:139`
+(shared-memory tensor channel between preprocessing pods and trainers),
+`service/coworker_data_service.py:43` (gRPC data plane) and
+`data/shm_dataloader.py`. TPU framing: the host CPUs of a TPU VM are the
+coworkers; N producer processes run the user's batch iterator and write
+packed batches into a fixed-slot POSIX shared-memory ring, and the
+training process drains the ring, overlapping host preprocessing with
+device steps without the GIL or per-batch pickling through a pipe.
+
+Control rides the framework's unix-socket SharedQueues (free-slot and
+ready-slot queues); bulk bytes ride one shm segment, so a batch is
+copied exactly once on each side.
+"""
+
+import io
+import multiprocessing as mp
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import (
+    SharedQueue,
+    SharedQueueClient,
+    attach_shared_memory,
+    create_shared_memory,
+)
+
+logger = get_logger(__name__)
+
+_DONE = "__coworker_done__"
+
+
+def _pack(batch: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in batch.items()})
+    return buf.getvalue()
+
+
+def _unpack(raw: memoryview) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(bytes(raw))) as z:
+        # copy out: the shm slot is recycled as soon as we return
+        return {k: np.array(z[k]) for k in z.files}
+
+
+class BatchRing:
+    """Fixed-slot shm ring. Create server-side once; attach elsewhere."""
+
+    def __init__(
+        self,
+        name: str = "coworker",
+        slots: int = 8,
+        slot_bytes: int = 16 << 20,
+        create: bool = False,
+    ):
+        self.name = name
+        self.slots = slots
+        self.slot_bytes = slot_bytes
+        shm_name = f"dlrover_tpu_ring_{name}"
+        if create:
+            self._shm = create_shared_memory(shm_name, slots * slot_bytes)
+            self._free: Any = SharedQueue(f"{name}_free")
+            self._ready: Any = SharedQueue(f"{name}_ready")
+            for i in range(slots):
+                self._free.put(i)
+        else:
+            self._shm = attach_shared_memory(shm_name)
+            self._free = SharedQueueClient(f"{name}_free")
+            self._ready = SharedQueueClient(f"{name}_ready")
+
+    # ---- producer side ---------------------------------------------------
+
+    def put(self, batch: Dict[str, np.ndarray], timeout: float = 60.0):
+        raw = _pack(batch)
+        if len(raw) > self.slot_bytes:
+            raise ValueError(
+                f"batch packs to {len(raw)} bytes > slot_bytes="
+                f"{self.slot_bytes}; raise slot_bytes"
+            )
+        slot = self._wait(self._free, timeout)
+        if slot is None:
+            raise TimeoutError("no free slot (consumer stalled?)")
+        lo = slot * self.slot_bytes
+        self._shm.buf[lo : lo + len(raw)] = raw
+        self._ready.put({"slot": slot, "used": len(raw)})
+
+    def mark_done(self):
+        self._ready.put(_DONE)
+
+    # ---- consumer side ---------------------------------------------------
+
+    def get(self, timeout: float = 60.0) -> Optional[Dict[str, np.ndarray]]:
+        """Next batch, or None on a producer-done marker."""
+        item = self._wait(self._ready, timeout)
+        if item is None:
+            raise TimeoutError("no ready batch (producers stalled?)")
+        if item == _DONE:
+            return None
+        slot, used = item["slot"], item["used"]
+        lo = slot * self.slot_bytes
+        batch = _unpack(self._shm.buf[lo : lo + used])
+        self._free.put(slot)
+        return batch
+
+    @staticmethod
+    def _wait(queue, timeout: float):
+        deadline = time.time() + timeout
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return None
+            item = queue.get(timeout=min(remaining, 1.0))
+            if item is not None:
+                return item
+
+    def close(self):
+        self._shm.close()
+        for q in (self._free, self._ready):
+            if isinstance(q, SharedQueue):
+                q.close()
+
+
+def _producer_main(
+    name: str,
+    slots: int,
+    slot_bytes: int,
+    worker_id: int,
+    num_workers: int,
+    producer_fn,
+):
+    # geometry must match the creator's: slot offsets are slot_bytes-strided
+    ring = BatchRing(name, slots=slots, slot_bytes=slot_bytes, create=False)
+    try:
+        for batch in producer_fn(worker_id, num_workers):
+            ring.put(batch)
+    except Exception:  # noqa: BLE001
+        logger.exception("coworker %d failed", worker_id)
+    finally:
+        ring.mark_done()
+
+
+class CoworkerPool:
+    """N producer processes feeding one shm ring.
+
+    ``producer_fn(worker_id, num_workers) -> iterator of batch dicts``
+    must be picklable (top-level function); shard your dataset by
+    worker_id inside it. The consumer iterates ``batches()`` until every
+    producer finished.
+    """
+
+    def __init__(
+        self,
+        producer_fn: Callable[[int, int], Iterator[Dict]],
+        num_workers: int = 2,
+        slots: int = 8,
+        slot_bytes: int = 16 << 20,
+        name: str = "coworker",
+    ):
+        self.producer_fn = producer_fn
+        self.num_workers = num_workers
+        self.name = name
+        self.ring = BatchRing(
+            name, slots=slots, slot_bytes=slot_bytes, create=True
+        )
+        self._procs: List[mp.Process] = []
+
+    def start(self):
+        ctx = mp.get_context("spawn")
+        env_run = os.environ.get("DLROVER_TPU_RUN_ID")
+        for wid in range(self.num_workers):
+            p = ctx.Process(
+                target=_producer_main,
+                args=(
+                    self.name,
+                    self.ring.slots,
+                    self.ring.slot_bytes,
+                    wid,
+                    self.num_workers,
+                    self.producer_fn,
+                ),
+                daemon=True,
+            )
+            p.start()
+            self._procs.append(p)
+        logger.info(
+            "coworker pool: %d producers (run=%s)",
+            self.num_workers,
+            env_run,
+        )
+        return self
+
+    def batches(self, timeout: float = 120.0) -> Iterator[Dict]:
+        done = 0
+        while done < self.num_workers:
+            batch = self.ring.get(timeout=timeout)
+            if batch is None:
+                done += 1
+                continue
+            yield batch
+
+    def stop(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=10)
+        self.ring.close()

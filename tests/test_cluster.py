@@ -180,3 +180,39 @@ def test_brain_grows_then_shrinks_on_poor_scaling():
         "running", {"worker_num": 8, "steps_per_sec": 11.0}
     )
     assert plan2.worker_num == 6  # 8 − node_unit
+
+
+def test_scaler_max_cap_rounds_down_to_slices():
+    # max_hosts=6 with 4 hosts/slice: cap is 4 (one whole slice), never 8
+    created = []
+    scaler = SliceScaler(
+        _job(max_hosts=6, min_hosts=4), submit_fn=created.append
+    )
+    plan = ScalePlan()
+    plan.worker_num = 100
+    scaler.scale(plan)
+    assert len(created) == 4
+    crd = scaler.to_scale_plan_crd(plan)
+    assert crd.to_manifest()["spec"]["replicaCounts"]["worker"] == 4
+
+
+def test_brain_clamp_respects_min_after_unit_snap():
+    brain = BrainService(min_workers=3, node_unit=2, max_workers=16)
+    assert brain._clamp(3) == 4  # not 2
+
+
+def test_brain_does_not_regrow_into_known_bad_size():
+    brain = BrainService(node_unit=2, max_workers=16, min_workers=2)
+    brain.bind_job("j", "k")
+    brain.persist_metrics(
+        JobMetrics(job_name="j", worker_num=4, steps_per_sec=10.0)
+    )
+    brain.persist_metrics(
+        JobMetrics(job_name="j", worker_num=8, steps_per_sec=9.0)
+    )
+    # currently at 6 (after a shrink): 8 workers was SLOWER than 6
+    # (eff (9/10.5)·(6/8) ≈ 0.64 < 0.7) → hold, don't thrash back up
+    plan = brain.generate_plan(
+        "running", {"worker_num": 6, "steps_per_sec": 10.5}
+    )
+    assert plan.worker_num is None
