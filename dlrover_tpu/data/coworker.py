@@ -60,7 +60,11 @@ class BatchRing:
         self.name = name
         self.slots = slots
         self.slot_bytes = slot_bytes
-        shm_name = f"dlrover_tpu_ring_{name}"
+        self._creator = create
+        # run-id-scoped like the control sockets: two jobs sharing a host
+        # (and the default ring name) must not map the same segment
+        run_id = os.environ.get("DLROVER_TPU_RUN_ID", "default")
+        shm_name = f"dlrover_tpu_ring_{run_id}_{name}"
         if create:
             self._shm = create_shared_memory(shm_name, slots * slot_bytes)
             self._free: Any = SharedQueue(f"{name}_free")
@@ -119,6 +123,13 @@ class BatchRing:
 
     def close(self):
         self._shm.close()
+        if self._creator:
+            # reclaim /dev/shm: the segments are resource-tracker-exempt,
+            # so nothing else ever unlinks them
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
         for q in (self._free, self._ready):
             if isinstance(q, SharedQueue):
                 q.close()

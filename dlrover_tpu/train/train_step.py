@@ -52,6 +52,32 @@ def init_train_state(
     param_shardings = shd.shardings_for_tree(
         mesh, decoder.logical_axes(cfg), rules
     )
+    # optimizer-state leaves (Adam moments etc.) mirror param shapes and
+    # must be born with the SAME shardings — otherwise every step starts
+    # by involuntarily resharding the moments (XLA's "involuntary full
+    # rematerialization" warning, a full moment-tree copy per step)
+    def _constrain_like_params(opt_state, params):
+        # optax state nests whole param-shaped subtrees (Adam mu/nu etc.);
+        # match them by TREE STRUCTURE, not leaf shape — same-shape params
+        # can carry transposed shardings (wq ('embed','heads') vs wo
+        # ('heads','embed')), and a shape-keyed lookup would pin their
+        # moments to the wrong one
+        pdef = jax.tree.structure(params)
+
+        def is_param_tree(x):
+            try:
+                return jax.tree.structure(x) == pdef
+            except Exception:  # noqa: BLE001
+                return False
+
+        def con(sub):
+            if is_param_tree(sub):
+                return jax.tree.map(
+                    jax.lax.with_sharding_constraint, sub, param_shardings
+                )
+            return sub
+
+        return jax.tree.map(con, opt_state, is_leaf=is_param_tree)
 
     def f(rng):
         params = decoder.init(rng, cfg)
@@ -59,6 +85,7 @@ def init_train_state(
             jax.lax.with_sharding_constraint, params, param_shardings
         )
         opt_state = optimizer.init(params)
+        opt_state = _constrain_like_params(opt_state, params)
         return {
             "params": params,
             "opt_state": opt_state,
