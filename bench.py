@@ -13,6 +13,7 @@ compile or OOM on the big config cannot eat the whole bench budget.
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -48,7 +49,8 @@ def peak_tflops(device) -> float:
     return 197.0
 
 
-def run_config(name, batch, seq, remat, steps=10, warmup=3):
+def run_config(name, batch, seq, remat, steps=10, warmup=3,
+               state_dtype="bfloat16"):
     import jax
     import jax.numpy as jnp
 
@@ -68,7 +70,7 @@ def run_config(name, batch, seq, remat, steps=10, warmup=3):
         learning_rate=1e-4,
         warmup_steps=10,
         decay_steps=1000,
-        state_dtype="bfloat16",
+        state_dtype=state_dtype,
     )
     state = init_train_state(jax.random.key(0), cfg, mesh, opt)
     step = TrainStepBuilder(cfg, mesh, opt).build()
@@ -76,15 +78,23 @@ def run_config(name, batch, seq, remat, steps=10, warmup=3):
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, 1000)
     batch_data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
 
+    # sync via HOST READBACK, not block_until_ready: under the axon TPU
+    # relay block_until_ready returns before device completion, which
+    # would inflate throughput ~1000x; float() must wait for the value
     for _ in range(warmup):
         state, metrics = step(state, batch_data)
-    jax.block_until_ready(metrics["loss"])
+    warm_loss = float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch_data)
-    jax.block_until_ready(metrics["loss"])
+    final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    if not math.isfinite(final_loss):
+        raise RuntimeError(
+            f"non-finite loss {final_loss} (warmup {warm_loss}): "
+            "bench run is numerically invalid"
+        )
 
     tokens_per_s = steps * batch * seq / dt
     model_tflops = cfg.flops_per_token(seq) * tokens_per_s / 1e12
@@ -108,7 +118,12 @@ def main():
             int(sys.argv[4]),
             sys.argv[5] if len(sys.argv) > 5 else "none",
         )
-        print(json.dumps(run_config(name, batch, seq, remat)))
+        state_dtype = sys.argv[6] if len(sys.argv) > 6 else "bfloat16"
+        print(
+            json.dumps(
+                run_config(name, batch, seq, remat, state_dtype=state_dtype)
+            )
+        )
         return
 
     for name, batch, seq, remat, budget_s in _ATTEMPTS:

@@ -28,7 +28,7 @@ class ModelConfig:
     # numerics
     dtype: str = "bfloat16"          # activation/compute dtype
     param_dtype: str = "float32"
-    # rematerialisation policy: none | full | dots_saveable
+    # rematerialisation policy: none | full | dots_saveable | save_attn
     remat: str = "none"
     # MoE (0 = dense)
     n_experts: int = 0

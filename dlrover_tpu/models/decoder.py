@@ -213,7 +213,11 @@ def _mlp_block(x, layer, cfg: ModelConfig, mesh):
 def _layer_body(x, layer, positions, cfg: ModelConfig, mesh, attn_fn, rng=None):
     ln1, ln2 = layer["ln1"], layer["ln2"]
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
-    x = x + _attention_block(h, layer, cfg, mesh, positions, attn_fn)
+    attn_out = jax.ad_checkpoint.checkpoint_name(
+        _attention_block(h, layer, cfg, mesh, positions, attn_fn),
+        "attn_out",
+    )
+    x = x + attn_out
     h = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
     aux = {
         "moe_lb_loss": jnp.zeros([], jnp.float32),
@@ -265,8 +269,12 @@ def forward(
     if mesh is not None:
         x = shd.constrain(x, mesh, "batch", "seq", None)
 
-    if attn_impl == "auto" and jax.default_backend() == "cpu":
-        attn_impl = "reference"
+    if attn_impl == "auto":
+        # flash (pallas) on real accelerators; the kernel's interpret
+        # path is far slower than plain jnp on CPU
+        attn_impl = (
+            "reference" if jax.default_backend() == "cpu" else "flash"
+        )
 
     def attn_fn(q, k, v):
         if attn_impl == "ring":
@@ -277,7 +285,7 @@ def forward(
             from dlrover_tpu.parallel.sequence import ulysses_attention
 
             return ulysses_attention(q, k, v, mesh, causal=True)
-        if attn_impl in ("reference", "auto"):
+        if attn_impl == "reference":
             return mha_reference(q, k, v, causal=True)
         from dlrover_tpu.ops.pallas_attention import flash_attention
 
@@ -290,6 +298,17 @@ def forward(
         body = jax.checkpoint(body)
     elif cfg.remat == "dots_saveable":
         body = jax.checkpoint(body, policy=cp.dots_saveable)
+    elif cfg.remat == "save_attn":
+        # keep the tagged attention-block outputs AND the flash kernel's
+        # custom_vjp residuals (out, lse) — so backward recomputes the
+        # cheap MLP/norm/projection math but never re-runs the attention
+        # kernel itself
+        body = jax.checkpoint(
+            body,
+            policy=cp.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse"
+            ),
+        )
 
     zero_aux = {
         "moe_lb_loss": jnp.zeros([], jnp.float32),

@@ -38,6 +38,7 @@ def _fwd_kernel(
     k_ref,  # [block_k, d]
     v_ref,  # [block_k, d]
     o_ref,  # [block_q, d]
+    lse_ref,  # [block_q, 8] f32 (8 lanes to satisfy TPU tiling; col 0 used)
     m_scratch,  # [block_q, 128] f32
     l_scratch,  # [block_q, 128] f32
     acc_scratch,  # [block_q, d] f32
@@ -104,6 +105,10 @@ def _fwd_kernel(
         l = l_scratch[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+        # log-sum-exp per row — the backward's only softmax residual
+        lse_ref[0] = jnp.broadcast_to(
+            m_scratch[:, :1] + jnp.log(l), lse_ref.shape[1:]
+        )
 
 
 def _flash_fwd(
@@ -145,7 +150,7 @@ def _flash_fwd(
         block_q=block_q,
         block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -153,8 +158,14 @@ def _flash_fwd(
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda g, i, j: (g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -167,36 +178,107 @@ def _flash_fwd(
         ),
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse[:, :, 0].reshape(b, h, sq)  # [B, H, S]
+    return out, lse
 
 
-def _chunked_reference_attention(q, k, v, causal, scale, chunk=1024):
-    """O(S·chunk) attention used for the backward recompute."""
-    from dlrover_tpu.ops.attention import mha_reference
+def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk):
+    """True O(S·chunk) flash backward from saved (out, lse).
 
-    return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
+    Recomputes p = exp(s − lse) one key-chunk at a time (lax.scan), never
+    materialising the [S, S] attention matrix — the memory property the
+    reference's CUDA flash-attention backward has and a plain vjp through
+    a softmax attention lacks. GQA: kv heads are expanded for the compute
+    and group-summed for dk/dv.
+
+    Layout: [B, H, S, D] throughout; f32 accumulation.
+    """
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    groups = h // hkv
+    # GQA layout [B, Hkv, G, S, D]: K/V stay at hkv heads — expanding them
+    # by jnp.repeat would multiply KV memory by `groups` for the whole
+    # sequence, exactly the footprint flash attention exists to avoid
+    qt = (
+        q.transpose(0, 2, 1, 3)
+        .reshape(b, hkv, groups, sq, d)
+        .astype(jnp.float32)
+    )
+    gt = (
+        g.transpose(0, 2, 1, 3)
+        .reshape(b, hkv, groups, sq, d)
+        .astype(jnp.float32)
+    )
+    ot = (
+        out.transpose(0, 2, 1, 3)
+        .reshape(b, hkv, groups, sq, d)
+        .astype(jnp.float32)
+    )
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)   # [B,Hkv,Sk,D]
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    lse_g = lse.reshape(b, hkv, groups, sq)
+    delta = jnp.sum(gt * ot, axis=-1)                  # [B,Hkv,G,Sq]
+
+    chunk = min(chunk, sk)
+    n_chunks = sk // chunk
+    assert sk % chunk == 0
+    k_chunks = kt.reshape(b, hkv, n_chunks, chunk, d)
+    v_chunks = vt.reshape(b, hkv, n_chunks, chunk, d)
+    q_pos = jnp.arange(sq)
+
+    def body(dq_acc, idx):
+        kc = k_chunks[:, :, idx]                       # [B,Hkv,C,D]
+        vc = v_chunks[:, :, idx]
+        s = jnp.einsum("bkgqd,bkcd->bkgqc", qt, kc) * scale
+        if causal:
+            k_pos = idx * chunk + jnp.arange(chunk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None, None], s, NEG_INF)
+        p = jnp.exp(s - lse_g[..., None])              # [B,Hkv,G,Q,C]
+        dv_c = jnp.einsum("bkgqc,bkgqd->bkcd", p, gt)
+        dp = jnp.einsum("bkgqd,bkcd->bkgqc", gt, vc)
+        ds = p * (dp - delta[..., None]) * scale
+        dk_c = jnp.einsum("bkgqc,bkgqd->bkcd", ds, qt)
+        dq_acc = dq_acc + jnp.einsum("bkgqc,bkcd->bkgqd", ds, kc)
+        return dq_acc, (dk_c, dv_c)
+
+    dq, (dk_chunks, dv_chunks) = jax.lax.scan(
+        body, jnp.zeros_like(qt), jnp.arange(n_chunks)
+    )
+    # scan stacks on axis 0: [n_chunks, B, Hkv, C, D] → [B, Hkv, Sk, D]
+    dk = dk_chunks.transpose(1, 2, 0, 3, 4).reshape(b, hkv, sk, d)
+    dv = dv_chunks.transpose(1, 2, 0, 3, 4).reshape(b, hkv, sk, d)
+    dq = dq.reshape(b, h, sq, d)
+    return (
+        dq.transpose(0, 2, 1, 3).astype(q.dtype),
+        dk.transpose(0, 2, 1, 3).astype(k.dtype),
+        dv.transpose(0, 2, 1, 3).astype(v.dtype),
+    )
 
 
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
 def _flash_attention(q, k, v, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
 
 
 def _fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    # named so remat policies can pin the kernel residuals in memory and
+    # skip re-running the forward kernel in backward (decoder save_attn)
+    out = jax.ad_checkpoint.checkpoint_name(out, "flash_out")
+    lse = jax.ad_checkpoint.checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, out, lse)
 
 
 def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
-    q, k, v = residuals
-
-    def ref(q, k, v):
-        return _chunked_reference_attention(q, k, v, causal, scale)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _chunked_backward(
+        q, k, v, out, lse, g, causal, scale, chunk=block_k
+    )
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
@@ -217,8 +299,23 @@ def flash_attention(
     q: [B, S, H, D]; k/v: [B, S, Hkv, D] (GQA via fewer kv heads).
     """
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    if pltpu is None or jax.default_backend() == "cpu":
+    sq, sk = q.shape[1], k.shape[1]
+    bq = _fit_block(sq, block_q)
+    bk = _fit_block(sk, block_k)
+    if pltpu is None or jax.default_backend() == "cpu" or bq is None or (
+        bk is None
+    ):
+        # off-TPU, or seq not tileable to a lane-aligned block: plain jnp
+        # (the old auto behavior — never a trace-time crash)
         from dlrover_tpu.ops.attention import mha_reference
 
         return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
-    return _flash_attention(q, k, v, causal, scale, block_q, block_k)
+    return _flash_attention(q, k, v, causal, scale, bq, bk)
+
+
+def _fit_block(s: int, prefer: int):
+    """Largest 128-multiple block ≤ prefer that divides the sequence."""
+    for b in (prefer, 1024, 512, 256, 128):
+        if b <= prefer and b <= s and s % b == 0 and b % 128 == 0:
+            return b
+    return None

@@ -61,19 +61,32 @@ def init_train_state(
         # match them by TREE STRUCTURE, not leaf shape — same-shape params
         # can carry transposed shardings (wq ('embed','heads') vs wo
         # ('heads','embed')), and a shape-keyed lookup would pin their
-        # moments to the wrong one
+        # moments to the wrong one. Quantized states (QuantizedArray
+        # leaves, different shapes) are treated as leaves for the match
+        # and left as-is — they are 4-8x smaller, so the per-step reshard
+        # this guards against is proportionally cheap for them.
+        from dlrover_tpu.ops.quant import QuantizedArray
+
+        def is_q(x):
+            return isinstance(x, QuantizedArray)
+
         pdef = jax.tree.structure(params)
 
         def is_param_tree(x):
             try:
-                return jax.tree.structure(x) == pdef
+                return jax.tree.structure(x, is_leaf=is_q) == pdef
             except Exception:  # noqa: BLE001
                 return False
 
         def con(sub):
             if is_param_tree(sub):
                 return jax.tree.map(
-                    jax.lax.with_sharding_constraint, sub, param_shardings
+                    lambda leaf, s: leaf
+                    if is_q(leaf)
+                    else jax.lax.with_sharding_constraint(leaf, s),
+                    sub,
+                    param_shardings,
+                    is_leaf=is_q,
                 )
             return sub
 

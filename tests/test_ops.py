@@ -24,10 +24,12 @@ def test_flash_kernel_matches_reference(causal):
 
     q, k, v = _qkv(jax.random.key(0))
     scale = q.shape[-1] ** -0.5
-    out = _flash_fwd(
+    out, lse = _flash_fwd(
         q, k, v, causal, scale, block_q=128, block_k=128, interpret=True
     )
     ref = mha_reference(q, k, v, causal=causal, softmax_scale=scale)
+    assert lse.shape == (q.shape[0], q.shape[2], q.shape[1])
+    assert np.isfinite(np.asarray(lse)).all()
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
     )
@@ -38,7 +40,7 @@ def test_flash_kernel_gqa():
 
     q, k, v = _qkv(jax.random.key(1), h=8, hkv=2)
     scale = q.shape[-1] ** -0.5
-    out = _flash_fwd(
+    out, _ = _flash_fwd(
         q, k, v, True, scale, block_q=128, block_k=128, interpret=True
     )
     ref = mha_reference(q, k, v, causal=True, softmax_scale=scale)
@@ -199,3 +201,57 @@ def test_make_optimizer_wsam_and_int4():
         isinstance(leaf, QuantizedArray) and leaf.bits == 4
         for leaf in leaves
     )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_matches_reference(causal):
+    """The chunked flash backward (lse-based) must match autodiff through
+    the reference attention — without materializing [S, S]."""
+    from dlrover_tpu.ops.pallas_attention import (
+        _chunked_backward,
+        _flash_fwd,
+    )
+
+    q, k, v = _qkv(jax.random.key(2), b=2, s=256, h=4, d=64)
+    scale = q.shape[-1] ** -0.5
+    out, lse = _flash_fwd(
+        q, k, v, causal, scale, block_q=128, block_k=128, interpret=True
+    )
+    g = jax.random.normal(jax.random.key(3), out.shape, out.dtype)
+
+    dq, dk, dv = _chunked_backward(
+        q, k, v, out, lse, g, causal, scale, chunk=64
+    )
+
+    def ref(q, k, v):
+        return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    rdq, rdk, rdv = vjp(g)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=2e-3, atol=2e-3)
+
+
+def test_flash_backward_gqa():
+    from dlrover_tpu.ops.pallas_attention import (
+        _chunked_backward,
+        _flash_fwd,
+    )
+
+    q, k, v = _qkv(jax.random.key(4), b=2, s=128, h=8, hkv=2, d=32)
+    scale = q.shape[-1] ** -0.5
+    out, lse = _flash_fwd(
+        q, k, v, True, scale, block_q=128, block_k=128, interpret=True
+    )
+    g = jax.random.normal(jax.random.key(5), out.shape, out.dtype)
+    dq, dk, dv = _chunked_backward(q, k, v, out, lse, g, True, scale, chunk=64)
+
+    def ref(q, k, v):
+        return mha_reference(q, k, v, causal=True, softmax_scale=scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    rdq, rdk, rdv = vjp(g)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=2e-3, atol=2e-3)
