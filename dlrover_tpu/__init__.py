@@ -22,3 +22,17 @@ Layer map (bottom-up), mirroring the reference's structure
 """
 
 __version__ = "0.1.0"
+
+# Workers launched by the elastic agent get a SIGUSR2 py-stack dumper so
+# the agent's StackCollector can diagnose hangs (env set by the agent;
+# see agent/collectors.py StackCollector).
+import os as _os
+
+if _os.environ.get("DLROVER_TPU_STACK_DUMP") == "1":
+    try:
+        from dlrover_tpu.agent.collectors import StackCollector
+
+        StackCollector.install_in_worker()
+    except Exception:  # noqa: BLE001 — diagnosis must never break startup
+        pass
+del _os

@@ -75,6 +75,8 @@ class WorkerProcess:
         self._cmd = cmd
         full_env = dict(os.environ)
         full_env.update(env)
+        # SIGUSR2 py-stack dumper for hang diagnosis (collectors.py)
+        full_env.setdefault("DLROVER_TPU_STACK_DUMP", "1")
         self._tail: "deque[str]" = deque(maxlen=200)
         self._proc = subprocess.Popen(
             cmd, env=full_env, stderr=subprocess.PIPE, text=True

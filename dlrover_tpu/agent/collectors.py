@@ -1,0 +1,198 @@
+"""Agent-side diagnosis data collectors.
+
+Reference: dlrover/python/elastic_agent/diagnosis/datacollector/*.py —
+pluggable collectors the agent runs when the master requests diagnosis
+data (worker logs, runtime metrics, stuck-process stack dumps), plus
+monitor/diagnosis.py which periodically ships them.
+
+TPU twist for stack dumps: workers launched by our agent install a
+``faulthandler`` SIGUSR2 handler writing python thread stacks to a
+per-pid file (see agent.WorkerProcess), so the agent can obtain a
+py-level stack of a hung worker without ptrace or py-spy.
+"""
+
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+STACK_DIR = "/tmp/dlrover_tpu_stacks"
+
+
+@dataclass
+class DiagnosisData:
+    data_type: str
+    content: str
+    timestamp: float = field(default_factory=time.time)
+
+
+class DataCollector:
+    """Base: collect() returns DiagnosisData or None."""
+
+    data_type = "base"
+
+    def collect(self) -> Optional[DiagnosisData]:
+        raise NotImplementedError
+
+    def is_enabled(self) -> bool:
+        return True
+
+
+class LogCollector(DataCollector):
+    """Tail of a worker's log file (reference: training_log_collector)."""
+
+    data_type = "training_log"
+
+    def __init__(self, log_path: str, max_lines: int = 200):
+        self.log_path = log_path
+        self.max_lines = max_lines
+
+    def is_enabled(self) -> bool:
+        return bool(self.log_path) and os.path.exists(self.log_path)
+
+    def collect(self) -> Optional[DiagnosisData]:
+        if not self.is_enabled():
+            return None
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - (1 << 20)))
+                lines = f.read().decode("utf-8", "replace").splitlines()
+        except OSError:
+            return None
+        return DiagnosisData(
+            self.data_type, "\n".join(lines[-self.max_lines :])
+        )
+
+
+class ProcStateCollector(DataCollector):
+    """Kernel-side view of a worker process: state, wchan, threads, fds.
+
+    A D-state worker with wchan in a TPU driver call vs an S-state worker
+    idle in a collective tells the master which failure branch to take.
+    """
+
+    data_type = "proc_state"
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def is_enabled(self) -> bool:
+        return os.path.exists(f"/proc/{self.pid}")
+
+    def collect(self) -> Optional[DiagnosisData]:
+        if not self.is_enabled():
+            return None
+        out: Dict[str, str] = {"pid": str(self.pid)}
+        try:
+            with open(f"/proc/{self.pid}/status") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    if k in ("State", "Threads", "VmRSS", "VmSwap"):
+                        out[k] = v.strip()
+            try:
+                with open(f"/proc/{self.pid}/wchan") as f:
+                    out["wchan"] = f.read().strip()
+            except OSError:
+                pass
+            out["fds"] = str(len(os.listdir(f"/proc/{self.pid}/fd")))
+        except OSError:
+            return None
+        content = "\n".join(f"{k}: {v}" for k, v in out.items())
+        return DiagnosisData(self.data_type, content)
+
+
+class StackCollector(DataCollector):
+    """Python thread stacks of a (hung) worker via the faulthandler
+    protocol: SIGUSR2 → worker dumps to ``STACK_DIR/<pid>.stack``.
+
+    Reference analog: cuda_log_collector / the xpu stack trace dump —
+    here the py stack is the useful layer (XLA dispatch happens in C++,
+    but the hang is almost always visible at the python call site).
+    """
+
+    data_type = "py_stack"
+
+    def __init__(self, pid: int, timeout: float = 5.0):
+        self.pid = pid
+        self.timeout = timeout
+
+    @staticmethod
+    def stack_path(pid: int) -> str:
+        return os.path.join(STACK_DIR, f"{pid}.stack")
+
+    @staticmethod
+    def install_in_worker():
+        """Call inside a worker process (the launcher does this): dump
+        thread stacks to the per-pid file on SIGUSR2."""
+        import faulthandler
+
+        os.makedirs(STACK_DIR, exist_ok=True)
+        path = StackCollector.stack_path(os.getpid())
+        f = open(path, "w")  # noqa: SIM115 — handle must outlive the call
+        faulthandler.register(signal.SIGUSR2, file=f, all_threads=True)
+
+    def is_enabled(self) -> bool:
+        return os.path.exists(f"/proc/{self.pid}")
+
+    def collect(self) -> Optional[DiagnosisData]:
+        path = self.stack_path(self.pid)
+        try:
+            before = os.path.getsize(path) if os.path.exists(path) else 0
+            os.kill(self.pid, signal.SIGUSR2)
+        except (ProcessLookupError, PermissionError):
+            return None
+        deadline = time.time() + self.timeout
+        while time.time() < deadline:
+            if os.path.exists(path) and os.path.getsize(path) > before:
+                time.sleep(0.1)  # let the dump finish
+                with open(path) as f:
+                    f.seek(before)
+                    return DiagnosisData(self.data_type, f.read())
+            time.sleep(0.05)
+        return None
+
+
+class CollectorRunner:
+    """Runs all enabled collectors, reports via the master client."""
+
+    def __init__(self, master_client=None):
+        self.collectors: List[DataCollector] = []
+        self._client = master_client
+
+    def register(self, collector: DataCollector):
+        self.collectors.append(collector)
+
+    def collect_all(self) -> List[DiagnosisData]:
+        out = []
+        for c in self.collectors:
+            try:
+                if not c.is_enabled():
+                    continue
+                data = c.collect()
+                if data is not None:
+                    out.append(data)
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "collector %s failed", c.data_type, exc_info=True
+                )
+        return out
+
+    def report(self) -> int:
+        data = self.collect_all()
+        if self._client is None:
+            return len(data)
+        for d in data:
+            try:
+                self._client.report_failure(
+                    f"[{d.data_type}] {d.content[:4000]}", level="diagnosis"
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning("diagnosis report failed", exc_info=True)
+        return len(data)

@@ -275,6 +275,32 @@ class MasterClient:
 
     # ---- runtime config --------------------------------------------------
 
+    def bump_ps_version(self) -> bool:
+        """Announce a sparse-tier membership change (reference:
+        elastic_ps.py update cluster version)."""
+        return self._t.report(
+            msgs.PsVersionReport(node_id=self.node_id, version_type="global")
+        )
+
+    def report_ps_node_version(self, version: int) -> bool:
+        return self._t.report(
+            msgs.PsVersionReport(
+                node_id=self.node_id,
+                version_type="node",
+                version=version,
+            )
+        )
+
+    def get_ps_version(
+        self, version_type: str = "global"
+    ) -> msgs.PsVersionResponse:
+        resp = self._t.get(
+            msgs.PsVersionRequest(
+                node_id=self.node_id, version_type=version_type
+            )
+        )
+        return resp or msgs.PsVersionResponse()
+
     def get_parallel_config(self) -> msgs.ParallelConfig:
         resp = self._t.get(msgs.ParallelConfigRequest(node_id=self.node_id))
         return resp or msgs.ParallelConfig()

@@ -382,3 +382,29 @@ class ParallelConfig:
 @message
 class ParallelConfigRequest:
     node_id: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Sparse-tier (PS) cluster versioning (reference: elastic_ps.py)
+# ---------------------------------------------------------------------------
+
+
+@message
+class PsVersionReport:
+    """Bump (global) or set (node) a sparse cluster version."""
+
+    node_id: int = 0
+    version_type: str = "global"   # global | node
+    version: int = 0               # node type: the version to record
+
+
+@message
+class PsVersionRequest:
+    node_id: int = 0
+    version_type: str = "global"
+
+
+@message
+class PsVersionResponse:
+    version: int = 0
+    servers: tuple = ()

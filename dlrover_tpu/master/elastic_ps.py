@@ -1,0 +1,74 @@
+"""Elastic sparse-tier (PS) cluster-version bookkeeping.
+
+Reference: dlrover/python/master/elastic_training/elastic_ps.py:18
+(ElasticPsService) + agent side elastic_agent/tensorflow/elastic_ps.py —
+the master keeps a monotonically increasing "cluster version" for the
+parameter-server set; when PS membership changes (scale-out/in,
+migration), the version bumps and workers rebuild their sessions.
+
+TPU framing: the "PS set" is the group of hosts serving sparse embedding
+shards (the C++ KV tier, sparse/kv_table.py). On membership change the
+master bumps the version; workers poll it and re-partition their
+key→host assignment with ``sparse.partition`` (rendezvous hashing, so
+only keys owned by the changed hosts migrate).
+"""
+
+import threading
+from typing import Dict, List
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class ElasticPsService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._global_version = 0
+        # node_id -> restored/local version (reference keeps per-worker
+        # versions so late joiners can detect they are behind)
+        self._node_versions: Dict[int, int] = {}
+        self._servers: List[str] = []
+
+    # ---- versions (reference API surface) --------------------------------
+
+    def get_global_version(self) -> int:
+        with self._lock:
+            return self._global_version
+
+    def bump_global_version(self) -> int:
+        with self._lock:
+            self._global_version += 1
+            logger.info(
+                "sparse cluster version → %d", self._global_version
+            )
+            return self._global_version
+
+    def get_node_version(self, node_id: int) -> int:
+        with self._lock:
+            return self._node_versions.get(node_id, 0)
+
+    def set_node_version(self, node_id: int, version: int):
+        with self._lock:
+            self._node_versions[node_id] = version
+
+    # ---- server-set bookkeeping ------------------------------------------
+
+    def get_servers(self) -> List[str]:
+        with self._lock:
+            return list(self._servers)
+
+    def set_servers(self, servers: List[str]) -> int:
+        """Replace the sparse-serving host set; bumps the version when
+        membership actually changed."""
+        with self._lock:
+            if servers == self._servers:
+                return self._global_version
+            self._servers = list(servers)
+            self._global_version += 1
+            logger.info(
+                "sparse server set changed (%d hosts) → version %d",
+                len(servers),
+                self._global_version,
+            )
+            return self._global_version

@@ -80,6 +80,9 @@ class JobMaster:
         self.diagnosis_manager = DiagnosisManager()
         self.metric_collector = JobMetricCollector()
         self.metrics_server = MetricsHTTPServer(self.metric_collector, port=0)
+        from dlrover_tpu.master.elastic_ps import ElasticPsService
+
+        self.ps_service = ElasticPsService()
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
             task_manager=self.task_manager,
@@ -88,6 +91,7 @@ class JobMaster:
             sync_service=self.sync_service,
             speed_monitor=self.speed_monitor,
             diagnosis_manager=self.diagnosis_manager,
+            ps_service=self.ps_service,
         )
         self.server = MasterTransportServer(self.servicer, port=port)
 

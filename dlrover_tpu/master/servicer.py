@@ -25,6 +25,7 @@ class MasterServicer:
         sync_service=None,
         speed_monitor=None,
         diagnosis_manager=None,
+        ps_service=None,
     ):
         self.job_manager = job_manager
         self.task_manager = task_manager
@@ -33,6 +34,7 @@ class MasterServicer:
         self.sync_service = sync_service
         self.speed_monitor = speed_monitor
         self.diagnosis_manager = diagnosis_manager
+        self.ps_service = ps_service
         self._ckpt_steps = {}  # node_rank -> step (flash-ckpt rank sync)
 
     # ---- report: fire-and-forget ----------------------------------------
@@ -152,7 +154,17 @@ class MasterServicer:
             self.task_manager.restore_checkpoint(m.dataset_name, m.content)
         return True
 
+    def _report_ps_version(self, m: msgs.PsVersionReport) -> bool:
+        if not self.ps_service:
+            return False
+        if m.version_type == "global":
+            self.ps_service.bump_global_version()
+        else:
+            self.ps_service.set_node_version(m.node_id, m.version)
+        return True
+
     _REPORT_HANDLERS = {
+        "PsVersionReport": _report_ps_version,
         "HeartbeatReport": _report_heartbeat,
         "NodeStatusReport": _report_node_status,
         "NodeFailureReport": _report_node_failure,
@@ -286,7 +298,19 @@ class MasterServicer:
         cfg = node.paral_config if node else {}
         return msgs.ParallelConfig(**cfg) if cfg else msgs.ParallelConfig()
 
+    def _get_ps_version(self, m: msgs.PsVersionRequest):
+        if not self.ps_service:
+            return msgs.PsVersionResponse()
+        if m.version_type == "global":
+            version = self.ps_service.get_global_version()
+        else:
+            version = self.ps_service.get_node_version(m.node_id)
+        return msgs.PsVersionResponse(
+            version=version, servers=tuple(self.ps_service.get_servers())
+        )
+
     _GET_HANDLERS = {
+        "PsVersionRequest": _get_ps_version,
         "HeartbeatReport": _get_heartbeat,
         "NodeRegisterRequest": _get_register,
         "JoinRendezvousRequest": _get_join_rdzv,

@@ -213,11 +213,7 @@ def _mlp_block(x, layer, cfg: ModelConfig, mesh):
 def _layer_body(x, layer, positions, cfg: ModelConfig, mesh, attn_fn, rng=None):
     ln1, ln2 = layer["ln1"], layer["ln2"]
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
-    attn_out = jax.ad_checkpoint.checkpoint_name(
-        _attention_block(h, layer, cfg, mesh, positions, attn_fn),
-        "attn_out",
-    )
-    x = x + attn_out
+    x = x + _attention_block(h, layer, cfg, mesh, positions, attn_fn)
     h = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
     aux = {
         "moe_lb_loss": jnp.zeros([], jnp.float32),
@@ -299,15 +295,12 @@ def forward(
     elif cfg.remat == "dots_saveable":
         body = jax.checkpoint(body, policy=cp.dots_saveable)
     elif cfg.remat == "save_attn":
-        # keep the tagged attention-block outputs AND the flash kernel's
-        # custom_vjp residuals (out, lse) — so backward recomputes the
-        # cheap MLP/norm/projection math but never re-runs the attention
-        # kernel itself
+        # pin only the flash kernel's custom_vjp residuals (out, lse):
+        # backward recomputes the cheap MLP/norm/projection math but
+        # never re-runs the attention kernel itself
         body = jax.checkpoint(
             body,
-            policy=cp.save_only_these_names(
-                "attn_out", "flash_out", "flash_lse"
-            ),
+            policy=cp.save_only_these_names("flash_out", "flash_lse"),
         )
 
     zero_aux = {

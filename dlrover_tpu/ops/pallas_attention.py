@@ -302,15 +302,28 @@ def flash_attention(
     sq, sk = q.shape[1], k.shape[1]
     bq = _fit_block(sq, block_q)
     bk = _fit_block(sk, block_k)
-    if pltpu is None or jax.default_backend() == "cpu" or bq is None or (
-        bk is None
-    ):
-        # off-TPU, or seq not tileable to a lane-aligned block: plain jnp
-        # (the old auto behavior — never a trace-time crash)
+    if pltpu is None or not _on_tpu() or bq is None or bk is None:
+        # off-TPU (incl. GPU — this is a Mosaic-TPU kernel), or seq not
+        # tileable to a lane-aligned block: plain jnp, never a trace-time
+        # crash
         from dlrover_tpu.ops.attention import mha_reference
 
         return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
     return _flash_attention(q, k, v, causal, scale, bq, bk)
+
+
+def _on_tpu() -> bool:
+    """True for real TPU backends AND TPU relays whose platform name
+    differs (the axon tunnel reports platform 'axon', device_kind
+    'TPU v5 lite')."""
+    try:
+        d = jax.devices()[0]
+    except RuntimeError:
+        return False
+    return (
+        d.platform.lower() == "tpu"
+        or "tpu" in getattr(d, "device_kind", "").lower()
+    )
 
 
 def _fit_block(s: int, prefer: int):

@@ -1,0 +1,82 @@
+"""Key→server partitioning for the distributed sparse tier.
+
+Reference behavior: TFPlus shards KvVariables over PS tasks by key hash;
+on PS migration dlrover rebuilds TF_CONFIG and the whole session
+(tensorflow_failover.py). Here partitioning uses **rendezvous (HRW)
+hashing**, so a membership change only moves the keys owned by the
+added/removed servers (~K/n keys instead of a full reshuffle) — the
+elastic property the modulo hash lacks.
+"""
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_M1 = np.uint64(0xFF51AFD7ED558CCD)
+_M2 = np.uint64(0xC4CEB9FE1A85EC53)
+
+
+def _mix(x: np.ndarray) -> np.ndarray:
+    # splitmix64-style finalizer, vectorized
+    with np.errstate(over="ignore"):
+        x = x.astype(np.uint64)
+        x ^= x >> np.uint64(33)
+        x *= _M1
+        x ^= x >> np.uint64(33)
+        x *= _M2
+        x ^= x >> np.uint64(33)
+    return x
+
+
+def _server_seed(server: str) -> np.uint64:
+    h = np.uint64(1469598103934665603)  # FNV offset
+    with np.errstate(over="ignore"):
+        for b in server.encode("utf-8"):
+            h ^= np.uint64(b)
+            h *= np.uint64(1099511628211)
+    return h
+
+
+def assign_servers(
+    keys: Sequence[int], servers: List[str]
+) -> np.ndarray:
+    """HRW: each key goes to the server with max mix(key ^ seed(server)).
+
+    Returns the server INDEX per key (into ``servers``).
+    """
+    if not servers:
+        raise ValueError("no sparse servers")
+    k = np.asarray(keys, dtype=np.int64).astype(np.uint64)
+    scores = np.stack(
+        [_mix(k ^ _server_seed(s)) for s in servers]
+    )  # [n_servers, n_keys]
+    return np.argmax(scores, axis=0)
+
+
+def partition_keys(
+    keys: Sequence[int], servers: List[str]
+) -> Dict[str, np.ndarray]:
+    """{server: its keys} — the shape lookups/updates fan out with."""
+    k = np.asarray(keys, dtype=np.int64)
+    owner = assign_servers(k, servers)
+    return {s: k[owner == i] for i, s in enumerate(servers)}
+
+
+def migration_plan(
+    keys: Sequence[int],
+    old_servers: List[str],
+    new_servers: List[str],
+) -> List[Tuple[int, str, str]]:
+    """Keys whose owner changes, as (key, from_server, to_server).
+
+    With HRW, only keys owned by removed servers (or won by added ones)
+    appear here — the bounded-migration property.
+    """
+    k = np.asarray(keys, dtype=np.int64)
+    old_own = assign_servers(k, old_servers)
+    new_own = assign_servers(k, new_servers)
+    moves = []
+    for key, oi, ni in zip(k.tolist(), old_own, new_own):
+        if old_servers[oi] != new_servers[ni]:
+            moves.append((key, old_servers[oi], new_servers[ni]))
+    return moves

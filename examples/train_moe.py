@@ -41,8 +41,12 @@ def main():
     args = p.parse_args()
 
     n_dev = jax.device_count()
-    # ep must divide the device count: largest divisor ≤ n_experts
-    ep = max(d for d in range(1, n_dev + 1) if n_dev % d == 0 and d <= args.experts)
+    # ep must divide both the device count and the expert count
+    ep = max(
+        d
+        for d in range(1, n_dev + 1)
+        if n_dev % d == 0 and args.experts % d == 0
+    )
     mesh = build_mesh(MeshConfig(dp=n_dev // ep, ep=ep))
     cfg = get_config(
         "tiny-moe",

@@ -1,0 +1,84 @@
+"""Agent diagnosis collector tests.
+
+Reference behaviors: elastic_agent/diagnosis/datacollector — logs,
+process state, stuck-worker stack dumps.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.agent.collectors import (
+    CollectorRunner,
+    LogCollector,
+    ProcStateCollector,
+    StackCollector,
+)
+
+
+def test_log_collector_tails(tmp_path):
+    log = tmp_path / "worker.log"
+    log.write_text("\n".join(f"line {i}" for i in range(500)))
+    c = LogCollector(str(log), max_lines=100)
+    data = c.collect()
+    lines = data.content.splitlines()
+    assert len(lines) == 100
+    assert lines[-1] == "line 499"
+
+
+def test_log_collector_missing_file():
+    c = LogCollector("/nonexistent/x.log")
+    assert not c.is_enabled()
+    assert c.collect() is None
+
+
+def test_proc_state_collector_self():
+    c = ProcStateCollector(os.getpid())
+    data = c.collect()
+    assert data is not None
+    assert "State" in data.content and "Threads" in data.content
+
+
+def test_proc_state_collector_dead_pid():
+    assert ProcStateCollector(2**22 - 1).collect() is None
+
+
+def test_stack_collector_dumps_child_stacks(tmp_path):
+    """End-to-end: child installs the SIGUSR2 handler (as agent-launched
+    workers do), parent collects a py-level stack while it hangs."""
+    code = (
+        "from dlrover_tpu.agent.collectors import StackCollector\n"
+        "import time\n"
+        "StackCollector.install_in_worker()\n"
+        "def obvious_hang_marker():\n"
+        "    time.sleep(60)\n"
+        "obvious_hang_marker()\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        env={**os.environ, "PYTHONPATH": os.getcwd()},
+    )
+    try:
+        time.sleep(2.0)  # let the handler install
+        c = StackCollector(proc.pid, timeout=10.0)
+        data = c.collect()
+        assert data is not None
+        assert "obvious_hang_marker" in data.content
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_runner_skips_disabled_and_collects_rest(tmp_path):
+    log = tmp_path / "a.log"
+    log.write_text("hello\n")
+    runner = CollectorRunner()
+    runner.register(LogCollector(str(log)))
+    runner.register(LogCollector("/nonexistent.log"))
+    runner.register(ProcStateCollector(os.getpid()))
+    out = runner.collect_all()
+    types = {d.data_type for d in out}
+    assert types == {"training_log", "proc_state"}

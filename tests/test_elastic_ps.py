@@ -1,0 +1,109 @@
+"""Sparse-tier elasticity: PS cluster versions + HRW key partitioning.
+
+Reference behaviors: elastic_ps.py (ElasticPsService version bookkeeping)
+and the PS-migration failover path (tensorflow_failover.py) — here the
+re-partition story is rendezvous hashing with bounded key migration.
+"""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.master.elastic_ps import ElasticPsService
+from dlrover_tpu.sparse.partition import (
+    assign_servers,
+    migration_plan,
+    partition_keys,
+)
+
+
+def test_versions_bump_and_track_nodes():
+    svc = ElasticPsService()
+    assert svc.get_global_version() == 0
+    assert svc.bump_global_version() == 1
+    svc.set_node_version(3, 1)
+    assert svc.get_node_version(3) == 1
+    assert svc.get_node_version(4) == 0
+
+
+def test_server_set_change_bumps_version():
+    svc = ElasticPsService()
+    v1 = svc.set_servers(["h0:70", "h1:70"])
+    assert v1 == 1
+    # same set: no bump
+    assert svc.set_servers(["h0:70", "h1:70"]) == 1
+    assert svc.set_servers(["h0:70", "h1:70", "h2:70"]) == 2
+    assert svc.get_servers() == ["h0:70", "h1:70", "h2:70"]
+
+
+def test_assignment_deterministic_and_balanced():
+    servers = [f"host{i}:7000" for i in range(4)]
+    keys = np.arange(40000)
+    owner1 = assign_servers(keys, servers)
+    owner2 = assign_servers(keys, servers)
+    np.testing.assert_array_equal(owner1, owner2)
+    counts = np.bincount(owner1, minlength=4)
+    # HRW balance: each server within ±20% of fair share
+    assert (np.abs(counts - 10000) < 2000).all(), counts
+
+
+def test_partition_keys_cover_all():
+    servers = ["a", "b", "c"]
+    keys = np.arange(999)
+    parts = partition_keys(keys, servers)
+    total = np.concatenate(list(parts.values()))
+    assert sorted(total.tolist()) == keys.tolist()
+
+
+def test_bounded_migration_on_server_removal():
+    servers = [f"h{i}" for i in range(5)]
+    keys = np.arange(20000)
+    owner = assign_servers(keys, servers)
+    removed = "h2"
+    survivors = [s for s in servers if s != removed]
+    moves = migration_plan(keys, servers, survivors)
+    # ONLY keys owned by the removed server move (HRW property)
+    removed_keys = set(keys[owner == 2].tolist())
+    assert {m[0] for m in moves} == removed_keys
+    for _, src, dst in moves:
+        assert src == removed and dst != removed
+
+
+def test_bounded_migration_on_server_addition():
+    servers = [f"h{i}" for i in range(4)]
+    keys = np.arange(20000)
+    grown = servers + ["h_new"]
+    moves = migration_plan(keys, servers, grown)
+    # every move lands on the new server; ~1/5 of keys move
+    assert all(dst == "h_new" for _, _, dst in moves)
+    assert 0.1 < len(moves) / len(keys) < 0.3
+
+
+def test_empty_server_list_raises():
+    with pytest.raises(ValueError):
+        assign_servers([1, 2], [])
+
+
+def test_ps_version_rpc_roundtrip():
+    """Through the real servicer dispatch (in-process master fixture)."""
+    from dlrover_tpu.common import messages as msgs
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    svc = ElasticPsService()
+    servicer = MasterServicer(ps_service=svc)
+    svc.set_servers(["h0", "h1"])
+    assert servicer.report(
+        msgs.PsVersionReport(node_id=0, version_type="global")
+    )
+    resp = servicer.get(
+        msgs.PsVersionRequest(node_id=0, version_type="global")
+    )
+    assert resp.version == 2  # set_servers bumped once, report again
+    assert resp.servers == ("h0", "h1")
+    # node-level
+    servicer.report(
+        msgs.PsVersionReport(node_id=7, version_type="node", version=2)
+    )
+    resp2 = servicer.get(
+        msgs.PsVersionRequest(node_id=7, version_type="node")
+    )
+    assert resp2.version == 2
