@@ -101,7 +101,12 @@ class ProcStateCollector(DataCollector):
                     out["wchan"] = f.read().strip()
             except OSError:
                 pass
-            out["fds"] = str(len(os.listdir(f"/proc/{self.pid}/fd")))
+            try:
+                # fd dir is owner/root-only; its failure must not discard
+                # the State/Threads/VmRSS already gathered above
+                out["fds"] = str(len(os.listdir(f"/proc/{self.pid}/fd")))
+            except OSError:
+                pass
         except OSError:
             return None
         content = "\n".join(f"{k}: {v}" for k, v in out.items())

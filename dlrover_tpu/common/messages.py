@@ -407,4 +407,4 @@ class PsVersionRequest:
 @message
 class PsVersionResponse:
     version: int = 0
-    servers: tuple = ()
+    servers: List[str] = field(default_factory=list)

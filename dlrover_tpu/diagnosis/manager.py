@@ -77,6 +77,7 @@ class DiagnosisManager:
         self._lock = threading.Lock()
         self.failures: Deque[FailureRecord] = deque(maxlen=window)
         self.resource_history: Dict[int, Deque] = {}
+        self.diagnosis_data: Dict[int, Deque] = {}
         self._hang_cpu_percent = hang_cpu_percent
         self._window = window
         # node_id → actions queued for that node's next heartbeat
@@ -113,6 +114,15 @@ class DiagnosisManager:
             action,
         )
         return rec
+
+    def collect_diagnosis_data(self, node_id: int, content: str):
+        """Store agent collector payloads (log tails, stacks, proc state)
+        as evidence for later diagnosis — no failure side-effects."""
+        with self._lock:
+            hist = self.diagnosis_data.setdefault(
+                node_id, deque(maxlen=32)
+            )
+            hist.append({"t": time.time(), "content": content[:8000]})
 
     def collect_resource(self, msg):
         with self._lock:

@@ -70,6 +70,22 @@ class MasterServicer:
         return True
 
     def _report_node_failure(self, m: msgs.NodeFailureReport) -> bool:
+        if m.level == "diagnosis":
+            # routine diagnosis payloads (log tails, proc state, stack
+            # dumps from agent collectors) are evidence, NOT failures:
+            # no task re-queue, no failure classification — a healthy
+            # worker whose log merely contains an old error string must
+            # not trigger recovery actions
+            if self.diagnosis_manager:
+                self.diagnosis_manager.collect_diagnosis_data(
+                    m.node_id, m.error_data
+                )
+            logger.info(
+                "diagnosis data from node %d: %s",
+                m.node_id,
+                m.error_data[:200],
+            )
+            return True
         if self.diagnosis_manager:
             rec = self.diagnosis_manager.collect_failure(m)
             # an abort is a job-level verdict — every node must stop, not
@@ -306,7 +322,7 @@ class MasterServicer:
         else:
             version = self.ps_service.get_node_version(m.node_id)
         return msgs.PsVersionResponse(
-            version=version, servers=tuple(self.ps_service.get_servers())
+            version=version, servers=list(self.ps_service.get_servers())
         )
 
     _GET_HANDLERS = {

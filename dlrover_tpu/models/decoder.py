@@ -210,10 +210,24 @@ def _mlp_block(x, layer, cfg: ModelConfig, mesh):
     return h @ mlp["w_down"].astype(x.dtype)
 
 
-def _layer_body(x, layer, positions, cfg: ModelConfig, mesh, attn_fn, rng=None):
+def _layer_body(
+    x,
+    layer,
+    positions,
+    cfg: ModelConfig,
+    mesh,
+    attn_fn,
+    rng=None,
+    tag_attn_out: bool = False,
+):
     ln1, ln2 = layer["ln1"], layer["ln2"]
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
-    x = x + _attention_block(h, layer, cfg, mesh, positions, attn_fn)
+    attn = _attention_block(h, layer, cfg, mesh, positions, attn_fn)
+    if tag_attn_out:
+        # non-flash attention tags no flash_out/flash_lse, so save_attn
+        # would otherwise pin nothing and recompute O(S²) attention
+        attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
+    x = x + attn
     h = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
     aux = {
         "moe_lb_loss": jnp.zeros([], jnp.float32),
@@ -288,19 +302,26 @@ def forward(
         return flash_attention(q, k, v, causal=True)
 
     body = functools.partial(
-        _layer_body, cfg=cfg, mesh=mesh, attn_fn=attn_fn
+        _layer_body,
+        cfg=cfg,
+        mesh=mesh,
+        attn_fn=attn_fn,
+        tag_attn_out=(attn_impl != "flash"),
     )
     if cfg.remat == "full":
         body = jax.checkpoint(body)
     elif cfg.remat == "dots_saveable":
         body = jax.checkpoint(body, policy=cp.dots_saveable)
     elif cfg.remat == "save_attn":
-        # pin only the flash kernel's custom_vjp residuals (out, lse):
-        # backward recomputes the cheap MLP/norm/projection math but
-        # never re-runs the attention kernel itself
+        # pin the attention results so backward recomputes only the cheap
+        # MLP/norm/projection math: on the flash path the kernel's
+        # custom_vjp residuals (flash_out/flash_lse); on the reference
+        # path the tagged block output (attn_out) — never both
         body = jax.checkpoint(
             body,
-            policy=cp.save_only_these_names("flash_out", "flash_lse"),
+            policy=cp.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse"
+            ),
         )
 
     zero_aux = {

@@ -73,10 +73,9 @@ def migration_plan(
     appear here — the bounded-migration property.
     """
     k = np.asarray(keys, dtype=np.int64)
-    old_own = assign_servers(k, old_servers)
-    new_own = assign_servers(k, new_servers)
-    moves = []
-    for key, oi, ni in zip(k.tolist(), old_own, new_own):
-        if old_servers[oi] != new_servers[ni]:
-            moves.append((key, old_servers[oi], new_servers[ni]))
-    return moves
+    old_names = np.asarray(old_servers)[assign_servers(k, old_servers)]
+    new_names = np.asarray(new_servers)[assign_servers(k, new_servers)]
+    moved = np.nonzero(old_names != new_names)[0]
+    return [
+        (int(k[i]), str(old_names[i]), str(new_names[i])) for i in moved
+    ]

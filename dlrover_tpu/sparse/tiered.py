@@ -1,0 +1,222 @@
+"""Hybrid (multi-tier) embedding storage.
+
+Reference: tfplus/tfplus/kv_variable/hybrid_embedding — TableManager
+(table_manager.h:45) over a hot in-memory table and a pluggable storage
+interface (storage_table.h:74, storage_config.proto); the shipped impl is
+the memory tier with the interface ready for colder backends.
+
+Here: ``TieredTable`` = hot C++ KvTable (sparse/kv_table.py) + a cold
+tier behind the same narrow interface. Cold keys (stale by timestamp or
+below a frequency floor) are demoted out of RAM; a lookup that misses hot
+faults the rows back in (with their frequency/timestamp history). The
+shipped cold tier is an npz-file store; anything with
+put/get/delete/keys (e.g. an object store) slots in.
+"""
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.sparse.kv_table import KvTable
+
+logger = get_logger(__name__)
+
+
+class ColdStore:
+    """Pluggable cold-tier interface (reference: StorageTable)."""
+
+    def put(self, keys, values, freqs, ts) -> None:
+        raise NotImplementedError
+
+    def get(self, keys) -> Tuple[np.ndarray, ...]:
+        """Returns (found_mask, values, freqs, ts) aligned with keys."""
+        raise NotImplementedError
+
+    def delete(self, keys) -> None:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class FileColdStore(ColdStore):
+    """npz-backed cold tier: one directory, periodically compacted."""
+
+    def __init__(self, path: str, width: int, flush_every: int = 1):
+        """``flush_every``: serialize to disk every N mutations (each
+        flush rewrites the whole store — raise this for large cold tiers
+        and call flush() at checkpoint boundaries)."""
+        self.path = path
+        self.width = width
+        self.flush_every = max(1, flush_every)
+        self._mutations = 0
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.Lock()
+        # in-process index over the on-disk rows
+        self._rows: Dict[int, Tuple[np.ndarray, int, int]] = {}
+        self._load()
+
+    def _file(self) -> str:
+        return os.path.join(self.path, "cold.npz")
+
+    def _load(self):
+        f = self._file()
+        if not os.path.exists(f):
+            return
+        with np.load(f) as z:
+            for key, row, fr, t in zip(
+                z["keys"], z["values"], z["freqs"], z["ts"]
+            ):
+                self._rows[int(key)] = (row, int(fr), int(t))
+
+    def _flush(self):
+        keys = np.array(sorted(self._rows), dtype=np.int64)
+        values = np.stack(
+            [self._rows[int(k)][0] for k in keys]
+        ) if len(keys) else np.empty((0, self.width), np.float32)
+        freqs = np.array(
+            [self._rows[int(k)][1] for k in keys], dtype=np.uint32
+        )
+        ts = np.array([self._rows[int(k)][2] for k in keys], dtype=np.uint32)
+        # name must end in .npz or savez appends the suffix itself
+        tmp = os.path.join(self.path, "cold_tmp.npz")
+        np.savez(tmp, keys=keys, values=values, freqs=freqs, ts=ts)
+        os.replace(tmp, self._file())
+
+    def _maybe_flush(self):
+        self._mutations += 1
+        if self._mutations >= self.flush_every:
+            self._flush()
+            self._mutations = 0
+
+    def flush(self):
+        with self._lock:
+            self._flush()
+            self._mutations = 0
+
+    def put(self, keys, values, freqs, ts) -> None:
+        with self._lock:
+            for k, row, fr, t in zip(keys, values, freqs, ts):
+                self._rows[int(k)] = (
+                    np.asarray(row, np.float32),
+                    int(fr),
+                    int(t),
+                )
+            self._maybe_flush()
+
+    def get(self, keys):
+        keys = np.asarray(keys, np.int64)
+        found = np.zeros(keys.size, bool)
+        values = np.zeros((keys.size, self.width), np.float32)
+        freqs = np.zeros(keys.size, np.uint32)
+        ts = np.zeros(keys.size, np.uint32)
+        with self._lock:
+            for i, k in enumerate(keys.tolist()):
+                hit = self._rows.get(k)
+                if hit is not None:
+                    found[i] = True
+                    values[i], freqs[i], ts[i] = hit
+        return found, values, freqs, ts
+
+    def delete(self, keys) -> None:
+        with self._lock:
+            for k in np.asarray(keys, np.int64).tolist():
+                self._rows.pop(k, None)
+            self._maybe_flush()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+
+class TieredTable:
+    """Hot KvTable + cold store, one lookup surface.
+
+    Reference: hybrid_embedding TableManager/EVContext — callers see one
+    table; the manager decides the tier.
+    """
+
+    def __init__(self, table: KvTable, cold: ColdStore):
+        self.hot = table
+        self.cold = cold
+
+    # ---- lookups (fault cold rows back into the hot tier) ---------------
+
+    def gather_or_insert(self, keys, now_ts: Optional[int] = None):
+        keys = np.asarray(keys, np.int64)
+        self._promote_missing(keys, now_ts)
+        return self.hot.gather_or_insert(keys, now_ts=now_ts)
+
+    def gather_or_zeros(self, keys):
+        keys = np.asarray(keys, np.int64)
+        self._promote_missing(keys, None)
+        return self.hot.gather_or_zeros(keys)
+
+    def _promote_missing(self, keys, now_ts):
+        # a key that is in NEITHER tier is genuinely new; one that is only
+        # cold must come back hot with its history intact
+        freqs = self.hot.frequency(keys)
+        miss = keys[freqs == 0]
+        if miss.size == 0:
+            return
+        found, values, cfreqs, cts = self.cold.get(miss)
+        if not found.any():
+            return
+        fault = miss[found]
+        self.hot.import_(
+            fault,
+            values[found],
+            cfreqs[found],
+            np.full(
+                fault.size,
+                now_ts if now_ts is not None else int(time.time()),
+                np.uint32,
+            ),
+            mark_dirty=True,
+        )
+        self.cold.delete(fault)
+        logger.debug("promoted %d cold keys", fault.size)
+
+    # ---- demotion (the TTL path, but spill instead of drop) --------------
+
+    def demote_before_timestamp(self, ts: int) -> int:
+        """Move keys untouched since ``ts`` to the cold tier.
+
+        Same predicate as KvTable.delete_before_timestamp (TTL eviction),
+        but the rows survive — the hybrid-storage behavior the reference's
+        interface exists for.
+        """
+        keys, values, freqs, kts = self.hot.export(
+            delta_only=False, clear_dirty=False
+        )
+        stale = kts < ts
+        if not stale.any():
+            return 0
+        self.cold.put(keys[stale], values[stale], freqs[stale], kts[stale])
+        self.hot.delete(keys[stale])
+        logger.info("demoted %d keys to cold tier", int(stale.sum()))
+        return int(stale.sum())
+
+    # ---- passthroughs -----------------------------------------------------
+
+    def scatter(self, keys, updates, *a, **kw):
+        # promote first: a cold key's gradient update must land on its
+        # real row, not a fresh init row — and without promotion the next
+        # gather would overwrite the update with the stale cold copy
+        self._promote_missing(np.asarray(keys, np.int64), None)
+        return self.hot.scatter(keys, updates, *a, **kw)
+
+    def __len__(self) -> int:
+        return len(self.hot) + len(self.cold)
+
+    @property
+    def hot_size(self) -> int:
+        return len(self.hot)
+
+    @property
+    def cold_size(self) -> int:
+        return len(self.cold)

@@ -98,7 +98,7 @@ def test_ps_version_rpc_roundtrip():
         msgs.PsVersionRequest(node_id=0, version_type="global")
     )
     assert resp.version == 2  # set_servers bumped once, report again
-    assert resp.servers == ("h0", "h1")
+    assert resp.servers == ["h0", "h1"]
     # node-level
     servicer.report(
         msgs.PsVersionReport(node_id=7, version_type="node", version=2)

@@ -403,3 +403,63 @@ class TestEmbeddingCollection:
         out = f(jnp.array([[5, 6]], dtype=jnp.int64))
         np.testing.assert_allclose(np.asarray(out), [[8.0, 0.0]])
         t.close()
+
+
+class TestTieredTable:
+    """Hybrid storage: hot KvTable + cold file tier.
+
+    Reference behaviors: hybrid_embedding TableManager + StorageTable."""
+
+    def _tiered(self, tmp_path, dim=4):
+        from dlrover_tpu.sparse.kv_table import KvTable
+        from dlrover_tpu.sparse.tiered import FileColdStore, TieredTable
+
+        table = KvTable("tier_t", dim=dim, n_slots=0)
+        cold = FileColdStore(str(tmp_path / "cold"), width=dim)
+        return TieredTable(table, cold), table, cold
+
+    def test_demote_then_fault_back(self, tmp_path):
+        import numpy as np
+
+        tiered, hot, cold = self._tiered(tmp_path)
+        keys = np.array([1, 2, 3], dtype=np.int64)
+        rows = tiered.gather_or_insert(keys, now_ts=100)
+        assert tiered.hot_size == 3 and tiered.cold_size == 0
+
+        # keys 1,2 go stale; key 3 stays warm
+        hot.insert([3], rows[2:3], now_ts=500)
+        moved = tiered.demote_before_timestamp(400)
+        assert moved == 2
+        assert tiered.hot_size == 1 and tiered.cold_size == 2
+        assert len(tiered) == 3
+
+        # lookup faults the cold rows back with identical values
+        back = tiered.gather_or_insert(keys, now_ts=600)
+        np.testing.assert_allclose(back, rows, rtol=1e-6)
+        assert tiered.cold_size == 0 and tiered.hot_size == 3
+
+    def test_cold_store_survives_restart(self, tmp_path):
+        import numpy as np
+
+        from dlrover_tpu.sparse.tiered import FileColdStore
+
+        cold = FileColdStore(str(tmp_path / "c"), width=2)
+        cold.put(
+            np.array([7, 9]),
+            np.array([[1.0, 2.0], [3.0, 4.0]], np.float32),
+            np.array([5, 6], np.uint32),
+            np.array([10, 11], np.uint32),
+        )
+        cold2 = FileColdStore(str(tmp_path / "c"), width=2)
+        found, values, freqs, ts = cold2.get(np.array([9, 8]))
+        assert found.tolist() == [True, False]
+        np.testing.assert_allclose(values[0], [3.0, 4.0])
+        assert freqs[0] == 6 and ts[0] == 11
+
+    def test_new_keys_skip_cold_lookup(self, tmp_path):
+        import numpy as np
+
+        tiered, _, cold = self._tiered(tmp_path)
+        out = tiered.gather_or_zeros(np.array([42], dtype=np.int64))
+        np.testing.assert_array_equal(out, np.zeros((1, 4), np.float32))
+        assert tiered.cold_size == 0
