@@ -423,3 +423,106 @@ def loss_fn(
     acc = (jnp.argmax(logits, -1) == targets).astype(jnp.float32) * mask
     metrics["accuracy"] = acc.sum() / denom
     return loss, metrics
+
+
+# ---------------------------------------------------------------------------
+# KV-cache incremental decoding (inference path)
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> Dict:
+    """Per-layer stacked K/V buffers for incremental decoding."""
+    dt = jnp.dtype(cfg.dtype)
+    shape = (cfg.n_layer, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cached_attention(q, ck, cv, pos, cfg: ModelConfig):
+    """q:[B,1,H,D] over cached ck/cv:[B,Smax,Hkv,D]; attends ≤ pos."""
+    b, _, h, d = q.shape
+    smax, hkv = ck.shape[1], ck.shape[2]
+    groups = h // hkv
+    qg = q.reshape(b, hkv, groups, d)  # squeeze the length-1 axis
+    scale = d**-0.5
+    if cfg.mup_base_width:
+        scale = 1.0  # 1/d folded into q by the caller, matching forward
+    s = jnp.einsum(
+        "bkgd,bskd->bkgs",
+        qg.astype(jnp.float32),
+        ck.astype(jnp.float32),
+    ) * scale
+    mask = jnp.arange(smax) <= pos
+    s = jnp.where(mask[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", p, cv.astype(jnp.float32))
+    return out.reshape(b, 1, h * d).astype(q.dtype)
+
+
+def decode_step(
+    params: Params,
+    tokens: jax.Array,  # [B] int32 — token at position ``pos``
+    cache: Dict,
+    pos: jax.Array,     # scalar int32
+    cfg: ModelConfig,
+) -> Tuple[jax.Array, Dict]:
+    """One incremental step: logits predicting position ``pos+1``.
+
+    O(S·D) per token instead of the O(S²·D) full-prefix recompute of
+    ``forward`` — the standard KV-cache inference path (the reference
+    leans on transformers.generate; here it is native). Single-mesh only
+    (no pp/sp); MoE layers route the single token through moe_block.
+    """
+    dt = jnp.dtype(cfg.dtype)
+    b = tokens.shape[0]
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)[:, None, :]
+    x = x.astype(dt)
+    positions = jnp.broadcast_to(pos, (b, 1)).astype(jnp.int32)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+
+    nh, nkv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+    def layer_fn(carry, inp):
+        x = carry
+        layer, ck, cv = inp
+        ln1, ln2 = layer["ln1"], layer["ln2"]
+        h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+        q = (h @ layer["attn"]["wq"].astype(h.dtype)).reshape(b, 1, nh, hd)
+        k = (h @ layer["attn"]["wk"].astype(h.dtype)).reshape(b, 1, nkv, hd)
+        v = (h @ layer["attn"]["wv"].astype(h.dtype)).reshape(b, 1, nkv, hd)
+        if cfg.pos == "rope":
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+        if cfg.mup_base_width:
+            q = q * (hd**-1.0)  # full 1/d (see _attention_block + scale=1)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+        attn = _cached_attention(q, ck, cv, pos, cfg)
+        x = x + attn @ layer["attn"]["wo"].astype(x.dtype)
+        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+        if cfg.n_experts > 0:
+            from dlrover_tpu.parallel.moe import moe_block
+
+            x = x + moe_block(h2, layer["moe"], cfg, None)
+        else:
+            x = x + _mlp_block(h2, layer, cfg, None)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits, {"k": new_k, "v": new_v}

@@ -26,21 +26,35 @@ def sample(
     mesh=None,
     attn_impl: str = "auto",
     pad_id: int = 0,
+    use_cache: bool = True,
 ) -> jax.Array:
     """Sample continuations; returns [B, P + max_new_tokens].
 
     ``temperature=0`` is greedy. The scan carries the growing buffer at
     fixed shape (prompt padded to full length) — XLA-friendly: no dynamic
     shapes, one compilation for the whole rollout.
+
+    ``use_cache=True`` decodes incrementally with a KV cache (O(S) per
+    token via decoder.decode_step); ``False`` re-runs the full prefix
+    each step. The cache path covers single-mesh dense models — MoE
+    routes with per-step capacity in decode, a different policy than the
+    batch forward's capacity drops, so MoE always takes the full-prefix
+    path to keep sampling consistent with training-time logprobs.
+
+    Sampling draws use ``fold_in(rng, position)``, so the same seed
+    yields the same rollout on both paths.
     """
+    if use_cache and mesh is None and cfg.n_experts == 0:
+        return _sample_cached(
+            params, cfg, prompts, max_new_tokens, rng, temperature, pad_id
+        )
     b, p = prompts.shape
     total = p + max_new_tokens
     buf = jnp.full((b, total), pad_id, dtype=jnp.int32)
     buf = buf.at[:, :p].set(prompts)
     positions = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), (b, total))
 
-    def step(carry, i):
-        buf, rng = carry
+    def step(buf, i):
         logits = decoder.forward(
             params, buf, cfg, mesh=mesh, positions=positions,
             attn_impl=attn_impl,
@@ -49,18 +63,58 @@ def sample(
         step_logits = jax.lax.dynamic_slice_in_dim(
             logits, i - 1, 1, axis=1
         )[:, 0, :]
-        rng, sub = jax.random.split(rng)
         if temperature > 0.0:
-            tok = jax.random.categorical(sub, step_logits / temperature)
+            tok = jax.random.categorical(
+                jax.random.fold_in(rng, i), step_logits / temperature
+            )
         else:
             tok = jnp.argmax(step_logits, axis=-1)
         buf = jax.lax.dynamic_update_slice_in_dim(
             buf, tok[:, None].astype(jnp.int32), i, axis=1
         )
-        return (buf, rng), None
+        return buf, None
+
+    buf, _ = jax.lax.scan(step, buf, jnp.arange(p, total))
+    return buf
+
+
+def _sample_cached(
+    params, cfg, prompts, max_new_tokens, rng, temperature, pad_id
+):
+    """KV-cache decoding: prompt prefill and sampling share one scan —
+    position i feeds token i−1 into decode_step; while i is inside the
+    prompt the model's prediction is discarded in favor of the prompt
+    token, afterwards the sampled token is written into the buffer."""
+    b, p = prompts.shape
+    total = p + max_new_tokens
+    buf = jnp.full((b, total), pad_id, dtype=jnp.int32)
+    buf = buf.at[:, :p].set(prompts)
+    cache = decoder.init_kv_cache(cfg, b, total)
+
+    def step(carry, i):
+        buf, cache = carry
+        tok_in = jax.lax.dynamic_slice_in_dim(buf, i - 1, 1, axis=1)[:, 0]
+        logits, cache = decoder.decode_step(
+            params, tok_in, cache, i - 1, cfg
+        )
+        # position-keyed rng: identical draw stream to the uncached path
+        # (prefill positions take the prompt token, so their draw is
+        # discarded — the stream stays position-aligned either way)
+        if temperature > 0.0:
+            tok = jax.random.categorical(
+                jax.random.fold_in(rng, i), logits / temperature
+            )
+        else:
+            tok = jnp.argmax(logits, axis=-1)
+        prompt_tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)[:, 0]
+        tok = jnp.where(i < p, prompt_tok, tok).astype(jnp.int32)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, tok[:, None], i, axis=1
+        )
+        return (buf, cache), None
 
     (buf, _), _ = jax.lax.scan(
-        step, (buf, rng), jnp.arange(p, total)
+        step, (buf, cache), jnp.arange(1, total)
     )
     return buf
 

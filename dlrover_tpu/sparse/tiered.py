@@ -143,24 +143,42 @@ class TieredTable:
     def __init__(self, table: KvTable, cold: ColdStore):
         self.hot = table
         self.cold = cold
+        # export rows carry optimizer slots: width = (1 + n_slots)·dim —
+        # a dim-sized cold store would crash on fault-back
+        cold_width = getattr(cold, "width", None)
+        if cold_width is not None and cold_width != table.width:
+            raise ValueError(
+                f"cold store width {cold_width} != hot table width "
+                f"{table.width} (= (1 + n_slots) * dim — exported rows "
+                "include optimizer slots)"
+            )
+        # one coarse lock: demote/promote are multi-step cross-tier moves;
+        # a concurrent scatter in the middle would be silently lost
+        self._lock = threading.Lock()
 
     # ---- lookups (fault cold rows back into the hot tier) ---------------
 
     def gather_or_insert(self, keys, now_ts: Optional[int] = None):
         keys = np.asarray(keys, np.int64)
-        self._promote_missing(keys, now_ts)
-        return self.hot.gather_or_insert(keys, now_ts=now_ts)
+        with self._lock:
+            self._promote_missing(keys, now_ts)
+            return self.hot.gather_or_insert(keys, now_ts=now_ts)
 
     def gather_or_zeros(self, keys):
         keys = np.asarray(keys, np.int64)
-        self._promote_missing(keys, None)
-        return self.hot.gather_or_zeros(keys)
+        with self._lock:
+            self._promote_missing(keys, None)
+            return self.hot.gather_or_zeros(keys)
 
     def _promote_missing(self, keys, now_ts):
         # a key that is in NEITHER tier is genuinely new; one that is only
-        # cold must come back hot with its history intact
+        # cold must come back hot with its history intact. "Missing from
+        # hot" = frequency 0 AND timestamp 0: freq alone is not enough
+        # because rows created via insert()/scatter() never bump it, and
+        # overwriting such a fresh row with a stale cold copy loses data
         freqs = self.hot.frequency(keys)
-        miss = keys[freqs == 0]
+        ts = self.hot.timestamp(keys)
+        miss = keys[(freqs == 0) & (ts == 0)]
         if miss.size == 0:
             return
         found, values, cfreqs, cts = self.cold.get(miss)
@@ -190,14 +208,17 @@ class TieredTable:
         but the rows survive — the hybrid-storage behavior the reference's
         interface exists for.
         """
-        keys, values, freqs, kts = self.hot.export(
-            delta_only=False, clear_dirty=False
-        )
-        stale = kts < ts
-        if not stale.any():
-            return 0
-        self.cold.put(keys[stale], values[stale], freqs[stale], kts[stale])
-        self.hot.delete(keys[stale])
+        with self._lock:
+            keys, values, freqs, kts = self.hot.export(
+                delta_only=False, clear_dirty=False
+            )
+            stale = kts < ts
+            if not stale.any():
+                return 0
+            self.cold.put(
+                keys[stale], values[stale], freqs[stale], kts[stale]
+            )
+            self.hot.delete(keys[stale])
         logger.info("demoted %d keys to cold tier", int(stale.sum()))
         return int(stale.sum())
 
@@ -207,8 +228,9 @@ class TieredTable:
         # promote first: a cold key's gradient update must land on its
         # real row, not a fresh init row — and without promotion the next
         # gather would overwrite the update with the stale cold copy
-        self._promote_missing(np.asarray(keys, np.int64), None)
-        return self.hot.scatter(keys, updates, *a, **kw)
+        with self._lock:
+            self._promote_missing(np.asarray(keys, np.int64), None)
+            return self.hot.scatter(keys, updates, *a, **kw)
 
     def __len__(self) -> int:
         return len(self.hot) + len(self.cold)

@@ -195,3 +195,58 @@ def test_ppo_increases_rewarded_token_probability():
     assert p1 > p0 * 1.5, (p0, p1, scores)
     # rollout scores trend upward
     assert np.mean(scores[-3:]) > np.mean(scores[:3]), scores
+
+
+def test_cached_generation_matches_uncached_greedy():
+    """decode_step + KV cache must reproduce full-prefix greedy decoding
+    token for token."""
+    # float32: exact token equality between the two attention paths is
+    # only guaranteed without bf16 near-tie argmax flips
+    cfg = _cfg(n_layer=2, n_head=4, dtype="float32", param_dtype="float32")
+    params = decoder.init(jax.random.key(0), cfg)
+    prompts = jax.random.randint(jax.random.key(1), (3, 5), 0, 32)
+    cached = generate.sample(
+        params, cfg, prompts, 10, rng=jax.random.key(2),
+        temperature=0.0, use_cache=True,
+    )
+    uncached = generate.sample(
+        params, cfg, prompts, 10, rng=jax.random.key(2),
+        temperature=0.0, use_cache=False,
+    )
+    np.testing.assert_array_equal(np.asarray(cached), np.asarray(uncached))
+
+
+def test_cached_generation_gqa_and_learned_pos():
+    cfg = _cfg(n_layer=2, n_head=4, dtype="float32", param_dtype="float32")
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg, n_kv_head=2, pos="learned", tie_embeddings=False
+    )
+    params = decoder.init(jax.random.key(3), cfg)
+    prompts = jnp.ones((2, 4), jnp.int32)
+    cached = generate.sample(
+        params, cfg, prompts, 8, rng=jax.random.key(4),
+        temperature=0.0, use_cache=True,
+    )
+    uncached = generate.sample(
+        params, cfg, prompts, 8, rng=jax.random.key(4),
+        temperature=0.0, use_cache=False,
+    )
+    np.testing.assert_array_equal(np.asarray(cached), np.asarray(uncached))
+
+
+def test_decode_step_logits_match_forward():
+    cfg = _cfg(n_layer=1)
+    params = decoder.init(jax.random.key(5), cfg)
+    toks = jax.random.randint(jax.random.key(6), (2, 6), 0, 32)
+    full = decoder.forward(params, toks, cfg)
+    cache = decoder.init_kv_cache(cfg, 2, 6)
+    logits = None
+    for i in range(6):
+        logits, cache = decoder.decode_step(
+            params, toks[:, i], cache, jnp.asarray(i, jnp.int32), cfg
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, -1]), rtol=2e-2, atol=2e-2
+    )

@@ -463,3 +463,30 @@ class TestTieredTable:
         out = tiered.gather_or_zeros(np.array([42], dtype=np.int64))
         np.testing.assert_array_equal(out, np.zeros((1, 4), np.float32))
         assert tiered.cold_size == 0
+
+    def test_width_mismatch_rejected_and_slots_roundtrip(self, tmp_path):
+        import numpy as np
+
+        from dlrover_tpu.sparse.kv_table import GroupAdam, KvTable
+        from dlrover_tpu.sparse.tiered import FileColdStore, TieredTable
+
+        table = KvTable("tier_slots", dim=4, n_slots=2)  # Adam m+v slots
+        with pytest.raises(ValueError, match="width"):
+            TieredTable(table, FileColdStore(str(tmp_path / "bad"), width=4))
+        tiered = TieredTable(
+            table, FileColdStore(str(tmp_path / "ok"), width=table.width)
+        )
+        keys = np.array([11, 12], dtype=np.int64)
+        tiered.gather_or_insert(keys, now_ts=10)
+        opt = GroupAdam(lr=0.1)
+        opt.apply(table, keys, np.ones((2, 4), np.float32), now_ts=20)
+        rows_before = table.gather_full(keys)
+        assert tiered.demote_before_timestamp(100) == 2
+        back = tiered.gather_or_insert(keys, now_ts=200)
+        # full rows (values + optimizer slots) survive the round-trip
+        np.testing.assert_allclose(
+            np.asarray(table.gather_full(keys)),
+            np.asarray(rows_before),
+            rtol=1e-6,
+        )
+        assert back.shape == (2, 4)
