@@ -1,0 +1,113 @@
+"""Orbax interoperability for Flash Checkpoint.
+
+The native format (core.py packs) is built for elastic restore speed:
+shm-stageable, resharding-capable, one buffer per host. Orbax/TensorStore
+is the JAX ecosystem's interchange format — this adapter converts both
+ways so checkpoints flow to/from maxtext-style pipelines, model hubs, and
+long-term storage (SURVEY.md §7: "TensorStore/OCDBT as the storage
+backend (Orbax-compatible layout)").
+"""
+
+from typing import Any, Optional
+
+import jax
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_orbax(path: str, state: Any) -> None:
+    """Write a state pytree as an Orbax checkpoint directory."""
+    _checkpointer().save(path, state)
+    logger.info("wrote orbax checkpoint at %s", path)
+
+
+def load_orbax(
+    path: str,
+    target: Optional[Any] = None,
+    shardings: Optional[Any] = None,
+) -> Any:
+    """Read an Orbax checkpoint; optional target/shardings for restore
+    onto a mesh (resharded restore works the same as the native path)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = _checkpointer()
+    if target is None:
+        return ckptr.restore(path)
+    if shardings is not None:
+        args = jax.tree.map(
+            lambda t, s: ocp.ArrayRestoreArgs(
+                sharding=s, global_shape=t.shape, dtype=t.dtype
+            ),
+            target,
+            shardings,
+        )
+        return ckptr.restore(path, restore_args=args)
+    return ckptr.restore(path, item=target)
+
+
+def pack_to_orbax(
+    ckpt_dir: str,
+    out_path: str,
+    target: Any,
+    step: Optional[int] = None,
+    shardings: Optional[Any] = None,
+) -> int:
+    """Convert a committed native checkpoint into an Orbax directory.
+
+    ``target`` provides the pytree structure (state_template of the live
+    state). Returns the step converted.
+    """
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.storage import PosixStorage, read_tracker
+
+    engine = CheckpointEngine(ckpt_dir, use_agent=False)
+    if step is None:
+        step = read_tracker(ckpt_dir, PosixStorage())
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {ckpt_dir}"
+            )
+    state = engine.load_from_storage(target, shardings=shardings, step=step)
+    if state is None:
+        raise FileNotFoundError(
+            f"no committed checkpoint under {ckpt_dir}"
+        )
+    save_orbax(out_path, state)
+    return step
+
+
+def orbax_to_pack(
+    orbax_path: str,
+    ckpt_dir: str,
+    step: int,
+    target: Optional[Any] = None,
+    shardings: Optional[Any] = None,
+) -> None:
+    """Import an Orbax checkpoint into the native pack format (so an
+    externally-produced model can enter the flash-checkpoint flow)."""
+    from dlrover_tpu.checkpoint import core
+    from dlrover_tpu.checkpoint.saver import persist_pack
+    from dlrover_tpu.checkpoint.storage import PosixStorage
+
+    state = load_orbax(orbax_path, target=target, shardings=shardings)
+    entries, payload = core.plan_pack(state)
+    header = core.header_bytes(step, entries, {"dir": ckpt_dir})
+    buf = memoryview(bytearray(core.pack_size(header, payload)))
+    used = core.write_pack(buf, step, state, entries)
+    persist_pack(
+        buf[:used],
+        ckpt_dir,
+        step,
+        jax.process_index(),
+        jax.process_count(),
+        PosixStorage(),
+    )
+    logger.info("imported orbax checkpoint → %s step %d", ckpt_dir, step)

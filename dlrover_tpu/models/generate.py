@@ -3,9 +3,9 @@
 Reference: the actor generation step of atorch's RL pipeline
 (rl/model_engine + transformers .generate). Implemented as one jitted
 ``lax.scan`` over decode positions with a fixed-size token buffer, so the
-whole rollout compiles once. No KV cache yet — each step re-runs the full
-prefix (fine at experience-generation scale; a paged cache is the obvious
-later optimization).
+whole rollout compiles once. Default path decodes incrementally with a
+KV cache (decoder.decode_step, O(S) per token); the full-prefix
+recompute path remains for mesh/MoE setups the cache doesn't cover.
 """
 
 from typing import Optional
@@ -41,8 +41,12 @@ def sample(
     batch forward's capacity drops, so MoE always takes the full-prefix
     path to keep sampling consistent with training-time logprobs.
 
-    Sampling draws use ``fold_in(rng, position)``, so the same seed
-    yields the same rollout on both paths.
+    Sampling draws use ``fold_in(rng, position)``, so both paths consume
+    the same rng stream. Greedy (temperature=0) rollouts match token for
+    token across paths in float32; at temperature>0 the two paths
+    compute numerically different logits (per-token decode vs
+    full-prefix forward), so near-tie draws can diverge — that is
+    float noise, not a cache bug.
     """
     if use_cache and mesh is None and cfg.n_experts == 0:
         return _sample_cached(
