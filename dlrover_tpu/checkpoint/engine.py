@@ -87,7 +87,11 @@ class CheckpointEngine:
                 name = shm_name()
                 self._shm = create_shared_memory(name, _round_up(total))
             used = core.write_pack(
-                memoryview(self._shm.buf), step, state, entries
+                memoryview(self._shm.buf),
+                step,
+                state,
+                entries,
+                {"dir": self.ckpt_dir},
             )
             meta = {
                 "step": step,

@@ -98,10 +98,11 @@ def orbax_to_pack(
     from dlrover_tpu.checkpoint.storage import PosixStorage
 
     state = load_orbax(orbax_path, target=target, shardings=shardings)
+    extra = {"dir": ckpt_dir}
     entries, payload = core.plan_pack(state)
-    header = core.header_bytes(step, entries, {"dir": ckpt_dir})
+    header = core.header_bytes(step, entries, extra)
     buf = memoryview(bytearray(core.pack_size(header, payload)))
-    used = core.write_pack(buf, step, state, entries)
+    used = core.write_pack(buf, step, state, entries, extra)
     persist_pack(
         buf[:used],
         ckpt_dir,

@@ -32,6 +32,11 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
+# test hook: run every kernel in pallas interpret mode (CPU-executable);
+# lets composition layers (ring attention) exercise the real kernel path
+# on the virtual CPU mesh
+INTERPRET = False
+
 
 def _fwd_kernel(
     q_ref,  # [block_q, d]
@@ -119,8 +124,9 @@ def _flash_fwd(
     scale: float,
     block_q: int,
     block_k: int,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
+    interpret = INTERPRET if interpret is None else interpret
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     assert h % hkv == 0
@@ -183,8 +189,12 @@ def _flash_fwd(
     return out, lse
 
 
-def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk):
+def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk, g_lse=None):
     """True O(S·chunk) flash backward from saved (out, lse).
+
+    ``g_lse`` [B,H,S]: optional cotangent of the lse output (ring
+    attention's softmax-merge differentiates through lse). Since
+    ∂lse/∂s_j = p_j, it enters ds as an additive per-row term.
 
     Recomputes p = exp(s − lse) one key-chunk at a time (lax.scan), never
     materialising the [S, S] attention matrix — the memory property the
@@ -219,6 +229,12 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk):
     vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
     lse_g = lse.reshape(b, hkv, groups, sq)
     delta = jnp.sum(gt * ot, axis=-1)                  # [B,Hkv,G,Sq]
+    if g_lse is not None:
+        # fold the lse cotangent into the per-row correction: total
+        # ds = p·(dp − delta + g_lse)
+        delta = delta - g_lse.reshape(b, hkv, groups, sq).astype(
+            jnp.float32
+        )
 
     chunk = min(chunk, sk)
     n_chunks = sk // chunk
@@ -282,6 +298,31 @@ def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k):
+    """Flash attention returning (out, lse) with BOTH differentiable —
+    the primitive ring attention composes (the lse feeds the cross-block
+    softmax merge, so its gradient is load-bearing)."""
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+
+
+def _fwd_rule_lse(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
+    q, k, v, out, lse = residuals
+    g_out, g_lse = cot
+    return _chunked_backward(
+        q, k, v, out, lse, g_out, causal, scale, chunk=block_k,
+        g_lse=g_lse,
+    )
+
+
+flash_attention_with_lse.defvjp(_fwd_rule_lse, _bwd_rule_lse)
 
 
 def flash_attention(

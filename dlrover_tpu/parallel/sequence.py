@@ -132,6 +132,50 @@ def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
     return out.astype(jnp.float32), m, l
 
 
+def _block_softmax_jnp(q, k, v, scale, q_offset, k_offset, causal):
+    """Normalized partial attention of local q vs one k/v block.
+
+    Returns (out [B,Sq,H,D] f32 normalized within the block,
+    lse [B,H,Sq] f32; fully-masked rows: out 0, lse NEG_INF)."""
+    out_raw, m, l = _block_attend(q, k, v, scale, q_offset, k_offset, causal)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = out_raw / l_safe.transpose(0, 2, 1)[..., None]
+    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+    return out, lse
+
+
+def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal, bq, bk):
+    """Same contract via the Pallas flash kernel (O(block) memory inside).
+
+    Ring blocks are equal-sized, so vs the local q block a k/v block is
+    exactly one of: fully before (dense), diagonal (causal), fully after
+    (empty). The relation is traced (the source rotates), so lax.switch
+    picks the kernel variant.
+    """
+    from dlrover_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    b, sq, h, d = q.shape
+
+    def dense(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, False, scale, bq, bk)
+        return out.astype(jnp.float32), lse
+
+    def diagonal(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, True, scale, bq, bk)
+        return out.astype(jnp.float32), lse
+
+    def empty(q, k, v):
+        return (
+            jnp.zeros((b, sq, h, d), jnp.float32),
+            jnp.full((b, h, sq), NEG_INF, jnp.float32),
+        )
+
+    if not causal:
+        return dense(q, k, v)
+    case = jnp.where(k_offset == q_offset, 1, jnp.where(k_offset < q_offset, 0, 2))
+    return jax.lax.switch(case, (dense, diagonal, empty), q, k, v)
+
+
 def ring_attention(
     q: jax.Array,  # [B, S, H, D] — S sharded over sp outside shard_map
     k: jax.Array,
@@ -146,8 +190,14 @@ def ring_attention(
 
     Each of the sp devices holds one contiguous sequence block; k/v rotate
     around the ring (ppermute over ICI) for sp steps while the local q
-    accumulates online-softmax statistics. Communication overlaps the next
-    block's compute under XLA's latency-hiding scheduler.
+    merges per-block softmax results ((out, lse) logaddexp combination).
+    On TPU the per-block attention is the Pallas flash kernel, so forward
+    memory is O(kernel block) — not O(local_block²) — per step. The scan
+    body is rematerialized, so backward avoids the O(S²/sp) score
+    tensors; note the scan carries (rotating k/v + accumulator) are still
+    saved per step, so backward holds O(S) k/v per device — the usual
+    ring-attention bound. Communication overlaps the next block's
+    compute under XLA's scheduler.
     """
     sp = mesh.shape[axis]
     scale = (
@@ -157,46 +207,60 @@ def ring_attention(
         return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
 
     def local(q, k, v):
+        from dlrover_tpu.ops import pallas_attention as pa
+
         k, v = _match_heads(q, k, v)
         idx = jax.lax.axis_index(axis)
         b, sq, h, d = q.shape
         q_offset = idx * sq
 
+        bq = pa._fit_block(sq, 512)
+        bk = pa._fit_block(k.shape[1], 512)
+        use_flash = (
+            pa.pltpu is not None and pa._on_tpu() and bq and bk
+        )
+
         perm = [(i, (i + 1) % sp) for i in range(sp)]
 
         def body(carry, _):
-            k_blk, v_blk, src, acc, m_run, l_run = carry
+            k_blk, v_blk, src, acc, lse_run = carry
             k_offset = src * sq
-            out, m_blk, l_blk = _block_attend(
-                q, k_blk, v_blk, scale, q_offset, k_offset, causal
-            )
-            m_new = jnp.maximum(m_run, m_blk)
-            alpha_run = jnp.exp(m_run - m_new)
-            alpha_blk = jnp.exp(m_blk - m_new)
+            if use_flash:
+                out_blk, lse_blk = _block_softmax_flash(
+                    q, k_blk, v_blk, scale, q_offset, k_offset, causal,
+                    bq, bk,
+                )
+            else:
+                out_blk, lse_blk = _block_softmax_jnp(
+                    q, k_blk, v_blk, scale, q_offset, k_offset, causal
+                )
+            # merge two normalized partials: logaddexp on lse, rescale outs
+            lse_new = jnp.logaddexp(lse_run, lse_blk)
             alpha_run = jnp.where(
-                (m_run == NEG_INF), 0.0, alpha_run
+                lse_run <= NEG_INF, 0.0, jnp.exp(lse_run - lse_new)
             )
-            alpha_blk = jnp.where((m_blk == NEG_INF), 0.0, alpha_blk)
+            alpha_blk = jnp.where(
+                lse_blk <= NEG_INF, 0.0, jnp.exp(lse_blk - lse_new)
+            )
             acc = (
                 acc * alpha_run.transpose(0, 2, 1)[..., None]
-                + out * alpha_blk.transpose(0, 2, 1)[..., None]
+                + out_blk * alpha_blk.transpose(0, 2, 1)[..., None]
             )
-            l_run = l_run * alpha_run + l_blk * alpha_blk
             # rotate k/v to the next device on the ring
             k_next = jax.lax.ppermute(k_blk, axis, perm)
             v_next = jax.lax.ppermute(v_blk, axis, perm)
             src_next = jax.lax.rem(src - 1 + sp, sp)
-            return (k_next, v_next, src_next, acc, m_new, l_run), None
+            return (k_next, v_next, src_next, acc, lse_new), None
 
         acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
-        m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, h, sq), jnp.float32)
-        (_, _, _, acc, _, l_run), _ = jax.lax.scan(
-            body, (k, v, idx, acc0, m0, l0), None, length=sp
+        lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+        (_, _, _, acc, _), _ = jax.lax.scan(
+            jax.checkpoint(body),  # O(S/sp) backward memory per step
+            (k, v, idx, acc0, lse0),
+            None,
+            length=sp,
         )
-        l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
-        out = acc / l_safe.transpose(0, 2, 1)[..., None]
-        return out.astype(q.dtype)
+        return acc.astype(q.dtype)
 
     # batch stays sharded over (dp, fsdp), heads over tp; seq rides the ring
     spec = P(("dp", "fsdp"), axis, _head_axis(mesh, q, k), None)

@@ -255,3 +255,38 @@ def test_flash_backward_gqa():
     np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=2e-3, atol=2e-3)
+
+
+def test_chunked_backward_with_lse_cotangent():
+    """Ring attention differentiates through the flash lse output; the
+    chunked backward's g_lse term must match autodiff of (out, lse)."""
+    from dlrover_tpu.ops.pallas_attention import (
+        _chunked_backward,
+        _flash_fwd,
+    )
+
+    q, k, v = _qkv(jax.random.key(7), b=2, s=128, h=4, d=32)
+    scale = q.shape[-1] ** -0.5
+    out, lse = _flash_fwd(
+        q, k, v, True, scale, block_q=128, block_k=128, interpret=True
+    )
+    g_out = jax.random.normal(jax.random.key(8), out.shape, out.dtype)
+    g_lse = jax.random.normal(jax.random.key(9), lse.shape, lse.dtype)
+
+    def ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        lse = jax.nn.logsumexp(s, axis=-1)
+        p = jnp.exp(s - lse[..., None])
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return o, lse
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    rdq, rdk, rdv = vjp((g_out, g_lse))
+    dq, dk, dv = _chunked_backward(
+        q, k, v, out, lse, g_out, True, scale, chunk=64, g_lse=g_lse
+    )
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=2e-3, atol=2e-3)
