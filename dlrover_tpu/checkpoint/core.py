@@ -147,13 +147,17 @@ def write_pack(
     state: Any,
     entries: List[LeafEntry],
     extra: Dict = None,
+    header: Optional[bytes] = None,
 ) -> int:
     """Write header + all shard payloads into ``buf``; returns bytes used.
 
     Device→host copies are started async for every shard first, then
-    consumed — overlapping DMA with serialization.
+    consumed — overlapping DMA with serialization. Pass the ``header``
+    already computed for sizing to avoid re-serializing the (potentially
+    large) leaf manifest under the checkpoint lock.
     """
-    header = header_bytes(step, entries, extra)
+    if header is None:
+        header = header_bytes(step, entries, extra)
     n = len(header)
     buf[:HEADER_LEN_BYTES] = n.to_bytes(HEADER_LEN_BYTES, "little")
     buf[HEADER_LEN_BYTES : HEADER_LEN_BYTES + n] = header
@@ -215,6 +219,13 @@ class PackIndex:
                 ).reshape(shape)
                 self._shards.setdefault(path, []).append((idx, view))
 
+    def close(self):
+        """Drop all buffer views so the backing shm/mmap can close
+        cleanly (numpy views pin the mapping; without this, SharedMemory
+        teardown raises 'cannot close exported pointers exist')."""
+        self._shards.clear()
+        self._meta.clear()
+
     def paths(self) -> List[str]:
         return list(self._meta.keys())
 
@@ -238,7 +249,9 @@ class PackIndex:
             shards = self._shards.get(path, [])
             if not shards:
                 raise KeyError(f"no shards for {path}")
-            return shards[0][1].reshape(())
+            # COPY, not a view: jax's CPU backend zero-copy aliases numpy
+            # arrays, and a view would pin the backing shm mapping open
+            return np.array(shards[0][1], copy=True).reshape(())
         shape = tuple(s.stop - s.start for s in want)
         out = np.empty(shape, dtype)
         filled = np.zeros(shape, bool) if not _covers(want, self._shards.get(path, [])) else None

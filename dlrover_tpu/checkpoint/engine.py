@@ -91,7 +91,7 @@ class CheckpointEngine:
                 step,
                 state,
                 entries,
-                {"dir": self.ckpt_dir},
+                header=header,
             )
             meta = {
                 "step": step,
@@ -210,7 +210,13 @@ class CheckpointEngine:
             idx = core.PackIndex()
             idx.add_pack(memoryview(shm.buf)[: meta["used"]])
             state = core.restore_tree(target, idx, shardings)
-            logger.info("restored step %d from shared memory", idx.step)
+            step = idx.step
+            # restore_tree copied everything to device; release the shm
+            # views so the segment can close without GC noise
+            state = jax.block_until_ready(state)
+            idx.close()
+            shm.close()
+            logger.info("restored step %d from shared memory", step)
             return state
         except (FileNotFoundError, KeyError):
             return None

@@ -98,11 +98,10 @@ def orbax_to_pack(
     from dlrover_tpu.checkpoint.storage import PosixStorage
 
     state = load_orbax(orbax_path, target=target, shardings=shardings)
-    extra = {"dir": ckpt_dir}
     entries, payload = core.plan_pack(state)
-    header = core.header_bytes(step, entries, extra)
+    header = core.header_bytes(step, entries, {"dir": ckpt_dir})
     buf = memoryview(bytearray(core.pack_size(header, payload)))
-    used = core.write_pack(buf, step, state, entries, extra)
+    used = core.write_pack(buf, step, state, entries, header=header)
     persist_pack(
         buf[:used],
         ckpt_dir,

@@ -310,6 +310,10 @@ def flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k):
 
 def _fwd_rule_lse(q, k, v, causal, scale, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    # same tags as _fwd_rule: lets remat policies (and the ring's scan
+    # checkpoint) pin the residuals instead of re-running the kernel
+    out = jax.ad_checkpoint.checkpoint_name(out, "flash_out")
+    lse = jax.ad_checkpoint.checkpoint_name(lse, "flash_lse")
     return (out, lse), (q, k, v, out, lse)
 
 

@@ -209,7 +209,6 @@ def ring_attention(
     def local(q, k, v):
         from dlrover_tpu.ops import pallas_attention as pa
 
-        k, v = _match_heads(q, k, v)
         idx = jax.lax.axis_index(axis)
         b, sq, h, d = q.shape
         q_offset = idx * sq
@@ -219,6 +218,11 @@ def ring_attention(
         use_flash = (
             pa.pltpu is not None and pa._on_tpu() and bq and bk
         )
+        if not use_flash:
+            # the jnp block path needs matched heads; the flash kernel
+            # handles GQA natively — keeping k/v at hkv heads there means
+            # every ppermute rotation moves groups× fewer bytes over ICI
+            k, v = _match_heads(q, k, v)
 
         perm = [(i, (i + 1) % sp) for i in range(sp)]
 

@@ -121,6 +121,8 @@ def test_ring_attention_flash_path_matches_reference(monkeypatch):
     from dlrover_tpu.parallel import MeshConfig, build_mesh
     from dlrover_tpu.parallel.sequence import ring_attention
 
+    if pa.pltpu is None:
+        pytest.skip("pallas TPU module unavailable: flash path untestable")
     monkeypatch.setattr(pa, "INTERPRET", True)
     monkeypatch.setattr(pa, "_on_tpu", lambda: True)
     # _fit_block needs 128-multiples: S=512 over sp=2 → 256-local blocks

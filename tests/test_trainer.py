@@ -155,3 +155,43 @@ def test_trainer_data_exhaustion_stops_cleanly(tmp_path):
     )
     state = trainer.train()
     assert int(state["step"]) == 4
+
+
+def test_elastic_remesh_resume(tmp_path, monkeypatch):
+    """The elastic hard path (SURVEY §7): train on one mesh, lose the
+    cluster, restore the SAME checkpoint onto a DIFFERENT mesh (new
+    world shape after a scale event) and keep training — the pack
+    format's resharded restore end-to-end through the Trainer."""
+    cfg = _cfg()
+    opt = make_optimizer(learning_rate=3e-3, warmup_steps=2, decay_steps=100)
+    mesh_a = build_mesh(MeshConfig(dp=2, fsdp=4))
+    args = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=6,
+        save_interval=6,
+        report_to_master=False,
+    )
+    t1 = Trainer(cfg, args, _data_iter(), opt, mesh=mesh_a)
+    s1 = t1.train()
+    w1 = np.asarray(jax.tree.leaves(s1["params"])[0])
+
+    # "scale event": the replacement job gets a different topology
+    monkeypatch.setenv("DLROVER_TPU_RUN_ID", f"remesh_{time.time_ns()}")
+    mesh_b = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    args2 = TrainerArgs(
+        output_dir=str(tmp_path),
+        max_steps=9,
+        save_interval=0,
+        report_to_master=False,
+    )
+    t2 = Trainer(cfg, args2, _data_iter(seed=2), opt, mesh=mesh_b)
+    t2._init_state()
+    assert int(t2.state["step"]) == 6  # resumed across the mesh change
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(t2.state["params"])[0]), w1
+    )
+    # params landed with mesh-B shardings, and training continues
+    leaf = jax.tree.leaves(t2.state["params"])[0]
+    assert leaf.sharding.mesh.shape["tp"] == 2
+    s2 = t2.train()
+    assert int(s2["step"]) == 9
