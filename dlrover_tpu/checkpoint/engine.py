@@ -208,14 +208,20 @@ class CheckpointEngine:
                     return None
             shm = attach_shared_memory(meta["shm"])
             idx = core.PackIndex()
-            idx.add_pack(memoryview(shm.buf)[: meta["used"]])
-            state = core.restore_tree(target, idx, shardings)
-            step = idx.step
-            # restore_tree copied everything to device; release the shm
-            # views so the segment can close without GC noise
-            state = jax.block_until_ready(state)
-            idx.close()
-            shm.close()
+            try:
+                idx.add_pack(memoryview(shm.buf)[: meta["used"]])
+                state = core.restore_tree(target, idx, shardings)
+                step = idx.step
+                # restore_tree copied everything to device
+                state = jax.block_until_ready(state)
+            finally:
+                # release the views on every path so the segment can
+                # close without 'exported pointers exist' GC noise
+                idx.close()
+                try:
+                    shm.close()
+                except BufferError:
+                    pass
             logger.info("restored step %d from shared memory", step)
             return state
         except (FileNotFoundError, KeyError):

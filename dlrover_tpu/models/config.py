@@ -23,6 +23,9 @@ class ModelConfig:
     norm: str = "rmsnorm"            # rmsnorm | layernorm
     act: str = "swiglu"              # swiglu | gelu
     pos: str = "rope"                # rope | learned
+    # False = bidirectional attention (BERT-family encoders; the TP/SP
+    # machinery is identical — same weights, different mask)
+    causal: bool = True
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
     # numerics
@@ -134,9 +137,38 @@ def _llama(name, n_layer, n_head, d_model, d_ff, max_seq=4096, n_kv_head=None):
     )
 
 
+def _bert(name, n_layer, n_head, d_model, max_seq=512):
+    """BERT-family encoder (reference: atorch's TP BERT blocks,
+    distributed_modules/transformer.py:45): bidirectional attention,
+    learned positions, layernorm+gelu, tied MLM head."""
+    return ModelConfig(
+        name=name,
+        vocab_size=30592,            # 30522 padded to a 128 multiple
+        n_layer=n_layer,
+        n_head=n_head,
+        d_model=d_model,
+        d_ff=4 * d_model,
+        max_seq=max_seq,
+        causal=False,
+        pos="learned",
+        norm="layernorm",
+        act="gelu",
+        tie_embeddings=True,
+    )
+
+
 CONFIGS = {
     "tiny": ModelConfig(),
     "tiny-moe": replace(ModelConfig(name="tiny-moe"), n_experts=4),
+    "tiny-bert": replace(
+        ModelConfig(name="tiny-bert"),
+        causal=False,
+        pos="learned",
+        norm="layernorm",
+        act="gelu",
+    ),
+    "bert-base": _bert("bert-base", 12, 12, 768),
+    "bert-large": _bert("bert-large", 24, 16, 1024),
     "gpt2-124m": _gpt2("gpt2-124m", 12, 12, 768),
     "gpt2-355m": _gpt2("gpt2-355m", 24, 16, 1024),
     "gpt2-1.5b": _gpt2("gpt2-1.5b", 48, 25, 1600),

@@ -290,16 +290,16 @@ def forward(
         if attn_impl == "ring":
             from dlrover_tpu.parallel.sequence import ring_attention
 
-            return ring_attention(q, k, v, mesh, causal=True)
+            return ring_attention(q, k, v, mesh, causal=cfg.causal)
         if attn_impl == "ulysses":
             from dlrover_tpu.parallel.sequence import ulysses_attention
 
-            return ulysses_attention(q, k, v, mesh, causal=True)
+            return ulysses_attention(q, k, v, mesh, causal=cfg.causal)
         if attn_impl == "reference":
-            return mha_reference(q, k, v, causal=True)
+            return mha_reference(q, k, v, causal=cfg.causal)
         from dlrover_tpu.ops.pallas_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=cfg.causal)
 
     body = functools.partial(
         _layer_body,
@@ -472,6 +472,11 @@ def decode_step(
     leans on transformers.generate; here it is native). Single-mesh only
     (no pp/sp); MoE layers route the single token through moe_block.
     """
+    if not cfg.causal:
+        raise ValueError(
+            "decode_step requires a causal model; encoder (bidirectional) "
+            "configs have no autoregressive decode"
+        )
     dt = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)[:, None, :]

@@ -48,6 +48,13 @@ def sample(
     full-prefix forward), so near-tie draws can diverge — that is
     float noise, not a cache bug.
     """
+    if not cfg.causal:
+        # bidirectional (encoder) models have no autoregressive factorization:
+        # the full-prefix path would silently condition on the pad filler
+        raise ValueError(
+            "sample() requires a causal model; encoder configs "
+            "(causal=False) cannot generate autoregressively"
+        )
     if use_cache and mesh is None and cfg.n_experts == 0:
         return _sample_cached(
             params, cfg, prompts, max_new_tokens, rng, temperature, pad_id
