@@ -290,16 +290,46 @@ def forward(
         if attn_impl == "ring":
             from dlrover_tpu.parallel.sequence import ring_attention
 
-            return ring_attention(q, k, v, mesh, causal=cfg.causal)
+            return ring_attention(
+                q,
+                k,
+                v,
+                mesh,
+                causal=cfg.causal,
+                block_q=cfg.attn_block_q,
+                block_k=cfg.attn_block_k,
+            )
         if attn_impl == "ulysses":
+            from dlrover_tpu.ops.pallas_attention import flash_attention
             from dlrover_tpu.parallel.sequence import ulysses_attention
 
-            return ulysses_attention(q, k, v, mesh, causal=cfg.causal)
+            # the head-sharded inner attention is ordinary full attention
+            # — run it through the flash kernel (falls back off-TPU)
+            return ulysses_attention(
+                q,
+                k,
+                v,
+                mesh,
+                causal=cfg.causal,
+                attn_fn=functools.partial(
+                    flash_attention,
+                    causal=cfg.causal,
+                    block_q=cfg.attn_block_q,
+                    block_k=cfg.attn_block_k,
+                ),
+            )
         if attn_impl == "reference":
             return mha_reference(q, k, v, causal=cfg.causal)
         from dlrover_tpu.ops.pallas_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=cfg.causal)
+        return flash_attention(
+            q,
+            k,
+            v,
+            causal=cfg.causal,
+            block_q=cfg.attn_block_q,
+            block_k=cfg.attn_block_k,
+        )
 
     body = functools.partial(
         _layer_body,

@@ -30,6 +30,10 @@ except ImportError:  # pragma: no cover
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
+# cap on the backward recompute chunk: bounds the transient p/dp/ds
+# tensors to [B,H,S,1024] f32 regardless of the forward tile choice,
+# while leaving seq<=1024 single-chunk (measured fastest on v5e)
+BACKWARD_CHUNK = 1024
 NEG_INF = -1e30
 
 # test hook: run every kernel in pallas interpret mode (CPU-executable);
@@ -292,8 +296,12 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k):
 
 def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
     q, k, v, out, lse = residuals
+    # backward chunk is capped independently of the forward tile: a large
+    # forward block (grid-overhead win) must not let the recompute
+    # materialize [S, S]-sized p/dp/ds
     return _chunked_backward(
-        q, k, v, out, lse, g, causal, scale, chunk=block_k
+        q, k, v, out, lse, g, causal, scale,
+        chunk=min(block_k, BACKWARD_CHUNK),
     )
 
 
@@ -321,7 +329,8 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
     q, k, v, out, lse = residuals
     g_out, g_lse = cot
     return _chunked_backward(
-        q, k, v, out, lse, g_out, causal, scale, chunk=block_k,
+        q, k, v, out, lse, g_out, causal, scale,
+        chunk=min(block_k, BACKWARD_CHUNK),
         g_lse=g_lse,
     )
 

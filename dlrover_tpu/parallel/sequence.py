@@ -185,6 +185,8 @@ def ring_attention(
     causal: bool = True,
     axis: str = "sp",
     softmax_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
 ) -> jax.Array:
     """Exact attention over the full (sharded) sequence via a k/v ring.
 
@@ -213,8 +215,8 @@ def ring_attention(
         b, sq, h, d = q.shape
         q_offset = idx * sq
 
-        bq = pa._fit_block(sq, 512)
-        bk = pa._fit_block(k.shape[1], 512)
+        bq = pa._fit_block(sq, block_q)
+        bk = pa._fit_block(k.shape[1], block_k)
         use_flash = (
             pa.pltpu is not None and pa._on_tpu() and bq and bk
         )
