@@ -193,6 +193,17 @@ def _flash_fwd(
     return out, lse
 
 
+def _bwd_chunk(sk: int, block_k: int) -> int:
+    """Largest chunk ≤ min(block_k, BACKWARD_CHUNK) that divides sk —
+    the memory cap must never violate the sk % chunk == 0 invariant
+    (e.g. block_k=1280 with sk=2560 must not cap to 1024)."""
+    cap = max(1, min(block_k, BACKWARD_CHUNK, sk))
+    for c in range(cap, 0, -1):
+        if sk % c == 0:
+            return c
+    return 1
+
+
 def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk, g_lse=None):
     """True O(S·chunk) flash backward from saved (out, lse).
 
@@ -301,7 +312,7 @@ def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
     # materialize [S, S]-sized p/dp/ds
     return _chunked_backward(
         q, k, v, out, lse, g, causal, scale,
-        chunk=min(block_k, BACKWARD_CHUNK),
+        chunk=_bwd_chunk(k.shape[1], block_k),
     )
 
 
@@ -330,7 +341,7 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
     g_out, g_lse = cot
     return _chunked_backward(
         q, k, v, out, lse, g_out, causal, scale,
-        chunk=min(block_k, BACKWARD_CHUNK),
+        chunk=_bwd_chunk(k.shape[1], block_k),
         g_lse=g_lse,
     )
 

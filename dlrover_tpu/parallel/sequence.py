@@ -61,9 +61,14 @@ def ulysses_attention(
     sp = mesh.shape[axis]
     if sp == 1:
         return attn_fn(q, k, v)
+    # both inner impls (mha_reference and the flash kernel) handle GQA
+    # natively, so expand kv heads ONLY when sp can't split them — the
+    # expanded all-to-all would move groups× more bytes over ICI
+    expand_kv = k.shape[2] % sp != 0
 
     def local(q, k, v):
-        k, v = _match_heads(q, k, v)
+        if expand_kv:
+            k, v = _match_heads(q, k, v)
 
         # [B, S/sp, H, D] → [B, S, H/sp, D]
         def scatter_heads(x):
