@@ -4,3 +4,4 @@ from dlrover_tpu.models.config import (  # noqa: F401
     get_config,
 )
 from dlrover_tpu.models import decoder  # noqa: F401
+from dlrover_tpu.models import vision  # noqa: F401

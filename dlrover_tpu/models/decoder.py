@@ -247,6 +247,79 @@ def _layer_body(
     return x, aux
 
 
+def run_trunk(
+    x: jax.Array,          # [B, S, D] embedded inputs
+    layers: Params,        # stacked per-layer params (leading axis L)
+    positions: jax.Array,  # [B, S]
+    cfg: ModelConfig,
+    mesh=None,
+    attn_fn=None,
+    rng: Optional[jax.Array] = None,
+    tag_attn_out: bool = False,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run the stacked transformer layers: remat policy, pp pipelining,
+    MoE aux-loss accumulation. Shared by the decoder and the ViT trunk
+    (models/vision.py) so policies stay in one place.
+
+    Returns (hidden states [B,S,D] — pre-final-norm, aux losses).
+    """
+    body = functools.partial(
+        _layer_body,
+        cfg=cfg,
+        mesh=mesh,
+        attn_fn=attn_fn,
+        tag_attn_out=tag_attn_out,
+    )
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+    elif cfg.remat == "dots_saveable":
+        body = jax.checkpoint(body, policy=cp.dots_saveable)
+    elif cfg.remat == "save_attn":
+        # pin the attention results so backward recomputes only the cheap
+        # MLP/norm/projection math: on the flash path the kernel's
+        # custom_vjp residuals (flash_out/flash_lse); on the reference
+        # path the tagged block output (attn_out) — never both
+        body = jax.checkpoint(
+            body,
+            policy=cp.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse"
+            ),
+        )
+
+    zero_aux = {
+        "moe_lb_loss": jnp.zeros([], jnp.float32),
+        "moe_z_loss": jnp.zeros([], jnp.float32),
+    }
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+        # router aux losses are not collected across pipeline stages
+        aux = zero_aux
+        x = pipeline_apply(
+            lambda c, layer, pos: body(c, layer, pos)[0],
+            layers,
+            x,
+            positions,
+            mesh,
+            num_microbatches=cfg.pp_microbatches or None,
+        )
+    else:
+        n_layers = jax.tree.leaves(layers)[0].shape[0]
+
+        def scan_fn(carry, inp):
+            layer, idx = inp
+            r = jax.random.fold_in(rng, idx) if rng is not None else None
+            out, aux = body(carry, layer, positions, rng=r)
+            return out, aux
+
+        x, auxs = jax.lax.scan(
+            scan_fn, x, (layers, jnp.arange(n_layers))
+        )
+        aux = jax.tree.map(lambda a: a.sum(), auxs)
+    return x, aux
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -331,60 +404,16 @@ def forward(
             block_k=cfg.attn_block_k,
         )
 
-    body = functools.partial(
-        _layer_body,
-        cfg=cfg,
+    x, aux = run_trunk(
+        x,
+        params["layers"],
+        positions,
+        cfg,
         mesh=mesh,
         attn_fn=attn_fn,
+        rng=rng,
         tag_attn_out=(attn_impl != "flash"),
     )
-    if cfg.remat == "full":
-        body = jax.checkpoint(body)
-    elif cfg.remat == "dots_saveable":
-        body = jax.checkpoint(body, policy=cp.dots_saveable)
-    elif cfg.remat == "save_attn":
-        # pin the attention results so backward recomputes only the cheap
-        # MLP/norm/projection math: on the flash path the kernel's
-        # custom_vjp residuals (flash_out/flash_lse); on the reference
-        # path the tagged block output (attn_out) — never both
-        body = jax.checkpoint(
-            body,
-            policy=cp.save_only_these_names(
-                "attn_out", "flash_out", "flash_lse"
-            ),
-        )
-
-    zero_aux = {
-        "moe_lb_loss": jnp.zeros([], jnp.float32),
-        "moe_z_loss": jnp.zeros([], jnp.float32),
-    }
-    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
-    if pp > 1:
-        from dlrover_tpu.parallel.pipeline import pipeline_apply
-
-        # router aux losses are not collected across pipeline stages
-        aux = zero_aux
-        x = pipeline_apply(
-            lambda c, layer, pos: body(c, layer, pos)[0],
-            params["layers"],
-            x,
-            positions,
-            mesh,
-            num_microbatches=cfg.pp_microbatches or None,
-        )
-    else:
-        n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
-
-        def scan_fn(carry, inp):
-            layer, idx = inp
-            r = jax.random.fold_in(rng, idx) if rng is not None else None
-            out, aux = body(carry, layer, positions, rng=r)
-            return out, aux
-
-        x, auxs = jax.lax.scan(
-            scan_fn, x, (params["layers"], jnp.arange(n_layers))
-        )
-        aux = jax.tree.map(lambda a: a.sum(), auxs)
 
     fn = params["final_norm"]
     x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)

@@ -61,13 +61,14 @@ def ulysses_attention(
     sp = mesh.shape[axis]
     if sp == 1:
         return attn_fn(q, k, v)
-    # both inner impls (mha_reference and the flash kernel) handle GQA
-    # natively, so expand kv heads ONLY when sp can't split them — the
-    # expanded all-to-all would move groups× more bytes over ICI
-    expand_kv = k.shape[2] % sp != 0
-
     def local(q, k, v):
-        if expand_kv:
+        # both inner impls (mha_reference and the flash kernel) handle GQA
+        # natively, so expand kv heads ONLY when sp can't split them — the
+        # expanded all-to-all would move groups× more bytes over ICI.
+        # Decided HERE from the tp-LOCAL head count (k may arrive with its
+        # head axis already sharded over tp; the global count would
+        # misjudge divisibility).
+        if k.shape[2] % sp != 0:
             k, v = _match_heads(q, k, v)
 
         # [B, S/sp, H, D] → [B, S, H/sp, D]

@@ -45,7 +45,12 @@ def main():
     n_dev = jax.device_count()
     sp = args.sp or max(2, n_dev // 4)
     assert n_dev % sp == 0 and args.seq % sp == 0
-    mesh = build_mesh(MeshConfig(sp=sp, dp=n_dev // sp))
+    dp = n_dev // sp
+    assert args.batch % dp == 0, (
+        f"--batch {args.batch} must be divisible by dp={dp} "
+        f"(= devices {n_dev} / sp {sp})"
+    )
+    mesh = build_mesh(MeshConfig(sp=sp, dp=dp))
     cfg = get_config(
         "tiny",
         n_layer=2,

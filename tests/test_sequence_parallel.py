@@ -61,6 +61,29 @@ def test_ulysses_matches_reference(mesh, causal):
     )
 
 
+def test_ulysses_gqa_on_sp_tp_mesh():
+    """Regression: sp=4×tp=2 GQA (Hq=8, Hkv=4). The kv-expansion decision
+    must use the tp-LOCAL kv head count (4%4==0 globally, but each tp
+    shard holds 2 kv heads, which sp=4 cannot split without expansion)."""
+    mesh = build_mesh(MeshConfig(sp=4, tp=2))
+    ks = jax.random.split(jax.random.key(3), 3)
+    b, s, hq, hkv, d = 2, 64, 8, 4, 16
+    q = jax.random.normal(ks[0], (b, s, hq, d))
+    k = jax.random.normal(ks[1], (b, s, hkv, d))
+    v = jax.random.normal(ks[2], (b, s, hkv, d))
+    ref = mha_reference(q, k, v, causal=True)
+
+    def put(x):
+        return jax.device_put(
+            x, NamedSharding(mesh, P(None, "sp", "tp", None))
+        )
+
+    out = ulysses_attention(put(q), put(k), put(v), mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_ring_train_step_matches_dp(mesh):
     """Full train step with ring attention == plain attention numerics."""
     from dlrover_tpu.accelerate import auto_accelerate

@@ -1,0 +1,146 @@
+"""ViT + CLIP model family tests (8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models import vision
+from dlrover_tpu.models.vision import (
+    VIT_CONFIGS,
+    clip_loss,
+    clip_logical_axes,
+    clip_tiny_test,
+    encode_image,
+    encode_text,
+    forward_vit,
+    init_clip,
+    init_vit,
+    patchify,
+    vit_logical_axes,
+)
+from dlrover_tpu.parallel import MeshConfig, build_mesh
+from dlrover_tpu.parallel import sharding as shd
+
+
+def test_patchify_layout():
+    # pixel (y, x) of patch (gy, gx) must land at patch index gy*gw+gx
+    img = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    p = patchify(img, 4)
+    assert p.shape == (2, 4, 4 * 4 * 3)
+    np.testing.assert_array_equal(
+        np.asarray(p[0, 1]),  # patch (0,1): rows 0..3, cols 4..7
+        np.asarray(img[0, 0:4, 4:8, :].reshape(-1)),
+    )
+
+
+def test_vit_forward_shapes():
+    cfg = VIT_CONFIGS["vit-tiny-test"]
+    params = init_vit(jax.random.key(0), cfg)
+    imgs = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    feats = forward_vit(params, imgs, cfg)
+    assert feats.shape == (2, cfg.trunk.d_model)
+    toks = forward_vit(params, imgs, cfg, features_only=True)
+    assert toks.shape == (2, cfg.seq_len, cfg.trunk.d_model)
+
+
+def test_vit_mean_pool():
+    import dataclasses
+
+    cfg = dataclasses.replace(VIT_CONFIGS["vit-tiny-test"], pool="mean")
+    params = init_vit(jax.random.key(0), cfg)
+    assert "cls_token" not in params
+    imgs = jnp.ones((2, 32, 32, 3))
+    assert forward_vit(params, imgs, cfg).shape == (2, cfg.trunk.d_model)
+
+
+def test_vit_logical_axes_match_params():
+    cfg = VIT_CONFIGS["vit-tiny-test"]
+    params = init_vit(jax.random.key(0), cfg)
+    axes = vit_logical_axes(cfg)
+    is_leaf = lambda x: x is None or isinstance(x, tuple)  # noqa: E731
+    assert jax.tree.structure(params) == jax.tree.structure(
+        axes, is_leaf=is_leaf
+    )
+    for p, a in zip(
+        jax.tree.leaves(params), jax.tree.leaves(axes, is_leaf=is_leaf)
+    ):
+        if a is not None:
+            assert len(a) == p.ndim
+
+
+def _toy_batch(rng, b=8):
+    """Correlated (image, text) pairs: class c colors the image and is
+    the text token sequence."""
+    cls = jax.random.randint(rng, (b,), 0, 8)
+    shades = jax.random.normal(jax.random.key(7), (8, 3))
+    imgs = jnp.broadcast_to(
+        shades[cls][:, None, None, :], (b, 32, 32, 3)
+    )
+    tokens = jnp.broadcast_to((cls + 1)[:, None], (b, 8)).astype(jnp.int32)
+    return {"images": imgs, "tokens": tokens}
+
+
+def test_clip_loss_decreases():
+    cfg = clip_tiny_test()
+    params = init_clip(jax.random.key(0), cfg)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            clip_loss, has_aux=True
+        )(params, batch, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, metrics
+
+    losses = []
+    for i in range(30):
+        batch = _toy_batch(jax.random.key(i % 4))
+        params, opt_state, m = step(params, opt_state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_clip_encoders_normalized():
+    cfg = clip_tiny_test()
+    params = init_clip(jax.random.key(0), cfg)
+    batch = _toy_batch(jax.random.key(1))
+    img = encode_image(params, batch["images"], cfg)
+    txt = encode_text(params, batch["tokens"], cfg)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(img), axis=-1), 1.0, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(txt), axis=-1), 1.0, rtol=1e-5
+    )
+
+
+def test_clip_sharded_matches_single():
+    cfg = clip_tiny_test()
+    params = init_clip(jax.random.key(0), cfg)
+    batch = _toy_batch(jax.random.key(2))
+    loss_ref, _ = jax.jit(
+        lambda p, b: clip_loss(p, b, cfg)
+    )(params, batch)
+
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    axes = clip_logical_axes(cfg)
+    shardings = shd.shardings_for_tree(mesh, axes)
+    params_s = jax.device_put(params, shardings)
+    bs = shd.shardings_for_tree(
+        mesh,
+        {
+            "images": ("batch", None, None, None),
+            "tokens": ("batch", None),
+        },
+    )
+    batch_s = jax.device_put(batch, bs)
+    loss_sharded, _ = jax.jit(
+        lambda p, b: clip_loss(p, b, cfg, mesh=mesh)
+    )(params_s, batch_s)
+    np.testing.assert_allclose(
+        float(loss_ref), float(loss_sharded), rtol=2e-3
+    )
