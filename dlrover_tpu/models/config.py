@@ -26,6 +26,16 @@ class ModelConfig:
     # False = bidirectional attention (BERT-family encoders; the TP/SP
     # machinery is identical — same weights, different mask)
     causal: bool = True
+    # GLM-family prefix-LM (reference: atorch's TP GLM blocks,
+    # distributed_modules/transformer.py:270): bidirectional attention
+    # over a per-sequence prefix, causal over the tail. The prefix
+    # lengths arrive at runtime as batch["prefix_len"] ([B] int32).
+    prefix_lm: bool = False
+    # GPTNeoX/GPT-J-style parallel residual (reference: atorch's TP
+    # GPTNeoX blocks, transformer.py:838): attention and MLP both read
+    # the same layer input, x = x + attn(ln1 x) + mlp(ln2 x) — shortens
+    # the critical path and lets XLA overlap the two matmul chains
+    parallel_residual: bool = False
     # flash-kernel tile sizes (128-multiples; tunable by strategy search).
     # 1024 measured +12% step throughput over 512 on v5e at s=1024
     # (less grid overhead); _fit_block caps them to the actual sequence.
@@ -168,9 +178,54 @@ def _bert(name, n_layer, n_head, d_model, max_seq=512):
     )
 
 
+def _gptneox(name, n_layer, n_head, d_model, max_seq=2048):
+    return ModelConfig(
+        name=name,
+        vocab_size=50432,
+        n_layer=n_layer,
+        n_head=n_head,
+        d_model=d_model,
+        d_ff=4 * d_model,
+        max_seq=max_seq,
+        norm="layernorm",
+        act="gelu",
+        pos="rope",
+        parallel_residual=True,
+        tie_embeddings=False,
+    )
+
+
+def _glm(name, n_layer, n_head, d_model, max_seq=2048):
+    """GLM-family prefix-LM decoder (bidirectional prefix + causal tail).
+    Design divergence from the reference's GLM blocks: rope instead of
+    GLM's 2D block positions — the infilling capability lives in the
+    prefix mask, and rope needs no learned table."""
+    return ModelConfig(
+        name=name,
+        vocab_size=50304,
+        n_layer=n_layer,
+        n_head=n_head,
+        d_model=d_model,
+        d_ff=4 * d_model,
+        max_seq=max_seq,
+        norm="layernorm",
+        act="gelu",
+        pos="rope",
+        prefix_lm=True,
+        tie_embeddings=True,
+    )
+
+
 CONFIGS = {
     "tiny": ModelConfig(),
     "tiny-moe": replace(ModelConfig(name="tiny-moe"), n_experts=4),
+    "tiny-neox": replace(
+        ModelConfig(name="tiny-neox"),
+        parallel_residual=True,
+        norm="layernorm",
+        act="gelu",
+    ),
+    "tiny-glm": replace(ModelConfig(name="tiny-glm"), prefix_lm=True),
     "tiny-bert": replace(
         ModelConfig(name="tiny-bert"),
         causal=False,
@@ -188,6 +243,8 @@ CONFIGS = {
     "llama3-8b": _llama(
         "llama3-8b", 32, 32, 4096, 14336, max_seq=8192, n_kv_head=8
     ),
+    "gptneox-20b": _gptneox("gptneox-20b", 44, 64, 6144),
+    "glm-10b": _glm("glm-10b", 48, 64, 4096),
 }
 
 

@@ -227,21 +227,27 @@ def _layer_body(
         # non-flash attention tags no flash_out/flash_lse, so save_attn
         # would otherwise pin nothing and recompute O(S²) attention
         attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
-    x = x + attn
-    h = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
     aux = {
         "moe_lb_loss": jnp.zeros([], jnp.float32),
         "moe_z_loss": jnp.zeros([], jnp.float32),
     }
+    if cfg.parallel_residual:
+        # GPTNeoX-style: both branches read the LAYER INPUT —
+        # x + attn(ln1 x) + mlp(ln2 x); the attn and mlp matmul chains
+        # have no data dependence, so XLA can overlap them
+        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+    else:
+        x = x + attn
+        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
     if cfg.n_experts > 0:
         from dlrover_tpu.parallel.moe import moe_block
 
-        out, aux = moe_block(
-            h, layer["moe"], cfg, mesh, rng=rng, return_aux=True
+        mlp_out, aux = moe_block(
+            h2, layer["moe"], cfg, mesh, rng=rng, return_aux=True
         )
-        x = x + out
     else:
-        x = x + _mlp_block(h, layer, cfg, mesh)
+        mlp_out = _mlp_block(h2, layer, cfg, mesh)
+    x = x + attn + mlp_out if cfg.parallel_residual else x + mlp_out
     if mesh is not None:
         x = shd.constrain(x, mesh, "batch", "seq", None)
     return x, aux
@@ -330,6 +336,7 @@ def forward(
     rng: Optional[jax.Array] = None,
     return_aux: bool = False,
     features_only: bool = False,
+    prefix_len: Optional[jax.Array] = None,
 ):
     """tokens:[B,S] int32 → logits:[B,S,vocab] float32.
 
@@ -337,7 +344,9 @@ def forward(
     summed over layers ({moe_lb_loss, moe_z_loss}); ``rng`` enables
     switch-gating jitter during training. ``features_only=True`` returns
     the final-norm hidden states [B,S,D] instead of logits (value/reward
-    heads attach here).
+    heads attach here). ``prefix_len`` [B] int32 (prefix-LM configs):
+    keys before prefix_len[b] are bidirectionally visible — GLM-style
+    blank infilling; flash and reference paths only.
     """
     dt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape
@@ -357,6 +366,21 @@ def forward(
         # path is far slower than plain jnp on CPU
         attn_impl = (
             "reference" if jax.default_backend() == "cpu" else "flash"
+        )
+
+    if prefix_len is not None and attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            "prefix_len is not threaded through sequence-parallel "
+            "attention yet — use attn_impl='flash' or 'reference'"
+        )
+    if cfg.prefix_lm and prefix_len is None:
+        # a GLM-family model silently training fully-causal is the worst
+        # failure mode (looks healthy, learns the wrong objective) —
+        # callers wanting causal behavior pass explicit zeros
+        raise ValueError(
+            "cfg.prefix_lm is set but no prefix_len was provided "
+            "(loss_fn reads batch['prefix_len']); pass "
+            "jnp.zeros([batch], int32) for fully-causal behavior"
         )
 
     def attn_fn(q, k, v):
@@ -392,7 +416,9 @@ def forward(
                 ),
             )
         if attn_impl == "reference":
-            return mha_reference(q, k, v, causal=cfg.causal)
+            return mha_reference(
+                q, k, v, causal=cfg.causal, prefix_len=prefix_len
+            )
         from dlrover_tpu.ops.pallas_attention import flash_attention
 
         return flash_attention(
@@ -402,6 +428,7 @@ def forward(
             causal=cfg.causal,
             block_q=cfg.attn_block_q,
             block_k=cfg.attn_block_k,
+            prefix_len=prefix_len,
         )
 
     x, aux = run_trunk(
@@ -445,7 +472,9 @@ def loss_fn(
     attn_impl: str = "auto",
     rng: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S]}."""
+    """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S],
+    optional "prefix_len": [B] (prefix-LM; mask usually zeroes the prefix
+    targets so loss falls only on the causal tail)}."""
     logits, moe_aux = forward(
         params,
         batch["tokens"],
@@ -454,6 +483,7 @@ def loss_fn(
         attn_impl=attn_impl,
         rng=rng,
         return_aux=True,
+        prefix_len=batch.get("prefix_len"),
     )
     targets = batch["targets"]
     mask = batch.get("mask")
@@ -536,6 +566,12 @@ def decode_step(
             "decode_step requires a causal model; encoder (bidirectional) "
             "configs have no autoregressive decode"
         )
+    if cfg.prefix_lm:
+        raise ValueError(
+            "decode_step's per-token causal prefill cannot build a "
+            "prefix-LM cache (prefix K/V depend on bidirectional "
+            "attention below); use sample(use_cache=False)"
+        )
     dt = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)[:, None, :]
@@ -564,14 +600,24 @@ def decode_step(
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
         attn = _cached_attention(q, ck, cv, pos, cfg)
-        x = x + attn @ layer["attn"]["wo"].astype(x.dtype)
-        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+        attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
+        if cfg.parallel_residual:
+            # must mirror _layer_body: both branches read the layer input
+            h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+        else:
+            x = x + attn_out
+            h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
         if cfg.n_experts > 0:
             from dlrover_tpu.parallel.moe import moe_block
 
-            x = x + moe_block(h2, layer["moe"], cfg, None)
+            mlp_out = moe_block(h2, layer["moe"], cfg, None)
         else:
-            x = x + _mlp_block(h2, layer, cfg, None)
+            mlp_out = _mlp_block(h2, layer, cfg, None)
+        x = (
+            x + attn_out + mlp_out
+            if cfg.parallel_residual
+            else x + mlp_out
+        )
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(

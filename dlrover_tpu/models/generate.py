@@ -55,7 +55,15 @@ def sample(
             "sample() requires a causal model; encoder configs "
             "(causal=False) cannot generate autoregressively"
         )
-    if use_cache and mesh is None and cfg.n_experts == 0:
+    if (
+        use_cache
+        and mesh is None
+        and cfg.n_experts == 0
+        and not cfg.prefix_lm
+    ):
+        # prefix-LM models can't prefill through decode_step: the cached
+        # K/V of prefix positions depend on bidirectional attention in
+        # the layers below, which the per-token causal path never sees
         return _sample_cached(
             params, cfg, prompts, max_new_tokens, rng, temperature, pad_id
         )
@@ -64,11 +72,15 @@ def sample(
     buf = jnp.full((b, total), pad_id, dtype=jnp.int32)
     buf = buf.at[:, :p].set(prompts)
     positions = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), (b, total))
+    # GLM convention: the prompt is "part A" — bidirectionally visible
+    prefix = (
+        jnp.full((b,), p, jnp.int32) if cfg.prefix_lm else None
+    )
 
     def step(buf, i):
         logits = decoder.forward(
             params, buf, cfg, mesh=mesh, positions=positions,
-            attn_impl=attn_impl,
+            attn_impl=attn_impl, prefix_len=prefix,
         )
         # logits at position i-1 predict token i
         step_logits = jax.lax.dynamic_slice_in_dim(

@@ -38,8 +38,14 @@ def mha_reference(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
+    prefix_len: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Plain attention. q:[B,S,H,D], k/v:[B,S,Hkv,D] → [B,S,H,D]."""
+    """Plain attention. q:[B,S,H,D], k/v:[B,S,Hkv,D] → [B,S,H,D].
+
+    ``prefix_len`` [B] int32 (causal only): GLM-style prefix-LM — keys at
+    positions < prefix_len[b] are visible to every query (bidirectional
+    prefix), the rest follow the causal mask.
+    """
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     if hkv != h:
@@ -54,7 +60,16 @@ def mha_reference(
         q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         mask = q_pos >= k_pos - (sk - sq)
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        if prefix_len is not None:
+            pmask = (
+                mask[None]
+                | (k_pos[None] < prefix_len[:, None, None])
+            )  # [B, Sq, Sk]
+            logits = jnp.where(pmask[:, None], logits, -1e30)
+        else:
+            logits = jnp.where(mask[None, None], logits, -1e30)
+    elif prefix_len is not None:
+        raise ValueError("prefix_len requires causal=True")
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
         logits = jnp.where(seg_mask[:, None, :sq, :sk], logits, -1e30)

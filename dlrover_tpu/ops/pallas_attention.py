@@ -21,6 +21,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 try:  # pltpu only resolves on TPU builds of jaxlib
@@ -46,6 +48,7 @@ def _fwd_kernel(
     q_ref,  # [block_q, d]
     k_ref,  # [block_k, d]
     v_ref,  # [block_k, d]
+    prefix_ref,  # [B, 1] int32, whole array in SMEM (None w/o prefix)
     o_ref,  # [block_q, d]
     lse_ref,  # [block_q, 8] f32 (8 lanes to satisfy TPU tiling; col 0 used)
     m_scratch,  # [block_q, 128] f32
@@ -56,10 +59,15 @@ def _fwd_kernel(
     scale: float,
     block_q: int,
     block_k: int,
+    has_prefix: bool,
+    n_head: int = 1,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    if has_prefix:
+        # grid dim 0 is batch·heads; the scalar prefix is per-batch
+        pref = prefix_ref[pl.program_id(0) // n_head, 0]
 
     @pl.when(ki == 0)
     def _init():
@@ -70,8 +78,12 @@ def _fwd_kernel(
     q_start = qi * block_q
     k_start = ki * block_k
 
-    # skip blocks entirely above the causal diagonal
+    # skip blocks entirely above the causal diagonal (with a prefix-LM
+    # bidirectional prefix, above-diagonal blocks overlapping the prefix
+    # still run)
     run = (not causal) or (k_start <= q_start + block_q - 1)
+    if causal and has_prefix:
+        run = jnp.logical_or(run, k_start < pref)
 
     @pl.when(run)
     def _body():
@@ -91,7 +103,12 @@ def _fwd_kernel(
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            allowed = q_pos >= k_pos
+            if has_prefix:
+                # GLM-style prefix-LM: keys inside the prefix are visible
+                # to every query (bidirectional prefix, causal tail)
+                allowed = jnp.logical_or(allowed, k_pos < pref)
+            s = jnp.where(allowed, s, NEG_INF)
 
         m_prev = m_scratch[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -120,6 +137,12 @@ def _fwd_kernel(
         )
 
 
+def _call_without_prefix(kernel, q_ref, k_ref, v_ref, *rest):
+    """Adapter for the prefix-less call: the kernel signature always has
+    a prefix_ref slot, but pallas passes inputs positionally."""
+    return kernel(q_ref, k_ref, v_ref, None, *rest)
+
+
 def _flash_fwd(
     q: jax.Array,  # [B, S, H, D]
     k: jax.Array,  # [B, S, Hkv, D]
@@ -129,6 +152,7 @@ def _flash_fwd(
     block_q: int,
     block_k: int,
     interpret: Optional[bool] = None,
+    prefix: Optional[jax.Array] = None,  # [B] int32 prefix-LM lengths
 ) -> jax.Array:
     interpret = INTERPRET if interpret is None else interpret
     b, sq, h, d = q.shape
@@ -159,14 +183,28 @@ def _flash_fwd(
         scale=scale,
         block_q=block_q,
         block_k=block_k,
+        has_prefix=prefix is not None,
+        n_head=h,
     )
+    if prefix is None:
+        inputs = (qt, kt, vt)
+        prefix_specs = []
+        kernel_fn = functools.partial(_call_without_prefix, kernel)
+    else:
+        inputs = (qt, kt, vt, prefix.astype(jnp.int32).reshape(b, 1))
+        # the whole [B,1] scalar table lives in SMEM; the kernel indexes
+        # its batch row from grid dim 0 (Mosaic rejects sub-8 sublane
+        # blocking, so no per-step BlockSpec windowing here)
+        prefix_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        kernel_fn = kernel
     out, lse = pl.pallas_call(
-        kernel,
+        kernel_fn,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+            *prefix_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
@@ -187,7 +225,7 @@ def _flash_fwd(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*inputs)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse[:, :, 0].reshape(b, h, sq)  # [B, H, S]
     return out, lse
@@ -204,7 +242,8 @@ def _bwd_chunk(sk: int, block_k: int) -> int:
     return 1
 
 
-def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk, g_lse=None):
+def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
+                      g_lse=None, prefix=None):
     """True O(S·chunk) flash backward from saved (out, lse).
 
     ``g_lse`` [B,H,S]: optional cotangent of the lse output (ring
@@ -265,7 +304,15 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk, g_lse=None):
         if causal:
             k_pos = idx * chunk + jnp.arange(chunk)
             mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None, None], s, NEG_INF)
+            if prefix is not None:
+                # bidirectional prefix: [B,1,1,Q,C] per-batch mask
+                pmask = (
+                    mask[None]
+                    | (k_pos[None, None, :] < prefix[:, None, None])
+                )
+                s = jnp.where(pmask[:, None, None], s, NEG_INF)
+            else:
+                s = jnp.where(mask[None, None, None], s, NEG_INF)
         p = jnp.exp(s - lse_g[..., None])              # [B,Hkv,G,Q,C]
         dv_c = jnp.einsum("bkgqc,bkgqd->bkcd", p, gt)
         dp = jnp.einsum("bkgqd,bkcd->bkgqc", gt, vc)
@@ -289,31 +336,43 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk, g_lse=None):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
 )
-def _flash_attention(q, k, v, causal, scale, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+def _flash_attention(q, k, v, prefix, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+    )
     return out
 
 
-def _fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+def _fwd_rule(q, k, v, prefix, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+    )
     # named so remat policies can pin the kernel residuals in memory and
     # skip re-running the forward kernel in backward (decoder save_attn)
-    out = jax.ad_checkpoint.checkpoint_name(out, "flash_out")
-    lse = jax.ad_checkpoint.checkpoint_name(lse, "flash_lse")
-    return out, (q, k, v, out, lse)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, prefix, out, lse)
 
 
 def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
-    q, k, v, out, lse = residuals
+    q, k, v, prefix, out, lse = residuals
     # backward chunk is capped independently of the forward tile: a large
     # forward block (grid-overhead win) must not let the recompute
     # materialize [S, S]-sized p/dp/ds
-    return _chunked_backward(
+    dq, dk, dv = _chunked_backward(
         q, k, v, out, lse, g, causal, scale,
         chunk=_bwd_chunk(k.shape[1], block_k),
+        prefix=prefix,
     )
+    # prefix is integer data: its cotangent is symbolically zero (float0)
+    dprefix = (
+        None
+        if prefix is None
+        else np.zeros(prefix.shape, dtype=jax.dtypes.float0)
+    )
+    return dq, dk, dv, dprefix
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
@@ -331,8 +390,8 @@ def _fwd_rule_lse(q, k, v, causal, scale, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
     # same tags as _fwd_rule: lets remat policies (and the ring's scan
     # checkpoint) pin the residuals instead of re-running the kernel
-    out = jax.ad_checkpoint.checkpoint_name(out, "flash_out")
-    lse = jax.ad_checkpoint.checkpoint_name(lse, "flash_lse")
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return (out, lse), (q, k, v, out, lse)
 
 
@@ -358,23 +417,31 @@ def flash_attention(
     softmax_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    prefix_len: Optional[jax.Array] = None,  # [B] int32: prefix-LM
 ) -> jax.Array:
     """Flash attention; falls back to the jnp path off-TPU.
 
     q: [B, S, H, D]; k/v: [B, S, Hkv, D] (GQA via fewer kv heads).
+    ``prefix_len`` (causal only) makes keys at positions < prefix_len[b]
+    visible to every query — GLM-style bidirectional-prefix attention.
     """
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     sq, sk = q.shape[1], k.shape[1]
     bq = _fit_block(sq, block_q)
     bk = _fit_block(sk, block_k)
+    if prefix_len is not None and not causal:
+        raise ValueError("prefix_len requires causal=True")
     if pltpu is None or not _on_tpu() or bq is None or bk is None:
         # off-TPU (incl. GPU — this is a Mosaic-TPU kernel), or seq not
         # tileable to a lane-aligned block: plain jnp, never a trace-time
         # crash
         from dlrover_tpu.ops.attention import mha_reference
 
-        return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
-    return _flash_attention(q, k, v, causal, scale, bq, bk)
+        return mha_reference(
+            q, k, v, causal=causal, softmax_scale=scale,
+            prefix_len=prefix_len,
+        )
+    return _flash_attention(q, k, v, prefix_len, causal, scale, bq, bk)
 
 
 def _on_tpu() -> bool:
