@@ -43,6 +43,11 @@ NEG_INF = -1e30
 # on the virtual CPU mesh
 INTERPRET = False
 
+# pallas FA2 backward kernels (vs the jnp chunked recompute); tiles
+# capped separately from the forward (see _bwd_rule)
+USE_PALLAS_BWD = True
+BWD_BLOCK = 512
+
 
 def _fwd_kernel(
     q_ref,  # [block_q, d]
@@ -137,10 +142,274 @@ def _fwd_kernel(
         )
 
 
-def _call_without_prefix(kernel, q_ref, k_ref, v_ref, *rest):
-    """Adapter for the prefix-less call: the kernel signature always has
-    a prefix_ref slot, but pallas passes inputs positionally."""
-    return kernel(q_ref, k_ref, v_ref, None, *rest)
+def _insert_none_arg(kernel, idx):
+    """Adapter for the prefix-less call: the kernel signatures always
+    have a prefix_ref slot (at positional index ``idx``), but pallas
+    passes inputs positionally — splice a None in."""
+
+    def call(*refs):
+        return kernel(*refs[:idx], None, *refs[idx:])
+
+    return call
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, prefix_ref,
+    dq_ref,
+    acc_scratch,  # [block_q, d] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    has_prefix: bool,
+    n_head: int,
+):
+    """dq = Σ_k ds @ K with ds = p·(dp − delta)·scale, p recomputed from
+    the saved lse — FlashAttention-2 backward, k-blocks innermost so dq
+    stays resident in VMEM scratch."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    if has_prefix:
+        pref = prefix_ref[pl.program_id(0) // n_head, 0]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = (not causal) or (k_start <= q_start + block_q - 1)
+    if causal and has_prefix:
+        run = jnp.logical_or(run, k_start < pref)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            allowed = q_pos >= k_pos
+            if has_prefix:
+                allowed = jnp.logical_or(allowed, k_pos < pref)
+            s = jnp.where(allowed, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        acc_scratch[:] = acc_scratch[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_scratch[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, prefix_ref,
+    dk_ref, dv_ref,
+    dk_scratch,  # [block_k, d] f32
+    dv_scratch,  # [block_k, d] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    has_prefix: bool,
+    n_head: int,
+):
+    """dk/dv accumulated per k-block with q-blocks innermost:
+    dv = Σ_q pᵀ @ dO, dk = Σ_q dsᵀ @ Q."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    if has_prefix:
+        pref = prefix_ref[pl.program_id(0) // n_head, 0]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # a q block contributes unless it lies entirely above the diagonal
+    # (and the k block is outside any bidirectional prefix)
+    run = (not causal) or (q_start + block_q - 1 >= k_start)
+    if causal and has_prefix:
+        run = jnp.logical_or(run, k_start < pref)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            allowed = q_pos >= k_pos
+            if has_prefix:
+                allowed = jnp.logical_or(allowed, k_pos < pref)
+            s = jnp.where(allowed, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dv_scratch[:] = dv_scratch[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_scratch[:] = dk_scratch[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, g, causal, scale,
+                     block_q, block_k, prefix=None,
+                     interpret: Optional[bool] = None):
+    """FA2-style pallas backward: returns (dq, dk, dv).
+
+    All [B,S,H,D] layouts like the forward; GQA dk/dv are group-summed
+    back to the kv head count.
+    """
+    interpret = INTERPRET if interpret is None else interpret
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    groups = h // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d).astype(q.dtype)
+    # K/V stay at hkv heads; the BlockSpec index_map shares them across
+    # the head group (no jnp.repeat HBM copies). dk/dv are still written
+    # per q-head and group-summed after — a transient the accumulate-in-
+    # VMEM alternative would trade for an 'arbitrary' grid dim.
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    # per-row softmax residuals, broadcast to the 8-lane tile the kernels
+    # read column 0 of
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, S, H]
+    delta8 = jnp.broadcast_to(
+        delta.transpose(0, 2, 1).reshape(b * h, sq)[..., None],
+        (b * h, sq, 8),
+    )
+    lse8 = jnp.broadcast_to(
+        lse.reshape(b * h, sq)[..., None], (b * h, sq, 8)
+    )
+
+    has_prefix = prefix is not None
+    if has_prefix:
+        extra = (prefix.astype(jnp.int32).reshape(b, 1),)
+        extra_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        wrap = lambda kern: kern  # noqa: E731
+    else:
+        extra = ()
+        extra_specs = []
+        wrap = functools.partial(_insert_none_arg, idx=6)
+
+    common = dict(
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        has_prefix=has_prefix,
+        n_head=h,
+    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda g_, i, j: (g_, i, 0))
+    row8_spec = pl.BlockSpec((1, block_q, 8), lambda g_, i, j: (g_, i, 0))
+    k_spec = pl.BlockSpec(
+        (1, block_k, d), lambda g_, i, j: (g_ // groups, j, 0)
+    )
+    compiler_params = (
+        None
+        if interpret
+        else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    )
+
+    dq = pl.pallas_call(
+        wrap(functools.partial(_bwd_dq_kernel, **common)),
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row8_spec, row8_spec,
+                  *extra_specs],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse8, delta8, *extra)
+
+    # dkv grid swaps the roles: k-blocks outer, q-blocks inner
+    qkv_spec = pl.BlockSpec((1, block_q, d), lambda g_, j, i: (g_, i, 0))
+    row8_spec2 = pl.BlockSpec((1, block_q, 8), lambda g_, j, i: (g_, i, 0))
+    kv_in_spec = pl.BlockSpec(
+        (1, block_k, d), lambda g_, j, i: (g_ // groups, j, 0)
+    )
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda g_, j, i: (g_, j, 0))
+    dk, dv = pl.pallas_call(
+        wrap(functools.partial(_bwd_dkv_kernel, **common)),
+        grid=(b * h, sk // block_k, sq // block_q),
+        in_specs=[qkv_spec, kv_in_spec, kv_in_spec, qkv_spec, row8_spec2,
+                  row8_spec2, *extra_specs],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse8, delta8, *extra)
+
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, hkv, groups, sk, d).sum(axis=2)
+    dv = dv.reshape(b, hkv, groups, sk, d).sum(axis=2)
+    return (
+        dq.astype(q.dtype),
+        dk.transpose(0, 2, 1, 3).astype(k.dtype),
+        dv.transpose(0, 2, 1, 3).astype(v.dtype),
+    )
 
 
 def _flash_fwd(
@@ -165,16 +434,12 @@ def _flash_fwd(
         "sequence must be padded to the block size"
     )
 
-    # layout: [B, H, S, D] so the matmul dims are the minor two
+    # layout: [B, H, S, D] so the matmul dims are the minor two. K/V stay
+    # at hkv heads — GQA sharing happens in the BlockSpec index_map
+    # (g // groups), never as a materialized jnp.repeat in HBM
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = (
-        jnp.repeat(k.transpose(0, 2, 1, 3), groups, axis=1)
-        .reshape(b * h, sk, d)
-    )
-    vt = (
-        jnp.repeat(v.transpose(0, 2, 1, 3), groups, axis=1)
-        .reshape(b * h, sk, d)
-    )
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
 
     grid = (b * h, sq // block_q, sk // block_k)
     kernel = functools.partial(
@@ -189,7 +454,7 @@ def _flash_fwd(
     if prefix is None:
         inputs = (qt, kt, vt)
         prefix_specs = []
-        kernel_fn = functools.partial(_call_without_prefix, kernel)
+        kernel_fn = _insert_none_arg(kernel, 3)
     else:
         inputs = (qt, kt, vt, prefix.astype(jnp.int32).reshape(b, 1))
         # the whole [B,1] scalar table lives in SMEM; the kernel indexes
@@ -202,8 +467,12 @@ def _flash_fwd(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, d), lambda g, i, j: (g // groups, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda g, i, j: (g // groups, j, 0)
+            ),
             *prefix_specs,
         ],
         out_specs=[
@@ -358,14 +627,31 @@ def _fwd_rule(q, k, v, prefix, causal, scale, block_q, block_k):
 
 def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
     q, k, v, prefix, out, lse = residuals
-    # backward chunk is capped independently of the forward tile: a large
-    # forward block (grid-overhead win) must not let the recompute
-    # materialize [S, S]-sized p/dp/ds
-    dq, dk, dv = _chunked_backward(
-        q, k, v, out, lse, g, causal, scale,
-        chunk=_bwd_chunk(k.shape[1], block_k),
-        prefix=prefix,
-    )
+    bq = _fit_block(q.shape[1], min(block_q, BWD_BLOCK))
+    bk = _fit_block(k.shape[1], min(block_k, BWD_BLOCK))
+    if (
+        USE_PALLAS_BWD
+        and pltpu is not None
+        and (_on_tpu() or INTERPRET)
+        and bq is not None
+        and bk is not None
+    ):
+        # FA2-style kernels; tiles capped at BWD_BLOCK — the backward
+        # holds ~4 [bq,bk] f32 transients per step, so it tiles smaller
+        # than the forward
+        dq, dk, dv = _pallas_backward(
+            q, k, v, out, lse, g, causal, scale, bq, bk,
+            prefix=prefix,
+        )
+    else:
+        # jnp chunked recompute: the off-TPU path (and the g_lse-carrying
+        # ring variant below). The chunk cap keeps p/dp/ds at
+        # [B,H,S,chunk] f32 regardless of the forward tile choice.
+        dq, dk, dv = _chunked_backward(
+            q, k, v, out, lse, g, causal, scale,
+            chunk=_bwd_chunk(k.shape[1], block_k),
+            prefix=prefix,
+        )
     # prefix is integer data: its cotangent is symbolically zero (float0)
     dprefix = (
         None
