@@ -70,7 +70,10 @@ def auto_accelerate(
     from dlrover_tpu.train.train_step import build_eval_step
 
     def init_state(rng):
-        return init_train_state(rng, cfg2, mesh, opt)
+        return init_train_state(
+            rng, cfg2, mesh, opt,
+            offload_opt_state=plan.offload_opt_state,
+        )
 
     return AccelerateResult(
         mesh=mesh,

@@ -67,6 +67,7 @@ def build_from_plan(cfg: ModelConfig, plan, devices=None):
         opt,
         grad_accum=plan.grad_accum,
         attn_impl=attn_impl,
+        offload_opt_state=plan.offload_opt_state,
     )
     return mesh, builder, opt, batch_sharding(mesh), cfg
 
@@ -91,7 +92,10 @@ def dry_run(
         batch = jax.device_put({"tokens": tokens, "targets": tokens}, bsh)
 
         t0 = time.perf_counter()
-        state = init_train_state(jax.random.key(0), cfg2, mesh, opt)
+        state = init_train_state(
+            jax.random.key(0), cfg2, mesh, opt,
+            offload_opt_state=plan.offload_opt_state,
+        )
         if cost_only:
             lowered = jax.jit(builder.step_fn).lower(state, batch)
             compiled = lowered.compile()

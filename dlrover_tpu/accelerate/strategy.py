@@ -35,6 +35,8 @@ class AccelerationPlan:
     # optimizer
     optimizer: str = "adamw"
     optimizer_state_dtype: Optional[str] = None
+    # host-offloaded moments (reference: atorch CPU-offload Adam)
+    offload_opt_state: bool = False
     # data
     grad_accum: int = 1
     # sequence parallelism flavour: none | ulysses | ring
@@ -109,6 +111,11 @@ def _bf16_optim(plan: AccelerationPlan, cfg: Dict) -> None:
     plan.optimizer_state_dtype = "bfloat16"
 
 
+def _offload_opt(plan: AccelerationPlan, cfg: Dict) -> None:
+    """Moments to pinned host memory (reference: CPU-offload Adam)."""
+    plan.offload_opt_state = cfg.get("enabled", True)
+
+
 def _grad_accum(plan: AccelerationPlan, cfg: Dict) -> None:
     plan.grad_accum = int(cfg.get("steps", 1))
 
@@ -143,6 +150,7 @@ OPTIMIZATION_LIBRARY: Dict[str, Callable[[AccelerationPlan, Dict], None]] = {
     "module_replace": _module_replace,
     "low_bit_optim": _low_bit_optim,
     "bf16_optim": _bf16_optim,
+    "offload_opt": _offload_opt,
     "grad_accum": _grad_accum,
     "optimizer": _optimizer,
     "data_parallel": _data_parallel,

@@ -11,9 +11,16 @@ Forward: classic FlashAttention-2 online-softmax over k/v blocks. Grid is
 (m/l running stats + f32 accumulator). Causal blocks above the diagonal are
 skipped entirely.
 
-Backward: chunked recompute at the jnp level (O(S) memory) via custom_vjp —
-numerically matches the reference path; a Pallas backward kernel can slot in
-later without touching callers.
+Backward: FlashAttention-2-style pallas kernels via custom_vjp — a dq pass
+(k-blocks innermost, dq carried in VMEM scratch) and a dk/dv pass (q-blocks
+innermost), both recomputing p from the saved lse; tiles capped at
+BWD_BLOCK (512 measured fastest on v5e — the backward holds ~4 [bq,bk] f32
+transients). A jnp-level chunked recompute remains for off-TPU runs and
+the ring-attention variant whose lse cotangent feeds the softmax merge.
+
+Both paths support GLM-style prefix-LM masking (per-batch prefix scalar in
+SMEM) and GQA (K/V shared across head groups via BlockSpec index maps, no
+materialized repeats).
 """
 
 import functools
@@ -49,6 +56,53 @@ USE_PALLAS_BWD = True
 BWD_BLOCK = 512
 
 
+def _block_runs(causal, has_prefix, pref, q_start, k_start, block_q):
+    """Run-gate shared by all kernels: a (q,k) block pair participates
+    unless it lies entirely above the causal diagonal — and with a
+    prefix-LM prefix, k blocks inside the prefix always participate."""
+    run = (not causal) or (k_start <= q_start + block_q - 1)
+    if causal and has_prefix:
+        run = jnp.logical_or(run, k_start < pref)
+    return run
+
+
+def _masked_scores(q, k, scale, q_start, k_start, block_q, block_k,
+                   causal, has_prefix, pref):
+    """q @ kᵀ with the causal / prefix-LM mask — the ONE place the mask
+    rule lives; forward and both backward kernels call it so they cannot
+    drift apart."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        allowed = q_pos >= k_pos
+        if has_prefix:
+            # GLM-style prefix-LM: keys inside the prefix are visible
+            # to every query (bidirectional prefix, causal tail)
+            allowed = jnp.logical_or(allowed, k_pos < pref)
+        s = jnp.where(allowed, s, NEG_INF)
+    return s
+
+
+def _p_and_ds(s, do, v, lse_col, delta_col, scale):
+    """Backward-shared softmax recompute: p from the saved lse, then
+    ds = p·(dp − delta)·scale."""
+    p = jnp.exp(s - lse_col)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_col) * scale
+    return p, ds
+
+
 def _fwd_kernel(
     q_ref,  # [block_q, d]
     k_ref,  # [block_k, d]
@@ -70,9 +124,10 @@ def _fwd_kernel(
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
-    if has_prefix:
-        # grid dim 0 is batch·heads; the scalar prefix is per-batch
-        pref = prefix_ref[pl.program_id(0) // n_head, 0]
+    # grid dim 0 is batch·heads; the scalar prefix is per-batch
+    pref = (
+        prefix_ref[pl.program_id(0) // n_head, 0] if has_prefix else None
+    )
 
     @pl.when(ki == 0)
     def _init():
@@ -83,37 +138,13 @@ def _fwd_kernel(
     q_start = qi * block_q
     k_start = ki * block_k
 
-    # skip blocks entirely above the causal diagonal (with a prefix-LM
-    # bidirectional prefix, above-diagonal blocks overlapping the prefix
-    # still run)
-    run = (not causal) or (k_start <= q_start + block_q - 1)
-    if causal and has_prefix:
-        run = jnp.logical_or(run, k_start < pref)
-
-    @pl.when(run)
+    @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
+                         block_q))
     def _body():
-        q = q_ref[0]  # [block_q, d]
-        k = k_ref[0]  # [block_k, d]
-        s = jax.lax.dot_general(
-            q,
-            k,
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s = _masked_scores(
+            q_ref[0], k_ref[0], scale, q_start, k_start,
+            block_q, block_k, causal, has_prefix, pref,
         )
-        s = s * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            allowed = q_pos >= k_pos
-            if has_prefix:
-                # GLM-style prefix-LM: keys inside the prefix are visible
-                # to every query (bidirectional prefix, causal tail)
-                allowed = jnp.logical_or(allowed, k_pos < pref)
-            s = jnp.where(allowed, s, NEG_INF)
 
         m_prev = m_scratch[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -171,8 +202,9 @@ def _bwd_dq_kernel(
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
-    if has_prefix:
-        pref = prefix_ref[pl.program_id(0) // n_head, 0]
+    pref = (
+        prefix_ref[pl.program_id(0) // n_head, 0] if has_prefix else None
+    )
 
     @pl.when(ki == 0)
     def _init():
@@ -180,37 +212,19 @@ def _bwd_dq_kernel(
 
     q_start = qi * block_q
     k_start = ki * block_k
-    run = (not causal) or (k_start <= q_start + block_q - 1)
-    if causal and has_prefix:
-        run = jnp.logical_or(run, k_start < pref)
 
-    @pl.when(run)
+    @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
+                         block_q))
     def _body():
-        q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            allowed = q_pos >= k_pos
-            if has_prefix:
-                allowed = jnp.logical_or(allowed, k_pos < pref)
-            s = jnp.where(allowed, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, :1])
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        s = _masked_scores(
+            q_ref[0], k, scale, q_start, k_start,
+            block_q, block_k, causal, has_prefix, pref,
         )
-        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        _, ds = _p_and_ds(
+            s, do_ref[0], v_ref[0],
+            lse_ref[0][:, :1], delta_ref[0][:, :1], scale,
+        )
         acc_scratch[:] = acc_scratch[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -239,8 +253,9 @@ def _bwd_dkv_kernel(
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
-    if has_prefix:
-        pref = prefix_ref[pl.program_id(0) // n_head, 0]
+    pref = (
+        prefix_ref[pl.program_id(0) // n_head, 0] if has_prefix else None
+    )
 
     @pl.when(qi == 0)
     def _init():
@@ -249,43 +264,24 @@ def _bwd_dkv_kernel(
 
     q_start = qi * block_q
     k_start = ki * block_k
-    # a q block contributes unless it lies entirely above the diagonal
-    # (and the k block is outside any bidirectional prefix)
-    run = (not causal) or (q_start + block_q - 1 >= k_start)
-    if causal and has_prefix:
-        run = jnp.logical_or(run, k_start < pref)
 
-    @pl.when(run)
+    @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
+                         block_q))
     def _body():
         q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            allowed = q_pos >= k_pos
-            if has_prefix:
-                allowed = jnp.logical_or(allowed, k_pos < pref)
-            s = jnp.where(allowed, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, :1])
+        s = _masked_scores(
+            q, k_ref[0], scale, q_start, k_start,
+            block_q, block_k, causal, has_prefix, pref,
+        )
+        p, ds = _p_and_ds(
+            s, do, v_ref[0],
+            lse_ref[0][:, :1], delta_ref[0][:, :1], scale,
+        )
         dv_scratch[:] = dv_scratch[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_ref[0][:, :1]) * scale
         dk_scratch[:] = dk_scratch[:] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
