@@ -368,11 +368,6 @@ def forward(
             "reference" if jax.default_backend() == "cpu" else "flash"
         )
 
-    if prefix_len is not None and attn_impl in ("ring", "ulysses"):
-        raise NotImplementedError(
-            "prefix_len is not threaded through sequence-parallel "
-            "attention yet — use attn_impl='flash' or 'reference'"
-        )
     if cfg.prefix_lm and prefix_len is None:
         # a GLM-family model silently training fully-causal is the worst
         # failure mode (looks healthy, learns the wrong objective) —
@@ -395,6 +390,7 @@ def forward(
                 causal=cfg.causal,
                 block_q=cfg.attn_block_q,
                 block_k=cfg.attn_block_k,
+                prefix_len=prefix_len,
             )
         if attn_impl == "ulysses":
             from dlrover_tpu.ops.pallas_attention import flash_attention
@@ -414,6 +410,7 @@ def forward(
                     block_q=cfg.attn_block_q,
                     block_k=cfg.attn_block_k,
                 ),
+                prefix_len=prefix_len,
             )
         if attn_impl == "reference":
             return mha_reference(

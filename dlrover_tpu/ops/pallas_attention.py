@@ -660,31 +660,44 @@ def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, prefix, causal, scale,
+                             block_q, block_k):
     """Flash attention returning (out, lse) with BOTH differentiable —
     the primitive ring attention composes (the lse feeds the cross-block
-    softmax merge, so its gradient is load-bearing)."""
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    softmax merge, so its gradient is load-bearing). ``prefix`` [B] int32
+    adds the prefix-LM bidirectional-prefix mask (causal only)."""
+    return _flash_fwd(
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+    )
 
 
-def _fwd_rule_lse(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+def _fwd_rule_lse(q, k, v, prefix, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+    )
     # same tags as _fwd_rule: lets remat policies (and the ring's scan
     # checkpoint) pin the residuals instead of re-running the kernel
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return (out, lse), (q, k, v, out, lse)
+    return (out, lse), (q, k, v, prefix, out, lse)
 
 
 def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
-    q, k, v, out, lse = residuals
+    q, k, v, prefix, out, lse = residuals
     g_out, g_lse = cot
-    return _chunked_backward(
+    dq, dk, dv = _chunked_backward(
         q, k, v, out, lse, g_out, causal, scale,
         chunk=_bwd_chunk(k.shape[1], block_k),
         g_lse=g_lse,
+        prefix=prefix,
     )
+    dprefix = (
+        None
+        if prefix is None
+        else np.zeros(prefix.shape, dtype=jax.dtypes.float0)
+    )
+    return dq, dk, dv, dprefix
 
 
 flash_attention_with_lse.defvjp(_fwd_rule_lse, _bwd_rule_lse)

@@ -51,17 +51,24 @@ def ulysses_attention(
     causal: bool = True,
     axis: str = "sp",
     attn_fn=None,
+    prefix_len: Optional[jax.Array] = None,  # [B] int32 prefix-LM
 ) -> jax.Array:
     """Exact attention with seq-sharded inputs/outputs.
 
     Inside: all-to-all turns [B, S/sp, H, D] into [B, S, H/sp, D]
     (full sequence, sharded heads), runs normal attention, and reverses.
+    ``prefix_len`` (GLM prefix-LM) passes straight through: the inner
+    attention sees the full sequence, so the mask rule is unchanged —
+    it just needs the batch-sharded prefix scalars inside the shard_map.
     """
     attn_fn = attn_fn or functools.partial(mha_reference, causal=causal)
     sp = mesh.shape[axis]
     if sp == 1:
+        if prefix_len is not None:
+            return attn_fn(q, k, v, prefix_len=prefix_len)
         return attn_fn(q, k, v)
-    def local(q, k, v):
+
+    def local(q, k, v, prefix=None):
         # both inner impls (mha_reference and the flash kernel) handle GQA
         # natively, so expand kv heads ONLY when sp can't split them — the
         # expanded all-to-all would move groups× more bytes over ICI.
@@ -83,19 +90,27 @@ def ulysses_attention(
             )
 
         qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-        out = attn_fn(qh, kh, vh)
+        if prefix is not None:
+            out = attn_fn(qh, kh, vh, prefix_len=prefix)
+        else:
+            out = attn_fn(qh, kh, vh)
         return gather_seq(out)
 
     # batch stays sharded over (dp, fsdp) and heads over tp — declaring
     # either replicated would all-gather it and duplicate attention work
     spec = P(("dp", "fsdp"), axis, _head_axis(mesh, q, k), None)
+    args = (q, k, v)
+    in_specs = (spec, spec, spec)
+    if prefix_len is not None:
+        args = args + (prefix_len,)
+        in_specs = in_specs + (P(("dp", "fsdp")),)
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(*args)
 
 
 def _head_axis(mesh: Mesh, q, k) -> Optional[str]:
@@ -115,9 +130,12 @@ def _head_axis(mesh: Mesh, q, k) -> Optional[str]:
 # ---------------------------------------------------------------------------
 
 
-def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
+def _block_attend(q, k, v, scale, q_offset, k_offset, causal,
+                  prefix=None):
     """Partial attention of local q against one k/v block.
 
+    ``q_offset``/``k_offset`` are the blocks' global positions; ``prefix``
+    [B] (global prefix-LM lengths) makes keys before it visible to all.
     Returns (unnormalised out [B,Sq,H,D], row max m [B,H,Sq], row sum l).
     """
     b, sq, h, d = q.shape
@@ -128,7 +146,12 @@ def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
     if causal:
         q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        allowed = (q_pos >= k_pos)[None, None]  # [1,1,Sq,Sk]
+        if prefix is not None:
+            allowed = allowed | (
+                k_pos[None, None] < prefix[:, None, None, None]
+            )
+        s = jnp.where(allowed, s, NEG_INF)
     m = jnp.max(s, axis=-1)  # [B,H,Sq]
     p = jnp.exp(s - m[..., None])
     # fully-masked rows: zero contribution, not NaN
@@ -138,36 +161,55 @@ def _block_attend(q, k, v, scale, q_offset, k_offset, causal):
     return out.astype(jnp.float32), m, l
 
 
-def _block_softmax_jnp(q, k, v, scale, q_offset, k_offset, causal):
+def _block_softmax_jnp(q, k, v, scale, q_offset, k_offset, causal,
+                       prefix=None):
     """Normalized partial attention of local q vs one k/v block.
 
     Returns (out [B,Sq,H,D] f32 normalized within the block,
     lse [B,H,Sq] f32; fully-masked rows: out 0, lse NEG_INF)."""
-    out_raw, m, l = _block_attend(q, k, v, scale, q_offset, k_offset, causal)
+    out_raw, m, l = _block_attend(
+        q, k, v, scale, q_offset, k_offset, causal, prefix=prefix
+    )
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = out_raw / l_safe.transpose(0, 2, 1)[..., None]
     lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
     return out, lse
 
 
-def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal, bq, bk):
+def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal,
+                         bq, bk, prefix=None):
     """Same contract via the Pallas flash kernel (O(block) memory inside).
 
     Ring blocks are equal-sized, so vs the local q block a k/v block is
     exactly one of: fully before (dense), diagonal (causal), fully after
     (empty). The relation is traced (the source rotates), so lax.switch
     picks the kernel variant.
+
+    With a prefix-LM ``prefix``, blocks at/after the diagonal run the
+    causal kernel with a block-local prefix: globally, keys < prefix[b]
+    are visible to every query, which inside this k block means the first
+    ``prefix - k_offset`` keys (clamped) — the kernel's own block-skip
+    keeps fully-dark blocks cheap. Before-diagonal blocks are already
+    fully visible (dense) either way.
     """
     from dlrover_tpu.ops.pallas_attention import flash_attention_with_lse
 
     b, sq, h, d = q.shape
 
     def dense(q, k, v):
-        out, lse = flash_attention_with_lse(q, k, v, False, scale, bq, bk)
+        out, lse = flash_attention_with_lse(
+            q, k, v, None, False, scale, bq, bk
+        )
         return out.astype(jnp.float32), lse
 
     def diagonal(q, k, v):
-        out, lse = flash_attention_with_lse(q, k, v, True, scale, bq, bk)
+        # the kernel masks by block-LOCAL positions (iota from 0), and a
+        # diagonal block has q and k at the same global offset — plain
+        # causal masking is correct; the prefix part is folded in below
+        # when present
+        out, lse = flash_attention_with_lse(
+            q, k, v, None, True, scale, bq, bk
+        )
         return out.astype(jnp.float32), lse
 
     def empty(q, k, v):
@@ -178,6 +220,47 @@ def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal, bq, bk):
 
     if not causal:
         return dense(q, k, v)
+    if prefix is not None:
+        # block-local prefix: how many of THIS k block's keys fall inside
+        # the global bidirectional prefix
+        local_pref = jnp.clip(prefix - k_offset, 0, k.shape[1]).astype(
+            jnp.int32
+        )
+
+        def causal_prefix(q, k, v):
+            # diagonal block: block-local causal mask (both offsets
+            # align) + the block-local slice of the prefix
+            out, lse = flash_attention_with_lse(
+                q, k, v, local_pref, True, scale, bq, bk
+            )
+            return out.astype(jnp.float32), lse
+
+        def prefix_only(q, k, v):
+            # after-block the prefix reaches into: causally nothing is
+            # visible, only keys inside the prefix. The kernel has no
+            # prefix-without-causal mode, so use the jnp block path with
+            # a hugely negative q offset (kills the causal term) and
+            # local k positions — O(Sq·Sk) scores, taken only when this
+            # block actually overlaps some batch element's prefix
+            k, v = _match_heads(q, k, v)  # jnp path needs equal heads
+            return _block_softmax_jnp(
+                q, k, v, scale, -(jnp.int32(1) << 30), 0,
+                True, prefix=local_pref,
+            )
+
+        # after-blocks no prefix reaches stay EMPTY — without this branch
+        # every after-block would pay prefix_only's dense score matrix
+        reach = jnp.max(local_pref) > 0
+        case = jnp.where(
+            k_offset < q_offset,
+            0,
+            jnp.where(
+                k_offset == q_offset, 1, jnp.where(reach, 2, 3)
+            ),
+        )
+        return jax.lax.switch(
+            case, (dense, causal_prefix, prefix_only, empty), q, k, v
+        )
     case = jnp.where(k_offset == q_offset, 1, jnp.where(k_offset < q_offset, 0, 2))
     return jax.lax.switch(case, (dense, diagonal, empty), q, k, v)
 
@@ -193,6 +276,7 @@ def ring_attention(
     softmax_scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
+    prefix_len: Optional[jax.Array] = None,  # [B] int32 prefix-LM
 ) -> jax.Array:
     """Exact attention over the full (sharded) sequence via a k/v ring.
 
@@ -212,9 +296,12 @@ def ring_attention(
         softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     )
     if sp == 1:
-        return mha_reference(q, k, v, causal=causal, softmax_scale=scale)
+        return mha_reference(
+            q, k, v, causal=causal, softmax_scale=scale,
+            prefix_len=prefix_len,
+        )
 
-    def local(q, k, v):
+    def local(q, k, v, prefix=None):
         from dlrover_tpu.ops import pallas_attention as pa
 
         idx = jax.lax.axis_index(axis)
@@ -240,11 +327,12 @@ def ring_attention(
             if use_flash:
                 out_blk, lse_blk = _block_softmax_flash(
                     q, k_blk, v_blk, scale, q_offset, k_offset, causal,
-                    bq, bk,
+                    bq, bk, prefix=prefix,
                 )
             else:
                 out_blk, lse_blk = _block_softmax_jnp(
-                    q, k_blk, v_blk, scale, q_offset, k_offset, causal
+                    q, k_blk, v_blk, scale, q_offset, k_offset, causal,
+                    prefix=prefix,
                 )
             # merge two normalized partials: logaddexp on lse, rescale outs
             lse_new = jnp.logaddexp(lse_run, lse_blk)
@@ -276,10 +364,15 @@ def ring_attention(
 
     # batch stays sharded over (dp, fsdp), heads over tp; seq rides the ring
     spec = P(("dp", "fsdp"), axis, _head_axis(mesh, q, k), None)
+    args = (q, k, v)
+    in_specs = (spec, spec, spec)
+    if prefix_len is not None:
+        args = args + (prefix_len,)
+        in_specs = in_specs + (P(("dp", "fsdp")),)
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(*args)

@@ -41,24 +41,6 @@ def _to_memory_kind(tree, kind):
     return jax.tree.map(lambda x: jax.device_put(x, kind), tree)
 
 
-def offload_to_host(tree):
-    """Move arrays to pinned host memory in place of their device copies
-    (outside jit; per-leaf shardings preserved, memory kind swapped).
-
-    On the CPU backend this is a no-op: CPU jit rejects mixed-memory-kind
-    inputs, and its 'device' memory already IS host RAM — the offload
-    code path stays testable on the virtual mesh while the transfer only
-    happens on real accelerators."""
-    if jax.default_backend() == "cpu":
-        return tree
-    return jax.device_put(
-        tree,
-        jax.tree.map(
-            lambda x: x.sharding.with_memory_kind("pinned_host"), tree
-        ),
-    )
-
-
 def batch_sharding(mesh: Mesh, rules=None) -> NamedSharding:
     """Sharding for [B, S] token batches."""
     rules = dict(shd.DEFAULT_RULES, **(rules or {}))
@@ -67,38 +49,60 @@ def batch_sharding(mesh: Mesh, rules=None) -> NamedSharding:
     )
 
 
-def _opt_state_host_shardings(opt_shape, params, param_shardings, mesh):
-    """Per-leaf pinned_host NamedShardings for an optimizer-state tree:
-    param-shaped subtrees inherit the param shardings (host kind), the
-    rest (step counters, quantized-array innards) replicate on host."""
+def _is_quantized(x) -> bool:
     from dlrover_tpu.ops.quant import QuantizedArray
 
-    def is_q(x):
-        return isinstance(x, QuantizedArray)
+    return isinstance(x, QuantizedArray)
 
+
+def _map_param_subtrees(
+    opt_tree, params, param_shardings, param_leaf_fn, other_fn
+):
+    """Map over an optimizer-state tree, matching param-STRUCTURED
+    subtrees (Adam mu/nu etc.) by tree structure, not leaf shape —
+    same-shape params can carry transposed shardings, and a shape-keyed
+    lookup would pin their moments to the wrong one.
+
+    ``param_leaf_fn(leaf, param_sharding)`` is applied leaf-wise inside
+    matched subtrees (QuantizedArray nodes treated as leaves);
+    ``other_fn(subtree)`` covers everything else (step counters, …).
+    The ONE structure-matching rule both the init constraints and the
+    host-offload shardings build on."""
     pdef = jax.tree.structure(params)
 
     def is_param_tree(x):
         try:
-            return jax.tree.structure(x, is_leaf=is_q) == pdef
+            return (
+                jax.tree.structure(x, is_leaf=_is_quantized) == pdef
+            )
         except Exception:  # noqa: BLE001
             return False
-
-    rep = NamedSharding(mesh, P(), memory_kind="pinned_host")
 
     def con(sub):
         if is_param_tree(sub):
             return jax.tree.map(
-                lambda leaf, s: jax.tree.map(lambda _: rep, leaf)
-                if is_q(leaf)
-                else s.with_memory_kind("pinned_host"),
-                sub,
-                param_shardings,
-                is_leaf=is_q,
+                param_leaf_fn, sub, param_shardings,
+                is_leaf=_is_quantized,
             )
-        return jax.tree.map(lambda _: rep, sub)
+        return other_fn(sub)
 
-    return jax.tree.map(con, opt_shape, is_leaf=is_param_tree)
+    return jax.tree.map(con, opt_tree, is_leaf=is_param_tree)
+
+
+def _opt_state_host_shardings(opt_shape, params, param_shardings, mesh):
+    """Per-leaf pinned_host NamedShardings for an optimizer-state tree:
+    param-shaped subtrees inherit the param shardings (host kind), the
+    rest (step counters, quantized-array innards) replicate on host."""
+    rep = NamedSharding(mesh, P(), memory_kind="pinned_host")
+    return _map_param_subtrees(
+        opt_shape,
+        params,
+        param_shardings,
+        param_leaf_fn=lambda leaf, s: jax.tree.map(lambda _: rep, leaf)
+        if _is_quantized(leaf)
+        else s.with_memory_kind("pinned_host"),
+        other_fn=lambda sub: jax.tree.map(lambda _: rep, sub),
+    )
 
 
 def init_train_state(
@@ -124,40 +128,19 @@ def init_train_state(
     # by involuntarily resharding the moments (XLA's "involuntary full
     # rematerialization" warning, a full moment-tree copy per step)
     def _constrain_like_params(opt_state, params):
-        # optax state nests whole param-shaped subtrees (Adam mu/nu etc.);
-        # match them by TREE STRUCTURE, not leaf shape — same-shape params
-        # can carry transposed shardings (wq ('embed','heads') vs wo
-        # ('heads','embed')), and a shape-keyed lookup would pin their
-        # moments to the wrong one. Quantized states (QuantizedArray
-        # leaves, different shapes) are treated as leaves for the match
-        # and left as-is — they are 4-8x smaller, so the per-step reshard
-        # this guards against is proportionally cheap for them.
-        from dlrover_tpu.ops.quant import QuantizedArray
-
-        def is_q(x):
-            return isinstance(x, QuantizedArray)
-
-        pdef = jax.tree.structure(params)
-
-        def is_param_tree(x):
-            try:
-                return jax.tree.structure(x, is_leaf=is_q) == pdef
-            except Exception:  # noqa: BLE001
-                return False
-
-        def con(sub):
-            if is_param_tree(sub):
-                return jax.tree.map(
-                    lambda leaf, s: leaf
-                    if is_q(leaf)
-                    else jax.lax.with_sharding_constraint(leaf, s),
-                    sub,
-                    param_shardings,
-                    is_leaf=is_q,
-                )
-            return sub
-
-        return jax.tree.map(con, opt_state, is_leaf=is_param_tree)
+        # optax state nests whole param-shaped subtrees (Adam mu/nu
+        # etc.) — matched by structure via _map_param_subtrees.
+        # Quantized states are left as-is: they are 4-8x smaller, so the
+        # per-step reshard this guards against is proportionally cheap.
+        return _map_param_subtrees(
+            opt_state,
+            params,
+            param_shardings,
+            param_leaf_fn=lambda leaf, s: leaf
+            if _is_quantized(leaf)
+            else jax.lax.with_sharding_constraint(leaf, s),
+            other_fn=lambda sub: sub,
+        )
 
     def f(rng):
         params = decoder.init(rng, cfg)
@@ -186,7 +169,10 @@ def init_train_state(
         )
 
     def f_opt(params):
-        return _constrain_like_params(optimizer.init(params), params)
+        # NO device-kind sharding constraints here — out_shardings below
+        # fully pins placement AND host memory kind, so the moments never
+        # materialize HBM-resident (the point of offloading)
+        return optimizer.init(params)
 
     params = jax.jit(f_params)(rng)
     opt_shape = jax.eval_shape(f_opt, params)

@@ -175,14 +175,31 @@ def test_glm_loss_and_grads_with_prefix_batch():
     )
 
 
-def test_prefix_rejected_on_sequence_parallel_paths():
-    cfg = get_config("tiny-glm")
+def test_glm_forward_on_sequence_parallel_mesh():
+    """GLM + ring/ulysses: prefix-LM logits on an sp mesh match the
+    single-device reference path."""
+    from dlrover_tpu.parallel import MeshConfig, build_mesh
+    from dlrover_tpu.parallel import sharding as shd
+
+    cfg = get_config("tiny-glm", max_seq=64, dtype="float32")
     params = decoder.init(jax.random.key(0), cfg)
-    tokens = jnp.zeros((2, 16), jnp.int32)
-    with pytest.raises(NotImplementedError, match="sequence-parallel"):
-        decoder.forward(
-            params, tokens, cfg,
-            prefix_len=jnp.ones((2,), jnp.int32), attn_impl="ring",
+    tokens = jax.random.randint(jax.random.key(1), (4, 64), 0, 1000)
+    prefix = jnp.array([10, 40, 0, 63], jnp.int32)
+    ref = decoder.forward(
+        params, tokens, cfg, prefix_len=prefix, attn_impl="reference"
+    )
+    mesh = build_mesh(MeshConfig(sp=4, dp=2))
+    shardings = shd.shardings_for_tree(mesh, decoder.logical_axes(cfg))
+    params_s = jax.device_put(params, shardings)
+    for impl in ("ring", "ulysses"):
+        out = jax.jit(
+            lambda p, t, pf: decoder.forward(
+                p, t, cfg, mesh=mesh, prefix_len=pf, attn_impl=impl
+            )
+        )(params_s, tokens, prefix)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=3e-3, atol=3e-3,
+            err_msg=impl,
         )
 
 
