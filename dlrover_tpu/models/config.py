@@ -46,7 +46,10 @@ class ModelConfig:
     # numerics
     dtype: str = "bfloat16"          # activation/compute dtype
     param_dtype: str = "float32"
-    # rematerialisation policy: none | full | dots_saveable | save_attn
+    # rematerialisation policy:
+    # none | full | dots_saveable | save_attn | offload_attn
+    # (offload_attn = save_attn with residuals in pinned host memory —
+    # reference: atorch selective_offloading_checkpoint.py)
     remat: str = "none"
     # MoE (0 = dense)
     n_experts: int = 0

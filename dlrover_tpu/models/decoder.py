@@ -291,6 +291,23 @@ def run_trunk(
                 "attn_out", "flash_out", "flash_lse"
             ),
         )
+    elif cfg.remat == "offload_attn":
+        # like save_attn, but the pinned residuals live in pinned host
+        # memory instead of HBM (reference: atorch's selective offloading
+        # checkpoint, auto/opt_lib/selective_offloading_checkpoint.py) —
+        # activation memory ~frees the O(L·B·S·D) attention outputs at
+        # the cost of host DMA traffic in backward
+        body = jax.checkpoint(
+            body,
+            policy=cp.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[
+                    "attn_out", "flash_out", "flash_lse"
+                ],
+                offload_src="device",
+                offload_dst="pinned_host",
+            ),
+        )
 
     zero_aux = {
         "moe_lb_loss": jnp.zeros([], jnp.float32),
@@ -346,7 +363,8 @@ def forward(
     the final-norm hidden states [B,S,D] instead of logits (value/reward
     heads attach here). ``prefix_len`` [B] int32 (prefix-LM configs):
     keys before prefix_len[b] are bidirectionally visible — GLM-style
-    blank infilling; flash and reference paths only.
+    blank infilling; supported on every attention path (flash,
+    reference, ring, ulysses).
     """
     dt = jnp.dtype(cfg.dtype)
     b, s = tokens.shape

@@ -61,6 +61,8 @@ def ulysses_attention(
     attention sees the full sequence, so the mask rule is unchanged —
     it just needs the batch-sharded prefix scalars inside the shard_map.
     """
+    if prefix_len is not None and not causal:
+        raise ValueError("prefix_len requires causal=True")
     attn_fn = attn_fn or functools.partial(mha_reference, causal=causal)
     sp = mesh.shape[axis]
     if sp == 1:
@@ -291,6 +293,8 @@ def ring_attention(
     ring-attention bound. Communication overlaps the next block's
     compute under XLA's scheduler.
     """
+    if prefix_len is not None and not causal:
+        raise ValueError("prefix_len requires causal=True")
     sp = mesh.shape[axis]
     scale = (
         softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5

@@ -74,6 +74,22 @@ def test_sharded_init_and_step(mesh):
     assert int(state["step"]) == 4
 
 
+def test_offload_attn_remat_matches_no_remat():
+    """remat='offload_attn' (selective activation offload to pinned
+    host) must not change gradients."""
+    cfg0 = get_config("tiny", dtype="float32")
+    cfgo = get_config("tiny", dtype="float32", remat="offload_attn")
+    params = decoder.init(jax.random.key(0), cfg0)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, 1000)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    g0 = jax.grad(lambda p: decoder.loss_fn(p, batch, cfg0)[0])(params)
+    go = jax.grad(lambda p: decoder.loss_fn(p, batch, cfgo)[0])(params)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(go)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
 def test_offloaded_opt_state_matches_resident(mesh):
     """Host-offloaded moments (CPU-offload-Adam parity): same numerics
     as HBM-resident state, and the moments actually live in pinned_host."""
