@@ -36,10 +36,77 @@ class JobMeta:
     strategy_json: str = ""
 
 
+class GoodputTracker:
+    """Training goodput = productive wall-time / total wall-time.
+
+    The reference's headline fault-tolerance metric (GLM-65B goodput
+    69% → 95%, README.md:57-58; flash-ckpt wasted-time reduction,
+    docs/blogs/flash_checkpoint.md:38-41). The master marks the job
+    STALLED from startup and from every node failure / hang kick until
+    the next global-step report arrives — so rendezvous, restart,
+    restore, and recompilation spans all land in lost time.
+    """
+
+    def __init__(self, now: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._start = now if now is not None else time.time()
+        self._stalled_since: Optional[float] = self._start
+        self._stall_step: Optional[int] = None
+        self._lost = 0.0
+
+    def mark_stalled(
+        self, now: Optional[float] = None, at_step: Optional[int] = None
+    ):
+        """``at_step``: the global step when the stall began — a later
+        step report only closes the stall once training ADVANCES past it
+        (an in-flight report from a surviving worker, processed moments
+        after a node died, must not mark the whole recovery productive).
+        """
+        with self._lock:
+            if self._stalled_since is None:
+                self._stalled_since = (
+                    now if now is not None else time.time()
+                )
+                self._stall_step = at_step
+
+    def mark_productive(
+        self, now: Optional[float] = None, step: Optional[int] = None
+    ):
+        with self._lock:
+            if self._stalled_since is None:
+                return
+            if (
+                step is not None
+                and self._stall_step is not None
+                and step <= self._stall_step
+            ):
+                return  # stale report from before/at the stall point
+            ts = now if now is not None else time.time()
+            self._lost += max(0.0, ts - self._stalled_since)
+            self._stalled_since = None
+            self._stall_step = None
+
+    def lost_seconds(self, now: Optional[float] = None) -> float:
+        with self._lock:
+            ts = now if now is not None else time.time()
+            lost = self._lost
+            if self._stalled_since is not None:
+                lost += max(0.0, ts - self._stalled_since)
+            return lost
+
+    def goodput(self, now: Optional[float] = None) -> float:
+        ts = now if now is not None else time.time()
+        wall = ts - self._start
+        if wall <= 0:
+            return 1.0
+        return max(0.0, 1.0 - self.lost_seconds(ts) / wall)
+
+
 class JobMetricCollector:
     def __init__(self, max_records: int = 4096):
         self._lock = threading.Lock()
         self.meta = JobMeta()
+        self.goodput_tracker: Optional[GoodputTracker] = None
         self.records: Deque[RuntimeRecord] = deque(maxlen=max_records)
         self.counters: Dict[str, float] = {
             "node_failures_total": 0,
@@ -80,23 +147,34 @@ class JobMetricCollector:
 
     # ---- export ----------------------------------------------------------
 
+    def _goodput(self) -> Optional[float]:
+        if self.goodput_tracker is None:
+            return None
+        return self.goodput_tracker.goodput()
+
     def to_json(self) -> str:
+        gp = self._goodput()
         with self._lock:
             return json.dumps(
                 {
                     "meta": asdict(self.meta),
                     "counters": dict(self.counters),
+                    "goodput": gp,
                     "records": [asdict(r) for r in list(self.records)[-100:]],
                 }
             )
 
     def prometheus_text(self) -> str:
         """Prometheus exposition format (xpu_timer-style export surface)."""
+        gp = self._goodput()
         with self._lock:
             lines = []
             for name, value in self.counters.items():
                 lines.append(f"# TYPE dlrover_tpu_{name} counter")
                 lines.append(f"dlrover_tpu_{name} {value}")
+            if gp is not None:
+                lines.append("# TYPE dlrover_tpu_goodput gauge")
+                lines.append(f"dlrover_tpu_goodput {gp}")
             if self.records:
                 last = self.records[-1]
                 gauges = {

@@ -73,12 +73,15 @@ class JobMaster:
         )
         from dlrover_tpu.diagnosis.manager import DiagnosisManager
         from dlrover_tpu.master.job_metrics import (
+            GoodputTracker,
             JobMetricCollector,
             MetricsHTTPServer,
         )
 
         self.diagnosis_manager = DiagnosisManager()
         self.metric_collector = JobMetricCollector()
+        self.goodput_tracker = GoodputTracker()
+        self.metric_collector.goodput_tracker = self.goodput_tracker
         self.metrics_server = MetricsHTTPServer(self.metric_collector, port=0)
         from dlrover_tpu.master.elastic_ps import ElasticPsService
 
@@ -92,6 +95,7 @@ class JobMaster:
             speed_monitor=self.speed_monitor,
             diagnosis_manager=self.diagnosis_manager,
             ps_service=self.ps_service,
+            goodput_tracker=self.goodput_tracker,
         )
         self.server = MasterTransportServer(self.servicer, port=port)
 
@@ -126,6 +130,11 @@ class JobMaster:
             mgr.remove_alive_node(node.rank_index)
         self.speed_monitor.reset_running_speed()
         self.metric_collector.inc("node_failures_total")
+        # goodput: lost time runs from here until a step report ADVANCES
+        # past the step training had reached when the node died
+        self.goodput_tracker.mark_stalled(
+            at_step=self.speed_monitor.global_step
+        )
 
     @property
     def port(self) -> int:
@@ -184,6 +193,9 @@ class JobMaster:
                     # Cooldown: ckpt + re-rendezvous takes a while before
                     # fresh CPU samples land — don't re-kick every tick.
                     self._last_hang_kick = time.time()
+                    self.goodput_tracker.mark_stalled(
+                        at_step=self.speed_monitor.global_step
+                    )
                     logger.warning("all nodes idle — prescribing restart")
                     self.diagnosis_manager.queue_action_for(
                         [n.id for n in self.job_manager.running_nodes()],

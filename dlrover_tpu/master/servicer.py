@@ -26,6 +26,7 @@ class MasterServicer:
         speed_monitor=None,
         diagnosis_manager=None,
         ps_service=None,
+        goodput_tracker=None,
     ):
         self.job_manager = job_manager
         self.task_manager = task_manager
@@ -35,6 +36,7 @@ class MasterServicer:
         self.speed_monitor = speed_monitor
         self.diagnosis_manager = diagnosis_manager
         self.ps_service = ps_service
+        self.goodput_tracker = goodput_tracker
         self._ckpt_steps = {}  # node_rank -> step (flash-ckpt rank sync)
 
     # ---- report: fire-and-forget ----------------------------------------
@@ -141,6 +143,12 @@ class MasterServicer:
             self.speed_monitor.collect_global_step(
                 m.global_step, m.timestamp or time.time()
             )
+        if self.goodput_tracker:
+            # a step report means training is making forward progress —
+            # closes any stall opened by startup or a node failure, but
+            # only once the step ADVANCES past the stall point (stale
+            # in-flight reports must not hide the recovery span)
+            self.goodput_tracker.mark_productive(step=m.global_step)
         return True
 
     def _report_network_check(self, m: msgs.NetworkCheckResult) -> bool:

@@ -107,6 +107,38 @@ def test_config_tuner_writes_file(tmp_path):
     assert not tuner.poll_once()
 
 
+def test_goodput_tracker():
+    from dlrover_tpu.master.job_metrics import GoodputTracker
+
+    t = GoodputTracker(now=100.0)
+    # startup counts as stalled until the first step report
+    t.mark_productive(now=110.0)          # first step at t+10
+    assert t.goodput(now=110.0) == pytest.approx(0.0)
+    assert t.goodput(now=210.0) == pytest.approx(1 - 10 / 110)
+    # node failure at t+110 (training was at step 50) → a STALE in-flight
+    # report at/below the stall step must not close the stall
+    t.mark_stalled(now=210.0, at_step=50)
+    t.mark_stalled(now=215.0)             # idempotent while stalled
+    t.mark_productive(now=212.0, step=50)  # stale — ignored
+    t.mark_productive(now=240.0, step=51)  # real progress
+    assert t.lost_seconds(now=240.0) == pytest.approx(40.0)
+    # 300s wall, 40s lost → 86.7% goodput
+    assert t.goodput(now=400.0) == pytest.approx(1 - 40 / 300)
+    # productive while not stalled is a no-op
+    t.mark_productive(now=500.0)
+    assert t.lost_seconds(now=500.0) == pytest.approx(40.0)
+
+
+def test_goodput_exported():
+    from dlrover_tpu.master.job_metrics import GoodputTracker
+
+    col = JobMetricCollector()
+    col.goodput_tracker = GoodputTracker(now=0.0)
+    col.goodput_tracker.mark_productive(now=0.0)
+    assert "dlrover_tpu_goodput" in col.prometheus_text()
+    assert json.loads(col.to_json())["goodput"] is not None
+
+
 def test_metrics_export_http():
     col = JobMetricCollector()
     col.set_job_meta(job_name="j", model_name="tiny", num_params=123)
