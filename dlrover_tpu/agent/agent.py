@@ -11,6 +11,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from collections import deque
@@ -207,6 +208,19 @@ class ElasticTrainingAgent:
                 if p
             ),
         }
+        if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+            # persistent XLA compile cache across worker restarts: the
+            # re-mesh hard part (SURVEY §7) — a restarted worker whose
+            # mesh shape was compiled before (same world, or a prior
+            # round at the new world size) skips the multi-minute
+            # recompile, which dominates the <60s recovery budget
+            # uid suffix: a fixed shared path breaks (unwritable) or is
+            # poisonable for the second user on a multi-tenant host
+            env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+                tempfile.gettempdir(),
+                f"dlrover_tpu_jit_cache_{os.getuid()}",
+            )
+            env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1"
         env.update(self.config.env)
         return env
 

@@ -153,7 +153,11 @@ class DiagnosisManager:
         with self._lock:
             return self._pending_actions.pop(node_id, [])
 
-    def all_nodes_hanged(self, min_duration_s: float = 600.0) -> bool:
+    # idle window before a job-wide hang verdict; also how far goodput
+    # accounting backdates the stall (progress stopped at window start)
+    HANG_WINDOW_S = 600.0
+
+    def all_nodes_hanged(self, min_duration_s: float = HANG_WINDOW_S) -> bool:
         """Every node's CPU has been ~idle for the window → job hang
         (reference: dist_job_manager.py:802 all_running_node_hanged)."""
         now = time.time()

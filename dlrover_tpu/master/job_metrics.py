@@ -70,11 +70,23 @@ class GoodputTracker:
                 self._stall_step = at_step
 
     def mark_productive(
-        self, now: Optional[float] = None, step: Optional[int] = None
+        self,
+        now: Optional[float] = None,
+        step: Optional[int] = None,
+        report_ts: Optional[float] = None,
     ):
+        """``report_ts``: when the step was actually taken (worker-side
+        timestamp). A report generated BEFORE the stall opened is
+        in-flight state from the pre-failure world — it proves nothing
+        about recovery, whatever its step number (a surviving rank can
+        race the failure with a step above the master's last-seen one).
+        Clock skew between hosts shifts this boundary by the skew; the
+        step guard below is the skew-free backstop."""
         with self._lock:
             if self._stalled_since is None:
                 return
+            if report_ts is not None and report_ts <= self._stalled_since:
+                return  # sent before the stall opened — in-flight
             if (
                 step is not None
                 and self._stall_step is not None

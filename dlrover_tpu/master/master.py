@@ -193,8 +193,12 @@ class JobMaster:
                     # Cooldown: ckpt + re-rendezvous takes a while before
                     # fresh CPU samples land — don't re-kick every tick.
                     self._last_hang_kick = time.time()
+                    # progress stopped at the START of the idle window,
+                    # not at kick time — backdate the stall accordingly
                     self.goodput_tracker.mark_stalled(
-                        at_step=self.speed_monitor.global_step
+                        now=time.time()
+                        - self.diagnosis_manager.HANG_WINDOW_S,
+                        at_step=self.speed_monitor.global_step,
                     )
                     logger.warning("all nodes idle — prescribing restart")
                     self.diagnosis_manager.queue_action_for(

@@ -88,6 +88,14 @@ class MasterServicer:
                 m.error_data[:200],
             )
             return True
+        if self.goodput_tracker:
+            # worker-crash restarts (the common recovery path) stall the
+            # job until a post-restart step report advances past here
+            self.goodput_tracker.mark_stalled(
+                at_step=self.speed_monitor.global_step
+                if self.speed_monitor
+                else None
+            )
         if self.diagnosis_manager:
             rec = self.diagnosis_manager.collect_failure(m)
             # an abort is a job-level verdict — every node must stop, not
@@ -146,9 +154,12 @@ class MasterServicer:
         if self.goodput_tracker:
             # a step report means training is making forward progress —
             # closes any stall opened by startup or a node failure, but
-            # only once the step ADVANCES past the stall point (stale
-            # in-flight reports must not hide the recovery span)
-            self.goodput_tracker.mark_productive(step=m.global_step)
+            # only for steps TAKEN after the stall opened and ADVANCING
+            # past the stall point (in-flight/stale reports from
+            # surviving ranks must not hide the recovery span)
+            self.goodput_tracker.mark_productive(
+                step=m.global_step, report_ts=m.timestamp or None
+            )
         return True
 
     def _report_network_check(self, m: msgs.NetworkCheckResult) -> bool:

@@ -102,3 +102,34 @@ def test_sampler_short_tail_pads_equally():
         s.load_state_dict({"epoch": 0, "completed": 9})
         counts.append(len(list(iter(s))))
     assert len(set(counts)) == 1 and counts[0] >= 1
+
+
+def test_worker_env_sets_persistent_compile_cache(monkeypatch):
+    """Restarted workers must share an XLA compile cache — the re-mesh
+    recovery-time lever (SURVEY §7): same-shape restarts skip recompile."""
+    from dlrover_tpu.agent.agent import (
+        ElasticLaunchConfig,
+        ElasticTrainingAgent,
+    )
+    from dlrover_tpu.agent.rendezvous import RendezvousOutcome
+
+    class _T:
+        addr = "localhost:1"
+
+    class _Client:
+        _t = _T()
+        node_rank = 0
+
+    agent = ElasticTrainingAgent(ElasticLaunchConfig(), _Client())
+    outcome = RendezvousOutcome(
+        round=1, world={0: 1}, coordinator="localhost:7010",
+        process_id=0, num_processes=1, global_chips=1,
+    )
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    env = agent._worker_env(outcome)
+    assert env["JAX_COMPILATION_CACHE_DIR"]
+    assert env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "1"
+    # an operator-set cache dir wins (worker env inherits os.environ)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/custom")
+    env = agent._worker_env(outcome)
+    assert "JAX_COMPILATION_CACHE_DIR" not in env  # inherited, not forced

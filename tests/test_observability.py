@@ -119,8 +119,12 @@ def test_goodput_tracker():
     # report at/below the stall step must not close the stall
     t.mark_stalled(now=210.0, at_step=50)
     t.mark_stalled(now=215.0)             # idempotent while stalled
-    t.mark_productive(now=212.0, step=50)  # stale — ignored
-    t.mark_productive(now=240.0, step=51)  # real progress
+    t.mark_productive(now=212.0, step=50)  # stale step — ignored
+    # racing in-flight report: step ABOVE the stall point but taken
+    # before the stall opened — must not close it
+    t.mark_productive(now=213.0, step=51, report_ts=209.0)
+    # real post-restart progress (taken after the stall opened)
+    t.mark_productive(now=240.0, step=51, report_ts=239.5)
     assert t.lost_seconds(now=240.0) == pytest.approx(40.0)
     # 300s wall, 40s lost → 86.7% goodput
     assert t.goodput(now=400.0) == pytest.approx(1 - 40 / 300)
