@@ -49,6 +49,7 @@ class ElasticLaunchConfig:
     rdzv_timeout_s: float = DefaultValues.RDZV_TIMEOUT_S
     network_check: bool = False
     comm_perf_test: bool = False
+    exclude_straggler: bool = False
     node_unit: int = 1
     coordinator_port: int = 7010
     entrypoint: List[str] = field(default_factory=list)
@@ -122,6 +123,33 @@ class WorkerProcess:
         except subprocess.TimeoutExpired:
             self._proc.kill()
             self._proc.wait()
+
+
+def _compile_cache_dir() -> Optional[str]:
+    """Private per-user compile-cache dir, or None if one can't be had.
+
+    The path under /tmp is predictable, so it MUST be owned by us with
+    no group/other access — a pre-created attacker-owned dir would let
+    another local user read or poison serialized XLA executables that
+    workers deserialize on restart. On any mismatch fall back to a fresh
+    per-job mkdtemp (persistence across jobs is lost, safety is not).
+    """
+    path = os.path.join(
+        tempfile.gettempdir(), f"dlrover_tpu_jit_cache_{os.getuid()}"
+    )
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.stat(path)
+        if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+            logger.warning(
+                "compile cache dir %s is not a private dir we own; "
+                "using a per-job dir instead",
+                path,
+            )
+            return tempfile.mkdtemp(prefix="dlrover_tpu_jit_cache_")
+        return path
+    except OSError:
+        return None
 
 
 class ElasticTrainingAgent:
@@ -214,13 +242,10 @@ class ElasticTrainingAgent:
             # mesh shape was compiled before (same world, or a prior
             # round at the new world size) skips the multi-minute
             # recompile, which dominates the <60s recovery budget
-            # uid suffix: a fixed shared path breaks (unwritable) or is
-            # poisonable for the second user on a multi-tenant host
-            env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
-                tempfile.gettempdir(),
-                f"dlrover_tpu_jit_cache_{os.getuid()}",
-            )
-            env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1"
+            cache_dir = _compile_cache_dir()
+            if cache_dir:
+                env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+                env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1"
         env.update(self.config.env)
         return env
 

@@ -48,6 +48,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         action="store_true",
         help="run the matmul+collective health check before training",
     )
+    p.add_argument(
+        "--exclude-straggler",
+        action="store_true",
+        help="with --network-check: a node the check flags as a "
+        "straggler exits instead of joining (and slowing) the world "
+        "(reference: dlrover-run --exclude-straggler)",
+    )
     p.add_argument("--node-unit", type=int, default=1)
     p.add_argument("--monitor-interval", type=float, default=2.0)
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
@@ -118,6 +125,16 @@ def _run_network_check(client: MasterClient, config: ElasticLaunchConfig):
         sys.exit(3)
     if status.stragglers:
         logger.warning("stragglers detected: %s", status.stragglers)
+        if (
+            config.exclude_straggler
+            and client.node_rank in status.stragglers
+        ):
+            logger.error(
+                "this node is a straggler and --exclude-straggler is "
+                "set; exiting"
+            )
+            client.report_node_status(NodeStatus.CHECK_FAILED)
+            sys.exit(3)
 
 
 def run(args: argparse.Namespace) -> int:
@@ -146,6 +163,7 @@ def run(args: argparse.Namespace) -> int:
         max_restarts=args.max_restarts,
         monitor_interval_s=args.monitor_interval,
         network_check=args.network_check,
+        exclude_straggler=args.exclude_straggler,
         node_unit=args.node_unit,
         entrypoint=args.entrypoint,
     )

@@ -51,22 +51,35 @@ class GoodputTracker:
         self._lock = threading.Lock()
         self._start = now if now is not None else time.time()
         self._stalled_since: Optional[float] = self._start
+        self._stall_guard_ts: float = self._start
         self._stall_step: Optional[int] = None
+        self._last_close: float = self._start
         self._lost = 0.0
 
     def mark_stalled(
-        self, now: Optional[float] = None, at_step: Optional[int] = None
+        self,
+        now: Optional[float] = None,
+        at_step: Optional[int] = None,
+        accounted_from: Optional[float] = None,
     ):
         """``at_step``: the global step when the stall began — a later
         step report only closes the stall once training ADVANCES past it
         (an in-flight report from a surviving worker, processed moments
         after a node died, must not mark the whole recovery productive).
+
+        ``accounted_from``: backdated start for LOST-TIME accounting
+        (hang detection learns of a stall only after its idle window) —
+        clamped to the last stall close so no second is charged twice.
+        The report-timestamp guard still uses ``now`` (detection time):
+        reports taken inside the idle window prove nothing either way,
+        but their steps cannot advance past ``at_step`` while hung.
         """
         with self._lock:
             if self._stalled_since is None:
-                self._stalled_since = (
-                    now if now is not None else time.time()
-                )
+                ts = now if now is not None else time.time()
+                acct = accounted_from if accounted_from is not None else ts
+                self._stalled_since = max(acct, self._last_close)
+                self._stall_guard_ts = ts
                 self._stall_step = at_step
 
     def mark_productive(
@@ -85,8 +98,8 @@ class GoodputTracker:
         with self._lock:
             if self._stalled_since is None:
                 return
-            if report_ts is not None and report_ts <= self._stalled_since:
-                return  # sent before the stall opened — in-flight
+            if report_ts is not None and report_ts <= self._stall_guard_ts:
+                return  # sent before the stall was detected — in-flight
             if (
                 step is not None
                 and self._stall_step is not None
@@ -97,6 +110,7 @@ class GoodputTracker:
             self._lost += max(0.0, ts - self._stalled_since)
             self._stalled_since = None
             self._stall_step = None
+            self._last_close = ts
 
     def lost_seconds(self, now: Optional[float] = None) -> float:
         with self._lock:

@@ -194,11 +194,12 @@ class JobMaster:
                     # fresh CPU samples land — don't re-kick every tick.
                     self._last_hang_kick = time.time()
                     # progress stopped at the START of the idle window,
-                    # not at kick time — backdate the stall accordingly
+                    # not at kick time — backdate the lost-time
+                    # accounting (clamped inside the tracker)
                     self.goodput_tracker.mark_stalled(
-                        now=time.time()
-                        - self.diagnosis_manager.HANG_WINDOW_S,
                         at_step=self.speed_monitor.global_step,
+                        accounted_from=time.time()
+                        - self.diagnosis_manager.HANG_WINDOW_S,
                     )
                     logger.warning("all nodes idle — prescribing restart")
                     self.diagnosis_manager.queue_action_for(

@@ -248,6 +248,18 @@ CONFIGS = {
     ),
     "gptneox-20b": _gptneox("gptneox-20b", 44, 64, 6144),
     "glm-10b": _glm("glm-10b", 48, 64, 4096),
+    # sparse flagship: Mixtral-style MoE decoder (GQA + top-2 routing);
+    # the ep mesh axis + explicit all-to-all dispatch carry it
+    "mixtral-8x7b": replace(
+        _llama(
+            "mixtral-8x7b", 32, 32, 4096, 14336,
+            max_seq=8192, n_kv_head=8,
+        ),
+        n_experts=8,
+        expert_top_k=2,
+        moe_aux_coef=0.01,
+        moe_z_coef=0.001,
+    ),
 }
 
 

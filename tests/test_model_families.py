@@ -235,6 +235,22 @@ def test_neox_cached_decode_matches_forward():
     )
 
 
+def test_mixtral_style_config():
+    """MoE flagship preset: GQA + top-2 routing wired through forward."""
+    big = get_config("mixtral-8x7b")
+    assert big.n_experts == 8 and big.expert_top_k == 2
+    assert big.kv_heads == 8 and big.n_head == 32
+    cfg = get_config(
+        "mixtral-8x7b", n_layer=2, n_head=4, n_kv_head=2, d_model=128,
+        d_ff=256, vocab_size=512, max_seq=64, n_experts=4,
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 512)
+    logits, aux = decoder.forward(params, tokens, cfg, return_aux=True)
+    assert logits.shape == (2, 16, 512)
+    assert float(aux["moe_lb_loss"]) > 0.0  # router aux losses collected
+
+
 def test_glm_sample_runs_uncached():
     from dlrover_tpu.models.generate import greedy
 

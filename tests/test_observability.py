@@ -132,6 +132,20 @@ def test_goodput_tracker():
     t.mark_productive(now=500.0)
     assert t.lost_seconds(now=500.0) == pytest.approx(40.0)
 
+    # hang backdating: detection at t+500 backdates accounting to t+420,
+    # clamped to the last close (t+240 in this history is older, so the
+    # full backdate stands); the in-flight guard keys on DETECTION time
+    t.mark_stalled(now=500.0, at_step=80, accounted_from=420.0)
+    t.mark_productive(now=505.0, step=81, report_ts=460.0)  # in-window
+    assert t.lost_seconds(now=505.0) == pytest.approx(40.0 + 85.0)
+    t.mark_productive(now=520.0, step=81, report_ts=510.0)
+    assert t.lost_seconds(now=520.0) == pytest.approx(40.0 + 100.0)
+    # a backdate reaching before the last close is clamped — the span
+    # [520, 530] is charged once even though accounted_from says 400
+    t.mark_stalled(now=530.0, at_step=90, accounted_from=400.0)
+    t.mark_productive(now=540.0, step=91, report_ts=539.0)
+    assert t.lost_seconds(now=540.0) == pytest.approx(140.0 + 20.0)
+
 
 def test_goodput_exported():
     from dlrover_tpu.master.job_metrics import GoodputTracker
