@@ -125,31 +125,49 @@ class WorkerProcess:
             self._proc.wait()
 
 
+_compile_cache_memo: List[Optional[str]] = []
+
+
 def _compile_cache_dir() -> Optional[str]:
     """Private per-user compile-cache dir, or None if one can't be had.
 
-    The path under /tmp is predictable, so it MUST be owned by us with
-    no group/other access — a pre-created attacker-owned dir would let
-    another local user read or poison serialized XLA executables that
-    workers deserialize on restart. On any mismatch fall back to a fresh
-    per-job mkdtemp (persistence across jobs is lost, safety is not).
+    The path under /tmp is predictable, so it MUST be a real directory
+    (lstat — a pre-created symlink would redirect the cache to an
+    attacker-chosen location) owned by us with no group/other access:
+    another local user able to write it could poison serialized XLA
+    executables that workers deserialize on restart. On any mismatch
+    fall back to a per-job mkdtemp (cross-job persistence is lost,
+    safety is not) — memoized so every elastic restart of this agent
+    reuses ONE dir and the within-job cache keeps working.
     """
+    if _compile_cache_memo:
+        return _compile_cache_memo[0]
     path = os.path.join(
         tempfile.gettempdir(), f"dlrover_tpu_jit_cache_{os.getuid()}"
     )
+    result: Optional[str]
     try:
         os.makedirs(path, mode=0o700, exist_ok=True)
-        st = os.stat(path)
-        if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+        st = os.lstat(path)
+        import stat as stat_mod
+
+        if (
+            not stat_mod.S_ISDIR(st.st_mode)
+            or st.st_uid != os.getuid()
+            or (st.st_mode & 0o077)
+        ):
             logger.warning(
-                "compile cache dir %s is not a private dir we own; "
-                "using a per-job dir instead",
+                "compile cache dir %s is not a private directory we "
+                "own; using a per-job dir instead",
                 path,
             )
-            return tempfile.mkdtemp(prefix="dlrover_tpu_jit_cache_")
-        return path
+            result = tempfile.mkdtemp(prefix="dlrover_tpu_jit_cache_")
+        else:
+            result = path
     except OSError:
-        return None
+        result = None
+    _compile_cache_memo.append(result)
+    return result
 
 
 class ElasticTrainingAgent:

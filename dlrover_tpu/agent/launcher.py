@@ -49,6 +49,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="run the matmul+collective health check before training",
     )
     p.add_argument(
+        "--comm-perf-test",
+        action="store_true",
+        help="sweep allreduce sizes across local chips and log bus "
+        "bandwidth before training (reference: dlrover-run "
+        "--comm-perf-test)",
+    )
+    p.add_argument(
         "--exclude-straggler",
         action="store_true",
         help="with --network-check: a node the check flags as a "
@@ -163,6 +170,7 @@ def run(args: argparse.Namespace) -> int:
         max_restarts=args.max_restarts,
         monitor_interval_s=args.monitor_interval,
         network_check=args.network_check,
+        comm_perf_test=args.comm_perf_test,
         exclude_straggler=args.exclude_straggler,
         node_unit=args.node_unit,
         entrypoint=args.entrypoint,
@@ -184,6 +192,10 @@ def run(args: argparse.Namespace) -> int:
     try:
         if config.network_check:
             _run_network_check(client, config)
+        if config.comm_perf_test:
+            from dlrover_tpu.agent.node_check import run_comm_perf_test
+
+            run_comm_perf_test()
         agent = ElasticTrainingAgent(config, client)
         try:
             from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver

@@ -70,6 +70,41 @@ def collective_bench(n_elems: int = 1 << 24, iters: int = 4) -> float:
     return time.perf_counter() - t0
 
 
+def run_comm_perf_test(sizes=(1 << 20, 1 << 24, 1 << 27)) -> dict:
+    """Sweep allreduce sizes and report algorithmic bus bandwidth
+    (reference: dlrover-run --comm-perf-test). Returns {bytes: GB/s}
+    keyed by the PER-DEVICE reduced-buffer size; logs a warning when the
+    largest size runs below half the best observed bandwidth (a
+    congested/degraded link)."""
+    n = len(jax.devices())
+    if n < 2:
+        logger.info("comm perf: skipped — fewer than 2 devices")
+        return {}
+    iters = 4
+    results = {}
+    for n_elems in sizes:
+        secs = collective_bench(n_elems=n_elems, iters=iters)
+        # collective_bench shards [n, n_elems/n]: each device allreduces
+        # an n_elems/n-element bf16 buffer; a ring moves 2(n-1)/n of
+        # that buffer per device
+        nbytes = (n_elems // n) * 2
+        algo_bytes = 2 * (n - 1) / n * nbytes * iters
+        results[nbytes] = (algo_bytes / secs / 1e9) if secs > 0 else 0.0
+    vals = [v for v in results.values() if v > 0]
+    if vals and results[max(results)] < 0.5 * max(vals):
+        logger.warning(
+            "comm perf: largest allreduce at %.2f GB/s, well below the "
+            "best observed %.2f GB/s — link may be degraded",
+            results[max(results)],
+            max(vals),
+        )
+    for nbytes, gbps in results.items():
+        logger.info(
+            "comm perf: allreduce %6.1f MB → %7.2f GB/s", nbytes / 1e6, gbps
+        )
+    return results
+
+
 def run_node_check(mock_error: bool = False) -> Tuple[bool, float]:
     """Returns (succeeded, elapsed_seconds)."""
     try:

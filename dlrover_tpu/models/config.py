@@ -259,6 +259,7 @@ CONFIGS = {
         expert_top_k=2,
         moe_aux_coef=0.01,
         moe_z_coef=0.001,
+        moe_alltoall=True,  # ep>1 meshes must not replicate expert acts
     ),
 }
 

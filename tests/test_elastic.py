@@ -133,3 +133,14 @@ def test_worker_env_sets_persistent_compile_cache(monkeypatch):
     monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/custom")
     env = agent._worker_env(outcome)
     assert "JAX_COMPILATION_CACHE_DIR" not in env  # inherited, not forced
+
+
+def test_comm_perf_test_reports_bandwidth():
+    """--comm-perf-test sweep: positive GB/s per payload size on the
+    8-device mesh, keyed by payload bytes."""
+    from dlrover_tpu.agent.node_check import run_comm_perf_test
+
+    res = run_comm_perf_test(sizes=(1 << 16, 1 << 18))
+    # keys are PER-DEVICE reduced-buffer bytes: (elems/8 devices) · 2B
+    assert set(res) == {(1 << 16) // 8 * 2, (1 << 18) // 8 * 2}
+    assert all(v > 0 for v in res.values())
