@@ -165,7 +165,9 @@ def _compile_cache_dir() -> Optional[str]:
         else:
             result = path
     except OSError:
-        result = None
+        # transient (ENOSPC, perms mid-cleanup): do NOT memoize — let the
+        # next restart retry rather than losing the cache for the job
+        return None
     _compile_cache_memo.append(result)
     return result
 

@@ -22,7 +22,10 @@ from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.agent.agent import ElasticLaunchConfig, ElasticTrainingAgent
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.monitor import ResourceMonitor
-from dlrover_tpu.agent.node_check import run_node_check
+from dlrover_tpu.agent.node_check import (
+    run_comm_perf_test,
+    run_node_check,
+)
 
 logger = get_logger(__name__)
 
@@ -193,9 +196,10 @@ def run(args: argparse.Namespace) -> int:
         if config.network_check:
             _run_network_check(client, config)
         if config.comm_perf_test:
-            from dlrover_tpu.agent.node_check import run_comm_perf_test
-
-            run_comm_perf_test()
+            try:
+                run_comm_perf_test()
+            except Exception:  # noqa: BLE001 — diagnostic, never fatal
+                logger.warning("comm perf test failed", exc_info=True)
         agent = ElasticTrainingAgent(config, client)
         try:
             from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver

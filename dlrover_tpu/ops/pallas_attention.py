@@ -15,8 +15,10 @@ Backward: FlashAttention-2-style pallas kernels via custom_vjp — a dq pass
 (k-blocks innermost, dq carried in VMEM scratch) and a dk/dv pass (q-blocks
 innermost), both recomputing p from the saved lse; tiles capped at
 BWD_BLOCK (512 measured fastest on v5e — the backward holds ~4 [bq,bk] f32
-transients). A jnp-level chunked recompute remains for off-TPU runs and
-the ring-attention variant whose lse cotangent feeds the softmax merge.
+transients). The ring-attention variant's lse cotangent folds into the
+per-row delta before the kernels, so the SAME kernels serve it. A
+jnp-level chunked recompute remains as the off-TPU / untileable-shape
+fallback.
 
 Both paths support GLM-style prefix-LM masking (per-batch prefix scalar in
 SMEM) and GQA (K/V shared across head groups via BlockSpec index maps, no
@@ -295,11 +297,14 @@ def _bwd_dkv_kernel(
 
 def _pallas_backward(q, k, v, out, lse, g, causal, scale,
                      block_q, block_k, prefix=None,
-                     interpret: Optional[bool] = None):
+                     interpret: Optional[bool] = None,
+                     g_lse=None):
     """FA2-style pallas backward: returns (dq, dk, dv).
 
     All [B,S,H,D] layouts like the forward; GQA dk/dv are group-summed
-    back to the kv head count.
+    back to the kv head count. ``g_lse`` [B,H,S] (ring attention's lse
+    cotangent) folds into the per-row delta — ∂lse/∂s_j = p_j, so it
+    enters ds as an additive term and the kernels need no change.
     """
     interpret = INTERPRET if interpret is None else interpret
     b, sq, h, d = q.shape
@@ -322,9 +327,12 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [B, S, H]
+    delta_bh = delta.transpose(0, 2, 1).reshape(b * h, sq)
+    if g_lse is not None:
+        # total ds = p·(dp − delta + g_lse): subtract here once
+        delta_bh = delta_bh - g_lse.reshape(b * h, sq).astype(jnp.float32)
     delta8 = jnp.broadcast_to(
-        delta.transpose(0, 2, 1).reshape(b * h, sq)[..., None],
-        (b * h, sq, 8),
+        delta_bh[..., None], (b * h, sq, 8)
     )
     lse8 = jnp.broadcast_to(
         lse.reshape(b * h, sq)[..., None], (b * h, sq, 8)
@@ -622,39 +630,10 @@ def _fwd_rule(q, k, v, prefix, causal, scale, block_q, block_k):
 
 
 def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
-    q, k, v, prefix, out, lse = residuals
-    bq = _fit_block(q.shape[1], min(block_q, BWD_BLOCK))
-    bk = _fit_block(k.shape[1], min(block_k, BWD_BLOCK))
-    if (
-        USE_PALLAS_BWD
-        and pltpu is not None
-        and (_on_tpu() or INTERPRET)
-        and bq is not None
-        and bk is not None
-    ):
-        # FA2-style kernels; tiles capped at BWD_BLOCK — the backward
-        # holds ~4 [bq,bk] f32 transients per step, so it tiles smaller
-        # than the forward
-        dq, dk, dv = _pallas_backward(
-            q, k, v, out, lse, g, causal, scale, bq, bk,
-            prefix=prefix,
-        )
-    else:
-        # jnp chunked recompute: the off-TPU path (and the g_lse-carrying
-        # ring variant below). The chunk cap keeps p/dp/ds at
-        # [B,H,S,chunk] f32 regardless of the forward tile choice.
-        dq, dk, dv = _chunked_backward(
-            q, k, v, out, lse, g, causal, scale,
-            chunk=_bwd_chunk(k.shape[1], block_k),
-            prefix=prefix,
-        )
-    # prefix is integer data: its cotangent is symbolically zero (float0)
-    dprefix = (
-        None
-        if prefix is None
-        else np.zeros(prefix.shape, dtype=jax.dtypes.float0)
+    # same dispatch as the lse-carrying variant, with no lse cotangent
+    return _bwd_rule_lse(
+        causal, scale, block_q, block_k, residuals, (g, None)
     )
-    return dq, dk, dv, dprefix
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
@@ -684,14 +663,33 @@ def _fwd_rule_lse(q, k, v, prefix, causal, scale, block_q, block_k):
 
 
 def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
+    """The ONE backward dispatch (plain _bwd_rule delegates here with a
+    None lse cotangent): FA2 pallas kernels on TPU/interpret with tiles
+    capped at BWD_BLOCK (~4 [bq,bk] f32 transients per grid step, so
+    smaller than the forward's); jnp chunked recompute off-TPU or when
+    the sequence doesn't tile to a lane-aligned block."""
     q, k, v, prefix, out, lse = residuals
     g_out, g_lse = cot
-    dq, dk, dv = _chunked_backward(
-        q, k, v, out, lse, g_out, causal, scale,
-        chunk=_bwd_chunk(k.shape[1], block_k),
-        g_lse=g_lse,
-        prefix=prefix,
-    )
+    bq = _fit_block(q.shape[1], min(block_q, BWD_BLOCK))
+    bk = _fit_block(k.shape[1], min(block_k, BWD_BLOCK))
+    if (
+        USE_PALLAS_BWD
+        and pltpu is not None
+        and (_on_tpu() or INTERPRET)
+        and bq is not None
+        and bk is not None
+    ):
+        dq, dk, dv = _pallas_backward(
+            q, k, v, out, lse, g_out, causal, scale, bq, bk,
+            prefix=prefix, g_lse=g_lse,
+        )
+    else:
+        dq, dk, dv = _chunked_backward(
+            q, k, v, out, lse, g_out, causal, scale,
+            chunk=_bwd_chunk(k.shape[1], block_k),
+            g_lse=g_lse,
+            prefix=prefix,
+        )
     dprefix = (
         None
         if prefix is None
