@@ -58,6 +58,20 @@ USE_PALLAS_BWD = True
 BWD_BLOCK = 512
 
 
+def _last_visible_k_block(i, block_q, block_k):
+    """Highest k-block index the causal run gate admits for q block i —
+    the DMA-clamp twin of _block_runs: index maps clamp to this so
+    gate-skipped blocks are never fetched. Any change to the gate's
+    geometry must land here too."""
+    return ((i + 1) * block_q - 1) // block_k
+
+
+def _first_visible_q_block(j, n_q_blocks, block_q, block_k):
+    """Lowest q-block index the causal run gate admits for k block j,
+    clamped into range (causal with sk > sq can otherwise exceed it)."""
+    return jnp.minimum((j * block_k) // block_q, n_q_blocks - 1)
+
+
 def _block_runs(causal, has_prefix, pref, q_start, k_start, block_q):
     """Run-gate shared by all kernels: a (q,k) block pair participates
     unless it lies entirely above the causal diagonal — and with a
@@ -356,11 +370,21 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
         has_prefix=has_prefix,
         n_head=h,
     )
+    causal_clamp = causal and prefix is None
+
+    # dq grid (g, q-block i, k-block j): above-diagonal k blocks are
+    # compute-skipped; clamp their index so pallas re-addresses (and
+    # skips refetching) the previous block instead of DMAing dead data
+    def k_idx(g_, i, j):
+        if causal_clamp:
+            j = jnp.minimum(
+                j, _last_visible_k_block(i, block_q, block_k)
+            )
+        return (g_ // groups, j, 0)
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda g_, i, j: (g_, i, 0))
     row8_spec = pl.BlockSpec((1, block_q, 8), lambda g_, i, j: (g_, i, 0))
-    k_spec = pl.BlockSpec(
-        (1, block_k, d), lambda g_, i, j: (g_ // groups, j, 0)
-    )
+    k_spec = pl.BlockSpec((1, block_k, d), k_idx)
     compiler_params = (
         None
         if interpret
@@ -381,9 +405,19 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
         interpret=interpret,
     )(qt, kt, vt, dot, lse8, delta8, *extra)
 
-    # dkv grid swaps the roles: k-blocks outer, q-blocks inner
-    qkv_spec = pl.BlockSpec((1, block_q, d), lambda g_, j, i: (g_, i, 0))
-    row8_spec2 = pl.BlockSpec((1, block_q, 8), lambda g_, j, i: (g_, i, 0))
+    # dkv grid swaps the roles: k-blocks outer, q-blocks inner; q blocks
+    # entirely above the diagonal contribute nothing — clamp their index
+    nq = sq // block_q
+
+    def q_idx(g_, j, i):
+        if causal_clamp:
+            i = jnp.maximum(
+                i, _first_visible_q_block(j, nq, block_q, block_k)
+            )
+        return (g_, i, 0)
+
+    qkv_spec = pl.BlockSpec((1, block_q, d), q_idx)
+    row8_spec2 = pl.BlockSpec((1, block_q, 8), q_idx)
     kv_in_spec = pl.BlockSpec(
         (1, block_k, d), lambda g_, j, i: (g_ // groups, j, 0)
     )
@@ -466,17 +500,26 @@ def _flash_fwd(
         # blocking, so no per-step BlockSpec windowing here)
         prefix_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
         kernel_fn = kernel
+    if causal and prefix is None:
+        # above-diagonal blocks are compute-skipped by the run gate, but
+        # a naive index map still DMAs them; clamping j to the last
+        # visible block re-addresses the SAME block, which pallas does
+        # not refetch — nearly halves K/V HBM traffic at long sequence.
+        # (A prefix can make above-diagonal blocks live, so no clamp.)
+        def kv_index(g, i, j):
+            j_max = _last_visible_k_block(i, block_q, block_k)
+            return (g // groups, jnp.minimum(j, j_max), 0)
+    else:
+        def kv_index(g, i, j):
+            return (g // groups, j, 0)
+
     out, lse = pl.pallas_call(
         kernel_fn,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec(
-                (1, block_k, d), lambda g, i, j: (g // groups, j, 0)
-            ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda g, i, j: (g // groups, j, 0)
-            ),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
             *prefix_specs,
         ],
         out_specs=[

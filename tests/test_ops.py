@@ -76,6 +76,34 @@ def test_pallas_backward_matches_reference(causal, hkv):
                                rtol=2e-3, atol=2e-3)
 
 
+def test_pallas_backward_unequal_seq_lens():
+    """Regression: causal sk > sq must not clamp the dkv q-block index
+    out of range (jnp.maximum alone could exceed nq-1). Compared against
+    the chunked backward, which shares the kernel's mask convention."""
+    from dlrover_tpu.ops import pallas_attention as pa
+
+    ks = jax.random.split(jax.random.key(6), 4)
+    b, sq, sk, h, d = 2, 128, 256, 2, 32
+    q = jax.random.normal(ks[0], (b, sq, h, d))
+    k = jax.random.normal(ks[1], (b, sk, h, d))
+    v = jax.random.normal(ks[2], (b, sk, h, d))
+    scale = d**-0.5
+    out, lse = pa._flash_fwd(
+        q, k, v, True, scale, block_q=128, block_k=128, interpret=True
+    )
+    g = jax.random.normal(ks[3], out.shape)
+    dq, dk, dv = pa._pallas_backward(
+        q, k, v, out, lse, g, True, scale, 128, 128, interpret=True
+    )
+    rq, rk, rv = pa._chunked_backward(
+        q, k, v, out, lse, g, True, scale, chunk=128
+    )
+    for a, r in zip((dq, dk, dv), (rq, rk, rv)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), rtol=2e-3, atol=2e-3
+        )
+
+
 def test_pallas_backward_via_custom_vjp(monkeypatch):
     """The full _flash_attention custom_vjp routes through the pallas
     backward when INTERPRET is on."""
