@@ -386,6 +386,11 @@ def forward(
             "reference" if jax.default_backend() == "cpu" else "flash"
         )
 
+    if cfg.attn_window and attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            "attn_window is not threaded through sequence-parallel "
+            "attention yet — use attn_impl='flash' or 'reference'"
+        )
     if cfg.prefix_lm and prefix_len is None:
         # a GLM-family model silently training fully-causal is the worst
         # failure mode (looks healthy, learns the wrong objective) —
@@ -432,7 +437,8 @@ def forward(
             )
         if attn_impl == "reference":
             return mha_reference(
-                q, k, v, causal=cfg.causal, prefix_len=prefix_len
+                q, k, v, causal=cfg.causal, prefix_len=prefix_len,
+                window=cfg.attn_window,
             )
         from dlrover_tpu.ops.pallas_attention import flash_attention
 
@@ -444,6 +450,7 @@ def forward(
             block_q=cfg.attn_block_q,
             block_k=cfg.attn_block_k,
             prefix_len=prefix_len,
+            window=cfg.attn_window,
         )
 
     x, aux = run_trunk(
@@ -556,6 +563,9 @@ def _cached_attention(q, ck, cv, pos, cfg: ModelConfig):
         ck.astype(jnp.float32),
     ) * scale
     mask = jnp.arange(smax) <= pos
+    if cfg.attn_window:
+        # sliding window in decode: only the last attn_window cache slots
+        mask = mask & (jnp.arange(smax) > pos - cfg.attn_window)
     s = jnp.where(mask[None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgs,bskd->bkgd", p, cv.astype(jnp.float32))

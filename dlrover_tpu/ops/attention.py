@@ -39,12 +39,15 @@ def mha_reference(
     segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     prefix_len: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Plain attention. q:[B,S,H,D], k/v:[B,S,Hkv,D] → [B,S,H,D].
 
     ``prefix_len`` [B] int32 (causal only): GLM-style prefix-LM — keys at
     positions < prefix_len[b] are visible to every query (bidirectional
-    prefix), the rest follow the causal mask.
+    prefix), the rest follow the causal mask. ``window`` (causal only):
+    Mistral-style sliding window — each query sees the last ``window``
+    positions only.
     """
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -60,6 +63,14 @@ def mha_reference(
         q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         mask = q_pos >= k_pos - (sk - sq)
+        if window:
+            if window < 0:
+                raise ValueError(f"window must be >= 0, got {window}")
+            if prefix_len is not None:
+                raise ValueError(
+                    "window and prefix_len are mutually exclusive"
+                )
+            mask = mask & ((k_pos - (sk - sq)) > q_pos - window)
         if prefix_len is not None:
             pmask = (
                 mask[None]
@@ -70,6 +81,8 @@ def mha_reference(
             logits = jnp.where(mask[None, None], logits, -1e30)
     elif prefix_len is not None:
         raise ValueError("prefix_len requires causal=True")
+    elif window:
+        raise ValueError("window requires causal=True")
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
         logits = jnp.where(seg_mask[:, None, :sq, :sk], logits, -1e30)

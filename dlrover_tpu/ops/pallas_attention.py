@@ -66,27 +66,49 @@ def _last_visible_k_block(i, block_q, block_k):
     return ((i + 1) * block_q - 1) // block_k
 
 
+def _first_window_k_block(i, block_q, block_k, window):
+    """Lowest k-block index a sliding window admits for q block i:
+    its oldest row sees back to q_start − window + 1."""
+    return jnp.maximum(0, (i * block_q - window + 1) // block_k)
+
+
 def _first_visible_q_block(j, n_q_blocks, block_q, block_k):
     """Lowest q-block index the causal run gate admits for k block j,
     clamped into range (causal with sk > sq can otherwise exceed it)."""
     return jnp.minimum((j * block_k) // block_q, n_q_blocks - 1)
 
 
-def _block_runs(causal, has_prefix, pref, q_start, k_start, block_q):
+def _last_window_q_block(j, n_q_blocks, block_q, block_k, window):
+    """Highest q-block index a sliding window admits for k block j: its
+    newest key is visible up to k_end + window − 1."""
+    return jnp.minimum(
+        ((j + 1) * block_k - 1 + window - 1) // block_q, n_q_blocks - 1
+    )
+
+
+def _block_runs(causal, has_prefix, pref, q_start, k_start, block_q,
+                block_k=None, window=0):
     """Run-gate shared by all kernels: a (q,k) block pair participates
-    unless it lies entirely above the causal diagonal — and with a
-    prefix-LM prefix, k blocks inside the prefix always participate."""
+    unless it lies entirely above the causal diagonal or (with a
+    sliding window) entirely below it — and with a prefix-LM prefix,
+    k blocks inside the prefix always participate."""
     run = (not causal) or (k_start <= q_start + block_q - 1)
+    if causal and window:
+        # the OLDEST q row (q_start) sees back to q_start − window + 1;
+        # a k block ending before that is outside every row's window
+        run = jnp.logical_and(
+            run, k_start + block_k - 1 >= q_start - window + 1
+        )
     if causal and has_prefix:
         run = jnp.logical_or(run, k_start < pref)
     return run
 
 
 def _masked_scores(q, k, scale, q_start, k_start, block_q, block_k,
-                   causal, has_prefix, pref):
-    """q @ kᵀ with the causal / prefix-LM mask — the ONE place the mask
-    rule lives; forward and both backward kernels call it so they cannot
-    drift apart."""
+                   causal, has_prefix, pref, window=0):
+    """q @ kᵀ with the causal / prefix-LM / sliding-window mask — the
+    ONE place the mask rule lives; forward and both backward kernels
+    call it so they cannot drift apart."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -99,6 +121,10 @@ def _masked_scores(q, k, scale, q_start, k_start, block_q, block_k,
             jnp.int32, (block_q, block_k), 1
         )
         allowed = q_pos >= k_pos
+        if window:
+            # Mistral-style sliding window: each query sees the last
+            # `window` positions (itself included)
+            allowed = jnp.logical_and(allowed, q_pos - k_pos < window)
         if has_prefix:
             # GLM-style prefix-LM: keys inside the prefix are visible
             # to every query (bidirectional prefix, causal tail)
@@ -136,6 +162,7 @@ def _fwd_kernel(
     block_k: int,
     has_prefix: bool,
     n_head: int = 1,
+    window: int = 0,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -155,11 +182,11 @@ def _fwd_kernel(
     k_start = ki * block_k
 
     @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
-                         block_q))
+                         block_q, block_k, window))
     def _body():
         s = _masked_scores(
             q_ref[0], k_ref[0], scale, q_start, k_start,
-            block_q, block_k, causal, has_prefix, pref,
+            block_q, block_k, causal, has_prefix, pref, window=window,
         )
 
         m_prev = m_scratch[:, :1]  # [bq, 1]
@@ -211,6 +238,7 @@ def _bwd_dq_kernel(
     block_k: int,
     has_prefix: bool,
     n_head: int,
+    window: int = 0,
 ):
     """dq = Σ_k ds @ K with ds = p·(dp − delta)·scale, p recomputed from
     the saved lse — FlashAttention-2 backward, k-blocks innermost so dq
@@ -230,12 +258,12 @@ def _bwd_dq_kernel(
     k_start = ki * block_k
 
     @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
-                         block_q))
+                         block_q, block_k, window))
     def _body():
         k = k_ref[0]
         s = _masked_scores(
             q_ref[0], k, scale, q_start, k_start,
-            block_q, block_k, causal, has_prefix, pref,
+            block_q, block_k, causal, has_prefix, pref, window=window,
         )
         _, ds = _p_and_ds(
             s, do_ref[0], v_ref[0],
@@ -263,6 +291,7 @@ def _bwd_dkv_kernel(
     block_k: int,
     has_prefix: bool,
     n_head: int,
+    window: int = 0,
 ):
     """dk/dv accumulated per k-block with q-blocks innermost:
     dv = Σ_q pᵀ @ dO, dk = Σ_q dsᵀ @ Q."""
@@ -282,13 +311,13 @@ def _bwd_dkv_kernel(
     k_start = ki * block_k
 
     @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
-                         block_q))
+                         block_q, block_k, window))
     def _body():
         q = q_ref[0]
         do = do_ref[0]
         s = _masked_scores(
             q, k_ref[0], scale, q_start, k_start,
-            block_q, block_k, causal, has_prefix, pref,
+            block_q, block_k, causal, has_prefix, pref, window=window,
         )
         p, ds = _p_and_ds(
             s, do, v_ref[0],
@@ -312,7 +341,7 @@ def _bwd_dkv_kernel(
 def _pallas_backward(q, k, v, out, lse, g, causal, scale,
                      block_q, block_k, prefix=None,
                      interpret: Optional[bool] = None,
-                     g_lse=None):
+                     g_lse=None, window: int = 0):
     """FA2-style pallas backward: returns (dq, dk, dv).
 
     All [B,S,H,D] layouts like the forward; GQA dk/dv are group-summed
@@ -369,17 +398,23 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
         block_k=block_k,
         has_prefix=has_prefix,
         n_head=h,
+        window=window,
     )
     causal_clamp = causal and prefix is None
 
-    # dq grid (g, q-block i, k-block j): above-diagonal k blocks are
-    # compute-skipped; clamp their index so pallas re-addresses (and
-    # skips refetching) the previous block instead of DMAing dead data
+    # dq grid (g, q-block i, k-block j): above-diagonal (and, windowed,
+    # below-window) k blocks are compute-skipped; clamp their index so
+    # pallas re-addresses (and skips refetching) the previous block
+    # instead of DMAing dead data
     def k_idx(g_, i, j):
         if causal_clamp:
             j = jnp.minimum(
                 j, _last_visible_k_block(i, block_q, block_k)
             )
+            if window:
+                j = jnp.maximum(
+                    j, _first_window_k_block(i, block_q, block_k, window)
+                )
         return (g_ // groups, j, 0)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda g_, i, j: (g_, i, 0))
@@ -414,6 +449,13 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
             i = jnp.maximum(
                 i, _first_visible_q_block(j, nq, block_q, block_k)
             )
+            if window:
+                i = jnp.minimum(
+                    i,
+                    _last_window_q_block(
+                        j, nq, block_q, block_k, window
+                    ),
+                )
         return (g_, i, 0)
 
     qkv_spec = pl.BlockSpec((1, block_q, d), q_idx)
@@ -460,6 +502,7 @@ def _flash_fwd(
     block_k: int,
     interpret: Optional[bool] = None,
     prefix: Optional[jax.Array] = None,  # [B] int32 prefix-LM lengths
+    window: int = 0,  # sliding window (causal only; 0 = unlimited)
 ) -> jax.Array:
     interpret = INTERPRET if interpret is None else interpret
     b, sq, h, d = q.shape
@@ -488,6 +531,7 @@ def _flash_fwd(
         block_k=block_k,
         has_prefix=prefix is not None,
         n_head=h,
+        window=window,
     )
     if prefix is None:
         inputs = (qt, kt, vt)
@@ -501,14 +545,19 @@ def _flash_fwd(
         prefix_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
         kernel_fn = kernel
     if causal and prefix is None:
-        # above-diagonal blocks are compute-skipped by the run gate, but
-        # a naive index map still DMAs them; clamping j to the last
-        # visible block re-addresses the SAME block, which pallas does
-        # not refetch — nearly halves K/V HBM traffic at long sequence.
+        # above-diagonal (and, with a sliding window, below-window)
+        # blocks are compute-skipped by the run gate, but a naive index
+        # map still DMAs them; clamping j re-addresses the SAME block,
+        # which pallas does not refetch — saves the dead K/V traffic.
         # (A prefix can make above-diagonal blocks live, so no clamp.)
         def kv_index(g, i, j):
             j_max = _last_visible_k_block(i, block_q, block_k)
-            return (g // groups, jnp.minimum(j, j_max), 0)
+            j = jnp.minimum(j, j_max)
+            if window:
+                j = jnp.maximum(
+                    j, _first_window_k_block(i, block_q, block_k, window)
+                )
+            return (g // groups, j, 0)
     else:
         def kv_index(g, i, j):
             return (g // groups, j, 0)
@@ -559,7 +608,7 @@ def _bwd_chunk(sk: int, block_k: int) -> int:
 
 
 def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
-                      g_lse=None, prefix=None):
+                      g_lse=None, prefix=None, window=0):
     """True O(S·chunk) flash backward from saved (out, lse).
 
     ``g_lse`` [B,H,S]: optional cotangent of the lse output (ring
@@ -620,6 +669,10 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
         if causal:
             k_pos = idx * chunk + jnp.arange(chunk)
             mask = q_pos[:, None] >= k_pos[None, :]
+            if window:
+                mask = mask & (
+                    q_pos[:, None] - k_pos[None, :] < window
+                )
             if prefix is not None:
                 # bidirectional prefix: [B,1,1,Q,C] per-batch mask
                 pmask = (
@@ -652,18 +705,22 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
 )
-def _flash_attention(q, k, v, prefix, causal, scale, block_q, block_k):
+def _flash_attention(q, k, v, prefix, causal, scale, block_q, block_k,
+                     window=0):
     out, _ = _flash_fwd(
-        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix,
+        window=window,
     )
     return out
 
 
-def _fwd_rule(q, k, v, prefix, causal, scale, block_q, block_k):
+def _fwd_rule(q, k, v, prefix, causal, scale, block_q, block_k,
+              window=0):
     out, lse = _flash_fwd(
-        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix,
+        window=window,
     )
     # named so remat policies can pin the kernel residuals in memory and
     # skip re-running the forward kernel in backward (decoder save_attn)
@@ -672,31 +729,34 @@ def _fwd_rule(q, k, v, prefix, causal, scale, block_q, block_k):
     return out, (q, k, v, prefix, out, lse)
 
 
-def _bwd_rule(causal, scale, block_q, block_k, residuals, g):
+def _bwd_rule(causal, scale, block_q, block_k, window, residuals, g):
     # same dispatch as the lse-carrying variant, with no lse cotangent
     return _bwd_rule_lse(
-        causal, scale, block_q, block_k, residuals, (g, None)
+        causal, scale, block_q, block_k, window, residuals, (g, None)
     )
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_with_lse(q, k, v, prefix, causal, scale,
-                             block_q, block_k):
+                             block_q, block_k, window=0):
     """Flash attention returning (out, lse) with BOTH differentiable —
     the primitive ring attention composes (the lse feeds the cross-block
     softmax merge, so its gradient is load-bearing). ``prefix`` [B] int32
     adds the prefix-LM bidirectional-prefix mask (causal only)."""
     return _flash_fwd(
-        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix,
+        window=window,
     )
 
 
-def _fwd_rule_lse(q, k, v, prefix, causal, scale, block_q, block_k):
+def _fwd_rule_lse(q, k, v, prefix, causal, scale, block_q, block_k,
+                  window=0):
     out, lse = _flash_fwd(
-        q, k, v, causal, scale, block_q, block_k, prefix=prefix
+        q, k, v, causal, scale, block_q, block_k, prefix=prefix,
+        window=window,
     )
     # same tags as _fwd_rule: lets remat policies (and the ring's scan
     # checkpoint) pin the residuals instead of re-running the kernel
@@ -705,7 +765,8 @@ def _fwd_rule_lse(q, k, v, prefix, causal, scale, block_q, block_k):
     return (out, lse), (q, k, v, prefix, out, lse)
 
 
-def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
+def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
+                  cot):
     """The ONE backward dispatch (plain _bwd_rule delegates here with a
     None lse cotangent): FA2 pallas kernels on TPU/interpret with tiles
     capped at BWD_BLOCK (~4 [bq,bk] f32 transients per grid step, so
@@ -724,7 +785,7 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
     ):
         dq, dk, dv = _pallas_backward(
             q, k, v, out, lse, g_out, causal, scale, bq, bk,
-            prefix=prefix, g_lse=g_lse,
+            prefix=prefix, g_lse=g_lse, window=window,
         )
     else:
         dq, dk, dv = _chunked_backward(
@@ -732,6 +793,7 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, residuals, cot):
             chunk=_bwd_chunk(k.shape[1], block_k),
             g_lse=g_lse,
             prefix=prefix,
+            window=window,
         )
     dprefix = (
         None
@@ -754,12 +816,15 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     prefix_len: Optional[jax.Array] = None,  # [B] int32: prefix-LM
+    window: int = 0,  # sliding window (causal only; 0 = unlimited)
 ) -> jax.Array:
     """Flash attention; falls back to the jnp path off-TPU.
 
     q: [B, S, H, D]; k/v: [B, S, Hkv, D] (GQA via fewer kv heads).
     ``prefix_len`` (causal only) makes keys at positions < prefix_len[b]
     visible to every query — GLM-style bidirectional-prefix attention.
+    ``window`` (causal only) limits each query to the last ``window``
+    positions — Mistral-style sliding-window attention.
     """
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     sq, sk = q.shape[1], k.shape[1]
@@ -767,6 +832,13 @@ def flash_attention(
     bk = _fit_block(sk, block_k)
     if prefix_len is not None and not causal:
         raise ValueError("prefix_len requires causal=True")
+    if window:
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if prefix_len is not None:
+            raise ValueError("window and prefix_len are mutually exclusive")
     if pltpu is None or not _on_tpu() or bq is None or bk is None:
         # off-TPU (incl. GPU — this is a Mosaic-TPU kernel), or seq not
         # tileable to a lane-aligned block: plain jnp, never a trace-time
@@ -775,9 +847,11 @@ def flash_attention(
 
         return mha_reference(
             q, k, v, causal=causal, softmax_scale=scale,
-            prefix_len=prefix_len,
+            prefix_len=prefix_len, window=window,
         )
-    return _flash_attention(q, k, v, prefix_len, causal, scale, bq, bk)
+    return _flash_attention(
+        q, k, v, prefix_len, causal, scale, bq, bk, window
+    )
 
 
 def _on_tpu() -> bool:
