@@ -386,10 +386,11 @@ def forward(
             "reference" if jax.default_backend() == "cpu" else "flash"
         )
 
-    if cfg.attn_window and attn_impl in ("ring", "ulysses"):
+    if cfg.attn_window and attn_impl == "ring":
         raise NotImplementedError(
-            "attn_window is not threaded through sequence-parallel "
-            "attention yet — use attn_impl='flash' or 'reference'"
+            "attn_window is not threaded through ring attention yet "
+            "(rotating blocks need cross-block window offsets) — use "
+            "attn_impl='ulysses', 'flash', or 'reference'"
         )
     if cfg.prefix_lm and prefix_len is None:
         # a GLM-family model silently training fully-causal is the worst
@@ -434,6 +435,7 @@ def forward(
                     block_k=cfg.attn_block_k,
                 ),
                 prefix_len=prefix_len,
+                window=cfg.attn_window,
             )
         if attn_impl == "reference":
             return mha_reference(

@@ -52,23 +52,36 @@ def ulysses_attention(
     axis: str = "sp",
     attn_fn=None,
     prefix_len: Optional[jax.Array] = None,  # [B] int32 prefix-LM
+    window: int = 0,  # sliding window (causal only)
 ) -> jax.Array:
     """Exact attention with seq-sharded inputs/outputs.
 
     Inside: all-to-all turns [B, S/sp, H, D] into [B, S, H/sp, D]
     (full sequence, sharded heads), runs normal attention, and reverses.
-    ``prefix_len`` (GLM prefix-LM) passes straight through: the inner
-    attention sees the full sequence, so the mask rule is unchanged —
-    it just needs the batch-sharded prefix scalars inside the shard_map.
+    ``prefix_len`` (GLM prefix-LM) and ``window`` (sliding window) pass
+    straight through: the inner attention sees the full sequence with
+    its true global positions, so the mask rules are unchanged.
     """
     if prefix_len is not None and not causal:
         raise ValueError("prefix_len requires causal=True")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     attn_fn = attn_fn or functools.partial(mha_reference, causal=causal)
+
+    def _call_attn(q, k, v, prefix=None):
+        # forward the mask args only when set, so custom attn_fns that
+        # don't take them keep working; a set window/prefix reaches EVERY
+        # attn_fn (never silently dropped for custom ones)
+        kw = {}
+        if prefix is not None:
+            kw["prefix_len"] = prefix
+        if window:
+            kw["window"] = window
+        return attn_fn(q, k, v, **kw)
+
     sp = mesh.shape[axis]
     if sp == 1:
-        if prefix_len is not None:
-            return attn_fn(q, k, v, prefix_len=prefix_len)
-        return attn_fn(q, k, v)
+        return _call_attn(q, k, v, prefix_len)
 
     def local(q, k, v, prefix=None):
         # both inner impls (mha_reference and the flash kernel) handle GQA
@@ -92,10 +105,7 @@ def ulysses_attention(
             )
 
         qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-        if prefix is not None:
-            out = attn_fn(qh, kh, vh, prefix_len=prefix)
-        else:
-            out = attn_fn(qh, kh, vh)
+        out = _call_attn(qh, kh, vh, prefix)
         return gather_seq(out)
 
     # batch stays sharded over (dp, fsdp) and heads over tp — declaring

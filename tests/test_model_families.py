@@ -353,6 +353,37 @@ def test_window_decode_matches_forward():
     )
 
 
+def test_window_forward_on_ulysses_mesh():
+    """Decoder-level ulysses+window wiring: logits on an sp mesh match
+    the single-device reference path."""
+    from dlrover_tpu.parallel import MeshConfig, build_mesh
+    from dlrover_tpu.parallel import sharding as shd
+
+    cfg = get_config(
+        "tiny", max_seq=64, attn_window=10, dtype="float32"
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 64), 0, 1000)
+    ref = decoder.forward(params, tokens, cfg, attn_impl="reference")
+    mesh = build_mesh(MeshConfig(sp=4, dp=2))
+    params_s = jax.device_put(
+        params, shd.shardings_for_tree(mesh, decoder.logical_axes(cfg))
+    )
+    out = jax.jit(
+        lambda p, t: decoder.forward(
+            p, t, cfg, mesh=mesh, attn_impl="ulysses"
+        )
+    )(params_s, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=3e-3, atol=3e-3
+    )
+    # ring remains unimplemented for windows — loudly
+    with pytest.raises(NotImplementedError, match="ring"):
+        decoder.forward(
+            params_s, tokens, cfg, mesh=mesh, attn_impl="ring"
+        )
+
+
 def test_mixtral_style_config():
     """MoE flagship preset: GQA + top-2 routing wired through forward."""
     big = get_config("mixtral-8x7b")

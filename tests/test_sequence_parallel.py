@@ -150,6 +150,24 @@ def test_ulysses_prefix_matches_reference(mesh):
     )
 
 
+def test_ulysses_window_matches_reference(mesh):
+    """Sliding window through the all-to-all path: the inner attention
+    sees global positions, so the mask carries over unchanged."""
+    q, k, v = _qkv(jax.random.key(11))
+    ref = mha_reference(q, k, v, causal=True, window=40)
+    out = ulysses_attention(
+        _shard_seq(mesh, q),
+        _shard_seq(mesh, k),
+        _shard_seq(mesh, v),
+        mesh,
+        causal=True,
+        window=40,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_ring_prefix_matches_reference(mesh):
     """Prefix-LM masking through the ring (jnp block path): prefixes
     crossing ring-block boundaries, incl. one inside an after-block."""
