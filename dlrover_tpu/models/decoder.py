@@ -386,12 +386,6 @@ def forward(
             "reference" if jax.default_backend() == "cpu" else "flash"
         )
 
-    if cfg.attn_window and attn_impl == "ring":
-        raise NotImplementedError(
-            "attn_window is not threaded through ring attention yet "
-            "(rotating blocks need cross-block window offsets) — use "
-            "attn_impl='ulysses', 'flash', or 'reference'"
-        )
     if cfg.prefix_lm and prefix_len is None:
         # a GLM-family model silently training fully-causal is the worst
         # failure mode (looks healthy, learns the wrong objective) —
@@ -415,6 +409,7 @@ def forward(
                 block_q=cfg.attn_block_q,
                 block_k=cfg.attn_block_k,
                 prefix_len=prefix_len,
+                window=cfg.attn_window,
             )
         if attn_impl == "ulysses":
             from dlrover_tpu.ops.pallas_attention import flash_attention

@@ -64,8 +64,11 @@ def ulysses_attention(
     """
     if prefix_len is not None and not causal:
         raise ValueError("prefix_len requires causal=True")
-    if window and not causal:
-        raise ValueError("window requires causal=True")
+    if window:
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        if not causal:
+            raise ValueError("window requires causal=True")
     attn_fn = attn_fn or functools.partial(mha_reference, causal=causal)
 
     def _call_attn(q, k, v, prefix=None):
@@ -143,11 +146,12 @@ def _head_axis(mesh: Mesh, q, k) -> Optional[str]:
 
 
 def _block_attend(q, k, v, scale, q_offset, k_offset, causal,
-                  prefix=None):
+                  prefix=None, window=0):
     """Partial attention of local q against one k/v block.
 
     ``q_offset``/``k_offset`` are the blocks' global positions; ``prefix``
-    [B] (global prefix-LM lengths) makes keys before it visible to all.
+    [B] (global prefix-LM lengths) makes keys before it visible to all;
+    ``window`` limits each query to the last ``window`` global positions.
     Returns (unnormalised out [B,Sq,H,D], row max m [B,H,Sq], row sum l).
     """
     b, sq, h, d = q.shape
@@ -159,6 +163,8 @@ def _block_attend(q, k, v, scale, q_offset, k_offset, causal,
         q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         allowed = (q_pos >= k_pos)[None, None]  # [1,1,Sq,Sk]
+        if window:
+            allowed = allowed & (q_pos - k_pos < window)[None, None]
         if prefix is not None:
             allowed = allowed | (
                 k_pos[None, None] < prefix[:, None, None, None]
@@ -174,13 +180,14 @@ def _block_attend(q, k, v, scale, q_offset, k_offset, causal,
 
 
 def _block_softmax_jnp(q, k, v, scale, q_offset, k_offset, causal,
-                       prefix=None):
+                       prefix=None, window=0):
     """Normalized partial attention of local q vs one k/v block.
 
     Returns (out [B,Sq,H,D] f32 normalized within the block,
     lse [B,H,Sq] f32; fully-masked rows: out 0, lse NEG_INF)."""
     out_raw, m, l = _block_attend(
-        q, k, v, scale, q_offset, k_offset, causal, prefix=prefix
+        q, k, v, scale, q_offset, k_offset, causal, prefix=prefix,
+        window=window,
     )
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = out_raw / l_safe.transpose(0, 2, 1)[..., None]
@@ -189,7 +196,7 @@ def _block_softmax_jnp(q, k, v, scale, q_offset, k_offset, causal,
 
 
 def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal,
-                         bq, bk, prefix=None):
+                         bq, bk, prefix=None, window=0):
     """Same contract via the Pallas flash kernel (O(block) memory inside).
 
     Ring blocks are equal-sized, so vs the local q block a k/v block is
@@ -232,6 +239,53 @@ def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal,
 
     if not causal:
         return dense(q, k, v)
+    if window:
+        # sliding window over the ring: classify the k block by its
+        # distance behind the local q block. Fully-lit before-blocks run
+        # dense, the diagonal runs the kernel's own causal+window mask
+        # (offsets align block-locally), boundary blocks the window only
+        # partially covers take the jnp path with global offsets (an
+        # O(Sq·Sk) score matrix — fine when window ≳ the ring block, the
+        # regime where ring+window makes sense; for window << block,
+        # plain flash/ulysses windowed attention is the right tool and
+        # the ring buys nothing), and fully-dark blocks stay empty.
+        sq_local = q.shape[1]
+        sk_local = k.shape[1]
+        dist = q_offset - k_offset
+
+        def diag_cw(q, k, v):
+            out, lse = flash_attention_with_lse(
+                q, k, v, None, True, scale, bq, bk, window
+            )
+            return out.astype(jnp.float32), lse
+
+        def win_partial(q, k, v):
+            k2, v2 = _match_heads(q, k, v)
+            return _block_softmax_jnp(
+                q, k2, v2, scale, q_offset, k_offset, True,
+                window=window,
+            )
+
+        case = jnp.where(
+            k_offset > q_offset,
+            3,  # after the diagonal: empty
+            jnp.where(
+                k_offset == q_offset,
+                1,  # diagonal: causal + block-local window
+                jnp.where(
+                    dist - (sk_local - 1) >= window,
+                    3,  # every pair at/behind the window edge: empty
+                    jnp.where(
+                        dist + sq_local - 1 < window,
+                        0,  # every pair inside the window: dense
+                        2,  # window boundary crosses this block
+                    ),
+                ),
+            ),
+        )
+        return jax.lax.switch(
+            case, (dense, diag_cw, win_partial, empty), q, k, v
+        )
     if prefix is not None:
         # block-local prefix: how many of THIS k block's keys fall inside
         # the global bidirectional prefix
@@ -289,6 +343,7 @@ def ring_attention(
     block_q: int = 512,
     block_k: int = 512,
     prefix_len: Optional[jax.Array] = None,  # [B] int32 prefix-LM
+    window: int = 0,  # sliding window (causal only)
 ) -> jax.Array:
     """Exact attention over the full (sharded) sequence via a k/v ring.
 
@@ -305,6 +360,13 @@ def ring_attention(
     """
     if prefix_len is not None and not causal:
         raise ValueError("prefix_len requires causal=True")
+    if window:
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if prefix_len is not None:
+            raise ValueError("window and prefix_len are mutually exclusive")
     sp = mesh.shape[axis]
     scale = (
         softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
@@ -312,7 +374,7 @@ def ring_attention(
     if sp == 1:
         return mha_reference(
             q, k, v, causal=causal, softmax_scale=scale,
-            prefix_len=prefix_len,
+            prefix_len=prefix_len, window=window,
         )
 
     def local(q, k, v, prefix=None):
@@ -341,12 +403,12 @@ def ring_attention(
             if use_flash:
                 out_blk, lse_blk = _block_softmax_flash(
                     q, k_blk, v_blk, scale, q_offset, k_offset, causal,
-                    bq, bk, prefix=prefix,
+                    bq, bk, prefix=prefix, window=window,
                 )
             else:
                 out_blk, lse_blk = _block_softmax_jnp(
                     q, k_blk, v_blk, scale, q_offset, k_offset, causal,
-                    prefix=prefix,
+                    prefix=prefix, window=window,
                 )
             # merge two normalized partials: logaddexp on lse, rescale outs
             lse_new = jnp.logaddexp(lse_run, lse_blk)

@@ -353,9 +353,10 @@ def test_window_decode_matches_forward():
     )
 
 
-def test_window_forward_on_ulysses_mesh():
-    """Decoder-level ulysses+window wiring: logits on an sp mesh match
-    the single-device reference path."""
+def test_window_forward_on_sequence_parallel_mesh():
+    """Decoder-level window wiring through BOTH sp paths: logits on an
+    sp mesh match the single-device reference path (the window crosses
+    ring-block boundaries: 64/4 = 16-wide blocks, window 10)."""
     from dlrover_tpu.parallel import MeshConfig, build_mesh
     from dlrover_tpu.parallel import sharding as shd
 
@@ -369,18 +370,15 @@ def test_window_forward_on_ulysses_mesh():
     params_s = jax.device_put(
         params, shd.shardings_for_tree(mesh, decoder.logical_axes(cfg))
     )
-    out = jax.jit(
-        lambda p, t: decoder.forward(
-            p, t, cfg, mesh=mesh, attn_impl="ulysses"
-        )
-    )(params_s, tokens)
-    np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref), rtol=3e-3, atol=3e-3
-    )
-    # ring remains unimplemented for windows — loudly
-    with pytest.raises(NotImplementedError, match="ring"):
-        decoder.forward(
-            params_s, tokens, cfg, mesh=mesh, attn_impl="ring"
+    for impl in ("ulysses", "ring"):
+        out = jax.jit(
+            lambda p, t: decoder.forward(
+                p, t, cfg, mesh=mesh, attn_impl=impl
+            )
+        )(params_s, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=3e-3, atol=3e-3,
+            err_msg=impl,
         )
 
 
