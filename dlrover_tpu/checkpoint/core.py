@@ -320,17 +320,26 @@ def restore_tree(
     for (path, leaf), sharding in zip(leaves_with_path, shard_leaves):
         pstr = _path_str(path)
         gshape = pack_index.global_shape(pstr)
-        dtype = pack_index.dtype(pstr)
+        # restore into the TARGET's dtype: a precision change between
+        # save and restore (bf16 run resumed in f32, or vice versa) must
+        # not silently leak the pack dtype into the training state
+        dtype = np.dtype(
+            getattr(leaf, "dtype", None) or pack_index.dtype(pstr)
+        )
         if sharding is None:
             full = pack_index.read_slice(
                 pstr, tuple(slice(0, d) for d in gshape)
             )
-            out.append(jax.numpy.asarray(full.astype(dtype)))
+            # copy=False: a no-op when the pack already matches the
+            # target dtype (the normal resume path — no double copy)
+            out.append(jax.numpy.asarray(full.astype(dtype, copy=False)))
         else:
             arr = jax.make_array_from_callback(
                 gshape,
                 sharding,
-                lambda idx, p=pstr: pack_index.read_slice(p, idx),
+                lambda idx, p=pstr, dt=dtype: pack_index.read_slice(
+                    p, idx
+                ).astype(dt, copy=False),
             )
             out.append(arr)
     return jax.tree_util.tree_unflatten(treedef, out)

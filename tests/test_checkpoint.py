@@ -52,6 +52,45 @@ def test_pack_roundtrip_unsharded():
     assert int(out["step"]) == 3
 
 
+def test_restore_casts_to_target_dtype():
+    """A precision change between save and restore (bf16 run resumed in
+    f32, or vice versa) must land in the TARGET dtype, sharded or not."""
+    state = _state()
+    entries, payload = core.plan_pack(state)
+    header = core.header_bytes(1, entries)
+    buf = memoryview(bytearray(core.pack_size(header, payload)))
+    used = core.write_pack(buf, 1, state, entries)
+    idx = core.PackIndex()
+    idx.add_pack(buf[:used])
+    target = {
+        "params": {
+            "w": jax.ShapeDtypeStruct((8, 8), jnp.bfloat16),  # was f32
+            "b": jax.ShapeDtypeStruct((16,), jnp.float32),    # was bf16
+        },
+        "step": jax.ShapeDtypeStruct((), jnp.int32),
+    }
+    out = core.restore_tree(target, idx)
+    assert out["params"]["w"].dtype == jnp.bfloat16
+    assert out["params"]["b"].dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(out["params"]["w"], np.float32),
+        np.asarray(state["params"]["w"]),
+        rtol=1e-2,
+    )
+    # sharded path casts too
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    sh = {
+        "params": {
+            "w": NamedSharding(mesh, P(("dp", "fsdp"), "tp")),
+            "b": NamedSharding(mesh, P("tp")),
+        },
+        "step": NamedSharding(mesh, P()),
+    }
+    out_s = core.restore_tree(target, idx, sh)
+    assert out_s["params"]["w"].dtype == jnp.bfloat16
+    assert out_s["params"]["b"].dtype == jnp.float32
+
+
 def test_pack_roundtrip_sharded():
     mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
     state = _state(mesh)
