@@ -18,6 +18,9 @@ _DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
 
 # optimizer state slots per param (mu, nu for adam family)
 _OPT_SLOTS = {"adamw": 2, "adam": 2, "agd": 3, "sgd": 1, "lion": 1}
+# fraction of the host-offloaded moment tree budgeted device-resident
+# for in-flight streaming (see the comment at its use)
+OFFLOAD_OPT_WORKING_SET = 0.5
 
 
 @dataclass
@@ -78,6 +81,15 @@ def analyse(
         plan.optimizer_state_dtype or plan.param_dtype, pbytes
     )
     opt_b = n * slots * opt_dtype_b / param_shards
+    if plan.offload_opt_state:
+        # moments live in pinned host memory and stream through HBM
+        # around the update. NOTHING bounds the in-flight working set:
+        # XLA's memory-aware scheduler usually frees early leaves before
+        # late ones arrive, but it is not guaranteed, so budget a
+        # conservative half of the tree rather than a best-case sliver —
+        # and the measured search modes (dry_run) catch any remaining
+        # analytic optimism with a real step.
+        opt_b *= OFFLOAD_OPT_WORKING_SET
     grad_b = n * pbytes / param_shards
 
     act_dtype_b = _DTYPE_BYTES.get(plan.compute_dtype, 2)

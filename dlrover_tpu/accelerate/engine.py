@@ -26,6 +26,11 @@ from dlrover_tpu.accelerate.strategy import (
 logger = get_logger(__name__)
 
 
+# candidate cap for the cheap analytic phase (measured modes are
+# separately capped by max_measured); shared with tests
+ANALYTIC_CANDIDATE_CAP = 512
+
+
 def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
 
@@ -71,6 +76,13 @@ def generate_candidates(
                     base.append(("sequence_parallel", {"size": sp}))
                 candidates.append(base + [("checkpoint", {"policy": "none"})])
                 candidates.append(base + [("checkpoint", {"policy": "full"})])
+                # memory-squeeze tier: host-offloaded moments on top of
+                # full remat — fits models the resident plans cannot
+                candidates.append(
+                    base
+                    + [("checkpoint", {"policy": "full"}),
+                       ("offload_opt", {})]
+                )
     # dedupe, keep stable order
     seen = set()
     out = []
@@ -108,7 +120,33 @@ def generate_candidates(
     rest = [c for g in groups.values() for c in g[1:]]
     rest.sort(key=score, reverse=True)
     picked.extend(rest)
-    return picked[:max_candidates]
+    picked = picked[:max_candidates]
+
+    def has_offload(c):
+        return any(name == "offload_opt" for name, _ in c)
+
+    if not any(has_offload(c) for c in picked):
+        # the offload tier scores low (host DMA) so score-based
+        # truncation always drops it — but it exists for the case where
+        # nothing resident fits, so reserve one slot for the MOST
+        # SHARDED offload variant (minimum device memory), not the
+        # best-scoring one
+        def shards(c):
+            for name, d in c:
+                if name == "mixed_parallel":
+                    return (
+                        d.get("fsdp", 1) * d.get("tp", 1) * d.get("pp", 1)
+                    )
+            return 1
+
+        offloads = sorted(
+            (c for c in out if has_offload(c)),
+            key=lambda c: (shards(c), score(c)),
+            reverse=True,
+        )
+        if offloads:
+            picked[-1] = offloads[0]
+    return picked
 
 
 def _heuristic_score(
@@ -129,6 +167,11 @@ def _heuristic_score(
         score *= 1.0 - pipeline_bubble_fraction(pp, n_micro)  # fill/drain
     if plan.remat == "full":
         score *= 0.75
+    if plan.offload_opt_state:
+        # host DMA around the optimizer update (measured ~2x step cost
+        # at 124M single-chip; relatively cheaper as models grow) —
+        # chosen only when resident plans don't fit
+        score *= 0.55
     return score
 
 
@@ -216,7 +259,12 @@ def search_strategy(
     hbm = device_hbm_bytes()
     batch_per_chip = max(1, global_batch // n_devices)
     feasible: List[Tuple[float, Strategy, AccelerationPlan]] = []
-    for strat in generate_candidates(cfg, n_devices, seq):
+    # the analytic feasibility filter is cheap — consider the (near-)
+    # full candidate set here; only the measured modes below are capped
+    # (max_measured), so the default truncation would just hide plans
+    # (e.g. the offload tier) that memory pressure makes load-bearing
+    for strat in generate_candidates(cfg, n_devices, seq,
+                                     max_candidates=ANALYTIC_CANDIDATE_CAP):
         plan = apply_strategy(strat)
         try:
             a = analyse(cfg, plan, n_devices, batch_per_chip, seq, hbm)
@@ -226,12 +274,16 @@ def search_strategy(
             continue
         feasible.append((_heuristic_score(cfg, plan, n_devices), strat, plan))
     if not feasible:
-        # nothing fits: force max sharding + remat + bf16 params
+        # nothing fits: force max sharding + full remat + bf16 params
+        # + host-offloaded moments (the one offload strategy method;
+        # activation offload is the remat='offload_attn' policy, not
+        # taken here — full remat is the lower device-memory bound)
         strat = [
             ("half", {}),
             ("mixed_parallel", {"dp": 1, "fsdp": n_devices, "tp": 1, "sp": 1}),
             ("checkpoint", {"policy": "full"}),
             ("bf16_optim", {}),
+            ("offload_opt", {}),
         ]
         logger.warning("no analytically-feasible strategy; forcing %s", strat)
         return strat, apply_strategy(strat)
