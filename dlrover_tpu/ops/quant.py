@@ -62,6 +62,30 @@ class QuantizedArray:
         )
 
 
+def _quant_blocks(blocks: jax.Array, bits: int):
+    """Quantize ``(..., BLOCK)`` float32 blocks → (packed int8, scale)."""
+    qmax = 127.0 if bits == 8 else 7.0
+    scale = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True) / qmax
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(blocks / scale), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        # two's-complement nibbles packed pairwise into one byte
+        lo = q[..., 0::2] & 0xF
+        hi = (q[..., 1::2] & 0xF) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant_blocks(q: jax.Array, scale: jax.Array, bits: int) -> jax.Array:
+    """Inverse of ``_quant_blocks``: packed blocks → float32 ``(..., BLOCK)``."""
+    if bits == 4:
+        # sign-extend each nibble: shift into high bits, arithmetic-shift back
+        lo = (q.astype(jnp.int8) << 4) >> 4
+        hi = q.astype(jnp.int8) >> 4
+        q = jnp.stack([lo, hi], axis=-1).reshape(*q.shape[:-1], -1)
+    return q.astype(jnp.float32) * scale
+
+
 def quantize(x: jax.Array, bits: int = 8) -> QuantizedArray:
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
@@ -70,28 +94,12 @@ def quantize(x: jax.Array, bits: int = 8) -> QuantizedArray:
     pad = (-flat.size) % BLOCK
     flat = jnp.pad(flat, (0, pad))
     blocks = flat.reshape(-1, BLOCK)
-    qmax = 127.0 if bits == 8 else 7.0
-    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / qmax
-    scale = jnp.maximum(scale, 1e-12)
-    q = jnp.clip(jnp.round(blocks / scale), -qmax, qmax).astype(jnp.int8)
-    if bits == 4:
-        # two's-complement nibbles packed pairwise into one byte
-        lo = q[:, 0::2] & 0xF
-        hi = (q[:, 1::2] & 0xF) << 4
-        q = (lo | hi).astype(jnp.int8)
+    q, scale = _quant_blocks(blocks, bits)
     return QuantizedArray(q=q, scale=scale, shape=shape, dtype=dtype, bits=bits)
 
 
-def _unpack4(q: jax.Array) -> jax.Array:
-    # sign-extend each nibble: shift into the high bits, arithmetic-shift back
-    lo = (q.astype(jnp.int8) << 4) >> 4
-    hi = q.astype(jnp.int8) >> 4
-    return jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
-
-
 def dequantize(qa: QuantizedArray) -> jax.Array:
-    q = _unpack4(qa.q) if qa.bits == 4 else qa.q
-    flat = (q.astype(jnp.float32) * qa.scale).reshape(-1)
+    flat = _dequant_blocks(qa.q, qa.scale, qa.bits).reshape(-1)
     size = 1
     for d in qa.shape:
         size *= d
@@ -127,7 +135,15 @@ def quantize_optimizer_state(
     inner: optax.GradientTransformation,
     bits: int = 8,
 ) -> optax.GradientTransformation:
-    """Keep ``inner``'s large state leaves as block-quantized int8/int4."""
+    """Keep ``inner``'s large state leaves as block-quantized int8/int4.
+
+    Generic wrapper for arbitrary ``inner`` transforms. NOTE: it
+    round-trips the WHOLE state tree through float32 every update, so the
+    step-time HBM peak is the same as unquantized state — only resident
+    memory shrinks. For AdamW at billion-parameter scale use
+    ``lowbit_adamw``, which streams the dequant–update–requant in bounded
+    chunks and never materialises a full float32 moment tree.
+    """
 
     def init_fn(params):
         return _quantize_tree(inner.init(params), bits)
@@ -136,5 +152,168 @@ def quantize_optimizer_state(
         full = _dequantize_tree(state)
         updates, new_state = inner.update(updates, full, params)
         return updates, _quantize_tree(new_state, bits)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Fused streaming low-bit AdamW
+# ---------------------------------------------------------------------------
+
+# Elements processed per scan iteration. 4Mi elems = 16 MB per f32 chunk
+# buffer; ~6 live chunk buffers ≈ 100 MB transient regardless of leaf size.
+CHUNK_ELEMS = 4 * 1024 * 1024
+
+
+def _leaf_blocks(x: jax.Array) -> jax.Array:
+    """Flatten + pad a leaf to ``(n_blocks, BLOCK)`` float32 blocks."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % BLOCK
+    return jnp.pad(flat, (0, pad)).reshape(-1, BLOCK)
+
+
+def _zero_quantized(x: jax.Array, bits: int) -> QuantizedArray:
+    """All-zero quantized moment with the layout ``lowbit_adamw`` uses."""
+    n_blocks = -(-x.size // BLOCK)
+    cols = BLOCK if bits == 8 else BLOCK // 2
+    return QuantizedArray(
+        q=jnp.zeros((n_blocks, cols), jnp.int8),
+        scale=jnp.full((n_blocks, 1), 1e-12, jnp.float32),
+        shape=x.shape,
+        dtype=jnp.float32,
+        bits=bits,
+    )
+
+
+def lowbit_adamw(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    bits: int = 8,
+    chunk_elems: int = CHUNK_ELEMS,
+) -> optax.GradientTransformation:
+    """AdamW with block-quantized int8/int4 moments and bounded transients.
+
+    Reference capability: atorch's low-bit optimizer
+    (atorch/optimizers/low_bit/functional.py:543L) backed by CUDA
+    quantization kernels (ops/csrc/quantization/quantization_optimizer.cu).
+    TPU-native design: per leaf, a ``lax.scan`` streams fixed-size chunks
+    through dequant → moment update → requant → AdamW step, so the float32
+    working set is O(chunk) rather than O(params) — the whole point of
+    low-bit state, which the generic ``quantize_optimizer_state`` wrapper
+    loses at step time.
+    """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    chunk_blocks = max(1, chunk_elems // BLOCK)
+
+    def _lr(step):
+        return learning_rate(step) if callable(learning_rate) else learning_rate
+
+    def init_fn(params):
+        def moment(p):
+            if _should_quantize(p):
+                return _zero_quantized(p, bits)
+            return jnp.zeros_like(p, jnp.float32)
+
+        return {
+            "step": jnp.zeros([], jnp.int32),
+            "m": jax.tree.map(moment, params),
+            "v": jax.tree.map(moment, params),
+        }
+
+    def _dense_update(g, m, v, p, bc1, bc2):
+        g = g.astype(jnp.float32)
+        m2 = b1 * m + (1 - b1) * g
+        v2 = b2 * v + (1 - b2) * (g * g)
+        upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+        if weight_decay:
+            upd = upd + weight_decay * p.astype(jnp.float32)
+        return upd, m2, v2
+
+    def _chunked_update(g, mq: QuantizedArray, vq: QuantizedArray, p, bc1, bc2):
+        n_blocks = mq.q.shape[0]
+        pad_blocks = (-n_blocks) % chunk_blocks
+        n_chunks = (n_blocks + pad_blocks) // chunk_blocks
+
+        def blocks_of(x):
+            b = _leaf_blocks(x)
+            b = jnp.pad(b, ((0, pad_blocks), (0, 0)))
+            return b.reshape(n_chunks, chunk_blocks, BLOCK)
+
+        def chunks_of(q, scale):
+            q = jnp.pad(q, ((0, pad_blocks), (0, 0)))
+            scale = jnp.pad(scale, ((0, pad_blocks), (0, 0)))
+            return (
+                q.reshape(n_chunks, chunk_blocks, -1),
+                scale.reshape(n_chunks, chunk_blocks, 1),
+            )
+
+        xs = (
+            blocks_of(g),
+            blocks_of(p) if weight_decay else None,
+            chunks_of(mq.q, mq.scale),
+            chunks_of(vq.q, vq.scale),
+        )
+
+        def body(_, x):
+            gc, pc, (mqc, msc), (vqc, vsc) = x
+            m = _dequant_blocks(mqc, msc, bits)
+            v = _dequant_blocks(vqc, vsc, bits)
+            m2 = b1 * m + (1 - b1) * gc
+            v2 = b2 * v + (1 - b2) * (gc * gc)
+            upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+            if weight_decay:
+                upd = upd + weight_decay * pc
+            mq2, ms2 = _quant_blocks(m2, bits)
+            vq2, vs2 = _quant_blocks(v2, bits)
+            return None, (upd, (mq2, ms2), (vq2, vs2))
+
+        _, (upd, (mq2, ms2), (vq2, vs2)) = jax.lax.scan(body, None, xs)
+
+        def unchunk(x, cols):
+            return x.reshape(n_chunks * chunk_blocks, cols)[:n_blocks]
+
+        upd = upd.reshape(-1)[: g.size].reshape(g.shape)
+        cols = mq.q.shape[1]
+        new_m = QuantizedArray(
+            unchunk(mq2, cols), unchunk(ms2, 1), mq.shape, mq.dtype, bits
+        )
+        new_v = QuantizedArray(
+            unchunk(vq2, cols), unchunk(vs2, 1), vq.shape, vq.dtype, bits
+        )
+        return upd, new_m, new_v
+
+    def update_fn(updates, state, params=None):
+        if weight_decay and params is None:
+            raise ValueError("lowbit_adamw with weight_decay needs params")
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        bc1 = 1 - b1**t
+        bc2 = 1 - b2**t
+        lr = _lr(step)
+        p_tree = params if params is not None else updates
+
+        def leaf(g, m, v, p):
+            if isinstance(m, QuantizedArray):
+                upd, m2, v2 = _chunked_update(g, m, v, p, bc1, bc2)
+            else:
+                upd, m2, v2 = _dense_update(g, m, v, p, bc1, bc2)
+            return (-lr * upd).astype(g.dtype), m2, v2
+
+        out = jax.tree.map(
+            leaf,
+            updates,
+            state["m"],
+            state["v"],
+            p_tree,
+            is_leaf=lambda x: isinstance(x, QuantizedArray),
+        )
+        unzip = lambda i: jax.tree.map(
+            lambda x: x[i], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        return unzip(0), {"step": step, "m": unzip(1), "v": unzip(2)}
 
     return optax.GradientTransformation(init_fn, update_fn)

@@ -176,6 +176,23 @@ def make_optimizer(
     if grad_clip and grad_clip > 0:
         chain.append(optax.clip_by_global_norm(grad_clip))
 
+    if name == "adamw" and state_dtype in ("int8", "int4"):
+        # fused streaming path: chunked dequant-update-requant keeps the
+        # float32 working set O(chunk) — the generic wrapper below would
+        # materialise full f32 moments every step (OOM at >=1B params)
+        from dlrover_tpu.ops.quant import lowbit_adamw
+
+        chain.append(
+            lowbit_adamw(
+                lr,
+                b1=b1,
+                b2=b2,
+                weight_decay=weight_decay,
+                bits=8 if state_dtype == "int8" else 4,
+            )
+        )
+        return optax.chain(*chain)
+
     if name == "adamw":
         mu_dtype = None
         if state_dtype == "bfloat16":
