@@ -150,6 +150,7 @@ def _fwd_kernel(
     k_ref,  # [block_k, d]
     v_ref,  # [block_k, d]
     prefix_ref,  # [B, 1] int32, whole array in SMEM (None w/o prefix)
+    offs_ref,  # [1, 2] int32 (q_off, k_off) in SMEM (None w/o offsets)
     o_ref,  # [block_q, d]
     lse_ref,  # [block_q, 8] f32 (8 lanes to satisfy TPU tiling; col 0 used)
     m_scratch,  # [block_q, 128] f32
@@ -161,6 +162,7 @@ def _fwd_kernel(
     block_q: int,
     block_k: int,
     has_prefix: bool,
+    has_offsets: bool = False,
     n_head: int = 1,
     window: int = 0,
 ):
@@ -178,8 +180,10 @@ def _fwd_kernel(
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    q_start = qi * block_q
-    k_start = ki * block_k
+    # global offsets (ring attention: this call's q/k blocks sit at
+    # traced global positions) shift every position the mask rule sees
+    q_start = qi * block_q + (offs_ref[0, 0] if has_offsets else 0)
+    k_start = ki * block_k + (offs_ref[0, 1] if has_offsets else 0)
 
     @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
                          block_q, block_k, window))
@@ -216,19 +220,24 @@ def _fwd_kernel(
         )
 
 
-def _insert_none_arg(kernel, idx):
-    """Adapter for the prefix-less call: the kernel signatures always
-    have a prefix_ref slot (at positional index ``idx``), but pallas
-    passes inputs positionally — splice a None in."""
+def _insert_none_args(kernel, idxs):
+    """Adapter for optional SMEM args: the kernel signatures always have
+    prefix_ref/offs_ref slots (at positional indices ``idxs``, sorted),
+    but pallas passes inputs positionally — splice Nones in for the
+    absent ones."""
 
     def call(*refs):
-        return kernel(*refs[:idx], None, *refs[idx:])
+        refs = list(refs)
+        for idx in idxs:
+            refs.insert(idx, None)
+        return kernel(*refs)
 
     return call
 
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, prefix_ref,
+    offs_ref,
     dq_ref,
     acc_scratch,  # [block_q, d] f32
     *,
@@ -237,7 +246,8 @@ def _bwd_dq_kernel(
     block_q: int,
     block_k: int,
     has_prefix: bool,
-    n_head: int,
+    has_offsets: bool = False,
+    n_head: int = 1,
     window: int = 0,
 ):
     """dq = Σ_k ds @ K with ds = p·(dp − delta)·scale, p recomputed from
@@ -254,8 +264,8 @@ def _bwd_dq_kernel(
     def _init():
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    q_start = qi * block_q
-    k_start = ki * block_k
+    q_start = qi * block_q + (offs_ref[0, 0] if has_offsets else 0)
+    k_start = ki * block_k + (offs_ref[0, 1] if has_offsets else 0)
 
     @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
                          block_q, block_k, window))
@@ -281,6 +291,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, prefix_ref,
+    offs_ref,
     dk_ref, dv_ref,
     dk_scratch,  # [block_k, d] f32
     dv_scratch,  # [block_k, d] f32
@@ -290,7 +301,8 @@ def _bwd_dkv_kernel(
     block_q: int,
     block_k: int,
     has_prefix: bool,
-    n_head: int,
+    has_offsets: bool = False,
+    n_head: int = 1,
     window: int = 0,
 ):
     """dk/dv accumulated per k-block with q-blocks innermost:
@@ -307,8 +319,8 @@ def _bwd_dkv_kernel(
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
-    q_start = qi * block_q
-    k_start = ki * block_k
+    q_start = qi * block_q + (offs_ref[0, 0] if has_offsets else 0)
+    k_start = ki * block_k + (offs_ref[0, 1] if has_offsets else 0)
 
     @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
                          block_q, block_k, window))
@@ -341,7 +353,7 @@ def _bwd_dkv_kernel(
 def _pallas_backward(q, k, v, out, lse, g, causal, scale,
                      block_q, block_k, prefix=None,
                      interpret: Optional[bool] = None,
-                     g_lse=None, window: int = 0):
+                     g_lse=None, window: int = 0, offsets=None):
     """FA2-style pallas backward: returns (dq, dk, dv).
 
     All [B,S,H,D] layouts like the forward; GQA dk/dv are group-summed
@@ -382,14 +394,26 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
     )
 
     has_prefix = prefix is not None
+    has_offsets = offsets is not None
+    extra = ()
+    extra_specs = []
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    none_idxs = []
     if has_prefix:
-        extra = (prefix.astype(jnp.int32).reshape(b, 1),)
-        extra_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
-        wrap = lambda kern: kern  # noqa: E731
+        extra += (prefix.astype(jnp.int32).reshape(b, 1),)
+        extra_specs.append(smem_spec)
     else:
-        extra = ()
-        extra_specs = []
-        wrap = functools.partial(_insert_none_arg, idx=6)
+        none_idxs.append(6)
+    if has_offsets:
+        extra += (offsets.astype(jnp.int32).reshape(1, 2),)
+        extra_specs.append(smem_spec)
+    else:
+        none_idxs.append(7)
+    wrap = (
+        functools.partial(_insert_none_args, idxs=none_idxs)
+        if none_idxs
+        else (lambda kern: kern)
+    )
 
     common = dict(
         causal=causal,
@@ -397,10 +421,14 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
         block_q=block_q,
         block_k=block_k,
         has_prefix=has_prefix,
+        has_offsets=has_offsets,
         n_head=h,
         window=window,
     )
-    causal_clamp = causal and prefix is None
+    # with traced global offsets the diagonal's grid position is unknown
+    # at trace time — the run gate still compute-skips, but the DMA index
+    # clamp below must not assume a block-local diagonal
+    causal_clamp = causal and prefix is None and not has_offsets
 
     # dq grid (g, q-block i, k-block j): above-diagonal (and, windowed,
     # below-window) k blocks are compute-skipped; clamp their index so
@@ -503,6 +531,7 @@ def _flash_fwd(
     interpret: Optional[bool] = None,
     prefix: Optional[jax.Array] = None,  # [B] int32 prefix-LM lengths
     window: int = 0,  # sliding window (causal only; 0 = unlimited)
+    offsets: Optional[jax.Array] = None,  # [2] int32 global (q_off, k_off)
 ) -> jax.Array:
     interpret = INTERPRET if interpret is None else interpret
     b, sq, h, d = q.shape
@@ -530,21 +559,31 @@ def _flash_fwd(
         block_q=block_q,
         block_k=block_k,
         has_prefix=prefix is not None,
+        has_offsets=offsets is not None,
         n_head=h,
         window=window,
     )
-    if prefix is None:
-        inputs = (qt, kt, vt)
-        prefix_specs = []
-        kernel_fn = _insert_none_arg(kernel, 3)
-    else:
-        inputs = (qt, kt, vt, prefix.astype(jnp.int32).reshape(b, 1))
+    inputs = (qt, kt, vt)
+    prefix_specs = []
+    none_idxs = []
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    if prefix is not None:
         # the whole [B,1] scalar table lives in SMEM; the kernel indexes
         # its batch row from grid dim 0 (Mosaic rejects sub-8 sublane
         # blocking, so no per-step BlockSpec windowing here)
-        prefix_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
-        kernel_fn = kernel
-    if causal and prefix is None:
+        inputs += (prefix.astype(jnp.int32).reshape(b, 1),)
+        prefix_specs.append(smem_spec)
+    else:
+        none_idxs.append(3)
+    if offsets is not None:
+        inputs += (offsets.astype(jnp.int32).reshape(1, 2),)
+        prefix_specs.append(smem_spec)
+    else:
+        none_idxs.append(4)
+    kernel_fn = (
+        _insert_none_args(kernel, none_idxs) if none_idxs else kernel
+    )
+    if causal and prefix is None and offsets is None:
         # above-diagonal (and, with a sliding window, below-window)
         # blocks are compute-skipped by the run gate, but a naive index
         # map still DMAs them; clamping j re-addresses the SAME block,
@@ -608,7 +647,7 @@ def _bwd_chunk(sk: int, block_k: int) -> int:
 
 
 def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
-                      g_lse=None, prefix=None, window=0):
+                      g_lse=None, prefix=None, window=0, offsets=None):
     """True O(S·chunk) flash backward from saved (out, lse).
 
     ``g_lse`` [B,H,S]: optional cotangent of the lse output (ring
@@ -661,6 +700,8 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
     k_chunks = kt.reshape(b, hkv, n_chunks, chunk, d)
     v_chunks = vt.reshape(b, hkv, n_chunks, chunk, d)
     q_pos = jnp.arange(sq)
+    if offsets is not None:
+        q_pos = q_pos + offsets.reshape(-1)[0]
 
     def body(dq_acc, idx):
         kc = k_chunks[:, :, idx]                       # [B,Hkv,C,D]
@@ -668,6 +709,8 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
         s = jnp.einsum("bkgqd,bkcd->bkgqc", qt, kc) * scale
         if causal:
             k_pos = idx * chunk + jnp.arange(chunk)
+            if offsets is not None:
+                k_pos = k_pos + offsets.reshape(-1)[1]
             mask = q_pos[:, None] >= k_pos[None, :]
             if window:
                 mask = mask & (
@@ -705,28 +748,28 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
 )
-def _flash_attention(q, k, v, prefix, causal, scale, block_q, block_k,
-                     window=0):
+def _flash_attention(q, k, v, prefix, offsets, causal, scale, block_q,
+                     block_k, window=0):
     out, _ = _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window,
+        window=window, offsets=offsets,
     )
     return out
 
 
-def _fwd_rule(q, k, v, prefix, causal, scale, block_q, block_k,
+def _fwd_rule(q, k, v, prefix, offsets, causal, scale, block_q, block_k,
               window=0):
     out, lse = _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window,
+        window=window, offsets=offsets,
     )
     # named so remat policies can pin the kernel residuals in memory and
     # skip re-running the forward kernel in backward (decoder save_attn)
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return out, (q, k, v, prefix, out, lse)
+    return out, (q, k, v, prefix, offsets, out, lse)
 
 
 def _bwd_rule(causal, scale, block_q, block_k, window, residuals, g):
@@ -739,30 +782,33 @@ def _bwd_rule(causal, scale, block_q, block_k, window, residuals, g):
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def flash_attention_with_lse(q, k, v, prefix, causal, scale,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_with_lse(q, k, v, prefix, offsets, causal, scale,
                              block_q, block_k, window=0):
     """Flash attention returning (out, lse) with BOTH differentiable —
     the primitive ring attention composes (the lse feeds the cross-block
     softmax merge, so its gradient is load-bearing). ``prefix`` [B] int32
-    adds the prefix-LM bidirectional-prefix mask (causal only)."""
+    adds the prefix-LM bidirectional-prefix mask (causal only).
+    ``offsets`` [2] int32 (q_off, k_off) shifts the mask rule to global
+    positions — ring attention passes the blocks' traced ring offsets so
+    window-boundary and prefix-reach blocks run this kernel too."""
     return _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window,
+        window=window, offsets=offsets,
     )
 
 
-def _fwd_rule_lse(q, k, v, prefix, causal, scale, block_q, block_k,
-                  window=0):
+def _fwd_rule_lse(q, k, v, prefix, offsets, causal, scale, block_q,
+                  block_k, window=0):
     out, lse = _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window,
+        window=window, offsets=offsets,
     )
     # same tags as _fwd_rule: lets remat policies (and the ring's scan
     # checkpoint) pin the residuals instead of re-running the kernel
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return (out, lse), (q, k, v, prefix, out, lse)
+    return (out, lse), (q, k, v, prefix, offsets, out, lse)
 
 
 def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
@@ -772,7 +818,7 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
     capped at BWD_BLOCK (~4 [bq,bk] f32 transients per grid step, so
     smaller than the forward's); jnp chunked recompute off-TPU or when
     the sequence doesn't tile to a lane-aligned block."""
-    q, k, v, prefix, out, lse = residuals
+    q, k, v, prefix, offsets, out, lse = residuals
     g_out, g_lse = cot
     bq = _fit_block(q.shape[1], min(block_q, BWD_BLOCK))
     bk = _fit_block(k.shape[1], min(block_k, BWD_BLOCK))
@@ -785,7 +831,7 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
     ):
         dq, dk, dv = _pallas_backward(
             q, k, v, out, lse, g_out, causal, scale, bq, bk,
-            prefix=prefix, g_lse=g_lse, window=window,
+            prefix=prefix, g_lse=g_lse, window=window, offsets=offsets,
         )
     else:
         dq, dk, dv = _chunked_backward(
@@ -794,13 +840,19 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
             g_lse=g_lse,
             prefix=prefix,
             window=window,
+            offsets=offsets,
         )
     dprefix = (
         None
         if prefix is None
         else np.zeros(prefix.shape, dtype=jax.dtypes.float0)
     )
-    return dq, dk, dv, dprefix
+    doffsets = (
+        None
+        if offsets is None
+        else np.zeros(offsets.shape, dtype=jax.dtypes.float0)
+    )
+    return dq, dk, dv, dprefix, doffsets
 
 
 flash_attention_with_lse.defvjp(_fwd_rule_lse, _bwd_rule_lse)
@@ -850,7 +902,7 @@ def flash_attention(
             prefix_len=prefix_len, window=window,
         )
     return _flash_attention(
-        q, k, v, prefix_len, causal, scale, bq, bk, window
+        q, k, v, prefix_len, None, causal, scale, bq, bk, window
     )
 
 

@@ -217,7 +217,7 @@ def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal,
 
     def dense(q, k, v):
         out, lse = flash_attention_with_lse(
-            q, k, v, None, False, scale, bq, bk
+            q, k, v, None, None, False, scale, bq, bk
         )
         return out.astype(jnp.float32), lse
 
@@ -227,7 +227,7 @@ def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal,
         # causal masking is correct; the prefix part is folded in below
         # when present
         out, lse = flash_attention_with_lse(
-            q, k, v, None, True, scale, bq, bk
+            q, k, v, None, None, True, scale, bq, bk
         )
         return out.astype(jnp.float32), lse
 
@@ -244,27 +244,27 @@ def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal,
         # distance behind the local q block. Fully-lit before-blocks run
         # dense, the diagonal runs the kernel's own causal+window mask
         # (offsets align block-locally), boundary blocks the window only
-        # partially covers take the jnp path with global offsets (an
-        # O(Sq·Sk) score matrix — fine when window ≳ the ring block, the
-        # regime where ring+window makes sense; for window << block,
-        # plain flash/ulysses windowed attention is the right tool and
-        # the ring buys nothing), and fully-dark blocks stay empty.
+        # partially covers run the kernel with GLOBAL offsets in SMEM —
+        # its run gate compute-skips the tiles outside the window band —
+        # and fully-dark blocks stay empty.
         sq_local = q.shape[1]
         sk_local = k.shape[1]
         dist = q_offset - k_offset
 
         def diag_cw(q, k, v):
             out, lse = flash_attention_with_lse(
-                q, k, v, None, True, scale, bq, bk, window
+                q, k, v, None, None, True, scale, bq, bk, window
             )
             return out.astype(jnp.float32), lse
 
         def win_partial(q, k, v):
-            k2, v2 = _match_heads(q, k, v)
-            return _block_softmax_jnp(
-                q, k2, v2, scale, q_offset, k_offset, True,
-                window=window,
+            offs = jnp.stack(
+                [jnp.int32(q_offset), jnp.int32(k_offset)]
             )
+            out, lse = flash_attention_with_lse(
+                q, k, v, None, offs, True, scale, bq, bk, window
+            )
+            return out.astype(jnp.float32), lse
 
         case = jnp.where(
             k_offset > q_offset,
@@ -297,25 +297,26 @@ def _block_softmax_flash(q, k, v, scale, q_offset, k_offset, causal,
             # diagonal block: block-local causal mask (both offsets
             # align) + the block-local slice of the prefix
             out, lse = flash_attention_with_lse(
-                q, k, v, local_pref, True, scale, bq, bk
+                q, k, v, local_pref, None, True, scale, bq, bk
             )
             return out.astype(jnp.float32), lse
 
         def prefix_only(q, k, v):
             # after-block the prefix reaches into: causally nothing is
-            # visible, only keys inside the prefix. The kernel has no
-            # prefix-without-causal mode, so use the jnp block path with
-            # a hugely negative q offset (kills the causal term) and
-            # local k positions — O(Sq·Sk) scores, taken only when this
-            # block actually overlaps some batch element's prefix
-            k, v = _match_heads(q, k, v)  # jnp path needs equal heads
-            return _block_softmax_jnp(
-                q, k, v, scale, -(jnp.int32(1) << 30), 0,
-                True, prefix=local_pref,
+            # visible, only keys inside the prefix. Run the kernel with
+            # a hugely negative global q offset — it kills the causal
+            # term for every pair, leaving exactly the prefix mask; the
+            # run gate still visits prefix-lit k tiles (k_start < pref)
+            offs = jnp.stack(
+                [-(jnp.int32(1) << 30), jnp.int32(0)]
             )
+            out, lse = flash_attention_with_lse(
+                q, k, v, local_pref, offs, True, scale, bq, bk
+            )
+            return out.astype(jnp.float32), lse
 
         # after-blocks no prefix reaches stay EMPTY — without this branch
-        # every after-block would pay prefix_only's dense score matrix
+        # every after-block would visit the kernel for all-dark tiles
         reach = jnp.max(local_pref) > 0
         case = jnp.where(
             k_offset < q_offset,

@@ -109,7 +109,7 @@ def test_flash_kernel_prefix_matches_reference(monkeypatch):
 
     def flash(q, k, v):
         out = pallas_attention._flash_attention(
-            q, k, v, prefix, True, d**-0.5, 128, 128
+            q, k, v, prefix, None, True, d**-0.5, 128, 128
         )
         return out
 
@@ -287,7 +287,7 @@ def test_flash_kernel_window_matches_reference(monkeypatch):
 
     def flash(q, k, v):
         return pallas_attention._flash_attention(
-            q, k, v, None, True, d**-0.5, 128, 128, window
+            q, k, v, None, None, True, d**-0.5, 128, 128, window
         )
 
     out = flash(q, k, v)

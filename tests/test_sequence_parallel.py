@@ -226,6 +226,30 @@ def test_ring_window_flash_path(monkeypatch):
     )
 
 
+def test_ring_window_flash_path_gqa(monkeypatch):
+    """GQA through the windowed flash ring: k/v stay at hkv heads on the
+    ring (groups× fewer ppermute bytes) and the offset kernel handles
+    the boundary blocks without a head expansion."""
+    from dlrover_tpu.ops import pallas_attention as pa
+
+    if pa.pltpu is None:
+        pytest.skip("pallas TPU module unavailable")
+    monkeypatch.setattr(pa, "INTERPRET", True)
+    monkeypatch.setattr(pa, "_on_tpu", lambda: True)
+    mesh = build_mesh(MeshConfig(sp=4, dp=2))
+    b, s, hq, hkv, d = 2, 1024, 4, 2, 32
+    ks = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(ks[0], (b, s, hq, d))
+    k = jax.random.normal(ks[1], (b, s, hkv, d))
+    v = jax.random.normal(ks[2], (b, s, hkv, d))
+    window = 400
+    out = ring_attention(q, k, v, mesh, causal=True, window=window)
+    ref = mha_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=3e-3, atol=3e-3
+    )
+
+
 def test_ring_prefix_matches_reference(mesh):
     """Prefix-LM masking through the ring (jnp block path): prefixes
     crossing ring-block boundaries, incl. one inside an after-block."""
