@@ -65,14 +65,27 @@ class ModelConfig:
     moe_aux_coef: float = 0.0        # load-balancing loss coefficient
     moe_z_coef: float = 0.0          # router z-loss coefficient
     moe_alltoall: bool = False       # explicit shard_map all-to-all dispatch
+    moe_impl: str = "dense"          # dense (capacity) | ragged (dropless)
     # pipeline microbatches when the mesh has pp > 1 (0 → one per stage)
     pp_microbatches: int = 0
+    # interleaved (circular) pipeline: v layer chunks per stage cut the
+    # bubble to (P−1)/(M·v+P−1). The chunk→stage assignment permutes the
+    # semantic layer order, so v>1 requires pp_stages to pin the stage
+    # count the layout was built for (checkpoints stay well-defined on
+    # other meshes via parallel.pipeline.semantic_layer_perm).
+    pp_interleave: int = 1
+    pp_stages: int = 0
     # muP (train/mup.py): width of the base model hyperparams were tuned
     # at; None = standard parametrization. When set, attention uses 1/d
     # scaling and tied logits get the 1/width_mult MuReadout multiplier.
     mup_base_width: Optional[int] = None
 
     def __post_init__(self):
+        if self.moe_impl not in ("dense", "ragged"):
+            raise ValueError(
+                f"moe_impl must be 'dense' or 'ragged', got "
+                f"{self.moe_impl!r}"
+            )
         if self.moe_gating not in ("topk", "switch"):
             raise ValueError(
                 f"moe_gating must be 'topk' or 'switch', got "

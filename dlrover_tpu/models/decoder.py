@@ -314,6 +314,7 @@ def run_trunk(
         "moe_z_loss": jnp.zeros([], jnp.float32),
     }
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    v = max(1, getattr(cfg, "pp_interleave", 1))
     if pp > 1:
         from dlrover_tpu.parallel.pipeline import pipeline_apply
 
@@ -326,9 +327,32 @@ def run_trunk(
             positions,
             mesh,
             num_microbatches=cfg.pp_microbatches or None,
+            interleave=v,
         )
     else:
         n_layers = jax.tree.leaves(layers)[0].shape[0]
+        if v > 1:
+            # an interleave-stacked checkpoint: storage order is the
+            # pipeline's chunk layout — apply layers in semantic order
+            # so this is the SAME network the pp mesh trains
+            from dlrover_tpu.parallel.pipeline import semantic_layer_perm
+
+            if not cfg.pp_stages:
+                raise ValueError(
+                    "pp_interleave>1 needs cfg.pp_stages to recover the "
+                    "layer order off the pipeline mesh"
+                )
+            if n_layers % (cfg.pp_stages * v):
+                raise ValueError(
+                    f"n_layer={n_layers} not divisible by "
+                    f"pp_stages·pp_interleave={cfg.pp_stages}·{v}: the "
+                    "interleaved layer layout is undefined (jnp.take "
+                    "would silently truncate the stack)"
+                )
+            perm = jnp.asarray(
+                semantic_layer_perm(n_layers, cfg.pp_stages, v)
+            )
+            layers = jax.tree.map(lambda t: jnp.take(t, perm, 0), layers)
 
         def scan_fn(carry, inp):
             layer, idx = inp
@@ -380,11 +404,18 @@ def forward(
         x = shd.constrain(x, mesh, "batch", "seq", None)
 
     if attn_impl == "auto":
-        # flash (pallas) on real accelerators; the kernel's interpret
-        # path is far slower than plain jnp on CPU
-        attn_impl = (
-            "reference" if jax.default_backend() == "cpu" else "flash"
-        )
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            # a sequence-parallel mesh MUST use the shard_map sp paths:
+            # letting GSPMD partition a plain attention over seq-sharded
+            # q/k/v ends in "involuntary full rematerialization" (a
+            # replicate-then-repartition of the score matmul operands)
+            attn_impl = "ulysses"
+        else:
+            # flash (pallas) on real accelerators; the kernel's
+            # interpret path is far slower than plain jnp on CPU
+            attn_impl = (
+                "reference" if jax.default_backend() == "cpu" else "flash"
+            )
 
     if cfg.prefix_lm and prefix_len is None:
         # a GLM-family model silently training fully-causal is the worst

@@ -3,25 +3,103 @@
 Reference: atorch's PiPPy-based pipeline
 (auto/opt_lib/pipeline_parallel_optimization.py:56, compilers/pipe_compiler/
 distributed_pippy_compiler.py) — stage graphs executed over torch RPC with
-an interleaved schedule. None of that maps to TPU: XLA compiles one SPMD
-program, so the pipeline here is the *collective* formulation (scaling-book
-style): layer parameters are sharded over the ``pp`` mesh axis, microbatch
-activations rotate stage→stage with ``ppermute``, and the whole schedule is
-a ``lax.scan`` inside one ``shard_map`` that is manual over ``pp`` only —
-every other axis (dp/fsdp/tp/sp/ep) stays visible to GSPMD, so FSDP/TP
-sharding constraints inside the stage body keep working unchanged.
+an interleaved schedule (compilers/pipe_compiler/StageInterleaver.py). None
+of that maps to TPU: XLA compiles one SPMD program, so the pipeline here is
+the *collective* formulation (scaling-book style): layer parameters are
+sharded over the ``pp`` mesh axis, microbatch activations rotate
+stage→stage with ``ppermute``, and the whole schedule is a ``lax.scan``
+inside one ``shard_map`` that is manual over ``pp`` only — every other
+axis (dp/fsdp/tp/ep) stays visible to GSPMD, so FSDP/TP sharding
+constraints inside the stage body keep working unchanged.
 
-Schedule: GPipe-style fill-drain over M microbatches and P stages
-(M + P - 1 ticks, bubble fraction (P-1)/(M+P-1)). Gradients come from
-plain ``jax.grad`` through the scan — ``ppermute``'s transpose is the
-reverse permute, which *is* the backward pipeline.
+Schedules:
+- GPipe fill-drain (``interleave=1``): M + P − 1 ticks, bubble
+  (P−1)/(M+P−1).
+- Interleaved / circular (``interleave=v>1``): each device owns v
+  NON-ADJACENT layer chunks (virtual stage vs = j·P + s lives on device
+  s at local slot j), activations lap the ring v times, M·v + P − 1
+  ticks → bubble (P−1)/(M·v+P−1) — the v× bubble cut of the reference's
+  StageInterleaver, expressed as one SPMD scan.
+
+Stage-boundary dtype: ``boundary_dtype="bfloat16"`` moves half the ICI
+bytes per hop (via ``_bits_ppermute`` — the bits ride as uint16 so AD
+never differentiates an integer collective). The DEFAULT stays float32:
+differentiating the full decoder body over bf16 boundaries currently
+dies in XLA's SPMD partitioner with "Invalid binary instruction opcode
+copy" (repro: decoder.forward grad on a pp2·tp2·ep2 virtual-CPU mesh
+with cfg dtype=bfloat16 — isolated pipeline bodies incl. remat,
+sharding constraints, norms, softmax and rope all pass, so the trigger
+is some full-decoder op combination). Flip the default once the
+partitioner bug is fixed; the machinery and its parity test
+(test_pipeline.py::test_bf16_boundary_matches_f32) are in place.
+
+Gradients come from plain ``jax.grad`` through the scan — ``ppermute``'s
+transpose is the reverse permute, which *is* the backward pipeline.
 """
 
+import functools
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def interleaved_chunk_order(pp: int, v: int) -> np.ndarray:
+    """Storage-chunk index applied at each virtual-stage position.
+
+    Layer storage is contiguously sharded over pp: device s holds
+    storage chunks [s·v, (s+1)·v). Virtual stage vs = j·P + s runs
+    device s's local slot j = storage chunk s·v + j. Every layer-apply
+    path (pipelined or not) must use THIS order for the network to be
+    the same function on every mesh."""
+    return np.array(
+        [(vs % pp) * v + (vs // pp) for vs in range(pp * v)], np.int32
+    )
+
+
+def semantic_layer_perm(n_layer: int, pp: int, v: int) -> np.ndarray:
+    """Storage-layer indices in semantic (virtual-stage) order."""
+    cl = n_layer // (pp * v)
+    chunks = interleaved_chunk_order(pp, v)
+    return (
+        chunks[:, None] * cl + np.arange(cl, dtype=np.int32)[None, :]
+    ).reshape(-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _bits_ppermute(x, axis, perm):
+    """ppermute that moves raw bits (uintN on the wire).
+
+    Differentiating a bf16 collective chain through the pipeline scan
+    crashes XLA ("Invalid binary instruction opcode copy"), which is why
+    round 1 paid double ICI bytes upcasting boundaries to f32. Moving
+    the SAME bits as uint16 sidesteps the miscompile: AD never sees the
+    integer collective (this custom_vjp supplies the transpose — the
+    reverse ring permute of the cotangent bits)."""
+    return _bits_move(x, axis, perm)
+
+
+def _bits_move(x, axis, perm):
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return jax.lax.ppermute(x, axis, perm)
+    uint = jnp.dtype(f"uint{x.dtype.itemsize * 8}")
+    bits = jax.lax.bitcast_convert_type(x, uint)
+    moved = jax.lax.ppermute(bits, axis, perm)
+    return jax.lax.bitcast_convert_type(moved, x.dtype)
+
+
+def _bits_ppermute_fwd(x, axis, perm):
+    return _bits_move(x, axis, perm), None
+
+
+def _bits_ppermute_bwd(axis, perm, _, g):
+    inv = tuple((dst, src) for (src, dst) in perm)
+    return (_bits_move(g, axis, inv),)
+
+
+_bits_ppermute.defvjp(_bits_ppermute_fwd, _bits_ppermute_bwd)
 
 
 def pipeline_apply(
@@ -32,24 +110,34 @@ def pipeline_apply(
     mesh: Mesh,
     num_microbatches: Optional[int] = None,
     axis: str = "pp",
+    interleave: int = 1,
+    boundary_dtype=None,  # stage-hop dtype; None → float32 (see module doc)
 ) -> jax.Array:
     """Run the layer stack as a pp-stage pipeline; returns [B, S, D].
 
-    Each pp rank owns a contiguous block of L/pp layers (the ``layers``
-    logical axis maps to ``pp`` in the sharding rules). Stage 0 feeds a new
-    microbatch every tick; activations hop one stage per tick over ICI.
+    Each pp rank owns a contiguous storage block of L/pp layers, split
+    into ``interleave`` chunks (see ``interleaved_chunk_order``). Stage 0
+    feeds a new microbatch every tick of its free slots; activations hop
+    one stage per tick over ICI, wrapping pp−1 → 0 between laps.
     """
     pp = mesh.shape[axis]
     if pp == 1:
         raise ValueError("pipeline_apply requires a pp axis > 1")
+    v = max(1, int(interleave))
     b_global = x.shape[0]
     m = num_microbatches or pp
     if b_global % m:
         raise ValueError(
             f"global batch {b_global} not divisible by {m} microbatches"
         )
+    if v > 1 and m % pp:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({m}) divisible "
+            f"by pp ({pp})"
+        )
 
     compute_dtype = x.dtype
+    bdt = jnp.dtype(boundary_dtype or jnp.float32)
 
     def local(layers_blk, x_all, pos_all):
         stage = jax.lax.axis_index(axis)
@@ -63,46 +151,84 @@ def pipeline_apply(
 
         xs, pos = to_mb(x_all), to_mb(pos_all)
 
-        def stage_apply(act, p):
+        # local storage block [L/pp, ...] → v chunks [v, cl, ...]
+        def to_chunks(t):
+            return t.reshape((v, t.shape[0] // v) + t.shape[1:])
+
+        chunks = jax.tree.map(to_chunks, layers_blk)
+
+        def stage_apply(act, p, chunk_idx):
+            blk = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(
+                    t, chunk_idx, 0, keepdims=False
+                ),
+                chunks,
+            )
+
             def scan_body(c, layer):
                 return body_fn(c, layer, p), None
 
             out, _ = jax.lax.scan(
-                scan_body, act.astype(compute_dtype), layers_blk
+                scan_body, act.astype(compute_dtype), blk
             )
-            # activations cross carry/collective boundaries in f32: the
-            # transpose of a bf16 psum/collective crashes XLA ("Invalid
-            # binary instruction opcode copy"); compute stays bf16 inside
-            return out.astype(jnp.float32)
+            return out.astype(bdt)
 
-        # fill-drain: no wraparound edge — stage pp-1's output exits
-        perm = [(i, i + 1) for i in range(pp - 1)]
+        # interleaved: wraparound ring — stage pp-1 feeds stage 0 for
+        # the next lap. Fill-drain (v=1) has no next lap, so it keeps
+        # the edge-less perm: the wrap hop would ship a full microbatch
+        # every tick only for stage 0 to discard it (and that edge can
+        # cross DCN on a multi-slice mesh).
+        if v > 1:
+            perm = tuple((i, (i + 1) % pp) for i in range(pp))
+        else:
+            perm = tuple((i, i + 1) for i in range(pp - 1))
 
         def step(carry, t):
             buf, outs = carry
-            # stage s processes microbatch t - s (garbage outside [0, m),
-            # clipped — those ticks are the fill/drain bubble)
-            my_mb = jnp.clip(t - stage, 0, m - 1)
-            inp = jax.lax.dynamic_index_in_dim(xs, my_mb, 0, keepdims=False)
+            # stream position u: stage s at tick t works on the item its
+            # predecessor handled at t-1. m/j derivation (P | M groups):
+            #   m = (u // (P·v))·P + u mod P      (microbatch)
+            #   j = (u mod (P·v)) // P            (lap / local chunk)
+            u = t - stage
+            mb = jnp.clip(
+                (u // (pp * v)) * pp + jax.lax.rem(u, pp), 0, m - 1
+            )
+            j = jnp.clip(jax.lax.rem(u, pp * v) // pp, 0, v - 1)
+            active = (u >= 0) & (u < m * v)
+            inp = jax.lax.dynamic_index_in_dim(xs, mb, 0, keepdims=False)
             p_cur = jax.lax.dynamic_index_in_dim(
-                pos, my_mb, 0, keepdims=False
+                pos, mb, 0, keepdims=False
             )
-            cur = jnp.where(stage == 0, inp, buf)
-            out = stage_apply(cur, p_cur)
-            oidx = t - (pp - 1)
+            cur = jnp.where((stage == 0) & (j == 0), inp, buf)
+            out = stage_apply(cur, p_cur, j)
             outs_upd = jax.lax.dynamic_update_index_in_dim(
-                outs, out, jnp.clip(oidx, 0, m - 1), 0
+                outs, out.astype(jnp.float32), mb, 0
             )
-            outs = jnp.where((stage == pp - 1) & (oidx >= 0), outs_upd, outs)
-            buf = jax.lax.ppermute(out, axis, perm)
+            outs = jnp.where(
+                (stage == pp - 1) & (j == v - 1) & active, outs_upd, outs
+            )
+            # f32 hops use the plain collective (known-good); narrower
+            # ones ride as bits so AD sees only this custom transpose
+            if bdt.itemsize < 4:
+                buf = _bits_ppermute(out, axis, perm)
+            else:
+                buf = jax.lax.ppermute(out, axis, perm)
             return (buf, outs), None
 
         init = jax.lax.pcast(
-            (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)), (axis,), to="varying"
+            (
+                jnp.zeros_like(xs[0]),
+                jnp.zeros(xs.shape, jnp.float32),
+            ),
+            (axis,),
+            to="varying",
         )
-        (_, outs), _ = jax.lax.scan(step, init, jnp.arange(m + pp - 1))
+        (_, outs), _ = jax.lax.scan(
+            step, init, jnp.arange(m * v + pp - 1)
+        )
         # results accumulate on the last stage only; psum replicates them
-        # back across pp (zeros elsewhere contribute nothing)
+        # back across pp (zeros elsewhere contribute nothing). f32: the
+        # sum is exact regardless of stage count.
         outs = jax.lax.psum(outs, axis)
         return outs.swapaxes(0, 1).reshape(x_all.shape)
 
@@ -113,15 +239,17 @@ def pipeline_apply(
         axis_names={axis},
         in_specs=(layer_specs, P(), P()),
         out_specs=P(),
-    )(layers, x.astype(jnp.float32), positions)
+    )(layers, x.astype(bdt), positions)
     return out.astype(compute_dtype)
 
 
-def pipeline_bubble_fraction(pp: int, num_microbatches: int) -> float:
-    """Idle fraction of the GPipe fill-drain schedule."""
+def pipeline_bubble_fraction(
+    pp: int, num_microbatches: int, interleave: int = 1
+) -> float:
+    """Idle fraction of the schedule: (P−1)/(M·v + P−1)."""
     if pp <= 1:
         return 0.0
-    return (pp - 1) / (num_microbatches + pp - 1)
+    return (pp - 1) / (num_microbatches * max(1, interleave) + pp - 1)
 
 
 def validate_pipeline_config(cfg, mesh_cfg) -> None:
@@ -129,10 +257,26 @@ def validate_pipeline_config(cfg, mesh_cfg) -> None:
     pp = mesh_cfg.pp
     if pp <= 1:
         return
-    if cfg.n_layer % pp:
+    v = max(1, getattr(cfg, "pp_interleave", 1))
+    if cfg.n_layer % (pp * v):
         raise ValueError(
-            f"n_layer={cfg.n_layer} not divisible by pp={pp}"
+            f"n_layer={cfg.n_layer} not divisible by pp·interleave="
+            f"{pp}·{v}"
         )
+    if v > 1:
+        m = cfg.pp_microbatches or pp
+        if m % pp:
+            raise ValueError(
+                f"pp_interleave={v} needs pp_microbatches ({m}) "
+                f"divisible by pp ({pp})"
+            )
+        stages = getattr(cfg, "pp_stages", 0)
+        if stages and stages != pp:
+            raise ValueError(
+                f"cfg.pp_stages={stages} does not match mesh pp={pp}: "
+                "the interleaved layer order depends on the stage count, "
+                "so the checkpoint would be a different network"
+            )
     if mesh_cfg.sp > 1:
         raise ValueError(
             "pp>1 with sp>1 is unsupported: sequence-parallel attention "

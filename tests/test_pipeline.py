@@ -97,10 +97,132 @@ def test_pipeline_more_microbatches_than_stages():
     assert float(jnp.max(jnp.abs(ref - out))) < 1e-3
 
 
+def test_interleaved_forward_matches_scan():
+    """v=2 circular schedule ≡ the same network on a plain scan: the
+    non-pp path applies semantic_layer_perm, so both meshes compute the
+    SAME function from the same storage-ordered params."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, pp_interleave=2, pp_stages=2, pp_microbatches=4
+    )
+    tokens = _tokens()
+    params = jax.jit(lambda r: decoder.init(r, cfg))(jax.random.key(0))
+    mesh_ref = build_mesh(MeshConfig(dp=8))
+    ref = jax.jit(
+        lambda p, t: decoder.forward(p, t, cfg, mesh=mesh_ref)
+    )(params, tokens)
+
+    mesh = build_mesh(MeshConfig(dp=4, pp=2))
+    sharded = jax.device_put(
+        params, shardings_for_tree(mesh, decoder.logical_axes(cfg))
+    )
+    out = jax.jit(
+        lambda p, t: decoder.forward(p, t, cfg, mesh=mesh)
+    )(sharded, tokens)
+    assert float(jnp.max(jnp.abs(ref - out))) < 1e-3
+
+
+def test_interleaved_grads_match_scan():
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, pp_interleave=2, pp_stages=2, pp_microbatches=2
+    )
+    tokens = _tokens(batch=4)
+    params = jax.jit(lambda r: decoder.init(r, cfg))(jax.random.key(0))
+
+    def loss_on(mesh, p):
+        logits = decoder.forward(p, tokens, cfg, mesh=mesh)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    mesh_ref = build_mesh(MeshConfig(dp=8))
+    g_ref = jax.jit(jax.grad(lambda p: loss_on(mesh_ref, p)))(params)
+    mesh_pp = build_mesh(MeshConfig(dp=4, pp=2))
+    sharded = jax.device_put(
+        params, shardings_for_tree(mesh_pp, decoder.logical_axes(cfg))
+    )
+    g_pp = jax.jit(jax.grad(lambda p: loss_on(mesh_pp, p)))(sharded)
+    for ref_leaf, pp_leaf, path in zip(
+        jax.tree.leaves(g_ref),
+        jax.tree.leaves(g_pp),
+        jax.tree.leaves(
+            jax.tree.map_with_path(lambda p, _: str(p), g_ref)
+        ),
+    ):
+        assert (
+            float(jnp.max(jnp.abs(ref_leaf - pp_leaf))) < 2e-3
+        ), path
+
+
+def test_bf16_boundary_matches_f32():
+    """bits-ppermute bf16 stage hops ≡ f32 hops (fwd and grads) on a
+    pipeline body — half the ICI bytes when enabled."""
+    from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = build_mesh(MeshConfig(dp=2, pp=4))
+    L, B, S, D = 4, 8, 16, 32
+    w = (
+        jax.random.normal(jax.random.key(0), (L, D, D)) * 0.1
+    ).astype(jnp.bfloat16)
+    x = jax.random.normal(jax.random.key(1), (B, S, D)).astype(
+        jnp.bfloat16
+    )
+    pos = jnp.zeros((B, S), jnp.int32)
+
+    def body(c, layer, p):
+        return jnp.tanh(c @ layer)
+
+    def loss(w, x, bdt):
+        out = pipeline_apply(
+            body, w, x, pos, mesh, boundary_dtype=bdt
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    ref = float(jax.jit(lambda w, x: loss(w, x, None))(w, x))
+    bf = float(jax.jit(lambda w, x: loss(w, x, "bfloat16"))(w, x))
+    assert abs(ref - bf) / max(abs(ref), 1) < 2e-2
+
+    g_ref = jax.jit(jax.grad(lambda w: loss(w, x, None)))(w)
+    g_bf = jax.jit(jax.grad(lambda w: loss(w, x, "bfloat16")))(w)
+    err = float(
+        jnp.max(jnp.abs(g_ref.astype(jnp.float32) - g_bf.astype(jnp.float32)))
+    )
+    scale = float(jnp.max(jnp.abs(g_ref.astype(jnp.float32))))
+    assert err / max(scale, 1e-6) < 5e-2
+
+
+def test_semantic_layer_perm_roundtrip():
+    from dlrover_tpu.parallel.pipeline import (
+        interleaved_chunk_order,
+        semantic_layer_perm,
+    )
+    import numpy as np
+
+    # P=2, v=2, L=8 (cl=2): virtual stages run storage chunks
+    # [0, 2, 1, 3] → layers [0,1, 4,5, 2,3, 6,7]
+    assert interleaved_chunk_order(2, 2).tolist() == [0, 2, 1, 3]
+    assert semantic_layer_perm(8, 2, 2).tolist() == [0, 1, 4, 5, 2, 3, 6, 7]
+    # v=1 is the identity (GPipe)
+    assert semantic_layer_perm(8, 4, 1).tolist() == list(range(8))
+    # every storage layer appears exactly once
+    assert sorted(semantic_layer_perm(12, 3, 2).tolist()) == list(range(12))
+    np.testing.assert_array_equal(
+        np.sort(semantic_layer_perm(16, 4, 2)), np.arange(16)
+    )
+
+
 def test_bubble_fraction():
     assert pipeline_bubble_fraction(1, 4) == 0.0
     assert pipeline_bubble_fraction(4, 4) == pytest.approx(3 / 7)
     assert pipeline_bubble_fraction(4, 12) == pytest.approx(3 / 15)
+    # interleaving divides the bubble: (P−1)/(M·v+P−1)
+    assert pipeline_bubble_fraction(4, 4, interleave=2) == pytest.approx(
+        3 / 11
+    )
+    assert pipeline_bubble_fraction(4, 8, interleave=4) == pytest.approx(
+        3 / 35
+    )
 
 
 def test_validate_rejects_bad_configs():
@@ -113,3 +235,18 @@ def test_validate_rejects_bad_configs():
             get_config("tiny", n_layer=4), MeshConfig(pp=2, sp=2)
         )
     validate_pipeline_config(get_config("tiny", n_layer=4), MeshConfig(pp=2))
+    # interleave: layer count must divide by pp·v; stage count must match
+    with pytest.raises(ValueError, match="pp·interleave"):
+        validate_pipeline_config(
+            get_config("tiny", n_layer=4, pp_interleave=4, pp_stages=2),
+            MeshConfig(pp=2),
+        )
+    with pytest.raises(ValueError, match="pp_stages"):
+        validate_pipeline_config(
+            get_config("tiny", n_layer=8, pp_interleave=2, pp_stages=4),
+            MeshConfig(pp=2),
+        )
+    validate_pipeline_config(
+        get_config("tiny", n_layer=8, pp_interleave=2, pp_stages=2),
+        MeshConfig(pp=2),
+    )
