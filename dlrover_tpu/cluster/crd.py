@@ -176,16 +176,22 @@ def pod_manifest(
     host_index: int,
     slice_index: int,
     master_addr: str = "",
+    attempt: int = 0,
 ) -> Dict[str, Any]:
     """Concrete pod for host ``host_index`` (global), slice-annotated so
-    the master's rendezvous can build ICI-contiguous process groups."""
+    the master's rendezvous can build ICI-contiguous process groups.
+    ``attempt`` > 0 suffixes the name so a relaunched pod never collides
+    with its dead predecessor still visible in the API."""
     tpl = pod_template(job_name, role, rs)
     name = f"{job_name}-{role}-{host_index}"
+    if attempt:
+        name = f"{name}-r{attempt}"
     tpl["metadata"]["name"] = name
     tpl["metadata"]["labels"].update(
         {
             "elasticjob.dlrover/rank-index": str(host_index),
             "elasticjob.dlrover/slice-index": str(slice_index),
+            "elasticjob.dlrover/relaunch-count": str(attempt),
         }
     )
     env = tpl["spec"]["containers"][0]["env"]

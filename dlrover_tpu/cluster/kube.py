@@ -1,0 +1,473 @@
+"""In-process Kubernetes API double + list-watch platform binding.
+
+Reference: the reference binds to K8s through three pieces — a
+list-watch pod watcher (dlrover/python/master/watcher/k8s_watcher.py:194
+``PodWatcher.watch``, resourceVersion-resumed), a pod scaler
+(master/scaler/pod_scaler.py:372 ``_periodic_create_pod``), and the Go
+operator's reconcile loop (go/operator/pkg/controllers/
+elasticjob_controller.go:47). This module is the same contract,
+TPU-native: a ``KubeApi`` protocol the master talks to, a
+``FakeKubeApi`` in-process API-server double (thread-safe store +
+resourceVersion'd watch streams) so the ENTIRE reconcile loop — pod
+dies → watch event → NodeEvent → relaunch ScalePlan → new pod
+manifest — runs end-to-end in tests, and a ``JobReconciler`` that
+plays the operator for ElasticJob/ScalePlan CRDs. A real cluster
+client implementing ``KubeApi`` (create/delete/list/watch) drops in
+unchanged.
+"""
+
+import copy
+import itertools
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.node_manager import NodeEvent
+
+logger = get_logger(__name__)
+
+JOB_LABEL = "elasticjob.dlrover/name"
+RANK_LABEL = "elasticjob.dlrover/rank-index"
+INCARNATION_LABEL = "elasticjob.dlrover/relaunch-count"
+
+# pod phase → node status (reference: k8s_watcher._convert_pod_event)
+_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.FAILED,
+}
+
+# container termination reason → exit reason (reference:
+# pod_watcher _verify_restarting / new_pod_event classification)
+_REASON_TO_EXIT = {
+    "OOMKilled": NodeExitReason.OOM,
+    "Evicted": NodeExitReason.KILLED,
+    "Preempted": NodeExitReason.KILLED,
+    "DeadlineExceeded": NodeExitReason.KILLED,
+    "FatalError": NodeExitReason.FATAL_ERROR,
+}
+
+
+@dataclass
+class WatchEvent:
+    type: str                 # ADDED | MODIFIED | DELETED
+    obj: Dict                 # full manifest (deep copy)
+    resource_version: int = 0
+
+    @property
+    def kind(self) -> str:
+        return self.obj.get("kind", "")
+
+    @property
+    def name(self) -> str:
+        return self.obj.get("metadata", {}).get("name", "")
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self.obj.get("metadata", {}).get("labels", {}) or {}
+
+
+class KubeApi:
+    """The master's platform contract (subset of a K8s client)."""
+
+    def create(self, manifest: Dict) -> Dict:
+        raise NotImplementedError
+
+    def delete(self, kind: str, name: str, namespace: str = "default"):
+        raise NotImplementedError
+
+    def get(
+        self, kind: str, name: str, namespace: str = "default"
+    ) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "default",
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict]:
+        raise NotImplementedError
+
+    def watch(
+        self,
+        kind: Optional[str] = None,
+        namespace: str = "default",
+        label_selector: Optional[Dict[str, str]] = None,
+        since_rv: int = 0,
+        stop: Optional[threading.Event] = None,
+        poll_s: float = 0.2,
+    ) -> Iterator[WatchEvent]:
+        raise NotImplementedError
+
+
+def _match_labels(obj: Dict, selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class FakeKubeApi(KubeApi):
+    """API-server double: object store + resourceVersion'd watch streams.
+
+    Everything a list-watch client observes from a real API server is
+    modelled: monotonically increasing resourceVersions, replay of
+    events after ``since_rv``, label-selector filtering, and phase
+    transitions via ``set_pod_phase`` (the test's stand-in for the
+    kubelet)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._objects: Dict[Tuple[str, str, str], Dict] = {}
+        self._events: List[WatchEvent] = []
+        self._rv = itertools.count(1)
+
+    # ---- store ------------------------------------------------------------
+
+    def _key(self, manifest: Dict) -> Tuple[str, str, str]:
+        meta = manifest.get("metadata", {})
+        return (
+            manifest.get("kind", ""),
+            meta.get("namespace", "default"),
+            meta.get("name", ""),
+        )
+
+    def _emit(self, etype: str, manifest: Dict):
+        rv = next(self._rv)
+        manifest.setdefault("metadata", {})["resourceVersion"] = rv
+        self._events.append(
+            WatchEvent(etype, copy.deepcopy(manifest), rv)
+        )
+        self._cond.notify_all()
+
+    def create(self, manifest: Dict) -> Dict:
+        manifest = copy.deepcopy(manifest)
+        with self._cond:
+            key = self._key(manifest)
+            if not key[2]:
+                raise ValueError("manifest has no metadata.name")
+            if key in self._objects:
+                raise ValueError(f"{key[0]} {key[2]} already exists")
+            if manifest.get("kind") == "Pod":
+                manifest.setdefault("status", {"phase": "Pending"})
+            self._objects[key] = manifest
+            self._emit("ADDED", manifest)
+        return copy.deepcopy(manifest)
+
+    def update(self, manifest: Dict) -> Dict:
+        manifest = copy.deepcopy(manifest)
+        with self._cond:
+            key = self._key(manifest)
+            if key not in self._objects:
+                raise KeyError(f"{key[0]} {key[2]} not found")
+            self._objects[key] = manifest
+            self._emit("MODIFIED", manifest)
+        return copy.deepcopy(manifest)
+
+    def delete(self, kind: str, name: str, namespace: str = "default"):
+        with self._cond:
+            obj = self._objects.pop((kind, namespace, name), None)
+            if obj is not None:
+                self._emit("DELETED", obj)
+
+    def get(
+        self, kind: str, name: str, namespace: str = "default"
+    ) -> Optional[Dict]:
+        with self._cond:
+            obj = self._objects.get((kind, namespace, name))
+            return copy.deepcopy(obj) if obj else None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "default",
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict]:
+        with self._cond:
+            return [
+                copy.deepcopy(o)
+                for (k, ns, _), o in sorted(self._objects.items())
+                if k == kind
+                and ns == namespace
+                and _match_labels(o, label_selector)
+            ]
+
+    # ---- watch ------------------------------------------------------------
+
+    def watch(
+        self,
+        kind: Optional[str] = None,
+        namespace: str = "default",
+        label_selector: Optional[Dict[str, str]] = None,
+        since_rv: int = 0,
+        stop: Optional[threading.Event] = None,
+        poll_s: float = 0.2,
+    ) -> Iterator[WatchEvent]:
+        """Yield events with resource_version > since_rv (replaying the
+        backlog first, like a real list-watch resuming from a listed
+        resourceVersion), then block for new ones until ``stop``."""
+        stop = stop or threading.Event()
+        rv = since_rv
+        while not stop.is_set():
+            with self._cond:
+                batch = [
+                    ev
+                    for ev in self._events
+                    if ev.resource_version > rv
+                    and (kind is None or ev.kind == kind)
+                    and ev.obj.get("metadata", {}).get(
+                        "namespace", "default"
+                    )
+                    == namespace
+                    and _match_labels(ev.obj, label_selector)
+                ]
+                if not batch:
+                    self._cond.wait(timeout=poll_s)
+                    continue
+            for ev in batch:
+                rv = ev.resource_version
+                yield ev
+
+    def latest_rv(self) -> int:
+        with self._cond:
+            return self._events[-1].resource_version if self._events else 0
+
+    # ---- kubelet stand-in -------------------------------------------------
+
+    def set_pod_phase(
+        self,
+        name: str,
+        phase: str,
+        reason: str = "",
+        namespace: str = "default",
+    ):
+        """Test hook: what the kubelet/scheduler would write to status."""
+        with self._cond:
+            obj = self._objects.get(("Pod", namespace, name))
+            if obj is None:
+                raise KeyError(f"pod {name} not found")
+            obj.setdefault("status", {})["phase"] = phase
+            if reason:
+                obj["status"]["reason"] = reason
+            self._emit("MODIFIED", obj)
+
+
+# ---------------------------------------------------------------------------
+# Pod list-watch → NodeEvents (reference: k8s_watcher.PodWatcher)
+# ---------------------------------------------------------------------------
+
+
+def pod_to_node_event(ev: WatchEvent) -> Optional[NodeEvent]:
+    """Translate one pod watch event into the master's NodeEvent."""
+    if ev.kind != "Pod":
+        return None
+    rank = ev.labels.get(RANK_LABEL)
+    if rank is None:
+        return None
+    node_id = int(rank)
+    incarnation = int(ev.labels.get(INCARNATION_LABEL, -1))
+    status = ev.obj.get("status", {}) or {}
+    reason = status.get("reason", "")
+    exit_reason = _REASON_TO_EXIT.get(reason, "")
+    if ev.type == "DELETED":
+        return NodeEvent(
+            NodeEventType.DELETED,
+            node_id,
+            status=NodeStatus.DELETED,
+            exit_reason=exit_reason or NodeExitReason.KILLED,
+            incarnation=incarnation,
+        )
+    node_status = _PHASE_TO_STATUS.get(status.get("phase", ""))
+    if node_status is None:
+        return None
+    if node_status == NodeStatus.FAILED and not exit_reason:
+        exit_reason = NodeExitReason.UNKNOWN
+    return NodeEvent(
+        NodeEventType.MODIFIED,
+        node_id,
+        status=node_status,
+        exit_reason=exit_reason,
+        incarnation=incarnation,
+    )
+
+
+class PodWatcher:
+    """List-watch thread feeding a handler (JobManager.process_event).
+
+    Reference: k8s_watcher.PodWatcher.watch (:194) — list first, then
+    watch from the listed resourceVersion, surviving watch restarts."""
+
+    def __init__(
+        self,
+        api: KubeApi,
+        job_name: str,
+        handler: Callable[[NodeEvent], None],
+        namespace: str = "default",
+    ):
+        self._api = api
+        self._job = job_name
+        self._handler = handler
+        self._ns = namespace
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def list_node_events(self) -> List[NodeEvent]:
+        """Initial list: current pod states as synthetic MODIFIED events."""
+        events = []
+        for pod in self._api.list(
+            "Pod", self._ns, {JOB_LABEL: self._job}
+        ):
+            ev = pod_to_node_event(WatchEvent("MODIFIED", pod))
+            if ev:
+                events.append(ev)
+        return events
+
+    def start(self, since_rv: int = 0):
+        for ev in self.list_node_events():
+            self._handler(ev)
+        self._thread = threading.Thread(
+            target=self._run,
+            args=(since_rv,),
+            name=f"pod-watch-{self._job}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _run(self, since_rv: int):
+        for ev in self._api.watch(
+            kind="Pod",
+            namespace=self._ns,
+            label_selector={JOB_LABEL: self._job},
+            since_rv=since_rv,
+            stop=self._stop,
+        ):
+            ne = pod_to_node_event(ev)
+            if ne is None:
+                continue
+            try:
+                self._handler(ne)
+            except Exception:
+                logger.exception("pod watch handler failed for %s", ev)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Operator analog (reference: elasticjob_controller.go Reconcile)
+# ---------------------------------------------------------------------------
+
+
+class JobReconciler:
+    """Reconciles ElasticJob + ScalePlan CRDs into pods via a SliceScaler.
+
+    Reference: the Go operator's controllers
+    (elasticjob_controller.go:47 — on ElasticJob events, ensure the
+    replica pods exist; scaleplan_controller.go — on ScalePlan events,
+    apply replicaCounts/removePods). Runs as a watch thread against any
+    KubeApi; with FakeKubeApi this IS the operator for tests."""
+
+    def __init__(
+        self,
+        api: KubeApi,
+        job,  # cluster.crd.ElasticJob
+        role: str = "worker",
+        master_addr: str = "",
+    ):
+        from dlrover_tpu.cluster.scaler import SliceScaler
+        from dlrover_tpu.master.node_manager import ScalePlan
+
+        self._api = api
+        self._job = job
+        self._role = role
+        self._ns = job.namespace
+        self._plan_cls = ScalePlan
+        self.scaler = SliceScaler(
+            job,
+            role=role,
+            submit_fn=api.create,
+            delete_fn=lambda name: api.delete("Pod", name, self._ns),
+            master_addr=master_addr,
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, since_rv: int = 0):
+        self._thread = threading.Thread(
+            target=self._run,
+            args=(since_rv,),
+            name=f"reconcile-{self._job.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self, since_rv: int):
+        for ev in self._api.watch(
+            namespace=self._ns, since_rv=since_rv, stop=self._stop
+        ):
+            try:
+                self._reconcile(ev)
+            except Exception:
+                logger.exception("reconcile failed for %s", ev)
+
+    def _reconcile(self, ev: WatchEvent):
+        if ev.kind == "ElasticJob" and ev.type in ("ADDED", "MODIFIED"):
+            if ev.name != self._job.name:
+                return
+            spec = ev.obj.get("spec", {})
+            if spec.get("suspend"):
+                return
+            replicas = (
+                spec.get("replicaSpecs", {})
+                .get(self._role, {})
+                .get("replicas")
+            )
+            if replicas is None:
+                return
+            plan = self._plan_cls()
+            plan.worker_num = replicas
+            self.scaler.scale(plan)
+        elif ev.kind == "ScalePlan" and ev.type == "ADDED":
+            spec = ev.obj.get("spec", {})
+            if spec.get("ownerJob") != self._job.name:
+                return
+            plan = self._plan_cls()
+            counts = spec.get("replicaCounts", {})
+            if self._role in counts:
+                plan.worker_num = counts[self._role]
+            for pod_name in spec.get("removePods", []):
+                m = re.search(r"-(\d+)$", pod_name)
+                if m:
+                    plan.remove_nodes.append(
+                        _RemoveRef(int(m.group(1)))
+                    )
+            if not plan.empty():
+                self.scaler.scale(plan)
+
+
+@dataclass
+class _RemoveRef:
+    """Minimal node ref for ScalePlan.remove_nodes (.id + .name)."""
+
+    id: int = field(default=0)
+
+    @property
+    def name(self) -> str:
+        return f"node-{self.id}"

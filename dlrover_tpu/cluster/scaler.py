@@ -70,8 +70,19 @@ class SliceScaler(Scaler):
                 self._scale_to(plan.worker_num)
             for node in plan.remove_nodes:
                 self._remove_host(node.id)
-            for _ in plan.launch_nodes:
-                self._add_host()
+            for node in plan.launch_nodes:
+                # a relaunch keeps the node's rank index: delete the
+                # predecessor pod (it may still be Running — e.g. a
+                # heartbeat-timeout wedge holding its slice) and create
+                # the replacement under an incarnation-suffixed name.
+                # The predecessor's DELETED watch event carries the OLD
+                # incarnation label, so the master's stale-event guard
+                # drops it instead of relaunching again.
+                idx = getattr(node, "id", None)
+                attempt = getattr(node, "incarnation", 0)
+                if idx is not None and idx in self._pods:
+                    self._remove_host(idx)
+                self._add_host(idx=idx, attempt=attempt)
 
     # ---- internals --------------------------------------------------------
 
@@ -112,8 +123,9 @@ class SliceScaler(Scaler):
             i += 1
         return i
 
-    def _add_host(self):
-        idx = self._next_index()
+    def _add_host(self, idx: Optional[int] = None, attempt: int = 0):
+        if idx is None:
+            idx = self._next_index()
         hps = self.rs.slice.hosts_per_slice
         manifest = pod_manifest(
             self.job.name,
@@ -122,6 +134,7 @@ class SliceScaler(Scaler):
             host_index=idx,
             slice_index=idx // max(hps, 1),
             master_addr=self.master_addr,
+            attempt=attempt,
         )
         self.submit_fn(manifest)
         self._pods[idx] = manifest["metadata"]["name"]

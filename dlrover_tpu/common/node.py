@@ -90,7 +90,8 @@ class Node:
         self.heartbeat_time: float = 0.0
 
         self.exit_reason: str = ""
-        self.relaunch_count = 0
+        self.relaunch_count = 0  # budget-consuming failures only
+        self.incarnation = 0     # bumps on EVERY relaunch (pod identity)
         self.max_relaunch_count = max_relaunch_count
         self.relaunchable = True
         self.is_released = False
@@ -126,7 +127,12 @@ class Node:
             self.relaunch_count += 1
 
     def new_incarnation(self) -> "Node":
-        """Clone bookkeeping for a relaunched incarnation of this node."""
+        """Clone bookkeeping for a relaunched incarnation of this node.
+
+        ``incarnation`` always bumps — it is the pod-identity counter
+        (names, stale-event guards) — while ``relaunch_count`` only
+        moves via ``inc_relaunch_count`` (budget; eviction/preemption
+        exits are free)."""
         node = copy.copy(self)
         node.status = NodeStatus.INITIAL
         node.start_time = None
@@ -134,6 +140,7 @@ class Node:
         node.exit_reason = ""
         node.is_released = False
         node.create_time = time.time()
+        node.incarnation = self.incarnation + 1
         return node
 
     def __repr__(self):

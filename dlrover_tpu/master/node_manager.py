@@ -32,6 +32,12 @@ class NodeEvent:
     node_id: int
     status: str = ""
     exit_reason: str = ""
+    # which incarnation of the node the event is about (pods carry a
+    # relaunch-count label); -1 = unknown → always accepted. Guards the
+    # relaunch loop against stale events for an already-replaced pod
+    # (e.g. the platform GC deleting the dead predecessor) cascading
+    # into relaunches of the healthy replacement.
+    incarnation: int = -1
 
 
 class ScalePlan:
@@ -171,6 +177,8 @@ class JobManager:
             node = self._nodes.get(event.node_id)
             if node is None:
                 return
+            if 0 <= event.incarnation < node.incarnation:
+                return  # stale: about a pod this node already replaced
             if event.event_type == NodeEventType.HEARTBEAT_TIMEOUT:
                 status = NodeStatus.FAILED
                 node.exit_reason = NodeExitReason.KILLED
@@ -198,6 +206,10 @@ class JobManager:
             node.update_status(status)
 
     def _on_node_down(self, node: Node):
+        if node.is_released:
+            # the master removed this node on purpose (scale-in): its
+            # termination is expected, not a failure to relaunch
+            return
         for cb in self.node_failed_callbacks:
             cb(node)
         if node.should_relaunch():
@@ -288,10 +300,25 @@ class JobManager:
                         return True
             return False
 
+    def release_node(self, node_id: int):
+        """Mark a node as removed-on-purpose (scale-in): its upcoming
+        pod deletion/failure events must not trigger a relaunch."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.is_released = True
+
     def set_worker_num(self, n: int):
-        """Elastic scale target; new node slots get fresh bookkeeping."""
+        """Elastic scale target; new node slots get fresh bookkeeping.
+
+        Scale-in releases the highest-indexed nodes (mirroring the
+        scaler's drop-highest-first policy) so their pod deletions read
+        as intentional, not as failures to relaunch."""
         with self._lock:
             self._num_workers = n
+            for i, node in self._nodes.items():
+                if i >= n and not node.is_exited():
+                    node.is_released = True
             for i in range(n):
                 if i not in self._nodes:
                     node = Node(
