@@ -61,6 +61,9 @@ def top_k_gating(
     """
     b, s, e = gate_logits.shape
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    # raw per-choice weights from the shared rule; the capacity-kept
+    # masking below is this path's only divergence from _topk_weights
+    # (renormalization must run over KEPT choices, after drops)
     gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [B,S,k]
     # one-hot expert assignment per choice: [B, S, k, E]
     assign = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
@@ -98,15 +101,7 @@ def switch_gating(
     Multiplicative jitter noise on the router logits during training
     (``rng`` given) decorrelates expert assignment, per the Switch paper.
     """
-    if jitter_eps > 0.0 and rng is not None:
-        noise = jax.random.uniform(
-            rng,
-            gate_logits.shape,
-            minval=1.0 - jitter_eps,
-            maxval=1.0 + jitter_eps,
-            dtype=gate_logits.dtype,
-        )
-        gate_logits = gate_logits * noise
+    gate_logits = _jitter(gate_logits, jitter_eps, rng)
     return top_k_gating(gate_logits, 1, capacity, renormalize=False)
 
 
@@ -128,6 +123,53 @@ def router_z_loss(gate_logits: jax.Array) -> jax.Array:
     """ST-MoE router z-loss: mean logsumexp² keeps router logits small."""
     logz = jax.nn.logsumexp(gate_logits.astype(jnp.float32), axis=-1)
     return jnp.mean(logz**2)
+
+
+def _jitter(gate_logits, jitter_eps, rng):
+    """Switch-paper multiplicative router noise (train only)."""
+    if jitter_eps > 0.0 and rng is not None:
+        noise = jax.random.uniform(
+            rng,
+            gate_logits.shape,
+            minval=1.0 - jitter_eps,
+            maxval=1.0 + jitter_eps,
+            dtype=gate_logits.dtype,
+        )
+        gate_logits = gate_logits * noise
+    return gate_logits
+
+
+def _topk_weights(probs, k: int, renormalize: bool):
+    """Top-k choice + combine-weight rule — THE router weight rule,
+    shared by the capacity paths (via top_k_gating) and the ragged path
+    (via _route) so the lowerings cannot drift apart.
+
+    ``renormalize`` MUST be False for k=1: renormalizing a single choice
+    yields the constant 1.0, which has zero derivative w.r.t. the router
+    logits — the router would never train. Raw router probability
+    (Switch: y = p_i(x)·E_i(x)) keeps it differentiable."""
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)
+    if renormalize and k > 1:
+        weights = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9
+        )
+    else:
+        weights = gate_vals
+    return weights, gate_idx
+
+
+def _route(x, moe, cfg, rng):
+    """Shared router entry for the ragged path: logits (+switch jitter)
+    → probs, combine weights, expert choices."""
+    k = 1 if cfg.moe_gating == "switch" else cfg.expert_top_k
+    gate_logits = x @ moe["w_gate"].astype(x.dtype)
+    if cfg.moe_gating == "switch":
+        gate_logits = _jitter(gate_logits, cfg.moe_jitter, rng)
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    weights, gate_idx = _topk_weights(
+        probs, k, renormalize=cfg.moe_gating != "switch"
+    )
+    return gate_logits, probs, weights, gate_idx
 
 
 def _gate(x, moe, cfg, rng):
@@ -171,14 +213,23 @@ def moe_block(
 ):
     """x: [B,S,D] → [B,S,D]. Expert FFN sharded over the ``ep`` axis.
 
-    Two dispatch lowerings:
-    - dense einsum (default): dispatch/combine einsums + sharding
-      constraints; XLA inserts the expert all-to-alls on ICI.
+    Three dispatch lowerings:
+    - dense einsum (default): capacity-based one-hot dispatch/combine
+      einsums + sharding constraints; XLA inserts the expert
+      all-to-alls on ICI.
     - explicit all-to-all (``cfg.moe_alltoall``): shard_map over ``ep``
       with ``lax.all_to_all``, the direct analog of the reference's
       ``_AllToAll`` autograd op (moe_layer.py:22) — tokens are sharded
       over ``ep`` too, so each rank routes B/ep of the batch.
+    - ragged / dropless (``cfg.moe_impl == "ragged"``): tokens sorted by
+      expert + ``lax.ragged_dot`` grouped-GEMM — FLOPs scale with the
+      tokens actually routed, no capacity truncation under imbalance
+      (reference capability: grouped_gemm_moe.py:46, built there on a
+      CUDA grouped-GEMM kernel; ragged_dot is the TPU-native primitive).
     """
+    if cfg.moe_impl == "ragged":
+        out, aux = _moe_block_ragged(x, moe, cfg, mesh, rng)
+        return (out, aux) if return_aux else out
     if (
         cfg.moe_alltoall
         and mesh is not None
@@ -280,3 +331,151 @@ def _moe_block_alltoall(x, moe, cfg, mesh, rng):
         moe["w_down"].astype(x.dtype),
     )
     return out, aux
+
+
+# ---------------------------------------------------------------------------
+# Dropless (ragged grouped-GEMM) lowering
+# ---------------------------------------------------------------------------
+
+
+def _ragged_ffn(xl, moe_local, gate_idx, weights, dtype):
+    """Grouped-GEMM expert FFN over one rank's token slice.
+
+    xl: [T, D] tokens, gate_idx/weights: [T, k] routing. Sorts the (token,
+    choice) pairs by expert, runs the three projections as ragged matmuls
+    (``lax.ragged_dot``: rhs [E, ·, ·], group_sizes = actual per-expert
+    token counts — the MXU only sees the routed tokens), and scatter-adds
+    the weighted expert outputs back. No capacity, no drops.
+    Returns (out [T, D], group_sizes [E] int32).
+    """
+    t, d = xl.shape
+    k = gate_idx.shape[-1]
+    e = moe_local["w_up"].shape[0]
+    flat_idx = gate_idx.reshape(t * k)
+    order = jnp.argsort(flat_idx)  # stable: preserves token order per expert
+    token_of = order // k
+    sorted_in = jnp.take(xl, token_of, axis=0)  # [T·k, D]
+    group_sizes = jnp.bincount(flat_idx, length=e).astype(jnp.int32)
+
+    up = jax.lax.ragged_dot(
+        sorted_in, moe_local["w_up"].astype(dtype), group_sizes
+    )
+    gate_p = jax.lax.ragged_dot(
+        sorted_in, moe_local["w_gate_proj"].astype(dtype), group_sizes
+    )
+    h = jax.nn.silu(gate_p) * up
+    out_sorted = jax.lax.ragged_dot(
+        h, moe_local["w_down"].astype(dtype), group_sizes
+    )  # [T·k, D]
+    w_sorted = jnp.take(weights.reshape(t * k), order)[:, None]
+    out = jnp.zeros((t, d), jnp.float32)
+    out = out.at[token_of].add(
+        out_sorted.astype(jnp.float32) * w_sorted
+    )
+    return out.astype(dtype), group_sizes
+
+
+def _ragged_aux(gate_logits, probs, group_sizes, pmean_axes=None):
+    """Router losses from actual (dropless) assignment counts.
+
+    lb loss: E · Σ_e f_e·p_e with f_e = fraction of (token, choice) slots
+    routed to e — the dropless analog of GShard's dispatch fraction.
+    Global statistics: fractions are pmean'd over token-sharding axes
+    BEFORE the product (see _moe_block_alltoall note on bias)."""
+    total = jnp.maximum(group_sizes.sum(), 1).astype(jnp.float32)
+    frac_tokens = group_sizes.astype(jnp.float32) / total
+    frac_probs = probs.astype(jnp.float32).mean(axis=(0, 1))
+    z = router_z_loss(gate_logits)
+    if pmean_axes:
+        frac_tokens = jax.lax.pmean(frac_tokens, axis_name=pmean_axes)
+        frac_probs = jax.lax.pmean(frac_probs, axis_name=pmean_axes)
+        z = jax.lax.pmean(z, axis_name=pmean_axes)
+    e = probs.shape[-1]
+    return {
+        "moe_lb_loss": e * jnp.sum(frac_tokens * frac_probs),
+        "moe_z_loss": z,
+    }
+
+
+def _moe_block_ragged(x, moe, cfg, mesh=None, rng=None):
+    """Dropless MoE: per-rank token sort + ragged grouped-GEMM.
+
+    Token-sharding axes (dp/fsdp/sp) stay sharded — each rank routes and
+    computes its own token slice with every expert's weights; the expert
+    FFN width shards over tp (partial products psum'd). The ``ep`` axis
+    is not used by this lowering (experts are token-local); meshes with
+    ep>1 route expert WEIGHT storage over ep via the all-to-all/dense
+    paths instead.
+    """
+    b, s, d = x.shape
+    if mesh is None or all(
+        mesh.shape.get(a, 1) == 1 for a in ("dp", "fsdp", "sp", "tp")
+    ):
+        gate_logits, probs, weights, gate_idx = _route(x, moe, cfg, rng)
+        out, group_sizes = _ragged_ffn(
+            x.reshape(b * s, d),
+            moe,
+            gate_idx.reshape(b * s, -1),
+            weights.reshape(b * s, -1),
+            x.dtype,
+        )
+        aux = _ragged_aux(gate_logits, probs, group_sizes)
+        return out.reshape(b, s, d), aux
+
+    if mesh.shape.get("ep", 1) > 1:
+        raise ValueError(
+            "moe_impl='ragged' computes experts token-locally and does "
+            "not shard them over ep; use an ep=1 mesh (shard dp/fsdp/tp "
+            "instead) or moe_alltoall/dense for expert parallelism"
+        )
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    token_axes = ("dp", "fsdp")
+
+    def body(xl, w_gate, w_up, w_gp, w_down):
+        local = {
+            "w_gate": w_gate,
+            "w_up": w_up,
+            "w_gate_proj": w_gp,
+            "w_down": w_down,
+        }
+        bl, sl, _ = xl.shape
+        gate_logits, probs, weights, gate_idx = _route(xl, local, cfg, rng)
+        out, group_sizes = _ragged_ffn(
+            xl.reshape(bl * sl, d),
+            local,
+            gate_idx.reshape(bl * sl, -1),
+            weights.reshape(bl * sl, -1),
+            xl.dtype,
+        )
+        # tp shards the FFN width: the down-projection emits partial
+        # sums over the mlp dimension
+        if mesh.shape.get("tp", 1) > 1:
+            out = jax.lax.psum(out, axis_name="tp")
+        aux = _ragged_aux(
+            gate_logits, probs, group_sizes,
+            pmean_axes=token_axes + ("sp",),
+        )
+        return out.reshape(bl, sl, d), aux
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(token_axes, "sp", None),
+            P(None, None),          # router replicated
+            P(None, None, "tp"),    # FFN width over tp
+            P(None, None, "tp"),
+            P(None, "tp", None),
+        ),
+        out_specs=(P(token_axes, "sp", None), P()),
+        check_vma=False,
+    )(
+        x,
+        moe["w_gate"].astype(x.dtype),
+        moe["w_up"].astype(x.dtype),
+        moe["w_gate_proj"].astype(x.dtype),
+        moe["w_down"].astype(x.dtype),
+    )
