@@ -175,8 +175,18 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _attention_block(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
-    b, s, d = x.shape
+def _project_qkv(
+    x, layer, cfg: ModelConfig, positions, *, mup_full_scale: bool = False
+):
+    """QKV projection + rope + muP q-scaling — the ONE place this math
+    lives; the batch forward (_attention_block), prefill and decode_step
+    all call it so they cannot drift apart.
+
+    muP wants 1/d_head TOTAL attention scaling. The batch path's attn
+    impls apply 1/sqrt(d_head) themselves, so q carries the other half;
+    the cache paths run their attention with scale=1 and set
+    ``mup_full_scale`` so q carries all of it."""
+    b, s, _ = x.shape
     nh, nkv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     q = (x @ layer["attn"]["wq"].astype(x.dtype)).reshape(b, s, nh, hd)
     k = (x @ layer["attn"]["wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
@@ -185,9 +195,32 @@ def _attention_block(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
     if cfg.mup_base_width:
-        # muP attention: 1/d_head total scaling (the attn impls apply
-        # 1/sqrt(d_head); fold the rest into q)
-        q = q * (hd ** -0.5)
+        q = q * (hd ** (-1.0 if mup_full_scale else -0.5))
+    return q, k, v
+
+
+def _cache_layer_tail(x, attn_out, layer, cfg: ModelConfig):
+    """Residual + MLP/MoE wiring shared by prefill and decode_step
+    (mirrors _layer_body minus mesh constraints, aux and rng)."""
+    ln2 = layer["ln2"]
+    if cfg.parallel_residual:
+        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+    else:
+        x = x + attn_out
+        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+    if cfg.n_experts > 0:
+        from dlrover_tpu.parallel.moe import moe_block
+
+        mlp_out = moe_block(h2, layer["moe"], cfg, None)
+    else:
+        mlp_out = _mlp_block(h2, layer, cfg, None)
+    return x + attn_out + mlp_out if cfg.parallel_residual else x + mlp_out
+
+
+def _attention_block(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
+    b, s, d = x.shape
+    nh, hd = cfg.n_head, cfg.head_dim
+    q, k, v = _project_qkv(x, layer, cfg, positions)
     if mesh is not None:
         q = shd.constrain(q, mesh, "batch", "seq", "heads", None)
         k = shd.constrain(k, mesh, "batch", "seq", "kv", None)
@@ -600,12 +633,97 @@ def _cached_attention(q, ck, cv, pos, cfg: ModelConfig):
     return out.reshape(b, 1, h * d).astype(q.dtype)
 
 
+def prefill(
+    params: Params,
+    tokens: jax.Array,  # [B, P] int32 — the whole prompt
+    cfg: ModelConfig,
+    max_len: int,
+    prefix_len: Optional[jax.Array] = None,  # [B] int32 (prefix-LM)
+) -> Tuple[jax.Array, Dict]:
+    """Batch forward over the prompt that RETURNS the filled KV cache.
+
+    One [B,P] forward replaces P sequential ``decode_step`` calls — the
+    prompt runs at batched-matmul efficiency, and prefix-LM models
+    become cacheable at all: the prompt K/V are computed WITH the
+    bidirectional-prefix mask (``prefix_len``), which the per-token
+    causal prefill can never produce (reference capability:
+    transformers' prefill inside .generate; atorch leans on it for RL
+    rollouts, rl/model_engine/model_engine.py).
+
+    Returns (logits [B, P, V] f32, cache with positions [0, P) filled).
+    """
+    if not cfg.causal:
+        raise ValueError("prefill requires a causal model")
+    if cfg.prefix_lm and prefix_len is None:
+        # same footgun guard as forward(): a prefix-LM model silently
+        # prefilled fully-causal would hand decode_step a wrong cache
+        raise ValueError(
+            "cfg.prefix_lm is set but no prefix_len was provided; pass "
+            "jnp.zeros([batch], int32) for fully-causal behavior"
+        )
+    if getattr(cfg, "pp_interleave", 1) > 1:
+        raise ValueError(
+            "prefill scans layers in storage order; interleave-stacked "
+            "checkpoints (pp_interleave>1) need the semantic layer "
+            "permutation — use forward() paths"
+        )
+    dt = jnp.dtype(cfg.dtype)
+    b, p = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+
+    nh, hd = cfg.n_head, cfg.head_dim
+    scale = 1.0 if cfg.mup_base_width else hd**-0.5
+
+    def layer_fn(carry, layer):
+        x = carry
+        ln1 = layer["ln1"]
+        h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+        q, k, v = _project_qkv(
+            h, layer, cfg, positions, mup_full_scale=True
+        )
+        attn = mha_reference(
+            q, k, v,
+            causal=True,
+            softmax_scale=scale,
+            prefix_len=prefix_len,
+            window=cfg.attn_window,
+        ).reshape(b, p, nh * hd)
+        attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
+        x = _cache_layer_tail(x, attn_out, layer, cfg)
+        # cache layout [B, max_len, Hkv, D], prompt slots filled
+        pad = max_len - p
+        ck = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        cv = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_fn, x, params["layers"])
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def decode_step(
     params: Params,
     tokens: jax.Array,  # [B] int32 — token at position ``pos``
     cache: Dict,
     pos: jax.Array,     # scalar int32
     cfg: ModelConfig,
+    prefilled: bool = False,
 ) -> Tuple[jax.Array, Dict]:
     """One incremental step: logits predicting position ``pos+1``.
 
@@ -613,17 +731,25 @@ def decode_step(
     ``forward`` — the standard KV-cache inference path (the reference
     leans on transformers.generate; here it is native). Single-mesh only
     (no pp/sp); MoE layers route the single token through moe_block.
+
+    ``prefilled`` asserts the cache came from ``prefill``: required for
+    prefix-LM models, whose prompt K/V depend on bidirectional attention
+    that per-token causal decoding can never reconstruct. The causal
+    cached attention here is correct for the post-prompt tail either way
+    (a tail query sees all prefix keys AND earlier tail keys — both are
+    ≤ pos).
     """
     if not cfg.causal:
         raise ValueError(
             "decode_step requires a causal model; encoder (bidirectional) "
             "configs have no autoregressive decode"
         )
-    if cfg.prefix_lm:
+    if cfg.prefix_lm and not prefilled:
         raise ValueError(
             "decode_step's per-token causal prefill cannot build a "
             "prefix-LM cache (prefix K/V depend on bidirectional "
-            "attention below); use sample(use_cache=False)"
+            "attention below); build the cache with prefill() and pass "
+            "prefilled=True, or use sample(use_cache=False)"
         )
     dt = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
@@ -635,42 +761,19 @@ def decode_step(
             params["pos_embed"]["table"], positions, axis=0
         ).astype(dt)
 
-    nh, nkv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
-
     def layer_fn(carry, inp):
         x = carry
         layer, ck, cv = inp
-        ln1, ln2 = layer["ln1"], layer["ln2"]
+        ln1 = layer["ln1"]
         h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
-        q = (h @ layer["attn"]["wq"].astype(h.dtype)).reshape(b, 1, nh, hd)
-        k = (h @ layer["attn"]["wk"].astype(h.dtype)).reshape(b, 1, nkv, hd)
-        v = (h @ layer["attn"]["wv"].astype(h.dtype)).reshape(b, 1, nkv, hd)
-        if cfg.pos == "rope":
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
-        if cfg.mup_base_width:
-            q = q * (hd**-1.0)  # full 1/d (see _attention_block + scale=1)
+        q, k, v = _project_qkv(
+            h, layer, cfg, positions, mup_full_scale=True
+        )
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
         attn = _cached_attention(q, ck, cv, pos, cfg)
         attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
-        if cfg.parallel_residual:
-            # must mirror _layer_body: both branches read the layer input
-            h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
-        else:
-            x = x + attn_out
-            h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
-        if cfg.n_experts > 0:
-            from dlrover_tpu.parallel.moe import moe_block
-
-            mlp_out = moe_block(h2, layer["moe"], cfg, None)
-        else:
-            mlp_out = _mlp_block(h2, layer, cfg, None)
-        x = (
-            x + attn_out + mlp_out
-            if cfg.parallel_residual
-            else x + mlp_out
-        )
+        x = _cache_layer_tail(x, attn_out, layer, cfg)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(

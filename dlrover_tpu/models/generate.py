@@ -3,9 +3,11 @@
 Reference: the actor generation step of atorch's RL pipeline
 (rl/model_engine + transformers .generate). Implemented as one jitted
 ``lax.scan`` over decode positions with a fixed-size token buffer, so the
-whole rollout compiles once. Default path decodes incrementally with a
-KV cache (decoder.decode_step, O(S) per token); the full-prefix
-recompute path remains for mesh/MoE setups the cache doesn't cover.
+whole rollout compiles once. Default path prefills the prompt in ONE
+batch forward that returns the KV cache (decoder.prefill — matmul-bound,
+like transformers' prefill), then decodes incrementally
+(decoder.decode_step, O(S) per token); the full-prefix recompute path
+remains for mesh/MoE setups the cache doesn't cover.
 """
 
 from typing import Optional
@@ -27,6 +29,7 @@ def sample(
     attn_impl: str = "auto",
     pad_id: int = 0,
     use_cache: bool = True,
+    prompt_lens: Optional[jax.Array] = None,  # [B] int32 true lengths
 ) -> jax.Array:
     """Sample continuations; returns [B, P + max_new_tokens].
 
@@ -34,12 +37,20 @@ def sample(
     fixed shape (prompt padded to full length) — XLA-friendly: no dynamic
     shapes, one compilation for the whole rollout.
 
-    ``use_cache=True`` decodes incrementally with a KV cache (O(S) per
-    token via decoder.decode_step); ``False`` re-runs the full prefix
-    each step. The cache path covers single-mesh dense models — MoE
-    routes with per-step capacity in decode, a different policy than the
-    batch forward's capacity drops, so MoE always takes the full-prefix
-    path to keep sampling consistent with training-time logprobs.
+    ``use_cache=True`` prefills the prompt in one batch forward
+    (decoder.prefill) and decodes incrementally (O(S) per token);
+    ``False`` re-runs the full prefix each step. The cache path covers
+    single-mesh dense models including prefix-LM — MoE routes with
+    per-step capacity in decode, a different policy than the batch
+    forward's capacity drops, so MoE always takes the full-prefix path
+    to keep sampling consistent with training-time logprobs.
+
+    ``prompt_lens`` (ragged batches): per-sequence true prompt lengths.
+    For prefix-LM models it bounds the bidirectional prefix per sequence
+    — WITHOUT it the full padded width is used, making pad tokens
+    bidirectionally-visible context for every query. (Pad tokens between
+    a sequence's true length and P remain ordinarily causally visible on
+    every path — left-pad ragged prompts when that matters.)
 
     Sampling draws use ``fold_in(rng, position)``, so both paths consume
     the same rng stream. Greedy (temperature=0) rollouts match token for
@@ -55,27 +66,39 @@ def sample(
             "sample() requires a causal model; encoder configs "
             "(causal=False) cannot generate autoregressively"
         )
+    b, p = prompts.shape
+    # GLM convention: the prompt is "part A" — bidirectionally visible.
+    # Per-sequence true lengths keep ragged pads out of the prefix.
+    prefix = None
+    if cfg.prefix_lm:
+        prefix = (
+            prompt_lens.astype(jnp.int32)
+            if prompt_lens is not None
+            else jnp.full((b,), p, jnp.int32)
+        )
+    # the cache path needs no model-parallel axes (prefill/decode_step
+    # carry no sharding constraints); a dp/fsdp-only mesh is fine — the
+    # batch axis shards through GSPMD propagation. Interleave-stacked
+    # checkpoints (pp_interleave>1) are excluded: prefill/decode_step
+    # scan layers in storage order, not the semantic_layer_perm order
+    # the pipeline layout requires.
+    cacheable_mesh = mesh is None or all(
+        mesh.shape.get(a, 1) == 1 for a in ("tp", "sp", "pp", "ep")
+    )
     if (
         use_cache
-        and mesh is None
+        and cacheable_mesh
         and cfg.n_experts == 0
-        and not cfg.prefix_lm
+        and getattr(cfg, "pp_interleave", 1) <= 1
     ):
-        # prefix-LM models can't prefill through decode_step: the cached
-        # K/V of prefix positions depend on bidirectional attention in
-        # the layers below, which the per-token causal path never sees
         return _sample_cached(
-            params, cfg, prompts, max_new_tokens, rng, temperature, pad_id
+            params, cfg, prompts, max_new_tokens, rng, temperature,
+            pad_id, prefix,
         )
-    b, p = prompts.shape
     total = p + max_new_tokens
     buf = jnp.full((b, total), pad_id, dtype=jnp.int32)
     buf = buf.at[:, :p].set(prompts)
     positions = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), (b, total))
-    # GLM convention: the prompt is "part A" — bidirectionally visible
-    prefix = (
-        jnp.full((b,), p, jnp.int32) if cfg.prefix_lm else None
-    )
 
     def step(buf, i):
         logits = decoder.forward(
@@ -102,42 +125,48 @@ def sample(
 
 
 def _sample_cached(
-    params, cfg, prompts, max_new_tokens, rng, temperature, pad_id
+    params, cfg, prompts, max_new_tokens, rng, temperature, pad_id, prefix
 ):
-    """KV-cache decoding: prompt prefill and sampling share one scan —
-    position i feeds token i−1 into decode_step; while i is inside the
-    prompt the model's prediction is discarded in favor of the prompt
-    token, afterwards the sampled token is written into the buffer."""
+    """Prefill + incremental decode: one batch forward fills the KV
+    cache for the whole prompt (prefix-LM masking included), then the
+    scan decodes only the new positions."""
     b, p = prompts.shape
     total = p + max_new_tokens
     buf = jnp.full((b, total), pad_id, dtype=jnp.int32)
     buf = buf.at[:, :p].set(prompts)
-    cache = decoder.init_kv_cache(cfg, b, total)
+    if max_new_tokens <= 0:
+        return buf
+
+    logits_p, cache = decoder.prefill(
+        params, prompts, cfg, total, prefix_len=prefix
+    )
+    # grow the cache buffers to total via prefill's max_len — done there
+
+    def draw(step_logits, i):
+        if temperature > 0.0:
+            return jax.random.categorical(
+                jax.random.fold_in(rng, i), step_logits / temperature
+            )
+        return jnp.argmax(step_logits, axis=-1)
+
+    # first new token comes from the prefill logits at position p-1
+    tok0 = draw(logits_p[:, p - 1, :], jnp.int32(p)).astype(jnp.int32)
+    buf = buf.at[:, p].set(tok0)
 
     def step(carry, i):
         buf, cache = carry
         tok_in = jax.lax.dynamic_slice_in_dim(buf, i - 1, 1, axis=1)[:, 0]
         logits, cache = decoder.decode_step(
-            params, tok_in, cache, i - 1, cfg
+            params, tok_in, cache, i - 1, cfg, prefilled=True
         )
-        # position-keyed rng: identical draw stream to the uncached path
-        # (prefill positions take the prompt token, so their draw is
-        # discarded — the stream stays position-aligned either way)
-        if temperature > 0.0:
-            tok = jax.random.categorical(
-                jax.random.fold_in(rng, i), logits / temperature
-            )
-        else:
-            tok = jnp.argmax(logits, axis=-1)
-        prompt_tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)[:, 0]
-        tok = jnp.where(i < p, prompt_tok, tok).astype(jnp.int32)
+        tok = draw(logits, i).astype(jnp.int32)
         buf = jax.lax.dynamic_update_slice_in_dim(
             buf, tok[:, None], i, axis=1
         )
         return (buf, cache), None
 
     (buf, _), _ = jax.lax.scan(
-        step, (buf, cache), jnp.arange(1, total)
+        step, (buf, cache), jnp.arange(p + 1, total)
     )
     return buf
 
