@@ -114,14 +114,16 @@ def _should_quantize(leaf) -> bool:
     )
 
 
-def _quantize_tree(state, bits: int = 8):
+def quantize_tree(state, bits: int = 8):
+    """Blockwise-quantize every large float leaf of a pytree (small
+    leaves pass through untouched). Inverse: ``dequantize_tree``."""
     return jax.tree.map(
         lambda leaf: quantize(leaf, bits) if _should_quantize(leaf) else leaf,
         state,
     )
 
 
-def _dequantize_tree(state):
+def dequantize_tree(state):
     return jax.tree.map(
         lambda leaf: dequantize(leaf)
         if isinstance(leaf, QuantizedArray)
@@ -129,6 +131,11 @@ def _dequantize_tree(state):
         state,
         is_leaf=lambda x: isinstance(x, QuantizedArray),
     )
+
+
+# intra-module aliases (historical names)
+_quantize_tree = quantize_tree
+_dequantize_tree = dequantize_tree
 
 
 def quantize_optimizer_state(

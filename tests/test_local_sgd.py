@@ -122,6 +122,96 @@ def test_local_sgd_converges_and_stays_in_sync(reducer):
     np.testing.assert_allclose(np.asarray(results[0]["w"]), 2.0, atol=0.3)
 
 
+def _run_big_slices(world, cfg, steps, lr=0.1, target=2.0, dim=8192):
+    """Like _run_slices but with a leaf large enough to be quantized
+    (>= ops.quant.MIN_QUANT_SIZE)."""
+    transport = InProcessTransport(world)
+    results = [None] * world
+
+    def slice_main(rank):
+        rng = jax.random.key(rank)
+        params = {"w": jnp.zeros((dim,))}
+        sync = LocalSGDSynchronizer(cfg, transport.make_exchange(rank))
+        sync.maybe_sync(0, params)
+        for step in range(1, steps + 1):
+            noise = jax.random.normal(
+                jax.random.fold_in(rng, step), (dim,)
+            ) * 0.1
+            g = 2 * (params["w"] - target) + noise
+            params = {"w": params["w"] - lr * g}
+            params = sync.maybe_sync(step, params)
+        results[rank] = params
+
+    threads = [
+        threading.Thread(target=slice_main, args=(r,)) for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+@pytest.mark.parametrize("compress", ["int8", "int4"])
+def test_compressed_sync_converges_and_stays_in_sync(compress):
+    """int8/int4 outer reduce (quant_reduce.cu capability): slices stay
+    bit-identical after syncs and converge within tolerance of the
+    uncompressed trajectory."""
+    cfg_c = LocalSGDConfig(sync_interval=4, compress=compress)
+    cfg_f = LocalSGDConfig(sync_interval=4)
+    res_c = _run_big_slices(world=3, cfg=cfg_c, steps=24)
+    res_f = _run_big_slices(world=3, cfg=cfg_f, steps=24)
+    for r in res_c[1:]:
+        np.testing.assert_allclose(
+            np.asarray(r["w"]), np.asarray(res_c[0]["w"]), rtol=1e-5
+        )
+    np.testing.assert_allclose(np.asarray(res_c[0]["w"]), 2.0, atol=0.3)
+    # compressed endpoint within a small band of the exact one
+    err = np.abs(
+        np.asarray(res_c[0]["w"]) - np.asarray(res_f[0]["w"])
+    ).max()
+    assert err < 0.05, err
+
+
+def test_compressed_wire_bytes_shrink_4x():
+    from dlrover_tpu.parallel.local_sgd import _pack_tree
+    from dlrover_tpu.ops.quant import quantize_tree
+
+    delta = {"w": jnp.asarray(np.random.randn(512 * 1024), jnp.float32)}
+    raw = len(_pack_tree(delta))
+    q8 = len(_pack_tree(quantize_tree(delta, bits=8)))
+    q4 = len(_pack_tree(quantize_tree(delta, bits=4)))
+    assert raw / q8 > 3.5, (raw, q8)
+    assert raw / q4 > 6.5, (raw, q4)
+
+
+def test_error_feedback_conserves_delta():
+    """sent + residual must equal the intended delta exactly, and the
+    residual is re-injected into the next round's send."""
+    sent_trees = []
+
+    def exchange(t):
+        sent_trees.append(t)
+        return [t]
+
+    cfg = LocalSGDConfig(sync_interval=1, compress="int8")
+    sync = LocalSGDSynchronizer(cfg, exchange)
+    sync.maybe_sync(0, {"w": jnp.zeros((8192,))})
+    # mixed magnitudes INSIDE each 256-wide quantization block: the big
+    # values force a coarse blockwise scale, so the small ones suffer
+    # real quantization error
+    delta = jnp.where(jnp.arange(8192) % 2 == 0, 3.0, 1e-3)
+    sync.maybe_sync(1, {"w": delta})
+    from dlrover_tpu.ops.quant import dequantize_tree
+
+    sent = dequantize_tree(sent_trees[0])["w"]
+    resid = sync._error["w"]
+    np.testing.assert_allclose(
+        np.asarray(sent + resid), np.asarray(delta), rtol=1e-6
+    )
+    assert float(jnp.abs(resid).max()) > 0.0
+
+
 def test_local_sgd_interval_respected():
     calls = []
 
