@@ -15,7 +15,11 @@ from typing import List, Optional, Tuple
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.models.config import ModelConfig
-from dlrover_tpu.accelerate.analyser import analyse, device_hbm_bytes
+from dlrover_tpu.accelerate.analyser import (
+    OFFLOAD_OPT_WORKING_SET,
+    analyse,
+    device_hbm_bytes,
+)
 from dlrover_tpu.accelerate.dry_runner import dry_run
 from dlrover_tpu.accelerate.strategy import (
     AccelerationPlan,
@@ -292,6 +296,19 @@ def search_strategy(
     if mode == "heuristic":
         score, strat, plan = feasible[0]
         logger.info("heuristic strategy (score %.3f): %s", score, strat)
+        if plan.offload_opt_state:
+            # analyse() budgets the offloaded moments' in-flight HBM
+            # working set at a flat OFFLOAD_OPT_WORKING_SET of the tree;
+            # nothing in the step bounds the true peak, so an
+            # analytically-feasible offload plan can still OOM at step
+            # time. The measured modes validate with a real step.
+            logger.warning(
+                "heuristic mode selected offload_opt on analytic memory "
+                "estimates alone (working-set factor %.2f is an "
+                "assumption, not a bound) — prefer mode='measured' or "
+                "'cost' to validate with a dry run before training",
+                OFFLOAD_OPT_WORKING_SET,
+            )
         return strat, plan
 
     if mode == "bo":

@@ -72,24 +72,28 @@ def collective_bench(n_elems: int = 1 << 24, iters: int = 4) -> float:
 
 def run_comm_perf_test(sizes=(1 << 20, 1 << 24, 1 << 27)) -> dict:
     """Sweep allreduce sizes and report algorithmic bus bandwidth
-    (reference: dlrover-run --comm-perf-test). Returns {bytes: GB/s}
-    keyed by the PER-DEVICE reduced-buffer size; logs a warning when the
-    largest size runs below half the best observed bandwidth (a
-    congested/degraded link)."""
+    (reference: dlrover-run --comm-perf-test). Returns {n_elems: GB/s}
+    keyed by the REQUESTED global element count — per-device derived
+    sizes can collide (two requested sizes within a factor of
+    device-count of each other) and would silently overwrite; logs a
+    warning when the largest size runs below half the best observed
+    bandwidth (a congested/degraded link)."""
     n = len(jax.devices())
     if n < 2:
         logger.info("comm perf: skipped — fewer than 2 devices")
         return {}
     iters = 4
     results = {}
+    per_device_bytes = {}
     for n_elems in sizes:
         secs = collective_bench(n_elems=n_elems, iters=iters)
         # collective_bench shards [n, n_elems/n]: each device allreduces
         # an n_elems/n-element bf16 buffer; a ring moves 2(n-1)/n of
         # that buffer per device
         nbytes = (n_elems // n) * 2
+        per_device_bytes[n_elems] = nbytes
         algo_bytes = 2 * (n - 1) / n * nbytes * iters
-        results[nbytes] = (algo_bytes / secs / 1e9) if secs > 0 else 0.0
+        results[n_elems] = (algo_bytes / secs / 1e9) if secs > 0 else 0.0
     vals = [v for v in results.values() if v > 0]
     if vals and results[max(results)] < 0.5 * max(vals):
         logger.warning(
@@ -98,9 +102,11 @@ def run_comm_perf_test(sizes=(1 << 20, 1 << 24, 1 << 27)) -> dict:
             results[max(results)],
             max(vals),
         )
-    for nbytes, gbps in results.items():
+    for n_elems, gbps in results.items():
         logger.info(
-            "comm perf: allreduce %6.1f MB → %7.2f GB/s", nbytes / 1e6, gbps
+            "comm perf: allreduce %6.1f MB/device → %7.2f GB/s",
+            per_device_bytes[n_elems] / 1e6,
+            gbps,
         )
     return results
 
