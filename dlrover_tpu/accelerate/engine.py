@@ -293,22 +293,27 @@ def search_strategy(
         return strat, apply_strategy(strat)
 
     feasible.sort(key=lambda t: -t[0])
+
+    def _warn_if_unvalidated_offload(plan):
+        # analyse() budgets the offloaded moments' in-flight HBM working
+        # set at a flat OFFLOAD_OPT_WORKING_SET of the tree; nothing in
+        # the step bounds the true peak, so an analytically-feasible
+        # offload plan can still OOM at step time. Only an EXECUTED step
+        # validates it (mode='measure' or 'bo'; 'cost' compiles without
+        # running, so it cannot catch a runtime allocation peak).
+        if plan.offload_opt_state:
+            logger.warning(
+                "selected offload_opt without a successfully measured "
+                "step (working-set factor %.2f is an assumption, not a "
+                "bound) — run mode='measure' or 'bo' to validate before "
+                "training",
+                OFFLOAD_OPT_WORKING_SET,
+            )
+
     if mode == "heuristic":
         score, strat, plan = feasible[0]
         logger.info("heuristic strategy (score %.3f): %s", score, strat)
-        if plan.offload_opt_state:
-            # analyse() budgets the offloaded moments' in-flight HBM
-            # working set at a flat OFFLOAD_OPT_WORKING_SET of the tree;
-            # nothing in the step bounds the true peak, so an
-            # analytically-feasible offload plan can still OOM at step
-            # time. The measured modes validate with a real step.
-            logger.warning(
-                "heuristic mode selected offload_opt on analytic memory "
-                "estimates alone (working-set factor %.2f is an "
-                "assumption, not a bound) — prefer mode='measured' or "
-                "'cost' to validate with a dry run before training",
-                OFFLOAD_OPT_WORKING_SET,
-            )
+        _warn_if_unvalidated_offload(plan)
         return strat, plan
 
     if mode == "bo":
@@ -317,6 +322,7 @@ def search_strategy(
         )
         if best is None:
             _, strat, plan = feasible[0]
+            _warn_if_unvalidated_offload(plan)
             return strat, plan
         return best[1], best[2]
 
@@ -346,6 +352,13 @@ def search_strategy(
         if best is None or metric > best[0]:
             best = (metric, strat, plan)
     if best is None:
+        # every dry run failed: the fallback pick is exactly as
+        # unvalidated as the heuristic one
         _, strat, plan = feasible[0]
+        _warn_if_unvalidated_offload(plan)
         return strat, plan
+    if mode == "cost":
+        # cost mode compiles but never executes a step, so an offload
+        # pick is still runtime-unvalidated
+        _warn_if_unvalidated_offload(best[2])
     return best[1], best[2]

@@ -33,6 +33,190 @@ def _env(run_id, extra=None):
     return env
 
 
+def _drain(proc):
+    """Pump a process's merged stdout into a queue from a daemon thread:
+    keeps the ~64KB pipe from backpressure-blocking the producer while
+    the test waits on OTHER processes, and lets readers enforce real
+    deadlines (a blocking readline would only re-check its deadline
+    between lines)."""
+    import queue as queue_mod
+    import threading
+
+    q = queue_mod.Queue()
+
+    def run():
+        for line in proc.stdout:
+            q.put(line)
+        q.put(None)
+
+    threading.Thread(target=run, daemon=True).start()
+    return q
+
+
+def _collect(q, lines, until, deadline, on_line=None):
+    """Consume queued lines until ``until(line)`` or EOF/deadline.
+    Returns the matching line or None."""
+    import queue as queue_mod
+
+    while time.time() < deadline:
+        try:
+            line = q.get(timeout=0.2)
+        except queue_mod.Empty:
+            continue
+        if line is None:
+            return None
+        lines.append(line)
+        if on_line:
+            on_line(line)
+        if until(line):
+            return line
+    return None
+
+
+def test_world_shrink_resharded_recovery(tmp_path):
+    """The composed elasticity path (SURVEY §7 hard part #1): 2-node
+    training checkpoints to memory, both workers die, one node leaves
+    permanently, the master re-seals at world=1, and the survivor
+    restores the 2-host checkpoint onto the 1-process mesh (resharded
+    read of both emergency-persisted host packs) and finishes. Recovery
+    wall-clock (crash → resumed) is printed."""
+    run_id = f"ws{os.getpid()}"
+    master = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--port",
+            "0",
+            # min_nodes=1 lets the post-crash rendezvous seal a
+            # 1-node world after the 30s extra-nodes grace
+            "--num-workers",
+            "1",
+            "--max-workers",
+            "2",
+        ],
+        cwd=REPO,
+        env=_env(run_id),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    survivor = casualty = None
+    try:
+        master_q = _drain(master)  # drained for the whole test
+        master_lines = []
+        addr_line = _collect(
+            master_q,
+            master_lines,
+            until=lambda l: l.startswith("DLROVER_TPU_MASTER_ADDR="),
+            deadline=time.time() + 60,
+        )
+        assert addr_line, "master did not print its address"
+        addr = re.match(
+            r"DLROVER_TPU_MASTER_ADDR=(.+)", addr_line.strip()
+        ).group(1)
+
+        ckpt_dir = str(tmp_path / "ckpt")
+
+        def launch_agent(node_id, max_restarts):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "dlrover_tpu.agent.launcher",
+                    "--nnodes",
+                    "1:2",
+                    "--node-id",
+                    str(node_id),
+                    "--nproc",
+                    "1",
+                    "--max-restarts",
+                    str(max_restarts),
+                    "--master-addr",
+                    addr,
+                    "--",
+                    sys.executable,
+                    "examples/train_gpt_elastic.py",
+                    "--steps",
+                    "6",
+                    "--batch",
+                    "4",
+                    "--seq",
+                    "32",
+                    "--ckpt-dir",
+                    ckpt_dir,
+                    "--ckpt-every",
+                    "2",
+                    "--crash-at",
+                    "3",
+                ],
+                cwd=REPO,
+                env=_env(
+                    f"{run_id}_n{node_id}",
+                    {"DLROVER_TPU_COORDINATOR_PORT": "0"},
+                ),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+
+        # node 1 has no restart budget: after the synchronized crash at
+        # step 3 it leaves the job for good (the "lost host")
+        survivor = launch_agent(0, max_restarts=2)
+        casualty = launch_agent(1, max_restarts=0)
+        sur_q, cas_q = _drain(survivor), _drain(casualty)
+        sur_lines, cas_lines = [], []
+
+        assert (
+            _collect(
+                cas_q,
+                cas_lines,
+                until=lambda l: "simulating crash at step 3" in l,
+                deadline=time.time() + 300,
+            )
+            is not None
+        ), "".join(cas_lines)[-2000:]
+        t_crash = time.time()
+        casualty.wait(timeout=120)
+        assert casualty.returncode != 0
+
+        stamps = {}
+
+        def stamp(line):
+            if "resumed from step" in line and "resumed" not in stamps:
+                stamps["resumed"] = time.time()
+
+        _collect(
+            sur_q,
+            sur_lines,
+            until=lambda l: False,  # run to EOF or deadline
+            deadline=time.time() + 360,
+            on_line=stamp,
+        )
+        survivor.wait(timeout=60)
+        sur_out = "".join(sur_lines)
+
+        assert survivor.returncode == 0, sur_out[-4000:]
+        # phase 1 ran as a real 2-process cluster
+        assert "2 global devices" in sur_out, sur_out[-3000:]
+        # the survivor crashed too, restarted, and resumed from the
+        # emergency-persisted step-2 checkpoint on the SHRUNK world
+        assert "simulating crash at step 3" in sur_out
+        assert "resumed from step 2" in sur_out, sur_out[-3000:]
+        assert "worker succeeded" in sur_out
+        assert "resumed" in stamps
+        print(
+            f"\n[elastic-recovery] world 2→1 recovery wall-clock: "
+            f"{stamps['resumed'] - t_crash:.1f}s (crash → resumed-from-ckpt)"
+        )
+    finally:
+        for proc in (survivor, casualty):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        master.kill()
+        master.wait()
+
+
 def test_two_node_elastic_training(tmp_path):
     run_id = f"mn{os.getpid()}"
     master = subprocess.Popen(
