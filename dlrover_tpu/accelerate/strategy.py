@@ -37,6 +37,8 @@ class AccelerationPlan:
     optimizer_state_dtype: Optional[str] = None
     # host-offloaded moments (reference: atorch CPU-offload Adam)
     offload_opt_state: bool = False
+    # fp8 GEMMs w/ delayed scaling (ops/fp8.py; native on v6e+ only)
+    fp8: bool = False
     # data
     grad_accum: int = 1
     # sequence parallelism flavour: none | ulysses | ring
@@ -116,6 +118,25 @@ def _offload_opt(plan: AccelerationPlan, cfg: Dict) -> None:
     plan.offload_opt_state = cfg.get("enabled", True)
 
 
+def _fp8(plan: AccelerationPlan, cfg: Dict) -> None:
+    """fp8 GEMMs with delayed scaling (reference: atorch's
+    TransformerEngine fp8 autocast, amp_optimization.py:197; TPU impl
+    in ops/fp8.py). Hard-gated on native fp8 hardware unless the caller
+    forces it — on pre-fp8 chips (v5e) the quantization would cost
+    accuracy with zero speedup."""
+    if cfg.get("force"):
+        plan.fp8 = True
+        return
+    from dlrover_tpu.accelerate.device_context import fp8_supported
+
+    if not fp8_supported():
+        raise ValueError(
+            "fp8 strategy requires native fp8 hardware (TPU v6e+); "
+            "pass {'force': True} to apply anyway"
+        )
+    plan.fp8 = True
+
+
 def _grad_accum(plan: AccelerationPlan, cfg: Dict) -> None:
     plan.grad_accum = int(cfg.get("steps", 1))
 
@@ -150,6 +171,7 @@ OPTIMIZATION_LIBRARY: Dict[str, Callable[[AccelerationPlan, Dict], None]] = {
     "module_replace": _module_replace,
     "low_bit_optim": _low_bit_optim,
     "bf16_optim": _bf16_optim,
+    "fp8": _fp8,
     "offload_opt": _offload_opt,
     "grad_accum": _grad_accum,
     "optimizer": _optimizer,

@@ -19,7 +19,7 @@ import os
 import threading
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.resource_optimizer import (
@@ -77,6 +77,57 @@ class MetricsStore:
             return [r for r in self._rows if r.job_kind == job_kind]
 
 
+# ---- pluggable optimize algorithms ----------------------------------------
+#
+# Reference: go/brain/pkg/optimizer/implementation/optalgorithm/
+# optimize_algorithm.go — a name → algorithm registry; each algorithm
+# inspects the metrics store + live stats and contributes to the plan.
+# A stage runs a CHAIN of algorithms; later ones only fill fields the
+# earlier ones left unset (worker_num) or merge resource hints.
+
+OptimizeAlgorithm = Callable[["BrainService", Dict], ResourcePlan]
+_ALGORITHMS: Dict[str, OptimizeAlgorithm] = {}
+
+
+def register_algorithm(name: str):
+    def deco(fn):
+        _ALGORITHMS[name] = fn
+        return fn
+
+    return deco
+
+
+def get_algorithm(name: str) -> "OptimizeAlgorithm":
+    try:
+        return _ALGORITHMS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown brain algorithm {name!r}; registered: "
+            f"{sorted(_ALGORITHMS)}"
+        ) from None
+
+
+def _merge_plans(base: ResourcePlan, extra: ResourcePlan) -> ResourcePlan:
+    if base.worker_num is None:
+        base.worker_num = extra.worker_num
+    for role, res in extra.node_resources.items():
+        base.node_resources.setdefault(role, {}).update(res)
+    return base
+
+
+DEFAULT_STAGE_CHAINS = {
+    "create": [
+        "job_worker_create_resource",
+        "job_worker_create_oom_resource",
+    ],
+    "running": [
+        "job_worker_resource",
+        "job_ps_oom_resource",
+        "job_hot_ps_resource",
+    ],
+}
+
+
 class BrainService(ResourceOptimizer):
     """persist_metrics / optimize, cluster-memory backed."""
 
@@ -87,12 +138,14 @@ class BrainService(ResourceOptimizer):
         max_workers: int = 64,
         node_unit: int = 1,
         efficiency_floor: float = 0.7,
+        stage_chains: Optional[Dict[str, List[str]]] = None,
     ):
         self.store = store or MetricsStore()
         self.min_workers = min_workers
         self.max_workers = max_workers
         self.node_unit = max(1, node_unit)
         self.efficiency_floor = efficiency_floor
+        self.stage_chains = stage_chains or DEFAULT_STAGE_CHAINS
         self._job_name = ""
         self._job_kind = ""
 
@@ -111,9 +164,10 @@ class BrainService(ResourceOptimizer):
     # ---- brain.proto optimize (ResourceOptimizer interface) ---------------
 
     def generate_plan(self, stage: str, stats: Dict) -> ResourcePlan:
-        if stage == "create":
-            return self._first_allocation()
-        return self._adjust_running(stats)
+        plan = ResourcePlan()
+        for name in self.stage_chains.get(stage, []):
+            plan = _merge_plans(plan, get_algorithm(name)(self, stats))
+        return plan
 
     def _first_allocation(self) -> ResourcePlan:
         """Cold-start worker count from completed jobs of the same kind
@@ -195,3 +249,90 @@ class BrainService(ResourceOptimizer):
         while n < self.min_workers:
             n += self.node_unit
         return min(n, max(self.max_workers, self.min_workers))
+
+
+# ---- stock algorithms ------------------------------------------------------
+
+
+@register_algorithm("job_worker_create_resource")
+def _algo_worker_create(svc: BrainService, stats: Dict) -> ResourcePlan:
+    """First allocation from same-kind history
+    (optimize_job_worker_create_resource.go analog)."""
+    return svc._first_allocation()
+
+
+@register_algorithm("job_worker_create_oom_resource")
+def _algo_worker_create_oom(svc: BrainService, stats: Dict) -> ResourcePlan:
+    """Cold-start memory hint when this kind's history shows OOMs
+    (optimize_job_worker_create_oom_resource.go analog): start with
+    scaled host memory instead of rediscovering the OOM live."""
+    plan = ResourcePlan()
+    rows = svc.store.kind_rows(svc._job_kind)
+    ooms = sum(1 for r in rows if r.oom)
+    if rows and ooms and ooms >= max(1, len(rows) // 4):
+        plan.node_resources["worker"] = {"memory_scale": 1.5}
+        logger.info(
+            "brain create-oom hint for kind %r: %d/%d history rows OOMed",
+            svc._job_kind,
+            ooms,
+            len(rows),
+        )
+    return plan
+
+
+@register_algorithm("job_worker_resource")
+def _algo_worker_resource(svc: BrainService, stats: Dict) -> ResourcePlan:
+    """Running-job worker adjustment
+    (optimize_job_worker_resource.go analog)."""
+    return svc._adjust_running(stats)
+
+
+@register_algorithm("job_ps_oom_resource")
+def _algo_ps_oom(svc: BrainService, stats: Dict) -> ResourcePlan:
+    """Sparse-tier (the reference's PS role) memory pressure
+    (optimize_job_ps_oom_resource.go analog): when a KV shard host is
+    near its memory cap, add a PS node so the HRW partitioner spreads
+    the table wider — embedding tables grow with seen vocabulary, so
+    waiting for the OOM loses the table."""
+    plan = ResourcePlan()
+    used = stats.get("ps_mem_used_bytes")
+    cap = stats.get("ps_mem_cap_bytes")
+    ps_num = int(stats.get("ps_num", 0))
+    if used and cap and ps_num and used / cap > 0.85:
+        plan.node_resources["ps"] = {"num": ps_num + 1}
+        logger.info(
+            "brain ps-oom: %.0f%% of sparse-tier memory used → %d ps",
+            100 * used / cap,
+            ps_num + 1,
+        )
+    return plan
+
+
+@register_algorithm("job_hot_ps_resource")
+def _algo_hot_ps(svc: BrainService, stats: Dict) -> ResourcePlan:
+    """Hot-shard rebalance (optimize_job_hot_ps_resource.go analog):
+    when one sparse shard takes a disproportionate share of lookup
+    traffic, emit per-shard HRW weights that shift keys off it (the
+    elastic PS tier consumes them as bounded-migration weight updates)."""
+    plan = ResourcePlan()
+    qps: Dict[str, float] = stats.get("ps_shard_qps") or {}
+    if len(qps) < 2:
+        return plan
+    total = sum(qps.values())
+    if total <= 0:
+        return plan
+    mean = total / len(qps)
+    hot = {s: q for s, q in qps.items() if q > 2.0 * mean}
+    if not hot:
+        return plan
+    # weight inversely to load, normalized to mean 1.0
+    weights = {s: mean / max(q, 1e-9) for s, q in qps.items()}
+    norm = sum(weights.values()) / len(weights)
+    plan.node_resources["ps"] = {
+        "weights": {s: w / norm for s, w in weights.items()}
+    }
+    logger.info(
+        "brain hot-ps: shards %s over 2x mean qps → rebalance weights",
+        sorted(hot),
+    )
+    return plan

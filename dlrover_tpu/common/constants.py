@@ -13,6 +13,8 @@ class NodeType:
     MASTER = "master"
     WORKER = "worker"          # a TPU host driving its local chips
     COWORKER = "coworker"      # CPU-only data preprocessing host
+    CHIEF = "chief"            # rank-0 coordination anchor (TF lineage)
+    EVALUATOR = "evaluator"    # side-car eval host, outside the train mesh
 
 
 class NodeStatus:

@@ -33,11 +33,14 @@ class JobAutoScaler:
         min_workers: int = 1,
         max_workers: int = 1,
         node_unit: int = 1,
+        ps_service=None,
     ):
         self.job_manager = job_manager
         self.speed_monitor = speed_monitor
         self.scaler = scaler
         self.rdzv_managers = rdzv_managers or {}
+        # sparse-tier consumer for Brain ps hints (hot-shard weights)
+        self.ps_service = ps_service
         self.optimizer = optimizer or LocalHeuristicOptimizer(
             min_workers=min_workers,
             max_workers=max_workers,
@@ -91,6 +94,14 @@ class JobAutoScaler:
 
     def execute_plan(self, plan):
         import time
+
+        # sparse-tier hints execute regardless of the worker target:
+        # a hot-shard rebalance (Brain job_hot_ps_resource) installs HRW
+        # weights and bumps the sparse cluster version so workers
+        # re-partition with bounded migration
+        ps_hints = plan.node_resources.get("ps", {})
+        if self.ps_service is not None and "weights" in ps_hints:
+            self.ps_service.set_weights(ps_hints["weights"])
 
         target = plan.worker_num
         if target is None:

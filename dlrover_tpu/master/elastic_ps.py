@@ -14,7 +14,7 @@ only keys owned by the changed hosts migrate).
 """
 
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.log import get_logger
 
@@ -29,6 +29,9 @@ class ElasticPsService:
         # versions so late joiners can detect they are behind)
         self._node_versions: Dict[int, int] = {}
         self._servers: List[str] = []
+        # per-server HRW weights (Brain hot-shard rebalance); workers
+        # pass them to sparse.partition.assign_servers
+        self._weights: Dict[str, float] = {}
 
     # ---- versions (reference API surface) --------------------------------
 
@@ -69,6 +72,30 @@ class ElasticPsService:
             logger.info(
                 "sparse server set changed (%d hosts) → version %d",
                 len(servers),
+                self._global_version,
+            )
+            return self._global_version
+
+    # ---- HRW weights (Brain hot-shard rebalance consumer) ----------------
+
+    def get_weights(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._weights)
+
+    def set_weights(self, weights: Optional[Dict[str, float]]) -> int:
+        """Install rebalance weights from a Brain plan
+        (node_resources['ps']['weights']); bumps the version so workers
+        re-partition (sparse.partition.assign_servers consumes them —
+        changing one server's weight only migrates that server's keys)."""
+        weights = dict(weights or {})
+        with self._lock:
+            if weights == self._weights:
+                return self._global_version
+            self._weights = weights
+            self._global_version += 1
+            logger.info(
+                "sparse HRW weights updated (%d entries) → version %d",
+                len(weights),
                 self._global_version,
             )
             return self._global_version

@@ -115,19 +115,44 @@ class JobMaster:
                 max_workers=max_w,
                 node_unit=node_unit,
                 interval_s=ctx.autoscale_interval_s,
+                ps_service=self.ps_service,
             )
         self._stop = threading.Event()
         self._last_hang_kick = 0.0
         self.exit_reason = ""
 
-        # wire elastic event callbacks: a dead node's shards re-queue and
-        # its rendezvous membership drops (reference: event_callback.py:42)
+        # wire elastic event callbacks through the pluggable registry
+        # (reference: event_callback.py:42): shard reschedule + rdzv
+        # prune from the stock observers, master-local accounting from a
+        # private one. Users can append their own NodeEventCallback.
+        from dlrover_tpu.master.event_callback import (
+            ClusterContext,
+            default_callbacks,
+        )
+
+        self.job_manager.cluster_context = ClusterContext(
+            self.job_manager,
+            task_manager=self.task_manager,
+            rdzv_managers=self.rdzv_managers,
+            speed_monitor=self.speed_monitor,
+        )
+        self.job_manager.event_callbacks.extend(
+            default_callbacks(
+                task_manager=self.task_manager,
+                rdzv_managers=self.rdzv_managers,
+                on_job_failed=self._fail_job,
+            )
+        )
         self.job_manager.node_failed_callbacks.append(self._on_node_down)
 
+    def _fail_job(self, reason: str):
+        self.exit_reason = JobExitReason.RELAUNCH_BUDGET_EXHAUSTED
+        logger.error("job failed: %s", reason)
+        self._stop.set()
+
     def _on_node_down(self, node):
-        self.task_manager.recover_worker_tasks(node.id)
-        for mgr in self.rdzv_managers.values():
-            mgr.remove_alive_node(node.rank_index)
+        # master-local accounting (shard requeue + rdzv prune live in
+        # the registry callbacks above)
         self.speed_monitor.reset_running_speed()
         self.metric_collector.inc("node_failures_total")
         # goodput: lost time runs from here until a step report ADVANCES
@@ -169,9 +194,14 @@ class JobMaster:
                     # Drain: workers still run their final step, persist
                     # checkpoints, and report status after the last shard is
                     # done — keep serving RPCs until they exit (bounded).
+                    # Evaluators gate the drain too (reference:
+                    # EvaluatorManager wait-then-finish).
                     self._wait_workers_drain(ctx.worker_drain_timeout_s)
                     break
-                if self.job_manager.all_workers_exited():
+                if (
+                    self.job_manager.all_workers_exited()
+                    and self.job_manager.all_evaluators_exited()
+                ):
                     if self.job_manager.all_workers_succeeded():
                         self.exit_reason = JobExitReason.SUCCEEDED
                     else:
@@ -214,7 +244,10 @@ class JobMaster:
     def _wait_workers_drain(self, timeout_s: float):
         deadline = time.time() + timeout_s
         while time.time() < deadline and not self._stop.is_set():
-            if self.job_manager.all_workers_exited():
+            if (
+                self.job_manager.all_workers_exited()
+                and self.job_manager.all_evaluators_exited()
+            ):
                 return
             time.sleep(1.0)
 

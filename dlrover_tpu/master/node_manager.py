@@ -9,7 +9,7 @@ the pod-slice scaler (``master/scaler.py``).
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from dlrover_tpu.common.constants import (
     DefaultValues,
@@ -97,9 +97,13 @@ class JobManager:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._start_time = time.time()
-        # callbacks: fn(node) fired on terminal transitions
+        # legacy fn(node) hooks fired on terminal transitions
         self.node_failed_callbacks: List[Callable[[Node], None]] = []
         self.node_succeeded_callbacks: List[Callable[[Node], None]] = []
+        # pluggable observer registry (reference: event_callback.py:42);
+        # populate with master.event_callback.NodeEventCallback objects
+        self.event_callbacks: List[Any] = []
+        self.cluster_context: Any = None  # set by the master (ClusterContext)
         self._init_nodes()
 
     def _init_nodes(self):
@@ -147,8 +151,14 @@ class JobManager:
             node.topology.slice_index = meta.slice_index
             node.heartbeat_time = time.time()
             self._apply_status(node, NodeStatus.RUNNING)
+            started = node.status == NodeStatus.RUNNING
             logger.info("registered %s from %s", node, meta.host_addr)
-            return node
+        # outside the lock: observers may call back into query methods.
+        # Fire only if the transition actually happened — a straggler
+        # re-registering a terminally-failed node must not look alive.
+        if started:
+            self._fire("on_node_started", node)
+        return node
 
     def handle_heartbeat(self, node_id: int) -> List[str]:
         with self._lock:
@@ -195,15 +205,35 @@ class JobManager:
             self._apply_status(node, status)
 
         if status in (NodeStatus.FAILED, NodeStatus.DELETED):
+            self._fire(
+                "on_node_deleted"
+                if status == NodeStatus.DELETED
+                else "on_node_failed",
+                node,
+            )
             self._on_node_down(node)
         elif status == NodeStatus.SUCCEEDED:
+            self._fire("on_node_succeeded", node)
             for cb in self.node_succeeded_callbacks:
                 cb(node)
+        elif status == NodeStatus.RUNNING:
+            self._fire("on_node_started", node)
 
     def _apply_status(self, node: Node, status: str):
         flow = transition(node.status, status)
         if flow.allowed:
             node.update_status(status)
+
+    def _fire(self, hook: str, node: Node):
+        """Dispatch one lifecycle hook to every registered observer; an
+        observer exception never breaks node bookkeeping."""
+        for cb in self.event_callbacks:
+            try:
+                getattr(cb, hook)(node, self.cluster_context)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "event callback %s.%s failed", type(cb).__name__, hook
+                )
 
     def _on_node_down(self, node: Node):
         if node.is_released:
@@ -271,14 +301,41 @@ class JobManager:
                 if n.status == NodeStatus.RUNNING
             ]
 
+    def nodes_of_type(self, node_type: str) -> List[Node]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.type == node_type]
+
     def all_workers_exited(self) -> bool:
         with self._lock:
-            return all(n.is_exited() for n in self._nodes.values())
+            return all(
+                n.is_exited()
+                for n in self._nodes.values()
+                if n.type in (NodeType.WORKER, NodeType.CHIEF)
+            )
 
     def all_workers_succeeded(self) -> bool:
         with self._lock:
             return all(
-                n.status == NodeStatus.SUCCEEDED for n in self._nodes.values()
+                n.status == NodeStatus.SUCCEEDED
+                for n in self._nodes.values()
+                if n.type in (NodeType.WORKER, NodeType.CHIEF)
+            )
+
+    def all_evaluators_exited(self) -> bool:
+        """Evaluators run outside the train mesh; job completion waits
+        for them (reference: EvaluatorManager wait-then-finish)."""
+        with self._lock:
+            return all(
+                n.is_exited()
+                for n in self._nodes.values()
+                if n.type == NodeType.EVALUATOR
+            )
+
+    def is_chief_running(self) -> bool:
+        with self._lock:
+            return any(
+                n.type == NodeType.CHIEF and n.status == NodeStatus.RUNNING
+                for n in self._nodes.values()
             )
 
     def any_node_failed_fatally(self) -> bool:

@@ -1,0 +1,144 @@
+"""fp8 matmul with delayed scaling (TransformerEngine recipe, TPU-native).
+
+Reference capability: atorch's fp8 path
+(auto/opt_lib/amp_optimization.py:197 — TransformerEngine fp8 autocast
+with a DelayedScaling recipe). Here the same numerics are expressed
+functionally: forward operands quantize to e4m3, gradients to e5m2,
+each with a per-tensor scale derived from a rolling amax history
+(delayed scaling — the scale for step t comes from steps < t, so
+quantization never serializes on the current tensor's max).
+
+State threading uses the Flax fp8-einsum convention: the fp8 state is a
+differentiable INPUT whose "cotangent" carries the UPDATED state out of
+the backward pass (the only place the gradient's amax is observable) —
+
+    out = fp8_dot(x, w, state)
+    grads = jax.grad(loss, argnums=(0, 1, 2))(x, w, state)
+    new_state = grads[2]          # updated amax histories, not a grad
+
+On fp8 hardware (Trillium/v6e+, see accelerate.device_context) the
+quantized operands feed the MXU directly; elsewhere the dot upcasts the
+ALREADY-QUANTIZED values to bf16, so numerics are identical everywhere
+and speed follows hardware support. Strategy hook: the "fp8" entry in
+accelerate.strategy gates on ``device_context.fp8_supported()``.
+"""
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+AMAX_HISTORY = 16
+
+
+def init_fp8_state() -> Dict[str, jax.Array]:
+    """Per-GEMM delayed-scaling state: amax histories for the forward
+    operands (e4m3) and the incoming gradient (e5m2)."""
+    return {
+        "amax_x": jnp.ones((AMAX_HISTORY,), jnp.float32),
+        "amax_w": jnp.ones((AMAX_HISTORY,), jnp.float32),
+        "amax_g": jnp.ones((AMAX_HISTORY,), jnp.float32),
+    }
+
+
+def _scale_from_history(hist: jax.Array, fmax: float) -> jax.Array:
+    """Delayed scale: map the history's max amax onto the format max."""
+    amax = jnp.maximum(jnp.max(hist), 1e-12)
+    return amax / fmax
+
+
+def _push_amax(hist: jax.Array, x: jax.Array) -> jax.Array:
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)[None]
+    return jnp.concatenate([hist[1:], cur])
+
+
+def quantize_fp8(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    fmax = E4M3_MAX if dtype == E4M3 else E5M2_MAX
+    return jnp.clip(
+        x.astype(jnp.float32) / scale, -fmax, fmax
+    ).astype(dtype)
+
+
+def _dot(a_q, b_q, native: bool):
+    if not native:
+        # pre-fp8 hardware: same quantized VALUES, bf16 MXU path
+        a_q = a_q.astype(jnp.bfloat16)
+        b_q = b_q.astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        a_q, b_q, (((a_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _resolve_native(native):
+    if native is not None:
+        return bool(native)
+    from dlrover_tpu.accelerate.device_context import fp8_supported
+
+    return fp8_supported()
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fp8_dot(x, w, state, native=None):
+    """``x @ w`` with fp8 operands and delayed scaling.
+
+    x: [..., K], w: [K, N], ``state`` from ``init_fp8_state``. Returns
+    out [..., N] in x.dtype. Differentiating w.r.t. ``state`` yields the
+    UPDATED state (see module docstring), never a real gradient.
+    ``native=None`` probes the hardware (device_context.fp8_supported):
+    fp8 operands feed the MXU directly on v6e+, bf16-upcast of the same
+    quantized values elsewhere."""
+    out, _ = _fp8_fwd_impl(x, w, state, _resolve_native(native))
+    return out
+
+
+def _fp8_fwd_impl(x, w, state, native):
+    sx = _scale_from_history(state["amax_x"], E4M3_MAX)
+    sw = _scale_from_history(state["amax_w"], E4M3_MAX)
+    qx = quantize_fp8(x, sx, E4M3)
+    qw = quantize_fp8(w, sw, E4M3)
+    out = (_dot(qx, qw, native) * (sx * sw)).astype(x.dtype)
+    return out, (qx, qw, sx, sw)
+
+
+def _fp8_fwd(x, w, state, native):
+    native = _resolve_native(native)
+    out, (qx, qw, sx, sw) = _fp8_fwd_impl(x, w, state, native)
+    res = (
+        qx,
+        qw,
+        sx,
+        sw,
+        state,
+        _push_amax(state["amax_x"], x),
+        _push_amax(state["amax_w"], w),
+        jnp.zeros((0,), x.dtype),  # dtype carriers (residuals must be
+        jnp.zeros((0,), w.dtype),  # jax types, not raw dtypes)
+    )
+    return out, res
+
+
+def _fp8_bwd(native, res, g):
+    native = _resolve_native(native)
+    qx, qw, sx, sw, state, hist_x, hist_w, xdt0, wdt0 = res
+    xdt, wdt = xdt0.dtype, wdt0.dtype
+    sg = _scale_from_history(state["amax_g"], E5M2_MAX)
+    qg = quantize_fp8(g, sg, E5M2)
+    dx = (_dot(qg, qw.T, native) * (sg * sw)).astype(xdt)
+    x2d = qx.reshape(-1, qx.shape[-1])
+    g2d = qg.reshape(-1, qg.shape[-1])
+    dw = (_dot(x2d.T, g2d, native) * (sx * sg)).astype(wdt)
+    new_state = {
+        "amax_x": hist_x,
+        "amax_w": hist_w,
+        "amax_g": _push_amax(state["amax_g"], g),
+    }
+    return dx, dw, new_state
+
+
+fp8_dot.defvjp(_fp8_fwd, _fp8_bwd)

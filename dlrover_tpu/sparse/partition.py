@@ -8,7 +8,7 @@ added/removed servers (~K/n keys instead of a full reshuffle) — the
 elastic property the modulo hash lacks.
 """
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,9 +38,19 @@ def _server_seed(server: str) -> np.uint64:
 
 
 def assign_servers(
-    keys: Sequence[int], servers: List[str]
+    keys: Sequence[int],
+    servers: List[str],
+    weights: Optional[Dict[str, float]] = None,
 ) -> np.ndarray:
-    """HRW: each key goes to the server with max mix(key ^ seed(server)).
+    """HRW: each key goes to the server with the max rendezvous score.
+
+    Unweighted (default): max mix(key ^ seed(server)) — cheap integer
+    argmax. With ``weights`` ({server: w>0}): weighted rendezvous
+    hashing, score = −w / ln(u) with u = mix normalized into (0,1) —
+    the Brain's hot-shard rebalance emits these weights, and changing
+    one server's weight only moves keys to/from THAT server (the same
+    bounded-migration property membership changes have). Missing
+    servers default to weight 1.0.
 
     Returns the server INDEX per key (into ``servers``).
     """
@@ -50,15 +60,25 @@ def assign_servers(
     scores = np.stack(
         [_mix(k ^ _server_seed(s)) for s in servers]
     )  # [n_servers, n_keys]
-    return np.argmax(scores, axis=0)
+    if weights is None:
+        return np.argmax(scores, axis=0)
+    w = np.array(
+        [max(float(weights.get(s, 1.0)), 1e-9) for s in servers]
+    )
+    # normalize the 64-bit mix into open (0,1); clamp off the endpoints
+    u = (scores.astype(np.float64) + 0.5) / 2.0**64
+    u = np.clip(u, 1e-12, 1.0 - 1e-12)
+    return np.argmax(-w[:, None] / np.log(u), axis=0)
 
 
 def partition_keys(
-    keys: Sequence[int], servers: List[str]
+    keys: Sequence[int],
+    servers: List[str],
+    weights: Optional[Dict[str, float]] = None,
 ) -> Dict[str, np.ndarray]:
     """{server: its keys} — the shape lookups/updates fan out with."""
     k = np.asarray(keys, dtype=np.int64)
-    owner = assign_servers(k, servers)
+    owner = assign_servers(k, servers, weights)
     return {s: k[owner == i] for i, s in enumerate(servers)}
 
 
@@ -66,15 +86,22 @@ def migration_plan(
     keys: Sequence[int],
     old_servers: List[str],
     new_servers: List[str],
+    old_weights: Optional[Dict[str, float]] = None,
+    new_weights: Optional[Dict[str, float]] = None,
 ) -> List[Tuple[int, str, str]]:
     """Keys whose owner changes, as (key, from_server, to_server).
 
-    With HRW, only keys owned by removed servers (or won by added ones)
-    appear here — the bounded-migration property.
+    With HRW, only keys owned by removed servers (or won by added ones —
+    or shifted by a weight change) appear here — the bounded-migration
+    property.
     """
     k = np.asarray(keys, dtype=np.int64)
-    old_names = np.asarray(old_servers)[assign_servers(k, old_servers)]
-    new_names = np.asarray(new_servers)[assign_servers(k, new_servers)]
+    old_names = np.asarray(old_servers)[
+        assign_servers(k, old_servers, old_weights)
+    ]
+    new_names = np.asarray(new_servers)[
+        assign_servers(k, new_servers, new_weights)
+    ]
     moved = np.nonzero(old_names != new_names)[0]
     return [
         (int(k[i]), str(old_names[i]), str(new_names[i])) for i in moved

@@ -4,6 +4,7 @@ Reference behaviors: go/operator ElasticJob/ScalePlan CRDs, PodScaler,
 go/brain optimize algorithms.
 """
 
+import pytest
 import yaml
 
 from dlrover_tpu.cluster import (
@@ -128,6 +129,102 @@ def test_scale_plan_crd_render():
     assert m["kind"] == "ScalePlan"
     assert m["spec"]["replicaCounts"]["worker"] == 8  # snapped
     assert m["spec"]["ownerJob"] == "gpt-train"
+
+
+def test_brain_algorithm_registry_and_chains():
+    """optalgorithm analog: named algorithms, per-stage chains, plan
+    merging (later algorithms fill unset fields / add hints)."""
+    from dlrover_tpu.cluster.brain import (
+        get_algorithm,
+        register_algorithm,
+    )
+    from dlrover_tpu.master.resource_optimizer import ResourcePlan
+
+    with pytest.raises(ValueError, match="unknown brain algorithm"):
+        get_algorithm("nope")
+
+    @register_algorithm("test_fixed_three")
+    def fixed(svc, stats):
+        p = ResourcePlan()
+        p.worker_num = 3
+        return p
+
+    brain = BrainService(
+        stage_chains={"running": ["test_fixed_three", "job_ps_oom_resource"]}
+    )
+    plan = brain.generate_plan(
+        "running",
+        {
+            "ps_mem_used_bytes": 9.0e9,
+            "ps_mem_cap_bytes": 10.0e9,
+            "ps_num": 2,
+        },
+    )
+    # both algorithms contributed: count from the first, ps hint merged
+    assert plan.worker_num == 3
+    assert plan.node_resources["ps"]["num"] == 3
+
+
+def test_brain_create_oom_memory_hint():
+    store = MetricsStore()
+    for i in range(4):
+        store.append(
+            JobMetrics(
+                job_name=f"j{i}",
+                job_kind="dlrm",
+                worker_num=4,
+                samples_per_sec=100.0,
+                finished=True,
+                oom=(i < 2),  # half the history OOMed
+            )
+        )
+    brain = BrainService(store)
+    brain.bind_job("new", "dlrm")
+    plan = brain.generate_plan("create", {})
+    assert plan.node_resources["worker"]["memory_scale"] == 1.5
+
+
+def test_brain_hot_ps_rebalance_weights():
+    brain = BrainService()
+    brain.bind_job("j", "dlrm")
+    plan = brain.generate_plan(
+        "running",
+        {"ps_shard_qps": {"ps0": 1000.0, "ps1": 100.0, "ps2": 100.0}},
+    )
+    w = plan.node_resources["ps"]["weights"]
+    # the hot shard gets the smallest weight
+    assert w["ps0"] < w["ps1"] and w["ps0"] < w["ps2"]
+    # balanced traffic → no rebalance plan
+    plan2 = brain.generate_plan(
+        "running", {"ps_shard_qps": {"ps0": 100.0, "ps1": 110.0}}
+    )
+    assert "ps" not in plan2.node_resources
+
+
+def test_weighted_hrw_shifts_load_boundedly():
+    """Weighted rendezvous hashing: lowering one server's weight only
+    moves keys OFF that server (bounded migration), and the moved
+    fraction tracks the weight change."""
+    from dlrover_tpu.sparse.partition import (
+        assign_servers,
+        migration_plan,
+        partition_keys,
+    )
+
+    keys = list(range(30000))
+    servers = ["ps0", "ps1", "ps2"]
+    eq = {s: 1.0 for s in servers}
+    base = partition_keys(keys, servers, eq)
+    sizes = {s: len(v) for s, v in base.items()}
+    # roughly balanced at equal weights
+    assert max(sizes.values()) < 1.3 * min(sizes.values())
+
+    cooled = dict(eq, ps0=0.5)
+    moved = migration_plan(keys, servers, servers, eq, cooled)
+    # every move originates from the cooled server
+    assert moved and all(src == "ps0" for _, src, _ in moved)
+    after = partition_keys(keys, servers, cooled)
+    assert len(after["ps0"]) < 0.7 * sizes["ps0"]
 
 
 def test_brain_first_allocation_from_history(tmp_path):

@@ -33,6 +33,127 @@ def _client(master, node_id):
     return c
 
 
+def test_event_callback_registry_fires_hooks(master):
+    """Pluggable NodeEventCallback observers (event_callback.py:42
+    analog) see started/failed/succeeded with the cluster context, and
+    an observer exception never breaks lifecycle handling."""
+    from dlrover_tpu.master.event_callback import NodeEventCallback
+
+    seen = []
+
+    class Recorder(NodeEventCallback):
+        def on_node_started(self, node, ctx):
+            seen.append(("started", node.id, ctx is not None))
+
+        def on_node_failed(self, node, ctx):
+            seen.append(("failed", node.id, ctx.task_manager is not None))
+
+    class Broken(NodeEventCallback):
+        def on_node_started(self, node, ctx):
+            raise RuntimeError("observer bug")
+
+    master.job_manager.event_callbacks.extend([Recorder(), Broken()])
+    c0 = _client(master, 0)
+    c0.report_node_status(NodeStatus.FAILED, exit_reason="fatal_error")
+    assert ("started", 0, True) in seen
+    assert ("failed", 0, True) in seen
+    # Broken raised on started, yet the node still registered + failed
+    assert master.job_manager.get_node(0).status == NodeStatus.FAILED
+
+
+def test_task_reschedule_callback_requeues_shards(master):
+    """A dead node's in-flight shard goes back to the queue through the
+    registry's TaskRescheduleCallback (no inline master plumbing)."""
+    master.task_manager.new_dataset(
+        "ds", dataset_size=8, shard_size=4
+    )
+    c0, c1 = _client(master, 0), _client(master, 1)
+    t0 = c0.get_task("ds")
+    assert t0.task_id >= 0
+    c0.report_node_status(NodeStatus.FAILED, exit_reason="killed")
+    # the shard node 0 held is available again (for node 1)
+    t1 = c1.get_task("ds")
+    t2 = c1.get_task("ds")
+    got = {t1.shard_start, t2.shard_start}
+    assert t0.shard_start in got
+
+
+def test_chief_and_evaluator_roles(master):
+    """Role-aware accounting: workers succeeding does not complete the
+    job while an evaluator still runs; chief visibility is queryable."""
+    from dlrover_tpu.common.constants import NodeType
+
+    c0, c1 = _client(master, 0), _client(master, 1)
+    ev = MasterClient(master.addr, node_id=7)
+    ev.register_node(node_type=NodeType.EVALUATOR)
+    chief = MasterClient(master.addr, node_id=8)
+    chief.register_node(node_type=NodeType.CHIEF)
+
+    jm = master.job_manager
+    assert jm.is_chief_running()
+    assert len(jm.nodes_of_type(NodeType.EVALUATOR)) == 1
+    c0.report_node_status(NodeStatus.SUCCEEDED)
+    c1.report_node_status(NodeStatus.SUCCEEDED)
+    chief.report_node_status(NodeStatus.SUCCEEDED)
+    assert jm.all_workers_succeeded()
+    assert not jm.all_evaluators_exited()  # evaluator still running
+    ev.report_node_status(NodeStatus.SUCCEEDED)
+    assert jm.all_evaluators_exited()
+
+
+def test_chief_exhaustion_fails_job_and_evaluator_gates_exit(master):
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.master.event_callback import ChiefFailureCallback
+
+    failures = []
+    master.job_manager.event_callbacks.append(
+        ChiefFailureCallback(failures.append)
+    )
+    chief = MasterClient(master.addr, node_id=9)
+    chief.register_node(node_type=NodeType.CHIEF)
+    # non-relaunchable exit → the job-failed hook fires (DELETED path
+    # covered by the alias)
+    chief.report_node_status(NodeStatus.FAILED, exit_reason="fatal_error")
+    assert failures and "chief" in failures[0]
+
+    # evaluator gating: workers done but evaluator alive → master's exit
+    # condition must hold off
+    ev = MasterClient(master.addr, node_id=7)
+    ev.register_node(node_type=NodeType.EVALUATOR)
+    c0, c1 = _client(master, 0), _client(master, 1)
+    c0.report_node_status(NodeStatus.SUCCEEDED)
+    c1.report_node_status(NodeStatus.SUCCEEDED)
+    jm = master.job_manager
+    assert jm.all_workers_succeeded() is False  # chief FAILED counts
+    assert not jm.all_evaluators_exited()
+    ev.report_node_status(NodeStatus.SUCCEEDED)
+    assert jm.all_evaluators_exited()
+
+
+def test_brain_ps_weights_flow_to_sparse_tier(master):
+    """Brain hot-shard plan → auto-scaler → ElasticPsService weights +
+    version bump (the rebalance consumer path)."""
+    from dlrover_tpu.master.auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.node_manager import NoopScaler
+    from dlrover_tpu.master.resource_optimizer import ResourcePlan
+
+    scaler = JobAutoScaler(
+        master.job_manager,
+        master.speed_monitor,
+        NoopScaler(),
+        ps_service=master.ps_service,
+    )
+    v0 = master.ps_service.get_global_version()
+    plan = ResourcePlan()
+    plan.node_resources["ps"] = {"weights": {"ps0": 0.5, "ps1": 1.0}}
+    scaler.execute_plan(plan)
+    assert master.ps_service.get_weights() == {"ps0": 0.5, "ps1": 1.0}
+    assert master.ps_service.get_global_version() == v0 + 1
+    # idempotent: same weights do not churn the version
+    scaler.execute_plan(plan)
+    assert master.ps_service.get_global_version() == v0 + 1
+
+
 def test_register_and_heartbeat(master):
     c = _client(master, 0)
     assert c.node_rank == 0

@@ -456,3 +456,98 @@ def test_chunked_backward_with_lse_cotangent():
     np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# fp8 delayed-scaling GEMM (ops/fp8.py)
+# ---------------------------------------------------------------------------
+
+
+def test_fp8_dot_close_to_exact():
+    from dlrover_tpu.ops import fp8
+
+    x = jax.random.normal(jax.random.key(0), (64, 128)) * 2.0
+    w = jax.random.normal(jax.random.key(1), (128, 32)) * 0.5
+    state = fp8.init_fp8_state()
+    # warm the amax histories so the delayed scales match the data
+    for _ in range(2):
+        g = jax.grad(
+            lambda x, w, s: jnp.sum(fp8.fp8_dot(x, w, s) ** 2),
+            argnums=(0, 1, 2),
+        )(x, w, state)
+        state = g[2]
+    out = fp8.fp8_dot(x, w, state)
+    exact = x @ w
+    # e4m3 has ~2 decimal digits; relative error stays in the few-% band
+    rel = float(
+        jnp.linalg.norm(out.astype(jnp.float32) - exact)
+        / jnp.linalg.norm(exact)
+    )
+    assert rel < 0.05, rel
+
+
+def test_fp8_state_rides_the_cotangent():
+    from dlrover_tpu.ops import fp8
+
+    x = jax.random.normal(jax.random.key(0), (16, 64)) * 3.0
+    w = jax.random.normal(jax.random.key(1), (64, 16))
+    state = fp8.init_fp8_state()
+    dx, dw, new_state = jax.grad(
+        lambda x, w, s: jnp.sum(fp8.fp8_dot(x, w, s)), argnums=(0, 1, 2)
+    )(x, w, state)
+    # the "state gradient" is the UPDATED state: histories rolled with
+    # the observed amaxes, not derivatives
+    assert float(new_state["amax_x"][-1]) == pytest.approx(
+        float(jnp.max(jnp.abs(x))), rel=1e-6
+    )
+    assert float(new_state["amax_w"][-1]) == pytest.approx(
+        float(jnp.max(jnp.abs(w))), rel=1e-6
+    )
+    assert float(new_state["amax_g"][-1]) == pytest.approx(1.0)  # dL/dy = 1
+    # gradients exist and have the right shapes/dtypes
+    assert dx.shape == x.shape and dw.shape == w.shape
+    assert jnp.isfinite(dx).all() and jnp.isfinite(dw).all()
+
+
+def test_fp8_gradients_approximate_exact():
+    from dlrover_tpu.ops import fp8
+
+    x = jax.random.normal(jax.random.key(2), (32, 64))
+    w = jax.random.normal(jax.random.key(3), (64, 48))
+    state = fp8.init_fp8_state()
+    for _ in range(2):
+        g = jax.grad(
+            lambda x, w, s: jnp.sum(fp8.fp8_dot(x, w, s) ** 2),
+            argnums=(0, 1, 2),
+        )(x, w, state)
+        state = g[2]
+    dx8, dw8, _ = jax.grad(
+        lambda x, w, s: jnp.sum(fp8.fp8_dot(x, w, s) ** 2),
+        argnums=(0, 1, 2),
+    )(x, w, state)
+    dx, dw = jax.grad(
+        lambda x, w: jnp.sum((x @ w) ** 2), argnums=(0, 1)
+    )(x, w)
+    for a, b in ((dx8, dx), (dw8, dw)):
+        rel = float(
+            jnp.linalg.norm(a.astype(jnp.float32) - b)
+            / jnp.linalg.norm(b)
+        )
+        # e5m2 gradient quantization: coarser than e4m3
+        assert rel < 0.15, rel
+
+
+def test_fp8_strategy_gated_on_hardware():
+    from dlrover_tpu.accelerate.device_context import (
+        detect_device_context,
+        fp8_supported,
+    )
+    from dlrover_tpu.accelerate.strategy import apply_strategy
+
+    ctx = detect_device_context()
+    assert ctx.n_devices >= 1
+    assert not fp8_supported()  # CPU test platform has no native fp8
+    with pytest.raises(ValueError, match="fp8"):
+        apply_strategy([("fp8", {})])
+    plan = apply_strategy([("fp8", {"force": True})])
+    assert plan.fp8
