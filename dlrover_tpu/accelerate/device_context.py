@@ -30,14 +30,8 @@ _PEAK_BF16_TFLOPS = {
 # device kinds with native fp8 MXU support (Trillium on)
 _FP8_KINDS = ("v6 lite", "v6e", "v7")
 
-_HBM_GB = {
-    "v4": 32.0,
-    "v5 lite": 16.0,
-    "v5e": 16.0,
-    "v5p": 95.0,
-    "v6 lite": 32.0,
-    "v6e": 32.0,
-}
+# HBM sizing delegates to analyser.device_hbm_bytes() — one table (plus
+# its runtime memory_stats probe), not two to keep in sync
 
 
 @dataclass(frozen=True)
@@ -69,12 +63,14 @@ def detect_device_context() -> DeviceContext:
         n = len(devices)
     except Exception:  # noqa: BLE001
         return DeviceContext("cpu", "cpu", 0, 16e9, 0.1, False, False)
+    from dlrover_tpu.accelerate.analyser import device_hbm_bytes
+
     on_tpu = platform == "tpu" or "tpu" in kind.lower()
     ctx = DeviceContext(
         platform=platform,
         device_kind=kind,
         n_devices=n,
-        hbm_bytes=_lookup(kind, _HBM_GB, 16.0) * 1e9 if on_tpu else 16e9,
+        hbm_bytes=device_hbm_bytes(),
         peak_bf16_tflops=_lookup(kind, _PEAK_BF16_TFLOPS, 197.0)
         if on_tpu
         else 0.1,

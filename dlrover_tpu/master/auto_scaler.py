@@ -34,13 +34,17 @@ class JobAutoScaler:
         max_workers: int = 1,
         node_unit: int = 1,
         ps_service=None,
+        ps_scale_fn=None,
     ):
         self.job_manager = job_manager
         self.speed_monitor = speed_monitor
         self.scaler = scaler
         self.rdzv_managers = rdzv_managers or {}
-        # sparse-tier consumer for Brain ps hints (hot-shard weights)
+        # sparse-tier consumers for Brain ps hints: weight rebalance goes
+        # to the version service; count changes go to the platform hook
+        # (fn(target_num) — the sparse tier's analog of SliceScaler)
         self.ps_service = ps_service
+        self.ps_scale_fn = ps_scale_fn
         self.optimizer = optimizer or LocalHeuristicOptimizer(
             min_workers=min_workers,
             max_workers=max_workers,
@@ -102,6 +106,15 @@ class JobAutoScaler:
         ps_hints = plan.node_resources.get("ps", {})
         if self.ps_service is not None and "weights" in ps_hints:
             self.ps_service.set_weights(ps_hints["weights"])
+        if "num" in ps_hints:
+            if self.ps_scale_fn is not None:
+                self.ps_scale_fn(int(ps_hints["num"]))
+            else:
+                logger.warning(
+                    "plan requests %d sparse hosts but no ps_scale_fn "
+                    "is bound — sparse tier not scaled",
+                    ps_hints["num"],
+                )
 
         target = plan.worker_num
         if target is None:

@@ -150,12 +150,17 @@ class JobManager:
             node.topology.slice_id = meta.slice_id
             node.topology.slice_index = meta.slice_index
             node.heartbeat_time = time.time()
+            prev_status = node.status
             self._apply_status(node, NodeStatus.RUNNING)
-            started = node.status == NodeStatus.RUNNING
+            started = (
+                node.status == NodeStatus.RUNNING
+                and prev_status != NodeStatus.RUNNING
+            )
             logger.info("registered %s from %s", node, meta.host_addr)
         # outside the lock: observers may call back into query methods.
-        # Fire only if the transition actually happened — a straggler
-        # re-registering a terminally-failed node must not look alive.
+        # Fire only on an actual transition INTO running — neither a
+        # straggler re-registering a terminally-failed node nor a
+        # network-blip re-registration of an already-running one.
         if started:
             self._fire("on_node_started", node)
         return node

@@ -66,6 +66,11 @@ class ModelConfig:
     moe_z_coef: float = 0.0          # router z-loss coefficient
     moe_alltoall: bool = False       # explicit shard_map all-to-all dispatch
     moe_impl: str = "dense"          # dense (capacity) | ragged (dropless)
+    # ragged+ep: per-destination all-to-all buffer bound, as a multiple
+    # of the balanced share (t·k/ep). Memory/wire bound ONLY — compute
+    # stays ragged; tokens past the bound drop. ep (the worst case)
+    # guarantees droplessness at ep× wire cost.
+    moe_a2a_bound: float = 2.0
     # pipeline microbatches when the mesh has pp > 1 (0 → one per stage)
     pp_microbatches: int = 0
     # interleaved (circular) pipeline: v layer chunks per stage cut the

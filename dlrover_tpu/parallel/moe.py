@@ -423,11 +423,7 @@ def _moe_block_ragged(x, moe, cfg, mesh=None, rng=None):
         return out.reshape(b, s, d), aux
 
     if mesh.shape.get("ep", 1) > 1:
-        raise ValueError(
-            "moe_impl='ragged' computes experts token-locally and does "
-            "not shard them over ep; use an ep=1 mesh (shard dp/fsdp/tp "
-            "instead) or moe_alltoall/dense for expert parallelism"
-        )
+        return _moe_block_ragged_a2a(x, moe, cfg, mesh, rng)
 
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -471,6 +467,180 @@ def _moe_block_ragged(x, moe, cfg, mesh=None, rng=None):
             P(None, "tp", None),
         ),
         out_specs=(P(token_axes, "sp", None), P()),
+        check_vma=False,
+    )(
+        x,
+        moe["w_gate"].astype(x.dtype),
+        moe["w_up"].astype(x.dtype),
+        moe["w_gate_proj"].astype(x.dtype),
+        moe["w_down"].astype(x.dtype),
+    )
+
+
+def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
+    """Dropless-by-default expert parallelism: bounded all-to-all for
+    bytes, ragged grouped-GEMM for FLOPs.
+
+    The TPU answer to the reference's grouped-GEMM MoE under expert
+    parallelism (grouped_gemm_moe.py:46 + moe_layer.py _AllToAll).
+    XLA:CPU cannot run `ragged-all-to-all`, and static shapes are the
+    XLA contract anyway — so the exchange is a REGULAR all_to_all over
+    a per-destination buffer bound (cfg.moe_a2a_bound × the balanced
+    share t·k/ep; `ep` ⇒ guaranteed dropless), while the expert compute
+    is `lax.ragged_dot` over the ACTUAL received token counts. Unlike
+    the capacity path, imbalance costs zero extra FLOPs and tokens only
+    drop past the byte bound (counted, not silent: see the
+    moe_dropped_frac aux).
+
+    Layout: tokens sharded over (dp, fsdp, ep); experts sharded over ep
+    (each rank owns E/ep experts, all its FFN weights local).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape["ep"]
+    e = cfg.n_experts
+    if e % ep:
+        raise ValueError(f"n_experts {e} not divisible by ep {ep}")
+    e_local = e // ep
+    b, s, d = x.shape
+    token_axes = ("dp", "fsdp", "ep")
+
+    def body(xl, w_gate, w_up, w_gp, w_down):
+        local = {
+            "w_gate": w_gate,
+            "w_up": w_up,
+            "w_gate_proj": w_gp,
+            "w_down": w_down,
+        }
+        bl, sl, _ = xl.shape
+        gate_logits, probs, weights, gate_idx = _route(xl, local, cfg, rng)
+        k = gate_idx.shape[-1]
+        t = bl * sl
+        cap = max(1, int(cfg.moe_a2a_bound * t * k / ep))
+        xt = xl.reshape(t, d)
+        flat_idx = gate_idx.reshape(t * k)
+        order = jnp.argsort(flat_idx)          # stable: token order per expert
+        token_of = order // k
+        sorted_in = jnp.take(xt, token_of, axis=0)       # [t·k, D]
+        counts = jnp.bincount(flat_idx, length=e).astype(jnp.int32)
+
+        # ---- pack per-destination blocks [ep, cap, D] -------------------
+        cnt_dest = counts.reshape(ep, e_local).sum(-1)   # [ep]
+        start_dest = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(cnt_dest)[:-1]]
+        )
+        slot = jnp.arange(cap)[None, :]                   # [1, cap]
+        src_idx = start_dest[:, None] + slot              # [ep, cap]
+        send_valid = slot < cnt_dest[:, None]             # drops past cap
+        send = jnp.where(
+            send_valid[..., None],
+            jnp.take(
+                sorted_in, jnp.clip(src_idx, 0, t * k - 1), axis=0
+            ),
+            0.0,
+        )                                                  # [ep, cap, D]
+
+        # ---- exchange ---------------------------------------------------
+        # axis 0: destination before the a2a, source after
+        recv = jax.lax.all_to_all(
+            send, "ep", split_axis=0, concat_axis=0, tiled=True
+        )                                                  # [ep, cap, D]
+        counts_all = jax.lax.all_gather(counts, "ep")      # [ep, E]
+        my_rank = jax.lax.axis_index("ep")
+        # per (source, local expert) counts for MY experts
+        mine = jax.lax.dynamic_slice_in_dim(
+            counts_all, my_rank * e_local, e_local, axis=1
+        )                                                  # [ep, e_local]
+        # also bound by cap: a source sent at most cap of them
+        sent_mine = jnp.minimum(
+            mine,
+            jnp.maximum(
+                cap
+                - jnp.concatenate(
+                    [
+                        jnp.zeros((ep, 1), jnp.int32),
+                        jnp.cumsum(mine, axis=1)[:, :-1],
+                    ],
+                    axis=1,
+                ),
+                0,
+            ),
+        )
+
+        # ---- compact + sort received rows by expert ---------------------
+        # within a source block, rows are expert-sorted; slot b belongs
+        # to local expert searchsorted(cumsum(sent_mine[i]), b, 'right')
+        csum = jnp.cumsum(sent_mine, axis=1)               # [ep, e_local]
+        key = jax.vmap(
+            lambda c: jnp.searchsorted(c, jnp.arange(cap), side="right")
+        )(csum)                                            # [ep, cap]
+        key = jnp.where(
+            jnp.arange(cap)[None, :] < csum[:, -1:], key, e_local
+        )  # sentinel for padding slots
+        perm = jnp.argsort(key.reshape(-1))                # [ep·cap]
+        flat_recv = recv.reshape(ep * cap, d)
+        compact = jnp.take(flat_recv, perm, axis=0)
+        group_sizes = sent_mine.sum(0)                     # [e_local]
+
+        # ---- ragged expert FFN ------------------------------------------
+        up = jax.lax.ragged_dot(compact, w_up, group_sizes)
+        gp = jax.lax.ragged_dot(compact, w_gp, group_sizes)
+        h = jax.nn.silu(gp) * up
+        out_sorted = jax.lax.ragged_dot(h, w_down, group_sizes)
+        # zero the sentinel tail so the return path carries no garbage
+        n_real = group_sizes.sum()
+        out_sorted = jnp.where(
+            (jnp.arange(ep * cap) < n_real)[:, None], out_sorted, 0.0
+        )
+
+        # ---- return path: unsort, a2a back, unpack ----------------------
+        inv = jnp.argsort(perm)
+        back = jnp.take(out_sorted, inv, axis=0).reshape(ep, cap, d)
+        ret = jax.lax.all_to_all(
+            back, "ep", split_axis=0, concat_axis=0, tiled=True
+        )                                                  # [ep(dest), cap, D]
+        # sorted position p lived in dest block (expert(p)//e_local) at
+        # slot p - start_dest[dest]
+        pos = jnp.arange(t * k)
+        sorted_expert = jnp.take(
+            flat_idx, jnp.clip(order, 0, t * k - 1)
+        )
+        dest = sorted_expert // e_local
+        b_slot = pos - jnp.take(start_dest, dest)
+        kept = b_slot < cap
+        gathered = ret.reshape(ep * cap, d)[
+            jnp.clip(dest * cap + b_slot, 0, ep * cap - 1)
+        ]
+        out_per_choice = jnp.where(kept[:, None], gathered, 0.0)
+
+        w_sorted = jnp.take(weights.reshape(t * k), order)[:, None]
+        out = jnp.zeros((t, d), jnp.float32)
+        out = out.at[token_of].add(
+            out_per_choice.astype(jnp.float32) * w_sorted
+        )
+
+        # ---- aux: global stats ------------------------------------------
+        aux = _ragged_aux(
+            gate_logits, probs, counts, pmean_axes=token_axes
+        )
+        dropped = (t * k) - cnt_dest.clip(max=cap).sum()
+        aux["moe_dropped_frac"] = jax.lax.pmean(
+            dropped.astype(jnp.float32) / (t * k), axis_name=token_axes
+        )
+        return out.reshape(bl, sl, d).astype(xl.dtype), aux
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(token_axes, None, None),
+            P(None, None),          # router replicated
+            P("ep", None, None),    # expert-sharded FFN weights
+            P("ep", None, None),
+            P("ep", None, None),
+        ),
+        out_specs=(P(token_axes, None, None), P()),
         check_vma=False,
     )(
         x,

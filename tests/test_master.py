@@ -153,6 +153,14 @@ def test_brain_ps_weights_flow_to_sparse_tier(master):
     scaler.execute_plan(plan)
     assert master.ps_service.get_global_version() == v0 + 1
 
+    # ps-oom count hints reach the platform hook
+    targets = []
+    scaler.ps_scale_fn = targets.append
+    plan2 = ResourcePlan()
+    plan2.node_resources["ps"] = {"num": 3}
+    scaler.execute_plan(plan2)
+    assert targets == [3]
+
 
 def test_register_and_heartbeat(master):
     c = _client(master, 0)
