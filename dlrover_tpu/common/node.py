@@ -92,6 +92,7 @@ class Node:
         self.exit_reason: str = ""
         self.relaunch_count = 0  # budget-consuming failures only
         self.incarnation = 0     # bumps on EVERY relaunch (pod identity)
+        self.agent_restart_count = 0  # agent-reported worker restarts
         self.max_relaunch_count = max_relaunch_count
         self.relaunchable = True
         self.is_released = False

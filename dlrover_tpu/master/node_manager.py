@@ -151,16 +151,19 @@ class JobManager:
             node.topology.slice_index = meta.slice_index
             node.heartbeat_time = time.time()
             prev_status = node.status
+            prev_rc = node.agent_restart_count
+            node.agent_restart_count = max(prev_rc, restart_count)
             self._apply_status(node, NodeStatus.RUNNING)
-            started = (
-                node.status == NodeStatus.RUNNING
-                and prev_status != NodeStatus.RUNNING
+            started = node.status == NodeStatus.RUNNING and (
+                prev_status != NodeStatus.RUNNING
+                or restart_count > prev_rc
             )
             logger.info("registered %s from %s", node, meta.host_addr)
         # outside the lock: observers may call back into query methods.
-        # Fire only on an actual transition INTO running — neither a
-        # straggler re-registering a terminally-failed node nor a
-        # network-blip re-registration of an already-running one.
+        # Fire on an actual transition INTO running OR on a worker
+        # restart (higher restart_count — the replacement registering
+        # before any failure event landed); never for a straggler
+        # re-registering a terminal node or a network-blip duplicate.
         if started:
             self._fire("on_node_started", node)
         return node

@@ -13,9 +13,10 @@ skipped entirely.
 
 Backward: FlashAttention-2-style pallas kernels via custom_vjp — a dq pass
 (k-blocks innermost, dq carried in VMEM scratch) and a dk/dv pass (q-blocks
-innermost), both recomputing p from the saved lse; tiles capped at
-BWD_BLOCK (512 measured fastest on v5e — the backward holds ~4 [bq,bk] f32
-transients). The ring-attention variant's lse cotangent folds into the
+innermost), both recomputing p from the saved lse; tiles capped by head
+width (BWD_BLOCK=512 for head_dim 64, BWD_BLOCK_WIDE=1024 for head_dim
+≥128 — both measured on v5e; the backward holds ~4 [bq,bk] f32
+transients at whichever cap applies). The ring-attention variant's lse cotangent folds into the
 per-row delta before the kernels, so the SAME kernels serve it. A
 jnp-level chunked recompute remains as the off-TPU / untileable-shape
 fallback.
@@ -55,7 +56,8 @@ INTERPRET = False
 # pallas FA2 backward kernels (vs the jnp chunked recompute); tiles
 # capped separately from the forward (see _bwd_rule)
 USE_PALLAS_BWD = True
-BWD_BLOCK = 512
+BWD_BLOCK = 512        # measured best for head_dim 64 (v5e)
+BWD_BLOCK_WIDE = 1024  # measured best for head_dim >= 128 (v5e)
 
 
 def _last_visible_k_block(i, block_q, block_k):
@@ -815,13 +817,15 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
                   cot):
     """The ONE backward dispatch (plain _bwd_rule delegates here with a
     None lse cotangent): FA2 pallas kernels on TPU/interpret with tiles
-    capped at BWD_BLOCK (~4 [bq,bk] f32 transients per grid step, so
-    smaller than the forward's); jnp chunked recompute off-TPU or when
-    the sequence doesn't tile to a lane-aligned block."""
+    capped per head width (BWD_BLOCK / BWD_BLOCK_WIDE — ~4 [bq,bk] f32
+    transients per grid step at the applied cap); jnp chunked recompute
+    off-TPU or when the sequence doesn't tile to a lane-aligned block."""
     q, k, v, prefix, offsets, out, lse = residuals
     g_out, g_lse = cot
-    bq = _fit_block(q.shape[1], min(block_q, BWD_BLOCK))
-    bk = _fit_block(k.shape[1], min(block_k, BWD_BLOCK))
+    # wider heads keep the MXU busier per tile, so bigger tiles win
+    bwd_cap = BWD_BLOCK_WIDE if q.shape[-1] >= 128 else BWD_BLOCK
+    bq = _fit_block(q.shape[1], min(block_q, bwd_cap))
+    bk = _fit_block(k.shape[1], min(block_k, bwd_cap))
     if (
         USE_PALLAS_BWD
         and pltpu is not None

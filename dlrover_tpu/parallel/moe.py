@@ -338,6 +338,34 @@ def _moe_block_alltoall(x, moe, cfg, mesh, rng):
 # ---------------------------------------------------------------------------
 
 
+def _sort_by_expert(xt, gate_idx, e):
+    """Stable-sort prologue shared by both ragged lowerings: (token,
+    choice) pairs ordered by expert. STABILITY is load-bearing — the
+    a2a pack/unpack indexing assumes per-expert token order survives.
+
+    Returns (flat_idx [t·k], order [t·k], token_of [t·k],
+    sorted_in [t·k, D], counts [E])."""
+    t, k = gate_idx.shape
+    flat_idx = gate_idx.reshape(t * k)
+    order = jnp.argsort(flat_idx)
+    token_of = order // k
+    sorted_in = jnp.take(xt, token_of, axis=0)
+    counts = jnp.bincount(flat_idx, length=e).astype(jnp.int32)
+    return flat_idx, order, token_of, sorted_in, counts
+
+
+def _combine_weighted(out_per_choice, weights, order, token_of, t, d, dtype):
+    """Weighted scatter-add of per-(token, choice) expert outputs back
+    to token order — the combine tail both ragged lowerings share
+    (f32 accumulation; weights applied in sorted order)."""
+    w_sorted = jnp.take(weights.reshape(-1), order)[:, None]
+    out = jnp.zeros((t, d), jnp.float32)
+    out = out.at[token_of].add(
+        out_per_choice.astype(jnp.float32) * w_sorted
+    )
+    return out.astype(dtype)
+
+
 def _ragged_ffn(xl, moe_local, gate_idx, weights, dtype):
     """Grouped-GEMM expert FFN over one rank's token slice.
 
@@ -349,13 +377,10 @@ def _ragged_ffn(xl, moe_local, gate_idx, weights, dtype):
     Returns (out [T, D], group_sizes [E] int32).
     """
     t, d = xl.shape
-    k = gate_idx.shape[-1]
     e = moe_local["w_up"].shape[0]
-    flat_idx = gate_idx.reshape(t * k)
-    order = jnp.argsort(flat_idx)  # stable: preserves token order per expert
-    token_of = order // k
-    sorted_in = jnp.take(xl, token_of, axis=0)  # [T·k, D]
-    group_sizes = jnp.bincount(flat_idx, length=e).astype(jnp.int32)
+    _, order, token_of, sorted_in, group_sizes = _sort_by_expert(
+        xl, gate_idx, e
+    )
 
     up = jax.lax.ragged_dot(
         sorted_in, moe_local["w_up"].astype(dtype), group_sizes
@@ -367,12 +392,10 @@ def _ragged_ffn(xl, moe_local, gate_idx, weights, dtype):
     out_sorted = jax.lax.ragged_dot(
         h, moe_local["w_down"].astype(dtype), group_sizes
     )  # [T·k, D]
-    w_sorted = jnp.take(weights.reshape(t * k), order)[:, None]
-    out = jnp.zeros((t, d), jnp.float32)
-    out = out.at[token_of].add(
-        out_sorted.astype(jnp.float32) * w_sorted
+    out = _combine_weighted(
+        out_sorted, weights, order, token_of, t, d, dtype
     )
-    return out.astype(dtype), group_sizes
+    return out, group_sizes
 
 
 def _ragged_aux(gate_logits, probs, group_sizes, pmean_axes=None):
@@ -409,7 +432,8 @@ def _moe_block_ragged(x, moe, cfg, mesh=None, rng=None):
     """
     b, s, d = x.shape
     if mesh is None or all(
-        mesh.shape.get(a, 1) == 1 for a in ("dp", "fsdp", "sp", "tp")
+        mesh.shape.get(a, 1) == 1
+        for a in ("dp", "fsdp", "sp", "tp", "ep")
     ):
         gate_logits, probs, weights, gate_idx = _route(x, moe, cfg, rng)
         out, group_sizes = _ragged_ffn(
@@ -518,12 +542,9 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
         k = gate_idx.shape[-1]
         t = bl * sl
         cap = max(1, int(cfg.moe_a2a_bound * t * k / ep))
-        xt = xl.reshape(t, d)
-        flat_idx = gate_idx.reshape(t * k)
-        order = jnp.argsort(flat_idx)          # stable: token order per expert
-        token_of = order // k
-        sorted_in = jnp.take(xt, token_of, axis=0)       # [t·k, D]
-        counts = jnp.bincount(flat_idx, length=e).astype(jnp.int32)
+        flat_idx, order, token_of, sorted_in, counts = _sort_by_expert(
+            xl.reshape(t, d), gate_idx.reshape(t, k), e
+        )
 
         # ---- pack per-destination blocks [ep, cap, D] -------------------
         cnt_dest = counts.reshape(ep, e_local).sum(-1)   # [ep]
@@ -572,12 +593,11 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
         # within a source block, rows are expert-sorted; slot b belongs
         # to local expert searchsorted(cumsum(sent_mine[i]), b, 'right')
         csum = jnp.cumsum(sent_mine, axis=1)               # [ep, e_local]
+        # padding slots (b >= csum[-1]) get key e_local from searchsorted
+        # itself, so they stably sort last — no explicit sentinel needed
         key = jax.vmap(
             lambda c: jnp.searchsorted(c, jnp.arange(cap), side="right")
         )(csum)                                            # [ep, cap]
-        key = jnp.where(
-            jnp.arange(cap)[None, :] < csum[:, -1:], key, e_local
-        )  # sentinel for padding slots
         perm = jnp.argsort(key.reshape(-1))                # [ep·cap]
         flat_recv = recv.reshape(ep * cap, d)
         compact = jnp.take(flat_recv, perm, axis=0)
@@ -603,9 +623,7 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
         # sorted position p lived in dest block (expert(p)//e_local) at
         # slot p - start_dest[dest]
         pos = jnp.arange(t * k)
-        sorted_expert = jnp.take(
-            flat_idx, jnp.clip(order, 0, t * k - 1)
-        )
+        sorted_expert = jnp.take(flat_idx, order)  # order is a permutation
         dest = sorted_expert // e_local
         b_slot = pos - jnp.take(start_dest, dest)
         kept = b_slot < cap
@@ -613,11 +631,8 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
             jnp.clip(dest * cap + b_slot, 0, ep * cap - 1)
         ]
         out_per_choice = jnp.where(kept[:, None], gathered, 0.0)
-
-        w_sorted = jnp.take(weights.reshape(t * k), order)[:, None]
-        out = jnp.zeros((t, d), jnp.float32)
-        out = out.at[token_of].add(
-            out_per_choice.astype(jnp.float32) * w_sorted
+        out = _combine_weighted(
+            out_per_choice, weights, order, token_of, t, d, jnp.float32
         )
 
         # ---- aux: global stats ------------------------------------------
