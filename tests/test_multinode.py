@@ -89,14 +89,19 @@ def test_world_shrink_resharded_recovery(tmp_path):
             "--port",
             "0",
             # min_nodes=1 lets the post-crash rendezvous seal a
-            # 1-node world after the 30s extra-nodes grace
+            # 1-node world after the extra-nodes grace
             "--num-workers",
             "1",
             "--max-workers",
             "2",
         ],
         cwd=REPO,
-        env=_env(run_id),
+        # shrink grace tuned down (default 30s): the post-crash re-seal
+        # waits this long for the lost node to come back before going
+        # ahead at world=1 — the dominant term in recovery wall-clock
+        env=_env(
+            run_id, {"DLROVER_TPU_CTX_RDZV_WAIT_EXTRA_NODES_S": "3"}
+        ),
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
