@@ -53,6 +53,102 @@ def _drain(proc):
     return q
 
 
+def _kill_tree(proc):
+    """SIGKILL a launched agent AND its worker children (they share the
+    process group because we launch with start_new_session=True).
+    killpg works while ANY group member is alive — even if the leader
+    already exited and orphaned a hung worker."""
+    import signal
+
+    if proc is None:
+        return
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        if proc.poll() is None:
+            proc.kill()
+
+
+def _drain_now(q, lines):
+    """Pull whatever is already queued, non-blocking (for diagnostics)."""
+    import queue as queue_mod
+
+    while True:
+        try:
+            line = q.get_nowait()
+        except queue_mod.Empty:
+            return
+        if line is None:
+            return
+        lines.append(line)
+
+
+def _start_master(run_id, argv_extra=(), env_extra=None):
+    """Spawn dlrover_tpu.master.main, return (proc, queue, lines, addr)."""
+    master = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--port",
+            "0",
+            *argv_extra,
+        ],
+        cwd=REPO,
+        env=_env(run_id, env_extra),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    q = _drain(master)
+    lines = []
+    addr_line = _collect(
+        q,
+        lines,
+        until=lambda l: l.startswith("DLROVER_TPU_MASTER_ADDR="),
+        deadline=time.time() + 60,
+    )
+    assert addr_line, "master did not print its address"
+    addr = re.match(
+        r"DLROVER_TPU_MASTER_ADDR=(.+)", addr_line.strip()
+    ).group(1)
+    return master, q, lines, addr
+
+
+def _launch_agent(run_id, node_id, addr, train_args, agent_args=(),
+                  nnodes="1:2"):
+    """Spawn a launcher+worker process group for one node."""
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.agent.launcher",
+            "--nnodes",
+            nnodes,
+            "--node-id",
+            str(node_id),
+            "--nproc",
+            "1",
+            *agent_args,
+            "--master-addr",
+            addr,
+            "--",
+            sys.executable,
+            "examples/train_gpt_elastic.py",
+            *train_args,
+        ],
+        cwd=REPO,
+        env=_env(
+            f"{run_id}_n{node_id}",
+            {"DLROVER_TPU_COORDINATOR_PORT": "0"},
+        ),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+
+
 def _collect(q, lines, until, deadline, on_line=None):
     """Consume queued lines until ``until(line)`` or EOF/deadline.
     Returns the matching line or None."""
@@ -81,94 +177,30 @@ def test_world_shrink_resharded_recovery(tmp_path):
     read of both emergency-persisted host packs) and finishes. Recovery
     wall-clock (crash → resumed) is printed."""
     run_id = f"ws{os.getpid()}"
-    master = subprocess.Popen(
-        [
-            sys.executable,
-            "-m",
-            "dlrover_tpu.master.main",
-            "--port",
-            "0",
-            # min_nodes=1 lets the post-crash rendezvous seal a
-            # 1-node world after the extra-nodes grace
-            "--num-workers",
-            "1",
-            "--max-workers",
-            "2",
-        ],
-        cwd=REPO,
-        # shrink grace tuned down (default 30s): the post-crash re-seal
-        # waits this long for the lost node to come back before going
-        # ahead at world=1 — the dominant term in recovery wall-clock
-        env=_env(
-            run_id, {"DLROVER_TPU_CTX_RDZV_WAIT_EXTRA_NODES_S": "3"}
-        ),
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
+    # shrink grace tuned down (default 30s): the post-crash re-seal
+    # waits this long for the lost node to come back before going
+    # ahead at world=1 — the dominant term in recovery wall-clock
+    # (min_nodes=1 lets it seal a 1-node world at all)
+    master, master_q, master_lines, addr = _start_master(
+        run_id,
+        argv_extra=("--num-workers", "1", "--max-workers", "2"),
+        env_extra={"DLROVER_TPU_CTX_RDZV_WAIT_EXTRA_NODES_S": "3"},
     )
     survivor = casualty = None
     try:
-        master_q = _drain(master)  # drained for the whole test
-        master_lines = []
-        addr_line = _collect(
-            master_q,
-            master_lines,
-            until=lambda l: l.startswith("DLROVER_TPU_MASTER_ADDR="),
-            deadline=time.time() + 60,
+        train_args = (
+            "--steps", "6", "--batch", "4", "--seq", "32",
+            "--ckpt-dir", str(tmp_path / "ckpt"),
+            "--ckpt-every", "2", "--crash-at", "3",
         )
-        assert addr_line, "master did not print its address"
-        addr = re.match(
-            r"DLROVER_TPU_MASTER_ADDR=(.+)", addr_line.strip()
-        ).group(1)
-
-        ckpt_dir = str(tmp_path / "ckpt")
-
-        def launch_agent(node_id, max_restarts):
-            return subprocess.Popen(
-                [
-                    sys.executable,
-                    "-m",
-                    "dlrover_tpu.agent.launcher",
-                    "--nnodes",
-                    "1:2",
-                    "--node-id",
-                    str(node_id),
-                    "--nproc",
-                    "1",
-                    "--max-restarts",
-                    str(max_restarts),
-                    "--master-addr",
-                    addr,
-                    "--",
-                    sys.executable,
-                    "examples/train_gpt_elastic.py",
-                    "--steps",
-                    "6",
-                    "--batch",
-                    "4",
-                    "--seq",
-                    "32",
-                    "--ckpt-dir",
-                    ckpt_dir,
-                    "--ckpt-every",
-                    "2",
-                    "--crash-at",
-                    "3",
-                ],
-                cwd=REPO,
-                env=_env(
-                    f"{run_id}_n{node_id}",
-                    {"DLROVER_TPU_COORDINATOR_PORT": "0"},
-                ),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-
         # node 1 has no restart budget: after the synchronized crash at
         # step 3 it leaves the job for good (the "lost host")
-        survivor = launch_agent(0, max_restarts=2)
-        casualty = launch_agent(1, max_restarts=0)
+        survivor = _launch_agent(
+            run_id, 0, addr, train_args, ("--max-restarts", "2")
+        )
+        casualty = _launch_agent(
+            run_id, 1, addr, train_args, ("--max-restarts", "0")
+        )
         sur_q, cas_q = _drain(survivor), _drain(casualty)
         sur_lines, cas_lines = [], []
 
@@ -216,90 +248,111 @@ def test_world_shrink_resharded_recovery(tmp_path):
         )
     finally:
         for proc in (survivor, casualty):
-            if proc is not None and proc.poll() is None:
-                proc.kill()
+            _kill_tree(proc)
+        master.kill()
+        master.wait()
+
+
+def test_world_grow_joins_mid_run(tmp_path):
+    """Scale-UP elasticity: a 1-node job is joined by a second host
+    mid-run. The running agent notices the waiting node (membership
+    poll), checkpoints, restarts its worker, and both re-seal a 2-node
+    world that resumes from the checkpoint — the grow half of the
+    composed elasticity path (the shrink half is the test above)."""
+    run_id = f"wg{os.getpid()}"
+    # grace must outlive the running agent's checkpoint+restart cycle:
+    # with a too-small value the joiner seals a 1-node world alone and
+    # the two agents ping-pong restarts
+    master, master_q, master_lines, addr = _start_master(
+        run_id,
+        argv_extra=("--num-workers", "1", "--max-workers", "2"),
+        env_extra={"DLROVER_TPU_CTX_RDZV_WAIT_EXTRA_NODES_S": "10"},
+    )
+    a0 = a1 = None
+    try:
+        # --steps 400 is pure runway: the test tears down after the
+        # joint checkpoint; it must never finish before the joiner
+        # arrives (node 1's process startup can take minutes under load)
+        train_args = (
+            "--steps", "400", "--batch", "4", "--seq", "32",
+            "--ckpt-dir", str(tmp_path / "ckpt"), "--ckpt-every", "2",
+        )
+        a0 = _launch_agent(run_id, 0, addr, train_args)
+        q0 = _drain(a0)
+        lines0 = []
+        # wait until node 0 is genuinely TRAINING alone (a few steps in)
+        assert _collect(
+            q0,
+            lines0,
+            until=lambda l: "step=4" in l,
+            deadline=time.time() + 240,
+        ), "".join(lines0)[-3000:]
+
+        # second host joins mid-run
+        a1 = _launch_agent(run_id, 1, addr, train_args)
+        q1 = _drain(a1)
+        lines1 = []
+        # the composed path is proven once the restarted world RESUMES
+        # and then commits a joint checkpoint ("(2 hosts)") — running
+        # to completion is other tests' job and makes this one
+        # timing-fragile under CI contention
+        saw_resume = {}
+
+        def watch(line):
+            if "resumed from step" in line:
+                saw_resume["yes"] = True
+
+        joint_ckpt = _collect(
+            q0,
+            lines0,
+            until=lambda l: "(2 hosts)" in l and "yes" in saw_resume,
+            deadline=time.time() + 420,
+            on_line=watch,
+        )
+        out0 = "".join(lines0)
+        if joint_ckpt is None:
+            _drain_now(q1, lines1)  # the joiner may hold the real error
+            raise AssertionError(
+                "no joint checkpoint after resume:\n--- node 0 ---\n"
+                + out0[-3000:]
+                + "\n--- node 1 ---\n"
+                + "".join(lines1)[-2000:]
+            )
+        # the running agent restarted for the membership change...
+        assert "membership changed" in out0, out0[-3000:]
+        # ...and the re-sealed world is a real 2-process cluster that
+        # resumed from the checkpoint instead of starting over
+        assert "2 global devices" in out0, out0[-3000:]
+        assert "resumed from step" in out0, out0[-3000:]
+    finally:
+        for proc in (a0, a1):
+            _kill_tree(proc)
         master.kill()
         master.wait()
 
 
 def test_two_node_elastic_training(tmp_path):
     run_id = f"mn{os.getpid()}"
-    master = subprocess.Popen(
-        [
-            sys.executable,
-            "-m",
-            "dlrover_tpu.master.main",
-            "--port",
-            "0",
-            "--num-workers",
-            "2",
-        ],
-        cwd=REPO,
-        env=_env(run_id),
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
+    master, _mq, _mlines, addr = _start_master(
+        run_id, argv_extra=("--num-workers", "2")
     )
-    addr = None
-    deadline = time.time() + 60
-    while time.time() < deadline:
-        line = master.stdout.readline()
-        if not line:
-            time.sleep(0.1)
-            continue
-        m = re.match(r"DLROVER_TPU_MASTER_ADDR=(.+)", line.strip())
-        if m:
-            addr = m.group(1)
-            break
-    assert addr, "master did not print its address"
-
-    ckpt_dir = str(tmp_path / "ckpt")
-    agents = []
-    for node_id in range(2):
-        agents.append(
-            subprocess.Popen(
-                [
-                    sys.executable,
-                    "-m",
-                    "dlrover_tpu.agent.launcher",
-                    "--nnodes",
-                    "2",
-                    "--node-id",
-                    str(node_id),
-                    "--nproc",
-                    "1",
-                    "--master-addr",
-                    addr,
-                    "--",
-                    sys.executable,
-                    "examples/train_gpt_elastic.py",
-                    "--steps",
-                    "4",
-                    "--batch",
-                    "4",
-                    "--seq",
-                    "32",
-                    "--ckpt-dir",
-                    ckpt_dir,
-                    "--ckpt-every",
-                    "2",
-                ],
-                cwd=REPO,
-                env=_env(
-                    f"{run_id}_n{node_id}",
-                    {"DLROVER_TPU_COORDINATOR_PORT": "0"},
-                ),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-        )
-
+    train_args = (
+        "--steps", "4", "--batch", "4", "--seq", "32",
+        "--ckpt-dir", str(tmp_path / "ckpt"), "--ckpt-every", "2",
+    )
+    agents = [
+        _launch_agent(run_id, node_id, addr, train_args, nnodes="2")
+        for node_id in range(2)
+    ]
+    queues = [_drain(a) for a in agents]
     outs = []
     try:
-        for agent in agents:
-            out, _ = agent.communicate(timeout=420)
-            outs.append(out)
+        deadline = time.time() + 420
+        for agent, q in zip(agents, queues):
+            lines = []
+            _collect(q, lines, until=lambda l: False, deadline=deadline)
+            agent.wait(timeout=60)
+            outs.append("".join(lines))
         for i, agent in enumerate(agents):
             assert agent.returncode == 0, f"agent {i} failed:\n{outs[i][-4000:]}"
         assert any("done at step 4" in o for o in outs), outs[0][-2000:]
@@ -307,7 +360,6 @@ def test_two_node_elastic_training(tmp_path):
         assert any("2 global devices" in o for o in outs), outs[0][-2000:]
     finally:
         for agent in agents:
-            if agent.poll() is None:
-                agent.kill()
+            _kill_tree(agent)
         master.kill()
         master.wait()
