@@ -1,0 +1,149 @@
+"""Platform-binding demo: the full K8s reconcile loop on an API double.
+
+Runs the production wiring — PodWatcher list-watch → NodeEvents →
+JobManager relaunch decisions → SliceScaler pod creates — against
+FakeKubeApi (an in-process API-server double with resourceVersion'd
+watch streams), and injects the failures a real cluster throws:
+
+  1. master creates the worker pods and they come up
+  2. one pod is OOM-killed → watch event → relaunch (budget consumed)
+  3. one pod is evicted → relaunch WITHOUT consuming budget
+  4. platform GC reaps a dead predecessor → stale event, no action
+  5. the job scales in → released pods' deletions are expected
+
+Usage:  python examples/run_kube_reconcile.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
+
+from dlrover_tpu.cluster.crd import (  # noqa: E402
+    ElasticJob,
+    ElasticJobSpec,
+    ReplicaSpec,
+    TPUSliceSpec,
+)
+from dlrover_tpu.cluster.kube import (  # noqa: E402
+    JOB_LABEL,
+    FakeKubeApi,
+    PodWatcher,
+)
+from dlrover_tpu.cluster.scaler import SliceScaler  # noqa: E402
+from dlrover_tpu.master.node_manager import (  # noqa: E402
+    JobManager,
+    ScalePlan,
+)
+
+
+def wait_for(cond, timeout=5.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(what)
+
+
+def pods(api):
+    return sorted(
+        (
+            p["metadata"]["name"],
+            p.get("status", {}).get("phase", "?"),
+        )
+        for p in api.list("Pod", label_selector={JOB_LABEL: "demo"})
+    )
+
+
+def main():
+    api = FakeKubeApi()
+    job = ElasticJob(
+        "demo",
+        spec=ElasticJobSpec(
+            replica_specs={
+                "worker": ReplicaSpec(
+                    replicas=3, slice=TPUSliceSpec(hosts_per_slice=1)
+                )
+            },
+            min_hosts=1,
+            max_hosts=4,
+        ),
+    )
+    scaler = SliceScaler(
+        job,
+        submit_fn=api.create,
+        delete_fn=lambda name: api.delete("Pod", name),
+        master_addr="10.0.0.1:8000",
+    )
+    jm = JobManager(num_workers=3, relaunch_budget=2, scaler=scaler)
+    watcher = PodWatcher(api, "demo", jm.process_event)
+
+    print("== 1. create worker pods")
+    plan = ScalePlan()
+    plan.worker_num = 3
+    scaler.scale(plan)
+    watcher.start()
+    for i in range(3):
+        api.set_pod_phase(f"demo-worker-{i}", "Running")
+    wait_for(
+        lambda: all(
+            jm.get_node(i).status == "running" for i in range(3)
+        ),
+        what="pods running",
+    )
+    print("   pods:", pods(api))
+
+    print("== 2. worker-0 OOM-killed → relaunch (budget consumed)")
+    api.set_pod_phase("demo-worker-0", "Failed", reason="OOMKilled")
+    wait_for(
+        lambda: api.get("Pod", "demo-worker-0-r1") is not None,
+        what="replacement for worker-0",
+    )
+    api.set_pod_phase("demo-worker-0-r1", "Running")
+    wait_for(lambda: jm.get_node(0).status == "running")
+    print(
+        f"   node 0: relaunch_count={jm.get_node(0).relaunch_count} "
+        f"incarnation={jm.get_node(0).incarnation}"
+    )
+
+    print("== 3. worker-1 evicted → relaunch WITHOUT consuming budget")
+    api.set_pod_phase("demo-worker-1", "Failed", reason="Evicted")
+    wait_for(
+        lambda: api.get("Pod", "demo-worker-1-r1") is not None,
+        what="replacement for worker-1",
+    )
+    api.set_pod_phase("demo-worker-1-r1", "Running")
+    wait_for(lambda: jm.get_node(1).status == "running")
+    print(
+        f"   node 1: relaunch_count={jm.get_node(1).relaunch_count} "
+        f"(eviction is budget-free), incarnation="
+        f"{jm.get_node(1).incarnation}"
+    )
+
+    print("== 4. platform GC reaps the dead predecessors (stale events)")
+    api.delete("Pod", "demo-worker-0")
+    api.delete("Pod", "demo-worker-1")
+    time.sleep(0.3)
+    assert jm.get_node(0).status == "running"
+    assert jm.get_node(1).status == "running"
+    print("   replacements untouched:", pods(api))
+
+    print("== 5. scale in to 1 worker (released pods are not failures)")
+    jm.set_worker_num(1)
+    plan = ScalePlan()
+    plan.worker_num = 1
+    scaler.scale(plan)
+    time.sleep(0.3)
+    live = [n for n, ph in pods(api) if ph != "Failed"]
+    print("   live pods:", live)
+    assert live == ["demo-worker-0-r1"], live
+
+    watcher.stop()
+    jm.stop()
+    print("[kube-reconcile] done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

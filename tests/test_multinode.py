@@ -56,17 +56,19 @@ def _drain(proc):
 def _kill_tree(proc):
     """SIGKILL a launched agent AND its worker children (they share the
     process group because we launch with start_new_session=True).
-    killpg works while ANY group member is alive — even if the leader
-    already exited and orphaned a hung worker."""
+
+    Only while the leader is UNREAPED: its pid (== the pgid) is then
+    guaranteed still ours. After a successful wait() the pid may have
+    been recycled, and killpg would nuke an innocent process group —
+    normally-exited agents tear down their own workers anyway."""
     import signal
 
-    if proc is None:
+    if proc is None or proc.poll() is not None:
         return
     try:
         os.killpg(proc.pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError):
-        if proc.poll() is None:
-            proc.kill()
+        proc.kill()
 
 
 def _drain_now(q, lines):
