@@ -8,7 +8,8 @@ watch streams), and injects the failures a real cluster throws:
   1. master creates the worker pods and they come up
   2. one pod is OOM-killed → watch event → relaunch (budget consumed)
   3. one pod is evicted → relaunch WITHOUT consuming budget
-  4. platform GC reaps a dead predecessor → stale event, no action
+  4. the relaunches' own predecessor deletions arrive as stale
+     watch events (old incarnation) and are suppressed — no cascade
   5. the job scales in → released pods' deletions are expected
 
 Usage:  python examples/run_kube_reconcile.py
@@ -121,13 +122,18 @@ def main():
         f"{jm.get_node(1).incarnation}"
     )
 
-    print("== 4. platform GC reaps the dead predecessors (stale events)")
-    api.delete("Pod", "demo-worker-0")
-    api.delete("Pod", "demo-worker-1")
+    print("== 4. stale-event suppression")
+    # each relaunch above DELETED its predecessor pod; those DELETED
+    # watch events carry the old incarnation label and the master drops
+    # them — otherwise every relaunch would cascade into another one.
+    # Proof: no -r2 replacements exist and the nodes stay running.
     time.sleep(0.3)
+    assert api.get("Pod", "demo-worker-0-r2") is None
+    assert api.get("Pod", "demo-worker-1-r2") is None
     assert jm.get_node(0).status == "running"
     assert jm.get_node(1).status == "running"
-    print("   replacements untouched:", pods(api))
+    assert jm.get_node(0).incarnation == 1
+    print("   no relaunch cascade:", pods(api))
 
     print("== 5. scale in to 1 worker (released pods are not failures)")
     jm.set_worker_num(1)

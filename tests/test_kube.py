@@ -188,9 +188,10 @@ def test_reconcile_loop_end_to_end():
         msg="node 0 running after relaunch",
     )
 
-    # platform GC reaps the dead predecessor: its DELETED event carries
-    # incarnation 0 < relaunch_count 1 → stale, must NOT relaunch again
-    api.delete("Pod", "demo-worker-0")
+    # the relaunch DELETED the dead predecessor; that watch event
+    # carries incarnation 0 < the node's current incarnation 1 → it is
+    # dropped as stale. Without the guard it would read as another
+    # failure of rank 0 and cascade into relaunching the healthy -r1.
     time.sleep(0.3)
     assert jm.get_node(0).status == NodeStatus.RUNNING
     assert jm.get_node(0).relaunch_count == 1

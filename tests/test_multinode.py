@@ -57,18 +57,20 @@ def _kill_tree(proc):
     """SIGKILL a launched agent AND its worker children (they share the
     process group because we launch with start_new_session=True).
 
-    Only while the leader is UNREAPED: its pid (== the pgid) is then
-    guaranteed still ours. After a successful wait() the pid may have
-    been recycled, and killpg would nuke an innocent process group —
-    normally-exited agents tear down their own workers anyway."""
+    Safe to call even after the leader was reaped: Linux keeps the pid
+    number reserved while it is still the pgid of any live member, so
+    killpg either hits OUR group (reaping a crashed leader's orphaned
+    workers — the case this exists for) or raises ProcessLookupError
+    once the whole group is gone."""
     import signal
 
-    if proc is None or proc.poll() is not None:
+    if proc is None:
         return
     try:
         os.killpg(proc.pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError):
-        proc.kill()
+        if proc.poll() is None:
+            proc.kill()
 
 
 def _drain_now(q, lines):
