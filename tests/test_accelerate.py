@@ -174,3 +174,21 @@ def test_offload_strategy_chosen_when_memory_forces_it():
     assert tight is not None and tight.offload_opt_state, (
         "offload tier never became the choice under memory pressure"
     )
+
+
+def test_device_context_probe():
+    """Capability probe (atorch device_context.py:10 analog): coherent
+    facts on the test platform, cached, and consistent with the
+    analyser's HBM sizing."""
+    from dlrover_tpu.accelerate.analyser import device_hbm_bytes
+    from dlrover_tpu.accelerate.device_context import (
+        detect_device_context,
+        fp8_supported,
+    )
+
+    ctx = detect_device_context()
+    assert ctx.platform == "cpu" and not ctx.on_tpu
+    assert ctx.n_devices == 8  # the virtual test mesh
+    assert ctx.hbm_bytes == device_hbm_bytes()  # single source of truth
+    assert not ctx.supports_fp8 and not fp8_supported()
+    assert detect_device_context() is ctx  # lru-cached singleton

@@ -425,3 +425,37 @@ def test_reconciler_snaps_to_whole_slices():
         msg="snap 5 → 8 hosts",
     )
     rec.stop()
+
+
+def test_pod_watcher_resumes_from_resource_version():
+    """List-then-watch (k8s_watcher.py:194 semantics): a watcher started
+    from the post-list resourceVersion sees only NEW events — the
+    backlog arrives via the initial list, not replayed twice."""
+    api = FakeKubeApi()
+    job = _job(replicas=2)
+    scaler = SliceScaler(
+        job,
+        submit_fn=api.create,
+        delete_fn=lambda name: api.delete("Pod", name),
+    )
+    plan = ScalePlan()
+    plan.worker_num = 2
+    scaler.scale(plan)
+    api.set_pod_phase("demo-worker-0", "Running")
+    rv = api.latest_rv()
+
+    events = []
+    watcher = PodWatcher(api, "demo", events.append)
+    # the initial list snapshots CURRENT state (one running, one
+    # pending); the watch resumes after rv so the backlog isn't doubled
+    watcher.start(since_rv=rv)
+    _wait(lambda: len(events) >= 2, msg="list snapshot")
+    assert sorted(e.node_id for e in events[:2]) == [0, 1]
+    n_list = len(events)
+
+    api.set_pod_phase("demo-worker-1", "Running")
+    _wait(lambda: len(events) > n_list, msg="fresh watch event")
+    fresh = events[n_list:]
+    assert all(e.node_id == 1 for e in fresh)
+    assert all(e.status == NodeStatus.RUNNING for e in fresh)
+    watcher.stop()
