@@ -270,3 +270,56 @@ def test_socket_transport_allgather():
     finally:
         t0.close()
         t1.close()
+
+
+def test_compressed_exchange_over_socket_wire():
+    """QuantizedArray delta trees survive the real TCP wire: the packed
+    npz carries the int8 payload + scales (a registered pytree, so
+    _pack_tree/_unpack_tree need no special casing), and both peers
+    dequantize to identical trees."""
+    from dlrover_tpu.ops.quant import QuantizedArray, dequantize_tree, quantize_tree
+    from dlrover_tpu.parallel.local_sgd import socket_exchange
+
+    t0 = SocketTransport(0, {}, bind_host="127.0.0.1", token="t")
+    t1 = SocketTransport(1, {}, bind_host="127.0.0.1", token="t")
+    peers = {0: f"127.0.0.1:{t0.port}", 1: f"127.0.0.1:{t1.port}"}
+    t0.peers = dict(peers)
+    t1.peers = dict(peers)
+    deltas = [
+        {"w": jnp.full((8192,), 0.5), "small": jnp.ones((4,))},
+        {"w": jnp.linspace(-1.0, 1.0, 8192), "small": jnp.zeros((4,))},
+    ]
+    try:
+        out = [None, None]
+
+        def run(rank, t):
+            ex = socket_exchange(t)
+            out[rank] = ex(quantize_tree(deltas[rank], bits=8))
+
+        th = [
+            threading.Thread(target=run, args=(r, t))
+            for r, t in ((0, t0), (1, t1))
+        ]
+        for t in th:
+            t.start()
+        for t in th:
+            t.join()
+        for rank in (0, 1):
+            got = [dequantize_tree(t) for t in out[rank]]
+            # large leaf arrived quantized; small leaf exact
+            assert isinstance(out[rank][0]["w"], QuantizedArray)
+            np.testing.assert_allclose(
+                np.asarray(got[0]["w"]), 0.5, atol=0.01
+            )
+            np.testing.assert_allclose(
+                np.asarray(got[1]["w"]),
+                np.asarray(deltas[1]["w"]),
+                atol=0.01,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got[rank]["small"]),
+                np.asarray(deltas[rank]["small"]),
+            )
+    finally:
+        t0.close()
+        t1.close()
