@@ -243,18 +243,24 @@ def test_local_sgd_warmup_syncs_every_step():
     assert len(calls) == 3
 
 
-def test_socket_transport_allgather():
+def _socket_pair_exchange(payloads):
+    """Two SocketTransports exchange the given pytrees over real TCP.
+    Joins the worker threads and re-raises any captured exception (a
+    bare thread would swallow it and fail later as a cryptic None)."""
     t0 = SocketTransport(0, {}, bind_host="127.0.0.1", token="t")
     t1 = SocketTransport(1, {}, bind_host="127.0.0.1", token="t")
     peers = {0: f"127.0.0.1:{t0.port}", 1: f"127.0.0.1:{t1.port}"}
     t0.peers = dict(peers)
     t1.peers = dict(peers)
+    out = [None, None]
+    errs = [None, None]
     try:
-        out = [None, None]
 
         def run(rank, t):
-            ex = socket_exchange(t)
-            out[rank] = ex({"w": jnp.full((4,), float(rank + 1))})
+            try:
+                out[rank] = socket_exchange(t)(payloads[rank])
+            except Exception as e:  # noqa: BLE001
+                errs[rank] = e
 
         th = [
             threading.Thread(target=run, args=(r, t))
@@ -264,12 +270,22 @@ def test_socket_transport_allgather():
             t.start()
         for t in th:
             t.join()
-        for rank in (0, 1):
-            np.testing.assert_allclose(np.asarray(out[rank][0]["w"]), 1.0)
-            np.testing.assert_allclose(np.asarray(out[rank][1]["w"]), 2.0)
+        for e in errs:
+            if e is not None:
+                raise e
+        return out
     finally:
         t0.close()
         t1.close()
+
+
+def test_socket_transport_allgather():
+    out = _socket_pair_exchange(
+        [{"w": jnp.full((4,), float(rank + 1))} for rank in (0, 1)]
+    )
+    for rank in (0, 1):
+        np.testing.assert_allclose(np.asarray(out[rank][0]["w"]), 1.0)
+        np.testing.assert_allclose(np.asarray(out[rank][1]["w"]), 2.0)
 
 
 def test_compressed_exchange_over_socket_wire():
@@ -277,49 +293,28 @@ def test_compressed_exchange_over_socket_wire():
     npz carries the int8 payload + scales (a registered pytree, so
     _pack_tree/_unpack_tree need no special casing), and both peers
     dequantize to identical trees."""
-    from dlrover_tpu.ops.quant import QuantizedArray, dequantize_tree, quantize_tree
-    from dlrover_tpu.parallel.local_sgd import socket_exchange
+    from dlrover_tpu.ops.quant import (
+        QuantizedArray,
+        dequantize_tree,
+        quantize_tree,
+    )
 
-    t0 = SocketTransport(0, {}, bind_host="127.0.0.1", token="t")
-    t1 = SocketTransport(1, {}, bind_host="127.0.0.1", token="t")
-    peers = {0: f"127.0.0.1:{t0.port}", 1: f"127.0.0.1:{t1.port}"}
-    t0.peers = dict(peers)
-    t1.peers = dict(peers)
     deltas = [
         {"w": jnp.full((8192,), 0.5), "small": jnp.ones((4,))},
         {"w": jnp.linspace(-1.0, 1.0, 8192), "small": jnp.zeros((4,))},
     ]
-    try:
-        out = [None, None]
-
-        def run(rank, t):
-            ex = socket_exchange(t)
-            out[rank] = ex(quantize_tree(deltas[rank], bits=8))
-
-        th = [
-            threading.Thread(target=run, args=(r, t))
-            for r, t in ((0, t0), (1, t1))
-        ]
-        for t in th:
-            t.start()
-        for t in th:
-            t.join()
-        for rank in (0, 1):
-            got = [dequantize_tree(t) for t in out[rank]]
-            # large leaf arrived quantized; small leaf exact
-            assert isinstance(out[rank][0]["w"], QuantizedArray)
-            np.testing.assert_allclose(
-                np.asarray(got[0]["w"]), 0.5, atol=0.01
-            )
-            np.testing.assert_allclose(
-                np.asarray(got[1]["w"]),
-                np.asarray(deltas[1]["w"]),
-                atol=0.01,
-            )
-            np.testing.assert_array_equal(
-                np.asarray(got[rank]["small"]),
-                np.asarray(deltas[rank]["small"]),
-            )
-    finally:
-        t0.close()
-        t1.close()
+    out = _socket_pair_exchange(
+        [quantize_tree(d, bits=8) for d in deltas]
+    )
+    for rank in (0, 1):
+        got = [dequantize_tree(t) for t in out[rank]]
+        # large leaf arrived quantized; small leaf exact
+        assert isinstance(out[rank][0]["w"], QuantizedArray)
+        np.testing.assert_allclose(np.asarray(got[0]["w"]), 0.5, atol=0.01)
+        np.testing.assert_allclose(
+            np.asarray(got[1]["w"]), np.asarray(deltas[1]["w"]), atol=0.01
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got[rank]["small"]),
+            np.asarray(deltas[rank]["small"]),
+        )

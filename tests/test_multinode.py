@@ -335,6 +335,47 @@ def test_world_grow_joins_mid_run(tmp_path):
         master.wait()
 
 
+def test_launcher_network_check_gates_training(tmp_path):
+    """--network-check end to end through the launcher CLI: the agent
+    runs the paired MXU/collective pre-check through its own rendezvous,
+    reports to the master, and only THEN spawns the worker (the
+    dlrover-run network-check semantic)."""
+    run_id = f"nc{os.getpid()}"
+    master, _mq, _ml, addr = _start_master(
+        run_id, argv_extra=("--num-workers", "1")
+    )
+    agent = None
+    try:
+        agent = _launch_agent(
+            run_id,
+            0,
+            addr,
+            (
+                "--steps", "3", "--batch", "4", "--seq", "32",
+                "--ckpt-dir", str(tmp_path / "ckpt"),
+            ),
+            agent_args=("--network-check",),
+            nnodes="1",
+        )
+        q = _drain(agent)
+        lines = []
+        _collect(
+            q, lines, until=lambda l: False, deadline=time.time() + 300
+        )
+        agent.wait(timeout=60)
+        out = "".join(lines)
+        assert agent.returncode == 0, out[-4000:]
+        # the check ran before training and passed
+        assert "node check" in out, out[-3000:]
+        assert "done at step 3" in out, out[-3000:]
+        assert out.index("node check") < out.index("done at step 3")
+        assert "worker succeeded" in out
+    finally:
+        _kill_tree(agent)
+        master.kill()
+        master.wait()
+
+
 def test_two_node_elastic_training(tmp_path):
     run_id = f"mn{os.getpid()}"
     master, _mq, _mlines, addr = _start_master(
