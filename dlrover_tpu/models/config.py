@@ -52,9 +52,13 @@ class ModelConfig:
     dtype: str = "bfloat16"          # activation/compute dtype
     param_dtype: str = "float32"
     # rematerialisation policy:
-    # none | full | dots_saveable | save_attn | offload_attn
-    # (offload_attn = save_attn with residuals in pinned host memory —
-    # reference: atorch selective_offloading_checkpoint.py)
+    # none | full | dots_saveable | save_attn | save_qkv |
+    # save_qkv_gate | save_dots | offload_attn
+    # (save_qkv/save_qkv_gate/save_dots = save_attn plus the qkv /
+    # qkv+gate / qkv+gate+up matmul outputs — graded memory/recompute
+    # tradeoffs between full and dots_saveable; offload_attn =
+    # save_attn with residuals in pinned host memory — reference:
+    # atorch selective_offloading_checkpoint.py)
     remat: str = "none"
     # MoE (0 = dense)
     n_experts: int = 0
@@ -84,6 +88,23 @@ class ModelConfig:
     # at; None = standard parametrization. When set, attention uses 1/d
     # scaling and tied logits get the 1/width_mult MuReadout multiplier.
     mup_base_width: Optional[int] = None
+    # fused lm-head cross-entropy (ops/fused_ce.py): chunk the vocab
+    # axis with online logsumexp so the [B*S, vocab] f32 logits tensor
+    # (~1 GiB at b8*s1024*v32k) never materializes. loss_fn falls back
+    # to the unfused path automatically when the vocab axis is
+    # tp-sharded (Megatron-style vocab parallelism splits the head
+    # weight across chips; the chunk scan would force a gather).
+    fused_ce: bool = True
+    ce_block_v: int = 4096           # vocab chunk width (128-multiple)
+    # fp8 GEMMs with delayed scaling in the MLP projections
+    # (ops/fp8.py): forward operands e4m3, gradients e5m2, per-tensor
+    # scales from rolling amax histories threaded through the train
+    # state (state["fp8"], updated via the state-on-cotangent
+    # convention). Numerics are identical on every backend (pre-fp8
+    # chips upcast the already-quantized values to bf16); the
+    # accelerate strategy enables it by default only where the MXU
+    # consumes fp8 natively (v6e+, device_context.fp8_supported).
+    fp8: bool = False
 
     def __post_init__(self):
         if self.moe_impl not in ("dense", "ragged"):
@@ -96,6 +117,13 @@ class ModelConfig:
                 f"moe_gating must be 'topk' or 'switch', got "
                 f"{self.moe_gating!r}"
             )
+        if self.remat not in (
+            "none", "full", "dots_saveable", "save_attn", "save_qkv",
+            "save_qkv_gate", "save_dots", "offload_attn",
+        ):
+            # a typo'd policy would silently train with NO remat and
+            # OOM configs that only fit WITH one — fail at build time
+            raise ValueError(f"unknown remat policy {self.remat!r}")
         for name in ("attn_block_q", "attn_block_k"):
             b = getattr(self, name)
             if b <= 0 or b % 128:

@@ -191,6 +191,15 @@ def _project_qkv(
     q = (x @ layer["attn"]["wq"].astype(x.dtype)).reshape(b, s, nh, hd)
     k = (x @ layer["attn"]["wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
     v = (x @ layer["attn"]["wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
+    # names for the selective remat policies (save_qkv / save_dots):
+    # identity outside jax.checkpoint, so the cache paths are
+    # unaffected. Tagged BEFORE rope: backward re-runs only the cheap
+    # trig mix, never the projections — and the tag stays off the
+    # attention input, whose `name` barrier XLA:CPU's thunk runtime
+    # answers with an unsupported BF16xBF16=F32 DotThunk.
+    q = jax.ad_checkpoint.checkpoint_name(q, "q_proj")
+    k = jax.ad_checkpoint.checkpoint_name(k, "k_proj")
+    v = jax.ad_checkpoint.checkpoint_name(v, "v_proj")
     if cfg.pos == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -230,14 +239,35 @@ def _attention_block(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
     return out @ layer["attn"]["wo"].astype(x.dtype)
 
 
-def _mlp_block(x, layer, cfg: ModelConfig, mesh):
+def _mlp_block(x, layer, cfg: ModelConfig, mesh, fp8=None):
     mlp = layer["mlp"]
+    if fp8 is not None:
+        # fp8 GEMMs with delayed scaling (cfg.fp8): fp8_dot's "grad"
+        # w.r.t. each state dict is the UPDATED amax history — the
+        # train step harvests it from the gradient tree (ops/fp8.py
+        # state-on-cotangent convention)
+        from dlrover_tpu.ops.fp8 import fp8_dot
+
+        if cfg.act == "swiglu":
+            gate = fp8_dot(x, mlp["w_gate"].astype(x.dtype), fp8["gate"])
+            up = fp8_dot(x, mlp["w_up"].astype(x.dtype), fp8["up"])
+            h = jax.nn.silu(gate) * up
+        else:
+            h = jax.nn.gelu(
+                fp8_dot(x, mlp["w_up"].astype(x.dtype), fp8["up"])
+            )
+        if mesh is not None:
+            h = shd.constrain(h, mesh, "batch", "seq", "mlp")
+        return fp8_dot(h, mlp["w_down"].astype(x.dtype), fp8["down"])
     if cfg.act == "swiglu":
         gate = x @ mlp["w_gate"].astype(x.dtype)
         up = x @ mlp["w_up"].astype(x.dtype)
+        gate = jax.ad_checkpoint.checkpoint_name(gate, "mlp_gate")
+        up = jax.ad_checkpoint.checkpoint_name(up, "mlp_up")
         h = jax.nn.silu(gate) * up
     else:
         h = jax.nn.gelu(x @ mlp["w_up"].astype(x.dtype))
+        h = jax.ad_checkpoint.checkpoint_name(h, "mlp_up")
     if mesh is not None:
         h = shd.constrain(h, mesh, "batch", "seq", "mlp")
     return h @ mlp["w_down"].astype(x.dtype)
@@ -252,6 +282,7 @@ def _layer_body(
     attn_fn,
     rng=None,
     tag_attn_out: bool = False,
+    fp8=None,
 ):
     ln1, ln2 = layer["ln1"], layer["ln2"]
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
@@ -279,7 +310,7 @@ def _layer_body(
             h2, layer["moe"], cfg, mesh, rng=rng, return_aux=True
         )
     else:
-        mlp_out = _mlp_block(h2, layer, cfg, mesh)
+        mlp_out = _mlp_block(h2, layer, cfg, mesh, fp8=fp8)
     x = x + attn + mlp_out if cfg.parallel_residual else x + mlp_out
     if mesh is not None:
         x = shd.constrain(x, mesh, "batch", "seq", None)
@@ -295,10 +326,16 @@ def run_trunk(
     attn_fn=None,
     rng: Optional[jax.Array] = None,
     tag_attn_out: bool = False,
+    fp8_layers=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run the stacked transformer layers: remat policy, pp pipelining,
     MoE aux-loss accumulation. Shared by the decoder and the ViT trunk
     (models/vision.py) so policies stay in one place.
+
+    ``fp8_layers``: stacked per-layer fp8 delayed-scaling states
+    (init_fp8_states; leading axis L) — scanned alongside the layer
+    params. Dense layers only; incompatible with pp (state threading
+    across stages is not wired).
 
     Returns (hidden states [B,S,D] — pre-final-norm, aux losses).
     """
@@ -322,6 +359,41 @@ def run_trunk(
             body,
             policy=cp.save_only_these_names(
                 "attn_out", "flash_out", "flash_lse"
+            ),
+        )
+    elif cfg.remat == "save_qkv":
+        # save_attn PLUS the post-rope q/k/v projections: backward skips
+        # the attention kernel re-run AND the qkv matmuls (~30% of the
+        # full-remat recompute flops) for ~130 MB/layer at b8·s1024 —
+        # the policy the fused-CE memory savings (ops/fused_ce.py) buy
+        body = jax.checkpoint(
+            body,
+            policy=cp.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse",
+                "q_proj", "k_proj", "v_proj",
+            ),
+        )
+    elif cfg.remat == "save_qkv_gate":
+        # save_qkv plus ONE of the two swiglu projections: ~half the
+        # extra footprint of save_dots for half its recompute savings —
+        # the largest policy that still fits 1.4B training on a 16 GiB
+        # chip (see bench.py)
+        body = jax.checkpoint(
+            body,
+            policy=cp.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse",
+                "q_proj", "k_proj", "v_proj", "mlp_gate",
+            ),
+        )
+    elif cfg.remat == "save_dots":
+        # save_qkv plus the swiglu gate/up projections: backward
+        # recomputes only norms/elementwise + the o/down matmuls —
+        # ~70% of the recompute flops gone for ~300 MB/layer
+        body = jax.checkpoint(
+            body,
+            policy=cp.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse",
+                "q_proj", "k_proj", "v_proj", "mlp_gate", "mlp_up",
             ),
         )
     elif cfg.remat == "offload_attn":
@@ -348,6 +420,10 @@ def run_trunk(
     }
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     v = max(1, getattr(cfg, "pp_interleave", 1))
+    if fp8_layers is not None and pp > 1:
+        raise ValueError(
+            "fp8 state threading is not wired through pipeline stages"
+        )
     if pp > 1:
         from dlrover_tpu.parallel.pipeline import pipeline_apply
 
@@ -387,17 +463,63 @@ def run_trunk(
             )
             layers = jax.tree.map(lambda t: jnp.take(t, perm, 0), layers)
 
-        def scan_fn(carry, inp):
-            layer, idx = inp
-            r = jax.random.fold_in(rng, idx) if rng is not None else None
-            out, aux = body(carry, layer, positions, rng=r)
-            return out, aux
+        if fp8_layers is not None:
 
-        x, auxs = jax.lax.scan(
-            scan_fn, x, (layers, jnp.arange(n_layers))
-        )
+            def scan_fn8(carry, inp):
+                layer, fp8, idx = inp
+                r = (
+                    jax.random.fold_in(rng, idx)
+                    if rng is not None
+                    else None
+                )
+                out, aux = body(carry, layer, positions, rng=r, fp8=fp8)
+                return out, aux
+
+            x, auxs = jax.lax.scan(
+                scan_fn8, x, (layers, fp8_layers, jnp.arange(n_layers))
+            )
+        else:
+
+            def scan_fn(carry, inp):
+                layer, idx = inp
+                r = (
+                    jax.random.fold_in(rng, idx)
+                    if rng is not None
+                    else None
+                )
+                out, aux = body(carry, layer, positions, rng=r)
+                return out, aux
+
+            x, auxs = jax.lax.scan(
+                scan_fn, x, (layers, jnp.arange(n_layers))
+            )
         aux = jax.tree.map(lambda a: a.sum(), auxs)
     return x, aux
+
+
+def init_fp8_states(cfg: ModelConfig):
+    """Stacked per-layer fp8 delayed-scaling states for the MLP GEMMs.
+
+    One {amax_x, amax_w, amax_g} history set per projection per layer
+    (leading axis L), matching run_trunk's scan. Lives in the train
+    state under ``state["fp8"]``; the step's gradient w.r.t. it IS the
+    updated state (ops/fp8.py convention). Reference:
+    atorch/auto/opt_lib/amp_optimization.py:197 (TE fp8 autocast).
+    """
+    if cfg.n_experts > 0:
+        raise ValueError("fp8 wiring covers dense MLP layers, not MoE")
+    from dlrover_tpu.ops.fp8 import init_fp8_state
+
+    names = ("gate", "up", "down") if cfg.act == "swiglu" else (
+        "up", "down"
+    )
+    one = init_fp8_state()
+    return {
+        name: jax.tree.map(
+            lambda h: jnp.tile(h[None], (cfg.n_layer, 1)), one
+        )
+        for name in names
+    }
 
 
 def forward(
@@ -411,6 +533,7 @@ def forward(
     return_aux: bool = False,
     features_only: bool = False,
     prefix_len: Optional[jax.Array] = None,
+    fp8_states=None,
 ):
     """tokens:[B,S] int32 → logits:[B,S,vocab] float32.
 
@@ -523,27 +646,39 @@ def forward(
         attn_fn=attn_fn,
         rng=rng,
         tag_attn_out=(attn_impl != "flash"),
+        fp8_layers=fp8_states,
     )
 
     fn = params["final_norm"]
     x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
     if features_only:
         return (x, aux) if return_aux else x
-    if cfg.tie_embeddings:
-        w_out = params["embed"]["tokens"].T
-    else:
-        w_out = params["lm_head"]["w"]
+    w_out, head_scale = head_weight_scale(params, cfg)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, w_out.astype(dt), preferred_element_type=jnp.float32
     )
-    if cfg.mup_base_width and cfg.tie_embeddings:
-        # MuReadout multiplier — ONLY for tied embeddings, where the
-        # readout weight is the (input-class) embedding and cannot carry
-        # the output-class init/lr scaling itself. An untied lm_head gets
-        # that scaling from rescale_init + mu_adam instead; giving it the
-        # multiplier too would doubly suppress the logits.
-        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    if head_scale != 1.0:
+        logits = logits * head_scale
     return (logits, aux) if return_aux else logits
+
+
+def head_weight_scale(params: Params, cfg: ModelConfig):
+    """(lm-head weight [D, V], static logit multiplier).
+
+    The muP MuReadout multiplier applies ONLY for tied embeddings, where
+    the readout weight is the (input-class) embedding and cannot carry
+    the output-class init/lr scaling itself. An untied lm_head gets that
+    scaling from rescale_init + mu_adam instead; giving it the
+    multiplier too would doubly suppress the logits.
+    """
+    if cfg.tie_embeddings:
+        w = params["embed"]["tokens"].T
+    else:
+        w = params["lm_head"]["w"]
+    scale = 1.0
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        scale = cfg.mup_base_width / cfg.d_model
+    return w, scale
 
 
 def loss_fn(
@@ -554,30 +689,59 @@ def loss_fn(
     z_loss: float = 0.0,
     attn_impl: str = "auto",
     rng: Optional[jax.Array] = None,
+    fp8_states=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S],
     optional "prefix_len": [B] (prefix-LM; mask usually zeroes the prefix
     targets so loss falls only on the causal tail)}."""
-    logits, moe_aux = forward(
-        params,
-        batch["tokens"],
-        cfg,
-        mesh=mesh,
-        attn_impl=attn_impl,
-        rng=rng,
-        return_aux=True,
-        prefix_len=batch.get("prefix_len"),
-    )
     targets = batch["targets"]
+    use_fused = cfg.fused_ce and not (
+        mesh is not None and mesh.shape.get("tp", 1) > 1
+    )
+    if use_fused:
+        from dlrover_tpu.ops.fused_ce import fused_linear_ce
+
+        feats, moe_aux = forward(
+            params,
+            batch["tokens"],
+            cfg,
+            mesh=mesh,
+            attn_impl=attn_impl,
+            rng=rng,
+            return_aux=True,
+            features_only=True,
+            prefix_len=batch.get("prefix_len"),
+            fp8_states=fp8_states,
+        )
+        w_out, head_scale = head_weight_scale(params, cfg)
+        bv = min(
+            cfg.ce_block_v, (cfg.vocab_size + 127) // 128 * 128
+        )
+        logz, tgt_logit, amax = fused_linear_ce(
+            feats, w_out, targets, head_scale, bv
+        )
+    else:
+        logits, moe_aux = forward(
+            params,
+            batch["tokens"],
+            cfg,
+            mesh=mesh,
+            attn_impl=attn_impl,
+            rng=rng,
+            return_aux=True,
+            prefix_len=batch.get("prefix_len"),
+            fp8_states=fp8_states,
+        )
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1
+        )[..., 0]
+        amax = jnp.argmax(logits, -1)
+
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones_like(targets, dtype=jnp.float32)
     mask = mask.astype(jnp.float32)
-
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    tgt_logit = jnp.take_along_axis(
-        logits, targets[..., None], axis=-1
-    )[..., 0]
     nll = (logz - tgt_logit) * mask
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = nll.sum() / denom
@@ -592,7 +756,7 @@ def loss_fn(
         loss = loss + lb + rz
         metrics["moe_lb_loss"] = lb
         metrics["moe_z_loss"] = rz
-    acc = (jnp.argmax(logits, -1) == targets).astype(jnp.float32) * mask
+    acc = (amax == targets).astype(jnp.float32) * mask
     metrics["accuracy"] = acc.sum() / denom
     return loss, metrics
 

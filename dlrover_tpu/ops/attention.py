@@ -55,9 +55,22 @@ def mha_reference(
         k = _repeat_kv(k, h // hkv)
         v = _repeat_kv(v, h // hkv)
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    )
+    if jax.default_backend() == "cpu":
+        # explicit f32 upcast rather than preferred_element_type:
+        # XLA:CPU's thunk runtime cannot execute a BF16xBF16=F32 dot
+        # when a `name` barrier (remat checkpoint tags upstream) keeps
+        # it from fusing the converts in; on CPU the extra precision is
+        # free. TPU keeps bf16 operands + f32 accumulate — the native
+        # MXU contract (this path serves prefill/generation there).
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+        )
+    else:
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
     logits = logits * scale
     if causal:
         q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
