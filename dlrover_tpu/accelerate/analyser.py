@@ -18,9 +18,24 @@ _DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
 
 # optimizer state slots per param (mu, nu for adam family)
 _OPT_SLOTS = {"adamw": 2, "adam": 2, "agd": 3, "sgd": 1, "lion": 1}
-# fraction of the host-offloaded moment tree budgeted device-resident
-# for in-flight streaming (see the comment at its use)
+# extra slack multiplier on the streamed-offload working-set bound
+# (transfer double-buffering of adjacent leaves in the chain)
+OFFLOAD_OPT_LEAF_SLACK = 2.0
+# legacy whole-tree offload (non-streaming optimizers): the transient
+# device working set is unbounded in principle; budget a conservative
+# half of the tree (pre-r3 behavior)
 OFFLOAD_OPT_WORKING_SET = 0.5
+
+
+def offload_streams(plan) -> bool:
+    """Whether this plan's offload takes the per-leaf streamed path
+    (train/optimizer.py streamed_offload_adamw) — must mirror
+    dry_runner.build_from_plan's gate."""
+    return (
+        plan.offload_opt_state
+        and plan.optimizer == "adamw"
+        and plan.optimizer_state_dtype is None
+    )
 
 
 @dataclass
@@ -81,14 +96,21 @@ def analyse(
         plan.optimizer_state_dtype or plan.param_dtype, pbytes
     )
     opt_b = n * slots * opt_dtype_b / param_shards
-    if plan.offload_opt_state:
-        # moments live in pinned host memory and stream through HBM
-        # around the update. NOTHING bounds the in-flight working set:
-        # XLA's memory-aware scheduler usually frees early leaves before
-        # late ones arrive, but it is not guaranteed, so budget a
-        # conservative half of the tree rather than a best-case sliver —
-        # and the measured search modes (dry_run) catch any remaining
-        # analytic optimism with a real step.
+    if offload_streams(plan):
+        # moments live in pinned host memory and the streamed update
+        # (train/optimizer.py streamed_offload_adamw) serializes the
+        # per-leaf transfers with optimization_barrier chaining, so the
+        # device-resident moment working set is bounded by the LARGEST
+        # LEAF's m+v (f32), not a fraction of the tree. Largest leaves:
+        # the embedding [vocab, d] and the stacked mlp [L, d, ff].
+        d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+        max_leaf = max(v * d, cfg.n_layer * d * f)
+        opt_b = (
+            OFFLOAD_OPT_LEAF_SLACK * slots * 4 * max_leaf / param_shards
+        )
+    elif plan.offload_opt_state:
+        # non-streaming optimizer on the legacy whole-tree path: no
+        # structural bound exists — keep the conservative budget
         opt_b *= OFFLOAD_OPT_WORKING_SET
     grad_b = n * pbytes / param_shards
 

@@ -53,10 +53,22 @@ def build_from_plan(cfg: ModelConfig, plan, devices=None):
         dtype=plan.compute_dtype,
         param_dtype=plan.param_dtype,
         remat=plan.remat,
+        fp8=plan.fp8,
+    )
+    # streamed offload (per-leaf HBM working set, see
+    # streamed_offload_adamw) replaces the legacy whole-tree
+    # device_put dance whenever the plan's optimizer supports it; the
+    # builder-level flag remains only for optimizers without a
+    # streaming implementation
+    streamed = (
+        plan.offload_opt_state
+        and plan.optimizer == "adamw"
+        and plan.optimizer_state_dtype is None
     )
     opt = make_optimizer(
         name=plan.optimizer,
         state_dtype=plan.optimizer_state_dtype,
+        offload_states=streamed,
     )
     attn_impl = plan.attn_impl
     if plan.sp_mode in ("ring", "ulysses") and plan.mesh.sp != 1:
@@ -67,7 +79,7 @@ def build_from_plan(cfg: ModelConfig, plan, devices=None):
         opt,
         grad_accum=plan.grad_accum,
         attn_impl=attn_impl,
-        offload_opt_state=plan.offload_opt_state,
+        offload_opt_state=plan.offload_opt_state and not streamed,
     )
     return mesh, builder, opt, batch_sharding(mesh), cfg
 

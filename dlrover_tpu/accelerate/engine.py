@@ -16,7 +16,6 @@ from typing import List, Optional, Tuple
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.models.config import ModelConfig
 from dlrover_tpu.accelerate.analyser import (
-    OFFLOAD_OPT_WORKING_SET,
     analyse,
     device_hbm_bytes,
 )
@@ -295,19 +294,23 @@ def search_strategy(
     feasible.sort(key=lambda t: -t[0])
 
     def _warn_if_unvalidated_offload(plan):
-        # analyse() budgets the offloaded moments' in-flight HBM working
-        # set at a flat OFFLOAD_OPT_WORKING_SET of the tree; nothing in
-        # the step bounds the true peak, so an analytically-feasible
-        # offload plan can still OOM at step time. Only an EXECUTED step
-        # validates it (mode='measure' or 'bo'; 'cost' compiles without
-        # running, so it cannot catch a runtime allocation peak).
-        if plan.offload_opt_state:
+        # analyse() budgets the offloaded moments' device working set at
+        # the largest-leaf bound the streamed update enforces
+        # (streamed_offload_adamw's barrier-serialized transfers). The
+        # bound is structural for the streamed adamw path; a measured
+        # step (mode='measure'/'bo') remains the ground truth for
+        # optimizers that still take the legacy whole-tree path.
+        if plan.offload_opt_state and (
+            plan.optimizer != "adamw"
+            or plan.optimizer_state_dtype is not None
+        ):
             logger.warning(
-                "selected offload_opt without a successfully measured "
-                "step (working-set factor %.2f is an assumption, not a "
-                "bound) — run mode='measure' or 'bo' to validate before "
+                "selected offload_opt with a non-streaming optimizer "
+                "(%s/%s): the whole-tree legacy path has no working-set "
+                "bound — run mode='measure' or 'bo' to validate before "
                 "training",
-                OFFLOAD_OPT_WORKING_SET,
+                plan.optimizer,
+                plan.optimizer_state_dtype,
             )
 
     if mode == "heuristic":

@@ -28,6 +28,279 @@ def warmup_cosine(
     )
 
 
+def build_schedule(
+    name: str,
+    peak_lr: float,
+    warmup_steps: int = 100,
+    decay_steps: int = 10000,
+    end_lr_ratio: float = 0.1,
+):
+    """Named LR schedules (reference: atorch_trainer's HF-style
+    lr_scheduler_type breadth — linear/cosine/constant/polynomial/
+    inverse_sqrt). Returns an optax schedule fn, or the constant
+    ``peak_lr`` for name="constant" without warmup."""
+    if name == "warmup_cosine":
+        return warmup_cosine(
+            peak_lr, warmup_steps, decay_steps, end_lr_ratio
+        )
+    if name == "warmup_linear":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, peak_lr, warmup_steps),
+                optax.linear_schedule(
+                    peak_lr, peak_lr * end_lr_ratio,
+                    max(1, decay_steps - warmup_steps),
+                ),
+            ],
+            [warmup_steps],
+        )
+    if name == "constant_with_warmup":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, peak_lr, warmup_steps),
+                optax.constant_schedule(peak_lr),
+            ],
+            [warmup_steps],
+        )
+    if name == "constant":
+        return peak_lr
+    if name == "polynomial":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, peak_lr, warmup_steps),
+                optax.polynomial_schedule(
+                    peak_lr, peak_lr * end_lr_ratio, power=2.0,
+                    transition_steps=max(1, decay_steps - warmup_steps),
+                ),
+            ],
+            [warmup_steps],
+        )
+    if name == "inverse_sqrt":
+        def sched(step):
+            import jax.numpy as _jnp
+
+            s = _jnp.maximum(step, 1)
+            warm = peak_lr * s / max(warmup_steps, 1)
+            decay = peak_lr * (max(warmup_steps, 1) / s) ** 0.5
+            return _jnp.where(s < warmup_steps, warm, decay)
+
+        return sched
+    raise ValueError(f"unknown schedule {name!r}")
+
+
+def factored_adamw(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    m_dtype=jnp.bfloat16,
+    min_factored_size: int = 128,
+) -> optax.GradientTransformation:
+    """AdamW momentum + Adafactor-style factored second moment.
+
+    For every matrix-shaped parameter the per-element variance nu is
+    replaced by its rank-1 nonnegative factorization (row means R and
+    column means C with v_hat = R*C / mean(R), exactly Adafactor's
+    estimator, Shazeer & Stern 2018); vectors/scalars keep exact nu.
+    First moment stays dense bf16 — this is the "Adafactor with
+    momentum" / CAME family that trained T5 and PaLM.
+
+    Why it exists here: on a 16 GiB v5e training 1.4B params, dense nu
+    costs 2.7 GiB of HBM and ~5.4 GiB of optimizer bandwidth per step.
+    Factoring frees both — the HBM buys the ``save_qkv_gate`` remat
+    tier (models/decoder.py), the bandwidth shortens the optimizer
+    phase outright. Reference capability analog: atorch low-bit states
+    (low_bit/functional.py) compress nu 4x; factoring compresses it
+    ~1000x with a weaker (but battle-tested) estimator.
+    """
+
+    def _lr(step):
+        return learning_rate(step) if callable(learning_rate) else learning_rate
+
+    def _factored(p) -> bool:
+        return (
+            p.ndim >= 2
+            and p.shape[-1] >= min_factored_size
+            and p.shape[-2] >= min_factored_size
+        )
+
+    def init_fn(params):
+        def m0(p):
+            return jnp.zeros_like(
+                p, m_dtype if p.ndim >= 1 else jnp.float32
+            )
+
+        def v0(p):
+            if _factored(p):
+                return {
+                    "r": jnp.zeros(p.shape[:-1], jnp.float32),
+                    "c": jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32),
+                }
+            return jnp.zeros_like(p, jnp.float32)
+
+        return {
+            "step": jnp.zeros([], jnp.int32),
+            "m": jax.tree.map(m0, params),
+            "v": jax.tree.map(v0, params),
+        }
+
+    def update_fn(updates, state, params=None):
+        if weight_decay and params is None:
+            raise ValueError("factored_adamw with weight_decay needs params")
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        bc1 = 1 - b1**t
+        bc2 = 1 - b2**t
+        # schedule parity with optax.scale_by_schedule: the lr for
+        # update t reads schedule(count BEFORE increment) — bias
+        # correction uses the incremented count
+        lr = _lr(state["step"])
+        p_tree = params if params is not None else updates
+
+        from dlrover_tpu.ops.quant import adamw_direction, adamw_m_ema
+
+        def leaf(g, m, v, p):
+            g32 = g.astype(jnp.float32)
+            m2 = adamw_m_ema(g32, m.astype(jnp.float32), b1)
+            g2 = g32 * g32
+            if isinstance(v, dict):
+                r2 = b2 * v["r"] + (1 - b2) * jnp.mean(g2, axis=-1)
+                c2 = b2 * v["c"] + (1 - b2) * jnp.mean(g2, axis=-2)
+                # v_hat = outer(r, c) / mean(r): exact when nu is rank-1
+                denom = jnp.maximum(jnp.mean(r2, axis=-1, keepdims=True),
+                                    1e-30)
+                vhat = (r2 / denom)[..., None] * c2[..., None, :]
+                new_v = {"r": r2, "c": c2}
+            else:
+                vhat = b2 * v + (1 - b2) * g2
+                new_v = vhat
+            upd = adamw_direction(
+                m2, vhat, bc1, bc2, eps, weight_decay,
+                p.astype(jnp.float32) if weight_decay else None,
+            )
+            return (-lr * upd).astype(g.dtype), m2.astype(m.dtype), new_v
+
+        # the v tree nests {"r","c"} dicts below the grads' leaf
+        # positions — flatten_up_to collapses them back to one entry per
+        # grad leaf so the trees zip despite the ragged structure
+        gdef = jax.tree.structure(updates)
+        g_leaves = gdef.flatten_up_to(updates)
+        m_leaves = gdef.flatten_up_to(state["m"])
+        v_leaves = gdef.flatten_up_to(state["v"])
+        p_leaves = gdef.flatten_up_to(p_tree)
+        out = [
+            leaf(g, m, v, p)
+            for g, m, v, p in zip(g_leaves, m_leaves, v_leaves, p_leaves)
+        ]
+        return (
+            jax.tree.unflatten(gdef, [o[0] for o in out]),
+            {
+                "step": step,
+                "m": jax.tree.unflatten(gdef, [o[1] for o in out]),
+                "v": jax.tree.unflatten(gdef, [o[2] for o in out]),
+            },
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def streamed_offload_adamw(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """AdamW whose moments live in pinned host memory, streamed per leaf.
+
+    The legacy offload path (TrainStepBuilder.offload_opt_state) moves
+    the WHOLE moment tree HBM-ward before the update — a transient
+    device working set of 2x param bytes, exactly the peak offload
+    exists to avoid (ADVICE r1 #1 / VERDICT r2 #8). Here the update
+    walks the leaves in a serialized chain: each leaf's host->device
+    transfer is data-dependent (via lax.optimization_barrier) on the
+    previous leaf's computed update, so XLA cannot hoist the transfers
+    together and the device-resident moment working set is bounded by
+    the LARGEST LEAF (m+v), not the tree. accelerate/analyser.py models
+    this bound for the `offload_opt` strategy tier.
+
+    Drop-in for optax.adamw inside a chain (grad clipping composes in
+    front). Moments are placed on host inside update_fn; pair with
+    ``init_train_state(offload_opt_state=True)`` so they are BORN on
+    host too. Reference capability: atorch's CPU-offload Adam
+    (SURVEY §2.3 optimizers).
+    """
+    from dlrover_tpu.ops.quant import adamw_direction, adamw_moments
+
+    _host = jax.memory.Space.Host
+    _dev = jax.memory.Space.Device
+
+    def _lr(step):
+        return learning_rate(step) if callable(learning_rate) else learning_rate
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+        return {
+            "step": jnp.zeros([], jnp.int32),
+            "m": jax.tree.map(zeros, params),
+            "v": jax.tree.map(zeros, params),
+        }
+
+    def update_fn(updates, state, params=None):
+        if weight_decay and params is None:
+            raise ValueError(
+                "streamed_offload_adamw with weight_decay needs params"
+            )
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        bc1 = 1 - b1**t
+        bc2 = 1 - b2**t
+        # schedule parity with optax.scale_by_schedule: the lr for
+        # update t reads schedule(count BEFORE increment) — bias
+        # correction uses the incremented count
+        lr = _lr(state["step"])
+        p_tree = params if params is not None else updates
+
+        gdef = jax.tree.structure(updates)
+        g_leaves = jax.tree.leaves(updates)
+        m_leaves = gdef.flatten_up_to(state["m"])
+        v_leaves = gdef.flatten_up_to(state["v"])
+        p_leaves = gdef.flatten_up_to(p_tree)
+
+        token = step.astype(jnp.float32)
+        out_u, out_m, out_v = [], [], []
+        for g, m_h, v_h, p in zip(g_leaves, m_leaves, v_leaves, p_leaves):
+            # serialize THE TRANSFER: the host values only become
+            # consumable after the previous leaf's token, so the
+            # host->device copy cannot be hoisted to the front
+            m_h, v_h, tok = jax.lax.optimization_barrier(
+                (m_h, v_h, token)
+            )
+            m32 = jax.device_put(m_h, _dev)
+            v32 = jax.device_put(v_h, _dev)
+            g32 = g.astype(jnp.float32)
+            m2, v2 = adamw_moments(g32, m32, v32, b1, b2)
+            upd = adamw_direction(
+                m2, v2, bc1, bc2, eps, weight_decay,
+                p.astype(jnp.float32) if weight_decay else None,
+            )
+            out_u.append((-lr * upd).astype(g.dtype))
+            out_m.append(jax.device_put(m2, _host))
+            out_v.append(jax.device_put(v2, _host))
+            token = m2.ravel()[0] + tok * 0
+        return (
+            jax.tree.unflatten(gdef, out_u),
+            {
+                "step": step,
+                "m": jax.tree.unflatten(gdef, out_m),
+                "v": jax.tree.unflatten(gdef, out_v),
+            },
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def agd(
     learning_rate,
     b1: float = 0.9,
@@ -160,21 +433,72 @@ def make_optimizer(
     decay_steps: int = 100000,
     schedule: str = "warmup_cosine",
     state_dtype: Optional[str] = None,
+    offload_states: bool = False,
 ) -> optax.GradientTransformation:
     """Build the training optimizer.
 
     ``state_dtype="bfloat16"`` keeps first/second moments in bf16
     (reference: atorch BF16Optimizer); ``"int8"`` uses the block-quantized
-    states from ``ops/quant.py`` (reference: low_bit/functional.py).
+    states from ``ops/quant.py`` (reference: low_bit/functional.py);
+    ``"mixed8"`` keeps bf16 momentum with int8 variance; ``"factored"``
+    keeps bf16 momentum with an Adafactor-factored variance.
+    ``offload_states=True`` (adamw only) keeps f32 moments in pinned
+    host memory, streamed through HBM one leaf at a time
+    (streamed_offload_adamw) — pair with
+    ``init_train_state(offload_opt_state=True)``.
     """
-    if schedule == "warmup_cosine":
-        lr = warmup_cosine(learning_rate, warmup_steps, decay_steps)
-    else:
+    if schedule == "none":
         lr = learning_rate
+    else:
+        lr = build_schedule(
+            schedule, learning_rate, warmup_steps, decay_steps
+        )
 
     chain = []
     if grad_clip and grad_clip > 0:
         chain.append(optax.clip_by_global_norm(grad_clip))
+
+    if offload_states:
+        if name != "adamw" or state_dtype is not None:
+            raise ValueError(
+                "offload_states streaming is implemented for plain adamw "
+                "(f32 host moments); got name="
+                f"{name!r} state_dtype={state_dtype!r}"
+            )
+        chain.append(
+            streamed_offload_adamw(
+                lr, b1=b1, b2=b2, weight_decay=weight_decay
+            )
+        )
+        return optax.chain(*chain)
+
+    if name == "adamw" and state_dtype == "factored":
+        # Adafactor-factored nu + bf16 momentum (see factored_adamw):
+        # ~2.7 GiB of HBM and ~5 GiB/step of bandwidth back at 1.4B
+        chain.append(
+            factored_adamw(
+                lr, b1=b1, b2=b2, weight_decay=weight_decay
+            )
+        )
+        return optax.chain(*chain)
+
+    if name == "adamw" and state_dtype in ("mixed8", "mixed4"):
+        # bf16 momentum + int8/int4 blockwise variance: frees ~75% of
+        # nu's HBM with Adafactor-grade variance fidelity; cheaper per
+        # step than bf16 nu (less optimizer bandwidth). The bench's
+        # save_qkv_gate remat tier exists because of this headroom.
+        from dlrover_tpu.ops.quant import mixed_adamw
+
+        chain.append(
+            mixed_adamw(
+                lr,
+                b1=b1,
+                b2=b2,
+                weight_decay=weight_decay,
+                v_bits=8 if state_dtype == "mixed8" else 4,
+            )
+        )
+        return optax.chain(*chain)
 
     if name == "adamw" and state_dtype in ("int8", "int4"):
         # fused streaming path: chunked dequant-update-requant keeps the

@@ -149,11 +149,15 @@ def init_train_state(
         )
         opt_state = optimizer.init(params)
         opt_state = _constrain_like_params(opt_state, params)
-        return {
+        state = {
             "params": params,
             "opt_state": opt_state,
             "step": jnp.zeros([], jnp.int32),
         }
+        if cfg.fp8:
+            # fp8 delayed-scaling amax histories: tiny, replicated
+            state["fp8"] = decoder.init_fp8_states(cfg)
+        return state
 
     if not (offload_opt_state and jax.default_backend() != "cpu"):
         return jax.jit(f)(rng)
@@ -180,11 +184,14 @@ def init_train_state(
         opt_shape, params, param_shardings, mesh
     )
     opt_state = jax.jit(f_opt, out_shardings=out_sh)(params)
-    return {
+    state = {
         "params": params,
         "opt_state": opt_state,
         "step": jnp.zeros([], jnp.int32),
     }
+    if cfg.fp8:
+        state["fp8"] = jax.jit(lambda: decoder.init_fp8_states(cfg))()
+    return state
 
 
 class TrainStepBuilder:
@@ -216,42 +223,60 @@ class TrainStepBuilder:
             and cfg.moe_gating == "switch"
             and cfg.moe_jitter > 0.0
         )
+        if cfg.fp8 and loss_fn is not None:
+            raise ValueError(
+                "cfg.fp8 threads fp8_states through the built-in "
+                "loss_fn; a custom loss_fn cannot receive them"
+            )
         self._loss_fn = loss_fn or functools.partial(
             decoder.loss_fn, cfg=cfg, mesh=mesh, attn_impl=attn_impl
         )
 
-    def _grads(self, params, batch, rng=None):
+    def _grads(self, params, batch, rng=None, fp8=None):
         if self._needs_rng and rng is not None:
             loss_fn = functools.partial(self._loss_fn, rng=rng)
         else:
             loss_fn = self._loss_fn
+        if fp8 is not None:
+            # differentiate w.r.t. the fp8 state too: its "gradient" IS
+            # the updated delayed-scaling state (ops/fp8.py convention)
+            grad_fn = jax.value_and_grad(
+                lambda p, f8: loss_fn(p, batch, fp8_states=f8),
+                argnums=(0, 1),
+                has_aux=True,
+            )
+            (loss, metrics), (grads, new_fp8) = grad_fn(params, fp8)
+            return loss, metrics, grads, new_fp8
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (loss, metrics), grads = grad_fn(params, batch)
-        return loss, metrics, grads
+        return loss, metrics, grads, None
 
-    def _accumulated_grads(self, params, batch, rng=None):
-        """Microbatch scan: batch leading dim is [accum, micro_b, ...]."""
+    def _accumulated_grads(self, params, batch, rng=None, fp8=None):
+        """Microbatch scan: batch leading dim is [accum, micro_b, ...].
+
+        The fp8 state (when present) threads through the scan carry so
+        each microbatch's amax observations roll into the next."""
         a = self.grad_accum
 
         def micro(carry, inp):
             mb, idx = inp
-            g_acc, loss_acc = carry
+            g_acc, loss_acc, f8 = carry
             r = jax.random.fold_in(rng, idx) if rng is not None else None
-            loss, _, g = self._grads(params, mb, rng=r)
+            loss, _, g, new_f8 = self._grads(params, mb, rng=r, fp8=f8)
             g_acc = jax.tree.map(jnp.add, g_acc, g)
-            return (g_acc, loss_acc + loss), None
+            return (g_acc, loss_acc + loss, new_f8), None
 
         zeros = jax.tree.map(jnp.zeros_like, params)
         mb_batch = jax.tree.map(
             lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]), batch
         )
-        (grads, loss), _ = jax.lax.scan(
+        (grads, loss, new_fp8), _ = jax.lax.scan(
             micro,
-            (zeros, jnp.zeros([], jnp.float32)),
+            (zeros, jnp.zeros([], jnp.float32), fp8),
             (mb_batch, jnp.arange(a)),
         )
         grads = jax.tree.map(lambda g: g / a, grads)
-        return loss / a, {"loss": loss / a}, grads
+        return loss / a, {"loss": loss / a}, grads, new_fp8
 
     def step_fn(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
         batch = jax.tree.map(
@@ -267,13 +292,14 @@ class TrainStepBuilder:
             # deterministic per-step jitter key: same across hosts (SPMD
             # lockstep), different every step
             rng = jax.random.fold_in(jax.random.key(17), state["step"])
+        fp8 = state.get("fp8")
         if self.grad_accum > 1:
-            loss, metrics, grads = self._accumulated_grads(
-                state["params"], batch, rng=rng
+            loss, metrics, grads, new_fp8 = self._accumulated_grads(
+                state["params"], batch, rng=rng, fp8=fp8
             )
         else:
-            loss, metrics, grads = self._grads(
-                state["params"], batch, rng=rng
+            loss, metrics, grads, new_fp8 = self._grads(
+                state["params"], batch, rng=rng, fp8=fp8
             )
         opt_state = state["opt_state"]
         if self.offload_opt_state:
@@ -293,6 +319,8 @@ class TrainStepBuilder:
             "opt_state": new_opt,
             "step": state["step"] + 1,
         }
+        if new_fp8 is not None:
+            new_state["fp8"] = new_fp8
         return new_state, metrics
 
     def build(self) -> Callable:
