@@ -1,0 +1,143 @@
+"""fp8 model-graph wiring (VERDICT r2 #4).
+
+ops/fp8.py's delayed-scaling GEMM threaded through the decoder MLPs and
+the train step: the fp8 state lives in ``state["fp8"]``, updates ride
+the gradient of the fp8 inputs (state-on-cotangent), and — because
+pre-fp8 backends upcast the ALREADY-QUANTIZED values — CPU runs the
+same numerics v6e+ would, so the wiring + convergence are testable
+here; only the speed claim needs hardware. Reference:
+atorch/auto/opt_lib/amp_optimization.py:197 (TE fp8 autocast).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import decoder, get_config
+from dlrover_tpu.parallel import MeshConfig, build_mesh
+from dlrover_tpu.train import (
+    TrainStepBuilder,
+    init_train_state,
+    make_optimizer,
+)
+from dlrover_tpu.train.train_step import batch_sharding
+
+
+def _cfg(fp8: bool):
+    return get_config(
+        "tiny", n_layer=2, d_model=64, d_ff=128, n_head=4,
+        vocab_size=128, max_seq=32, fp8=fp8,
+    )
+
+
+def _batch(key, batch=8, seq=32):
+    base = jax.random.randint(key, (batch, seq + 1), 0, 8)
+    return {
+        "tokens": base[:, :-1].astype(jnp.int32),
+        "targets": base[:, 1:].astype(jnp.int32),
+    }
+
+
+def test_fp8_state_updates_and_loss_tracks_bf16():
+    """Training the tiny flagship with fp8 on: the delayed-scaling
+    histories roll every step, and the loss curve tracks the bf16 run
+    within tolerance (same quantized numerics the v6e MXU would see)."""
+    mesh = build_mesh(MeshConfig(dp=-1))
+    batch = jax.device_put(_batch(jax.random.key(1)), batch_sharding(mesh))
+    losses = {}
+    for fp8 in (False, True):
+        cfg = _cfg(fp8)
+        opt = make_optimizer(
+            learning_rate=3e-3, warmup_steps=2, decay_steps=200
+        )
+        state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+        if fp8:
+            assert "fp8" in state
+            before = np.asarray(
+                jax.tree.leaves(state["fp8"])[0]
+            ).copy()
+        step = TrainStepBuilder(cfg, mesh, opt).build()
+        curve = []
+        for _ in range(25):
+            state, metrics = step(state, batch)
+            curve.append(float(metrics["loss"]))
+        losses[fp8] = curve
+        if fp8:
+            after = np.asarray(jax.tree.leaves(state["fp8"])[0])
+            assert not np.allclose(before, after), (
+                "fp8 amax histories never updated"
+            )
+    # both train; fp8 tracks bf16 (quantization noise bounded)
+    assert losses[True][-1] < losses[True][0] * 0.7
+    np.testing.assert_allclose(
+        losses[True][-1], losses[False][-1], rtol=0.15
+    )
+
+
+def test_fp8_with_grad_accum_threads_state():
+    """The microbatch scan must roll the fp8 state across microbatches
+    (amax from micro i visible to micro i+1's scales next step)."""
+    mesh = build_mesh(MeshConfig(dp=-1))
+    cfg = _cfg(True)
+    opt = make_optimizer(learning_rate=1e-3, warmup_steps=2,
+                         decay_steps=100)
+    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+    step = TrainStepBuilder(cfg, mesh, opt, grad_accum=2).build()
+    batch = jax.device_put(
+        _batch(jax.random.key(2), batch=8), batch_sharding(mesh)
+    )
+    before = np.asarray(jax.tree.leaves(state["fp8"])[0]).copy()
+    state, metrics = step(state, batch)
+    after = np.asarray(jax.tree.leaves(state["fp8"])[0])
+    assert np.isfinite(float(metrics["loss"]))
+    assert not np.allclose(before, after)
+
+
+def test_fp8_composes_with_remat():
+    cfg = dataclasses.replace(_cfg(True), remat="full")
+    mesh = build_mesh(MeshConfig(dp=-1))
+    opt = make_optimizer(learning_rate=1e-3, warmup_steps=2,
+                         decay_steps=100)
+    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+    step = TrainStepBuilder(cfg, mesh, opt).build()
+    batch = jax.device_put(_batch(jax.random.key(3)), batch_sharding(mesh))
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_fp8_rejects_unsupported_combos():
+    with pytest.raises(ValueError, match="MoE"):
+        decoder.init_fp8_states(
+            get_config("tiny-moe", n_layer=2, d_model=64, d_ff=128,
+                       n_head=4, vocab_size=128, max_seq=32)
+        )
+    mesh = build_mesh(MeshConfig(dp=-1))
+    cfg = _cfg(True)
+    opt = make_optimizer(learning_rate=1e-3)
+    with pytest.raises(ValueError, match="custom loss_fn"):
+        TrainStepBuilder(
+            cfg, mesh, opt, loss_fn=lambda p, b: (0.0, {})
+        )
+
+
+def test_fp8_strategy_force_applies_to_config():
+    """auto_accelerate path: the fp8 strategy entry (forced off-v6e)
+    lands in the built model config."""
+    from dlrover_tpu.accelerate.dry_runner import build_from_plan
+    from dlrover_tpu.accelerate.strategy import apply_strategy
+
+    plan = apply_strategy(
+        [
+            ("mixed_parallel",
+             {"dp": 1, "fsdp": 1, "tp": 1, "sp": 1, "pp": 1}),
+            ("fp8", {"force": True}),
+        ]
+    )
+    cfg = _cfg(False)
+    _, builder, _, _, cfg2 = build_from_plan(
+        cfg, plan, devices=jax.devices()[:1]
+    )
+    assert cfg2.fp8 is True
