@@ -11,7 +11,7 @@ global-step reports to the elastic master when one is present.
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +22,12 @@ from dlrover_tpu.models.config import ModelConfig
 from dlrover_tpu.observability.loss_spike import LossSpikeDetector
 from dlrover_tpu.observability.profiler import StepTimer
 from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.train.callbacks import (
+    Callback,
+    CallbackList,
+    LossSpikeCallback,
+    TrainerControl,
+)
 from dlrover_tpu.train.train_step import (
     TrainStepBuilder,
     batch_sharding,
@@ -45,10 +51,19 @@ class TrainerArgs:
     eval_steps: int = 8
     seed: int = 0
     resume: bool = True
+    # resume from this exact committed step instead of the latest
+    # (reference: atorch_trainer's resume_from_checkpoint semantics)
+    resume_from_step: Optional[int] = None
     grad_accum: int = 1
     attn_impl: str = "auto"
     detect_loss_spikes: bool = True
     report_to_master: bool = True
+    # run a final evaluation when the loop exits (even without cadence)
+    eval_at_end: bool = False
+    # sample one step under jax.profiler.trace every N steps and parse
+    # the per-op runtime breakdown (observability/runtime_timer.py —
+    # the xpu_timer analog); 0 = off
+    profile_interval: int = 0
 
 
 class Trainer:
@@ -69,6 +84,7 @@ class Trainer:
         master_client=None,
         loss_fn: Optional[Callable] = None,
         rules=None,
+        callbacks: Optional[List[Callback]] = None,
     ):
         self.cfg = cfg
         self.args = args
@@ -103,6 +119,22 @@ class Trainer:
             else None
         )
         self._ckpt = None
+        self.runtime_timer = None
+        if args.profile_interval:
+            from dlrover_tpu.observability.runtime_timer import (
+                RuntimeKernelTimer,
+            )
+
+            self.runtime_timer = RuntimeKernelTimer(
+                interval_steps=args.profile_interval
+            )
+        self.control = TrainerControl()
+        self.callbacks = CallbackList(callbacks)
+        if self.spike_detector is not None:
+            self.callbacks.add(LossSpikeCallback(self.spike_detector))
+
+    def add_callback(self, cb: Callback):
+        self.callbacks.add(cb)
 
     # ---- checkpointing ---------------------------------------------------
 
@@ -132,6 +164,7 @@ class Trainer:
         restored = self.checkpointer.load_checkpoint(
             state_template(self.state),
             shardings=jax.tree.map(lambda x: x.sharding, self.state),
+            step=self.args.resume_from_step,
         )
         if restored is not None:
             self.state = restored
@@ -146,9 +179,12 @@ class Trainer:
         if self._step_fn is None:
             self._step_fn = self._builder.build()
         start = int(self.state["step"])
+        control = self.control
+        self.callbacks.fire("on_train_begin", self, control)
         window_loss = 0.0
         window_n = 0
         last_saved = -1
+        last_evaled = -1
         t_log = time.perf_counter()
         for step in range(start + 1, args.max_steps + 1):
             try:
@@ -158,21 +194,37 @@ class Trainer:
                 break
             batch = jax.device_put(batch, self._batch_sharding)
             self.timer.start()
-            self.state, metrics = self._step_fn(self.state, batch)
+            if self.runtime_timer is not None:
+                self.state, metrics = self.runtime_timer.profiled_call(
+                    step, self._step_fn, self.state, batch
+                )
+            else:
+                self.state, metrics = self._step_fn(self.state, batch)
             self.timer.stop(outputs=metrics["loss"])
             loss = float(metrics["loss"])
             window_loss += loss
             window_n += 1
-            if self.spike_detector is not None:
-                self.spike_detector.update(step, loss)
-            if args.log_interval and step % args.log_interval == 0:
+            self.callbacks.fire(
+                "on_step_end", self, step, {"loss": loss}, control
+            )
+            if control.should_log or (
+                args.log_interval and step % args.log_interval == 0
+            ):
                 dt = time.perf_counter() - t_log
                 t_log = time.perf_counter()
+                logs = {
+                    "loss": window_loss / max(window_n, 1),
+                    "steps_per_s": window_n / max(dt, 1e-9),
+                }
+                self.callbacks.fire("on_log", self, step, logs, control)
                 logger.info(
-                    "step %d | loss %.4f | %.2f steps/s",
+                    "step %d | loss %.4f | %.2f steps/s%s",
                     step,
-                    window_loss / max(window_n, 1),
-                    window_n / max(dt, 1e-9),
+                    logs["loss"],
+                    logs["steps_per_s"],
+                    " | lr %.3e" % logs["learning_rate"]
+                    if "learning_rate" in logs
+                    else "",
                 )
                 window_loss, window_n = 0.0, 0
             if self.client is not None and args.report_to_master:
@@ -191,24 +243,50 @@ class Trainer:
                 self.checkpointer.save_checkpoint(
                     step, self.state, storage_type=StorageType.MEMORY
                 )
-            if args.save_interval and step % args.save_interval == 0:
+            if control.should_save or (
+                args.save_interval and step % args.save_interval == 0
+            ):
                 self.checkpointer.save_checkpoint(step, self.state)
                 last_saved = step
-            if args.eval_interval and step % args.eval_interval == 0:
+                self.callbacks.fire("on_save", self, step, control)
+            if control.should_eval or (
+                args.eval_interval and step % args.eval_interval == 0
+            ):
                 eval_metrics = self.evaluate()
+                last_evaled = step
                 if eval_metrics:
                     logger.info(
                         "eval @ step %d | loss %.4f",
                         step,
                         eval_metrics["loss"],
                     )
+                    self.callbacks.fire(
+                        "on_eval", self, step, eval_metrics, control
+                    )
+            control.reset_step_flags()
+            if control.should_stop:
+                logger.info("training stopped by callback at step %d", step)
+                break
+        if args.eval_at_end and int(self.state["step"]) != last_evaled:
+            eval_metrics = self.evaluate()
+            if eval_metrics:
+                self.callbacks.fire(
+                    "on_eval", self, int(self.state["step"]),
+                    eval_metrics, control,
+                )
         # final checkpoint so a clean exit is always resumable (skipped
-        # when the loop's cadence already saved this exact step)
+        # when the loop's cadence already saved this exact step). Any
+        # save at all — including callback-forced ones with
+        # save_interval=0 — must be awaited before returning, or the
+        # process can exit mid-persist.
         if args.save_interval:
             final_step = int(self.state["step"])
             if final_step != last_saved:
                 self.checkpointer.save_checkpoint(final_step, self.state)
+                last_saved = final_step
+        if last_saved >= 0:
             self.checkpointer.wait_for_persist()
+        self.callbacks.fire("on_train_end", self, control)
         return self.state
 
     def evaluate(self) -> Dict[str, float]:

@@ -179,3 +179,70 @@ def test_metrics_export_http():
         assert doc["records"][-1]["speed_steps_per_s"] == 2.5
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# runtime kernel timing (xpu_timer analog: periodic trace sampling)
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_timer_samples_real_op_breakdown(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.observability.runtime_timer import RuntimeKernelTimer
+
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: jnp.tanh(a @ a) @ a)
+    f(x)  # compile outside the trace
+    timer = RuntimeKernelTimer(interval_steps=3, top_k=8)
+    # step 1, 2: plain calls; step 3: sampled
+    for step in (1, 2):
+        timer.profiled_call(step, f, x)
+        assert timer.sampled_at == -1
+    timer.profiled_call(3, f, x)
+    assert timer.sampled_at == 3
+    bd = timer.breakdown
+    assert bd, "no ops parsed from the trace"
+    names = " ".join(o.name for o in bd)
+    assert "dot" in names  # the matmuls dominate
+    # fractions normalize, python-frame noise filtered out
+    assert abs(sum(o.fraction for o in bd) - 1.0) < 1e-6 or len(bd) == 8
+    assert not any("$" in o.name or "/" in o.name for o in bd)
+    text = timer.prometheus_text()
+    assert "dlrover_tpu_kernel_time_us" in text and 'op="' in text
+
+
+def test_runtime_timer_in_trainer(tmp_path):
+    """profile_interval wires the timer around the live train step."""
+    import numpy as np
+
+    from dlrover_tpu.models import get_config
+    from dlrover_tpu.parallel import MeshConfig, build_mesh
+    from dlrover_tpu.train import Trainer, TrainerArgs, make_optimizer
+
+    def data():
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        while True:
+            base = rng.randint(0, 8, size=(8, 33))
+            yield {
+                "tokens": jnp.asarray(base[:, :-1], jnp.int32),
+                "targets": jnp.asarray(base[:, 1:], jnp.int32),
+            }
+
+    cfg = get_config("tiny", n_layer=2, d_model=64, d_ff=128, n_head=4,
+                     vocab_size=128, max_seq=32)
+    args = TrainerArgs(
+        output_dir=str(tmp_path), max_steps=4, log_interval=0,
+        save_interval=0, report_to_master=False,
+        detect_loss_spikes=False, profile_interval=2,
+    )
+    opt = make_optimizer(learning_rate=1e-3, warmup_steps=2,
+                         decay_steps=50)
+    tr = Trainer(cfg, args, data(), opt,
+                 mesh=build_mesh(MeshConfig(dp=-1)))
+    tr.train()
+    assert tr.runtime_timer.sampled_at in (2, 4)
+    assert tr.runtime_timer.breakdown
