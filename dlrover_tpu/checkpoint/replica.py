@@ -72,15 +72,9 @@ def _send_frame(sock: socket.socket, header: Dict, payload=None):
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], min(n - got, _CHUNK))
-        if r == 0:
-            raise ConnectionError("peer closed mid-frame")
-        got += r
-    return buf
+    from dlrover_tpu.common.sockets import recv_exact
+
+    return recv_exact(sock, n)
 
 
 def _recv_header(sock: socket.socket) -> Dict:

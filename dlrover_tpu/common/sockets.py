@@ -1,0 +1,41 @@
+"""Shared low-level socket helpers for the framed-TCP services.
+
+One copy of the exact-read loop used by every data-plane protocol in
+the codebase (checkpoint/replica.py ring backup, data/coworker.py batch
+ingress, sparse/server.py KV serving) — recv_into over a memoryview in
+bounded chunks, with an explicit cap so a desynced or hostile peer
+cannot make us allocate an attacker-chosen buffer.
+"""
+
+import socket
+from typing import Optional
+
+_CHUNK = 1 << 20
+
+# Nothing in the framework legitimately frames more than a checkpoint
+# shard chunk; anything larger is a desynced stream or garbage.
+MAX_FRAME_BYTES = 1 << 31
+
+
+def recv_exact(
+    sock: socket.socket,
+    n: int,
+    max_bytes: Optional[int] = MAX_FRAME_BYTES,
+) -> bytearray:
+    """Read exactly ``n`` bytes or raise ConnectionError.
+
+    An out-of-range ``n`` (negative, or past ``max_bytes``) raises
+    ConnectionError too: a length field that absurd means the stream is
+    desynced — treat it as a dead peer, never as an allocation request.
+    """
+    if n < 0 or (max_bytes is not None and n > max_bytes):
+        raise ConnectionError(f"invalid frame length {n}")
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(n - got, _CHUNK))
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return buf

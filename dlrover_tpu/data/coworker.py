@@ -79,7 +79,10 @@ class BatchRing:
     # ---- producer side ---------------------------------------------------
 
     def put(self, batch: Dict[str, np.ndarray], timeout: float = 60.0):
-        raw = _pack(batch)
+        self.put_bytes(_pack(batch), timeout=timeout)
+
+    def put_bytes(self, raw: bytes, timeout: float = 60.0):
+        """Deposit an already-packed batch (the TCP ingress path)."""
         if len(raw) > self.slot_bytes:
             raise ValueError(
                 f"batch packs to {len(raw)} bytes > slot_bytes="
@@ -165,18 +168,36 @@ class CoworkerPool:
 
     def __init__(
         self,
-        producer_fn: Callable[[int, int], Iterator[Dict]],
+        producer_fn: Optional[Callable[[int, int], Iterator[Dict]]] = None,
         num_workers: int = 2,
         slots: int = 8,
         slot_bytes: int = 16 << 20,
         name: str = "coworker",
+        remote_producers: int = 0,
+        listen: bool = False,
+        listen_host: str = "0.0.0.0",
+        listen_port: int = 0,
     ):
+        """``remote_producers``/``listen``: accept that many producers
+        from other hosts over TCP (each sends one done marker, exactly
+        like a local producer). ``producer_fn=None`` with ``listen=True``
+        runs fully network-fed (num_workers is forced to 0)."""
+        if producer_fn is None:
+            num_workers = 0
+        if remote_producers and not listen:
+            raise ValueError("remote_producers > 0 requires listen=True")
         self.producer_fn = producer_fn
         self.num_workers = num_workers
+        self.remote_producers = remote_producers
         self.name = name
         self.ring = BatchRing(
             name, slots=slots, slot_bytes=slot_bytes, create=True
         )
+        self.feed_server: Optional["BatchFeedServer"] = None
+        if listen:
+            self.feed_server = BatchFeedServer(
+                self.ring, host=listen_host, port=listen_port
+            )
         self._procs: List[mp.Process] = []
 
     def start(self):
@@ -206,7 +227,8 @@ class CoworkerPool:
 
     def batches(self, timeout: float = 120.0) -> Iterator[Dict]:
         done = 0
-        while done < self.num_workers:
+        total = self.num_workers + self.remote_producers
+        while done < total:
             batch = self.ring.get(timeout=timeout)
             if batch is None:
                 done += 1
@@ -218,4 +240,234 @@ class CoworkerPool:
             if p.is_alive():
                 p.terminate()
             p.join(timeout=10)
+        if self.feed_server is not None:
+            self.feed_server.stop()
         self.ring.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-pod data plane (TCP)
+# ---------------------------------------------------------------------------
+#
+# Reference: atorch's coworker gRPC tier — CPU pods run
+# service/coworker_data_service.py:43 and trainers discover batches via
+# data_info_service.py:32. On TPU pods (few host cores, fat chips) remote
+# CPU feeding matters MORE, so the same ring gains a TCP ingress: remote
+# producer pools push packed batches into the consumer host's shm ring;
+# local producers keep the zero-hop shm path. Backpressure is the ring
+# itself — the server acks a put only after a slot was claimed, so a
+# fast producer blocks instead of ballooning the consumer's RAM.
+
+import socket as _socket
+import socketserver as _socketserver
+import struct as _struct
+import threading as _threading
+
+_HDR = _struct.Struct("<cq")  # op byte + payload length
+_OP_PUT = b"P"
+_OP_DONE = b"D"
+_OP_ACK = b"A"
+_OP_ERR = b"E"
+
+
+def _net_send(sock, op: bytes, payload: bytes = b""):
+    sock.sendall(_HDR.pack(op, len(payload)))
+    if payload:
+        sock.sendall(payload)
+
+
+def _net_recv(sock):
+    from dlrover_tpu.common.sockets import recv_exact
+
+    try:
+        hdr = recv_exact(sock, _HDR.size)
+    except ConnectionError:
+        return None, None
+    op, n = _HDR.unpack(hdr)
+    # bound by the shared cap: a garbage length from a stray client is
+    # a dead stream (ConnectionError), never an allocation request
+    payload = recv_exact(sock, n)
+    return op, payload
+
+
+class BatchFeedServer:
+    """Consumer-side TCP ingress depositing remote batches into a ring."""
+
+    def __init__(
+        self,
+        ring: BatchRing,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        put_timeout: float = 600.0,
+    ):
+        self.ring = ring
+        self.put_timeout = put_timeout
+        outer = self
+
+        class Handler(_socketserver.BaseRequestHandler):
+            def handle(self):
+                saw_put = False
+                while True:
+                    try:
+                        op, payload = _net_recv(self.request)
+                    except (ConnectionError, OSError):
+                        op = None
+                    if op is None:
+                        # abnormal disconnect (producer died / network
+                        # partition): account its done marker so the
+                        # consumer's producer-count still closes. Bare
+                        # connect/disconnects (k8s TCP health probes)
+                        # never sent a batch and must NOT count — a
+                        # producer dying pre-first-put falls to the
+                        # consumer's get-timeout backstop instead.
+                        if saw_put:
+                            outer.ring.mark_done()
+                        return
+                    if op == _OP_PUT:
+                        try:
+                            # generous slot wait: a consumer can stall
+                            # for minutes (checkpoint persist, eval) —
+                            # the TCP credit already bounds memory, so
+                            # patience costs nothing
+                            outer.ring.put_bytes(
+                                bytes(payload), timeout=outer.put_timeout
+                            )
+                            saw_put = True
+                            _net_send(self.request, _OP_ACK)
+                        except Exception as e:  # noqa: BLE001
+                            logger.exception("feed server put failed")
+                            # this producer's stream is over: account
+                            # its done marker so the consumer's
+                            # producer-count still closes
+                            outer.ring.mark_done()
+                            try:
+                                _net_send(
+                                    self.request, _OP_ERR,
+                                    str(e).encode()[:512],
+                                )
+                            except OSError:
+                                pass
+                            return
+                    elif op == _OP_DONE:
+                        outer.ring.mark_done()
+                        _net_send(self.request, _OP_ACK)
+                        return
+
+        class Server(_socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = self._server.server_address
+        self._thread = _threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        logger.info("batch feed server on %s:%d", *self.address)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteBatchWriter:
+    """Producer-side client: pack and push batches to a BatchFeedServer.
+
+    One TCP connection, strict put→ack credit: the writer cannot run
+    ahead of the consumer's ring (its ack IS the free-slot claim)."""
+
+    def __init__(self, addr, timeout: float = 900.0):
+        # must exceed the server's ring-slot wait (put_timeout=600):
+        # if the writer gave up first, the server's eventual ack would
+        # desync the put/ack credit protocol
+        self._sock = _socket.create_connection(addr, timeout=timeout)
+        self._sock.settimeout(timeout)
+
+    def put(self, batch: Dict[str, np.ndarray]):
+        self.put_bytes(_pack(batch))
+
+    def put_bytes(self, raw: bytes):
+        _net_send(self._sock, _OP_PUT, raw)
+        op, payload = _net_recv(self._sock)
+        if op != _OP_ACK:
+            raise RuntimeError(
+                f"feed server rejected batch: {bytes(payload or b'')!r}"
+            )
+
+    def done(self):
+        try:
+            _net_send(self._sock, _OP_DONE)
+            _net_recv(self._sock)
+        except OSError:
+            # server already closed this stream (it then accounts the
+            # done marker itself on the error path)
+            pass
+        finally:
+            self._sock.close()
+
+
+def _remote_producer_main(addr, worker_id, num_workers, producer_fn):
+    writer = None
+    try:
+        # connect with retries: the feed server may come up after the
+        # producer pool (e.g. trainer restarting). If every attempt
+        # fails no marker can reach the consumer at all — batches()
+        # then ends via its get-timeout backstop.
+        for attempt in range(5):
+            try:
+                writer = RemoteBatchWriter(addr)
+                break
+            except OSError:
+                if attempt == 4:
+                    raise
+                time.sleep(2.0 * (attempt + 1))
+        for batch in producer_fn(worker_id, num_workers):
+            writer.put(batch)
+    except Exception:  # noqa: BLE001
+        logger.exception("remote coworker %d failed", worker_id)
+    finally:
+        if writer is not None:
+            writer.done()
+
+
+class RemoteProducerPool:
+    """N producer processes on a CPU host feeding a remote trainer.
+
+    The cross-pod counterpart of CoworkerPool: run this on machines
+    without chips, point it at the trainer's ``BatchFeedServer``
+    address. The trainer counts each remote producer toward its
+    done-marker total via ``CoworkerPool(remote_producers=...)``."""
+
+    def __init__(
+        self,
+        addr,
+        producer_fn: Callable[[int, int], Iterator[Dict]],
+        num_workers: int = 2,
+    ):
+        self.addr = tuple(addr)
+        self.producer_fn = producer_fn
+        self.num_workers = num_workers
+        self._procs: List[mp.Process] = []
+
+    def start(self):
+        ctx = mp.get_context("spawn")
+        for wid in range(self.num_workers):
+            p = ctx.Process(
+                target=_remote_producer_main,
+                args=(self.addr, wid, self.num_workers, self.producer_fn),
+                daemon=True,
+            )
+            p.start()
+            self._procs.append(p)
+        return self
+
+    def join(self, timeout: float = 300.0):
+        deadline = time.time() + timeout
+        for p in self._procs:
+            p.join(timeout=max(0.1, deadline - time.time()))
+
+    def stop(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=10)
