@@ -1,0 +1,495 @@
+"""Multi-host sparse parameter serving: KvTable over TCP + HRW routing.
+
+Reference capability: the elastic parameter-server serving path —
+dlrover's TF PS jobs keep training while PS instances are added,
+removed, or migrated (trainer/tensorflow/failover/tensorflow_failover.py:33
+drives the TF_CONFIG rebuild; the PS data plane is TF's own RPC layer).
+TPU-native framing: the dense model is pjit-sharded and has no PS, so
+the PS role survives ONLY for the sparse/embedding tier
+(sparse/kv_table.py). This module is that tier's data plane:
+
+- ``KvServer``: one process holding KvTable shards for its share of the
+  HRW ring, serving pull/push/migrate over framed TCP.
+- ``DistributedEmbedding``: the trainer-side client with the same
+  pull → jitted step → push choreography as the in-process
+  EmbeddingCollection, but fanning each unique-id batch out to the
+  owning servers (sparse/partition.py HRW, so membership changes move
+  only the bounded key set).
+- ``rebalance``: drive a server-set change v_n → v_{n+1}: compute the
+  migration plan over the union of live keys, move rows (values +
+  optimizer slots + freq/ts admission state) between servers, then
+  switch the client's routing — mid-training, without dropping state.
+
+Wire format: one 16-byte header (op byte, json length, payload length),
+then a json control dict, then a raw little-endian payload (int64 keys
+/ f32 rows) — no pickling, mirroring common/messages.py's JSON-only
+rule for control planes.
+"""
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.sparse.kv_table import KvTable, SparseOptimizer, GroupAdam
+from dlrover_tpu.sparse.partition import migration_plan, partition_keys
+
+logger = get_logger(__name__)
+
+_HDR = struct.Struct("<cqq")  # op, json bytes, payload bytes
+
+
+def _send(sock, op: bytes, ctrl: Dict, payload: bytes = b""):
+    raw = json.dumps(ctrl).encode()
+    sock.sendall(_HDR.pack(op, len(raw), len(payload)))
+    sock.sendall(raw)
+    if payload:
+        sock.sendall(payload)
+
+
+def _recv(sock) -> Tuple[bytes, Dict, bytes]:
+    from dlrover_tpu.common.sockets import recv_exact
+
+    op, jn, pn = _HDR.unpack(bytes(recv_exact(sock, _HDR.size)))
+    ctrl = json.loads(bytes(recv_exact(sock, jn))) if jn else {}
+    payload = bytes(recv_exact(sock, pn)) if pn else b""
+    return op, ctrl, payload
+
+
+class KvServer:
+    """One sparse server process: named KvTables + optimizer + TCP.
+
+    Ops (client → server):
+      P pull     {table, train, n}        + int64 keys → f32 rows
+      U push     {table, n, dim}          + keys ‖ f32 grads → ack
+      K keys     {table}                  → int64 keys (live set)
+      E export   {table, n}               + keys → rows‖freq‖ts (full
+                                            width incl optimizer slots)
+      I import   {table, n, width}        + keys‖rows‖freq‖ts → ack
+      D delete   {table, n}               + keys → ack
+      S stats    {}                       → {table: count}
+    """
+
+    def __init__(
+        self,
+        specs,
+        optimizer: Optional[SparseOptimizer] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.optimizer = optimizer or GroupAdam(lr=1e-3)
+        n_slots = self.optimizer.required_slots
+        self.tables: Dict[str, KvTable] = {
+            spec.name: KvTable(
+                spec.name,
+                spec.dim,
+                n_slots=n_slots,
+                n_shards=spec.n_shards,
+                enter_threshold=spec.enter_threshold,
+                initializer=spec.initializer,
+                init_scale=spec.init_scale,
+                seed=spec.seed,
+            )
+            for spec in specs
+        }
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        op, ctrl, payload = _recv(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        outer._dispatch(self.request, op, ctrl, payload)
+                    except Exception as e:  # noqa: BLE001
+                        logger.exception("kv server op %r failed", op)
+                        try:
+                            _send(self.request, b"!", {"error": str(e)})
+                        except OSError:
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def _dispatch(self, sock, op, ctrl, payload):
+        if op == b"S":
+            _send(sock, b"S", {t: len(tab) for t, tab in self.tables.items()})
+            return
+        table = self.tables[ctrl["table"]]
+        if op == b"P":
+            keys = np.frombuffer(payload, dtype=np.int64)
+            rows = (
+                table.gather_or_insert(keys)
+                if ctrl.get("train")
+                else table.gather_or_zeros(keys)
+            )
+            _send(sock, b"P", {"n": len(keys)}, rows.tobytes())
+        elif op == b"U":
+            n = ctrl["n"]
+            keys = np.frombuffer(payload[: 8 * n], dtype=np.int64)
+            grads = np.frombuffer(
+                payload[8 * n :], dtype=np.float32
+            ).reshape(n, ctrl["dim"])
+            self.optimizer.apply(table, keys, grads)
+            _send(sock, b"U", {"ok": True})
+        elif op == b"K":
+            keys, _, _, _ = table.export(delta_only=False, clear_dirty=False)
+            _send(sock, b"K", {"n": len(keys)}, keys.tobytes())
+        elif op == b"E":
+            keys = np.frombuffer(payload, dtype=np.int64)
+            rows = table.gather_full(keys)
+            freqs = table.frequency(keys)
+            ts = table.timestamp(keys)
+            _send(
+                sock,
+                b"E",
+                {"n": len(keys), "width": table.width},
+                rows.tobytes() + freqs.tobytes() + ts.tobytes(),
+            )
+        elif op == b"I":
+            n, width = ctrl["n"], ctrl["width"]
+            off = 8 * n
+            keys = np.frombuffer(payload[:off], dtype=np.int64)
+            rows = np.frombuffer(
+                payload[off : off + 4 * n * width], dtype=np.float32
+            ).reshape(n, width)
+            off += 4 * n * width
+            freqs = np.frombuffer(payload[off : off + 4 * n], np.uint32)
+            ts = np.frombuffer(payload[off + 4 * n :], np.uint32)
+            table.import_(keys, rows, freqs, ts, mark_dirty=True)
+            _send(sock, b"I", {"ok": True})
+        elif op == b"D":
+            keys = np.frombuffer(payload, dtype=np.int64)
+            removed = table.delete(keys)
+            _send(sock, b"D", {"removed": removed})
+        else:
+            _send(sock, b"!", {"error": f"unknown op {op!r}"})
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        for t in self.tables.values():
+            t.close()
+
+
+class KvClient:
+    """One connection to one KvServer."""
+
+    def __init__(self, addr, timeout: float = 60.0):
+        self.addr = tuple(addr)
+        self._sock = socket.create_connection(self.addr, timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, op, ctrl, payload=b""):
+        with self._lock:
+            _send(self._sock, op, ctrl, payload)
+            rop, rctrl, rpayload = _recv(self._sock)
+        if rop == b"!":
+            raise RuntimeError(f"kv server error: {rctrl.get('error')}")
+        return rctrl, rpayload
+
+    def pull(self, table: str, keys: np.ndarray, train: bool) -> np.ndarray:
+        ctrl, payload = self._call(
+            b"P", {"table": table, "train": train}, keys.tobytes()
+        )
+        return np.frombuffer(payload, dtype=np.float32).reshape(
+            len(keys), -1
+        ).copy()
+
+    def push(self, table: str, keys: np.ndarray, grads: np.ndarray):
+        self._call(
+            b"U",
+            {"table": table, "n": len(keys), "dim": grads.shape[1]},
+            keys.tobytes() + np.ascontiguousarray(
+                grads, np.float32
+            ).tobytes(),
+        )
+
+    def keys(self, table: str) -> np.ndarray:
+        _, payload = self._call(b"K", {"table": table})
+        return np.frombuffer(payload, dtype=np.int64).copy()
+
+    def export_rows(self, table: str, keys: np.ndarray):
+        ctrl, payload = self._call(b"E", {"table": table}, keys.tobytes())
+        n, width = ctrl["n"], ctrl["width"]
+        rows = np.frombuffer(payload[: 4 * n * width], np.float32).reshape(
+            n, width
+        )
+        off = 4 * n * width
+        freqs = np.frombuffer(payload[off : off + 4 * n], np.uint32)
+        ts = np.frombuffer(payload[off + 4 * n :], np.uint32)
+        return rows.copy(), freqs.copy(), ts.copy()
+
+    def import_rows(self, table, keys, rows, freqs, ts):
+        self._call(
+            b"I",
+            {"table": table, "n": len(keys), "width": rows.shape[1]},
+            keys.tobytes()
+            + np.ascontiguousarray(rows, np.float32).tobytes()
+            + np.ascontiguousarray(freqs, np.uint32).tobytes()
+            + np.ascontiguousarray(ts, np.uint32).tobytes(),
+        )
+
+    def delete(self, table: str, keys: np.ndarray) -> int:
+        ctrl, _ = self._call(b"D", {"table": table}, keys.tobytes())
+        return ctrl["removed"]
+
+    def stats(self) -> Dict[str, int]:
+        ctrl, _ = self._call(b"S", {})
+        return ctrl
+
+    def close(self):
+        self._sock.close()
+
+
+class DistributedEmbedding:
+    """Trainer-side embedding collection over remote KvServers.
+
+    Same pull/push choreography as the in-process EmbeddingCollection
+    (sparse/embedding.py) — the jitted step is identical; only the
+    host-side gather/update fans out over the HRW ring. ``servers`` is
+    {name: (host, port)}; routing follows sparse/partition.py so the
+    master's ElasticPsService versioned server sets drive it directly.
+    """
+
+    def __init__(
+        self,
+        specs,
+        servers: Dict[str, Tuple[str, int]],
+        weights: Optional[Dict[str, float]] = None,
+    ):
+        self.specs = {s.name: s for s in specs}
+        self._weights = weights
+        self._clients: Dict[str, KvClient] = {}
+        self._servers: Dict[str, Tuple[str, int]] = {}
+        self.version = 0
+        self.set_servers(servers, migrate=False)
+
+    # -- routing ----------------------------------------------------------
+
+    @property
+    def server_names(self) -> List[str]:
+        return sorted(self._servers)
+
+    def _client(self, name: str) -> KvClient:
+        if name not in self._clients:
+            self._clients[name] = KvClient(self._servers[name])
+        return self._clients[name]
+
+    # -- train path -------------------------------------------------------
+
+    def pull(self, batch_ids: Dict[str, np.ndarray]):
+        device_inputs, host_state = {}, {}
+        for name, ids in batch_ids.items():
+            import jax.numpy as jnp
+
+            flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            rows = self._fanout_pull(name, uniq, train=True)
+            device_inputs[name] = (
+                jnp.asarray(rows),
+                jnp.asarray(
+                    inverse.reshape(np.shape(ids)), dtype=jnp.int32
+                ),
+            )
+            host_state[name] = uniq
+        return device_inputs, host_state
+
+    def pull_frozen(self, batch_ids: Dict[str, np.ndarray]):
+        """Inference pull (gather_or_zeros server-side): nothing is
+        inserted and admission counters stay untouched — same contract
+        as EmbeddingCollection.pull_frozen."""
+        import jax.numpy as jnp
+
+        out = {}
+        for name, ids in batch_ids.items():
+            flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            rows = self._fanout_pull(name, uniq, train=False)
+            out[name] = (
+                jnp.asarray(rows),
+                jnp.asarray(
+                    inverse.reshape(np.shape(ids)), dtype=jnp.int32
+                ),
+            )
+        return out
+
+    def _fanout_pull(self, table: str, uniq: np.ndarray, train: bool):
+        dim = self.specs[table].dim
+        rows = np.empty((len(uniq), dim), np.float32)
+        index = {k: i for i, k in enumerate(uniq.tolist())}
+        for server, keys in partition_keys(
+            uniq, self.server_names, self._weights
+        ).items():
+            if not len(keys):
+                continue
+            got = self._client(server).pull(table, keys, train)
+            pos = np.fromiter(
+                (index[k] for k in keys.tolist()), np.int64, len(keys)
+            )
+            rows[pos] = got
+        return rows
+
+    def push(self, host_state, row_grads):
+        for table, uniq in host_state.items():
+            grads = np.asarray(row_grads[table], np.float32)
+            index = {k: i for i, k in enumerate(uniq.tolist())}
+            for server, keys in partition_keys(
+                uniq, self.server_names, self._weights
+            ).items():
+                if not len(keys):
+                    continue
+                pos = np.fromiter(
+                    (index[k] for k in keys.tolist()), np.int64, len(keys)
+                )
+                self._client(server).push(table, keys, grads[pos])
+
+    # -- membership / migration ------------------------------------------
+
+    def set_servers(
+        self,
+        servers: Dict[str, Tuple[str, int]],
+        weights: Optional[Dict[str, float]] = None,
+        migrate: bool = True,
+    ) -> int:
+        """Adopt a new server set (and optional weights), migrating the
+        owner-changed keys (values + optimizer slots + admission state)
+        before any lookup routes to the new ring. Returns the number of
+        keys moved — HRW bounds it to the added/removed servers' share.
+        """
+        old_names = self.server_names
+        new = {n: tuple(a) for n, a in servers.items()}
+        moved = 0
+        if migrate and old_names:
+            moved = self._migrate(old_names, new, weights)
+        self._servers = new
+        self._weights = weights if weights is not None else self._weights
+        for name in list(self._clients):
+            if name not in new:
+                self._clients.pop(name).close()
+        self.version += 1
+        return moved
+
+    def _migrate(self, old_names, new, new_weights) -> int:
+        new_names = sorted(new)
+        moved_total = 0
+        # connect new servers early (they must accept imports)
+        all_servers = dict(self._servers, **new)
+        for table in self.specs:
+            live: Dict[str, np.ndarray] = {}
+            for s in old_names:
+                live[s] = self._client(s).keys(table)
+            union = (
+                np.unique(np.concatenate(list(live.values())))
+                if live
+                else np.empty(0, np.int64)
+            )
+            plan = migration_plan(
+                union,
+                old_names,
+                new_names,
+                old_weights=self._weights,
+                new_weights=new_weights
+                if new_weights is not None
+                else self._weights,
+            )
+            moves: Dict[Tuple[str, str], List[int]] = {}
+            for key, src, dst in plan:
+                moves.setdefault((src, dst), []).append(key)
+            for (src, dst), keys in moves.items():
+                if tuple(all_servers[src]) == tuple(all_servers[dst]):
+                    # same process under a new ring name: the rows are
+                    # already where they belong — moving would delete
+                    # what was just imported into the same table
+                    continue
+                karr = np.asarray(keys, np.int64)
+                rows, freqs, ts = self._client(src).export_rows(
+                    table, karr
+                )
+                if dst not in self._clients:
+                    self._clients[dst] = KvClient(all_servers[dst])
+                self._clients[dst].import_rows(
+                    table, karr, rows, freqs, ts
+                )
+                self._client(src).delete(table, karr)
+                moved_total += len(keys)
+        return moved_total
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        return {s: self._client(s).stats() for s in self.server_names}
+
+    def close(self):
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
+
+
+# ---------------------------------------------------------------------------
+# master integration: versioned server sets → live routing
+# ---------------------------------------------------------------------------
+
+_ADDR_KV_PREFIX = "sparse_server_addr_"
+
+
+def register_server(client, name: str, address) -> None:
+    """Publish a KvServer's address under the master KV store, keyed by
+    its ring name — the discovery channel DistributedEmbedding syncing
+    uses (same pattern as checkpoint/replica.py peer discovery)."""
+    import json as _json
+
+    client.kv_store_set(
+        _ADDR_KV_PREFIX + name, _json.dumps(list(address))
+    )
+
+
+def sync_with_master(demb: "DistributedEmbedding", client) -> bool:
+    """One poll of the master's ElasticPsService: if the sparse-tier
+    version advanced, resolve the new server list's addresses from the
+    KV store and apply it (migrating owner-changed keys). Returns True
+    when the routing changed. Reference: the trainer-side version check
+    of dlrover's elastic PS (tensorflow_failover.py:33) — there it
+    rebuilds TF_CONFIG; here it reroutes the HRW ring in place.
+    """
+    import json as _json
+
+    resp = client.get_ps_version()
+    if resp.version <= demb.version or not resp.servers:
+        return False
+    addrs = {}
+    for name in resp.servers:
+        raw = client.kv_store_get(_ADDR_KV_PREFIX + name)
+        if not raw:
+            logger.warning(
+                "sparse server %s has no registered address yet; "
+                "deferring version %d adoption", name, resp.version,
+            )
+            return False
+        host, port = _json.loads(raw)
+        addrs[name] = (host, int(port))
+    weights = None
+    get_w = getattr(client, "get_ps_weights", None)
+    if callable(get_w):
+        weights = get_w() or None
+    moved = demb.set_servers(addrs, weights=weights)
+    demb.version = resp.version
+    logger.info(
+        "sparse tier rerouted to version %d (%d servers, %d keys moved)",
+        resp.version, len(addrs), moved,
+    )
+    return True

@@ -1,0 +1,234 @@
+"""Multi-host sparse serving e2e (VERDICT r2 #7).
+
+Two real KvServer PROCESSES serve the embedding tier over TCP while a
+DeepFM trains against them through DistributedEmbedding; mid-run the
+server set changes (scale-out, then scale-in) and the HRW rebalance
+migrates only the owner-changed keys — values, optimizer slots and
+admission state included — without interrupting convergence.
+
+Reference capability: dlrover's elastic TF PS jobs keep training while
+PS instances migrate (trainer/tensorflow/failover/tensorflow_failover.py:33);
+here the PS role is the sparse tier's KvServer ring.
+"""
+
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+from dlrover_tpu.sparse import GroupAdam
+from dlrover_tpu.sparse.embedding import EmbeddingSpec
+from dlrover_tpu.sparse.server import (
+    DistributedEmbedding,
+    KvClient,
+    KvServer,
+)
+
+
+def _specs(emb_dim=8):
+    return [
+        EmbeddingSpec("emb", emb_dim, initializer="normal",
+                      init_scale=0.01, seed=3),
+        EmbeddingSpec("wide", 1, initializer="zeros"),
+    ]
+
+
+def _server_main(port_q, emb_dim, lr):
+    server = KvServer(_specs(emb_dim), optimizer=GroupAdam(lr=lr))
+    port_q.put(server.address[1])
+    threading.Event().wait()  # park; the parent terminates us
+
+
+def _spawn_server(ctx, emb_dim=8, lr=5e-3):
+    q = ctx.Queue()
+    p = ctx.Process(target=_server_main, args=(q, emb_dim, lr), daemon=True)
+    p.start()
+    port = q.get(timeout=60)
+    return p, ("127.0.0.1", port)
+
+
+@pytest.fixture()
+def two_servers():
+    ctx = mp.get_context("spawn")
+    procs, addrs = [], {}
+    for name in ("s0", "s1"):
+        p, addr = _spawn_server(ctx)
+        procs.append(p)
+        addrs[name] = addr
+    yield ctx, procs, addrs
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+        p.join(timeout=10)
+
+
+def _synthetic_ctr(rng, n, cfg):
+    cat = rng.integers(0, 50, size=(n, cfg.n_fields))
+    dense = rng.normal(size=(n, cfg.n_dense)).astype(np.float32)
+    hot = (cat % 7 == 0).sum(axis=1) + dense[:, 0]
+    p = 1.0 / (1.0 + np.exp(-(hot - 2.0)))
+    labels = (rng.random(n) < p).astype(np.float32)
+    return cat.astype(np.int64), dense, labels
+
+
+def test_lookup_update_over_wire(two_servers):
+    """Basic wire ops: pull inserts rows on the OWNING server; push
+    updates move the values; routing is disjoint and complete."""
+    _, _, addrs = two_servers
+    demb = DistributedEmbedding(_specs(), addrs)
+    ids = np.arange(100, dtype=np.int64).reshape(10, 10)
+    dev, host = demb.pull({"emb": ids})
+    rows0 = np.asarray(dev["emb"][0])
+    assert rows0.shape == (100, 8)
+    # rows landed on both servers, partitioned disjointly
+    stats = demb.stats()
+    counts = [s["emb"] for s in stats.values()]
+    assert sum(counts) == 100 and all(c > 0 for c in counts)
+    # a push changes what the next pull returns
+    demb.push(host, {"emb": np.ones((100, 8), np.float32)})
+    dev2, _ = demb.pull({"emb": ids})
+    assert not np.allclose(rows0, np.asarray(dev2["emb"][0]))
+    demb.close()
+
+
+def test_deepfm_trains_and_survives_rebalance(two_servers):
+    """The headline drive: train -> scale OUT (migrate) -> train ->
+    scale IN (migrate back) -> train; convergence must continue and
+    migration stay bounded to the HRW-moved share."""
+    ctx, procs, addrs = two_servers
+    cfg = DeepFMConfig(n_fields=6, n_dense=4, emb_dim=8, mlp_dims=(32,))
+    rng = np.random.default_rng(0)
+    cat, dense, labels = _synthetic_ctr(rng, 512, cfg)
+
+    model = DeepFM(cfg, optimizer=GroupAdam(lr=5e-3), dense_lr=5e-3)
+    model.coll.close()
+    demb = DistributedEmbedding(_specs(cfg.emb_dim), addrs)
+    model.coll = demb
+
+    first = model.train_step(cat, dense, labels)
+    for _ in range(20):
+        mid = model.train_step(cat, dense, labels)
+    assert mid < first * 0.9, (first, mid)
+
+    total_before = sum(s["emb"] for s in demb.stats().values())
+
+    # ---- scale OUT: add s2; only ~1/3 of keys may move --------------
+    p2, addr2 = _spawn_server(ctx)
+    procs.append(p2)
+    moved = demb.set_servers(dict(addrs, s2=addr2))
+    stats = demb.stats()
+    assert "s2" in stats and stats["s2"]["emb"] > 0
+    assert sum(s["emb"] for s in stats.values()) == total_before
+    # bounded migration: HRW moves ~1/3 on 2->3 growth, never most keys
+    assert 0 < moved < total_before * 2 * 0.6  # emb + wide tables
+
+    for _ in range(10):
+        after_grow = model.train_step(cat, dense, labels)
+    # optimizer slots moved with the rows: convergence continues, no
+    # re-warmup spike
+    assert after_grow < first * 0.9
+
+    # ---- scale IN: drop s0; its keys must migrate before routing ----
+    new_set = {"s1": addrs["s1"], "s2": addr2}
+    moved_in = demb.set_servers(new_set)
+    stats = demb.stats()
+    assert sorted(stats) == ["s1", "s2"]
+    assert sum(s["emb"] for s in stats.values()) == total_before
+    assert moved_in > 0
+
+    for _ in range(10):
+        final = model.train_step(cat, dense, labels)
+    assert final < first * 0.9
+
+    # inference path over the wire (frozen: no inserts)
+    preds = model.predict(cat, dense)
+    assert preds.shape == (512,)
+    total_after = sum(s["emb"] for s in demb.stats().values())
+    assert total_after == total_before
+    demb.close()
+    model.dense_params = None  # model.close() would close demb twice
+
+
+def test_migration_preserves_row_values(two_servers):
+    """Row-level proof: a migrated key's value/freq round-trips exactly
+    (the optimizer slab rides along in gather_full width)."""
+    _, _, addrs = two_servers
+    demb = DistributedEmbedding(_specs(), addrs)
+    ids = np.arange(40, dtype=np.int64)
+    demb.pull({"emb": ids})  # insert
+    demb.push(
+        {"emb": ids}, {"emb": np.full((40, 8), 0.25, np.float32)}
+    )
+    dev, _ = demb.pull({"emb": ids})
+    before = np.asarray(dev["emb"][0]).copy()
+
+    # force migration by renaming the ring (new server NAMES re-hash
+    # every key even on the same processes)
+    moved = demb.set_servers(
+        {"a0": addrs["s0"], "a1": addrs["s1"]}
+    )
+    assert moved > 0
+    dev2, _ = demb.pull({"emb": ids})
+    np.testing.assert_allclose(
+        before, np.asarray(dev2["emb"][0]), atol=1e-6
+    )
+    demb.close()
+
+
+def test_sync_with_master_reroutes(two_servers):
+    """Trainer-side version poll: when the master's ElasticPsService
+    bumps the sparse-tier version, the client resolves addresses from
+    the KV store and reroutes (tensorflow_failover.py:33 capability)."""
+    from dlrover_tpu.common import messages as msgs
+    from dlrover_tpu.sparse.server import register_server, sync_with_master
+
+    ctx, procs, addrs = two_servers
+
+    class FakeClient:
+        def __init__(self):
+            self.kv = {}
+            self.version = 0
+            self.servers = []
+
+        def kv_store_set(self, k, v):
+            self.kv[k] = v
+            return True
+
+        def kv_store_get(self, k):
+            return self.kv.get(k, "")
+
+        def get_ps_version(self, version_type="global"):
+            return msgs.PsVersionResponse(
+                version=self.version, servers=self.servers
+            )
+
+    client = FakeClient()
+    for name, addr in addrs.items():
+        register_server(client, name, addr)
+    demb = DistributedEmbedding(_specs(), {"s0": addrs["s0"]})
+    demb.pull({"emb": np.arange(30, dtype=np.int64)})
+    base_version = demb.version
+
+    # no version change -> no reroute
+    assert sync_with_master(demb, client) is False
+
+    # master announces the 2-server set
+    client.version = base_version + 1
+    client.servers = ["s0", "s1"]
+    assert sync_with_master(demb, client) is True
+    assert demb.version == base_version + 1
+    assert demb.server_names == ["s0", "s1"]
+    # rows redistributed across both processes, none lost
+    stats = demb.stats()
+    assert sum(s["emb"] for s in stats.values()) == 30
+
+    # unknown address defers adoption instead of half-routing
+    client.version += 1
+    client.servers = ["s0", "s1", "ghost"]
+    assert sync_with_master(demb, client) is False
+    assert demb.server_names == ["s0", "s1"]
+    demb.close()
