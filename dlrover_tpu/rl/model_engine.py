@@ -66,11 +66,31 @@ class ModelEngine:
         critic_learning_rate: float = 1e-5,
         grad_clip: float = 1.0,
         actor_params: Optional[Any] = None,
+        reward_params: Optional[Any] = None,
         init_reward: bool = True,
+        critic_from_reward: Any = "auto",
     ):
         """``init_reward=False`` skips the learned reward backbone — use
         it when RLTrainer gets a programmatic ``reward_fn``, so a full
-        model's worth of HBM is not wasted on unread weights."""
+        model's worth of HBM is not wasted on unread weights.
+
+        Weight sharing (the hybrid-engine economy, reference:
+        atorch/atorch/rl/ds_hybrid_engine/hybrid_engine.py — there the
+        actor's training and inference modules share parameter storage):
+        on TPU rollout jits read the SAME sharded actor buffers the
+        train step updates, so the inference copy the reference works to
+        eliminate never exists here. Within the engine, ref aliases the
+        actor's initial arrays; with a SUPPLIED (trained) reward model
+        the critic backbone warm-starts FROM it by alias (the TRL /
+        InstructGPT recipe) — the production RLHF setup then holds TWO
+        distinct full weight sets for four roles at init.
+        ``critic_from_reward="auto"`` applies that alias exactly when
+        ``reward_params`` were provided: warm-starting from a
+        fresh-RANDOM reward backbone would couple two inits for no
+        benefit (measurably hurts toy PPO). Arrays are immutable and
+        updates rebind, so the aliases stay frozen and only *diverged*
+        trainable weights ever cost extra HBM.
+        """
         self.cfg = cfg
         self.mesh = mesh
         rng = rng if rng is not None else jax.random.key(0)
@@ -80,18 +100,32 @@ class ModelEngine:
         # snapshot): jax arrays are immutable and optimizer updates rebind
         # rather than mutate, so no copy — no second weight set in HBM
         ref = actor
-        critic = {
-            "backbone": decoder.init(keys[1], cfg),
-            "v_head": init_value_head(keys[2], cfg),
-        }
-        reward = (
-            {
+        reward = None
+        if reward_params is not None:
+            # supplied pretrained reward weights always win, regardless
+            # of init_reward (which only gates FRESH initialization)
+            reward = reward_params
+        elif init_reward:
+            reward = {
                 "backbone": decoder.init(keys[3], cfg),
                 "v_head": init_value_head(keys[4], cfg),
             }
-            if init_reward
-            else None
-        )
+        if critic_from_reward == "auto":
+            critic_from_reward = reward_params is not None
+        if critic_from_reward and reward is not None:
+            # critic starts FROM the reward model (TRL-style warm start;
+            # also how InstructGPT initializes the value function) — the
+            # backbone is an alias, so only the critic's own training
+            # divergence costs memory
+            critic = {
+                "backbone": reward["backbone"],
+                "v_head": init_value_head(keys[2], cfg),
+            }
+        else:
+            critic = {
+                "backbone": decoder.init(keys[1], cfg),
+                "v_head": init_value_head(keys[2], cfg),
+            }
         self.params: Dict[str, Any] = {
             "actor": actor,
             "critic": critic,
@@ -135,6 +169,31 @@ class ModelEngine:
         return reward_score(
             self.params["reward"], tokens, self.cfg, mesh=self.mesh, mask=mask
         )
+
+    # ---- memory accounting ----------------------------------------------
+
+    def distinct_param_bytes(self) -> int:
+        """Bytes of UNIQUE parameter arrays across all roles.
+
+        Aliased subtrees (ref→actor, critic→reward backbones) count
+        once: arrays are immutable, so object identity == storage
+        identity. This is the accounting behind the "4 roles, ≤2 full
+        weight sets at init" guarantee."""
+        seen = {}
+        for tree in self.params.values():
+            if tree is None:
+                continue
+            for leaf in jax.tree.leaves(tree):
+                seen[id(leaf)] = leaf.nbytes
+        return sum(seen.values())
+
+    def weight_sets(self) -> float:
+        """distinct param bytes / one actor's bytes — 2.0 ≈ two full
+        models resident (plus epsilon for the value heads)."""
+        actor_bytes = sum(
+            leaf.nbytes for leaf in jax.tree.leaves(self.params["actor"])
+        )
+        return self.distinct_param_bytes() / max(actor_bytes, 1)
 
     # ---- updates ---------------------------------------------------------
 

@@ -361,3 +361,73 @@ def test_decode_step_logits_match_forward():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(full[:, -1]), rtol=2e-2, atol=2e-2
     )
+
+
+def test_model_engine_weight_sharing_accounting():
+    """4 roles, <=2 full weight sets at init (hybrid-engine economy:
+    reference ds_hybrid_engine/hybrid_engine.py shares actor storage
+    between train and rollout; here ref aliases actor AND — in the
+    production setup where a TRAINED reward model is supplied — the
+    critic backbone warm-starts from it by alias, TRL-style)."""
+    cfg = _cfg()
+    from dlrover_tpu.models import decoder as _dec
+    from dlrover_tpu.rl.model_engine import init_value_head
+
+    trained_rm = {
+        "backbone": _dec.init(jax.random.key(9), cfg),
+        "v_head": init_value_head(jax.random.key(10), cfg),
+    }
+    eng = ModelEngine(cfg, learning_rate=1e-2, reward_params=trained_rm)
+    # critic backbone IS the supplied reward backbone at init
+    for c_leaf, r_leaf in zip(
+        jax.tree.leaves(eng.params["critic"]["backbone"]),
+        jax.tree.leaves(eng.params["reward"]["backbone"]),
+    ):
+        assert c_leaf is r_leaf
+    # accounting: distinct bytes across ALL FOUR roles ~= 2 actors
+    # (+ two tiny value heads), never 4
+    assert eng.weight_sets() < 2.2
+    # after an actor update the ref diverges -> one extra weight set,
+    # but the critic/reward pair still shares
+    grads = jax.tree.map(jnp.ones_like, eng.params["actor"])
+    eng.apply_gradients("actor", grads)
+    assert eng.weight_sets() < 3.2
+    # auto: a fresh-RANDOM reward backbone is NOT aliased into the
+    # critic (coupling two random inits measurably hurts toy PPO)
+    eng2 = ModelEngine(cfg)
+    assert eng2.weight_sets() > 2.8  # actor(+ref alias), critic, reward
+
+
+def test_rollout_reads_training_actor_buffers(tmp_path):
+    """The rollout path must consume the SAME actor arrays the train
+    step updates — no inference copy (the storage sharing the
+    reference's hybrid engine exists to provide)."""
+    from dlrover_tpu.models import generate
+
+    cfg = _cfg()
+    eng = ModelEngine(cfg, learning_rate=1e-2)
+    seen = []
+    orig = generate.sample
+
+    def spy(params, *a, **k):
+        seen.append(params)
+        return orig(params, *a, **k)
+
+    import dlrover_tpu.rl.trainer as rl_trainer_mod
+
+    trainer = rl_trainer_mod.RLTrainer(
+        eng,
+        rl_trainer_mod.PPOConfig(max_new_tokens=4, ppo_epochs=1),
+        reward_fn=lambda tokens, mask: jnp.zeros((tokens.shape[0],)),
+    )
+    prompts = jnp.ones((2, 4), jnp.int32)
+    try:
+        rl_trainer_mod.generate.sample = spy
+        trainer.make_experience(prompts, jax.random.key(0))
+    finally:
+        rl_trainer_mod.generate.sample = orig
+    assert seen, "rollout never sampled"
+    for got, have in zip(
+        jax.tree.leaves(seen[0]), jax.tree.leaves(eng.params["actor"])
+    ):
+        assert got is have
