@@ -33,6 +33,11 @@ _PEAK_TFLOPS = {
 
 _REFERENCE_HFU = 0.656  # BASELINE.md #8
 
+# one deadline for the whole run: attempts + aux passes must fit the
+# documented `timeout 900 python bench.py` with slack for interpreter
+# startup (the per-attempt budgets below must sum to <= this)
+_DEADLINE_S = 870
+
 # (config, batch, seq, remat, subprocess timeout seconds)
 # llama-1.4b leads: every hot dim is a 128-multiple (d=16·128,
 # head_dim=128, ff=44·128), measured 0.60 MFU vs gpt2-1.5b's 0.48 on
@@ -47,7 +52,9 @@ _REFERENCE_HFU = 0.656  # BASELINE.md #8
 # its per-attempt timeout (CPU fall-through worst case)
 _ATTEMPTS = [
     ("llama-1.4b", 8, 1024, "save_qkv", 420),
-    ("gpt2-1.5b", 8, 1024, "save_qkv", 180),
+    # gpt2-1.5b stays on full remat: its tied 50k-vocab embedding puts
+    # params at 1.56B and save_qkv's pinned residuals OOM the 16 GiB
+    ("gpt2-1.5b", 8, 1024, "full", 180),
     ("gpt2-355m", 16, 1024, "full", 120),
     ("gpt2-124m", 16, 512, "none", 90),
     ("tiny", 8, 128, "none", 60),
@@ -102,6 +109,51 @@ def check_kernels(b=2, s=1024, h=16, d=128) -> bool:
     for a, b_ in zip(gf, gr):
         ok = ok and close(a, b_, 3e-2)
     return bool(ok)
+
+
+def measure_mxu_ceiling(n_pairs: int = 40, reps: int = 5) -> dict:
+    """Achievable chained-matmul rate at the flagship's MLP shapes.
+
+    The practical ceiling the step competes against — NOT the nominal
+    peak. Methodology matters under the axon relay: a single timed call
+    folds the ~100 ms host-readback into the measurement and reads
+    40-70% low; chaining ``reps`` calls and syncing once amortizes it.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    a0 = jax.random.normal(jax.random.key(5), (8192, 2048), jnp.bfloat16)
+    wm = jax.random.normal(jax.random.key(6), (2048, 5632), jnp.bfloat16)
+    wm = wm * 0.02
+    wn = jax.random.normal(jax.random.key(7), (5632, 2048), jnp.bfloat16)
+    wn = wn * 0.0005
+
+    @jax.jit
+    def chain(a):
+        def body(c, _):
+            c = jnp.dot(c, wm, preferred_element_type=jnp.bfloat16)
+            c = jnp.dot(c, wn, preferred_element_type=jnp.bfloat16)
+            return c, None
+
+        out, _ = jax.lax.scan(body, a, None, length=n_pairs)
+        return out
+
+    out = chain(a0)
+    float(jnp.sum(out.astype(jnp.float32)))  # warm + sync
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        out = chain(out)
+    float(jnp.sum(out.astype(jnp.float32)))
+    dt = _time.perf_counter() - t0
+    fl = 2 * 8192 * 2048 * 5632 * 2 * n_pairs * reps
+    tf = fl / dt / 1e12
+    dev = jax.devices()[0]
+    return {
+        "mxu_tflops": round(tf, 1),
+        "mxu_ceiling_frac": round(tf / peak_tflops(dev), 4),
+    }
 
 
 def peak_tflops(device) -> float:
@@ -180,6 +232,9 @@ def main():
     if len(sys.argv) >= 2 and sys.argv[1] == "--check":
         print(json.dumps({"kernels_ok": check_kernels()}))
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--ceiling":
+        print(json.dumps(measure_mxu_ceiling()))
+        return
     if len(sys.argv) >= 5 and sys.argv[1] == "--single":
         name, batch, seq, remat = (
             sys.argv[2],
@@ -221,7 +276,7 @@ def main():
                 # envelope — when attempts already consumed it, the
                 # check reports null rather than risking the result
                 # line itself
-                remaining = 870 - (time.monotonic() - t0)
+                remaining = _DEADLINE_S - (time.monotonic() - t0)
                 if remaining >= 45:
                     record["kernels_ok"] = _run_kernel_check(
                         budget_s=int(min(180, remaining))
@@ -231,6 +286,16 @@ def main():
                         "kernel check skipped: bench budget exhausted\n"
                     )
                     record["kernels_ok"] = None
+                # achievable-matmul ceiling at the flagship shapes:
+                # contextualizes the MFU (remaining gap = remat
+                # recompute vs this, not vs the nominal peak)
+                remaining = _DEADLINE_S - (time.monotonic() - t0)
+                if remaining >= 45:
+                    record.update(
+                        _run_aux_json(
+                            "--ceiling", int(min(120, remaining))
+                        )
+                    )
                 print(json.dumps(record))
                 return
             sys.stderr.write(
@@ -242,21 +307,24 @@ def main():
     raise SystemExit("all bench configs failed")
 
 
-def _run_kernel_check(budget_s: int = 180):
+def _run_aux_json(flag: str, budget_s: int) -> dict:
+    """Run ``bench.py <flag>`` in a subprocess, parse its JSON line."""
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--check"],
+            [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True,
             timeout=budget_s,
             text=True,
         )
         if out.returncode == 0 and out.stdout.strip():
-            return json.loads(
-                out.stdout.strip().splitlines()[-1]
-            )["kernels_ok"]
-    except (subprocess.TimeoutExpired, json.JSONDecodeError, KeyError):
+            return json.loads(out.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, json.JSONDecodeError):
         pass
-    return False
+    return {}
+
+
+def _run_kernel_check(budget_s: int = 180):
+    return _run_aux_json("--check", budget_s).get("kernels_ok", False)
 
 
 if __name__ == "__main__":

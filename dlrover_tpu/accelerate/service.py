@@ -1,0 +1,119 @@
+"""Acceleration-engine service: strategy search as a callable endpoint.
+
+Reference: atorch's engine split — auto/engine/servicer.py (a gRPC
+service running the strategy search/dryrun loop) with
+auto/engine_client.py on the trainer side. TPU framing: the search
+itself is analytic-first (accelerate/engine.py) and cheap, but the
+split still earns its keep when (a) one search brain serves many jobs
+(the Brain pairing), or (b) the measured modes should run somewhere
+with a chip while the client is a CPU-only submitter. The transport is
+the framework's own framed-JSON gRPC pair (common/comm.py) — no new
+protocol, no pickling.
+
+    server = EngineService(port=0)             # chip-side
+    client = EngineClient(f"127.0.0.1:{server.port}")
+    strategy, plan = client.search(cfg, n_devices=8, global_batch=32,
+                                   seq=256, mode="heuristic")
+"""
+
+import dataclasses
+import json
+
+from dlrover_tpu.common.comm import (
+    MasterTransportClient,
+    MasterTransportServer,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.models.config import ModelConfig
+
+logger = get_logger(__name__)
+
+
+def _cfg_to_json(cfg: ModelConfig) -> str:
+    return json.dumps(dataclasses.asdict(cfg))
+
+
+def _cfg_from_json(raw: str) -> ModelConfig:
+    return ModelConfig(**json.loads(raw))
+
+
+class _EngineServicer:
+    """report() is unused; get() answers StrategySearchRequest."""
+
+    def report(self, msg) -> bool:  # pragma: no cover - protocol stub
+        return True
+
+    def get(self, msg):
+        if not isinstance(msg, msgs.StrategySearchRequest):
+            return None
+        from dlrover_tpu.accelerate.engine import search_strategy
+        from dlrover_tpu.accelerate.strategy import strategy_to_json
+
+        try:
+            cfg = _cfg_from_json(msg.model_config_json)
+            strategy, plan = search_strategy(
+                cfg,
+                msg.n_devices,
+                msg.global_batch,
+                msg.seq,
+                mode=msg.mode,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.exception("strategy search failed")
+            return msgs.StrategySearchResponse(error=str(e))
+        return msgs.StrategySearchResponse(
+            strategy_json=strategy_to_json(strategy)
+        )
+
+
+class EngineService:
+    """Hosts the search engine behind the typed transport."""
+
+    def __init__(self, port: int = 0):
+        self._server = MasterTransportServer(_EngineServicer(), port=port)
+        self._server.start()
+        self.port = self._server.port
+
+    def stop(self):
+        self._server.stop()
+
+
+class EngineClient:
+    """Trainer-side: submit a model config, receive a strategy."""
+
+    def __init__(self, addr: str, timeout_s: float = 120.0):
+        self._t = MasterTransportClient(addr, timeout_s=timeout_s)
+
+    def search(
+        self,
+        cfg: ModelConfig,
+        n_devices: int,
+        global_batch: int,
+        seq: int,
+        mode: str = "heuristic",
+    ):
+        """Returns (strategy, plan) exactly like engine.search_strategy."""
+        from dlrover_tpu.accelerate.strategy import (
+            apply_strategy,
+            strategy_from_json,
+        )
+
+        resp = self._t.get(
+            msgs.StrategySearchRequest(
+                model_config_json=_cfg_to_json(cfg),
+                n_devices=n_devices,
+                global_batch=global_batch,
+                seq=seq,
+                mode=mode,
+            )
+        )
+        if resp is None:
+            raise RuntimeError("engine service unreachable")
+        if resp.error:
+            raise RuntimeError(f"strategy search failed: {resp.error}")
+        strategy = strategy_from_json(resp.strategy_json)
+        return strategy, apply_strategy(strategy)
+
+    def close(self):
+        self._t.close()

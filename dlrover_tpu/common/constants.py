@@ -15,6 +15,7 @@ class NodeType:
     COWORKER = "coworker"      # CPU-only data preprocessing host
     CHIEF = "chief"            # rank-0 coordination anchor (TF lineage)
     EVALUATOR = "evaluator"    # side-car eval host, outside the train mesh
+    PS = "ps"                  # sparse-tier KvServer host (sparse/server.py)
 
 
 class NodeStatus:

@@ -408,3 +408,25 @@ class PsVersionRequest:
 class PsVersionResponse:
     version: int = 0
     servers: List[str] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Acceleration-engine service (reference: auto/engine/servicer.py)
+# ---------------------------------------------------------------------------
+
+
+@message
+class StrategySearchRequest:
+    """Run a strategy search for a model config (accelerate/service.py)."""
+
+    model_config_json: str = ""
+    n_devices: int = 1
+    global_batch: int = 8
+    seq: int = 256
+    mode: str = "heuristic"
+
+
+@message
+class StrategySearchResponse:
+    strategy_json: str = ""
+    error: str = ""

@@ -76,6 +76,37 @@ class ElasticPsService:
             )
             return self._global_version
 
+    def add_server(self, name: str) -> int:
+        """Atomically add one server (idempotent). Returns the version.
+
+        The lifecycle callback runs on the servicer's thread pool —
+        concurrent registrations doing get_servers/set_servers would
+        lose each other's writes; membership edits must happen under
+        THIS lock."""
+        with self._lock:
+            if name in self._servers:
+                return self._global_version
+            self._servers = sorted([*self._servers, name])
+            self._global_version += 1
+            logger.info(
+                "sparse server %s joined (%d hosts) → version %d",
+                name, len(self._servers), self._global_version,
+            )
+            return self._global_version
+
+    def remove_server(self, name: str) -> int:
+        """Atomically remove one server (idempotent)."""
+        with self._lock:
+            if name not in self._servers:
+                return self._global_version
+            self._servers = [s for s in self._servers if s != name]
+            self._global_version += 1
+            logger.info(
+                "sparse server %s left (%d hosts) → version %d",
+                name, len(self._servers), self._global_version,
+            )
+            return self._global_version
+
     # ---- HRW weights (Brain hot-shard rebalance consumer) ----------------
 
     def get_weights(self) -> Dict[str, float]:
@@ -99,3 +130,50 @@ class ElasticPsService:
                 self._global_version,
             )
             return self._global_version
+
+
+class PsClusterCallback:
+    """Node-lifecycle → sparse server set: the master-side orchestration
+    of PS elasticity (reference: dlrover node/ps.py scale-in/out plans —
+    there the manager edits TF_CONFIG cluster specs; here membership IS
+    the versioned HRW ring workers re-route from).
+
+    Register on the JobManager's event-callback registry: PS-typed node
+    starts join the server set, failures/deletions leave it; each
+    membership change bumps the cluster version, which trainers observe
+    via get_ps_version → sparse.server.sync_with_master → bounded key
+    migration. Duck-typed to master/event_callback.NodeEventCallback.
+    """
+
+    def __init__(self, ps_service: ElasticPsService):
+        self._ps = ps_service
+
+    def _is_ps(self, node) -> bool:
+        from dlrover_tpu.common.constants import NodeType
+
+        return getattr(node, "type", None) == NodeType.PS
+
+    def _name(self, node) -> str:
+        return getattr(node, "name", None) or f"ps-{node.id}"
+
+    def on_node_started(self, node, ctx):
+        if self._is_ps(node):
+            # atomic: concurrent scale-out registrations must not lose
+            # each other's membership (callbacks run on the servicer's
+            # thread pool)
+            self._ps.add_server(self._name(node))
+
+    def on_node_succeeded(self, node, ctx):
+        # an exited PS is not serving regardless of exit status (clean
+        # drain / operator stop reports SUCCEEDED, not DELETED)
+        self._drop(node)
+
+    def on_node_failed(self, node, ctx):
+        self._drop(node)
+
+    def on_node_deleted(self, node, ctx):
+        self._drop(node)
+
+    def _drop(self, node):
+        if self._is_ps(node):
+            self._ps.remove_server(self._name(node))

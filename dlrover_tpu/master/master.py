@@ -143,6 +143,13 @@ class JobMaster:
                 on_job_failed=self._fail_job,
             )
         )
+        # PS-typed node lifecycle drives the versioned sparse server set
+        # (workers reroute via sync_with_master)
+        from dlrover_tpu.master.elastic_ps import PsClusterCallback
+
+        self.job_manager.event_callbacks.append(
+            PsClusterCallback(self.ps_service)
+        )
         self.job_manager.node_failed_callbacks.append(self._on_node_down)
 
     def _fail_job(self, reason: str):

@@ -143,6 +143,12 @@ class JobManager:
                     max_relaunch_count=self._relaunch_budget,
                 )
                 self._nodes[meta.node_id] = node
+            elif meta.node_type and node.type != meta.node_type:
+                # pre-created records default to WORKER; honor the
+                # registrant's declared role (a PS landing on a
+                # pre-created id must still enter the sparse tier —
+                # PsClusterCallback keys off node.type)
+                node.type = meta.node_type
             node.host_addr = meta.host_addr
             node.config_resource = NodeResource(
                 tpu_chips=meta.local_chips, tpu_type=meta.tpu_type

@@ -11,8 +11,8 @@ step's wall time) instead of a per-launch tax, and the breakdown is
 the ACTUAL executed schedule — fusions, collectives, transfers — not
 compile-time cost estimates (KernelCensus covers those).
 
-The breakdown feeds WorkerMetrics/Prometheus via ``prometheus_text``
-and the trainer's log stream via the ``RuntimeProfileCallback``.
+The breakdown feeds Prometheus via ``prometheus_text``; the Trainer
+wires sampling around its live step via ``TrainerArgs.profile_interval``.
 """
 
 import glob
@@ -22,7 +22,7 @@ import os
 import re
 import shutil
 import tempfile
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.log import get_logger

@@ -31,7 +31,7 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 

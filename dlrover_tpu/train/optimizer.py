@@ -447,7 +447,7 @@ def make_optimizer(
     (streamed_offload_adamw) — pair with
     ``init_train_state(offload_opt_state=True)``.
     """
-    if schedule == "none":
+    if schedule in ("none", "const", "constant"):
         lr = learning_rate
     else:
         lr = build_schedule(

@@ -1,0 +1,127 @@
+"""DeepFM over the MULTI-HOST sparse serving ring, with a live rebalance.
+
+    python examples/train_deepfm_serving.py --steps 40
+
+Exercises: two KvServer processes serving the embedding tier over TCP →
+DistributedEmbedding HRW routing (pull → jitted step → push) → a
+mid-run scale-out to a third server with bounded key migration
+(values + optimizer slots + admission state) → continued convergence.
+This is the elastic-PS capability of the reference's TF PS jobs
+(tensorflow_failover.py) on the TPU-native sparse tier.
+"""
+
+import argparse
+import multiprocessing as mp
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
+
+
+def _server_main(port_q, emb_dim, lr):
+    from dlrover_tpu.sparse import GroupAdam
+    from dlrover_tpu.sparse.embedding import EmbeddingSpec
+    from dlrover_tpu.sparse.server import KvServer
+
+    server = KvServer(
+        [
+            EmbeddingSpec("emb", emb_dim, initializer="normal",
+                          init_scale=0.01, seed=3),
+            EmbeddingSpec("wide", 1, initializer="zeros"),
+        ],
+        optimizer=GroupAdam(lr=lr),
+    )
+    port_q.put(server.address[1])
+    threading.Event().wait()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--batch", type=int, default=512)
+    args = ap.parse_args()
+    if args.steps < 2:
+        ap.error("--steps must be >= 2 (train halves flank the rebalance)")
+
+    from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+    from dlrover_tpu.sparse import GroupAdam
+    from dlrover_tpu.sparse.embedding import EmbeddingSpec
+    from dlrover_tpu.sparse.server import DistributedEmbedding
+
+    cfg = DeepFMConfig(n_fields=6, n_dense=4, emb_dim=8, mlp_dims=(32,))
+    ctx = mp.get_context("spawn")
+
+    def spawn(name):
+        q = ctx.Queue()
+        p = ctx.Process(
+            target=_server_main, args=(q, cfg.emb_dim, 5e-3), daemon=True
+        )
+        p.start()
+        return p, ("127.0.0.1", q.get(timeout=60))
+
+    procs, addrs = [], {}
+    for name in ("s0", "s1"):
+        p, addr = spawn(name)
+        procs.append(p)
+        addrs[name] = addr
+    print(f"[deepfm-serving] 2 sparse servers up: {addrs}")
+
+    specs = [
+        EmbeddingSpec("emb", cfg.emb_dim, initializer="normal",
+                      init_scale=0.01, seed=3),
+        EmbeddingSpec("wide", 1, initializer="zeros"),
+    ]
+    model = DeepFM(cfg, optimizer=GroupAdam(lr=5e-3), dense_lr=5e-3)
+    model.coll.close()
+    demb = DistributedEmbedding(specs, addrs)
+    model.coll = demb
+
+    rng = np.random.default_rng(0)
+    cat = rng.integers(0, 50, size=(args.batch, cfg.n_fields)).astype(
+        np.int64
+    )
+    dense = rng.normal(size=(args.batch, cfg.n_dense)).astype(np.float32)
+    hot = (cat % 7 == 0).sum(axis=1) + dense[:, 0]
+    labels = (
+        rng.random(args.batch) < 1.0 / (1.0 + np.exp(-(hot - 2.0)))
+    ).astype(np.float32)
+
+    half = args.steps // 2
+    first = None
+    for step in range(1, half + 1):
+        loss = model.train_step(cat, dense, labels)
+        first = first if first is not None else loss
+        if step % 10 == 0 or step == 1:
+            print(f"[deepfm-serving] step {step} loss {loss:.4f}")
+
+    p2, addr2 = spawn("s2")
+    procs.append(p2)
+    moved = demb.set_servers(dict(addrs, s2=addr2))
+    stats = demb.stats()
+    total = sum(s["emb"] for s in stats.values())
+    print(
+        f"[deepfm-serving] scaled 2->3 servers: {moved} keys migrated, "
+        f"{total} emb rows now on "
+        f"{ {s: c['emb'] for s, c in stats.items()} }"
+    )
+
+    for step in range(half + 1, args.steps + 1):
+        loss = model.train_step(cat, dense, labels)
+        if step % 10 == 0 or step == args.steps:
+            print(f"[deepfm-serving] step {step} loss {loss:.4f}")
+
+    ok = loss < first * 0.9
+    print(
+        f"[deepfm-serving] done: loss {first:.4f} -> {loss:.4f} "
+        f"({'converging' if ok else 'NOT CONVERGING'})"
+    )
+    demb.close()
+    for p in procs:
+        p.terminate()
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

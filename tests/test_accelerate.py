@@ -192,3 +192,42 @@ def test_device_context_probe():
     assert ctx.hbm_bytes == device_hbm_bytes()  # single source of truth
     assert not ctx.supports_fp8 and not fp8_supported()
     assert detect_device_context() is ctx  # lru-cached singleton
+
+
+def test_engine_service_round_trip():
+    """The engine client/servicer split (reference auto/engine/
+    servicer.py): a CPU-only client submits a model config over the
+    typed transport and gets back the same strategy an in-process
+    search would produce."""
+    from dlrover_tpu.accelerate.engine import search_strategy
+    from dlrover_tpu.accelerate.service import EngineClient, EngineService
+    from dlrover_tpu.models import get_config
+
+    cfg = get_config("tiny", n_layer=2, d_model=64, d_ff=128, n_head=4,
+                     vocab_size=128, max_seq=64)
+    service = EngineService(port=0)
+    client = EngineClient(f"127.0.0.1:{service.port}")
+    try:
+        strategy, plan = client.search(
+            cfg, n_devices=8, global_batch=16, seq=64, mode="heuristic"
+        )
+        local_strategy, local_plan = search_strategy(
+            cfg, 8, 16, 64, mode="heuristic"
+        )
+        assert strategy == local_strategy
+        assert plan.mesh.resolved_sizes(8) == (
+            local_plan.mesh.resolved_sizes(8)
+        )
+        # errors propagate as typed failures, not hangs
+        from dlrover_tpu.common import messages as msgs
+
+        resp = client._t.get(
+            msgs.StrategySearchRequest(
+                model_config_json="{not json", n_devices=8,
+                global_batch=8, seq=64,
+            )
+        )
+        assert resp.error
+    finally:
+        client.close()
+        service.stop()

@@ -107,3 +107,42 @@ def test_ps_version_rpc_roundtrip():
         msgs.PsVersionRequest(node_id=7, version_type="node")
     )
     assert resp2.version == 2
+
+
+def test_ps_cluster_callback_drives_server_set():
+    """Node lifecycle -> versioned server set (reference node/ps.py
+    scale plans): PS starts join the ring, failures leave it, worker
+    nodes are ignored, and each membership change bumps the version."""
+    from dataclasses import dataclass
+
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.master.elastic_ps import PsClusterCallback
+
+    @dataclass
+    class FakeNode:
+        id: int
+        type: str
+        name: str = ""
+
+    ps = ElasticPsService()
+    cb = PsClusterCallback(ps)
+
+    cb.on_node_started(FakeNode(0, NodeType.PS, "ps-a"), None)
+    cb.on_node_started(FakeNode(1, NodeType.PS, "ps-b"), None)
+    v2 = ps.get_global_version()
+    assert ps.get_servers() == ["ps-a", "ps-b"] and v2 == 2
+
+    # non-PS nodes never touch the ring
+    cb.on_node_started(FakeNode(5, NodeType.WORKER, "w-0"), None)
+    cb.on_node_failed(FakeNode(5, NodeType.WORKER, "w-0"), None)
+    assert ps.get_global_version() == v2
+
+    # duplicate start is idempotent (no spurious version churn)
+    cb.on_node_started(FakeNode(0, NodeType.PS, "ps-a"), None)
+    assert ps.get_global_version() == v2
+
+    cb.on_node_failed(FakeNode(0, NodeType.PS, "ps-a"), None)
+    assert ps.get_servers() == ["ps-b"]
+    assert ps.get_global_version() == v2 + 1
+    cb.on_node_deleted(FakeNode(1, NodeType.PS, "ps-b"), None)
+    assert ps.get_servers() == []
