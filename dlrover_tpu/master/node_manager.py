@@ -147,7 +147,12 @@ class JobManager:
                 # pre-created records default to WORKER; honor the
                 # registrant's declared role (a PS landing on a
                 # pre-created id must still enter the sparse tier —
-                # PsClusterCallback keys off node.type)
+                # PsClusterCallback keys off node.type). The default
+                # name derives from the type: refresh it too, or the PS
+                # ring would publish a stale "worker-N" entry that never
+                # resolves to the server's registered address
+                if node.name == f"{node.type}-{node.id}":
+                    node.name = f"{meta.node_type}-{node.id}"
                 node.type = meta.node_type
             node.host_addr = meta.host_addr
             node.config_resource = NodeResource(

@@ -84,6 +84,10 @@ class ModelConfig:
     # other meshes via parallel.pipeline.semantic_layer_perm).
     pp_interleave: int = 1
     pp_stages: int = 0
+    # stage-hop dtype override; None rides hops at the compute dtype
+    # (bf16 models → half the ICI bytes, numerically free — see
+    # parallel/pipeline.py module doc). Set "float32" to force wide hops.
+    pp_boundary_dtype: Optional[str] = None
     # muP (train/mup.py): width of the base model hyperparams were tuned
     # at; None = standard parametrization. When set, attention uses 1/d
     # scaling and tied logits get the 1/width_mult MuReadout multiplier.

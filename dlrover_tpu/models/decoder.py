@@ -437,6 +437,7 @@ def run_trunk(
             mesh,
             num_microbatches=cfg.pp_microbatches or None,
             interleave=v,
+            boundary_dtype=cfg.pp_boundary_dtype,
         )
     else:
         n_layers = jax.tree.leaves(layers)[0].shape[0]

@@ -21,17 +21,20 @@ Schedules:
   ticks → bubble (P−1)/(M·v+P−1) — the v× bubble cut of the reference's
   StageInterleaver, expressed as one SPMD scan.
 
-Stage-boundary dtype: ``boundary_dtype="bfloat16"`` moves half the ICI
-bytes per hop (via ``_bits_ppermute`` — the bits ride as uint16 so AD
-never differentiates an integer collective). The DEFAULT stays float32:
-differentiating the full decoder body over bf16 boundaries currently
-dies in XLA's SPMD partitioner with "Invalid binary instruction opcode
-copy" (repro: decoder.forward grad on a pp2·tp2·ep2 virtual-CPU mesh
-with cfg dtype=bfloat16 — isolated pipeline bodies incl. remat,
-sharding constraints, norms, softmax and rope all pass, so the trigger
-is some full-decoder op combination). Flip the default once the
-partitioner bug is fixed; the machinery and its parity test
-(test_pipeline.py::test_bf16_boundary_matches_f32) are in place.
+Stage-boundary dtype: hops ride at the COMPUTE dtype by default
+(``boundary_dtype=None`` → ``x.dtype``) — for a bf16 model that halves
+the ICI bytes per hop, and it is numerically free: stage outputs are
+already bf16-quantized, so a wider f32 hop would carry the same values.
+Sub-32-bit hops move as raw uint16 bits (``_bits_ppermute``) so AD never
+differentiates a narrow collective directly. Two XLA:SPMD partitioner
+pitfalls shape this code, both manifesting as the "Invalid binary
+instruction opcode copy" CHECK crash: (a) differentiating a bf16
+``ppermute`` chain (avoided by the bits ride + custom transpose), and
+(b) cotangents flowing back through a sub-32-bit microbatch FEED — the
+``jnp.where`` select + ``dynamic_index`` transpose over a bf16 ``xs``
+(avoided by keeping the feed/select path f32; it is device-local, so
+this costs no ICI traffic). Parity:
+test_pipeline.py::test_bf16_boundary_matches_f32.
 
 Gradients come from plain ``jax.grad`` through the scan — ``ppermute``'s
 transpose is the reverse permute, which *is* the backward pipeline.
@@ -111,7 +114,7 @@ def pipeline_apply(
     num_microbatches: Optional[int] = None,
     axis: str = "pp",
     interleave: int = 1,
-    boundary_dtype=None,  # stage-hop dtype; None → float32 (see module doc)
+    boundary_dtype=None,  # stage-hop dtype; None → compute (x.dtype)
 ) -> jax.Array:
     """Run the layer stack as a pp-stage pipeline; returns [B, S, D].
 
@@ -137,7 +140,7 @@ def pipeline_apply(
         )
 
     compute_dtype = x.dtype
-    bdt = jnp.dtype(boundary_dtype or jnp.float32)
+    bdt = jnp.dtype(boundary_dtype or compute_dtype)
 
     def local(layers_blk, x_all, pos_all):
         stage = jax.lax.axis_index(axis)
@@ -199,7 +202,14 @@ def pipeline_apply(
             p_cur = jax.lax.dynamic_index_in_dim(
                 pos, mb, 0, keepdims=False
             )
-            cur = jnp.where((stage == 0) & (j == 0), inp, buf)
+            # the select runs in f32 regardless of boundary dtype: the
+            # cotangent flowing back through a sub-32-bit xs feed (the
+            # where transpose + dynamic_update accumulation) is what
+            # trips XLA:SPMD's "Invalid binary instruction opcode copy"
+            # check — only the ppermute hop itself needs to be narrow
+            cur = jnp.where(
+                (stage == 0) & (j == 0), inp, buf.astype(jnp.float32)
+            )
             out = stage_apply(cur, p_cur, j)
             outs_upd = jax.lax.dynamic_update_index_in_dim(
                 outs, out.astype(jnp.float32), mb, 0
@@ -217,7 +227,7 @@ def pipeline_apply(
 
         init = jax.lax.pcast(
             (
-                jnp.zeros_like(xs[0]),
+                jnp.zeros(xs.shape[1:], bdt),
                 jnp.zeros(xs.shape, jnp.float32),
             ),
             (axis,),
@@ -239,7 +249,7 @@ def pipeline_apply(
         axis_names={axis},
         in_specs=(layer_specs, P(), P()),
         out_specs=P(),
-    )(layers, x.astype(bdt), positions)
+    )(layers, x.astype(jnp.float32), positions)
     return out.astype(compute_dtype)
 
 

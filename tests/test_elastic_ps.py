@@ -109,6 +109,28 @@ def test_ps_version_rpc_roundtrip():
     assert resp2.version == 2
 
 
+def test_ps_register_on_precreated_id_refreshes_ring_name():
+    """A PS landing on a pre-created worker id must enter the ring under
+    its PS name: node.type AND the default-derived node.name refresh, or
+    the ring would publish a stale 'worker-N' that never resolves to the
+    server's registered address (sync_with_master would defer the whole
+    set forever)."""
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.common.messages import NodeMeta
+    from dlrover_tpu.master.elastic_ps import PsClusterCallback
+    from dlrover_tpu.master.node_manager import JobManager
+
+    jm = JobManager(num_workers=2)
+    ps = ElasticPsService()
+    jm.event_callbacks.append(PsClusterCallback(ps))
+    node = jm.register_node(
+        NodeMeta(node_id=1, node_type=NodeType.PS)
+    )
+    assert node.type == NodeType.PS
+    assert node.name == f"{NodeType.PS}-1"
+    assert ps.get_servers() == [f"{NodeType.PS}-1"]
+
+
 def test_ps_cluster_callback_drives_server_set():
     """Node lifecycle -> versioned server set (reference node/ps.py
     scale plans): PS starts join the ring, failures leave it, worker

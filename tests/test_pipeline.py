@@ -192,6 +192,50 @@ def test_bf16_boundary_matches_f32():
     assert err / max(scale, 1e-6) < 5e-2
 
 
+def test_bf16_boundary_grad_through_input_feed():
+    """Regression: cotangents through the microbatch FEED with bf16
+    boundaries used to hit XLA:SPMD's "Invalid binary instruction
+    opcode copy" CHECK crash (the where-select/dynamic_index transpose
+    over a sub-32-bit xs). The fix keeps the feed path f32; this test
+    differentiates w.r.t. the pipeline INPUT — the exact crash shape —
+    and checks the grads against f32 hops."""
+    from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = build_mesh(MeshConfig(dp=4, pp=2))
+    L, B, S, D = 2, 8, 16, 32
+    w = (
+        jax.random.normal(jax.random.key(0), (L, D, D)) * 0.1
+    ).astype(jnp.bfloat16)
+    x = jax.random.normal(jax.random.key(1), (B, S, D)).astype(
+        jnp.bfloat16
+    )
+    pos = jnp.zeros((B, S), jnp.int32)
+
+    def body(c, layer, p):
+        return jnp.tanh(c @ layer)
+
+    def loss(w, x, bdt):
+        out = pipeline_apply(
+            body, w, x, pos, mesh, boundary_dtype=bdt
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    gw_bf, gx_bf = jax.jit(
+        jax.grad(lambda w, x: loss(w, x, "bfloat16"), argnums=(0, 1))
+    )(w, x)
+    gw_f32, gx_f32 = jax.jit(
+        jax.grad(lambda w, x: loss(w, x, "float32"), argnums=(0, 1))
+    )(w, x)
+    for a, b in ((gw_bf, gw_f32), (gx_bf, gx_f32)):
+        err = float(
+            jnp.max(
+                jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))
+            )
+        )
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32))))
+        assert err / max(scale, 1e-6) < 5e-2
+
+
 def test_semantic_layer_perm_roundtrip():
     from dlrover_tpu.parallel.pipeline import (
         interleaved_chunk_order,
