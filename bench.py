@@ -112,12 +112,17 @@ def check_kernels(b=2, s=1024, h=16, d=128) -> bool:
 
 
 def measure_mxu_ceiling(n_pairs: int = 40, reps: int = 5) -> dict:
-    """Achievable chained-matmul rate at the flagship's MLP shapes.
+    """Achievable chained-matmul rate at the flagship's MLP shapes, plus
+    the gpt2-1.5b fallback's shapes for comparison.
 
     The practical ceiling the step competes against — NOT the nominal
-    peak. Methodology matters under the axon relay: a single timed call
-    folds the ~100 ms host-readback into the measurement and reads
-    40-70% low; chaining ``reps`` calls and syncing once amortizes it.
+    peak. The second measurement quantifies the fallback config's
+    documented shape penalty (d=1600 is 12.5 MXU tiles, so every matmul
+    pads 1600 -> 1664): the bound the gpt2-1.5b MFU should be judged
+    against rides in the BENCH json instead of only in the README.
+    Methodology matters under the axon relay: a single timed call folds
+    the ~100 ms host-readback into the measurement and reads 40-70%
+    low; chaining ``reps`` calls and syncing once amortizes it.
     """
     import time as _time
 
@@ -129,35 +134,43 @@ def measure_mxu_ceiling(n_pairs: int = 40, reps: int = 5) -> dict:
         # timeout on the CPU fall-through path, and the ratio against
         # the 0.1-TFLOPS placeholder peak is meaningless anyway
         return {}
-    a0 = jax.random.normal(jax.random.key(5), (8192, 2048), jnp.bfloat16)
-    wm = jax.random.normal(jax.random.key(6), (2048, 5632), jnp.bfloat16)
-    wm = wm * 0.02
-    wn = jax.random.normal(jax.random.key(7), (5632, 2048), jnp.bfloat16)
-    wn = wn * 0.0005
-
-    @jax.jit
-    def chain(a):
-        def body(c, _):
-            c = jnp.dot(c, wm, preferred_element_type=jnp.bfloat16)
-            c = jnp.dot(c, wn, preferred_element_type=jnp.bfloat16)
-            return c, None
-
-        out, _ = jax.lax.scan(body, a, None, length=n_pairs)
-        return out
-
-    out = chain(a0)
-    float(jnp.sum(out.astype(jnp.float32)))  # warm + sync
-    t0 = _time.perf_counter()
-    for _ in range(reps):
-        out = chain(out)
-    float(jnp.sum(out.astype(jnp.float32)))
-    dt = _time.perf_counter() - t0
-    fl = 2 * 8192 * 2048 * 5632 * 2 * n_pairs * reps
-    tf = fl / dt / 1e12
     dev = jax.devices()[0]
+
+    def chained_rate(n, d, f):
+        a0 = jax.random.normal(jax.random.key(5), (n, d), jnp.bfloat16)
+        wm = jax.random.normal(jax.random.key(6), (d, f), jnp.bfloat16)
+        wm = wm * 0.02
+        wn = jax.random.normal(jax.random.key(7), (f, d), jnp.bfloat16)
+        wn = wn * 0.0005
+
+        @jax.jit
+        def chain(a):
+            def body(c, _):
+                c = jnp.dot(c, wm, preferred_element_type=jnp.bfloat16)
+                c = jnp.dot(c, wn, preferred_element_type=jnp.bfloat16)
+                return c, None
+
+            out, _ = jax.lax.scan(body, a, None, length=n_pairs)
+            return out
+
+        out = chain(a0)
+        float(jnp.sum(out.astype(jnp.float32)))  # warm + sync
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            out = chain(out)
+        float(jnp.sum(out.astype(jnp.float32)))
+        dt = _time.perf_counter() - t0
+        fl = 2 * n * d * f * 2 * n_pairs * reps
+        return fl / dt / 1e12
+
+    tf = chained_rate(8192, 2048, 5632)  # llama-1.4b MLP shapes
+    tf_gpt2 = chained_rate(8192, 1600, 6400)  # gpt2-1.5b MLP shapes
     return {
         "mxu_tflops": round(tf, 1),
         "mxu_ceiling_frac": round(tf / peak_tflops(dev), 4),
+        "mxu_ceiling_frac_gpt2_shapes": round(
+            tf_gpt2 / peak_tflops(dev), 4
+        ),
     }
 
 

@@ -236,6 +236,36 @@ def test_bf16_boundary_grad_through_input_feed():
         assert err / max(scale, 1e-6) < 5e-2
 
 
+def test_bf16_decoder_train_step_on_pp_mesh():
+    """Full bf16 decoder train step over bf16 stage hops (the default) —
+    the op-combination category where the partitioner crash actually
+    lived: isolated bodies always passed while the full decoder died.
+    Pins the feed-path-f32 workaround at decoder level, not just on a
+    toy body."""
+    cfg = get_config(
+        "tiny",
+        n_layer=2,
+        d_model=64,
+        d_ff=128,
+        n_head=4,
+        vocab_size=256,
+        max_seq=64,
+    )
+    assert cfg.dtype == "bfloat16"  # the default the fix protects
+    mesh = build_mesh(MeshConfig(dp=4, pp=2))
+    opt = make_optimizer(
+        learning_rate=1e-3, warmup_steps=2, decay_steps=10
+    )
+    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+    step = TrainStepBuilder(cfg, mesh, opt).build()
+    tokens = jax.random.randint(jax.random.key(1), (8, 64), 0, 256)
+    batch = jax.device_put(
+        {"tokens": tokens, "targets": tokens}, batch_sharding(mesh)
+    )
+    state, metrics = step(state, batch)
+    assert jnp.isfinite(metrics["loss"])
+
+
 def test_semantic_layer_perm_roundtrip():
     from dlrover_tpu.parallel.pipeline import (
         interleaved_chunk_order,
