@@ -78,6 +78,55 @@ def test_bounded_migration_on_server_addition():
     assert 0.1 < len(moves) / len(keys) < 0.3
 
 
+def test_migration_bounded_fuzz():
+    """HRW invariant under ARBITRARY membership changes (remove+add in
+    one step, weight changes): a key moves only if its old owner left
+    or its new owner just arrived/gained weight — never between two
+    untouched servers."""
+    rng = np.random.RandomState(7)
+    keys = np.arange(8000)
+    for trial in range(10):
+        n = rng.randint(3, 8)
+        old = [f"s{i}" for i in range(n)]
+        removed = set(
+            s for s in old if rng.rand() < 0.3 and len(old) > 2
+        )
+        survivors = [s for s in old if s not in removed]
+        if not survivors:
+            survivors = old[:1]
+            removed = set(old[1:])
+        added = [f"new{trial}_{j}" for j in range(rng.randint(0, 3))]
+        new = survivors + added
+        moves = migration_plan(keys, old, new)
+        old_owner = dict(
+            zip(keys.tolist(), np.asarray(assign_servers(keys, old)))
+        )
+        for key, src, dst in moves:
+            assert src != dst
+            # every move's source must be the true old owner
+            assert old[old_owner[key]] == src
+            # and the move must be explained by the membership change
+            assert (src in removed) or (dst in added), (
+                f"trial {trial}: {key} moved {src}->{dst} though "
+                "neither endpoint changed"
+            )
+
+
+def test_weight_change_moves_keys_only_to_or_from_that_server():
+    """Weighted HRW: raising one server's weight pulls keys TO it only;
+    nothing migrates between other pairs (the Brain's hot-shard
+    rebalance relies on this)."""
+    servers = [f"h{i}" for i in range(5)]
+    keys = np.arange(10000)
+    base = assign_servers(keys, servers)
+    boosted = assign_servers(
+        keys, servers, weights={"h2": 3.0}
+    )
+    changed = base != boosted
+    # every changed key now lands on h2
+    assert set(np.asarray(boosted)[changed].tolist()) <= {2}
+
+
 def test_empty_server_list_raises():
     with pytest.raises(ValueError):
         assign_servers([1, 2], [])

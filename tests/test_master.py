@@ -254,6 +254,70 @@ def test_rendezvous_restart_bumps_round(master):
     assert world == {0: 4, 1: 4}
 
 
+def test_rendezvous_concurrent_join_storm():
+    """Stress: many threads join/poll/crash/rejoin concurrently. The
+    sealed world must always be internally consistent — contiguous rank
+    set from the waiting pool, node_unit multiple, one coordinator —
+    and a post-storm rendezvous must still seal (no wedged state)."""
+    import threading
+
+    import numpy as np
+
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(
+        min_nodes=4, max_nodes=8, waiting_timeout=0.05, node_unit=2
+    )
+    stop = time.time() + 2.0
+    errors = []
+
+    def node(rank):
+        rng = np.random.RandomState(rank)
+        try:
+            while time.time() < stop:
+                mgr.join_rendezvous(rank, rank, 4, f"h{rank}")
+                for _ in range(rng.randint(1, 20)):
+                    rnd, _, world, coord = mgr.get_comm_world(rank)
+                    if world:
+                        # invariants on any observed sealed world
+                        if len(world) % 2:
+                            errors.append(f"odd world {world}")
+                        if not (4 <= len(world) <= 8):
+                            errors.append(f"size {len(world)}")
+                        if rank in world and not coord:
+                            errors.append("sealed without coordinator")
+                        break
+                    time.sleep(0.001)
+                if rng.rand() < 0.3:
+                    mgr.remove_alive_node(rank)  # simulated crash
+                time.sleep(rng.rand() * 0.01)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=node, args=(r,)) for r in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:5]
+
+    # post-storm: a clean rendezvous still seals
+    for r in range(4):
+        mgr.join_rendezvous(r, r, 4, f"h{r}")
+    deadline = time.time() + 2
+    world = {}
+    while time.time() < deadline and not world:
+        _, _, world, coord = mgr.get_comm_world(0)
+        time.sleep(0.01)
+    assert sorted(world) == [0, 1, 2, 3]
+    assert coord
+
+
 def test_data_sharding_dispatch_and_requeue(master):
     c0, c1 = _client(master, 0), _client(master, 1)
     c0.report_dataset_shard_params(
