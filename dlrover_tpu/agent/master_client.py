@@ -305,6 +305,37 @@ class MasterClient:
         resp = self._t.get(msgs.ParallelConfigRequest(node_id=self.node_id))
         return resp or msgs.ParallelConfig()
 
+    def report_model_info(
+        self,
+        model_name: str = "",
+        num_params: int = 0,
+        flops_per_token: float = 0.0,
+        global_batch_size: int = 0,
+        seq_len: int = 0,
+        strategy_json: str = "",
+    ) -> bool:
+        """Model/job statistics for metrics + the Brain optimizer
+        (reference: master_client.py:217 report_model_info)."""
+        return self._t.report(
+            msgs.ModelInfoReport(
+                node_id=self.node_id,
+                model_name=model_name,
+                num_params=num_params,
+                flops_per_token=flops_per_token,
+                global_batch_size=global_batch_size,
+                seq_len=seq_len,
+                strategy_json=strategy_json,
+            )
+        )
+
+    def get_running_nodes(self) -> list:
+        """Live node listing (reference: master_client.py
+        get_running_nodes)."""
+        resp = self._t.get(
+            msgs.RunningNodesRequest(node_id=self.node_id)
+        )
+        return list(resp.nodes) if resp else []
+
     def close(self):
         self._t.close()
 

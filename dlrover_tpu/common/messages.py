@@ -161,6 +161,44 @@ class ResourceStats:
     hbm_used_mb: float = 0.0
 
 
+@message
+class ModelInfoReport:
+    """Model/job statistics for the metrics collector and the Brain
+    resource optimizer (reference: grpc.ModelInfo, servicer.py:413
+    _collect_model_info)."""
+
+    node_id: int = 0
+    model_name: str = ""
+    num_params: int = 0
+    flops_per_token: float = 0.0
+    global_batch_size: int = 0
+    seq_len: int = 0
+    strategy_json: str = ""
+
+
+@message
+class RunningNodesRequest:
+    node_id: int = 0
+
+
+@message
+class NodeInfo:
+    id: int = 0
+    type: str = "worker"
+    name: str = ""
+    status: str = ""
+    host_addr: str = ""
+    rank_index: int = 0
+
+
+@message
+class RunningNodesResponse:
+    """Live node listing (reference: master_client.py get_running_nodes
+    → job_manager.get_running_nodes, dist_job_manager.py:701)."""
+
+    nodes: List[NodeInfo] = field(default_factory=list)
+
+
 # ---------------------------------------------------------------------------
 # Rendezvous (reference: rdzv_manager.py + master_client.py:300-360)
 # ---------------------------------------------------------------------------

@@ -96,6 +96,7 @@ class JobMaster:
             diagnosis_manager=self.diagnosis_manager,
             ps_service=self.ps_service,
             goodput_tracker=self.goodput_tracker,
+            metric_collector=self.metric_collector,
         )
         self.server = MasterTransportServer(self.servicer, port=port)
 

@@ -27,6 +27,7 @@ class MasterServicer:
         diagnosis_manager=None,
         ps_service=None,
         goodput_tracker=None,
+        metric_collector=None,
     ):
         self.job_manager = job_manager
         self.task_manager = task_manager
@@ -37,6 +38,7 @@ class MasterServicer:
         self.diagnosis_manager = diagnosis_manager
         self.ps_service = ps_service
         self.goodput_tracker = goodput_tracker
+        self.metric_collector = metric_collector
         self._ckpt_steps = {}  # node_rank -> step (flash-ckpt rank sync)
 
     # ---- report: fire-and-forget ----------------------------------------
@@ -198,7 +200,20 @@ class MasterServicer:
             self.ps_service.set_node_version(m.node_id, m.version)
         return True
 
+    def _report_model_info(self, m: msgs.ModelInfoReport) -> bool:
+        if self.metric_collector:
+            self.metric_collector.set_job_meta(
+                model_name=m.model_name,
+                num_params=m.num_params,
+                flops_per_token=m.flops_per_token,
+                global_batch_size=m.global_batch_size,
+                seq_len=m.seq_len,
+                strategy_json=m.strategy_json,
+            )
+        return True
+
     _REPORT_HANDLERS = {
+        "ModelInfoReport": _report_model_info,
         "PsVersionReport": _report_ps_version,
         "HeartbeatReport": _report_heartbeat,
         "NodeStatusReport": _report_node_status,
@@ -344,7 +359,25 @@ class MasterServicer:
             version=version, servers=list(self.ps_service.get_servers())
         )
 
+    def _get_running_nodes(self, m: msgs.RunningNodesRequest):
+        if not self.job_manager:
+            return msgs.RunningNodesResponse()
+        return msgs.RunningNodesResponse(
+            nodes=[
+                msgs.NodeInfo(
+                    id=n.id,
+                    type=n.type,
+                    name=n.name,
+                    status=n.status,
+                    host_addr=n.host_addr or "",
+                    rank_index=n.rank_index,
+                )
+                for n in self.job_manager.running_nodes()
+            ]
+        )
+
     _GET_HANDLERS = {
+        "RunningNodesRequest": _get_running_nodes,
         "PsVersionRequest": _get_ps_version,
         "HeartbeatReport": _get_heartbeat,
         "NodeRegisterRequest": _get_register,

@@ -117,10 +117,12 @@ def test_pack_roundtrip_sharded():
 
 def test_pack_reshard_fuzz():
     """Randomized pack→restore across sharding layouts: random shapes,
-    dtypes, and source/target PartitionSpecs (incl. uneven last shards
-    via non-divisible dims padded up by the sharding). Any offset/slice
-    bug in the pack format shows up as a value mismatch here long
-    before a multi-host scale event would find it."""
+    dtypes, and source/target PartitionSpecs. Dims are kept divisible
+    by every axis combo because jax's NamedSharding device_put rejects
+    uneven dims outright — unevenly-sharded leaves cannot exist in this
+    framework. Any offset/slice bug in the pack format shows up as a
+    value mismatch here long before a multi-host scale event would
+    find it."""
     mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
     rng = np.random.RandomState(0)
     axes_pool = [None, "dp", "fsdp", "tp", ("dp", "fsdp")]

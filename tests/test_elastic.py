@@ -94,10 +94,10 @@ def test_sampler_resume_fuzz_covers_epoch_exactly_once():
             f"trial {trial}: replayed "
             f"{sorted(set(consumed) & set(resumed))[:5]}"
         )
-        # and together both phases cover the whole epoch
-        assert set(consumed) | set(resumed) == set(range(n)) or (
-            len(set(consumed) | set(resumed)) >= n - world * bs
-        ), f"trial {trial} lost samples"
+        # and together both phases cover the whole epoch exactly
+        assert set(consumed) | set(resumed) == set(range(n)), (
+            f"trial {trial} lost samples"
+        )
 
 
 def test_dataloader_with_sampler_and_reconfig(tmp_path):

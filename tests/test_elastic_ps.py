@@ -88,13 +88,12 @@ def test_migration_bounded_fuzz():
     for trial in range(10):
         n = rng.randint(3, 8)
         old = [f"s{i}" for i in range(n)]
-        removed = set(
-            s for s in old if rng.rand() < 0.3 and len(old) > 2
-        )
+        # remove randomly but always keep at least one survivor
+        removed = set()
+        for s in old[1:]:
+            if rng.rand() < 0.3:
+                removed.add(s)
         survivors = [s for s in old if s not in removed]
-        if not survivors:
-            survivors = old[:1]
-            removed = set(old[1:])
         added = [f"new{trial}_{j}" for j in range(rng.randint(0, 3))]
         new = survivors + added
         moves = migration_plan(keys, old, new)

@@ -254,6 +254,30 @@ def test_rendezvous_restart_bumps_round(master):
     assert world == {0: 4, 1: 4}
 
 
+def test_model_info_and_running_nodes(master):
+    """report_model_info lands in the metrics collector's JobMeta (the
+    Brain optimizer's input); get_running_nodes lists the live world
+    (reference: master_client.py report_model_info/get_running_nodes)."""
+    c0 = _client(master, 0)
+    c1 = _client(master, 1)
+    assert c0.report_model_info(
+        model_name="llama-1.4b",
+        num_params=1_360_000_000,
+        flops_per_token=8.2e9,
+        global_batch_size=8,
+        seq_len=1024,
+    )
+    meta = master.metric_collector.meta
+    assert meta.model_name == "llama-1.4b"
+    assert meta.num_params == 1_360_000_000
+    assert meta.seq_len == 1024
+
+    nodes = c1.get_running_nodes()
+    assert {n.id for n in nodes} == {0, 1}
+    assert all(n.status == "running" for n in nodes)
+    assert {n.rank_index for n in nodes} == {0, 1}
+
+
 def test_rendezvous_concurrent_join_storm():
     """Stress: many threads join/poll/crash/rejoin concurrently. The
     sealed world must always be internally consistent — contiguous rank
@@ -306,7 +330,11 @@ def test_rendezvous_concurrent_join_storm():
         t.join()
     assert not errors, errors[:5]
 
-    # post-storm: a clean rendezvous still seals
+    # post-storm: clear every storm leftover (waiting stragglers AND a
+    # possibly still-sealed world), then a clean rendezvous must seal —
+    # proving the storm cannot wedge the manager's internal state.
+    for r in range(8):
+        mgr.remove_alive_node(r)
     for r in range(4):
         mgr.join_rendezvous(r, r, 4, f"h{r}")
     deadline = time.time() + 2
@@ -314,7 +342,7 @@ def test_rendezvous_concurrent_join_storm():
     while time.time() < deadline and not world:
         _, _, world, coord = mgr.get_comm_world(0)
         time.sleep(0.01)
-    assert sorted(world) == [0, 1, 2, 3]
+    assert sorted(world) == [0, 1, 2, 3], world
     assert coord
 
 
