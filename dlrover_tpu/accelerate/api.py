@@ -37,6 +37,14 @@ class AccelerateResult:
     init_state: Callable          # (rng) -> sharded TrainState
     batch_sharding: Any
     eval_step: Optional[Callable] = None
+    # the optimizer and the fully-configured TrainStepBuilder the plan
+    # lowered to (sp attention override, offload_opt_state, grad_accum
+    # all applied). To drive the plan through the high-level loop, hand
+    # Trainer BOTH: Trainer(..., optimizer=res.optimizer,
+    # step_builder=res.step_builder, init_state_fn=res.init_state) —
+    # rebuilding from the raw plan fields would drop the overrides.
+    optimizer: Any = None
+    step_builder: Any = None
 
 
 def auto_accelerate(
@@ -84,4 +92,6 @@ def auto_accelerate(
         init_state=init_state,
         batch_sharding=bsh,
         eval_step=build_eval_step(cfg2, mesh, attn_impl=plan.attn_impl),
+        optimizer=opt,
+        step_builder=builder,
     )

@@ -202,14 +202,21 @@ class MasterServicer:
 
     def _report_model_info(self, m: msgs.ModelInfoReport) -> bool:
         if self.metric_collector:
-            self.metric_collector.set_job_meta(
-                model_name=m.model_name,
-                num_params=m.num_params,
-                flops_per_token=m.flops_per_token,
-                global_batch_size=m.global_batch_size,
-                seq_len=m.seq_len,
-                strategy_json=m.strategy_json,
-            )
+            # partial update: unset (zero/empty) fields must not clobber
+            # values another reporter already provided
+            kw = {
+                k: v
+                for k, v in (
+                    ("model_name", m.model_name),
+                    ("num_params", m.num_params),
+                    ("flops_per_token", m.flops_per_token),
+                    ("global_batch_size", m.global_batch_size),
+                    ("seq_len", m.seq_len),
+                    ("strategy_json", m.strategy_json),
+                )
+                if v
+            }
+            self.metric_collector.set_job_meta(**kw)
         return True
 
     _REPORT_HANDLERS = {

@@ -85,7 +85,15 @@ class Trainer:
         loss_fn: Optional[Callable] = None,
         rules=None,
         callbacks: Optional[List[Callback]] = None,
+        step_builder: Optional[TrainStepBuilder] = None,
+        init_state_fn: Optional[Callable] = None,
     ):
+        """``step_builder``/``init_state_fn``: hand in a fully-configured
+        TrainStepBuilder + state initializer (e.g. from
+        ``auto_accelerate`` — AccelerateResult.step_builder/.init_state)
+        instead of the one built here from args. This preserves plan
+        details TrainerArgs cannot express (sp attention override,
+        offloaded optimizer state born on host)."""
         self.cfg = cfg
         self.args = args
         self.mesh = mesh if mesh is not None else build_mesh(
@@ -95,7 +103,8 @@ class Trainer:
         self.train_iter = iter(train_iter)
         self.eval_iter_fn = eval_iter_fn
         self.client = master_client
-        self._builder = TrainStepBuilder(
+        self._init_state_fn = init_state_fn
+        self._builder = step_builder or TrainStepBuilder(
             cfg,
             self.mesh,
             optimizer,
@@ -151,12 +160,17 @@ class Trainer:
         return self._ckpt
 
     def _init_state(self):
-        self.state = init_train_state(
-            jax.random.key(self.args.seed),
-            self.cfg,
-            self.mesh,
-            self.optimizer,
-        )
+        if self._init_state_fn is not None:
+            self.state = self._init_state_fn(
+                jax.random.key(self.args.seed)
+            )
+        else:
+            self.state = init_train_state(
+                jax.random.key(self.args.seed),
+                self.cfg,
+                self.mesh,
+                self.optimizer,
+            )
         if not self.args.resume:
             return
         from dlrover_tpu.checkpoint.checkpointer import state_template
@@ -178,6 +192,24 @@ class Trainer:
             self._init_state()
         if self._step_fn is None:
             self._step_fn = self._builder.build()
+        if (
+            self.client is not None
+            and args.report_to_master
+            and jax.process_index() == 0
+        ):
+            # model statistics → master JobMeta → Brain optimizer input
+            # (reference: master_client.py report_model_info)
+            try:
+                self.client.report_model_info(
+                    model_name=self.cfg.name,
+                    num_params=self.cfg.num_params(),
+                    flops_per_token=self.cfg.flops_per_token(
+                        self.cfg.max_seq
+                    ),
+                    seq_len=self.cfg.max_seq,
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning("model-info report failed", exc_info=True)
         start = int(self.state["step"])
         control = self.control
         self.callbacks.fire("on_train_begin", self, control)

@@ -202,6 +202,65 @@ def test_elastic_remesh_resume(tmp_path, monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+def test_trainer_reports_model_info(tmp_path):
+    """The trainer announces model statistics to the master once at
+    train() start (reference: atorch report_model_info → Brain)."""
+
+    class FakeClient:
+        def __init__(self):
+            self.model_info = None
+            self.steps = []
+
+        def report_model_info(self, **kw):
+            self.model_info = kw
+            return True
+
+        def report_global_step(self, step, n):
+            self.steps.append(step)
+            return True
+
+    cfg = _cfg()
+    client = FakeClient()
+    args = TrainerArgs(
+        output_dir=str(tmp_path), max_steps=3, save_interval=0,
+        log_interval=0, resume=False, report_to_master=True,
+    )
+    t = Trainer(
+        cfg, args, _data_iter(), make_optimizer(learning_rate=1e-3),
+        mesh=build_mesh(MeshConfig(dp=8)), master_client=client,
+    )
+    t.train()
+    assert client.model_info is not None
+    assert client.model_info["model_name"] == cfg.name
+    assert client.model_info["num_params"] == cfg.num_params()
+    assert client.model_info["seq_len"] == cfg.max_seq
+
+
+def test_trainer_drives_auto_accelerate_plan(tmp_path):
+    """auto_accelerate → Trainer integration: the plan's lowering
+    (step builder + state initializer) drives the high-level loop
+    unchanged — no re-derivation from TrainerArgs that could drop the
+    sp/offload overrides."""
+    from dlrover_tpu.accelerate.api import auto_accelerate
+
+    cfg = _cfg()
+    res = auto_accelerate(cfg, global_batch=8, seq=32)
+    args = TrainerArgs(
+        output_dir=str(tmp_path), max_steps=3, save_interval=0,
+        log_interval=0, resume=False, report_to_master=False,
+    )
+    t = Trainer(
+        res.model_config, args, _data_iter(), res.optimizer,
+        mesh=res.mesh,
+        step_builder=res.step_builder,
+        init_state_fn=res.init_state,
+    )
+    state = t.train()
+    assert int(state["step"]) == 3
+    # the trainer really used the plan's builder, not its own
+    assert t._builder is res.step_builder
+
+
 def test_trainer_callbacks_fire_and_log_lr(tmp_path):
     import json
 
