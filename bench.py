@@ -62,21 +62,32 @@ _ATTEMPTS = [
 
 
 def check_kernels(b=2, s=1024, h=16, d=128) -> bool:
-    """On-chip numerics gate: Pallas flash fwd+bwd vs mha_reference.
+    """On-chip numerics gate for BOTH hand-written gradients in the hot
+    path: the Pallas flash kernels (fwd+bwd vs mha_reference) and the
+    fused lm-head cross-entropy custom_vjp (vs the materialized-logits
+    path).
 
-    Runs at bench-like shapes on the REAL device (tests/test_ops.py
-    covers interpret mode on CPU only), so silent tile/clamp
-    regressions in the kernel show up in the BENCH json as
+    Runs at bench-like shapes on the REAL device (tests/test_ops.py and
+    tests/test_fused_ce.py cover CPU/interpret mode only), so silent
+    tile/clamp/chunk regressions show up in the BENCH json as
     kernels_ok=false instead of as quietly-wrong training.
     """
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from dlrover_tpu.ops.attention import mha_reference
     from dlrover_tpu.ops.pallas_attention import flash_attention
 
     if jax.default_backend() == "cpu":
         return True  # the CPU fall-through path has no kernel to check
+
+    def close(a, b, tol):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        denom = np.maximum(np.abs(b).max(), 1e-6)
+        return float(np.abs(a - b).max() / denom) < tol
+
     ks = jax.random.split(jax.random.key(7), 3)
     q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
     k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
@@ -97,15 +108,40 @@ def check_kernels(b=2, s=1024, h=16, d=128) -> bool:
     (lr_, orr), gr = jax.jit(
         jax.value_and_grad(loss_ref, argnums=(0, 1, 2), has_aux=True)
     )(q, k, v)
-    import numpy as np
-
-    def close(a, b, tol):
-        a = np.asarray(a, np.float32)
-        b = np.asarray(b, np.float32)
-        denom = np.maximum(np.abs(b).max(), 1e-6)
-        return float(np.abs(a - b).max() / denom) < tol
 
     ok = close(of, orr, 2e-2)
+    for a, b_ in zip(gf, gr):
+        ok = ok and close(a, b_, 3e-2)
+    return bool(ok) and _check_fused_ce(close)
+
+
+def _check_fused_ce(close, b=2, s=512, dm=2048, v=32000) -> bool:
+    """Fused CE vs materialized logits: logz + grads w.r.t. x and w."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.ops.fused_ce import fused_linear_ce
+
+    kx, kw, kt = jax.random.split(jax.random.key(11), 3)
+    x = jax.random.normal(kx, (b, s, dm), jnp.bfloat16)
+    w = jax.random.normal(kw, (dm, v), jnp.bfloat16) * 0.02
+    t = jax.random.randint(kt, (b, s), 0, v)
+
+    def nll_fused(x, w):
+        logz, tgt, _ = fused_linear_ce(x, w, t)
+        return jnp.mean(logz - tgt)
+
+    def nll_ref(x, w):
+        logits = jnp.einsum(
+            "bsd,dv->bsv", x, w, preferred_element_type=jnp.float32
+        )
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t[..., None], -1)[..., 0]
+        return jnp.mean(logz - tgt)
+
+    lf, gf = jax.jit(jax.value_and_grad(nll_fused, argnums=(0, 1)))(x, w)
+    lr, gr = jax.jit(jax.value_and_grad(nll_ref, argnums=(0, 1)))(x, w)
+    ok = abs(float(lf) - float(lr)) / max(abs(float(lr)), 1e-6) < 1e-2
     for a, b_ in zip(gf, gr):
         ok = ok and close(a, b_, 3e-2)
     return bool(ok)

@@ -40,9 +40,10 @@ class AccelerateResult:
     # the optimizer and the fully-configured TrainStepBuilder the plan
     # lowered to (sp attention override, offload_opt_state, grad_accum
     # all applied). To drive the plan through the high-level loop, hand
-    # Trainer BOTH: Trainer(..., optimizer=res.optimizer,
-    # step_builder=res.step_builder, init_state_fn=res.init_state) —
-    # rebuilding from the raw plan fields would drop the overrides.
+    # Trainer the full lowering: Trainer(..., optimizer=res.optimizer,
+    # step_builder=res.step_builder, init_state_fn=res.init_state,
+    # eval_step_fn=res.eval_step) — rebuilding from the raw plan fields
+    # would drop the overrides (for eval too).
     optimizer: Any = None
     step_builder: Any = None
 
@@ -91,7 +92,11 @@ def auto_accelerate(
         train_step=builder.build(),
         init_state=init_state,
         batch_sharding=bsh,
-        eval_step=build_eval_step(cfg2, mesh, attn_impl=plan.attn_impl),
+        # builder.attn_impl carries the EFFECTIVE choice (sp meshes
+        # override plan.attn_impl to the sp_mode) — eval must match
+        eval_step=build_eval_step(
+            cfg2, mesh, attn_impl=builder.attn_impl
+        ),
         optimizer=opt,
         step_builder=builder,
     )

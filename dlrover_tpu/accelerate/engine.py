@@ -259,6 +259,15 @@ def search_strategy(
     max_measured: int = 6,
     devices=None,
 ) -> Tuple[Strategy, AccelerationPlan]:
+    if mode == "measured":  # common alias
+        mode = "measure"
+    if mode not in ("heuristic", "cost", "measure", "bo"):
+        # an unknown mode used to silently fall through to the measure
+        # loop — fail loudly instead
+        raise ValueError(
+            f"unknown search mode {mode!r}: expected "
+            "heuristic | cost | measure | bo"
+        )
     hbm = device_hbm_bytes()
     batch_per_chip = max(1, global_batch // n_devices)
     feasible: List[Tuple[float, Strategy, AccelerationPlan]] = []

@@ -87,13 +87,14 @@ class Trainer:
         callbacks: Optional[List[Callback]] = None,
         step_builder: Optional[TrainStepBuilder] = None,
         init_state_fn: Optional[Callable] = None,
+        eval_step_fn: Optional[Callable] = None,
     ):
-        """``step_builder``/``init_state_fn``: hand in a fully-configured
-        TrainStepBuilder + state initializer (e.g. from
-        ``auto_accelerate`` — AccelerateResult.step_builder/.init_state)
-        instead of the one built here from args. This preserves plan
-        details TrainerArgs cannot express (sp attention override,
-        offloaded optimizer state born on host)."""
+        """``step_builder``/``init_state_fn``/``eval_step_fn``: hand in
+        the fully-configured lowering (e.g. from ``auto_accelerate`` —
+        AccelerateResult.step_builder/.init_state/.eval_step) instead of
+        the ones built here from args. This preserves plan details
+        TrainerArgs cannot express (sp attention override, offloaded
+        optimizer state born on host) across training AND eval."""
         self.cfg = cfg
         self.args = args
         self.mesh = mesh if mesh is not None else build_mesh(
@@ -114,7 +115,7 @@ class Trainer:
             attn_impl=args.attn_impl,
         )
         self._step_fn = None
-        self._eval_fn = None
+        self._eval_fn = eval_step_fn
         self._batch_sharding = batch_sharding(self.mesh, rules)
         self.state: Any = None
         self.timer = StepTimer(

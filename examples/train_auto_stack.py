@@ -44,7 +44,7 @@ def main():
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=64)
     p.add_argument("--search", default="heuristic",
-                   choices=["heuristic", "cost", "measured", "bo"])
+                   choices=["heuristic", "cost", "measure", "bo"])
     p.add_argument("--ckpt-dir", default="/tmp/dlrover_tpu_auto_ckpt")
     args = p.parse_args()
 
@@ -89,6 +89,7 @@ def main():
         callbacks=[LRLoggingCallback()],
         step_builder=res.step_builder,
         init_state_fn=res.init_state,
+        eval_step_fn=res.eval_step,
     )
     state = trainer.train()
     print(f"[auto-stack] done at step {int(state['step'])}", flush=True)

@@ -248,17 +248,22 @@ def test_trainer_drives_auto_accelerate_plan(tmp_path):
     args = TrainerArgs(
         output_dir=str(tmp_path), max_steps=3, save_interval=0,
         log_interval=0, resume=False, report_to_master=False,
+        eval_at_end=True, eval_steps=2,
     )
     t = Trainer(
         res.model_config, args, _data_iter(), res.optimizer,
         mesh=res.mesh,
+        eval_iter_fn=lambda: _data_iter(seed=1),
         step_builder=res.step_builder,
         init_state_fn=res.init_state,
+        eval_step_fn=res.eval_step,
     )
     state = t.train()
     assert int(state["step"]) == 3
-    # the trainer really used the plan's builder, not its own
+    # the trainer really used the plan's lowering, not its own — for
+    # the train step AND eval (the sp/offload overrides live there)
     assert t._builder is res.step_builder
+    assert t._eval_fn is res.eval_step
 
 
 def test_trainer_callbacks_fire_and_log_lr(tmp_path):
