@@ -21,9 +21,9 @@ _lock = threading.Lock()
 _lib = None
 
 
-def _source_hash() -> str:
+def _source_hash(files=None) -> str:
     h = hashlib.sha256()
-    for rel in _SOURCES + _HEADERS:
+    for rel in (_SOURCES + _HEADERS if files is None else files):
         with open(os.path.join(_SRC_DIR, rel), "rb") as f:
             h.update(f.read())
     return h.hexdigest()[:16]
@@ -115,3 +115,39 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.kv_opt_slots.argtypes = [i32]
     lib.kv_sparse_apply.restype = i64
     lib.kv_sparse_apply.argtypes = [i64, i32, pi64, i32, pf32, pf32, u32]
+
+
+def build_and_run_cc_tests(timeout_s: int = 120) -> str:
+    """Compile + execute the native assert-based test binary
+    (src/kv_store_test.cc — the reference's C++ suite analog,
+    tfplus kv_variable_test.cc). Returns the binary's stdout; raises on
+    compile failure, CHECK failure, or crash. Cached by source hash like
+    the library build."""
+    test_src = os.path.join(_SRC_DIR, "src", "kv_store_test.cc")
+    # key by exactly the files the binary is built from
+    digest = _source_hash(
+        ["src/kv_store.cc", "src/kv_store.h", "src/kv_store_test.cc"]
+    )
+    exe = os.path.join(_SRC_DIR, f"_kv_store_test_{digest}")
+    if not os.path.exists(exe):
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-g",
+            "-I", os.path.join(_SRC_DIR, "src"),
+            os.path.join(_SRC_DIR, "src", "kv_store.cc"),
+            test_src, "-o", exe, "-lpthread",
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native test build failed:\n$ {' '.join(cmd)}\n{e.stderr}"
+            ) from e
+    out = subprocess.run(
+        [exe], capture_output=True, text=True, timeout=timeout_s
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"native tests failed (rc={out.returncode}):\n"
+            f"{out.stdout}{out.stderr}"
+        )
+    return out.stdout
