@@ -29,25 +29,39 @@ def _source_hash(files=None) -> str:
     return h.hexdigest()[:16]
 
 
-def _build(so_path: str) -> None:
-    srcs = [os.path.join(_SRC_DIR, rel) for rel in _SOURCES]
+def _compile(srcs, out_path: str, extra_flags=()) -> None:
+    """g++ the sources ATOMICALLY into out_path (temp file + rename, so
+    concurrent builders race benignly and an interrupted build never
+    leaves a truncated artifact at the cached path); retries without
+    -march=native for toolchains that reject it."""
+    fd, tmp = tempfile.mkstemp(
+        suffix=os.path.splitext(out_path)[1] or ".tmp", dir=_SRC_DIR
+    )
+    os.close(fd)
     cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-march=native",
+        "g++", "-O3", "-std=c++17", "-march=native",
         "-I", os.path.join(_SRC_DIR, "src"),
-        *srcs, "-o", so_path, "-lpthread",
+        *extra_flags, *srcs, "-o", tmp, "-lpthread",
     ]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except subprocess.CalledProcessError:  # retry without -march
-        cmd.remove("-march=native")
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as e:
-            raise RuntimeError(
-                "native library build failed:\n"
-                f"$ {' '.join(cmd)}\n{e.stderr}"
-            ) from e
+        except subprocess.CalledProcessError:  # retry without -march
+            cmd.remove("-march=native")
+            try:
+                subprocess.run(
+                    cmd, check=True, capture_output=True, text=True
+                )
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    "native build failed:\n"
+                    f"$ {' '.join(cmd)}\n{e.stderr}"
+                ) from e
+        os.chmod(tmp, 0o755)
+        os.replace(tmp, out_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_library() -> ctypes.CDLL:
@@ -58,16 +72,11 @@ def load_library() -> ctypes.CDLL:
             return _lib
         so_path = os.path.join(_SRC_DIR, f"_dlrover_native_{_source_hash()}.so")
         if not os.path.exists(so_path):
-            # build into a temp file then rename: concurrent processes race
-            # benignly (last rename wins, both files identical)
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_SRC_DIR)
-            os.close(fd)
-            try:
-                _build(tmp)
-                os.replace(tmp, so_path)
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
+            _compile(
+                [os.path.join(_SRC_DIR, rel) for rel in _SOURCES],
+                so_path,
+                extra_flags=("-shared", "-fPIC"),
+            )
         lib = ctypes.CDLL(so_path)
         _declare(lib)
         _lib = lib
@@ -130,18 +139,10 @@ def build_and_run_cc_tests(timeout_s: int = 120) -> str:
     )
     exe = os.path.join(_SRC_DIR, f"_kv_store_test_{digest}")
     if not os.path.exists(exe):
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-g",
-            "-I", os.path.join(_SRC_DIR, "src"),
-            os.path.join(_SRC_DIR, "src", "kv_store.cc"),
-            test_src, "-o", exe, "-lpthread",
-        ]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as e:
-            raise RuntimeError(
-                f"native test build failed:\n$ {' '.join(cmd)}\n{e.stderr}"
-            ) from e
+        _compile(
+            [os.path.join(_SRC_DIR, "src", "kv_store.cc"), test_src],
+            exe,
+        )
     out = subprocess.run(
         [exe], capture_output=True, text=True, timeout=timeout_s
     )

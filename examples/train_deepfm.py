@@ -8,6 +8,9 @@ Run:  python examples/train_deepfm.py [--steps 200] [--ckpt DIR]
 """
 
 import argparse
+import sys
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
 import time
 
 import numpy as np

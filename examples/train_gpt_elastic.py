@@ -12,8 +12,10 @@ restart.
 """
 
 import argparse
-import os
 import sys
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
+import os
 import time
 
 import jax

@@ -11,6 +11,9 @@ SURVEY.md §5.)
 """
 
 import argparse
+import sys
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
 
 import jax
 import jax.numpy as jnp

@@ -8,6 +8,9 @@ mesh axis, router load-balancing + z-losses, and the high-level Trainer.
 """
 
 import argparse
+import sys
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
 
 import jax
 import jax.numpy as jnp

@@ -8,6 +8,9 @@ The programmatic reward stands in for a learned reward model; swap in
 """
 
 import argparse
+import sys
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
 
 import jax
 import jax.numpy as jnp
