@@ -153,6 +153,50 @@ def test_deepfm_trains_and_survives_rebalance(two_servers):
     model.dense_params = None  # model.close() would close demb twice
 
 
+def test_server_crash_failover_without_migration(two_servers):
+    """Unplanned PS death: the dead server cannot export, so workers
+    adopt the survivor ring with migrate=False — lookups keep working,
+    keys the dead server owned re-initialize on demand
+    (gather-or-insert), and training continues. Availability over
+    durability for rows not yet checkpointed, matching the elastic-PS
+    failover story (TTL'd rows re-learn)."""
+    ctx, procs, addrs = two_servers
+    cfg = DeepFMConfig(n_fields=6, n_dense=4, emb_dim=8, mlp_dims=(32,))
+    rng = np.random.default_rng(1)
+    cat, dense, labels = _synthetic_ctr(rng, 256, cfg)
+
+    model = DeepFM(cfg, optimizer=GroupAdam(lr=5e-3), dense_lr=5e-3)
+    model.coll.close()
+    demb = DistributedEmbedding(_specs(cfg.emb_dim), addrs)
+    model.coll = demb
+
+    first = model.train_step(cat, dense, labels)
+    for _ in range(10):
+        model.train_step(cat, dense, labels)
+    s0_rows = demb.stats()["s0"]["emb"]
+    assert s0_rows > 0
+
+    # hard-kill s0 (no drain, no export possible)
+    procs[0].kill()
+    procs[0].join(timeout=10)
+
+    demb.set_servers({"s1": addrs["s1"]}, migrate=False)
+    # the survivor still holds its share; the dead server's rows are
+    # gone and will re-initialize on first touch
+    stats = demb.stats()
+    assert sorted(stats) == ["s1"]
+    dev, _ = demb.pull({"emb": np.arange(300, dtype=np.int64)})
+    assert np.asarray(dev["emb"][0]).shape == (300, cfg.emb_dim)
+
+    # training continues through the loss bump from the lost rows
+    for _ in range(15):
+        after = model.train_step(cat, dense, labels)
+    assert np.isfinite(after)
+    assert after < first, (first, after)
+    demb.close()
+    model.dense_params = None
+
+
 def test_migration_preserves_row_values(two_servers):
     """Row-level proof: a migrated key's value/freq round-trips exactly
     (the optimizer slab rides along in gather_full width)."""
