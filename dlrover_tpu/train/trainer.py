@@ -64,6 +64,10 @@ class TrainerArgs:
     # the per-op runtime breakdown (observability/runtime_timer.py —
     # the xpu_timer analog); 0 = off
     profile_interval: int = 0
+    # keep N batches in flight to the device ahead of the step (async
+    # device_put H2D overlap — train.data_utils.prefetch_to_device, the
+    # reference GPU preloader analog); 0 = off
+    prefetch: int = 0
 
 
 class Trainer:
@@ -117,6 +121,25 @@ class Trainer:
         self._step_fn = None
         self._eval_fn = eval_step_fn
         self._batch_sharding = batch_sharding(self.mesh, rules)
+        if args.prefetch > 0:
+            if jax.process_count() == 1:
+                from dlrover_tpu.train.data_utils import (
+                    prefetch_to_device,
+                )
+
+                self.train_iter = prefetch_to_device(
+                    self.train_iter, args.prefetch, self._batch_sharding
+                )
+            else:
+                # multi-host batches must go through form_global_batch
+                # (the caller's iterator) — say so instead of silently
+                # dropping the knob
+                logger.warning(
+                    "prefetch=%d ignored on multi-host runs: wrap your "
+                    "iterator with form_global_batch + "
+                    "prefetch_to_device instead",
+                    args.prefetch,
+                )
         self.state: Any = None
         self.timer = StepTimer(
             flops_per_step=0.0, peak_flops=0.0
