@@ -121,25 +121,24 @@ class Trainer:
         self._step_fn = None
         self._eval_fn = eval_step_fn
         self._batch_sharding = batch_sharding(self.mesh, rules)
-        if args.prefetch > 0:
-            if jax.process_count() == 1:
-                from dlrover_tpu.train.data_utils import (
-                    prefetch_to_device,
-                )
+        if jax.process_count() == 1:
+            # the ONE device-placement point for training batches:
+            # prefetch=0 degrades to plain per-batch device_put
+            from dlrover_tpu.train.data_utils import prefetch_to_device
 
-                self.train_iter = prefetch_to_device(
-                    self.train_iter, args.prefetch, self._batch_sharding
-                )
-            else:
-                # multi-host batches must go through form_global_batch
-                # (the caller's iterator) — say so instead of silently
-                # dropping the knob
-                logger.warning(
-                    "prefetch=%d ignored on multi-host runs: wrap your "
-                    "iterator with form_global_batch + "
-                    "prefetch_to_device instead",
-                    args.prefetch,
-                )
+            self.train_iter = prefetch_to_device(
+                self.train_iter, args.prefetch, self._batch_sharding
+            )
+        elif args.prefetch > 0:
+            # multi-host batches must go through form_global_batch (the
+            # caller's iterator) — say so instead of silently dropping
+            # the knob
+            logger.warning(
+                "prefetch=%d ignored on multi-host runs: wrap your "
+                "iterator with form_global_batch + prefetch_to_device "
+                "instead",
+                args.prefetch,
+            )
         self.state: Any = None
         self.timer = StepTimer(
             flops_per_step=0.0, peak_flops=0.0
@@ -244,11 +243,13 @@ class Trainer:
         t_log = time.perf_counter()
         for step in range(start + 1, args.max_steps + 1):
             try:
+                # single-process: already device-placed by the
+                # prefetch_to_device wrap in __init__; multi-host
+                # batches arrive global via form_global_batch
                 batch = next(self.train_iter)
             except StopIteration:
                 logger.info("data exhausted at step %d", step - 1)
                 break
-            batch = jax.device_put(batch, self._batch_sharding)
             self.timer.start()
             if self.runtime_timer is not None:
                 self.state, metrics = self.runtime_timer.profiled_call(
