@@ -279,7 +279,24 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         "vs_baseline": round(mfu / _REFERENCE_HFU, 4),
         "tokens_per_sec": round(tokens_per_s, 1),
         "model_tflops_per_sec": round(model_tflops, 2),
+        "flop_expansion_est": _FLOP_EXPANSION.get(remat, 1.0),
     }
+
+
+# Executed/counted FLOP ratio by remat tier (fwd+bwd counted as 3×fwd;
+# backward re-runs the non-pinned share of the forward): remat recompute
+# is real MXU work that MFU deliberately does not credit. Estimates from
+# the measured step anatomy (README "Performance notes").
+_FLOP_EXPANSION = {
+    "full": round((3 + 1.0) / 3, 3),
+    "dots_saveable": round((3 + 0.35) / 3, 3),
+    "save_attn": round((3 + 0.9) / 3, 3),
+    "save_qkv": round((3 + 0.7) / 3, 3),
+    "save_qkv_gate": round((3 + 0.5) / 3, 3),
+    "save_dots": round((3 + 0.3) / 3, 3),
+    "offload_attn": round((3 + 0.9) / 3, 3),
+    "none": 1.0,
+}
 
 
 def main():
@@ -349,6 +366,26 @@ def main():
                         _run_aux_json(
                             "--ceiling", int(min(120, remaining))
                         )
+                    )
+                # how close the schedule runs to the ACHIEVABLE rate:
+                # executed flops (counted × remat expansion) against the
+                # measured chained-matmul ceiling AT THE WINNING
+                # CONFIG'S shapes (gpt2 fallbacks pad d=1600 on the MXU
+                # — judging them against the llama-shape ceiling would
+                # understate them ~10-15%). ~1.0 means the remaining
+                # vs_baseline gap is the remat recompute HBM forces,
+                # not scheduling losses.
+                ceiling_key = (
+                    "mxu_ceiling_frac_gpt2_shapes"
+                    if name.startswith("gpt2")
+                    else "mxu_ceiling_frac"
+                )
+                if record.get(ceiling_key):
+                    record["schedule_vs_achievable"] = round(
+                        record["value"]
+                        * record.get("flop_expansion_est", 1.0)
+                        / record[ceiling_key],
+                        3,
                     )
                 print(json.dumps(record))
                 return
