@@ -40,25 +40,42 @@ _DEADLINE_S = 870
 
 # (config, batch, seq, remat, subprocess timeout seconds)
 # llama-1.4b leads: every hot dim is a 128-multiple (d=16·128,
-# head_dim=128, ff=44·128), measured 0.60 MFU vs gpt2-1.5b's 0.48 on
-# v5e — the MXU tiles cleanly instead of padding 1600→1664 and
-# half-filling lanes at head_dim 64.
+# head_dim=128, ff=44·128), measured ~10 MFU points over gpt2-1.5b's
+# d=1600/head_dim=64 shapes on v5e — the MXU tiles cleanly.
 # remat=save_qkv: fused CE (ops/fused_ce.py) freed the ~2 GiB f32
 # logits working set, which buys pinning the qkv projections + flash
 # residuals — backward skips ~30% of the full-remat recompute flops.
-# Measured r3 on v5e: full 0.611 → fused-CE+save_qkv 0.630.
+# Sequence length: b1·s8192 leads (same 8192 tokens/step as b8·s1024,
+# so identical optimizer amortization and activation footprint) —
+# longer sequences spend MORE of each token's flops in attention, which
+# the Pallas flash kernel runs at MXU density, so utilization RISES
+# with context length (measured r3, save_qkv: 0.626 b8·s1024 → 0.651
+# b2·s4096 → 0.692 b1·s8192; 0.667 b1·s16384 save_attn). The
+# reference's 65.6% HFU headline ran BLOCK_SIZE=4096
+# (fsdp_llama2_entry.sh:11); the s4096 attempt is the seq-matched
+# comparison and rides along as mfu_at_baseline_seq4096 in the
+# emitted record.
 # budgets sum to ≤870s so the documented `timeout 900 python bench.py`
 # always reaches the tiny config even if every larger attempt grinds to
 # its per-attempt timeout (CPU fall-through worst case)
 _ATTEMPTS = [
-    ("llama-1.4b", 8, 1024, "save_qkv", 420),
+    ("llama-1.4b", 1, 8192, "save_qkv", 280),
+    ("llama-1.4b", 2, 4096, "save_qkv", 170),
+    ("llama-1.4b", 8, 1024, "save_qkv", 110),
     # gpt2-1.5b stays on full remat: its tied 50k-vocab embedding puts
     # params at 1.56B and save_qkv's pinned residuals OOM the 16 GiB
-    ("gpt2-1.5b", 8, 1024, "full", 180),
-    ("gpt2-355m", 16, 1024, "full", 120),
-    ("gpt2-124m", 16, 512, "none", 90),
-    ("tiny", 8, 128, "none", 60),
+    ("gpt2-1.5b", 8, 1024, "full", 110),
+    ("gpt2-355m", 16, 1024, "full", 60),
+    ("gpt2-124m", 16, 512, "none", 60),
+    ("tiny", 8, 128, "none", 80),
 ]
+
+# seq-matched companion for the long-context lead config (the baseline
+# measured at 4096): embedded in the record when budget allows. Derived
+# from the attempt ladder so the fallback record and the companion are
+# always the SAME recipe.
+_BASELINE_SEQ_COMPANION = _ATTEMPTS[1][:4]
+assert _BASELINE_SEQ_COMPANION[2] == 4096
 
 
 def check_kernels(b=2, s=1024, h=16, d=128) -> bool:
@@ -380,13 +397,41 @@ def main():
                     if name.startswith("gpt2")
                     else "mxu_ceiling_frac"
                 )
-                if record.get(ceiling_key):
+                # the interpretation only holds while trunk matmuls
+                # dominate: at long seq the flash kernel's attention
+                # flops (not represented in the matmul-chain ceiling,
+                # and with a seq-dependent recompute share) push the
+                # ratio past 1.0 — emit nothing rather than a
+                # >100%-of-achievable number
+                if seq > 4096:
+                    record.pop("flop_expansion_est", None)
+                elif record.get(ceiling_key):
                     record["schedule_vs_achievable"] = round(
                         record["value"]
                         * record.get("flop_expansion_est", 1.0)
                         / record[ceiling_key],
                         3,
                     )
+                # seq-matched companion: when the long-context config
+                # wins, also measure at the baseline's own seq (4096)
+                # so the record carries the apples-to-apples number
+                if seq > _BASELINE_SEQ_COMPANION[2]:
+                    remaining = _DEADLINE_S - (time.monotonic() - t0)
+                    if remaining >= 120:
+                        cn, cb, cs, cr = _BASELINE_SEQ_COMPANION
+                        comp = _run_aux_json(
+                            [
+                                "--single", cn, str(cb), str(cs), cr
+                            ],
+                            int(min(220, remaining)),
+                        )
+                        if comp.get("value"):
+                            record["mfu_at_baseline_seq4096"] = comp[
+                                "value"
+                            ]
+                            record["vs_baseline_at_seq4096"] = comp[
+                                "vs_baseline"
+                            ]
                 print(json.dumps(record))
                 return
             sys.stderr.write(
@@ -398,11 +443,12 @@ def main():
     raise SystemExit("all bench configs failed")
 
 
-def _run_aux_json(flag: str, budget_s: int) -> dict:
-    """Run ``bench.py <flag>`` in a subprocess, parse its JSON line."""
+def _run_aux_json(flag, budget_s: int) -> dict:
+    """Run ``bench.py <flag...>`` in a subprocess, parse its JSON line."""
+    args = [flag] if isinstance(flag, str) else list(flag)
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), flag],
+            [sys.executable, os.path.abspath(__file__), *args],
             capture_output=True,
             timeout=budget_s,
             text=True,
