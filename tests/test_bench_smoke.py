@@ -1,0 +1,65 @@
+"""bench.py bit-rot guard: the driver runs bench.py on real hardware at
+round end, where an import error or schema regression would surface too
+late to fix. Run the cheap pieces here on the CPU mesh."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    PALLAS_AXON_POOL_IPS="",
+    XLA_FLAGS="--xla_force_host_platform_device_count=1",
+)
+
+
+def _run(args, timeout):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=_ENV,
+        cwd=_REPO,
+    )
+
+
+def test_bench_single_tiny_emits_schema():
+    out = _run(["--single", "tiny", "2", "64", "none"], timeout=240)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "tokens_per_sec", "flop_expansion_est"):
+        assert key in rec, key
+    assert rec["unit"] == "fraction_of_peak"
+    assert rec["value"] > 0
+
+
+def test_bench_aux_modes_cpu_safe():
+    # kernel check short-circuits true off-TPU; ceiling returns {}
+    out = _run(["--check"], timeout=120)
+    assert out.returncode == 0
+    assert json.loads(out.stdout.strip().splitlines()[-1]) == {
+        "kernels_ok": True
+    }
+    out = _run(["--ceiling"], timeout=120)
+    assert out.returncode == 0
+    assert json.loads(out.stdout.strip().splitlines()[-1]) == {}
+
+
+def test_attempt_budgets_fit_deadline():
+    """The documented `timeout 900 python bench.py` must always reach
+    the tiny config: per-attempt budgets may not exceed the deadline."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    assert sum(a[4] for a in bench._ATTEMPTS) <= bench._DEADLINE_S
+    # the seq-matched companion must stay locked to the ladder
+    assert bench._BASELINE_SEQ_COMPANION == bench._ATTEMPTS[1][:4]
