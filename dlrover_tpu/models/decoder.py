@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.ad_checkpoint import checkpoint_policies as cp
+from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models.config import ModelConfig
 from dlrover_tpu.ops.attention import mha_reference
@@ -141,6 +142,81 @@ def logical_axes(cfg: ModelConfig) -> Params:
 
         ax["layers"]["moe"] = moe_logical_axes(cfg)
     return ax
+
+
+def _embed_lookup_hostile(mesh, table_shape, tokens_shape) -> bool:
+    """True when XLA's gather cannot be trusted on this mesh.
+
+    The table rests ZeRO-sharded ("vocab"→tp, "embed"→fsdp). When fsdp>1
+    the gather's output inherits the fsdp-sharded embed dim, which cannot
+    be cheaply resharded to the batch-sharded activation layout (fsdp on
+    dim 2 vs dp·fsdp on dim 0 is a transposed device order) — the SPMD
+    partitioner falls back to "involuntary full rematerialization", a
+    replicate-then-repartition of a [B,S,D] tensor every microbatch.
+    Constraint-based fixes are off the table: a sharding constraint on
+    the table inside the grad-accumulation scan miscompiles the
+    cotangent scatter on this XLA version (accumulated embed grads come
+    back wrong), and out-of-scan anchors lose to propagation from the
+    optimizer side. Manual sharding (shard_map) is the reliable path.
+    Skipped inside partial-manual regions (the pipeline's pp shard_map):
+    those meshes pipeline with fsdp=1 in practice and the nested-mesh
+    bookkeeping isn't worth it.
+    """
+    if mesh is None or mesh.shape.get("fsdp", 1) <= 1:
+        return False
+    # shard_map needs exact divisibility where GSPMD would pad; the
+    # fallback take is correct (just reshard-slow) for ragged shapes
+    vocab, _ = table_shape
+    b, s = tokens_shape
+    if (
+        vocab % mesh.shape.get("tp", 1)
+        or b % (mesh.shape.get("dp", 1) * mesh.shape["fsdp"])
+        or s % mesh.shape.get("sp", 1)
+    ):
+        return False
+    am = jax.sharding.get_abstract_mesh()
+    manual = any(
+        t == jax.sharding.AxisType.Manual for t in am.axis_types
+    )
+    return not manual
+
+
+def _vocab_parallel_embed(table: jax.Array, tokens: jax.Array, mesh):
+    """Megatron-style vocab-parallel embedding lookup under shard_map.
+
+    Each tp shard holds a contiguous vocab slice (the resting "vocab"→tp
+    sharding); out-of-shard tokens are masked to zero and one psum over
+    tp assembles the rows — the same masked-gather + all-reduce XLA
+    synthesizes for a vocab-sharded gather, but with every collective
+    explicit so the partitioner has no resharding decisions to make (and
+    none to get wrong; see _embed_lookup_hostile). The in_spec
+    P("tp", None) is the ZeRO gather-on-use: shard_map all-gathers the
+    table's fsdp-sharded embed dim at entry, and the transpose psums the
+    table cotangent back over (dp, fsdp, sp) before re-slicing — both on
+    table-sized tensors, never on [B,S,D] activations.
+
+    Reference parity: atorch's VocabParallelEmbedding
+    (atorch/modules/distributed_modules/layers.py) does the same
+    masked-lookup + all-reduce with torch collectives.
+    """
+    from jax import shard_map
+
+    def body(tbl, tok):
+        vs = tbl.shape[0]
+        off = jax.lax.axis_index("tp") * vs
+        idx = tok - off
+        inb = (idx >= 0) & (idx < vs)
+        x = jnp.take(tbl, jnp.where(inb, idx, 0), axis=0)
+        x = jnp.where(inb[..., None], x, jnp.zeros([], x.dtype))
+        return jax.lax.psum(x, "tp")
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("tp", None), P(("dp", "fsdp"), "sp")),
+        out_specs=P(("dp", "fsdp"), "sp", None),
+        check_vma=False,
+    )(table, tokens)
 
 
 # ---------------------------------------------------------------------------
@@ -552,7 +628,14 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
+    if _embed_lookup_hostile(
+        mesh, params["embed"]["tokens"].shape, tokens.shape
+    ):
+        x = _vocab_parallel_embed(
+            params["embed"]["tokens"], tokens, mesh
+        ).astype(dt)
+    else:
+        x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
     if cfg.pos == "learned":
         x = x + jnp.take(
             params["pos_embed"]["table"], positions, axis=0
