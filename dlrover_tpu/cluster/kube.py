@@ -57,6 +57,14 @@ _REASON_TO_EXIT = {
 }
 
 
+class WatchExpired(RuntimeError):
+    """The watch resume point fell out of the server's history window
+    (HTTP 410 Gone or an in-stream 410 ERROR event). Relist — which
+    returns a fresh rv — and restart the watch from it. PodWatcher and
+    JobReconciler do this inline; reference contract:
+    k8s_watcher.py:219."""
+
+
 @dataclass
 class WatchEvent:
     type: str                 # ADDED | MODIFIED | DELETED
@@ -343,21 +351,51 @@ class PodWatcher:
         )
         self._thread.start()
 
-    def _run(self, since_rv: int):
-        for ev in self._api.watch(
-            kind="Pod",
-            namespace=self._ns,
-            label_selector={JOB_LABEL: self._job},
-            since_rv=since_rv,
-            stop=self._stop,
-        ):
-            ne = pod_to_node_event(ev)
-            if ne is None:
-                continue
+    def _run(self, since_rv):
+        while not self._stop.is_set():
             try:
-                self._handler(ne)
-            except Exception:
-                logger.exception("pod watch handler failed for %s", ev)
+                for ev in self._api.watch(
+                    kind="Pod",
+                    namespace=self._ns,
+                    label_selector={JOB_LABEL: self._job},
+                    since_rv=since_rv,
+                    stop=self._stop,
+                ):
+                    ne = pod_to_node_event(ev)
+                    if ne is None:
+                        continue
+                    try:
+                        self._handler(ne)
+                    except Exception:
+                        logger.exception(
+                            "pod watch handler failed for %s", ev
+                        )
+                return  # watch ended via stop
+            except WatchExpired as e:
+                # resume-by-relist: grab a fresh collection rv FIRST,
+                # then re-deliver current pod states as synthetic
+                # MODIFIED events (anything that changed between the
+                # two shows up again in the watch — duplicates are
+                # idempotent through the stale-incarnation guard).
+                # Transient API errors here must not kill the thread:
+                # the 410 came from a server that may still be flaky —
+                # keep the old resume point and retry the whole cycle.
+                logger.info("pod watch expired (%s); relisting", e)
+                try:
+                    list_rv = getattr(self._api, "list_rv", None)
+                    since_rv = (
+                        list_rv("Pod", self._ns) if list_rv else 0
+                    )
+                    for ne in self.list_node_events():
+                        try:
+                            self._handler(ne)
+                        except Exception:
+                            logger.exception("relist handler failed")
+                except Exception:
+                    logger.exception("relist failed; retrying")
+                # don't hammer an API server whose whole history window
+                # is ahead of us (repeated 410s until state advances)
+                self._stop.wait(0.2)
 
     def stop(self):
         self._stop.set()
@@ -418,14 +456,36 @@ class JobReconciler:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
-    def _run(self, since_rv: int):
-        for ev in self._api.watch(
-            namespace=self._ns, since_rv=since_rv, stop=self._stop
-        ):
+    def _run(self, since_rv):
+        while not self._stop.is_set():
             try:
-                self._reconcile(ev)
-            except Exception:
-                logger.exception("reconcile failed for %s", ev)
+                for ev in self._api.watch(
+                    namespace=self._ns, since_rv=since_rv, stop=self._stop
+                ):
+                    try:
+                        self._reconcile(ev)
+                    except Exception:
+                        logger.exception("reconcile failed for %s", ev)
+                return
+            except WatchExpired as e:
+                # relist: re-assert the ElasticJob's DESIRED state (a
+                # replica-count reconcile is idempotent). Historical
+                # ScalePlans are deliberately NOT replayed — they are
+                # one-shot imperatives and a stale plan could undo
+                # scaling that happened after it. Transient API errors
+                # keep the old resume point and retry the cycle rather
+                # than killing the operator thread.
+                logger.info("reconcile watch expired (%s); relisting", e)
+                try:
+                    list_rv = getattr(self._api, "list_rv", None)
+                    since_rv = (
+                        list_rv("ElasticJob", self._ns) if list_rv else 0
+                    )
+                    for obj in self._api.list("ElasticJob", self._ns):
+                        self._reconcile(WatchEvent("MODIFIED", obj))
+                except Exception:
+                    logger.exception("reconcile relist failed; retrying")
+                self._stop.wait(0.2)
 
     def _reconcile(self, ev: WatchEvent):
         if ev.kind == "ElasticJob" and ev.type in ("ADDED", "MODIFIED"):

@@ -11,12 +11,17 @@ JobReconciler run unmodified against a real cluster, in-cluster
 (service-account token + CA) or via a proxy/test server.
 
 Scope notes:
-- resourceVersions are opaque STRINGS in the k8s API; etcd's are
-  numeric, and the watch/resume machinery here (and the reference's)
-  relies on that to order events. Non-numeric rvs raise loudly.
+- resourceVersions are opaque STRINGS in the k8s API (etcd's happen to
+  be numeric). The resume machinery treats them as pass-through tokens:
+  the last seen rv string is handed back verbatim on reconnect.
+  ``WatchEvent.resource_version`` keeps its integer type for the
+  in-process consumers (0 when the server's rv is non-numeric).
 - On HTTP 410 Gone (rv expired from etcd's window) the watch raises
   ``WatchExpired``; callers relist and resume — the same contract the
-  reference's watcher loop implements (k8s_watcher.py:219).
+  reference's watcher loop implements (k8s_watcher.py:219). PodWatcher
+  and JobReconciler (cluster/kube.py) implement that relist inline.
+- BOOKMARK events (the server periodically publishing a fresh rv with
+  no object change) advance the resume token and are not surfaced.
 """
 
 import json
@@ -25,12 +30,14 @@ import threading
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple, Union
 
-from dlrover_tpu.cluster.kube import KubeApi, WatchEvent
+from dlrover_tpu.cluster.kube import KubeApi, WatchEvent, WatchExpired
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
+
+__all__ = ["RealKubeApi", "WatchExpired"]
 
 _IN_CLUSTER_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
 _IN_CLUSTER_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
@@ -46,22 +53,19 @@ _BUILTIN_PATHS: Dict[str, Tuple[str, str]] = {
 }
 
 
-class WatchExpired(RuntimeError):
-    """HTTP 410: the resourceVersion fell out of etcd's history window.
-
-    Relist (which returns a fresh rv) and restart the watch from it.
-    """
+def _raw_rv(obj: Dict) -> str:
+    """The rv as the opaque token the server gave us ("" if absent)."""
+    return str(obj.get("metadata", {}).get("resourceVersion", "") or "")
 
 
 def _parse_rv(obj: Dict) -> int:
-    rv = obj.get("metadata", {}).get("resourceVersion", 0)
+    """Best-effort integer view of the rv for ``WatchEvent``'s int field
+    (k8s documents rvs as opaque; non-numeric ones read as 0 here and
+    the string token is what resume actually uses)."""
     try:
-        return int(rv)
-    except (TypeError, ValueError):
-        raise ValueError(
-            f"non-numeric resourceVersion {rv!r}: the resume machinery "
-            "orders events by rv and cannot proceed"
-        ) from None
+        return int(_raw_rv(obj) or 0)
+    except ValueError:
+        return 0
 
 
 class RealKubeApi(KubeApi):
@@ -206,10 +210,18 @@ class RealKubeApi(KubeApi):
             it.setdefault("kind", kind)
         return items
 
-    def list_rv(self, kind: str, namespace: str = "default") -> int:
-        """The collection resourceVersion — the rv to start a watch at."""
+    def list_rv(
+        self, kind: str, namespace: str = "default"
+    ) -> Union[int, str]:
+        """The collection resourceVersion — the rv to start a watch at.
+
+        Returned as int when numeric (every etcd-backed server today),
+        otherwise as the opaque string; ``watch(since_rv=...)`` accepts
+        either."""
         out = self._request("GET", self._path(kind, namespace))
-        return _parse_rv({"metadata": out.get("metadata", {})})
+        meta = {"metadata": out.get("metadata", {})}
+        raw = _raw_rv(meta)
+        return _parse_rv(meta) if raw.isdigit() else raw
 
     def watch(
         self,
@@ -235,10 +247,14 @@ class RealKubeApi(KubeApi):
             )
             return
         stop = stop or threading.Event()
-        rv = since_rv
+        rv = str(since_rv)  # opaque resume token, handed back verbatim
         sel = self._selector(label_selector)
         while not stop.is_set():
-            query = {"watch": "1", "resourceVersion": str(rv)}
+            query = {
+                "watch": "1",
+                "resourceVersion": rv,
+                "allowWatchBookmarks": "true",
+            }
             if sel:
                 query["labelSelector"] = sel
             try:
@@ -275,14 +291,17 @@ class RealKubeApi(KubeApi):
                                 f"watch error event: {status}"
                             )
                         obj = ev["object"]
+                        rv = _raw_rv(obj) or rv
+                        if ev.get("type") == "BOOKMARK":
+                            # progress marker only: fresh rv, no change
+                            continue
                         obj.setdefault("kind", kind)
-                        rv = _parse_rv(obj)
-                        yield WatchEvent(ev["type"], obj, rv)
+                        yield WatchEvent(ev["type"], obj, _parse_rv(obj))
             except (TimeoutError, OSError, urllib.error.URLError) as e:
                 if stop.is_set():
                     return
                 logger.info(
-                    "watch stream dropped (%s); resuming from rv %d", e, rv
+                    "watch stream dropped (%s); resuming from rv %s", e, rv
                 )
                 stop.wait(poll_s)
 
@@ -291,7 +310,11 @@ class RealKubeApi(KubeApi):
     ) -> Iterator[WatchEvent]:
         import queue
 
-        stop = stop or threading.Event()
+        outer = stop or threading.Event()
+        # the pumps get their OWN stop event: setting the caller's event
+        # on exit would make a WatchExpired unraisable to recover from
+        # (the caller's resume loop checks that same event)
+        inner = threading.Event()
         q: "queue.Queue" = queue.Queue()
 
         def pump(kind: str):
@@ -301,7 +324,7 @@ class RealKubeApi(KubeApi):
                     namespace=namespace,
                     label_selector=label_selector,
                     since_rv=since_rv,
-                    stop=stop,
+                    stop=inner,
                     poll_s=poll_s,
                 ):
                     q.put(ev)
@@ -315,7 +338,7 @@ class RealKubeApi(KubeApi):
         for t in threads:
             t.start()
         try:
-            while not stop.is_set():
+            while not outer.is_set():
                 try:
                     item = q.get(timeout=poll_s)
                 except queue.Empty:
@@ -324,4 +347,4 @@ class RealKubeApi(KubeApi):
                     raise item
                 yield item
         finally:
-            stop.set()
+            inner.set()

@@ -108,7 +108,7 @@ class _KubeHandler(BaseHTTPRequestHandler):
                 self._send(200, obj)
             return
         if q.get("watch") == "1":
-            self._stream_watch(kind, ns, sel, int(q.get("resourceVersion", 0)))
+            self._stream_watch(kind, ns, sel, q.get("resourceVersion", "0"))
             return
         items = self.fake.list(kind, ns, label_selector=sel)
         # real list items omit kind (clients re-add it)
@@ -120,12 +120,34 @@ class _KubeHandler(BaseHTTPRequestHandler):
                 "kind": f"{kind}List",
                 "items": items,
                 "metadata": {
-                    "resourceVersion": str(self.fake.latest_rv())
+                    "resourceVersion": self._rv_out(self.fake.latest_rv())
                 },
             },
         )
 
-    def _stream_watch(self, kind, ns, sel, since_rv):
+    # rv_prefix (opaque-rv mode): rvs go on the wire as "<prefix><n>"
+    # strings — non-numeric, like the documented k8s contract allows
+    def _rv_out(self, rv: int) -> str:
+        return f"{getattr(self.server, 'rv_prefix', '')}{rv}"
+
+    def _rv_in(self, raw: str) -> int:
+        prefix = getattr(self.server, "rv_prefix", "")
+        if prefix and raw.startswith(prefix):
+            raw = raw[len(prefix):]
+        try:
+            return int(raw)
+        except ValueError:
+            return 0
+
+    def _chunk(self, payload: dict):
+        raw = (json.dumps(payload) + "\n").encode()
+        self.wfile.write(f"{len(raw):x}\r\n".encode())
+        self.wfile.write(raw + b"\r\n")
+        self.wfile.flush()
+
+    def _stream_watch(self, kind, ns, sel, raw_rv):
+        getattr(self.server, "seen_watch_rvs", []).append(raw_rv)
+        since_rv = self._rv_in(raw_rv)
         # the 410 Gone contract: honor an artificially expired window
         if getattr(self.server, "expire_below_rv", 0) > since_rv > 0:
             self._send(410, {"kind": "Status", "code": 410})
@@ -134,6 +156,12 @@ class _KubeHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+        if getattr(self.server, "drop_streams", False):
+            # terminate the chunked body immediately: the client sees a
+            # clean end-of-stream and reconnects with its resume token
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+            return
         stop = threading.Event()
         try:
             for ev in self.fake.watch(
@@ -145,15 +173,28 @@ class _KubeHandler(BaseHTTPRequestHandler):
                 poll_s=0.05,
             ):
                 obj = dict(ev.obj)
-                obj.setdefault("metadata", {})["resourceVersion"] = str(
-                    ev.resource_version
+                obj.setdefault("metadata", {})["resourceVersion"] = (
+                    self._rv_out(ev.resource_version)
                 )
                 obj.pop("kind", None)  # like the real stream for core kinds
-                line = json.dumps({"type": ev.type, "object": obj}) + "\n"
-                raw = line.encode()
-                self.wfile.write(f"{len(raw):x}\r\n".encode())
-                self.wfile.write(raw + b"\r\n")
-                self.wfile.flush()
+                self._chunk({"type": ev.type, "object": obj})
+                if getattr(self.server, "send_bookmarks", False):
+                    self._chunk(
+                        {
+                            "type": "BOOKMARK",
+                            "object": {
+                                "metadata": {
+                                    "resourceVersion": self._rv_out(
+                                        ev.resource_version
+                                    )
+                                }
+                            },
+                        }
+                    )
+                if getattr(self.server, "drop_after_each", False):
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                    return
         except (BrokenPipeError, ConnectionResetError):
             pass
         finally:
@@ -210,6 +251,7 @@ def api_server():
     handler = type("H", (_KubeHandler,), {"fake": fake})
     server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
     server.daemon_threads = True
+    server.seen_watch_rvs = []
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     yield fake, f"http://127.0.0.1:{server.server_address[1]}", server
@@ -405,3 +447,129 @@ def test_job_reconciler_over_real_http_client(api_server):
         msg="scale plan removed worker-1 over HTTP",
     )
     rec.stop()
+
+
+def test_job_reconciler_survives_410_by_relisting(api_server):
+    """The reconciler's merged (kind=None) watch must survive a 410 the
+    same way PodWatcher does: relist the ElasticJob, re-assert desired
+    state, and keep reconciling — the watch-expired path must not kill
+    the operator thread (its pumps use an internal stop event, so the
+    WatchExpired is recoverable)."""
+    from dlrover_tpu.cluster.kube import JobReconciler
+
+    fake, url, server = api_server
+    api = _client(url)
+    rec = JobReconciler(api, _job(replicas=0), master_addr="10.0.0.1:8000")
+    rec.start()
+    api.create(
+        {
+            "kind": "ElasticJob",
+            "metadata": {"name": "demo"},
+            "spec": {"replicaSpecs": {"worker": {"replicas": 1}}},
+        }
+    )
+    _wait(
+        lambda: len(api.list("Pod", label_selector={JOB_LABEL: "demo"}))
+        == 1,
+        msg="reconciler created the first pod",
+    )
+    # expire the history window; the merged watch reconnects into 410s
+    server.drop_streams = True
+    server.expire_below_rv = fake.latest_rv() + 1
+    time.sleep(0.5)
+    # desired state changes while the watch is expired — only a live
+    # (relisting) reconciler can pick it up
+    server.drop_streams = False
+    ej = api.get("ElasticJob", "demo")
+    ej["spec"]["replicaSpecs"]["worker"]["replicas"] = 3
+    api.update(ej)
+    _wait(
+        lambda: len(api.list("Pod", label_selector={JOB_LABEL: "demo"}))
+        == 3,
+        timeout=10.0,
+        msg="reconciler scaled to 3 after the watch expired",
+    )
+    rec.stop()
+
+
+def test_watch_passes_opaque_rvs_through_and_skips_bookmarks(api_server):
+    """k8s documents resourceVersions as opaque strings: the client must
+    hand the last seen token back verbatim on reconnect (not parse it)
+    and swallow BOOKMARK progress events (which carry a fresh rv but no
+    object change). The server here emits rvs as non-numeric 'op-<n>'
+    strings, drops the stream after every event, and bookmarks after
+    each one — the watch must still deliver every event exactly once."""
+    fake, url, server = api_server
+    server.rv_prefix = "op-"
+    server.send_bookmarks = True
+    server.drop_after_each = True
+    api = _client(url)
+    stop = threading.Event()
+    seen = []
+
+    def consume():
+        for ev in api.watch(kind="Pod", since_rv=0, stop=stop):
+            seen.append((ev.type, ev.name, ev.resource_version))
+            if len(seen) >= 3:
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    api.create({"kind": "Pod", "metadata": {"name": "q0", "labels": {}}})
+    fake.set_pod_phase("q0", "Running")
+    fake.set_pod_phase("q0", "Failed", reason="OOMKilled")
+    t.join(timeout=8)
+    stop.set()
+    assert not t.is_alive()
+    # every event delivered once, in order, despite per-event reconnects
+    assert [s[0] for s in seen] == ["ADDED", "MODIFIED", "MODIFIED"]
+    # opaque rvs surface as 0 in the int field (documented best-effort)
+    assert [s[2] for s in seen] == [0, 0, 0]
+    # and the resume tokens the server received were the verbatim opaque
+    # strings it emitted, not re-parsed integers
+    opaque = [rv for rv in server.seen_watch_rvs if rv.startswith("op-")]
+    assert opaque, f"no opaque resume tokens seen: {server.seen_watch_rvs}"
+
+
+def test_pod_watcher_survives_410_by_relisting(api_server):
+    """The full resume-by-relist loop: a watch whose rv fell out of the
+    server's history window (410 Gone) must not kill the PodWatcher —
+    it relists, re-delivers current state, and keeps following events
+    (reference contract: k8s_watcher.py:219)."""
+    fake, url, server = api_server
+    api = _client(url)
+    job = _job(replicas=1)
+    scaler = SliceScaler(
+        job,
+        submit_fn=api.create,
+        delete_fn=lambda name: api.delete("Pod", name),
+        master_addr="10.0.0.1:8000",
+    )
+    jm = JobManager(num_workers=1, relaunch_budget=2, scaler=scaler)
+    watcher = PodWatcher(api, "demo", jm.process_event)
+    plan = ScalePlan()
+    plan.worker_num = 1
+    scaler.scale(plan)
+    watcher.start()
+    fake.set_pod_phase("demo-worker-0", "Running")
+    _wait(
+        lambda: jm.get_node(0).status == NodeStatus.RUNNING,
+        msg="node running before the 410",
+    )
+    # expire the whole current history: the next reconnect 410s until
+    # the relist loop picks up a fresh-enough rv
+    server.drop_streams = True
+    server.expire_below_rv = fake.latest_rv() + 1
+    time.sleep(0.5)  # let the watcher hit the 410/relist path
+    # state advances past the expiry window; only a live (relisted)
+    # watcher can see the failure and relaunch
+    server.drop_streams = False
+    fake.set_pod_phase("demo-worker-0", "Failed", reason="OOMKilled")
+    _wait(
+        lambda: api.get("Pod", "demo-worker-0-r1") is not None,
+        timeout=10.0,
+        msg="relaunch after the watch expired and relisted",
+    )
+    assert jm.get_node(0).relaunch_count == 1
+    watcher.stop()
+    jm.stop()
