@@ -44,7 +44,16 @@ def generate_candidates(
     seq: int,
     max_candidates: int = 32,
 ) -> List[Strategy]:
-    """Enumerate (tp, sp, pp, fsdp, dp) factorizations + remat choices."""
+    """Enumerate (tp, sp, pp, fsdp, dp) factorizations + remat choices.
+
+    On fp8-native hardware (device_context.fp8_supported) every dense-
+    model candidate carries the fp8 method by default — the reference
+    auto-applies TE fp8 the same way when the GPU supports it
+    (atorch/auto/opt_lib/amp_optimization.py:197). MoE models stay bf16
+    (expert GEMMs have no fp8 wiring)."""
+    from dlrover_tpu.accelerate.device_context import fp8_supported
+
+    fp8_default = fp8_supported() and cfg.n_experts == 0
     candidates: List[Strategy] = []
     for tp, sp in itertools.product(_divisors(n_devices), repeat=2):
         if n_devices % (tp * sp):
@@ -77,6 +86,8 @@ def generate_candidates(
                 ]
                 if sp > 1:
                     base.append(("sequence_parallel", {"size": sp}))
+                if fp8_default:
+                    base.append(("fp8", {}))
                 candidates.append(base + [("checkpoint", {"policy": "none"})])
                 candidates.append(base + [("checkpoint", {"policy": "full"})])
                 # memory-squeeze tier: host-offloaded moments on top of

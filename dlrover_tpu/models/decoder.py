@@ -251,8 +251,25 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _fp8_gemm(x, w, fp8, name):
+    """One fp8 GEMM: delayed scaling against the per-projection state,
+    or stateless current scaling when ``fp8`` is the "current" sentinel
+    (pipeline meshes — see run_trunk)."""
+    from dlrover_tpu.ops.fp8 import fp8_dot, fp8_dot_current
+
+    if fp8 == "current":
+        return fp8_dot_current(x, w)
+    return fp8_dot(x, w, fp8[name])
+
+
 def _project_qkv(
-    x, layer, cfg: ModelConfig, positions, *, mup_full_scale: bool = False
+    x,
+    layer,
+    cfg: ModelConfig,
+    positions,
+    *,
+    mup_full_scale: bool = False,
+    fp8=None,
 ):
     """QKV projection + rope + muP q-scaling — the ONE place this math
     lives; the batch forward (_attention_block), prefill and decode_step
@@ -261,12 +278,24 @@ def _project_qkv(
     muP wants 1/d_head TOTAL attention scaling. The batch path's attn
     impls apply 1/sqrt(d_head) themselves, so q carries the other half;
     the cache paths run their attention with scale=1 and set
-    ``mup_full_scale`` so q carries all of it."""
+    ``mup_full_scale`` so q carries all of it.
+
+    ``fp8``: per-layer delayed-scaling states for the q/k/v GEMMs
+    (keys "wq"/"wk"/"wv"; cfg.fp8 training only — the cache paths pass
+    None and stay bf16)."""
     b, s, _ = x.shape
     nh, nkv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
-    q = (x @ layer["attn"]["wq"].astype(x.dtype)).reshape(b, s, nh, hd)
-    k = (x @ layer["attn"]["wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
-    v = (x @ layer["attn"]["wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
+    if fp8 is not None:
+        q = _fp8_gemm(x, layer["attn"]["wq"].astype(x.dtype), fp8, "wq")
+        k = _fp8_gemm(x, layer["attn"]["wk"].astype(x.dtype), fp8, "wk")
+        v = _fp8_gemm(x, layer["attn"]["wv"].astype(x.dtype), fp8, "wv")
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nkv, hd)
+        v = v.reshape(b, s, nkv, hd)
+    else:
+        q = (x @ layer["attn"]["wq"].astype(x.dtype)).reshape(b, s, nh, hd)
+        k = (x @ layer["attn"]["wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
+        v = (x @ layer["attn"]["wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
     # names for the selective remat policies (save_qkv / save_dots):
     # identity outside jax.checkpoint, so the cache paths are
     # unaffected. Tagged BEFORE rope: backward re-runs only the cheap
@@ -302,39 +331,42 @@ def _cache_layer_tail(x, attn_out, layer, cfg: ModelConfig):
     return x + attn_out + mlp_out if cfg.parallel_residual else x + mlp_out
 
 
-def _attention_block(x, layer, cfg: ModelConfig, mesh, positions, attn_fn):
+def _attention_block(
+    x, layer, cfg: ModelConfig, mesh, positions, attn_fn, fp8=None
+):
     b, s, d = x.shape
     nh, hd = cfg.n_head, cfg.head_dim
-    q, k, v = _project_qkv(x, layer, cfg, positions)
+    q, k, v = _project_qkv(x, layer, cfg, positions, fp8=fp8)
     if mesh is not None:
         q = shd.constrain(q, mesh, "batch", "seq", "heads", None)
         k = shd.constrain(k, mesh, "batch", "seq", "kv", None)
         v = shd.constrain(v, mesh, "batch", "seq", "kv", None)
     out = attn_fn(q, k, v)
     out = out.reshape(b, s, nh * hd)
+    if fp8 is not None:
+        return _fp8_gemm(out, layer["attn"]["wo"].astype(x.dtype), fp8, "wo")
     return out @ layer["attn"]["wo"].astype(x.dtype)
 
 
 def _mlp_block(x, layer, cfg: ModelConfig, mesh, fp8=None):
     mlp = layer["mlp"]
     if fp8 is not None:
-        # fp8 GEMMs with delayed scaling (cfg.fp8): fp8_dot's "grad"
-        # w.r.t. each state dict is the UPDATED amax history — the
-        # train step harvests it from the gradient tree (ops/fp8.py
-        # state-on-cotangent convention)
-        from dlrover_tpu.ops.fp8 import fp8_dot
-
+        # fp8 GEMMs (cfg.fp8): delayed scaling against per-projection
+        # states — fp8_dot's "grad" w.r.t. each state dict is the
+        # UPDATED amax history, harvested from the gradient tree by the
+        # train step (ops/fp8.py state-on-cotangent convention) — or
+        # stateless current scaling under pipeline meshes
         if cfg.act == "swiglu":
-            gate = fp8_dot(x, mlp["w_gate"].astype(x.dtype), fp8["gate"])
-            up = fp8_dot(x, mlp["w_up"].astype(x.dtype), fp8["up"])
+            gate = _fp8_gemm(x, mlp["w_gate"].astype(x.dtype), fp8, "gate")
+            up = _fp8_gemm(x, mlp["w_up"].astype(x.dtype), fp8, "up")
             h = jax.nn.silu(gate) * up
         else:
             h = jax.nn.gelu(
-                fp8_dot(x, mlp["w_up"].astype(x.dtype), fp8["up"])
+                _fp8_gemm(x, mlp["w_up"].astype(x.dtype), fp8, "up")
             )
         if mesh is not None:
             h = shd.constrain(h, mesh, "batch", "seq", "mlp")
-        return fp8_dot(h, mlp["w_down"].astype(x.dtype), fp8["down"])
+        return _fp8_gemm(h, mlp["w_down"].astype(x.dtype), fp8, "down")
     if cfg.act == "swiglu":
         gate = x @ mlp["w_gate"].astype(x.dtype)
         up = x @ mlp["w_up"].astype(x.dtype)
@@ -362,7 +394,9 @@ def _layer_body(
 ):
     ln1, ln2 = layer["ln1"], layer["ln2"]
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
-    attn = _attention_block(h, layer, cfg, mesh, positions, attn_fn)
+    attn = _attention_block(
+        h, layer, cfg, mesh, positions, attn_fn, fp8=fp8
+    )
     if tag_attn_out:
         # non-flash attention tags no flash_out/flash_lse, so save_attn
         # would otherwise pin nothing and recompute O(S²) attention
@@ -410,8 +444,9 @@ def run_trunk(
 
     ``fp8_layers``: stacked per-layer fp8 delayed-scaling states
     (init_fp8_states; leading axis L) — scanned alongside the layer
-    params. Dense layers only; incompatible with pp (state threading
-    across stages is not wired).
+    params — or the string "current" for stateless current scaling
+    (the only sound fp8 mode under pp; see the pp guard below). Dense
+    layers only (MoE experts stay bf16).
 
     Returns (hidden states [B,S,D] — pre-final-norm, aux losses).
     """
@@ -421,6 +456,10 @@ def run_trunk(
         mesh=mesh,
         attn_fn=attn_fn,
         tag_attn_out=tag_attn_out,
+        # the "current" sentinel must be BAKED into the partial, not
+        # passed at call time: jax.checkpoint (below) treats call-time
+        # args as traceable values and a str is not a valid JAX type
+        **({"fp8": "current"} if fp8_layers == "current" else {}),
     )
     if cfg.remat == "full":
         body = jax.checkpoint(body)
@@ -496,14 +535,23 @@ def run_trunk(
     }
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     v = max(1, getattr(cfg, "pp_interleave", 1))
-    if fp8_layers is not None and pp > 1:
+    if pp > 1 and fp8_layers is not None and fp8_layers != "current":
+        # delayed-scaling state CANNOT thread a pipeline schedule: the
+        # pipeline runs every microbatch through the same layer inside
+        # one forward, so the state's cotangent is the SUM of m updated
+        # amax histories (plus bubble-tick pushes) — not a state. The
+        # train step passes the "current" sentinel on pp meshes instead
+        # (stateless per-tensor scaling, TE's Float8CurrentScaling).
         raise ValueError(
-            "fp8 state threading is not wired through pipeline stages"
+            "pipeline meshes use current-scaling fp8 (pass "
+            "fp8_states='current'); delayed-scaling state dicts cannot "
+            "thread a pipeline schedule"
         )
     if pp > 1:
         from dlrover_tpu.parallel.pipeline import pipeline_apply
 
         # router aux losses are not collected across pipeline stages
+        # (fp8="current" rides inside the body partial when set)
         aux = zero_aux
         x = pipeline_apply(
             lambda c, layer, pos: body(c, layer, pos)[0],
@@ -540,7 +588,7 @@ def run_trunk(
             )
             layers = jax.tree.map(lambda t: jnp.take(t, perm, 0), layers)
 
-        if fp8_layers is not None:
+        if fp8_layers is not None and fp8_layers != "current":
 
             def scan_fn8(carry, inp):
                 layer, fp8, idx = inp
@@ -556,6 +604,7 @@ def run_trunk(
                 scan_fn8, x, (layers, fp8_layers, jnp.arange(n_layers))
             )
         else:
+            # fp8="current" (when set) is baked into the body partial
 
             def scan_fn(carry, inp):
                 layer, idx = inp
@@ -575,21 +624,25 @@ def run_trunk(
 
 
 def init_fp8_states(cfg: ModelConfig):
-    """Stacked per-layer fp8 delayed-scaling states for the MLP GEMMs.
+    """Stacked per-layer fp8 delayed-scaling states for every linear in
+    the layer body: the attention q/k/v/o projections AND the MLP GEMMs
+    (the reference wires TE fp8 through its linears generally —
+    atorch/auto/opt_lib/amp_optimization.py:197).
 
     One {amax_x, amax_w, amax_g} history set per projection per layer
-    (leading axis L), matching run_trunk's scan. Lives in the train
-    state under ``state["fp8"]``; the step's gradient w.r.t. it IS the
-    updated state (ops/fp8.py convention). Reference:
-    atorch/auto/opt_lib/amp_optimization.py:197 (TE fp8 autocast).
+    (leading axis L), matching run_trunk's scan and the pipeline's
+    per-layer stacking. Lives in the train state under ``state["fp8"]``;
+    the step's gradient w.r.t. it IS the updated state (ops/fp8.py
+    convention).
     """
     if cfg.n_experts > 0:
         raise ValueError("fp8 wiring covers dense MLP layers, not MoE")
     from dlrover_tpu.ops.fp8 import init_fp8_state
 
-    names = ("gate", "up", "down") if cfg.act == "swiglu" else (
+    mlp_names = ("gate", "up", "down") if cfg.act == "swiglu" else (
         "up", "down"
     )
+    names = ("wq", "wk", "wv", "wo") + mlp_names
     one = init_fp8_state()
     return {
         name: jax.tree.map(

@@ -142,3 +142,56 @@ def _fp8_bwd(native, res, g):
 
 
 fp8_dot.defvjp(_fp8_fwd, _fp8_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fp8_dot_current(x, w, native=None):
+    """``x @ w`` with fp8 operands and CURRENT scaling (TE's
+    Float8CurrentScaling recipe): each tensor quantizes against its own
+    amax, computed in-line — no delayed-scaling state.
+
+    This is the fp8 path for pipeline-parallel meshes, where the
+    state-on-cotangent convention of ``fp8_dot`` is unsound: the
+    pipeline runs every microbatch through the same layer inside ONE
+    forward, so the per-layer state's cotangent is the SUM of m updated
+    histories (and bubble ticks contribute further garbage pushes) —
+    summed amax histories are not a state. Current scaling has no state
+    to corrupt and costs one extra reduction per operand, cheap next to
+    the GEMM on TPU.
+    """
+    out, _ = _fp8_cur_fwd(x, w, _resolve_native(native))
+    return out
+
+
+def _cur_scale(t: jax.Array, fmax: float) -> jax.Array:
+    amax = jnp.maximum(jnp.max(jnp.abs(t)).astype(jnp.float32), 1e-12)
+    return amax / fmax
+
+
+def _fp8_cur_fwd(x, w, native):
+    sx = _cur_scale(x, E4M3_MAX)
+    sw = _cur_scale(w, E4M3_MAX)
+    qx = quantize_fp8(x, sx, E4M3)
+    qw = quantize_fp8(w, sw, E4M3)
+    out = (_dot(qx, qw, native) * (sx * sw)).astype(x.dtype)
+    return out, (qx, qw, sx, sw,
+                 jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype))
+
+
+def _fp8_cur_bwd(native, res, g):
+    native = _resolve_native(native)
+    qx, qw, sx, sw, xdt0, wdt0 = res
+    sg = _cur_scale(g, E5M2_MAX)
+    qg = quantize_fp8(g, sg, E5M2)
+    dx = (_dot(qg, qw.T, native) * (sg * sw)).astype(xdt0.dtype)
+    x2d = qx.reshape(-1, qx.shape[-1])
+    g2d = qg.reshape(-1, qg.shape[-1])
+    dw = (_dot(x2d.T, g2d, native) * (sx * sg)).astype(wdt0.dtype)
+    return dx, dw
+
+
+def _fp8_cur_fwd_vjp(x, w, native):
+    return _fp8_cur_fwd(x, w, _resolve_native(native))
+
+
+fp8_dot_current.defvjp(_fp8_cur_fwd_vjp, _fp8_cur_bwd)

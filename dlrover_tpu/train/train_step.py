@@ -154,8 +154,10 @@ def init_train_state(
             "opt_state": opt_state,
             "step": jnp.zeros([], jnp.int32),
         }
-        if cfg.fp8:
-            # fp8 delayed-scaling amax histories: tiny, replicated
+        if cfg.fp8 and mesh.shape.get("pp", 1) == 1:
+            # fp8 delayed-scaling amax histories: tiny, replicated.
+            # Pipeline meshes carry NO fp8 state: they run stateless
+            # current scaling (decoder.run_trunk's "current" mode)
             state["fp8"] = decoder.init_fp8_states(cfg)
         return state
 
@@ -189,7 +191,7 @@ def init_train_state(
         "opt_state": opt_state,
         "step": jnp.zeros([], jnp.int32),
     }
-    if cfg.fp8:
+    if cfg.fp8 and mesh.shape.get("pp", 1) == 1:
         state["fp8"] = jax.jit(lambda: decoder.init_fp8_states(cfg))()
     return state
 
@@ -237,6 +239,15 @@ class TrainStepBuilder:
             loss_fn = functools.partial(self._loss_fn, rng=rng)
         else:
             loss_fn = self._loss_fn
+        if fp8 == "current":
+            # stateless current-scaling fp8 (pipeline meshes): nothing
+            # to differentiate or thread — plain grads, no state out
+            grad_fn = jax.value_and_grad(
+                lambda p: loss_fn(p, batch, fp8_states="current"),
+                has_aux=True,
+            )
+            (loss, metrics), grads = grad_fn(params)
+            return loss, metrics, grads, None
         if fp8 is not None:
             # differentiate w.r.t. the fp8 state too: its "gradient" IS
             # the updated delayed-scaling state (ops/fp8.py convention)
@@ -254,27 +265,35 @@ class TrainStepBuilder:
     def _accumulated_grads(self, params, batch, rng=None, fp8=None):
         """Microbatch scan: batch leading dim is [accum, micro_b, ...].
 
-        The fp8 state (when present) threads through the scan carry so
-        each microbatch's amax observations roll into the next."""
+        The fp8 delayed-scaling state (when present) threads through
+        the scan carry so each microbatch's amax observations roll into
+        the next; the stateless "current" mode has no carry entry."""
         a = self.grad_accum
+        is_cur = fp8 == "current"
 
         def micro(carry, inp):
             mb, idx = inp
-            g_acc, loss_acc, f8 = carry
+            if is_cur:
+                g_acc, loss_acc = carry
+                f8 = "current"
+            else:
+                g_acc, loss_acc, f8 = carry
             r = jax.random.fold_in(rng, idx) if rng is not None else None
             loss, _, g, new_f8 = self._grads(params, mb, rng=r, fp8=f8)
             g_acc = jax.tree.map(jnp.add, g_acc, g)
+            if is_cur:
+                return (g_acc, loss_acc + loss), None
             return (g_acc, loss_acc + loss, new_f8), None
 
         zeros = jax.tree.map(jnp.zeros_like, params)
         mb_batch = jax.tree.map(
             lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]), batch
         )
-        (grads, loss, new_fp8), _ = jax.lax.scan(
-            micro,
-            (zeros, jnp.zeros([], jnp.float32), fp8),
-            (mb_batch, jnp.arange(a)),
-        )
+        loss0 = jnp.zeros([], jnp.float32)
+        init = (zeros, loss0) if is_cur else (zeros, loss0, fp8)
+        out, _ = jax.lax.scan(micro, init, (mb_batch, jnp.arange(a)))
+        grads, loss = out[0], out[1]
+        new_fp8 = None if is_cur else out[2]
         grads = jax.tree.map(lambda g: g / a, grads)
         return loss / a, {"loss": loss / a}, grads, new_fp8
 
@@ -293,6 +312,15 @@ class TrainStepBuilder:
             # lockstep), different every step
             rng = jax.random.fold_in(jax.random.key(17), state["step"])
         fp8 = state.get("fp8")
+        if (
+            fp8 is None
+            and self.cfg.fp8
+            and self.mesh.shape.get("pp", 1) > 1
+        ):
+            # pipeline meshes: stateless current-scaling fp8 (delayed-
+            # scaling state cannot thread a pipeline schedule; see
+            # decoder.run_trunk)
+            fp8 = "current"
         if self.grad_accum > 1:
             loss, metrics, grads, new_fp8 = self._accumulated_grads(
                 state["params"], batch, rng=rng, fp8=fp8
