@@ -81,6 +81,7 @@ class ElasticJob:
                 "replicaSpecs": {
                     role: {
                         "replicas": rs.replicas,
+                        "hostsPerSlice": rs.slice.hosts_per_slice,
                         "template": pod_template(self.name, role, rs),
                     }
                     for role, rs in self.spec.replica_specs.items()
@@ -90,6 +91,58 @@ class ElasticJob:
 
     def render_yaml(self) -> str:
         return yaml.safe_dump(self.to_manifest(), sort_keys=False)
+
+    @staticmethod
+    def from_manifest(obj: Dict[str, Any]) -> "ElasticJob":
+        """Rebuild the job object from a watched/applied manifest — the
+        operator's inverse of ``to_manifest`` (the Go operator gets this
+        from controller-runtime decoding into elasticjob_types.go)."""
+        meta = obj.get("metadata", {}) or {}
+        spec = obj.get("spec", {}) or {}
+        replica_specs: Dict[str, ReplicaSpec] = {}
+        for role, rs in (spec.get("replicaSpecs") or {}).items():
+            tpl = (rs.get("template") or {}).get("spec", {}) or {}
+            cont = (tpl.get("containers") or [{}])[0]
+            sel = tpl.get("nodeSelector", {}) or {}
+            req = (cont.get("resources") or {}).get("requests", {}) or {}
+            replica_specs[role] = ReplicaSpec(
+                replicas=int(rs.get("replicas", 1)),
+                image=cont.get("image", "dlrover-tpu:latest"),
+                command=list(cont.get("command") or []),
+                cpu=str(req.get("cpu", "8")),
+                memory=str(req.get("memory", "32Gi")),
+                env={
+                    e["name"]: e.get("value", "")
+                    for e in (cont.get("env") or [])
+                    if "name" in e
+                },
+                slice=TPUSliceSpec(
+                    accelerator=sel.get(
+                        "cloud.google.com/gke-tpu-accelerator",
+                        "tpu-v5p-slice",
+                    ),
+                    topology=sel.get(
+                        "cloud.google.com/gke-tpu-topology", "2x2x1"
+                    ),
+                    chips_per_host=int(req.get("google.com/tpu", 4)),
+                    hosts_per_slice=int(rs.get("hostsPerSlice", 1)),
+                ),
+            )
+        return ElasticJob(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels") or {}),
+            spec=ElasticJobSpec(
+                distribution_strategy=spec.get(
+                    "distributionStrategy", "AllreduceStrategy"
+                ),
+                optimize_mode=spec.get("optimizeMode", "single-job"),
+                replica_specs=replica_specs,
+                min_hosts=int(spec.get("minHosts", 1)),
+                max_hosts=int(spec.get("maxHosts", 1)),
+                suspend=bool(spec.get("suspend", False)),
+            ),
+        )
 
 
 @dataclass

@@ -250,6 +250,11 @@ class FakeKubeApi(KubeApi):
         with self._cond:
             return self._events[-1].resource_version if self._events else 0
 
+    def list_rv(self, kind: str, namespace: str = "default") -> int:
+        """Collection resourceVersion (RealKubeApi parity): the rv to
+        resume a watch from after a relist."""
+        return self.latest_rv()
+
     # ---- kubelet stand-in -------------------------------------------------
 
     def set_pod_phase(

@@ -1,0 +1,430 @@
+"""``dlrover-tpu-operator``: the deployable controller process.
+
+Reference: the Go operator's entrypoint and packaging —
+dlrover/go/operator/main.go (manager + leader election over a Lease,
+controllers registered per CRD) and dlrover/go/operator/config/
+(crd/, rbac/, manifests/). TPU framing: the reconcile logic already
+exists as ``cluster/kube.py:JobReconciler`` (proven over the wire-level
+API server); this module adds what deployment needs around it —
+
+- **OperatorController**: a namespace-wide ElasticJob watch that spawns
+  one JobReconciler per job (the Go manager's controller fan-out),
+  creates the job's master pod + Service first so workers get
+  ``DLROVER_TPU_MASTER_ADDR`` injected (docs/kubernetes.md flow), and
+  tears the job down on DELETED.
+- **LeaderElector**: ConfigMap-held lease with holder + renew
+  timestamps (leader-election-lite — the Go operator uses a
+  coordination/v1 Lease the same way: acquire, renew at ttl/3, steal
+  when stale).
+- **main()**: argparse → RealKubeApi (in-cluster defaults) → elect →
+  run. Manifests to deploy it live under ``deploy/``.
+"""
+
+import argparse
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.cluster.crd import ElasticJob, ReplicaSpec, pod_template
+from dlrover_tpu.cluster.kube import (
+    JOB_LABEL,
+    JobReconciler,
+    KubeApi,
+    WatchEvent,
+    WatchExpired,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+MASTER_PORT = 8600
+
+
+def master_pod_manifest(
+    job: ElasticJob, port: int = MASTER_PORT, brain_addr: str = ""
+) -> Dict:
+    """The job-master pod: created before any worker so the reconciler
+    can inject its address (reference: the Go operator's master replica,
+    elasticjob_controller.go creating the master pod first).
+    ``optimizeMode: cluster`` jobs get ``--optimize-mode cluster
+    --brain-addr`` so the master actually consults the shared brain."""
+    rs = job.spec.replica_specs.get("master")
+    if rs is None:
+        worker = job.spec.replica_specs.get("worker") or ReplicaSpec()
+        command = [
+            "dlrover-tpu-master",
+            "--port",
+            str(port),
+            "--num-workers",
+            str(worker.replicas),
+            "--max-workers",
+            str(job.spec.max_hosts),
+            "--job-name",
+            job.name,
+        ]
+        if job.spec.optimize_mode == "cluster":
+            if brain_addr:
+                command += [
+                    "--optimize-mode", "cluster",
+                    "--brain-addr", brain_addr,
+                ]
+            else:
+                logger.warning(
+                    "ElasticJob %s asks optimizeMode=cluster but the "
+                    "operator has no --brain-addr; master runs "
+                    "single-job",
+                    job.name,
+                )
+        rs = ReplicaSpec(
+            replicas=1,
+            image=worker.image,
+            command=command,
+            cpu="2",
+            memory="4Gi",
+        )
+    tpl = pod_template(job.name, "master", rs)
+    # the master is a CPU pod: no TPU request, no slice pinning
+    tpl["spec"].pop("nodeSelector", None)
+    res = tpl["spec"]["containers"][0]["resources"]
+    res["requests"].pop("google.com/tpu", None)
+    res["limits"].pop("google.com/tpu", None)
+    tpl["metadata"]["name"] = f"{job.name}-master"
+    tpl["metadata"]["namespace"] = job.namespace
+    return {"apiVersion": "v1", "kind": "Pod", **tpl}
+
+
+def master_service_manifest(job: ElasticJob, port: int = MASTER_PORT) -> Dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{job.name}-master",
+            "namespace": job.namespace,
+            "labels": {JOB_LABEL: job.name},
+        },
+        "spec": {
+            "selector": {
+                JOB_LABEL: job.name,
+                "elasticjob.dlrover/replica-type": "master",
+            },
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+class LeaderElector:
+    """ConfigMap-held lease: one active operator per namespace.
+
+    The Go operator leans on controller-runtime's Lease-based election
+    (main.go ``LeaderElection: true``); the same acquire/renew/steal
+    protocol here runs over a ConfigMap so it needs no extra API group.
+    """
+
+    def __init__(
+        self,
+        api: KubeApi,
+        namespace: str = "default",
+        name: str = "dlrover-tpu-operator-leader",
+        identity: Optional[str] = None,
+        ttl_s: float = 15.0,
+    ):
+        self._api = api
+        self._ns = namespace
+        self._name = name
+        self.identity = identity or (
+            f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        )
+        self.ttl_s = ttl_s
+        self.held_by_other = False
+
+    def _manifest(self) -> Dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": self._name, "namespace": self._ns},
+            "data": {
+                "holder": self.identity,
+                "renew": repr(time.time()),
+            },
+        }
+
+    def try_acquire(self) -> bool:
+        """Acquire, renew, or steal-if-stale. False = not holding;
+        ``self.held_by_other`` distinguishes an authoritative loss
+        (another LIVE holder observed) from a transient API failure
+        (which a current leader may ride out until its ttl passes)."""
+        self.held_by_other = False
+        try:
+            cm = self._api.get("ConfigMap", self._name, self._ns)
+            if cm is None:
+                self._api.create(self._manifest())
+                return True
+            data = cm.get("data", {}) or {}
+            holder = data.get("holder", "")
+            try:
+                renew = float(data.get("renew", "0"))
+            except ValueError:
+                renew = 0.0
+            if holder != self.identity and time.time() - renew <= self.ttl_s:
+                self.held_by_other = True
+                return False
+            fresh = self._manifest()
+            fresh["metadata"] = cm.get("metadata", fresh["metadata"])
+            fresh["metadata"]["name"] = self._name
+            self._api.update(fresh)
+            return True
+        except Exception:  # noqa: BLE001 — create/update race or API flake
+            logger.debug("lease acquire attempt failed", exc_info=True)
+            return False
+
+    def run(
+        self,
+        stop: threading.Event,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Callable[[], None],
+    ) -> None:
+        """Blocking election loop: renew at ttl/3 while leading.
+
+        A failed renew does NOT immediately drop leadership: the lease
+        the cluster sees is still ours until ttl passes, and tearing
+        every reconciler down over one flaky API call would cold-restart
+        the whole namespace. Leadership is only ceded when renewal has
+        failed for longer than the lease ttl (at which point a standby
+        may legitimately have stolen it)."""
+        leading = False
+        last_renew_ok = 0.0
+        while not stop.is_set():
+            got = self.try_acquire()
+            now = time.time()
+            if got:
+                last_renew_ok = now
+                if not leading:
+                    logger.info(
+                        "leader election: %s leading", self.identity
+                    )
+                    leading = True
+                    on_started_leading()
+            elif leading and (
+                self.held_by_other or now - last_renew_ok > self.ttl_s
+            ):
+                logger.warning(
+                    "leader election: %s lost the lease (%s)",
+                    self.identity,
+                    "stolen by a live holder"
+                    if self.held_by_other
+                    else f"no successful renew for {now - last_renew_ok:.1f}s",
+                )
+                leading = False
+                on_stopped_leading()
+            stop.wait(self.ttl_s / 3 if leading else self.ttl_s / 2)
+        if leading:
+            on_stopped_leading()
+
+
+class OperatorController:
+    """Namespace-wide ElasticJob controller: one JobReconciler per job.
+
+    The Go manager registers ElasticJob + ScalePlan controllers once and
+    reconciles every object of the kind (elasticjob_controller.go:47);
+    here the per-job ScalePlan/replica logic is JobReconciler, and this
+    class is the fan-out: watch the collection, ensure a master
+    pod + Service and a reconciler for each live job, tear down on
+    DELETED.
+    """
+
+    def __init__(
+        self,
+        api: KubeApi,
+        namespace: str = "default",
+        master_port: int = MASTER_PORT,
+        brain_addr: str = "",
+    ):
+        self._api = api
+        self._ns = namespace
+        self._port = master_port
+        self._brain_addr = brain_addr
+        self._recs: Dict[str, JobReconciler] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="operator-controller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for rec in self._recs.values():
+            rec.stop()
+        self._recs.clear()
+
+    def jobs(self) -> List[str]:
+        return sorted(self._recs)
+
+    # ---- control loop -----------------------------------------------------
+
+    def _adopt_current(self):
+        """Sync reconcilers to the listed collection state; returns the
+        rv to resume the watch from.
+
+        The resume point is taken BEFORE the list (kube.py's hardened
+        order): a job created between the two calls is then replayed by
+        the watch instead of skipped forever. Runs at fresh start,
+        leader failover, and post-410 relist — where reconcilers whose
+        job vanished during the watch gap must be torn down here,
+        because their DELETED events are gone for good."""
+        list_rv = getattr(self._api, "list_rv", None)
+        since = list_rv("ElasticJob", self._ns) if list_rv else 0
+        listed = set()
+        for obj in self._api.list("ElasticJob", self._ns):
+            listed.add((obj.get("metadata") or {}).get("name", ""))
+            self._ensure(obj)
+        for gone in sorted(set(self._recs) - listed):
+            self._teardown(gone)
+        return since
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                since = self._adopt_current()
+                for ev in self._api.watch(
+                    kind="ElasticJob",
+                    namespace=self._ns,
+                    since_rv=since,
+                    stop=self._stop,
+                ):
+                    if ev.type in ("ADDED", "MODIFIED"):
+                        self._ensure(ev.obj)
+                    elif ev.type == "DELETED":
+                        self._teardown(ev.name)
+                return
+            except WatchExpired:
+                continue  # relist via the loop head
+            except Exception:
+                logger.exception("operator watch failed; retrying")
+                self._stop.wait(1.0)
+
+    def _ensure(self, obj: Dict):
+        name = (obj.get("metadata") or {}).get("name", "")
+        if not name or name in self._recs:
+            return  # per-job MODIFIED handling lives in its reconciler
+        job = ElasticJob.from_manifest(obj)
+        addr = self._ensure_master(job)
+        rec = JobReconciler(self._api, job, master_addr=addr)
+        rec.start()
+        # assert desired state NOW — a real API server's watch-from-
+        # current does not replay the ADDED event the way the fake does
+        rec._reconcile(WatchEvent("MODIFIED", obj))
+        self._recs[name] = rec
+        logger.info("operator: reconciling ElasticJob %s", name)
+
+    def _ensure_master(self, job: ElasticJob) -> str:
+        name = f"{job.name}-master"
+        if self._api.get("Pod", name, job.namespace) is None:
+            self._api.create(
+                master_pod_manifest(
+                    job, self._port, brain_addr=self._brain_addr
+                )
+            )
+        if self._api.get("Service", name, job.namespace) is None:
+            self._api.create(master_service_manifest(job, self._port))
+        return f"{name}.{job.namespace}.svc:{self._port}"
+
+    def _teardown(self, name: str):
+        rec = self._recs.pop(name, None)
+        if rec is None:
+            return
+        rec.stop()
+        # real k8s garbage-collects via ownerReferences; over the
+        # minimal KubeApi the operator deletes the job's pods itself
+        for pod in self._api.list(
+            "Pod", self._ns, label_selector={JOB_LABEL: name}
+        ):
+            self._api.delete("Pod", pod["metadata"]["name"], self._ns)
+        self._api.delete("Service", f"{name}-master", self._ns)
+        logger.info("operator: ElasticJob %s deleted; tore down", name)
+
+
+def parse_operator_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="dlrover-tpu-operator")
+    p.add_argument(
+        "--kube-url",
+        default="https://kubernetes.default.svc",
+        help="API server base URL (default: in-cluster service)",
+    )
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--token", default="", help="bearer token override")
+    p.add_argument(
+        "--ca-path", default="", help="server CA (default: in-cluster)"
+    )
+    p.add_argument(
+        "--no-verify", action="store_true", help="skip TLS verification"
+    )
+    p.add_argument("--master-port", type=int, default=MASTER_PORT)
+    p.add_argument(
+        "--brain-addr",
+        default="",
+        help="shared brain service addr, injected into masters of "
+        "optimizeMode=cluster jobs (e.g. "
+        "dlrover-tpu-brain.dlrover-tpu-system.svc:8600)",
+    )
+    p.add_argument("--lease-ttl", type=float, default=15.0)
+    p.add_argument(
+        "--no-leader-elect",
+        action="store_true",
+        help="run without the lease (single-replica deployments)",
+    )
+    return p.parse_args(argv)
+
+
+def run_operator(
+    args: argparse.Namespace,
+    api: Optional[KubeApi] = None,
+    stop: Optional[threading.Event] = None,
+) -> None:
+    """The entrypoint body, testable: inject ``api``/``stop``."""
+    if api is None:
+        from dlrover_tpu.cluster.kube_http import RealKubeApi
+
+        api = RealKubeApi(
+            args.kube_url,
+            token=args.token or None,
+            ca_path=args.ca_path or None,
+            verify=not args.no_verify,
+        )
+    stop = stop or threading.Event()
+    controller = OperatorController(
+        api,
+        namespace=args.namespace,
+        master_port=args.master_port,
+        brain_addr=args.brain_addr,
+    )
+    if args.no_leader_elect:
+        controller.start()
+        try:
+            stop.wait()
+        finally:
+            controller.stop()
+        return
+    elector = LeaderElector(
+        api, namespace=args.namespace, ttl_s=args.lease_ttl
+    )
+    elector.run(stop, controller.start, controller.stop)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    run_operator(parse_operator_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,259 @@
+"""dlrover-tpu-operator: deployable packaging (VERDICT r3 #3).
+
+Reference: dlrover/go/operator — main.go (manager entrypoint + leader
+election) and config/ (crd/, rbac/, manifests/). Covered here: the
+manifest set under deploy/ renders and matches what the code serves,
+the controller fan-out (one JobReconciler per ElasticJob, master
+pod + Service first), the ConfigMap lease, and the entrypoint main loop
+driven against the wire-level API server.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+import yaml
+
+from dlrover_tpu.cluster import crd
+from dlrover_tpu.cluster.crd import (
+    ElasticJob,
+    ElasticJobSpec,
+    ReplicaSpec,
+    TPUSliceSpec,
+)
+from dlrover_tpu.cluster.kube import JOB_LABEL, FakeKubeApi
+from dlrover_tpu.cluster.operator import (
+    LeaderElector,
+    OperatorController,
+    parse_operator_args,
+    run_operator,
+)
+
+DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy")
+
+
+def _job(name="demo", replicas=2, max_hosts=4):
+    return ElasticJob(
+        name,
+        spec=ElasticJobSpec(
+            replica_specs={
+                "worker": ReplicaSpec(
+                    replicas=replicas,
+                    slice=TPUSliceSpec(hosts_per_slice=1),
+                    env={"FOO": "bar"},
+                )
+            },
+            min_hosts=1,
+            max_hosts=max_hosts,
+        ),
+    )
+
+
+def _wait(cond, timeout=8.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _docs(path):
+    with open(os.path.join(DEPLOY, path)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def test_deploy_manifests_render_and_match_the_code():
+    crds = _docs("crd.yaml")
+    names = {d["spec"]["names"]["kind"]: d for d in crds}
+    assert set(names) == {"ElasticJob", "ScalePlan"}
+    for kind, d in names.items():
+        assert d["spec"]["group"] == crd.GROUP
+        versions = [v["name"] for v in d["spec"]["versions"]]
+        assert crd.VERSION in versions
+        # the plural must match the REST path RealKubeApi uses
+        from dlrover_tpu.cluster.kube_http import _BUILTIN_PATHS
+
+        prefix, plural = _BUILTIN_PATHS[kind]
+        assert d["spec"]["names"]["plural"] == plural
+        assert prefix == f"/apis/{crd.GROUP}/{crd.VERSION}"
+
+    rbac = _docs("rbac.yaml")
+    kinds = {d["kind"] for d in rbac}
+    assert {"ServiceAccount", "ClusterRole", "ClusterRoleBinding"} <= kinds
+    role = next(d for d in rbac if d["kind"] == "ClusterRole")
+    covered = {}
+    for rule in role["rules"]:
+        for res in rule["resources"]:
+            covered.setdefault(res, set()).update(rule["verbs"])
+    # everything cluster/operator.py + JobReconciler touch
+    assert {"create", "delete", "list", "watch"} <= covered["pods"]
+    assert {"create", "get", "update"} <= covered["configmaps"]
+    assert {"list", "watch"} <= covered["elasticjobs"]
+    assert "watch" in covered["scaleplans"]
+
+    dep_docs = _docs("operator.yaml")
+    dep = next(d for d in dep_docs if d["kind"] == "Deployment")
+    spec = dep["spec"]["template"]["spec"]
+    sa = next(d for d in rbac if d["kind"] == "ServiceAccount")
+    assert spec["serviceAccountName"] == sa["metadata"]["name"]
+    assert spec["containers"][0]["command"][0] == "dlrover-tpu-operator"
+    binding = next(d for d in rbac if d["kind"] == "ClusterRoleBinding")
+    assert binding["subjects"][0]["namespace"] == (
+        sa["metadata"]["namespace"]
+    )
+    assert dep["metadata"]["namespace"] == sa["metadata"]["namespace"]
+
+
+def test_elasticjob_manifest_roundtrip():
+    job = _job(replicas=3, max_hosts=8)
+    back = ElasticJob.from_manifest(job.to_manifest())
+    assert back.name == job.name
+    assert back.spec.max_hosts == 8
+    rs = back.spec.replica_specs["worker"]
+    assert rs.replicas == 3
+    assert rs.env["FOO"] == "bar"
+    assert rs.slice.hosts_per_slice == 1
+    assert rs.slice.chips_per_host == job.spec.replica_specs[
+        "worker"
+    ].slice.chips_per_host
+
+
+def test_operator_controller_fans_out_reconcilers():
+    """One controller, many jobs: each ElasticJob gets its master
+    pod + Service and its worker pods with the master addr injected;
+    DELETED tears the job's pods down."""
+    api = FakeKubeApi()
+    ctl = OperatorController(api)
+    ctl.start()
+    try:
+        api.create(_job("j1", replicas=2).to_manifest())
+        _wait(
+            lambda: api.get("Pod", "j1-worker-1") is not None,
+            msg="j1 workers",
+        )
+        assert api.get("Pod", "j1-master") is not None
+        assert api.get("Service", "j1-master") is not None
+        env = {
+            e["name"]: e["value"]
+            for e in api.get("Pod", "j1-worker-0")["spec"]["containers"][0][
+                "env"
+            ]
+        }
+        assert env["DLROVER_TPU_MASTER_ADDR"] == "j1-master.default.svc:8600"
+
+        api.create(_job("j2", replicas=1).to_manifest())
+        _wait(
+            lambda: api.get("Pod", "j2-worker-0") is not None,
+            msg="j2 worker",
+        )
+        assert ctl.jobs() == ["j1", "j2"]
+
+        api.delete("ElasticJob", "j1")
+        _wait(
+            lambda: not api.list("Pod", label_selector={JOB_LABEL: "j1"}),
+            msg="j1 pods torn down",
+        )
+        _wait(lambda: ctl.jobs() == ["j2"], msg="j1 reconciler removed")
+        assert api.get("Pod", "j2-worker-0") is not None  # j2 untouched
+    finally:
+        ctl.stop()
+
+
+def test_operator_relist_tears_down_jobs_deleted_during_watch_gap():
+    """After a 410, the DELETED events inside the gap are unrecoverable:
+    the relist must diff live reconcilers against the listed collection
+    and tear down the vanished jobs' pods (otherwise they leak forever
+    and a stale ScalePlan could scale a dead job back up)."""
+    api = FakeKubeApi()
+    ctl = OperatorController(api)
+    api.create(_job("gap", replicas=1).to_manifest())
+    since = ctl._adopt_current()
+    assert ctl.jobs() == ["gap"]
+    assert since > 0
+    _wait(lambda: api.get("Pod", "gap-worker-0") is not None, msg="pod")
+    # the job disappears while "the watch is down" (no controller loop
+    # running to see the DELETED event)
+    api.delete("ElasticJob", "gap")
+    ctl._adopt_current()
+    assert ctl.jobs() == []
+    assert not api.list("Pod", label_selector={JOB_LABEL: "gap"})
+    ctl.stop()
+
+
+def test_master_command_carries_cluster_optimize_mode():
+    """An optimizeMode=cluster job's master must actually be told to use
+    the brain (VERDICT r3 #4 wiring meets the operator)."""
+    from dlrover_tpu.cluster.operator import master_pod_manifest
+
+    job = _job("br", replicas=1)
+    job.spec.optimize_mode = "cluster"
+    pod = master_pod_manifest(job, brain_addr="brain.svc:8600")
+    cmd = pod["spec"]["containers"][0]["command"]
+    assert "--optimize-mode" in cmd and "cluster" in cmd
+    assert "--brain-addr" in cmd and "brain.svc:8600" in cmd
+    # without a brain addr the flag is dropped (with a warning), not
+    # emitted half-formed
+    pod2 = master_pod_manifest(job)
+    assert "--optimize-mode" not in pod2["spec"]["containers"][0]["command"]
+
+
+def test_leader_elector_acquire_renew_steal():
+    api = FakeKubeApi()
+    a = LeaderElector(api, identity="op-a", ttl_s=0.4)
+    b = LeaderElector(api, identity="op-b", ttl_s=0.4)
+    assert a.try_acquire()          # fresh lease
+    assert not b.try_acquire()      # held and live
+    assert a.try_acquire()          # renew own
+    time.sleep(0.6)                 # let it go stale
+    assert b.try_acquire()          # steal expired lease
+    assert not a.try_acquire()      # a sees b's live lease
+
+
+def test_operator_entrypoint_main_loop_over_http():
+    """Drive the REAL entrypoint body (argparse → RealKubeApi →
+    election → controller) against the wire-level API server from
+    test_kube_http; an ElasticJob applied by a separate client turns
+    into pods."""
+    from test_kube_http import _KubeHandler
+    from http.server import ThreadingHTTPServer
+
+    from dlrover_tpu.cluster.kube_http import RealKubeApi
+
+    fake = FakeKubeApi()
+    handler = type("H", (_KubeHandler,), {"fake": fake})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    server.daemon_threads = True
+    server.seen_watch_rvs = []
+    st = threading.Thread(target=server.serve_forever, daemon=True)
+    st.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        args = parse_operator_args(
+            ["--kube-url", url, "--token", "test-token",
+             "--lease-ttl", "2"]
+        )
+        stop = threading.Event()
+        op = threading.Thread(
+            target=run_operator, args=(args,), kwargs={"stop": stop},
+            daemon=True,
+        )
+        op.start()
+        client = RealKubeApi(url, token="test-token")
+        client.create(_job("wired", replicas=2).to_manifest())
+        _wait(
+            lambda: client.get("Pod", "wired-worker-1") is not None,
+            timeout=12.0,
+            msg="operator created workers over HTTP",
+        )
+        assert client.get("Pod", "wired-master") is not None
+        # the lease exists and is held by this operator instance
+        lease = client.get("ConfigMap", "dlrover-tpu-operator-leader")
+        assert lease and lease["data"]["holder"]
+        stop.set()
+        op.join(timeout=10)
+        assert not op.is_alive()
+    finally:
+        server.shutdown()
+        server.server_close()
