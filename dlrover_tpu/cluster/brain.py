@@ -46,7 +46,23 @@ class JobMetrics:
     oom: bool = False
 
 
-class MetricsStore:
+class BaseMetricsStore:
+    """Datastore contract the brain runs over (reference: the Go
+    brain's pluggable datastore, go/brain/pkg/datastore — MySQL in
+    production). Implementations: MetricsStore (in-memory / jsonl);
+    swap in anything that answers these three."""
+
+    def append(self, m: JobMetrics) -> None:
+        raise NotImplementedError
+
+    def job_rows(self, job_name: str) -> List[JobMetrics]:
+        raise NotImplementedError
+
+    def kind_rows(self, job_kind: str) -> List[JobMetrics]:
+        raise NotImplementedError
+
+
+class MetricsStore(BaseMetricsStore):
     """Append-only metrics log, optionally persisted as jsonl."""
 
     def __init__(self, path: Optional[str] = None):
@@ -133,7 +149,7 @@ class BrainService(ResourceOptimizer):
 
     def __init__(
         self,
-        store: Optional[MetricsStore] = None,
+        store: Optional[BaseMetricsStore] = None,
         min_workers: int = 1,
         max_workers: int = 64,
         node_unit: int = 1,
@@ -336,3 +352,183 @@ def _algo_hot_ps(svc: BrainService, stats: Dict) -> ResourcePlan:
         sorted(hot),
     )
     return plan
+
+
+# ---------------------------------------------------------------------------
+# Wire service (reference: the Go brain is a STANDALONE cluster-level
+# gRPC service shared across jobs, proto/brain.proto:196-199; masters
+# reach it through BrainResoureOptimizer, resource/brain_optimizer.py).
+# Same split here over the framework's typed transport, mirroring
+# accelerate/service.py's EngineService/EngineClient pair.
+# ---------------------------------------------------------------------------
+
+
+class _BrainServicer:
+    """Typed-transport servicer over one shared BrainService."""
+
+    def __init__(self, service: BrainService):
+        self._svc = service
+        # bind_job mutates per-job state on the shared service; requests
+        # from many masters interleave, so bind+optimize is one atom
+        self._lock = threading.Lock()
+
+    def report(self, msg) -> bool:
+        from dlrover_tpu.common import messages as msgs
+
+        if isinstance(msg, msgs.BrainPersistMetricsRequest):
+            try:
+                self._svc.persist_metrics(
+                    JobMetrics(**json.loads(msg.metrics_json))
+                )
+                return True
+            except (TypeError, json.JSONDecodeError):
+                logger.exception("bad persist_metrics payload")
+                return False
+        return False
+
+    def get(self, msg):
+        from dlrover_tpu.common import messages as msgs
+
+        if isinstance(msg, msgs.BrainOptimizeRequest):
+            try:
+                with self._lock:
+                    self._svc.bind_job(msg.job_name, msg.job_kind)
+                    plan = self._svc.generate_plan(
+                        msg.stage, json.loads(msg.stats_json)
+                    )
+                return msgs.BrainOptimizeResponse(
+                    plan_json=json.dumps(asdict(plan))
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.exception("brain optimize failed")
+                return msgs.BrainOptimizeResponse(error=str(e))
+        if isinstance(msg, msgs.BrainJobMetricsRequest):
+            rows = self._svc.get_job_metrics(msg.job_name)
+            return msgs.BrainJobMetricsResponse(
+                rows_json=json.dumps([asdict(r) for r in rows])
+            )
+        return None
+
+
+class BrainWireServer:
+    """Hosts one BrainService for the whole cluster."""
+
+    def __init__(self, service: Optional[BrainService] = None, port: int = 0):
+        from dlrover_tpu.common.comm import MasterTransportServer
+
+        self.service = service or BrainService()
+        self._server = MasterTransportServer(
+            _BrainServicer(self.service), port=port
+        )
+        self._server.start()
+        self.port = self._server.port
+
+    def stop(self):
+        self._server.stop()
+
+
+class BrainClient(ResourceOptimizer):
+    """Master-side optimizer backed by a remote brain
+    (optimize_mode=cluster). Drop-in where LocalHeuristicOptimizer or
+    an in-process BrainService goes: bind_job + generate_plan, plus the
+    persist/get metrics RPCs the reference client exposes."""
+
+    def __init__(self, addr: str, timeout_s: float = 30.0):
+        from dlrover_tpu.common.comm import MasterTransportClient
+
+        self._t = MasterTransportClient(addr, timeout_s=timeout_s)
+        self._job_name = ""
+        self._job_kind = ""
+
+    def bind_job(self, job_name: str, job_kind: str = ""):
+        self._job_name = job_name
+        self._job_kind = job_kind
+
+    def persist_metrics(self, m: JobMetrics) -> bool:
+        from dlrover_tpu.common import messages as msgs
+
+        return self._t.report(
+            msgs.BrainPersistMetricsRequest(metrics_json=json.dumps(asdict(m)))
+        )
+
+    def get_job_metrics(self, job_name: str) -> List[JobMetrics]:
+        from dlrover_tpu.common import messages as msgs
+
+        resp = self._t.get(msgs.BrainJobMetricsRequest(job_name=job_name))
+        if resp is None or resp.error:
+            raise RuntimeError(
+                f"brain get_job_metrics failed: "
+                f"{'unreachable' if resp is None else resp.error}"
+            )
+        return [JobMetrics(**d) for d in json.loads(resp.rows_json)]
+
+    def generate_plan(self, stage: str, stats: Dict) -> ResourcePlan:
+        from dlrover_tpu.common import messages as msgs
+
+        try:
+            resp = self._t.get(
+                msgs.BrainOptimizeRequest(
+                    job_name=self._job_name,
+                    job_kind=self._job_kind,
+                    stage=stage,
+                    stats_json=json.dumps(stats),
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — transport failure
+            logger.warning(
+                "brain optimize unreachable (%s); returning empty plan", e
+            )
+            return ResourcePlan()
+        if resp is None or resp.error:
+            # an unreachable/failing brain must not stall the job: an
+            # empty plan means "no change" (the reference master
+            # degrades to its local optimizer the same way)
+            logger.warning(
+                "brain optimize unavailable (%s); returning empty plan",
+                "unreachable" if resp is None else resp.error,
+            )
+            return ResourcePlan()
+        return ResourcePlan(**json.loads(resp.plan_json))
+
+    def close(self):
+        self._t.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``dlrover-tpu-brain``: run the cluster brain as its own process
+    (reference: go/brain's standalone deployment)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dlrover-tpu-brain")
+    p.add_argument("--port", type=int, default=8600)
+    p.add_argument(
+        "--store-path",
+        default="",
+        help="jsonl metrics store path (empty = in-memory)",
+    )
+    p.add_argument("--min-workers", type=int, default=1)
+    p.add_argument("--max-workers", type=int, default=64)
+    p.add_argument("--node-unit", type=int, default=1)
+    args = p.parse_args(argv)
+    store = MetricsStore(args.store_path or None)
+    server = BrainWireServer(
+        BrainService(
+            store=store,
+            min_workers=args.min_workers,
+            max_workers=args.max_workers,
+            node_unit=args.node_unit,
+        ),
+        port=args.port,
+    )
+    logger.info("dlrover-tpu-brain serving on port %d", server.port)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

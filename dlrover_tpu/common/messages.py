@@ -468,3 +468,44 @@ class StrategySearchRequest:
 class StrategySearchResponse:
     strategy_json: str = ""
     error: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Brain service (reference: dlrover/proto/brain.proto:196-199 —
+# persist_metrics / optimize / get_job_metrics as a standalone
+# cluster-level service shared across jobs)
+# ---------------------------------------------------------------------------
+
+
+@message
+class BrainPersistMetricsRequest:
+    """One JobMetrics observation, as its asdict JSON."""
+
+    metrics_json: str = ""
+
+
+@message
+class BrainOptimizeRequest:
+    """Ask the brain for a ResourcePlan for one job's stage."""
+
+    job_name: str = ""
+    job_kind: str = ""
+    stage: str = "running"        # create | running
+    stats_json: str = "{}"
+
+
+@message
+class BrainOptimizeResponse:
+    plan_json: str = ""           # ResourcePlan asdict JSON
+    error: str = ""
+
+
+@message
+class BrainJobMetricsRequest:
+    job_name: str = ""
+
+
+@message
+class BrainJobMetricsResponse:
+    rows_json: str = "[]"         # list of JobMetrics asdict JSON
+    error: str = ""

@@ -22,6 +22,18 @@ def parse_master_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--max-workers", type=int, default=0)
     p.add_argument("--node-unit", type=int, default=1)
     p.add_argument("--job-name", default="job")
+    p.add_argument("--job-kind", default="")
+    p.add_argument(
+        "--optimize-mode",
+        default="single-job",
+        choices=["single-job", "cluster"],
+        help="cluster = resource plans from a shared dlrover-tpu-brain",
+    )
+    p.add_argument(
+        "--brain-addr",
+        default="",
+        help="host:port of the brain service (optimize-mode=cluster)",
+    )
     return p.parse_args(argv)
 
 
@@ -31,6 +43,10 @@ def run(args: argparse.Namespace) -> str:
         num_workers=args.num_workers,
         max_workers=args.max_workers or args.num_workers,
         node_unit=args.node_unit,
+        optimize_mode=args.optimize_mode,
+        brain_addr=args.brain_addr,
+        job_name=args.job_name,
+        job_kind=args.job_kind,
     )
     master.prepare()
     # print the bound address for launchers/operators to scrape

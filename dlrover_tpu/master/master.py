@@ -42,8 +42,16 @@ class JobMaster:
         node_unit: int = 1,
         scaler: Optional[Scaler] = None,
         enable_auto_scaling: Optional[bool] = None,
+        optimize_mode: str = "single-job",
+        brain_addr: str = "",
+        job_name: str = "",
+        job_kind: str = "",
     ):
         ctx = get_context()
+        self.optimize_mode = optimize_mode
+        self.brain_addr = brain_addr
+        self.job_name = job_name
+        self.job_kind = job_kind
         self.speed_monitor = SpeedMonitor()
         self.job_manager = JobManager(
             num_workers=num_workers,
@@ -105,6 +113,33 @@ class JobMaster:
 
         if enable_auto_scaling is None:
             enable_auto_scaling = max_w > num_workers
+        # optimize_mode=cluster: plans come from the shared Brain wire
+        # service instead of the local heuristic (reference:
+        # resource/brain_optimizer.py consuming go/brain over gRPC).
+        # Built only when an auto-scaler will consume it — otherwise the
+        # client would sit unused holding an open channel; closed in
+        # stop() either way via self._brain_client.
+        optimizer = None
+        self._brain_client = None
+        if optimize_mode == "cluster":
+            if not brain_addr:
+                raise ValueError(
+                    "optimize_mode='cluster' needs brain_addr "
+                    "(host:port of a dlrover-tpu-brain)"
+                )
+            if not enable_auto_scaling:
+                logger.warning(
+                    "optimize_mode='cluster' has no effect without auto "
+                    "scaling (max_workers == num_workers); brain %s "
+                    "will not be consulted",
+                    brain_addr,
+                )
+            else:
+                from dlrover_tpu.cluster.brain import BrainClient
+
+                optimizer = BrainClient(brain_addr)
+                optimizer.bind_job(job_name or "job", job_kind)
+                self._brain_client = optimizer
         self.auto_scaler: Optional[JobAutoScaler] = None
         if enable_auto_scaling:
             self.auto_scaler = JobAutoScaler(
@@ -112,6 +147,7 @@ class JobMaster:
                 self.speed_monitor,
                 self.job_manager._scaler,
                 rdzv_managers=self.rdzv_managers,
+                optimizer=optimizer,
                 min_workers=num_workers,
                 max_workers=max_w,
                 node_unit=node_unit,
@@ -267,6 +303,8 @@ class JobMaster:
         self._stop.set()
         if self.auto_scaler is not None:
             self.auto_scaler.stop()
+        if self._brain_client is not None:
+            self._brain_client.close()
         self.task_manager.stop()
         self.job_manager.stop()
         self.metrics_server.stop()

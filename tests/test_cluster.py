@@ -313,3 +313,124 @@ def test_brain_does_not_regrow_into_known_bad_size():
         "running", {"worker_num": 6, "steps_per_sec": 10.5}
     )
     assert plan.worker_num is None
+
+
+# ---------------------------------------------------------------------------
+# Brain over the wire (VERDICT r3 #4): a standalone brain process shared
+# across jobs, reached through BrainClient — the reference's go/brain
+# gRPC deployment (proto/brain.proto:196-199) + brain_optimizer.py.
+# ---------------------------------------------------------------------------
+
+
+def _brain_proc(q, store_path):
+    from dlrover_tpu.cluster.brain import (
+        BrainService,
+        BrainWireServer,
+        MetricsStore,
+    )
+
+    server = BrainWireServer(
+        BrainService(
+            store=MetricsStore(store_path), min_workers=1, max_workers=8
+        ),
+        port=0,
+    )
+    q.put(server.port)
+    import time as _t
+
+    while True:
+        _t.sleep(0.5)
+
+
+@pytest.fixture()
+def brain_process(tmp_path):
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    proc = ctx.Process(
+        target=_brain_proc, args=(q, str(tmp_path / "brain.jsonl")),
+        daemon=True,
+    )
+    proc.start()
+    port = q.get(timeout=10)
+    yield f"127.0.0.1:{port}"
+    proc.terminate()
+    proc.join(timeout=5)
+
+
+def test_brain_wire_roundtrip_separate_process(brain_process):
+    """persist_metrics / get_job_metrics / optimize against a brain
+    living in ANOTHER process: cold-start first-allocation comes back
+    from same-kind history over the wire."""
+    from dlrover_tpu.cluster.brain import BrainClient
+
+    client = BrainClient(brain_process)
+    # two finished runs of kind "deepfm": 4 workers scaled best
+    for n, sps in ((2, 100.0), (4, 360.0)):
+        assert client.persist_metrics(
+            JobMetrics(
+                job_name=f"old-{n}",
+                job_kind="deepfm",
+                worker_num=n,
+                samples_per_sec=sps,
+                finished=True,
+            )
+        )
+    rows = client.get_job_metrics("old-4")
+    assert len(rows) == 1 and rows[0].worker_num == 4
+    client.bind_job("fresh-job", "deepfm")
+    plan = client.generate_plan("create", {})
+    assert plan.worker_num == 4  # 360/4 > 100/2 per-worker
+    client.close()
+
+
+def test_brain_client_degrades_to_empty_plan_when_unreachable():
+    from dlrover_tpu.cluster.brain import BrainClient
+
+    client = BrainClient("127.0.0.1:1", timeout_s=0.5)
+    client._t._retries = 1  # keep the failure fast
+    plan = client.generate_plan("running", {"worker_num": 2})
+    assert plan.empty()
+    client.close()
+
+
+def test_master_optimize_mode_cluster_uses_brain(brain_process):
+    """optimize_mode=cluster wires the auto-scaler's optimizer to the
+    remote brain (reference: resource/brain_optimizer.py); plans flow
+    over the wire from the shared store."""
+    from dlrover_tpu.cluster.brain import BrainClient
+    from dlrover_tpu.master.master import DistributedJobMaster
+
+    # seed history through a second client (another "job"'s master)
+    seeder = BrainClient(brain_process)
+    seeder.persist_metrics(
+        JobMetrics(
+            job_name="prev",
+            job_kind="gpt",
+            worker_num=2,
+            samples_per_sec=500.0,
+            finished=True,
+        )
+    )
+    seeder.close()
+    master = DistributedJobMaster(
+        num_workers=1,
+        max_workers=4,
+        optimize_mode="cluster",
+        brain_addr=brain_process,
+        job_name="this-job",
+        job_kind="gpt",
+    )
+    try:
+        assert isinstance(master.auto_scaler.optimizer, BrainClient)
+        plan = master.auto_scaler.optimizer.generate_plan("create", {})
+        assert plan.worker_num == 2
+    finally:
+        master.server.stop()
+        master.metrics_server.stop()
+
+    with pytest.raises(ValueError, match="brain_addr"):
+        DistributedJobMaster(
+            num_workers=1, max_workers=4, optimize_mode="cluster"
+        )
