@@ -197,8 +197,11 @@ class _Server(socketserver.ThreadingTCPServer):
 
 def _default_token() -> str:
     # every host of a run shares RUN_ID, so it doubles as a wire token
-    # keeping strays (other runs, port scanners) out of the store
-    return os.environ.get("DLROVER_TPU_RUN_ID", "")
+    # keeping strays (other runs, port scanners) out of the store (the
+    # shared helper grew out of this: common/sockets.default_token)
+    from dlrover_tpu.common.sockets import default_token
+
+    return default_token()
 
 
 @dataclass

@@ -5,10 +5,73 @@ the codebase (checkpoint/replica.py ring backup, data/coworker.py batch
 ingress, sparse/server.py KV serving) — recv_into over a memoryview in
 bounded chunks, with an explicit cap so a desynced or hostile peer
 cannot make us allocate an attacker-chosen buffer.
+
+Plus the shared connection-auth preamble (lifted from the replica
+ring's token handshake, VERDICT r3 #5): every data plane that carries
+model or training data authenticates at connect time with the run's
+shared token before a single protocol frame is parsed. The preamble is
+ALWAYS sent and always read — auth on/off only changes whether the
+token is compared — so a client and server that disagree about whether
+auth is enabled fail cleanly at the handshake instead of desyncing the
+protocol stream. Default credential: ``DLROVER_TPU_WIRE_TOKEN`` (the
+job-wide secret, for deployments that scope run ids per node), falling
+back to ``DLROVER_TPU_RUN_ID`` — every host of a run shares it, so it
+doubles as the wire credential keeping strays (other runs, port
+scanners) out without extra key plumbing.
 """
 
+import hmac
+import os
 import socket
 from typing import Optional
+
+# Starts with NUL so a mis-configured peer (token on one side only)
+# can never alias a legitimate op byte in any of the framed protocols.
+_AUTH_MAGIC = b"\x00DTPAUTH"
+_MAX_TOKEN = 4096
+
+
+def default_token() -> str:
+    """The run-shared wire token (empty = token comparison disabled)."""
+    return os.environ.get("DLROVER_TPU_WIRE_TOKEN") or os.environ.get(
+        "DLROVER_TPU_RUN_ID", ""
+    )
+
+
+def send_auth(sock: socket.socket, token: Optional[str]) -> None:
+    """Client side: send the auth preamble (always — an empty token
+    still sends magic + length 0, keeping the stream framing identical
+    whether or not auth is enforced)."""
+    raw = (token or "").encode("utf-8")
+    sock.sendall(
+        _AUTH_MAGIC + len(raw).to_bytes(4, "little") + raw
+    )
+
+
+def check_auth(sock: socket.socket, token: Optional[str]) -> bool:
+    """Server side: verify the preamble BEFORE parsing any frame.
+
+    The magic is required unconditionally (a stray client that never
+    sent the preamble is rejected even with auth disabled); the token
+    itself is compared only when the server has one. On False the
+    caller must close the connection without answering — no protocol
+    bytes reach an unauthenticated peer."""
+    try:
+        magic = bytes(recv_exact(sock, len(_AUTH_MAGIC)))
+        if magic != _AUTH_MAGIC:
+            return False
+        n = int.from_bytes(bytes(recv_exact(sock, 4)), "little")
+        if not 0 <= n <= _MAX_TOKEN:
+            return False
+        got = bytes(recv_exact(sock, n)) if n else b""
+    except (ConnectionError, OSError):
+        return False
+    if not token:
+        return True
+    # compare BYTES: compare_digest on str raises TypeError for
+    # non-ASCII, which would escape this function on attacker-chosen
+    # input (and break legitimate non-ASCII tokens)
+    return hmac.compare_digest(got, token.encode("utf-8"))
 
 _CHUNK = 1 << 20
 

@@ -299,13 +299,24 @@ class BatchFeedServer:
         host: str = "0.0.0.0",
         port: int = 0,
         put_timeout: float = 600.0,
+        token=None,
     ):
+        from dlrover_tpu.common.sockets import default_token
+
         self.ring = ring
         self.put_timeout = put_timeout
+        # this plane ACCEPTS TRAINING DATA: an unauthenticated producer
+        # could poison the batch stream — require the run token at
+        # connect (common/sockets.py preamble; None = run-id default)
+        self._token = default_token() if token is None else token
         outer = self
 
         class Handler(_socketserver.BaseRequestHandler):
             def handle(self):
+                from dlrover_tpu.common.sockets import check_auth
+
+                if not check_auth(self.request, outer._token):
+                    return  # close without answering; never mark_done
                 saw_put = False
                 while True:
                     try:
@@ -376,12 +387,17 @@ class RemoteBatchWriter:
     One TCP connection, strict put→ack credit: the writer cannot run
     ahead of the consumer's ring (its ack IS the free-slot claim)."""
 
-    def __init__(self, addr, timeout: float = 900.0):
+    def __init__(self, addr, timeout: float = 900.0, token=None):
+        from dlrover_tpu.common.sockets import default_token, send_auth
+
         # must exceed the server's ring-slot wait (put_timeout=600):
         # if the writer gave up first, the server's eventual ack would
         # desync the put/ack credit protocol
         self._sock = _socket.create_connection(addr, timeout=timeout)
         self._sock.settimeout(timeout)
+        send_auth(
+            self._sock, default_token() if token is None else token
+        )
 
     def put(self, batch: Dict[str, np.ndarray]):
         self.put_bytes(_pack(batch))

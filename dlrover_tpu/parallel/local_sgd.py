@@ -236,19 +236,23 @@ class SocketTransport:
         peers: Dict[int, str],
         port: int = 0,
         bind_host: str = "0.0.0.0",
-        token: str = "",
+        token: Optional[str] = None,
         timeout: float = 600.0,
     ):
         import socketserver
         import threading
 
         from dlrover_tpu.checkpoint import replica as wire
+        from dlrover_tpu.common.sockets import default_token
 
         self.rank = rank
         self.peers = dict(peers)
         self._validate_peers()
         self.timeout = timeout
-        self.token = token
+        # this plane exchanges GRADIENT DELTAS between slices: the run
+        # token is on by default (None = DLROVER_TPU_RUN_ID), not just
+        # peer-identity fields; pass "" to explicitly disable
+        self.token = default_token() if token is None else token
         self._wire = wire
         self._inbox: Dict[int, Dict[int, bytes]] = {}
         self._lock = threading.Lock()

@@ -81,7 +81,14 @@ class KvServer:
         optimizer: Optional[SparseOptimizer] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        token: Optional[str] = None,
     ):
+        from dlrover_tpu.common.sockets import default_token
+
+        # this plane carries MODEL WEIGHTS (embedding rows): connections
+        # must present the run token before any frame is parsed
+        # (common/sockets.py auth preamble; None = run-id default)
+        self._token = default_token() if token is None else token
         self.optimizer = optimizer or GroupAdam(lr=1e-3)
         n_slots = self.optimizer.required_slots
         self.tables: Dict[str, KvTable] = {
@@ -101,6 +108,10 @@ class KvServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                from dlrover_tpu.common.sockets import check_auth
+
+                if not check_auth(self.request, outer._token):
+                    return  # close without answering
                 while True:
                     try:
                         op, ctrl, payload = _recv(self.request)
@@ -190,10 +201,17 @@ class KvServer:
 class KvClient:
     """One connection to one KvServer."""
 
-    def __init__(self, addr, timeout: float = 60.0):
+    def __init__(
+        self, addr, timeout: float = 60.0, token: Optional[str] = None
+    ):
+        from dlrover_tpu.common.sockets import default_token, send_auth
+
         self.addr = tuple(addr)
         self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._sock.settimeout(timeout)
+        send_auth(
+            self._sock, default_token() if token is None else token
+        )
         self._lock = threading.Lock()
 
     def _call(self, op, ctrl, payload=b""):
