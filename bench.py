@@ -77,6 +77,13 @@ _ATTEMPTS = [
 _BASELINE_SEQ_COMPANION = _ATTEMPTS[1][:4]
 assert _BASELINE_SEQ_COMPANION[2] == 4096
 
+# the gpt2-family fallback stays MEASURED even when the flagship wins
+# (BASELINE.md #8 is judged per shape family; without this the gpt2
+# series would only appear in rounds where the flagship fails) —
+# embedded as record["fallback"] when budget allows
+_GPT2_FALLBACK = _ATTEMPTS[3][:4]
+assert _GPT2_FALLBACK[0].startswith("gpt2")
+
 
 def check_kernels(b=2, s=1024, h=16, d=128) -> bool:
     """On-chip numerics gate for BOTH hand-written gradients in the hot
@@ -432,6 +439,33 @@ def main():
                             record["vs_baseline_at_seq4096"] = comp[
                                 "vs_baseline"
                             ]
+                # keep the gpt2 series measured when the llama family
+                # wins: one fallback-family run rides along so both
+                # shape families carry numbers every round
+                if not name.startswith("gpt2") and name != "tiny":
+                    remaining = _DEADLINE_S - (time.monotonic() - t0)
+                    if remaining >= 130:
+                        fn, fb_b, fb_s, fb_r = _GPT2_FALLBACK
+                        fb = _run_aux_json(
+                            [
+                                "--single", fn, str(fb_b), str(fb_s),
+                                fb_r,
+                            ],
+                            int(min(220, remaining)),
+                        )
+                        if fb.get("value"):
+                            record["fallback"] = {
+                                "metric": fb["metric"],
+                                "value": fb["value"],
+                                "vs_baseline": fb["vs_baseline"],
+                                "mxu_ceiling_frac": record.get(
+                                    "mxu_ceiling_frac_gpt2_shapes"
+                                ),
+                            }
+                    else:
+                        sys.stderr.write(
+                            "gpt2 fallback skipped: budget exhausted\n"
+                        )
                 print(json.dumps(record))
                 return
             sys.stderr.write(
