@@ -1,0 +1,136 @@
+"""Full-stack drill worker: DeepFM over a KvServer ring, fed over TCP.
+
+The production composition the fault-tolerance story is about
+(reference: docs/tech_report/fault_tolerance_exps.md — elastic worker
+pool + elastic PS tier + data pipeline in ONE job): this worker
+
+- serves a ``BatchFeedServer`` ingress (remote coworker producers push
+  packed CTR batches into the host's shm ring; the port is printed for
+  the producer pool to discover),
+- trains a DeepFM whose sparse tier lives on a KvServer ring
+  (``DistributedEmbedding``; addresses from ``--kv-addrs``),
+- reports global steps to the job master when launched under the
+  elastic agent (``DLROVER_TPU_MASTER_ADDR``),
+- and self-heals a sparse-server death: on a wire error it probes the
+  ring, adopts the survivors with ``migrate=False`` (availability over
+  durability — lost rows re-initialize on touch) and keeps stepping.
+
+Run by ``tests/test_fullstack_drill.py`` under a real master + two
+launcher/agent process groups, with the test killing an agent AND a
+sparse server mid-run.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from dlrover_tpu.data.coworker import BatchFeedServer, BatchRing
+from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+from dlrover_tpu.sparse import GroupAdam
+from dlrover_tpu.sparse.embedding import EmbeddingSpec
+from dlrover_tpu.sparse.server import DistributedEmbedding, KvClient
+
+
+def _specs(emb_dim):
+    return [
+        EmbeddingSpec("emb", emb_dim, initializer="normal",
+                      init_scale=0.01, seed=3),
+        EmbeddingSpec("wide", 1, initializer="zeros"),
+    ]
+
+
+def _probe_survivors(servers, timeout=3.0):
+    alive = {}
+    for name, addr in servers.items():
+        try:
+            c = KvClient(tuple(addr), timeout=timeout)
+            c.stats()
+            c.close()
+            alive[name] = tuple(addr)
+        except Exception:  # noqa: BLE001 — dead/unreachable server
+            continue
+    return alive
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--kv-addrs", required=True,
+                   help='JSON {"s0": ["127.0.0.1", port], ...}')
+    p.add_argument("--emb-dim", type=int, default=8)
+    p.add_argument("--fields", type=int, default=6)
+    p.add_argument("--dense", type=int, default=4)
+    args = p.parse_args()
+
+    servers = {
+        k: tuple(v) for k, v in json.loads(args.kv_addrs).items()
+    }
+    cfg = DeepFMConfig(
+        n_fields=args.fields, n_dense=args.dense,
+        emb_dim=args.emb_dim, mlp_dims=(32,),
+    )
+    model = DeepFM(cfg, optimizer=GroupAdam(lr=5e-3), dense_lr=5e-3)
+    model.coll.close()
+    demb = DistributedEmbedding(_specs(cfg.emb_dim), servers)
+    model.coll = demb
+
+    ring = BatchRing("drill", slots=4, slot_bytes=1 << 20, create=True)
+    feed = BatchFeedServer(ring, host="127.0.0.1")
+    # the producer pool (the test) scrapes this line for the ingress port
+    print(f"[fullstack] feed port {feed.address[1]}", flush=True)
+
+    master = None
+    try:
+        import os
+
+        addr = os.environ.get("DLROVER_TPU_MASTER_ADDR")
+        if addr:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            master = MasterClient(addr)
+    except Exception:  # noqa: BLE001 — drill runs standalone too
+        master = None
+
+    step = 0
+    while step < args.steps:
+        batch = ring.get(timeout=120.0)
+        if batch is None:
+            print("[fullstack] producers done early", flush=True)
+            break
+        try:
+            loss = model.train_step(
+                batch["cat"].astype(np.int64),
+                batch["dense"].astype(np.float32),
+                batch["labels"].astype(np.float32),
+            )
+        except Exception as e:  # noqa: BLE001 — sparse-tier wire error
+            survivors = _probe_survivors(servers)
+            if not survivors:
+                print(f"[fullstack] sparse ring gone: {e}", flush=True)
+                raise
+            servers = survivors
+            demb.set_servers(survivors, migrate=False)
+            print(
+                f"[fullstack] sparse failover to {sorted(survivors)}",
+                flush=True,
+            )
+            continue
+        step += 1
+        print(f"[fullstack] step {step} loss {loss:.4f}", flush=True)
+        if master is not None and step % 5 == 0:
+            try:
+                master.report_global_step(step)
+            except Exception:  # noqa: BLE001
+                master = None
+    print("[fullstack] done", flush=True)
+    feed.stop()
+    ring.close()
+    demb.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
