@@ -45,6 +45,8 @@ class JobAutoScaler:
         # (fn(target_num) — the sparse tier's analog of SliceScaler)
         self.ps_service = ps_service
         self.ps_scale_fn = ps_scale_fn
+        self.min_workers = min_workers
+        self.max_workers = max_workers
         self.optimizer = optimizer or LocalHeuristicOptimizer(
             min_workers=min_workers,
             max_workers=max_workers,
@@ -119,6 +121,21 @@ class JobAutoScaler:
         target = plan.worker_num
         if target is None:
             return
+        # clamp to the JOB's declared elastic range: a cluster-shared
+        # optimizer (BrainClient) was not constructed with this job's
+        # min/max the way the local heuristic is, and its plan must not
+        # scale past what the job asked for
+        clamped = max(self.min_workers, min(self.max_workers, target))
+        if clamped != target:
+            logger.info(
+                "auto-scale: plan wants %d workers, clamped to the "
+                "job's [%d, %d] range → %d",
+                target,
+                self.min_workers,
+                self.max_workers,
+                clamped,
+            )
+            target = clamped
         logger.info(
             "auto-scale: %d → %d workers", self.job_manager.worker_num, target
         )

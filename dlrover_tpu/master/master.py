@@ -47,6 +47,15 @@ class JobMaster:
         job_name: str = "",
         job_kind: str = "",
     ):
+        # validate BEFORE any server construction: raising after the
+        # transport bound its port would leak the socket + thread pool
+        # on the error path (repo convention: a constructed-but-never-
+        # run master must not hold a port)
+        if optimize_mode == "cluster" and not brain_addr:
+            raise ValueError(
+                "optimize_mode='cluster' needs brain_addr "
+                "(host:port of a dlrover-tpu-brain)"
+            )
         ctx = get_context()
         self.optimize_mode = optimize_mode
         self.brain_addr = brain_addr
@@ -122,11 +131,6 @@ class JobMaster:
         optimizer = None
         self._brain_client = None
         if optimize_mode == "cluster":
-            if not brain_addr:
-                raise ValueError(
-                    "optimize_mode='cluster' needs brain_addr "
-                    "(host:port of a dlrover-tpu-brain)"
-                )
             if not enable_auto_scaling:
                 logger.warning(
                     "optimize_mode='cluster' has no effect without auto "
