@@ -43,6 +43,10 @@ class ReplicaSpec:
     cpu: str = "8"
     memory: str = "32Gi"
     env: Dict[str, str] = field(default_factory=dict)
+    # env var -> (secret name, key): rendered as valueFrom.secretKeyRef
+    # so credentials (the wire token) never appear as plaintext in pod
+    # specs readable by anyone with pods/get
+    secret_env: Dict[str, Any] = field(default_factory=dict)
     slice: TPUSliceSpec = field(default_factory=TPUSliceSpec)
 
 
@@ -105,17 +109,27 @@ class ElasticJob:
             cont = (tpl.get("containers") or [{}])[0]
             sel = tpl.get("nodeSelector", {}) or {}
             req = (cont.get("resources") or {}).get("requests", {}) or {}
+            env: Dict[str, str] = {}
+            secret_env: Dict[str, Any] = {}
+            for e in cont.get("env") or []:
+                if "name" not in e:
+                    continue
+                ref = (e.get("valueFrom") or {}).get("secretKeyRef")
+                if ref:
+                    secret_env[e["name"]] = (
+                        ref.get("name", ""),
+                        ref.get("key", ""),
+                    )
+                else:
+                    env[e["name"]] = e.get("value", "")
             replica_specs[role] = ReplicaSpec(
                 replicas=int(rs.get("replicas", 1)),
                 image=cont.get("image", "dlrover-tpu:latest"),
                 command=list(cont.get("command") or []),
                 cpu=str(req.get("cpu", "8")),
                 memory=str(req.get("memory", "32Gi")),
-                env={
-                    e["name"]: e.get("value", "")
-                    for e in (cont.get("env") or [])
-                    if "name" in e
-                },
+                env=env,
+                secret_env=secret_env,
                 slice=TPUSliceSpec(
                     accelerator=sel.get(
                         "cloud.google.com/gke-tpu-accelerator",
@@ -205,6 +219,18 @@ def pod_template(
                     "command": list(rs.command),
                     "env": [
                         {"name": k, "value": v} for k, v in rs.env.items()
+                    ]
+                    + [
+                        {
+                            "name": k,
+                            "valueFrom": {
+                                "secretKeyRef": {
+                                    "name": ref[0],
+                                    "key": ref[1],
+                                }
+                            },
+                        }
+                        for k, ref in rs.secret_env.items()
                     ],
                     "resources": {
                         "requests": {

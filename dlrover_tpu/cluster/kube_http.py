@@ -48,6 +48,7 @@ _BUILTIN_PATHS: Dict[str, Tuple[str, str]] = {
     "Pod": ("/api/v1", "pods"),
     "Service": ("/api/v1", "services"),
     "ConfigMap": ("/api/v1", "configmaps"),
+    "Secret": ("/api/v1", "secrets"),
     "ElasticJob": ("/apis/elastic.iml.github.io/v1alpha1", "elasticjobs"),
     "ScalePlan": ("/apis/elastic.iml.github.io/v1alpha1", "scaleplans"),
 }

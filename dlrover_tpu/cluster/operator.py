@@ -84,6 +84,10 @@ def master_pod_manifest(
             command=command,
             cpu="2",
             memory="4Gi",
+            # the worker env carries the run id + the wire-token
+            # secretKeyRef; the master joins the same auth'd planes
+            env=dict(worker.env),
+            secret_env=dict(worker.secret_env),
         )
     tpl = pod_template(job.name, "master", rs)
     # the master is a CPU pod: no TPU request, no slice pinning
@@ -318,6 +322,20 @@ class OperatorController:
         if not name or name in self._recs:
             return  # per-job MODIFIED handling lives in its reconciler
         job = ElasticJob.from_manifest(obj)
+        # the job-wide wire credential (common/sockets.py auth): minted
+        # once into a per-job Secret so every pod of the job — across
+        # operator restarts and leader failovers — authenticates the
+        # checkpoint-replica / KvServer / coworker-feed planes with the
+        # SAME token. Injected as a secretKeyRef (NOT a plaintext env
+        # value — pods/get is granted far more broadly than
+        # secrets/get, and a literal value in the pod spec would
+        # defeat the Secret).
+        secret_name = self._ensure_wire_token(job)
+        for rs in job.spec.replica_specs.values():
+            rs.secret_env.setdefault(
+                "DLROVER_TPU_WIRE_TOKEN", (secret_name, "token")
+            )
+            rs.env.setdefault("DLROVER_TPU_RUN_ID", job.name)
         addr = self._ensure_master(job)
         rec = JobReconciler(self._api, job, master_addr=addr)
         rec.start()
@@ -326,6 +344,40 @@ class OperatorController:
         rec._reconcile(WatchEvent("MODIFIED", obj))
         self._recs[name] = rec
         logger.info("operator: reconciling ElasticJob %s", name)
+
+    def _ensure_wire_token(self, job: ElasticJob) -> str:
+        """Get-or-create the job's wire-token Secret; returns its NAME
+        (pods reference it via secretKeyRef — the operator never needs
+        the value back).
+
+        Stability matters: a leader failover that minted a fresh token
+        would partition new pods from old ones mid-job, so an existing
+        Secret always wins. Only an AlreadyExists create race falls
+        back to the re-read; any other failure (RBAC forbidden, API
+        down) propagates with its real error."""
+        from dlrover_tpu.cluster.scaler import _is_already_exists
+
+        name = f"{job.name}-wire-token"
+        if self._api.get("Secret", name, job.namespace) is not None:
+            return name
+        try:
+            self._api.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "metadata": {
+                        "name": name,
+                        "namespace": job.namespace,
+                        "labels": {JOB_LABEL: job.name},
+                    },
+                    "type": "Opaque",
+                    "stringData": {"token": uuid.uuid4().hex},
+                }
+            )
+        except Exception as e:  # noqa: BLE001
+            if not _is_already_exists(e):
+                raise  # surface the REAL error (403, timeout, ...)
+        return name
 
     def _ensure_master(self, job: ElasticJob) -> str:
         name = f"{job.name}-master"
@@ -351,7 +403,80 @@ class OperatorController:
         ):
             self._api.delete("Pod", pod["metadata"]["name"], self._ns)
         self._api.delete("Service", f"{name}-master", self._ns)
+        self._api.delete("Secret", f"{name}-wire-token", self._ns)
         logger.info("operator: ElasticJob %s deleted; tore down", name)
+
+
+class OperatorHealthServer:
+    """``/healthz`` + ``/readyz`` for the Deployment's probes
+    (reference: the Go manager's health-probe bind, main.go
+    ``HealthProbeBindAddress``). BOTH answer 200 while the process
+    serves — readiness deliberately does NOT require leadership: a
+    standby that reported 503 would deadlock rolling updates (the
+    surge pod can never go Ready while the old leader renews the
+    lease), which is why the Go manager serves readyz independent of
+    election too. Body: JSON {leading, jobs} for operators/debugging.
+    """
+
+    def __init__(
+        self,
+        controller: OperatorController,
+        is_leading: Callable[[], bool],
+        port: int = 8081,
+    ):
+        self._controller = controller
+        self._is_leading = is_leading
+        self._requested_port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self.port = 0
+
+    def start(self):
+        import http.server
+        import json
+
+        controller = self._controller
+        is_leading = self._is_leading
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                leading = bool(is_leading())
+                if self.path.startswith(("/healthz", "/readyz")):
+                    code = 200
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(
+                    {"leading": leading, "jobs": controller.jobs()}
+                ).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence request logging
+                pass
+
+        import socketserver
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._httpd = Server(("0.0.0.0", self._requested_port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
 
 
 def parse_operator_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
@@ -383,6 +508,12 @@ def parse_operator_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         action="store_true",
         help="run without the lease (single-replica deployments)",
     )
+    p.add_argument(
+        "--health-port",
+        type=int,
+        default=8081,
+        help="/healthz + /readyz bind port (0 = ephemeral, -1 = off)",
+    )
     return p.parse_args(argv)
 
 
@@ -408,17 +539,37 @@ def run_operator(
         master_port=args.master_port,
         brain_addr=args.brain_addr,
     )
-    if args.no_leader_elect:
-        controller.start()
-        try:
-            stop.wait()
-        finally:
+    leading = {"v": args.no_leader_elect}
+    health = None
+    if args.health_port >= 0:
+        health = OperatorHealthServer(
+            controller, lambda: leading["v"], port=args.health_port
+        )
+        health.start()
+    try:
+        if args.no_leader_elect:
+            controller.start()
+            try:
+                stop.wait()
+            finally:
+                controller.stop()
+            return
+        elector = LeaderElector(
+            api, namespace=args.namespace, ttl_s=args.lease_ttl
+        )
+
+        def _up():
+            leading["v"] = True
+            controller.start()
+
+        def _down():
+            leading["v"] = False
             controller.stop()
-        return
-    elector = LeaderElector(
-        api, namespace=args.namespace, ttl_s=args.lease_ttl
-    )
-    elector.run(stop, controller.start, controller.stop)
+
+        elector.run(stop, _up, _down)
+    finally:
+        if health is not None:
+            health.stop()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -27,6 +27,14 @@ from dlrover_tpu.master.node_manager import ScalePlan, Scaler
 logger = get_logger(__name__)
 
 
+def _is_already_exists(exc: Exception) -> bool:
+    """409/AlreadyExists from any KubeApi flavor (HTTPError carries the
+    code; the in-process fake raises ValueError with the message)."""
+    if getattr(exc, "code", None) == 409:
+        return True
+    return "already exists" in str(exc).lower()
+
+
 def snap_to_slices(hosts: int, hosts_per_slice: int, minimum: int = 0) -> int:
     """Round a host count UP to whole slices (≥ minimum)."""
     if hosts_per_slice <= 1:
@@ -136,9 +144,22 @@ class SliceScaler(Scaler):
             master_addr=self.master_addr,
             attempt=attempt,
         )
-        self.submit_fn(manifest)
+        try:
+            self.submit_fn(manifest)
+            logger.info("created pod %s", manifest["metadata"]["name"])
+        except Exception as e:  # noqa: BLE001
+            # AlreadyExists is ADOPTION, not failure: a reconciler
+            # restarted (or a failed-over operator leader) re-asserts
+            # desired state over pods its predecessor created — the
+            # manifest is deterministic per index, so the live pod IS
+            # the one we wanted (reference: controller-runtime's
+            # CreateOrUpdate idempotency)
+            if not _is_already_exists(e):
+                raise
+            logger.info(
+                "adopted existing pod %s", manifest["metadata"]["name"]
+            )
         self._pods[idx] = manifest["metadata"]["name"]
-        logger.info("created pod %s", self._pods[idx])
 
     def _remove_host(self, idx: int):
         name = self._pods.pop(idx, None)

@@ -40,6 +40,7 @@ _PLURALS = {
     "pods": "Pod",
     "services": "Service",
     "configmaps": "ConfigMap",
+    "secrets": "Secret",
     "elasticjobs": "ElasticJob",
     "scaleplans": "ScalePlan",
 }

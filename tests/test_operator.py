@@ -136,7 +136,7 @@ def test_operator_controller_fans_out_reconcilers():
         assert api.get("Pod", "j1-master") is not None
         assert api.get("Service", "j1-master") is not None
         env = {
-            e["name"]: e["value"]
+            e["name"]: e.get("value", "")
             for e in api.get("Pod", "j1-worker-0")["spec"]["containers"][0][
                 "env"
             ]
@@ -199,6 +199,51 @@ def test_master_command_carries_cluster_optimize_mode():
     assert "--optimize-mode" not in pod2["spec"]["containers"][0]["command"]
 
 
+def test_wire_token_minted_once_and_injected_into_pods():
+    """Every pod of a job (workers AND master) references the SAME
+    per-job wire-token Secret via secretKeyRef — never a plaintext env
+    value (pods/get is granted far more broadly than secrets/get) —
+    and the Secret survives operator restarts/leader failovers (a
+    fresh token would partition new pods from old ones mid-job);
+    teardown removes it."""
+
+    def pod_env(api, name):
+        return {
+            e["name"]: e
+            for e in api.get("Pod", name)["spec"]["containers"][0]["env"]
+        }
+
+    api = FakeKubeApi()
+    ctl = OperatorController(api)
+    api.create(_job("tok", replicas=2).to_manifest())
+    ctl._adopt_current()
+    _wait(lambda: api.get("Pod", "tok-worker-1") is not None, msg="pods")
+    secret = api.get("Secret", "tok-wire-token")
+    assert secret is not None
+    token = secret["stringData"]["token"]
+    assert len(token) >= 32
+    for pod in ("tok-worker-0", "tok-worker-1", "tok-master"):
+        env = pod_env(api, pod)
+        ref = env["DLROVER_TPU_WIRE_TOKEN"]["valueFrom"]["secretKeyRef"]
+        assert ref == {"name": "tok-wire-token", "key": "token"}, pod
+        assert "value" not in env["DLROVER_TPU_WIRE_TOKEN"], (
+            "token must never be a plaintext env value"
+        )
+        assert env["DLROVER_TPU_RUN_ID"]["value"] == "tok"
+    ctl.stop()
+
+    # a NEW controller (restart / failover) adopting the same job
+    # reuses the minted Secret rather than partitioning the job
+    ctl2 = OperatorController(api)
+    ctl2._adopt_current()
+    assert api.get("Secret", "tok-wire-token")["stringData"][
+        "token"
+    ] == token
+    ctl2._teardown("tok")
+    assert api.get("Secret", "tok-wire-token") is None
+    ctl2.stop()
+
+
 def test_leader_elector_acquire_renew_steal():
     api = FakeKubeApi()
     a = LeaderElector(api, identity="op-a", ttl_s=0.4)
@@ -209,6 +254,58 @@ def test_leader_elector_acquire_renew_steal():
     time.sleep(0.6)                 # let it go stale
     assert b.try_acquire()          # steal expired lease
     assert not a.try_acquire()      # a sees b's live lease
+
+
+def test_health_endpoints_report_but_do_not_gate_on_leadership():
+    """Both probes answer 200 while serving — readiness deliberately
+    does NOT require leadership (a 503-ing standby would deadlock the
+    2-replica Deployment's rolling updates: the surge pod can never go
+    Ready while the old leader renews). The JSON body carries
+    {leading} for humans."""
+    import json
+    import urllib.request
+
+    from dlrover_tpu.cluster.operator import OperatorHealthServer
+
+    api = FakeKubeApi()
+    ctl = OperatorController(api)
+    state = {"leading": False}
+    health = OperatorHealthServer(
+        ctl, lambda: state["leading"], port=0
+    )
+    health.start()
+    try:
+        base = f"http://127.0.0.1:{health.port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["leading"] is False
+        with urllib.request.urlopen(f"{base}/readyz", timeout=5) as r:
+            assert r.status == 200  # standby is still Ready
+            assert json.loads(r.read())["leading"] is False
+        state["leading"] = True
+        with urllib.request.urlopen(f"{base}/readyz", timeout=5) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["leading"] is True
+    finally:
+        health.stop()
+        ctl.stop()
+
+
+def test_deployment_probes_match_health_server():
+    """The Deployment's probe paths/port must match what the operator
+    serves (a renamed flag or path would pass YAML validation and fail
+    only in the cluster)."""
+    dep = next(
+        d for d in _docs("operator.yaml") if d["kind"] == "Deployment"
+    )
+    cont = dep["spec"]["template"]["spec"]["containers"][0]
+    cmd = cont["command"]
+    assert "--health-port" in cmd
+    port = int(cmd[cmd.index("--health-port") + 1])
+    named = {p["name"]: p["containerPort"] for p in cont["ports"]}
+    assert named["health"] == port
+    assert cont["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert cont["readinessProbe"]["httpGet"]["path"] == "/readyz"
 
 
 def test_operator_entrypoint_main_loop_over_http():
@@ -232,7 +329,7 @@ def test_operator_entrypoint_main_loop_over_http():
     try:
         args = parse_operator_args(
             ["--kube-url", url, "--token", "test-token",
-             "--lease-ttl", "2"]
+             "--lease-ttl", "2", "--health-port", "0"]
         )
         stop = threading.Event()
         op = threading.Thread(
