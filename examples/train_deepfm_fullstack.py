@@ -79,7 +79,10 @@ def main():
 
     ring = BatchRing("drill", slots=4, slot_bytes=1 << 20, create=True)
     feed = BatchFeedServer(ring, host="127.0.0.1")
-    # the producer pool (the test) scrapes this line for the ingress port
+    # the producer pool (the test) scrapes this line for the ingress
+    # port; printed twice because the merged worker pipe can interleave
+    # one copy with logger output mid-line
+    print(f"[fullstack] feed port {feed.address[1]}", flush=True)
     print(f"[fullstack] feed port {feed.address[1]}", flush=True)
 
     master = None

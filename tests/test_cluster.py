@@ -345,18 +345,28 @@ def _brain_proc(q, store_path):
 @pytest.fixture()
 def brain_process(tmp_path):
     import multiprocessing as mp
+    import os
+    import signal
 
-    ctx = mp.get_context("fork")
+    # spawn, NOT fork: a fork child inherits pytest's signal handlers
+    # (its SIGTERM handler swallows terminate()), leaving an immortal
+    # child that multiprocessing's atexit join then waits on FOREVER —
+    # the suite hangs at shutdown. A spawned interpreter has default
+    # handlers and dies on terminate like it should.
+    ctx = mp.get_context("spawn")
     q = ctx.Queue()
     proc = ctx.Process(
         target=_brain_proc, args=(q, str(tmp_path / "brain.jsonl")),
         daemon=True,
     )
     proc.start()
-    port = q.get(timeout=10)
+    port = q.get(timeout=30)
     yield f"127.0.0.1:{port}"
     proc.terminate()
     proc.join(timeout=5)
+    if proc.is_alive():  # belt and braces: never leave it joinable
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.join(timeout=5)
 
 
 def test_brain_wire_roundtrip_separate_process(brain_process):

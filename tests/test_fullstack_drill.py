@@ -153,18 +153,22 @@ def test_fullstack_elasticity_drill(monkeypatch):
             "dense": batch_data[1],
             "labels": batch_data[2],
         }
+        # the port line can interleave with worker logger output on the
+        # merged pipe: match the digits explicitly (the script prints
+        # the line twice so one clean copy always exists)
+        port_re = re.compile(r"\[fullstack\] feed port (\d+)\b")
         for i in (0, 1):
             line = _collect(
                 queues[i],
                 logs[i],
-                until=lambda l: "[fullstack] feed port" in l,
+                until=lambda l: bool(port_re.search(l)),
                 deadline=time.time() + 120,
             )
             assert line, (
                 f"worker {i} never served its feed port:\n"
                 + "".join(logs[i][-40:])
             )
-            port = int(line.rsplit(" ", 1)[1])
+            port = int(port_re.search(line).group(1))
             prod = _Producer(port, batch)
             prod.start()
             producers.append(prod)
