@@ -117,6 +117,18 @@ class KubeApi:
     ) -> Iterator[WatchEvent]:
         raise NotImplementedError
 
+    def update_status(
+        self,
+        kind: str,
+        name: str,
+        status: Dict,
+        namespace: str = "default",
+    ) -> Optional[Dict]:
+        """Write ONLY the status subresource (a main-resource PUT is
+        ignored for .status once the CRD enables the subresource, and
+        a whole-object write could clobber a concurrent spec change)."""
+        raise NotImplementedError
+
 
 def _match_labels(obj: Dict, selector: Optional[Dict[str, str]]) -> bool:
     if not selector:
@@ -178,9 +190,33 @@ class FakeKubeApi(KubeApi):
             key = self._key(manifest)
             if key not in self._objects:
                 raise KeyError(f"{key[0]} {key[2]} not found")
+            # subresource semantics like a real server with the status
+            # subresource enabled: a main-resource PUT cannot change
+            # .status (the stored status, if any, is preserved) except
+            # for the kubelet-standin Pod phases the tests drive
+            if manifest.get("kind") != "Pod":
+                old_status = self._objects[key].get("status")
+                manifest.pop("status", None)
+                if old_status is not None:
+                    manifest["status"] = old_status
             self._objects[key] = manifest
             self._emit("MODIFIED", manifest)
         return copy.deepcopy(manifest)
+
+    def update_status(
+        self,
+        kind: str,
+        name: str,
+        status: Dict,
+        namespace: str = "default",
+    ) -> Optional[Dict]:
+        with self._cond:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                return None
+            obj["status"] = copy.deepcopy(status)
+            self._emit("MODIFIED", obj)
+            return copy.deepcopy(obj)
 
     def delete(self, kind: str, name: str, namespace: str = "default"):
         with self._cond:

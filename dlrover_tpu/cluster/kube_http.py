@@ -174,6 +174,25 @@ class RealKubeApi(KubeApi):
             body=manifest,
         )
 
+    def update_status(
+        self,
+        kind: str,
+        name: str,
+        status: Dict,
+        namespace: str = "default",
+    ) -> Optional[Dict]:
+        """PUT to the /status subresource path (the only write the API
+        server persists .status from once the CRD enables it)."""
+        obj = self.get(kind, name, namespace)
+        if obj is None:
+            return None
+        obj["status"] = status
+        return self._request(
+            "PUT",
+            self._path(kind, namespace, name) + "/status",
+            body=obj,
+        )
+
     def delete(self, kind: str, name: str, namespace: str = "default"):
         try:
             self._request("DELETE", self._path(kind, namespace, name))

@@ -245,14 +245,17 @@ class OperatorController:
         namespace: str = "default",
         master_port: int = MASTER_PORT,
         brain_addr: str = "",
+        status_interval_s: float = 5.0,
     ):
         self._api = api
         self._ns = namespace
         self._port = master_port
         self._brain_addr = brain_addr
+        self._status_interval_s = status_interval_s
         self._recs: Dict[str, JobReconciler] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._status_thread: Optional[threading.Thread] = None
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -262,11 +265,17 @@ class OperatorController:
             target=self._run, name="operator-controller", daemon=True
         )
         self._thread.start()
+        self._status_thread = threading.Thread(
+            target=self._status_loop, name="operator-status", daemon=True
+        )
+        self._status_thread.start()
 
     def stop(self):
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._status_thread is not None:
+            self._status_thread.join(timeout=5)
         for rec in self._recs.values():
             rec.stop()
         self._recs.clear()
@@ -390,6 +399,63 @@ class OperatorController:
         if self._api.get("Service", name, job.namespace) is None:
             self._api.create(master_service_manifest(job, self._port))
         return f"{name}.{job.namespace}.svc:{self._port}"
+
+    # ---- status subresource ------------------------------------------------
+
+    def _status_loop(self):
+        """Periodic ElasticJob.status sync (reference: the Go
+        controller writing ElasticJobStatus — phase + per-replica
+        counts — elasticjob_controller.go updateStatus). Writes only
+        when the computed status DIFFERS from the stored one, so the
+        resulting MODIFIED watch events cannot feed back into a write
+        loop (the reconcile they trigger is an idempotent no-op)."""
+        while not self._stop.is_set():
+            for name in list(self._recs):
+                try:
+                    self._sync_status(name)
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    logger.exception("status sync failed for %s", name)
+            self._stop.wait(self._status_interval_s)
+
+    def compute_status(self, name: str) -> Dict:
+        """Phase + per-replica pod-phase counts for one job."""
+        pods = self._api.list(
+            "Pod", self._ns, label_selector={JOB_LABEL: name}
+        )
+        replicas: Dict[str, Dict[str, int]] = {}
+        for pod in pods:
+            role = (pod.get("metadata", {}).get("labels") or {}).get(
+                "elasticjob.dlrover/replica-type", "worker"
+            )
+            phase = (pod.get("status") or {}).get("phase", "Pending")
+            bucket = replicas.setdefault(role, {})
+            bucket[phase] = bucket.get(phase, 0) + 1
+        workers = replicas.get("worker", {})
+        total = sum(workers.values())
+        terminal = workers.get("Failed", 0) + workers.get("Succeeded", 0)
+        if total == 0:
+            phase = "Pending"
+        elif workers.get("Running", 0) > 0:
+            phase = "Running"
+        elif terminal == total:
+            # ALL workers ended: any failure makes the job Failed
+            # (mixed Failed+Succeeded must not read as Pending forever)
+            phase = "Failed" if workers.get("Failed", 0) else "Succeeded"
+        else:
+            phase = "Pending"
+        return {"phase": phase, "replicaStatuses": replicas}
+
+    def _sync_status(self, name: str):
+        obj = self._api.get("ElasticJob", name, self._ns)
+        if obj is None:
+            return
+        status = self.compute_status(name)
+        if obj.get("status") == status:
+            return
+        # status SUBRESOURCE write: a main-resource PUT is ignored for
+        # .status once the CRD enables the subresource, and a whole-
+        # object write could clobber a concurrent spec change
+        self._api.update_status("ElasticJob", name, status, self._ns)
 
     def _teardown(self, name: str):
         rec = self._recs.pop(name, None)

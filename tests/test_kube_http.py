@@ -46,7 +46,7 @@ _PLURALS = {
 }
 _PATH_RE = re.compile(
     r"^/(?:api/v1|apis/[^/]+/[^/]+)/namespaces/(?P<ns>[^/]+)/"
-    r"(?P<plural>[^/?]+)(?:/(?P<name>[^/?]+))?$"
+    r"(?P<plural>[^/?]+)(?:/(?P<name>[^/?]+?))?(?P<sub>/status)?$"
 )
 
 
@@ -92,6 +92,7 @@ class _KubeHandler(BaseHTTPRequestHandler):
             m.group("name"),
             q,
             sel,
+            bool(m.group("sub")),  # the /status subresource
         )
 
     def do_GET(self):  # noqa: N802
@@ -100,7 +101,7 @@ class _KubeHandler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return
-        kind, ns, name, q, sel = route
+        kind, ns, name, q, sel, _sub = route
         if name:
             obj = self.fake.get(kind, name, ns)
             if obj is None:
@@ -207,7 +208,7 @@ class _KubeHandler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return
-        kind, ns, _, _, _ = route
+        kind, ns, _, _, _, _sub = route
         n = int(self.headers.get("Content-Length", 0))
         manifest = json.loads(self.rfile.read(n))
         manifest["kind"] = kind
@@ -224,10 +225,22 @@ class _KubeHandler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return
-        kind, ns, name, _, _ = route
+        kind, ns, name, _, _, sub = route
         n = int(self.headers.get("Content-Length", 0))
         manifest = json.loads(self.rfile.read(n))
         manifest["kind"] = kind
+        if sub:
+            # /status subresource: persist ONLY .status (the main
+            # resource's spec/metadata in the body are ignored, like a
+            # real server)
+            out = self.fake.update_status(
+                kind, name, manifest.get("status") or {}, ns
+            )
+            if out is None:
+                self._send(404, {"kind": "Status", "code": 404})
+            else:
+                self._send(200, out)
+            return
         try:
             out = self.fake.update(manifest)
         except KeyError:
@@ -241,7 +254,7 @@ class _KubeHandler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return
-        kind, ns, name, _, _ = route
+        kind, ns, name, _, _, _sub = route
         self.fake.delete(kind, name, ns)
         self._send(200, {"kind": "Status", "status": "Success"})
 
@@ -491,6 +504,38 @@ def test_job_reconciler_survives_410_by_relisting(api_server):
         msg="reconciler scaled to 3 after the watch expired",
     )
     rec.stop()
+
+
+def test_status_subresource_over_http(api_server):
+    """With the status subresource enabled, .status only persists via
+    the /status PUT path — a main-resource PUT silently drops it (real
+    API-server semantics, which the operator's status sync relies on);
+    and a status write never clobbers a concurrent spec change."""
+    fake, url, _ = api_server
+    api = _client(url)
+    api.create(
+        {
+            "kind": "ElasticJob",
+            "metadata": {"name": "sj"},
+            "spec": {"minHosts": 1},
+        }
+    )
+    # main-resource PUT cannot smuggle a status in
+    obj = api.get("ElasticJob", "sj")
+    obj["status"] = {"phase": "Hacked"}
+    api.update(obj)
+    assert (api.get("ElasticJob", "sj") or {}).get("status") is None
+    # the subresource write persists
+    api.update_status("ElasticJob", "sj", {"phase": "Running"})
+    assert api.get("ElasticJob", "sj")["status"]["phase"] == "Running"
+    # spec change + status write interleave without clobbering either
+    obj = api.get("ElasticJob", "sj")
+    obj["spec"]["minHosts"] = 3
+    api.update(obj)
+    api.update_status("ElasticJob", "sj", {"phase": "Failed"})
+    got = api.get("ElasticJob", "sj")
+    assert got["spec"]["minHosts"] == 3
+    assert got["status"]["phase"] == "Failed"
 
 
 def test_watch_passes_opaque_rvs_through_and_skips_bookmarks(api_server):
