@@ -123,10 +123,13 @@ class KubeApi:
         name: str,
         status: Dict,
         namespace: str = "default",
+        obj: Optional[Dict] = None,
     ) -> Optional[Dict]:
         """Write ONLY the status subresource (a main-resource PUT is
         ignored for .status once the CRD enables the subresource, and
-        a whole-object write could clobber a concurrent spec change)."""
+        a whole-object write could clobber a concurrent spec change).
+        ``obj``: optionally the already-fetched object, sparing wire
+        implementations the extra GET a full-body PUT needs."""
         raise NotImplementedError
 
 
@@ -209,14 +212,15 @@ class FakeKubeApi(KubeApi):
         name: str,
         status: Dict,
         namespace: str = "default",
+        obj: Optional[Dict] = None,  # unused: the store IS the truth
     ) -> Optional[Dict]:
         with self._cond:
-            obj = self._objects.get((kind, namespace, name))
-            if obj is None:
+            stored = self._objects.get((kind, namespace, name))
+            if stored is None:
                 return None
-            obj["status"] = copy.deepcopy(status)
-            self._emit("MODIFIED", obj)
-            return copy.deepcopy(obj)
+            stored["status"] = copy.deepcopy(status)
+            self._emit("MODIFIED", stored)
+            return copy.deepcopy(stored)
 
     def delete(self, kind: str, name: str, namespace: str = "default"):
         with self._cond:

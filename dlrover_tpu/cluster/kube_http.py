@@ -49,6 +49,7 @@ _BUILTIN_PATHS: Dict[str, Tuple[str, str]] = {
     "Service": ("/api/v1", "services"),
     "ConfigMap": ("/api/v1", "configmaps"),
     "Secret": ("/api/v1", "secrets"),
+    "Event": ("/api/v1", "events"),
     "ElasticJob": ("/apis/elastic.iml.github.io/v1alpha1", "elasticjobs"),
     "ScalePlan": ("/apis/elastic.iml.github.io/v1alpha1", "scaleplans"),
 }
@@ -180,12 +181,17 @@ class RealKubeApi(KubeApi):
         name: str,
         status: Dict,
         namespace: str = "default",
+        obj: Optional[Dict] = None,
     ) -> Optional[Dict]:
         """PUT to the /status subresource path (the only write the API
-        server persists .status from once the CRD enables it)."""
-        obj = self.get(kind, name, namespace)
+        server persists .status from once the CRD enables it).
+        ``obj``: the already-fetched object, to skip the extra GET the
+        PUT body needs (callers typically just read it to diff)."""
+        if obj is None:
+            obj = self.get(kind, name, namespace)
         if obj is None:
             return None
+        obj = dict(obj)
         obj["status"] = status
         return self._request(
             "PUT",

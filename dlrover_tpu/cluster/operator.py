@@ -353,6 +353,50 @@ class OperatorController:
         rec._reconcile(WatchEvent("MODIFIED", obj))
         self._recs[name] = rec
         logger.info("operator: reconciling ElasticJob %s", name)
+        self._record_event(
+            name,
+            "Reconciling",
+            "master + workers ensured",
+            uid=(obj.get("metadata") or {}).get("uid", ""),
+        )
+
+    def _record_event(
+        self, job_name: str, reason: str, message: str, uid: str = ""
+    ):
+        """Emit a k8s Event on the ElasticJob (reference: the Go
+        controller's EventRecorder — `kubectl describe elasticjob`
+        shows the reconcile trail). ``uid`` must be the live object's
+        metadata.uid: kubectl's describe selector filters on
+        involvedObject.uid, so an event without it never shows. Best-
+        effort: an Event that cannot be written never blocks
+        reconciliation."""
+        involved = {
+            "apiVersion": "elastic.iml.github.io/v1alpha1",
+            "kind": "ElasticJob",
+            "name": job_name,
+            "namespace": self._ns,
+        }
+        if uid:
+            involved["uid"] = uid
+        try:
+            self._api.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Event",
+                    "metadata": {
+                        "name": f"{job_name}.{uuid.uuid4().hex[:12]}",
+                        "namespace": self._ns,
+                        "labels": {JOB_LABEL: job_name},
+                    },
+                    "involvedObject": involved,
+                    "reason": reason,
+                    "message": message,
+                    "type": "Normal",
+                    "source": {"component": "dlrover-tpu-operator"},
+                }
+            )
+        except Exception:  # noqa: BLE001
+            logger.debug("event emit failed", exc_info=True)
 
     def _ensure_wire_token(self, job: ElasticJob) -> str:
         """Get-or-create the job's wire-token Secret; returns its NAME
@@ -454,8 +498,11 @@ class OperatorController:
             return
         # status SUBRESOURCE write: a main-resource PUT is ignored for
         # .status once the CRD enables the subresource, and a whole-
-        # object write could clobber a concurrent spec change
-        self._api.update_status("ElasticJob", name, status, self._ns)
+        # object write could clobber a concurrent spec change. The
+        # just-fetched obj rides along so wire clients skip a re-GET.
+        self._api.update_status(
+            "ElasticJob", name, status, self._ns, obj=obj
+        )
 
     def _teardown(self, name: str):
         rec = self._recs.pop(name, None)
@@ -471,6 +518,9 @@ class OperatorController:
         self._api.delete("Service", f"{name}-master", self._ns)
         self._api.delete("Secret", f"{name}-wire-token", self._ns)
         logger.info("operator: ElasticJob %s deleted; tore down", name)
+        self._record_event(
+            name, "TornDown", "pods, service and wire-token removed"
+        )
 
 
 class OperatorHealthServer:

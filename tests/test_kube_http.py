@@ -41,6 +41,7 @@ _PLURALS = {
     "services": "Service",
     "configmaps": "ConfigMap",
     "secrets": "Secret",
+    "events": "Event",
     "elasticjobs": "ElasticJob",
     "scaleplans": "ScalePlan",
 }

@@ -244,6 +244,32 @@ def test_elasticjob_status_reflects_pod_phases():
         ctl.stop()
 
 
+def test_operator_records_events_on_the_job():
+    """`kubectl describe elasticjob` shows the reconcile trail
+    (reference: the Go controller's EventRecorder): Reconciling on
+    adopt, TornDown on delete, with the RBAC verb to match."""
+    api = FakeKubeApi()
+    ctl = OperatorController(api)
+    api.create(_job("ev", replicas=1).to_manifest())
+    ctl._adopt_current()
+    events = api.list("Event", label_selector={JOB_LABEL: "ev"})
+    assert [e["reason"] for e in events] == ["Reconciling"]
+    assert events[0]["involvedObject"]["name"] == "ev"
+    api.delete("ElasticJob", "ev")
+    ctl._adopt_current()
+    reasons = {
+        e["reason"]
+        for e in api.list("Event", label_selector={JOB_LABEL: "ev"})
+    }
+    assert reasons == {"Reconciling", "TornDown"}
+    ctl.stop()
+    role = next(
+        d for d in _docs("rbac.yaml") if d["kind"] == "ClusterRole"
+    )
+    event_rules = [r for r in role["rules"] if "events" in r["resources"]]
+    assert event_rules and "create" in event_rules[0]["verbs"]
+
+
 def test_crd_printer_columns_point_at_real_fields():
     """kubectl's ElasticJob columns must reference fields the code
     actually writes (.status.phase) / the schema defines."""
