@@ -133,6 +133,18 @@ class KubeApi:
         raise NotImplementedError
 
 
+def _creation_order(obj: Dict):
+    """Sort key approximating the order the watch would have delivered:
+    creationTimestamp first (real servers), numeric resourceVersion as
+    the tiebreaker (the fake's monotonic counter)."""
+    md = obj.get("metadata", {}) or {}
+    rv = str(md.get("resourceVersion", ""))
+    return (
+        md.get("creationTimestamp") or "",
+        int(rv) if rv.isdigit() else 0,
+    )
+
+
 def _match_labels(obj: Dict, selector: Optional[Dict[str, str]]) -> bool:
     if not selector:
         return True
@@ -514,18 +526,32 @@ class JobReconciler:
                 return
             except WatchExpired as e:
                 # relist: re-assert the ElasticJob's DESIRED state (a
-                # replica-count reconcile is idempotent). Historical
-                # ScalePlans are deliberately NOT replayed — they are
-                # one-shot imperatives and a stale plan could undo
-                # scaling that happened after it. Transient API errors
-                # keep the old resume point and retry the cycle rather
-                # than killing the operator thread.
+                # replica-count reconcile is idempotent) and replay any
+                # ScalePlan that never reached a terminal phase —
+                # processed plans are marked Succeeded via the status
+                # subresource, so a stale plan can never undo scaling
+                # that happened after it. Transient API errors keep the
+                # old resume point and retry the cycle rather than
+                # killing the operator thread.
                 logger.info("reconcile watch expired (%s); relisting", e)
                 try:
                     list_rv = getattr(self._api, "list_rv", None)
                     since_rv = (
                         list_rv("ElasticJob", self._ns) if list_rv else 0
                     )
+                    # pending plans FIRST, oldest first (list order is
+                    # lexical by name — creation order is what the
+                    # watch would have delivered), and the ElasticJob's
+                    # DESIRED state LAST: even a stale plan that lost
+                    # its Succeeded mark to an API error gets its
+                    # effect overwritten by the final desired-state
+                    # assert, keeping the no-undo invariant
+                    # unconditional rather than mark-dependent
+                    for obj in sorted(
+                        self._api.list("ScalePlan", self._ns),
+                        key=_creation_order,
+                    ):
+                        self._reconcile(WatchEvent("MODIFIED", obj))
                     for obj in self._api.list("ElasticJob", self._ns):
                         self._reconcile(WatchEvent("MODIFIED", obj))
                 except Exception:
@@ -549,9 +575,18 @@ class JobReconciler:
             plan = self._plan_cls()
             plan.worker_num = replicas
             self.scaler.scale(plan)
-        elif ev.kind == "ScalePlan" and ev.type == "ADDED":
+        elif ev.kind == "ScalePlan" and ev.type in ("ADDED", "MODIFIED"):
             spec = ev.obj.get("spec", {})
             if spec.get("ownerJob") != self._job.name:
+                return
+            # plan lifecycle (reference: ScalePlanStatus in
+            # scaleplan_types.go): a processed plan is marked
+            # Succeeded via the status subresource, making it safe to
+            # re-see — on replays (MODIFIED self-event, relist after a
+            # 410) the terminal phase short-circuits, so an old plan
+            # can never undo scaling that happened after it
+            phase = (ev.obj.get("status") or {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
                 return
             plan = self._plan_cls()
             counts = spec.get("replicaCounts", {})
@@ -565,6 +600,19 @@ class JobReconciler:
                     )
             if not plan.empty():
                 self.scaler.scale(plan)
+            self._complete_scale_plan(ev.name)
+
+    def _complete_scale_plan(self, name: str):
+        try:
+            self._api.update_status(
+                "ScalePlan", name, {"phase": "Succeeded"}, self._ns
+            )
+        except NotImplementedError:
+            pass  # minimal KubeApi impls: plans stay un-marked
+        except Exception:  # noqa: BLE001 — marking is best-effort;
+            # the relist's desired-state-last ordering keeps un-marked
+            # replays from undoing later scaling
+            logger.exception("could not mark ScalePlan %s done", name)
 
 
 @dataclass

@@ -318,7 +318,12 @@ class OperatorController:
                     if ev.type in ("ADDED", "MODIFIED"):
                         self._ensure(ev.obj)
                     elif ev.type == "DELETED":
-                        self._teardown(ev.name)
+                        self._teardown(
+                            ev.name,
+                            uid=(ev.obj.get("metadata") or {}).get(
+                                "uid", ""
+                            ),
+                        )
                 return
             except WatchExpired:
                 continue  # relist via the loop head
@@ -504,7 +509,7 @@ class OperatorController:
             "ElasticJob", name, status, self._ns, obj=obj
         )
 
-    def _teardown(self, name: str):
+    def _teardown(self, name: str, uid: str = ""):
         rec = self._recs.pop(name, None)
         if rec is None:
             return
@@ -519,7 +524,10 @@ class OperatorController:
         self._api.delete("Secret", f"{name}-wire-token", self._ns)
         logger.info("operator: ElasticJob %s deleted; tore down", name)
         self._record_event(
-            name, "TornDown", "pods, service and wire-token removed"
+            name,
+            "TornDown",
+            "pods, service and wire-token removed",
+            uid=uid,
         )
 
 

@@ -408,6 +408,56 @@ def test_job_reconciler_plays_operator_for_crds():
     rec.stop()
 
 
+def test_scale_plan_lifecycle_makes_replays_safe():
+    """A processed ScalePlan is marked Succeeded via the status
+    subresource (reference: ScalePlanStatus, scaleplan_types.go), so a
+    replay — the plan's own status MODIFIED event, or a post-410
+    relist — can never undo scaling that happened after it."""
+    api = FakeKubeApi()
+    job = _job(replicas=2, max_hosts=6)
+    rec = JobReconciler(api, job)
+    rec.start()
+    api.create(job.to_manifest())
+    _wait(
+        lambda: len(api.list("Pod", label_selector={JOB_LABEL: "demo"}))
+        == 2,
+        msg="initial pods",
+    )
+    api.create(
+        ScalePlanCRD(
+            job_name="demo", name="sp-old", replica_counts={"worker": 1}
+        ).to_manifest()
+    )
+    _wait(
+        lambda: len(api.list("Pod", label_selector={JOB_LABEL: "demo"}))
+        == 1,
+        msg="scaled down by the plan",
+    )
+    _wait(
+        lambda: (api.get("ScalePlan", "sp-old") or {})
+        .get("status", {})
+        .get("phase")
+        == "Succeeded",
+        msg="plan marked Succeeded",
+    )
+    # the job scales UP afterwards
+    ej = api.get("ElasticJob", "demo")
+    ej["spec"]["replicaSpecs"]["worker"]["replicas"] = 3
+    api.update(ej)
+    _wait(
+        lambda: len(api.list("Pod", label_selector={JOB_LABEL: "demo"}))
+        == 3,
+        msg="scaled up after the plan",
+    )
+    # replaying the COMPLETED plan (as a relist would) must be a no-op
+    rec._reconcile(WatchEvent("MODIFIED", api.get("ScalePlan", "sp-old")))
+    time.sleep(0.3)
+    assert (
+        len(api.list("Pod", label_selector={JOB_LABEL: "demo"})) == 3
+    ), "a completed ScalePlan undid later scaling"
+    rec.stop()
+
+
 def test_reconciler_snaps_to_whole_slices():
     api = FakeKubeApi()
     job = _job(replicas=4, max_hosts=8, hosts_per_slice=4)
