@@ -105,9 +105,26 @@ def build_mesh(
         dcn = tuple(
             (config.num_slices if a == "dp" else 1) for a in MESH_AXES
         )
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            per_slice, dcn, devices=devices
-        )
+        if hasattr(devices[0], "slice_index"):
+            # real multi-slice hardware: let any misconfiguration
+            # (wrong num_slices vs the job's actual slices, ...) raise —
+            # a silent row-major fallback here would span inner axes
+            # across DCN with no error, just drastically slow collectives
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn, devices=devices
+            )
+        else:
+            # virtual/CPU devices carry no slice topology: a plain
+            # row-major reshape IS slice-major order (dp is the
+            # outermost mesh axis, so contiguous device blocks land one
+            # per emulated slice) — keeping the multi-slice code path
+            # compilable and testable off multi-slice hardware
+            logger.info(
+                "no slice topology attributes; emulating %d slices "
+                "with contiguous device blocks",
+                config.num_slices,
+            )
+            dev_array = np.asarray(devices).reshape(shape)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
