@@ -1,0 +1,147 @@
+"""Deployable control-plane demo: operator + brain + CRDs, end to end.
+
+Runs the production control-plane wiring on the in-process API double —
+everything the k8s deployment (deploy/) would run, minus the cluster:
+
+  1. a brain service starts standalone (the shared cluster optimizer)
+     and is seeded with a finished same-kind job's metrics
+  2. the operator elects a leader (ConfigMap lease), then adopts an
+     applied ElasticJob: wire-token Secret minted, master pod + Service
+     first, worker pods with the master address injected
+  3. pod phases flow into ElasticJob.status (the status subresource —
+     what `kubectl get elasticjobs` shows) and the reconcile trail
+     lands as k8s Events
+  4. a ScalePlan scales the job and is marked Succeeded (replay-safe)
+  5. job deletion tears everything down (pods, Service, Secret)
+
+Usage:  python examples/run_operator_stack.py
+Reference: dlrover/go/operator main.go + config/, go/brain.
+"""
+
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")  # repo-root run: `python examples/...`
+
+from dlrover_tpu.cluster.brain import (  # noqa: E402
+    BrainClient,
+    BrainService,
+    BrainWireServer,
+    JobMetrics,
+)
+from dlrover_tpu.cluster.crd import (  # noqa: E402
+    ElasticJob,
+    ElasticJobSpec,
+    ReplicaSpec,
+    ScalePlanCRD,
+)
+from dlrover_tpu.cluster.kube import JOB_LABEL, FakeKubeApi  # noqa: E402
+from dlrover_tpu.cluster.operator import (  # noqa: E402
+    LeaderElector,
+    OperatorController,
+)
+
+
+def wait_for(cond, timeout=20.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise SystemExit(f"timed out waiting for {what}")
+
+
+def main() -> int:
+    # 1. the cluster brain, standalone over the wire
+    brain = BrainWireServer(BrainService(max_workers=8), port=0)
+    client = BrainClient(f"127.0.0.1:{brain.port}")
+    client.persist_metrics(
+        JobMetrics(
+            job_name="yesterday",
+            job_kind="gpt",
+            worker_num=4,
+            samples_per_sec=900.0,
+            finished=True,
+        )
+    )
+    client.bind_job("demo", "gpt")
+    plan = client.generate_plan("create", {})
+    print(f"   brain first-allocation for kind 'gpt': {plan.worker_num} workers")
+
+    # 2. leader-elected operator adopts the job
+    api = FakeKubeApi()
+    elector = LeaderElector(api, ttl_s=5.0)
+    assert elector.try_acquire()
+    print(f"   leader: {elector.identity}")
+    ctl = OperatorController(api, status_interval_s=0.2)
+    ctl.start()
+    api.create(
+        ElasticJob(
+            "demo",
+            spec=ElasticJobSpec(
+                replica_specs={"worker": ReplicaSpec(replicas=2)},
+                min_hosts=1,
+                max_hosts=8,
+            ),
+        ).to_manifest()
+    )
+    wait_for(
+        lambda: api.get("Pod", "demo-worker-1") is not None, what="workers"
+    )
+    assert api.get("Pod", "demo-master") is not None
+    assert api.get("Service", "demo-master") is not None
+    assert api.get("Secret", "demo-wire-token") is not None
+    print("   adopted: master + 2 workers + Service + wire-token Secret")
+
+    # 3. pod phases → status subresource + events
+    api.set_pod_phase("demo-worker-0", "Running")
+    wait_for(
+        lambda: (api.get("ElasticJob", "demo") or {})
+        .get("status", {})
+        .get("phase")
+        == "Running",
+        what="Running status",
+    )
+    events = [
+        e["reason"] for e in api.list("Event", label_selector={JOB_LABEL: "demo"})
+    ]
+    print(f"   status: Running; events: {events}")
+
+    # 4. ScalePlan → scale + terminal phase
+    api.create(
+        ScalePlanCRD(
+            job_name="demo", name="grow", replica_counts={"worker": 4}
+        ).to_manifest()
+    )
+    wait_for(
+        lambda: len(api.list("Pod", label_selector={JOB_LABEL: "demo"})) == 5,
+        what="scale to 4 workers (+master)",
+    )
+    wait_for(
+        lambda: (api.get("ScalePlan", "grow") or {})
+        .get("status", {})
+        .get("phase")
+        == "Succeeded",
+        what="plan marked Succeeded",
+    )
+    print("   scaled to 4 via ScalePlan; plan Succeeded")
+
+    # 5. teardown on delete
+    api.delete("ElasticJob", "demo")
+    wait_for(
+        lambda: not api.list("Pod", label_selector={JOB_LABEL: "demo"}),
+        what="teardown",
+    )
+    assert api.get("Secret", "demo-wire-token") is None
+    print("   deleted: pods, Service and Secret removed")
+
+    ctl.stop()
+    client.close()
+    brain.stop()
+    print("[operator-stack] done")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
