@@ -1,4 +1,4 @@
-from dlrover_tpu.rl.config import PPOConfig  # noqa: F401
+from dlrover_tpu.rl.config import GRPOConfig, PPOConfig  # noqa: F401
 from dlrover_tpu.rl.model_engine import ModelEngine  # noqa: F401
 from dlrover_tpu.rl.replay_buffer import ReplayBuffer  # noqa: F401
-from dlrover_tpu.rl.trainer import RLTrainer  # noqa: F401
+from dlrover_tpu.rl.trainer import GRPOTrainer, RLTrainer  # noqa: F401

@@ -34,3 +34,32 @@ class PPOConfig:
                 "PPO requires temperature > 0: greedy rollouts have a "
                 "degenerate behavior policy with undefined logprobs"
             )
+
+
+@dataclass
+class GRPOConfig:
+    """GRPO hyperparameters (rl/grpo.py; DeepSeekMath recipe).
+
+    No gamma/lam/value_clip: there is no critic. ``group_size`` is the
+    number of completions sampled per prompt — the group IS the
+    baseline."""
+
+    group_size: int = 4
+    clip_ratio: float = 0.2
+    kl_coef: float = 0.05
+    epochs: int = 2
+    minibatches: int = 1
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+
+    def __post_init__(self):
+        if self.temperature <= 0.0:
+            raise ValueError(
+                "GRPO requires temperature > 0: the group baseline "
+                "needs diverse stochastic completions"
+            )
+        if self.group_size < 2:
+            raise ValueError(
+                "group_size must be >= 2: a single completion has no "
+                "group to be relative to"
+            )

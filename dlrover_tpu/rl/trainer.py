@@ -26,6 +26,43 @@ from dlrover_tpu.rl.replay_buffer import ReplayBuffer
 logger = get_logger(__name__)
 
 
+def _response_mask(rows: int, prompt_len: int, t: int) -> jax.Array:
+    """Shifted response mask [rows, T-1]: position i predicts token
+    i+1, responses start at index ``prompt_len`` — the ONE place this
+    subtle alignment rule lives for both trainers."""
+    pos = jnp.arange(t - 1)
+    return jnp.broadcast_to(
+        (pos >= prompt_len - 1), (rows, t - 1)
+    ).astype(jnp.float32)
+
+
+def _sequence_scores(engine, reward_fn, tokens, mask) -> jax.Array:
+    """Programmatic reward_fn if given, else the learned reward model."""
+    if reward_fn is not None:
+        return jnp.asarray(
+            reward_fn(np.asarray(tokens), np.asarray(mask)),
+            dtype=jnp.float32,
+        )
+    return engine.score(tokens, mask=None)
+
+
+def _run_buffer_epochs(buffer, epochs, batch_size, np_rng, update_fn):
+    """Minibatch-update loop shared by the trainers; returns the mean of
+    every stat over all updates (not the last snapshot), clearing the
+    buffer. ``update_fn(jbatch) -> stats`` applies one update in place."""
+    sums: Dict[str, float] = {}
+    n_updates = 0
+    for _ in range(epochs):
+        for batch in buffer.batches(batch_size, np_rng):
+            jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+            stats = update_fn(jbatch)
+            for k, v in stats.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n_updates += 1
+    buffer.clear()
+    return {k: v / max(n_updates, 1) for k, v in sums.items()}
+
+
 class RLTrainer:
     def __init__(
         self,
@@ -146,12 +183,7 @@ class RLTrainer:
             mesh=eng.mesh,
         )
         t = tokens.shape[1]
-        # response mask over the shifted (predicting) positions [B, T-1]:
-        # position i predicts token i+1, responses start at index p
-        pos = jnp.arange(t - 1)
-        mask = jnp.broadcast_to((pos >= p - 1), (b, t - 1)).astype(
-            jnp.float32
-        )
+        mask = _response_mask(b, p, t)
         # one compiled pass for the three model forwards, one for the
         # reward shaping + GAE — no per-op dispatch in the rollout path
         logprobs, ref_logprobs, values = self._rollout_stats(
@@ -160,13 +192,7 @@ class RLTrainer:
             eng.params["ref"],
             tokens,
         )
-        if self.reward_fn is not None:
-            score = jnp.asarray(
-                self.reward_fn(np.asarray(tokens), np.asarray(mask)),
-                dtype=jnp.float32,
-            )
-        else:
-            score = eng.score(tokens, mask=None)
+        score = _sequence_scores(eng, self.reward_fn, tokens, mask)
         advantages, returns = self._postprocess(
             score, logprobs, ref_logprobs, values, mask
         )
@@ -186,34 +212,166 @@ class RLTrainer:
     def train_on_buffer(self, batch_size: Optional[int] = None) -> Dict:
         eng, cfg = self.engine, self.config
         batch_size = batch_size or max(1, len(self.buffer) // cfg.minibatches)
-        sums: Dict[str, float] = {}
-        n_updates = 0
-        for _ in range(cfg.ppo_epochs):
-            for batch in self.buffer.batches(batch_size, self._np_rng):
-                jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
-                (
-                    eng.params["actor"],
-                    eng.opt_states["actor"],
-                    astats,
-                ) = self._actor_step(
-                    eng.params["actor"], eng.opt_states["actor"], jbatch
-                )
-                (
-                    eng.params["critic"],
-                    eng.opt_states["critic"],
-                    cstats,
-                ) = self._critic_step(
-                    eng.params["critic"], eng.opt_states["critic"], jbatch
-                )
-                for k, v in {**astats, **cstats}.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
-                n_updates += 1
-        self.buffer.clear()
-        # mean over all minibatch updates, not the last one's snapshot
-        return {k: v / max(n_updates, 1) for k, v in sums.items()}
+
+        def update(jbatch):
+            (
+                eng.params["actor"],
+                eng.opt_states["actor"],
+                astats,
+            ) = self._actor_step(
+                eng.params["actor"], eng.opt_states["actor"], jbatch
+            )
+            (
+                eng.params["critic"],
+                eng.opt_states["critic"],
+                cstats,
+            ) = self._critic_step(
+                eng.params["critic"], eng.opt_states["critic"], jbatch
+            )
+            return {**astats, **cstats}
+
+        return _run_buffer_epochs(
+            self.buffer, cfg.ppo_epochs, batch_size, self._np_rng, update
+        )
 
     def step(self, prompts: jax.Array, rng: jax.Array) -> Dict:
         """One full PPO round: rollout + buffer train."""
+        roll = self.make_experience(prompts, rng)
+        stats = self.train_on_buffer()
+        return {**roll, **stats}
+
+
+class GRPOTrainer:
+    """Critic-free RLHF: group-relative advantages (rl/grpo.py).
+
+    EXCEEDS the reference (atorch/rl is PPO-only). Same ModelEngine,
+    but only the actor trains — the critic role (and its optimizer
+    state) is never touched, and rollouts skip the value forward
+    entirely. Each prompt is repeated ``group_size`` times; the group's
+    score statistics replace the learned baseline.
+    """
+
+    def __init__(
+        self,
+        engine: ModelEngine,
+        config=None,
+        reward_fn: Optional[Callable] = None,
+    ):
+        from dlrover_tpu.rl import grpo
+        from dlrover_tpu.rl.config import GRPOConfig
+
+        self.engine = engine
+        self.config = config or GRPOConfig()
+        self.reward_fn = reward_fn
+        self.buffer = ReplayBuffer()
+        self._np_rng = np.random.default_rng(0)
+        cfg = self.config
+        inv_temp = 1.0 / cfg.temperature  # same tempered-policy rule as PPO
+
+        @jax.jit
+        def actor_step(params, opt_state, batch):
+            def loss_fn(p):
+                logits = self.engine.actor_logits(p, batch["tokens"]) * (
+                    inv_temp
+                )
+                logprobs = ppo.token_logprobs(
+                    logits[:, :-1], batch["tokens"][:, 1:]
+                )
+                loss, stats = grpo.grpo_loss(
+                    logprobs,
+                    batch["old_logprobs"],
+                    batch["advantages"],
+                    batch["ref_logprobs"],
+                    batch["mask"],
+                    cfg.clip_ratio,
+                    cfg.kl_coef,
+                )
+                return loss, stats
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = self.engine.optimizers["actor"].update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {**stats, "actor_loss": loss}
+
+        @jax.jit
+        def rollout_stats(actor_p, ref_p, tokens):
+            logits = self.engine.actor_logits(actor_p, tokens) * inv_temp
+            logprobs = ppo.token_logprobs(logits[:, :-1], tokens[:, 1:])
+            ref_logits = (
+                self.engine.actor_logits(ref_p, tokens) * inv_temp
+            )
+            ref_logprobs = ppo.token_logprobs(
+                ref_logits[:, :-1], tokens[:, 1:]
+            )
+            return logprobs, ref_logprobs
+
+        self._actor_step = actor_step
+        self._rollout_stats = rollout_stats
+        self._grpo = grpo
+
+    def make_experience(self, prompts: jax.Array, rng: jax.Array) -> Dict:
+        """Sample ``group_size`` completions per prompt; fill the buffer."""
+        eng, cfg = self.engine, self.config
+        b, p = prompts.shape
+        g = cfg.group_size
+        # contiguous repeat: rows [i*G, (i+1)*G) share prompt i — the
+        # layout group_advantages' reshape assumes
+        rep = jnp.repeat(prompts, g, axis=0)
+        tokens = generate.sample(
+            eng.params["actor"],
+            eng.cfg,
+            rep,
+            cfg.max_new_tokens,
+            rng=rng,
+            temperature=cfg.temperature,
+            mesh=eng.mesh,
+        )
+        t = tokens.shape[1]
+        mask = _response_mask(b * g, p, t)
+        logprobs, ref_logprobs = self._rollout_stats(
+            eng.params["actor"], eng.params["ref"], tokens
+        )
+        score = _sequence_scores(eng, self.reward_fn, tokens, mask)
+        adv = self._grpo.broadcast_advantages(
+            self._grpo.group_advantages(score, g), mask
+        )
+        self.buffer.add(
+            {
+                "tokens": tokens,
+                "old_logprobs": logprobs,
+                "ref_logprobs": ref_logprobs,
+                "advantages": adv,
+                "mask": mask,
+            }
+        )
+        return {"score_mean": float(score.mean())}
+
+    def train_on_buffer(self, batch_size: Optional[int] = None) -> Dict:
+        eng, cfg = self.engine, self.config
+        batch_size = batch_size or max(
+            1, len(self.buffer) // cfg.minibatches
+        )
+
+        def update(jbatch):
+            (
+                eng.params["actor"],
+                eng.opt_states["actor"],
+                stats,
+            ) = self._actor_step(
+                eng.params["actor"], eng.opt_states["actor"], jbatch
+            )
+            return stats
+
+        return _run_buffer_epochs(
+            self.buffer, cfg.epochs, batch_size, self._np_rng, update
+        )
+
+    def step(self, prompts: jax.Array, rng: jax.Array) -> Dict:
+        """One full GRPO round: grouped rollout + actor updates."""
         roll = self.make_experience(prompts, rng)
         stats = self.train_on_buffer()
         return {**roll, **stats}

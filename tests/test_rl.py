@@ -431,3 +431,86 @@ def test_rollout_reads_training_actor_buffers(tmp_path):
         jax.tree.leaves(seen[0]), jax.tree.leaves(eng.params["actor"])
     ):
         assert got is have
+
+
+# ---------------------------------------------------------------------------
+# GRPO (rl/grpo.py) — exceeds the reference: atorch/rl is PPO-only
+# ---------------------------------------------------------------------------
+
+
+def test_group_advantages_whiten_within_groups():
+    from dlrover_tpu.rl import grpo
+
+    scores = jnp.array([1.0, 2.0, 3.0, 4.0, 10.0, 10.0, 10.0, 10.0])
+    adv = grpo.group_advantages(scores, group_size=4)
+    # group 1: zero-mean, ordered like the raw scores
+    g1 = np.asarray(adv[:4])
+    assert abs(g1.mean()) < 1e-5
+    assert np.all(np.diff(g1) > 0)
+    # group 2: zero variance → zero advantage (no preference signal)
+    assert np.allclose(np.asarray(adv[4:]), 0.0, atol=1e-5)
+    with pytest.raises(ValueError, match="divisible"):
+        grpo.group_advantages(scores[:6], group_size=4)
+
+
+def test_kl_k3_nonnegative_and_zero_at_match():
+    from dlrover_tpu.rl import grpo
+
+    lp = jnp.log(jnp.array([[0.5, 0.25, 0.125]]))
+    mask = jnp.ones_like(lp)
+    assert float(grpo.kl_k3(lp, lp, mask)) == pytest.approx(0.0, abs=1e-7)
+    drift = lp + jnp.array([[0.3, -0.2, 0.1]])
+    assert float(grpo.kl_k3(drift, lp, mask)) > 0.0
+
+
+def test_grpo_increases_rewarded_token_probability():
+    """Same toy task as the PPO test, critic-free: reward = fraction of
+    response tokens equal to TARGET; the group baseline alone must be
+    enough signal for the actor to shift probability mass."""
+    from dlrover_tpu.rl import GRPOConfig, GRPOTrainer
+
+    TARGET = 7
+    cfg = _cfg(vocab_size=16, n_layer=1, d_model=32)
+    eng = ModelEngine(cfg, learning_rate=2e-2, rng=jax.random.key(2))
+
+    def reward_fn(tokens, mask):
+        resp = tokens[:, 1:] == TARGET
+        return (resp * mask).sum(-1) / np.maximum(mask.sum(-1), 1.0)
+
+    gcfg = GRPOConfig(
+        group_size=4,
+        max_new_tokens=8,
+        kl_coef=0.0,
+        epochs=2,
+        temperature=1.0,
+    )
+    trainer = GRPOTrainer(eng, gcfg, reward_fn=reward_fn)
+    prompts = jnp.ones((8, 2), jnp.int32)  # ×4 completions = 32 rollouts
+
+    def target_prob(params):
+        logits = eng.actor_logits(params, prompts)
+        return float(jax.nn.softmax(logits[:, -1, :], -1)[:, TARGET].mean())
+
+    critic_before = jax.tree.leaves(eng.params["critic"])[0].copy()
+    p0 = target_prob(eng.params["actor"])
+    scores = []
+    for i in range(12):
+        stats = trainer.step(prompts, jax.random.key(200 + i))
+        scores.append(stats["score_mean"])
+    p1 = target_prob(eng.params["actor"])
+    assert p1 > p0 * 1.5, (p0, p1, scores)
+    assert np.mean(scores[-3:]) > np.mean(scores[:3]), scores
+    # critic-free: the critic's weights were never touched
+    critic_after = jax.tree.leaves(eng.params["critic"])[0]
+    np.testing.assert_array_equal(
+        np.asarray(critic_before), np.asarray(critic_after)
+    )
+
+
+def test_grpo_config_validation():
+    from dlrover_tpu.rl import GRPOConfig
+
+    with pytest.raises(ValueError, match="group_size"):
+        GRPOConfig(group_size=1)
+    with pytest.raises(ValueError, match="temperature"):
+        GRPOConfig(temperature=0.0)
