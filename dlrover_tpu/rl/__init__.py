@@ -1,4 +1,8 @@
 from dlrover_tpu.rl.config import GRPOConfig, PPOConfig  # noqa: F401
 from dlrover_tpu.rl.model_engine import ModelEngine  # noqa: F401
 from dlrover_tpu.rl.replay_buffer import ReplayBuffer  # noqa: F401
-from dlrover_tpu.rl.trainer import GRPOTrainer, RLTrainer  # noqa: F401
+from dlrover_tpu.rl.trainer import (  # noqa: F401
+    DPOTrainer,
+    GRPOTrainer,
+    RLTrainer,
+)

@@ -1,0 +1,52 @@
+"""DPO: direct preference optimization — offline alignment, no rollouts.
+
+EXCEEDS the reference (atorch/rl has no offline-preference path):
+DPO (Rafailov et al. 2023) trains the policy directly on preference
+pairs (chosen, rejected) with the reference policy as the implicit
+reward normalizer — no reward model, no rollouts, no critic, no replay
+buffer; each update is one ordinary supervised-style jitted step, so it
+rides the same MXU-dense forward the trainers already use.
+
+    loss = −log σ( β·[(logπ(c) − logπ_ref(c)) − (logπ(r) − logπ_ref(r))] )
+
+summed token logprobs over each sequence's response span. The implicit
+per-pair rewards β·(logπ − logπ_ref) are emitted as stats: their
+margin and sign-accuracy are the standard DPO training diagnostics.
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_logprob(
+    logits: jax.Array,  # [B, T, V] — positions predicting tokens[:,1:]
+    tokens: jax.Array,  # [B, T]
+    mask: jax.Array,    # [B, T-1] response mask over shifted positions
+) -> jax.Array:
+    """Sum of response-token logprobs per sequence → [B]."""
+    from dlrover_tpu.rl import ppo
+
+    lp = ppo.token_logprobs(logits[:, :-1], tokens[:, 1:])
+    return (lp * mask).sum(axis=1)
+
+
+def dpo_loss(
+    policy_chosen: jax.Array,    # [B] seq logprobs under the policy
+    policy_rejected: jax.Array,  # [B]
+    ref_chosen: jax.Array,       # [B] under the frozen reference
+    ref_rejected: jax.Array,     # [B]
+    beta: float,
+) -> Tuple[jax.Array, Dict]:
+    chosen_reward = beta * (policy_chosen - ref_chosen)
+    rejected_reward = beta * (policy_rejected - ref_rejected)
+    margin = chosen_reward - rejected_reward
+    loss = -jax.nn.log_sigmoid(margin).mean()
+    stats = {
+        "reward_margin": margin.mean(),
+        "reward_accuracy": (margin > 0).mean(),
+        "chosen_reward": chosen_reward.mean(),
+        "rejected_reward": rejected_reward.mean(),
+    }
+    return loss, stats

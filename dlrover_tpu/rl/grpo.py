@@ -57,9 +57,10 @@ def kl_k3(
     """Unbiased nonnegative per-token KL estimate, masked mean → scalar.
 
     k3 = exp(Δ) − Δ − 1, Δ = ref − cur: ≥ 0 with equality iff the
-    logprobs match; its gradient w.r.t. ``logprobs`` is exp(Δ) − 1,
-    pulling the policy toward the reference proportionally to how far
-    it drifted."""
+    logprobs match; its gradient w.r.t. ``logprobs`` is 1 − exp(Δ),
+    so minimizing it pushes cur UP where the policy undershoots the
+    reference (Δ > 0) and down where it overshoots — toward the
+    reference either way."""
     d = ref_logprobs - logprobs
     kl = jnp.exp(d) - d - 1.0
     return (kl * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -375,3 +375,98 @@ class GRPOTrainer:
         roll = self.make_experience(prompts, rng)
         stats = self.train_on_buffer()
         return {**roll, **stats}
+
+
+class DPOTrainer:
+    """Offline preference optimization (rl/dpo.py) — the third
+    alignment algorithm on the shared engine (EXCEEDS the reference:
+    atorch/rl has no offline path). Only actor + ref are used; there
+    are no rollouts, so each call is one jitted supervised-style step
+    over a batch of (chosen, rejected) token pairs."""
+
+    def __init__(self, engine: ModelEngine, beta: float = 0.1):
+        from dlrover_tpu.rl import dpo
+
+        self.engine = engine
+        self.beta = float(beta)
+        if self.beta <= 0:
+            raise ValueError("beta must be > 0")
+
+        @jax.jit
+        def ref_logprobs(ref_params, batch):
+            rc = dpo.sequence_logprob(
+                self.engine.actor_logits(ref_params, batch["chosen"]),
+                batch["chosen"],
+                batch["chosen_mask"],
+            )
+            rr = dpo.sequence_logprob(
+                self.engine.actor_logits(ref_params, batch["rejected"]),
+                batch["rejected"],
+                batch["rejected_mask"],
+            )
+            return rc, rr
+
+        @jax.jit
+        def dpo_step(params, opt_state, batch):
+            def loss_fn(p):
+                pc = dpo.sequence_logprob(
+                    self.engine.actor_logits(p, batch["chosen"]),
+                    batch["chosen"],
+                    batch["chosen_mask"],
+                )
+                pr = dpo.sequence_logprob(
+                    self.engine.actor_logits(p, batch["rejected"]),
+                    batch["rejected"],
+                    batch["rejected_mask"],
+                )
+                return dpo.dpo_loss(
+                    pc,
+                    pr,
+                    batch["ref_chosen"],
+                    batch["ref_rejected"],
+                    self.beta,
+                )
+
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = self.engine.optimizers["actor"].update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {**stats, "dpo_loss": loss}
+
+        self._ref_logprobs = ref_logprobs
+        self._dpo_step = dpo_step
+
+    def prepare(self, batch: Dict) -> Dict:
+        """Attach the frozen reference's sequence logprobs to a batch.
+
+        The ref policy and the pairs are both fixed in offline DPO, so
+        these are per-pair CONSTANTS — computing them once here (and
+        reusing the prepared batch across epochs) halves the forwards
+        per update step. ``step`` calls this lazily for unprepared
+        batches."""
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        rc, rr = self._ref_logprobs(self.engine.params["ref"], jbatch)
+        return {**jbatch, "ref_chosen": rc, "ref_rejected": rr}
+
+    def step(self, batch: Dict) -> Dict:
+        """``batch``: chosen/rejected [B,T] int32 + their [B,T-1]
+        response masks (same shifted-mask rule as the other trainers —
+        build with ``_response_mask`` when pairs share a prompt length).
+        Pass a ``prepare``d batch when iterating epochs over a fixed
+        set, or a raw one (prepared lazily). Updates the actor in
+        place; returns the stats."""
+        eng = self.engine
+        if "ref_chosen" not in batch:
+            batch = self.prepare(batch)
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (
+            eng.params["actor"],
+            eng.opt_states["actor"],
+            stats,
+        ) = self._dpo_step(
+            eng.params["actor"], eng.opt_states["actor"], jbatch
+        )
+        return {k: float(v) for k, v in stats.items()}
