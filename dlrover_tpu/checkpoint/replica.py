@@ -152,13 +152,16 @@ class _Handler(socketserver.BaseRequestHandler):
         store: _ReplicaStore = self.server.store  # type: ignore[attr-defined]
         token = self.server.token  # type: ignore[attr-defined]
         max_bytes = self.server.max_frame_bytes  # type: ignore[attr-defined]
+        # authenticate BEFORE parsing any frame (shared preamble,
+        # common/sockets.py): an unauthenticated 'put' must not be able
+        # to force a multi-GB allocation, and the reject is silent —
+        # closing without answering, same as every other data plane
+        from dlrover_tpu.common.sockets import check_auth
+
+        if not check_auth(self.request, token):
+            return
         try:
             header = _recv_header(self.request)
-            # authenticate before touching the payload: an unauthenticated
-            # 'put' must not be able to force a multi-GB allocation
-            if token and header.get("token") != token:
-                _send_frame(self.request, {"ok": False, "error": "bad token"})
-                return
             payload = _recv_payload(self.request, header, max_bytes)
         except (ConnectionError, json.JSONDecodeError, OSError, ValueError):
             return
@@ -387,7 +390,6 @@ class ReplicaManager:
                         "src": self.process_index,
                         "step": step,
                         "size": len(pack),
-                        "token": self.config.token,
                     },
                     pack,
                 )
@@ -457,9 +459,7 @@ class ReplicaManager:
             return {}
         try:
             with self._connect(addr) as sock:
-                _send_frame(
-                    sock, {"op": "steps", "token": self.config.token}
-                )
+                _send_frame(sock, {"op": "steps"})
                 resp, _ = _recv_frame(sock)
                 return {int(k): int(v) for k, v in resp.get("steps", {}).items()}
         except OSError:
@@ -468,10 +468,7 @@ class ReplicaManager:
     def _get(self, addr: str, src: int) -> Optional[Tuple[int, bytes]]:
         try:
             with self._connect(addr) as sock:
-                _send_frame(
-                    sock,
-                    {"op": "get", "src": src, "token": self.config.token},
-                )
+                _send_frame(sock, {"op": "get", "src": src})
                 resp, payload = _recv_frame(sock)
                 if not resp.get("ok"):
                     return None
@@ -481,10 +478,15 @@ class ReplicaManager:
             return None
 
     def _connect(self, addr: str) -> socket.socket:
+        from dlrover_tpu.common.sockets import send_auth
+
         host, port = addr.rsplit(":", 1)
         sock = socket.create_connection(
             (host, int(port)), timeout=self.config.timeout
         )
+        # every connection on this plane speaks the shared auth preamble
+        # before its first frame (common/sockets.py)
+        send_auth(sock, self.config.token)
         return sock
 
     # ---- lifecycle -------------------------------------------------------

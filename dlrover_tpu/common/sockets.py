@@ -31,11 +31,34 @@ _AUTH_MAGIC = b"\x00DTPAUTH"
 _MAX_TOKEN = 4096
 
 
+_warned_fallback_token = False
+
+
 def default_token() -> str:
-    """The run-shared wire token (empty = token comparison disabled)."""
-    return os.environ.get("DLROVER_TPU_WIRE_TOKEN") or os.environ.get(
-        "DLROVER_TPU_RUN_ID", ""
-    )
+    """The run-shared wire token (empty = token comparison disabled).
+
+    ``DLROVER_TPU_WIRE_TOKEN`` is the real credential (the operator
+    provisions it as a per-job random Secret). The ``DLROVER_TPU_RUN_ID``
+    fallback is predictable outside the operator path (often the job
+    name), so it only keeps out accidental strays — warn once when it is
+    the active credential so non-operator deployments know to set a
+    random ``DLROVER_TPU_WIRE_TOKEN``.
+    """
+    tok = os.environ.get("DLROVER_TPU_WIRE_TOKEN")
+    if tok:
+        return tok
+    run_id = os.environ.get("DLROVER_TPU_RUN_ID", "")
+    global _warned_fallback_token
+    if run_id and not _warned_fallback_token:
+        _warned_fallback_token = True
+        from dlrover_tpu.common.log import get_logger
+
+        get_logger(__name__).warning(
+            "wire auth is using the DLROVER_TPU_RUN_ID fallback (a "
+            "predictable value outside the operator's Secret path); "
+            "set a random DLROVER_TPU_WIRE_TOKEN for real protection"
+        )
+    return run_id
 
 
 def send_auth(sock: socket.socket, token: Optional[str]) -> None:

@@ -225,9 +225,11 @@ class InProcessTransport:
 class SocketTransport:
     """Full-exchange all-gather between slice leaders over TCP.
 
-    Reuses the replica wire protocol (length-prefixed JSON + raw payload).
-    Suitable for the handful-of-slices regime local SGD targets; the
-    payload per sync is one packed delta pytree per slice.
+    Reuses the replica wire protocol (length-prefixed JSON + raw payload)
+    behind the shared connection-auth preamble (common/sockets.py), so
+    all four TCP data planes authenticate identically. Suitable for the
+    handful-of-slices regime local SGD targets; the payload per sync is
+    one packed delta pytree per slice.
     """
 
     def __init__(
@@ -243,17 +245,24 @@ class SocketTransport:
         import threading
 
         from dlrover_tpu.checkpoint import replica as wire
-        from dlrover_tpu.common.sockets import default_token
+        from dlrover_tpu.common.sockets import (
+            check_auth,
+            default_token,
+            send_auth,
+        )
 
         self.rank = rank
         self.peers = dict(peers)
         self._validate_peers()
         self.timeout = timeout
-        # this plane exchanges GRADIENT DELTAS between slices: the run
-        # token is on by default (None = DLROVER_TPU_RUN_ID), not just
-        # peer-identity fields; pass "" to explicitly disable
+        # this plane exchanges GRADIENT DELTAS between slices: it
+        # authenticates with the shared connection preamble
+        # (common/sockets.py — constant-time compare, reject before any
+        # frame is parsed), same as the replica ring / KV serving /
+        # coworker ingress planes; None = run-id default, "" disables
         self.token = default_token() if token is None else token
         self._wire = wire
+        self._send_auth = send_auth
         self._inbox: Dict[int, Dict[int, bytes]] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -261,10 +270,10 @@ class SocketTransport:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                if not check_auth(self.request, outer.token):
+                    return  # close without answering
                 try:
                     header = wire._recv_header(self.request)
-                    if outer.token and header.get("token") != outer.token:
-                        return
                     payload = wire._recv_payload(self.request, header)
                 except (OSError, ValueError):
                     return
@@ -317,13 +326,13 @@ class SocketTransport:
             with pysocket.create_connection(
                 (host, int(port)), timeout=self.timeout
             ) as sock:
+                self._send_auth(sock, self.token)
                 self._wire._send_frame(
                     sock,
                     {
                         "src": self.rank,
                         "round": rnd,
                         "size": len(blob),
-                        "token": self.token,
                     },
                     blob,
                 )
