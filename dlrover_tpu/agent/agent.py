@@ -52,6 +52,11 @@ class ElasticLaunchConfig:
     exclude_straggler: bool = False
     node_unit: int = 1
     coordinator_port: int = 7010
+    # persistent XLA compile-cache dir for workers ("" = the private
+    # per-user default under /tmp); same-shape restarts deserialize the
+    # cached executable instead of recompiling — the dominant term in
+    # the <60 s re-mesh recovery budget at real model sizes
+    compile_cache_dir: str = ""
     entrypoint: List[str] = field(default_factory=list)
     env: Dict[str, str] = field(default_factory=dict)
 
@@ -256,7 +261,13 @@ class ElasticTrainingAgent:
                 if p
             ),
         }
-        if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        if self.config.compile_cache_dir:
+            # job-config override (--compile-cache-dir / operator spec):
+            # e.g. a shared NFS path so every host of the job — and its
+            # relaunched replacements on FRESH hosts — hit one cache
+            env["JAX_COMPILATION_CACHE_DIR"] = self.config.compile_cache_dir
+            env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1"
+        elif "JAX_COMPILATION_CACHE_DIR" not in os.environ:
             # persistent XLA compile cache across worker restarts: the
             # re-mesh hard part (SURVEY §7) — a restarted worker whose
             # mesh shape was compiled before (same world, or a prior
@@ -388,6 +399,13 @@ class ElasticTrainingAgent:
         self._pending_restart.clear()
         if self._worker:
             self._worker.terminate()
+            # the killed worker can never complete an in-flight shard
+            # lease: tell the master to re-queue it NOW (the failure
+            # path re-queues via node-down; this voluntary path must
+            # do it explicitly or the dataset tail deadlocks)
+            self._safe_report(
+                self.client.report_worker_restart, "planned restart"
+            )
         try:
             self._initialize_worker()
             return True

@@ -88,6 +88,17 @@ class MasterClient:
             retries=retries,
         )
 
+    def report_worker_restart(
+        self, reason: str = "", retries: Optional[int] = None
+    ) -> bool:
+        """Planned worker kill+respawn: master re-queues in-flight
+        shards (a failure report does this via the node-down path; a
+        VOLUNTARY restart must do it explicitly)."""
+        return self._t.report(
+            msgs.WorkerRestartReport(node_id=self.node_id, reason=reason),
+            retries=retries,
+        )
+
     def report_failure(
         self,
         error_data: str,

@@ -144,6 +144,19 @@ class NodeStatusReport:
 
 
 @message
+class WorkerRestartReport:
+    """Agent notice that it killed + is respawning its worker on purpose
+    (membership change, restart prescription). The master must re-queue
+    the node's in-flight dataset shards — the dead worker can never
+    complete its lease, and a leaked lease deadlocks the end of the
+    dataset (every surviving rank polls WAIT forever while its SPMD
+    peers sit in the shard broadcast)."""
+
+    node_id: int = 0
+    reason: str = ""
+
+
+@message
 class NodeFailureReport:
     node_id: int = 0
     node_rank: int = -1

@@ -73,6 +73,27 @@ class MasterServicer:
             )
         return True
 
+    def _report_worker_restart(self, m: msgs.WorkerRestartReport) -> bool:
+        """Voluntary worker kill+respawn (membership change, restart
+        prescription): re-queue the node's in-flight shards — a leaked
+        lease can never complete and deadlocks the dataset's tail —
+        and open a goodput stall (training IS stopped until the
+        restarted world's first advancing step report)."""
+        logger.info(
+            "node %d restarting its worker (%s)", m.node_id, m.reason
+        )
+        if self.task_manager:
+            self.task_manager.recover_worker_tasks(m.node_id)
+        if self.goodput_tracker:
+            self.goodput_tracker.mark_stalled(
+                at_step=(
+                    self.speed_monitor.global_step
+                    if self.speed_monitor
+                    else None
+                )
+            )
+        return True
+
     def _report_node_failure(self, m: msgs.NodeFailureReport) -> bool:
         if m.level == "diagnosis":
             # routine diagnosis payloads (log tails, proc state, stack
@@ -224,6 +245,7 @@ class MasterServicer:
         "PsVersionReport": _report_ps_version,
         "HeartbeatReport": _report_heartbeat,
         "NodeStatusReport": _report_node_status,
+        "WorkerRestartReport": _report_worker_restart,
         "NodeFailureReport": _report_node_failure,
         "ResourceStats": _report_resource,
         "TaskResult": _report_task_result,
@@ -250,6 +272,13 @@ class MasterServicer:
             node = self.job_manager.register_node(m.meta, m.restart_count)
             for mgr in self.rdzv_managers.values():
                 mgr.add_alive_node(node.rank_index)
+            # a (re)registration is a FRESH incarnation: prescriptions
+            # queued against its dead predecessor (e.g. relaunch_node
+            # from the failure diagnosis) must not be delivered to the
+            # replacement — obeying them would kill the very node the
+            # relaunch asked for, looping the recovery
+            if self.diagnosis_manager:
+                self.diagnosis_manager.take_actions(node.id)
             return msgs.NodeRegisterResponse(
                 success=True,
                 node_rank=node.rank_index,

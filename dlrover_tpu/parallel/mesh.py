@@ -105,7 +105,11 @@ def build_mesh(
         dcn = tuple(
             (config.num_slices if a == "dp" else 1) for a in MESH_AXES
         )
-        if hasattr(devices[0], "slice_index"):
+        # Gate on the number of DISTINCT slice ids, not the mere
+        # presence of the attribute: multi-process CPU devices carry a
+        # slice_index too (all 0), which must take the emulation path.
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        if len(slice_ids) > 1:
             # real multi-slice hardware: let any misconfiguration
             # (wrong num_slices vs the job's actual slices, ...) raise —
             # a silent row-major fallback here would span inner axes
@@ -114,13 +118,14 @@ def build_mesh(
                 per_slice, dcn, devices=devices
             )
         else:
-            # virtual/CPU devices carry no slice topology: a plain
-            # row-major reshape IS slice-major order (dp is the
-            # outermost mesh axis, so contiguous device blocks land one
-            # per emulated slice) — keeping the multi-slice code path
-            # compilable and testable off multi-slice hardware
+            # single-slice or virtual/CPU devices: a plain row-major
+            # reshape IS slice-major order (dp is the outermost mesh
+            # axis, so contiguous device blocks land one per emulated
+            # slice) — keeping the multi-slice code path compilable and
+            # testable off multi-slice hardware. Safe because with at
+            # most one real slice no inner axis can silently span DCN.
             logger.info(
-                "no slice topology attributes; emulating %d slices "
+                "single physical slice; emulating %d slices "
                 "with contiguous device blocks",
                 config.num_slices,
             )

@@ -58,11 +58,31 @@ def main():
     p.add_argument("--ckpt-every", type=int, default=5)
     p.add_argument("--crash-at", type=int, default=-1,
                    help="deliberately crash at this step (failover demo)")
+    p.add_argument(
+        "--hosts-per-slice", type=int, default=0,
+        help="build a hybrid multi-slice mesh: every hosts-per-slice "
+        "processes form one emulated ICI slice, dp rides DCN across "
+        "slices (num_slices = process_count // hosts_per_slice)",
+    )
     args = p.parse_args()
 
     init_distributed()
     client = build_master_client()
-    mesh = build_mesh(MeshConfig(dp=-1))
+    if args.hosts_per_slice > 0:
+        # slice-grain elasticity: the mesh is rebuilt from the CURRENT
+        # world every (re)start, so a world that shrank by a whole slice
+        # re-meshes to fewer slices (dp shrinks, fsdp stays intra-slice)
+        num_slices = max(1, jax.process_count() // args.hosts_per_slice)
+        mesh = build_mesh(
+            MeshConfig(dp=num_slices, fsdp=-1, num_slices=num_slices)
+        )
+        print(
+            f"[worker] slice mesh: num_slices={num_slices} "
+            f"dp={mesh.shape['dp']} fsdp={mesh.shape['fsdp']}",
+            flush=True,
+        )
+    else:
+        mesh = build_mesh(MeshConfig(dp=-1))
     cfg = get_config(args.model, max_seq=args.seq)
     opt = make_optimizer(learning_rate=1e-3, warmup_steps=5, decay_steps=1000)
 

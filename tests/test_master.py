@@ -481,3 +481,67 @@ def test_unknown_failure_does_not_restart_dead_worker(master):
     c0 = _client(master, 0)
     c0.report_failure("worker exit code 1", level="process_error")
     assert c0.heartbeat_with_actions() == []
+
+
+def test_reregistration_clears_stale_prescriptions():
+    """A replacement agent must never be handed a prescription queued
+    against its dead predecessor: the slice drill's joiner was told
+    relaunch_node (diagnosed from the ORIGINAL node's crash) and obeyed
+    by exiting — looping the recovery it was the recovery for. A fresh
+    registration drains the node's pending action queue."""
+    from dlrover_tpu.common import messages as msgs
+    from dlrover_tpu.diagnosis.manager import DiagnosisManager
+    from dlrover_tpu.master.node_manager import JobManager
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    jm = JobManager(num_workers=2)
+    dm = DiagnosisManager()
+    servicer = MasterServicer(job_manager=jm, diagnosis_manager=dm)
+
+    # node 1 dies; the failure is diagnosed as needing a node relaunch
+    dm.collect_failure(
+        msgs.NodeFailureReport(
+            node_id=1, error_data="killed: preempted", level="node_error"
+        )
+    )
+    assert dm._pending_actions.get(1), "precondition: action queued"
+
+    # the replacement registers (fresh incarnation)
+    resp = servicer.get(
+        msgs.NodeRegisterRequest(
+            meta=msgs.NodeMeta(node_id=1, node_rank=1, host_addr="h1"),
+            restart_count=0,
+        )
+    )
+    assert resp.success
+    # ...and the stale prescription is gone: its next heartbeat carries
+    # no relaunch order
+    hb = servicer.get(msgs.HeartbeatReport(node_id=1))
+    assert not hb.actions, hb.actions
+
+
+def test_worker_restart_requeues_inflight_shards():
+    """A PLANNED worker restart (membership change / restart
+    prescription) must re-queue the node's leased shard immediately:
+    only node FAILURES re-queued before, so a voluntary restart leaked
+    the lease and the dataset tail deadlocked until the 1800 s shard
+    timeout (found by the slice-elasticity drill's grow phase)."""
+    from dlrover_tpu.common import messages as msgs
+    from dlrover_tpu.master.servicer import MasterServicer
+    from dlrover_tpu.master.task_manager import TaskManager
+
+    tm = TaskManager()
+    tm.new_dataset("train", dataset_size=16, shard_size=8)
+    servicer = MasterServicer(task_manager=tm)
+
+    t1 = tm.get_task("train", worker_id=0)
+    assert t1.task_id >= 0
+    # the second shard goes out too — nothing left in todo
+    t2 = tm.get_task("train", worker_id=0)
+    assert t2.task_id >= 0
+    assert tm.get_task("train", worker_id=0).task_type == "wait"
+
+    # agent kills + respawns its worker: both leases come back
+    servicer.report(msgs.WorkerRestartReport(node_id=0, reason="test"))
+    t3 = tm.get_task("train", worker_id=0)
+    assert t3.task_id >= 0, "lease was not re-queued"

@@ -4,174 +4,21 @@ The full distributed stack on one machine (SURVEY.md §4's
 multi-node-without-a-cluster tier): a standalone master process, two
 launcher/agent processes that rendezvous through it, and two worker
 processes forming a real 2-process jax.distributed cluster over CPU.
+Process plumbing lives in elastic_harness.py (shared with the
+slice-grain elasticity drill).
 """
 
 import os
-import re
-import subprocess
-import sys
 import time
 
-import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _env(run_id, extra=None):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update(
-        {
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "",  # workers: 1 local CPU device each
-            "DLROVER_TPU_RUN_ID": run_id,
-            "DLROVER_TPU_HOST_ADDR": "localhost",
-        }
-    )
-    if extra:
-        env.update(extra)
-    return env
-
-
-def _drain(proc):
-    """Pump a process's merged stdout into a queue from a daemon thread:
-    keeps the ~64KB pipe from backpressure-blocking the producer while
-    the test waits on OTHER processes, and lets readers enforce real
-    deadlines (a blocking readline would only re-check its deadline
-    between lines)."""
-    import queue as queue_mod
-    import threading
-
-    q = queue_mod.Queue()
-
-    def run():
-        for line in proc.stdout:
-            q.put(line)
-        q.put(None)
-
-    threading.Thread(target=run, daemon=True).start()
-    return q
-
-
-def _kill_tree(proc):
-    """SIGKILL a launched agent AND its worker children (they share the
-    process group because we launch with start_new_session=True).
-
-    Safe to call even after the leader was reaped: Linux keeps the pid
-    number reserved while it is still the pgid of any live member, so
-    killpg either hits OUR group (reaping a crashed leader's orphaned
-    workers — the case this exists for) or raises ProcessLookupError
-    once the whole group is gone."""
-    import signal
-
-    if proc is None:
-        return
-    try:
-        os.killpg(proc.pid, signal.SIGKILL)
-    except (ProcessLookupError, PermissionError):
-        if proc.poll() is None:
-            proc.kill()
-
-
-def _drain_now(q, lines):
-    """Pull whatever is already queued, non-blocking (for diagnostics)."""
-    import queue as queue_mod
-
-    while True:
-        try:
-            line = q.get_nowait()
-        except queue_mod.Empty:
-            return
-        if line is None:
-            return
-        lines.append(line)
-
-
-def _start_master(run_id, argv_extra=(), env_extra=None):
-    """Spawn dlrover_tpu.master.main, return (proc, queue, lines, addr)."""
-    master = subprocess.Popen(
-        [
-            sys.executable,
-            "-m",
-            "dlrover_tpu.master.main",
-            "--port",
-            "0",
-            *argv_extra,
-        ],
-        cwd=REPO,
-        env=_env(run_id, env_extra),
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-    )
-    q = _drain(master)
-    lines = []
-    addr_line = _collect(
-        q,
-        lines,
-        until=lambda l: l.startswith("DLROVER_TPU_MASTER_ADDR="),
-        deadline=time.time() + 60,
-    )
-    assert addr_line, "master did not print its address"
-    addr = re.match(
-        r"DLROVER_TPU_MASTER_ADDR=(.+)", addr_line.strip()
-    ).group(1)
-    return master, q, lines, addr
-
-
-def _launch_agent(run_id, node_id, addr, train_args, agent_args=(),
-                  nnodes="1:2"):
-    """Spawn a launcher+worker process group for one node."""
-    return subprocess.Popen(
-        [
-            sys.executable,
-            "-m",
-            "dlrover_tpu.agent.launcher",
-            "--nnodes",
-            nnodes,
-            "--node-id",
-            str(node_id),
-            "--nproc",
-            "1",
-            *agent_args,
-            "--master-addr",
-            addr,
-            "--",
-            sys.executable,
-            "examples/train_gpt_elastic.py",
-            *train_args,
-        ],
-        cwd=REPO,
-        env=_env(
-            f"{run_id}_n{node_id}",
-            {"DLROVER_TPU_COORDINATOR_PORT": "0"},
-        ),
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        start_new_session=True,
-    )
-
-
-def _collect(q, lines, until, deadline, on_line=None):
-    """Consume queued lines until ``until(line)`` or EOF/deadline.
-    Returns the matching line or None."""
-    import queue as queue_mod
-
-    while time.time() < deadline:
-        try:
-            line = q.get(timeout=0.2)
-        except queue_mod.Empty:
-            continue
-        if line is None:
-            return None
-        lines.append(line)
-        if on_line:
-            on_line(line)
-        if until(line):
-            return line
-    return None
-
+from elastic_harness import (
+    collect as _collect,
+    drain as _drain,
+    drain_now as _drain_now,
+    kill_tree as _kill_tree,
+    launch_agent as _launch_agent,
+    start_master as _start_master,
+)
 
 def test_world_shrink_resharded_recovery(tmp_path):
     """The composed elasticity path (SURVEY §7 hard part #1): 2-node

@@ -1,0 +1,220 @@
+"""Slice-grain elasticity drill (VERDICT r4 ask #1b).
+
+The production TPU topology is DCN-connected slices, and the elastic
+unit is a WHOLE slice (a partial slice has no ICI to the rest —
+SURVEY.md §7, cluster/scaler.py, rdzv node_unit). This drill runs the
+real process stack — a master plus one launcher/agent group per
+emulated slice, each worker process driving TWO local devices (a TPU VM
+with locally-attached chips) — and proves:
+
+1. kill the whole of slice 1 (its agent process group) → the master
+   re-seals a 1-slice world and the survivor re-meshes from
+   num_slices=2 (dp across DCN, fsdp intra-slice) to num_slices=1,
+   restoring the 2-slice checkpoint RESHARDED onto the 1-slice mesh
+   from the emergency-persisted host packs;
+2. recovery (crash → resumed-from-ckpt) fits the <60 s budget;
+3. a replacement slice joining mid-run re-meshes BACK to num_slices=2
+   and resumes from the shrunk world's progress — the grow half;
+4. loss continuity: every resume starts at-or-past the prior
+   checkpointed step (never from scratch) and the loss improves across
+   the whole shrink/grow.
+
+The worker (examples/train_gpt_elastic.py --hosts-per-slice 1) rebuilds
+its hybrid multi-slice mesh (parallel/mesh.py num_slices) from the
+CURRENT world on every restart. Whole-slice sealing at the rendezvous
+level (a partial slice is never sealed, node_unit truncation) is pinned
+separately in test_master.py::test_node_unit_rendezvous_seals_whole_slices,
+and the scaler's whole-slice snap in test_kube.py / test_cluster.py —
+this drill is the training-side re-mesh those guarantees feed.
+"""
+
+import os
+import re
+import time
+
+from elastic_harness import (
+    collect,
+    drain,
+    drain_now,
+    kill_tree,
+    launch_agent,
+    start_master,
+)
+
+# each host (= agent = emulated slice) drives 2 local CPU devices
+CHIPS_PER_HOST = 2
+HOST_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+
+
+def test_slice_shrink_grow_elasticity(tmp_path):
+    run_id = f"se{os.getpid()}"
+    master, master_q, master_lines, addr = start_master(
+        run_id,
+        argv_extra=("--num-workers", "1", "--max-workers", "2"),
+        # short grace: the post-crash re-seal (the recovery critical
+        # path) waits this long for the lost slice before shrinking
+        env_extra={"DLROVER_TPU_CTX_RDZV_WAIT_EXTRA_NODES_S": "3"},
+    )
+    # --steps 60 is pure runway: the test tears down after the grown
+    # world commits a joint checkpoint (running to dataset completion
+    # would race the joiner's cold start against the shrunk world's
+    # cached ~1 s steps — timing-fragile under CI contention, same
+    # reasoning as test_world_grow_joins_mid_run)
+    train_args = (
+        "--steps", "60", "--batch", "4", "--seq", "32",
+        "--hosts-per-slice", "1",
+        "--ckpt-dir", str(tmp_path / "ckpt"),
+        "--ckpt-every", "2",
+    )
+    agents = {}
+    queues = {}
+    lines = {}
+
+    def spawn(node_id, max_restarts, crash_at=None):
+        extra = ("--crash-at", str(crash_at)) if crash_at else ()
+        agents[node_id] = launch_agent(
+            run_id, node_id, addr, train_args + extra,
+            agent_args=("--max-restarts", str(max_restarts)),
+            nnodes="1:2",
+            env_extra=HOST_ENV,
+        )
+        queues[node_id] = drain(agents[node_id])
+        lines.setdefault(node_id, [])
+
+    def dump(nid):
+        drain_now(queues[nid], lines[nid])
+        return "".join(lines[nid])
+
+    try:
+        # slice 0 = host 0 (survivor, restart budget); slice 1 = host 1
+        # (the doomed slice: after the synchronized crash at step 3 it
+        # leaves the job for good)
+        spawn(0, max_restarts=2, crash_at=3)
+        spawn(1, max_restarts=0, crash_at=3)
+
+        # ---- phase 1: 2 slices × 2 chips training ----------------------
+        assert collect(
+            queues[0], lines[0],
+            until=lambda l: "slice mesh: num_slices=2" in l,
+            deadline=time.time() + 300,
+        ), dump(0)[-3000:]
+        assert collect(
+            queues[1], lines[1],
+            until=lambda l: "simulating crash at step 3" in l,
+            deadline=time.time() + 300,
+        ), dump(1)[-3000:]
+        t_crash = time.time()
+        agents[1].wait(timeout=180)
+        assert agents[1].returncode != 0
+
+        # ---- phase 2: shrink to 1 slice, resharded restore -------------
+        stamps = {}
+
+        def watch_resume(line):
+            if "resumed from step" in line and "resumed" not in stamps:
+                stamps["resumed"] = time.time()
+
+        shrunk = collect(
+            queues[0], lines[0],
+            until=lambda l: "slice mesh: num_slices=1" in l,
+            deadline=time.time() + 300,
+            on_line=watch_resume,
+        )
+        assert shrunk, dump(0)[-4000:]
+        resumed = collect(
+            queues[0], lines[0],
+            until=lambda l: "resumed from step" in l,
+            deadline=time.time() + 180,
+            on_line=watch_resume,
+        )
+        assert resumed, dump(0)[-4000:]
+        # continuity: resumed from the step-2 checkpoint, not step 0
+        assert "resumed from step 2" in resumed, resumed
+        recovery_s = stamps["resumed"] - t_crash
+        assert recovery_s < 60.0, f"recovery took {recovery_s:.1f}s"
+
+        # let the shrunk world make real progress before growing
+        assert collect(
+            queues[0], lines[0],
+            until=lambda l: re.search(r"step=[4-9] ", l),
+            deadline=time.time() + 240,
+        ), dump(0)[-4000:]
+
+        # ---- phase 3: a replacement slice joins — grow back ------------
+        # (no --crash-at: the replacement is a healthy fresh host)
+        spawn(1, max_restarts=2)
+
+        # the grow is proven once the re-meshed 2-slice world RESUMES
+        # from a checkpoint and then commits a joint one ("(2 hosts)").
+        # Generous deadline: on a loaded 1-core box the joiner's cold
+        # process start alone can take many minutes.
+        saw = {}
+
+        def watch_grow(line):
+            if "slice mesh: num_slices=1" in line:
+                saw["shrunk_mesh"] = True
+            elif "slice mesh: num_slices=2" in line and saw.get(
+                "shrunk_mesh"
+            ):
+                saw["regrown_mesh"] = True
+            elif "resumed from step" in line and saw.get("regrown_mesh"):
+                saw["regrown_resume"] = True
+
+        for line in lines[0]:
+            watch_grow(line)
+        joint = collect(
+            queues[0], lines[0],
+            until=lambda l: "(2 hosts)" in l and "regrown_resume" in saw,
+            deadline=time.time() + 900,
+            on_line=watch_grow,
+        )
+        if joint is None:
+            drain_now(master_q, master_lines)
+            raise AssertionError(
+                "no joint checkpoint after grow "
+                f"(agent0 rc={agents[0].poll()} "
+                f"agent1 rc={agents[1].poll()} saw={saw}):\n"
+                "--- host 0 ---\n"
+                + dump(0)[-4000:]
+                + "\n--- host 1 (joiner) ---\n"
+                + dump(1)[-2000:]
+                + "\n--- master ---\n"
+                + "".join(master_lines)[-2000:]
+            )
+        out0 = dump(0)
+
+        # phase 1 really ran 2 slices × 2 chips as one SPMD job
+        assert "4 global devices" in out0, out0[-4000:]
+        # the shrunk world re-meshed to one slice over 2 local chips
+        # (a single surviving host runs without jax.distributed, so the
+        # mesh line is the evidence: dp collapsed to 1, fsdp kept the
+        # intra-slice pair)
+        assert "slice mesh: num_slices=1 dp=1 fsdp=2" in out0, (
+            out0[-4000:]
+        )
+        # the grown world re-meshed BACK to two slices
+        assert out0.rindex("slice mesh: num_slices=2") > out0.index(
+            "slice mesh: num_slices=1"
+        ), out0[-4000:]
+        # continuity across the grow too: every resume is at-or-past the
+        # first checkpoint, never from scratch
+        resumes = [
+            int(m) for m in re.findall(r"resumed from step (\d+)", out0)
+        ]
+        assert resumes and resumes[0] == 2, resumes
+        assert all(r >= 2 for r in resumes), resumes
+        # loss improves across the whole drill
+        losses = [float(x) for x in re.findall(r"loss=([\d.]+)", out0)]
+        assert len(losses) >= 10, out0[-3000:]
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+        print(
+            f"\n[slice-elasticity] 2-slice→1-slice recovery: "
+            f"{recovery_s:.1f}s (crash → resumed, resharded "
+            f"dp2·fsdp2→dp1·fsdp2); grow re-meshed back to 2 slices; "
+            f"final loss {losses[-1]:.3f} < first {losses[0]:.3f}"
+        )
+    finally:
+        for proc in agents.values():
+            kill_tree(proc)
+        master.kill()
+        master.wait()
