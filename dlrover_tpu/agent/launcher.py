@@ -66,6 +66,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         "(reference: dlrover-run --exclude-straggler)",
     )
     p.add_argument("--node-unit", type=int, default=1)
+    p.add_argument(
+        "--compile-cache-dir",
+        default="",
+        help="persistent XLA compile-cache dir for workers (e.g. a "
+        "job-shared NFS path); default: a private per-user dir under "
+        "/tmp — restarts with an already-seen mesh shape skip the "
+        "recompile",
+    )
     p.add_argument("--monitor-interval", type=float, default=2.0)
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -176,6 +184,7 @@ def run(args: argparse.Namespace) -> int:
         comm_perf_test=args.comm_perf_test,
         exclude_straggler=args.exclude_straggler,
         node_unit=args.node_unit,
+        compile_cache_dir=args.compile_cache_dir,
         entrypoint=args.entrypoint,
     )
     config.auto_configure()

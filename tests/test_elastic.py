@@ -158,6 +158,124 @@ def test_sampler_short_tail_pads_equally():
     assert len(set(counts)) == 1 and counts[0] >= 1
 
 
+def test_compile_cache_dir_from_job_config(monkeypatch):
+    """--compile-cache-dir (job config) overrides the per-user default
+    AND an inherited operator env — the job's declared cache location
+    must win everywhere (e.g. shared NFS so replacement hosts hit it)."""
+    from dlrover_tpu.agent.agent import (
+        ElasticLaunchConfig,
+        ElasticTrainingAgent,
+    )
+    from dlrover_tpu.agent.launcher import parse_args
+    from dlrover_tpu.agent.rendezvous import RendezvousOutcome
+
+    args = parse_args(
+        ["--compile-cache-dir", "/mnt/job-cache", "--", "python", "t.py"]
+    )
+    assert args.compile_cache_dir == "/mnt/job-cache"
+
+    class _T:
+        addr = "localhost:1"
+
+    class _Client:
+        _t = _T()
+        node_rank = 0
+
+    agent = ElasticTrainingAgent(
+        ElasticLaunchConfig(compile_cache_dir="/mnt/job-cache"), _Client()
+    )
+    outcome = RendezvousOutcome(
+        round=1, world={0: 1}, coordinator="localhost:7010",
+        process_id=0, num_processes=1, global_chips=1,
+    )
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/operator-env")
+    env = agent._worker_env(outcome)
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/mnt/job-cache"
+    assert env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "1"
+
+
+_CACHE_STEP_SCRIPT = """
+import json, os, sys, time
+import jax, jax.numpy as jnp
+sys.path.insert(0, os.environ["DLROVER_TPU_TEST_REPO"])
+from dlrover_tpu.models import get_config
+from dlrover_tpu.parallel import MeshConfig, build_mesh
+from dlrover_tpu.train import (
+    TrainStepBuilder, batch_sharding, init_train_state, make_optimizer,
+)
+
+mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+cfg = get_config(
+    "tiny", n_layer=2, d_model=64, d_ff=128, n_head=4,
+    vocab_size=256, max_seq=64,
+)
+opt = make_optimizer(learning_rate=1e-3, warmup_steps=2, decay_steps=10)
+state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+step = TrainStepBuilder(cfg, mesh, opt).build()
+tokens = jnp.zeros((8, 64), dtype=jnp.int32)
+batch = jax.device_put(
+    {"tokens": tokens, "targets": tokens}, batch_sharding(mesh)
+)
+t0 = time.time()
+state, metrics = step(state, batch)
+loss = float(metrics["loss"])
+print(json.dumps({"loss": loss, "step_wall_s": time.time() - t0}))
+"""
+
+
+def test_restart_hits_persistent_compile_cache(tmp_path):
+    """The re-mesh recovery story end-to-end (VERDICT r4 ask #2): the
+    SAME sharded train step run in two fresh subprocesses against a
+    shared cache dir — the first populates the cache, the second adds
+    ZERO new entries (pure deserialization, i.e. a restart does not pay
+    the compile again)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache = tmp_path / "jit-cache"
+    cache.mkdir()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PYTHONPATH", None)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "JAX_COMPILATION_CACHE_DIR": str(cache),
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+            "DLROVER_TPU_TEST_REPO": repo,
+        }
+    )
+    script = _CACHE_STEP_SCRIPT
+
+    def run():
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            env=env, cwd=repo, capture_output=True, text=True,
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        import json as json_mod
+
+        return json_mod.loads(proc.stdout.strip().splitlines()[-1])
+
+    first = run()
+    entries_after_first = {
+        p.name for p in cache.rglob("*") if p.is_file()
+    }
+    assert entries_after_first, "first run populated no cache entries"
+    second = run()
+    entries_after_second = {
+        p.name for p in cache.rglob("*") if p.is_file()
+    }
+    # the restart compiled NOTHING new — every executable came from the
+    # shared cache
+    assert entries_after_second == entries_after_first
+    assert second["loss"] == pytest.approx(first["loss"], rel=1e-6)
+
+
 def test_worker_env_sets_persistent_compile_cache(monkeypatch):
     """Restarted workers must share an XLA compile cache — the re-mesh
     recovery-time lever (SURVEY §7): same-shape restarts skip recompile."""
