@@ -276,6 +276,11 @@ class FakeKubeApi(KubeApi):
         """Yield events with resource_version > since_rv (replaying the
         backlog first, like a real list-watch resuming from a listed
         resourceVersion), then block for new ones until ``stop``."""
+        if isinstance(since_rv, dict):
+            # per-kind resume tokens (RealKubeApi contract); the fake
+            # has ONE shared rv space, so the earliest token is the
+            # safe resume point (at-least-once, like a relist)
+            since_rv = min(since_rv.values(), default=0)
         stop = stop or threading.Event()
         rv = since_rv
         while not stop.is_set():
@@ -535,9 +540,18 @@ class JobReconciler:
                 # killing the operator thread.
                 logger.info("reconcile watch expired (%s); relisting", e)
                 try:
+                    # per-kind resume tokens: rvs are opaque
+                    # per-collection, so the multiplexed watch must not
+                    # resume the ScalePlan pump from the ElasticJob
+                    # collection's rv (or vice versa)
                     list_rv = getattr(self._api, "list_rv", None)
+                    kinds = getattr(
+                        self._api, "watch_kinds", ["ElasticJob"]
+                    )
                     since_rv = (
-                        list_rv("ElasticJob", self._ns) if list_rv else 0
+                        {k: list_rv(k, self._ns) for k in kinds}
+                        if list_rv
+                        else 0
                     )
                     # pending plans FIRST, oldest first (list order is
                     # lexical by name — creation order is what the

@@ -265,13 +265,20 @@ class RealKubeApi(KubeApi):
         raises WatchExpired for the caller to relist. ``kind=None``
         (the JobReconciler's all-kinds contract) fans out one
         per-collection watch per ``self.watch_kinds`` and merges the
-        streams — a real API server only watches per collection.
+        streams — a real API server only watches per collection. In
+        that mode ``since_rv`` may be a {kind: rv} mapping: k8s
+        resourceVersions are opaque PER-COLLECTION tokens, so resuming
+        every pump from one collection's rv could be rejected (410
+        loop) or mis-positioned on servers that don't share revisions
+        across types.
         """
         if kind is None:
             yield from self._watch_merged(
                 namespace, label_selector, since_rv, stop, poll_s
             )
             return
+        if isinstance(since_rv, dict):
+            since_rv = since_rv.get(kind, 0)
         stop = stop or threading.Event()
         rv = str(since_rv)  # opaque resume token, handed back verbatim
         sel = self._selector(label_selector)

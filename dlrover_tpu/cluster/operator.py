@@ -21,6 +21,7 @@ API server); this module adds what deployment needs around it —
 """
 
 import argparse
+import dataclasses
 import os
 import socket
 import threading
@@ -52,6 +53,42 @@ def master_pod_manifest(
     ``optimizeMode: cluster`` jobs get ``--optimize-mode cluster
     --brain-addr`` so the master actually consults the shared brain."""
     rs = job.spec.replica_specs.get("master")
+    if rs is not None and job.spec.optimize_mode == "cluster":
+        # a user-declared master spec is used verbatim — but its
+        # optimizeMode=cluster must not be silently ignored: append the
+        # brain flags when the command doesn't already carry them
+        if brain_addr and rs.command and (
+            "--brain-addr" not in rs.command
+        ):
+            rs = dataclasses.replace(
+                rs,
+                command=list(rs.command)
+                + ["--optimize-mode", "cluster", "--brain-addr", brain_addr],
+            )
+            logger.info(
+                "ElasticJob %s: appended --optimize-mode cluster "
+                "--brain-addr to the user-supplied master command",
+                job.name,
+            )
+        elif not brain_addr:
+            logger.warning(
+                "ElasticJob %s declares a master spec with "
+                "optimizeMode=cluster but the operator has no "
+                "--brain-addr; the master will run single-job",
+                job.name,
+            )
+        elif not rs.command:
+            # image-entrypoint master (command=[]): flags can't be
+            # appended without clobbering the entrypoint contract —
+            # don't silently ignore the optimizeMode either
+            logger.warning(
+                "ElasticJob %s: optimizeMode=cluster with an "
+                "image-entrypoint master spec (no command) — cannot "
+                "inject --brain-addr %s; configure the image to read "
+                "it, or declare an explicit command",
+                job.name,
+                brain_addr,
+            )
     if rs is None:
         worker = job.spec.replica_specs.get("worker") or ReplicaSpec()
         command = [

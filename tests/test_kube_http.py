@@ -150,6 +150,9 @@ class _KubeHandler(BaseHTTPRequestHandler):
 
     def _stream_watch(self, kind, ns, sel, raw_rv):
         getattr(self.server, "seen_watch_rvs", []).append(raw_rv)
+        getattr(self.server, "seen_watch_kind_rvs", []).append(
+            (kind, raw_rv)
+        )
         since_rv = self._rv_in(raw_rv)
         # the 410 Gone contract: honor an artificially expired window
         if getattr(self.server, "expire_below_rv", 0) > since_rv > 0:
@@ -267,6 +270,7 @@ def api_server():
     server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
     server.daemon_threads = True
     server.seen_watch_rvs = []
+    server.seen_watch_kind_rvs = []
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     yield fake, f"http://127.0.0.1:{server.server_address[1]}", server
@@ -620,3 +624,30 @@ def test_pod_watcher_survives_410_by_relisting(api_server):
     assert jm.get_node(0).relaunch_count == 1
     watcher.stop()
     jm.stop()
+
+
+def test_merged_watch_resumes_each_kind_from_its_own_rv(api_server):
+    """k8s resourceVersions are opaque PER-COLLECTION tokens: after a
+    relist, the multiplexed (kind=None) watch must hand the ElasticJob
+    pump the ElasticJob collection's rv and the ScalePlan pump the
+    ScalePlan collection's rv — never one collection's token to the
+    other's watch (ADVICE r4: a real API server may 410-loop or
+    mis-position a cross-kind token)."""
+    fake, url, server = api_server
+    api = _client(url)
+    stop = threading.Event()
+    tokens = {"ElasticJob": "ej-token-7", "ScalePlan": "sp-token-42"}
+
+    def consume():
+        for _ in api.watch(kind=None, since_rv=tokens, stop=stop):
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    _wait(
+        lambda: len(server.seen_watch_kind_rvs) >= 2,
+        msg="both pumps opened their watch",
+    )
+    stop.set()
+    opened = dict(server.seen_watch_kind_rvs[:2])
+    assert opened == tokens, server.seen_watch_kind_rvs

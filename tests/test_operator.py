@@ -199,6 +199,35 @@ def test_master_command_carries_cluster_optimize_mode():
     assert "--optimize-mode" not in pod2["spec"]["containers"][0]["command"]
 
 
+def test_user_supplied_master_spec_gets_brain_flags():
+    """A job that declares its OWN master replicaSpec must not have
+    optimizeMode=cluster silently ignored (ADVICE r4): the operator
+    appends the brain flags to the declared command — unless they are
+    already there, which is respected verbatim."""
+    from dlrover_tpu.cluster.crd import ReplicaSpec
+    from dlrover_tpu.cluster.operator import master_pod_manifest
+
+    job = _job("um", replicas=1)
+    job.spec.optimize_mode = "cluster"
+    job.spec.replica_specs["master"] = ReplicaSpec(
+        replicas=1, command=["my-master", "--port", "8600"]
+    )
+    pod = master_pod_manifest(job, brain_addr="brain.svc:8600")
+    cmd = pod["spec"]["containers"][0]["command"]
+    assert cmd[:3] == ["my-master", "--port", "8600"]
+    assert "--brain-addr" in cmd and "brain.svc:8600" in cmd
+    # the caller's spec object is not mutated
+    assert "--brain-addr" not in job.spec.replica_specs["master"].command
+    # a command already carrying the flag is left alone
+    job.spec.replica_specs["master"] = ReplicaSpec(
+        replicas=1,
+        command=["my-master", "--brain-addr", "other:1"],
+    )
+    pod2 = master_pod_manifest(job, brain_addr="brain.svc:8600")
+    cmd2 = pod2["spec"]["containers"][0]["command"]
+    assert cmd2.count("--brain-addr") == 1 and "other:1" in cmd2
+
+
 def test_elasticjob_status_reflects_pod_phases():
     """The operator writes ElasticJob.status (phase + per-replica pod
     counts — what `kubectl get elasticjobs` shows via the CRD's printer
