@@ -416,8 +416,13 @@ def _layer_body(
     if cfg.n_experts > 0:
         from dlrover_tpu.parallel.moe import moe_block
 
+        # fp8 reaches the experts as stateless current scaling (the
+        # dense/all-to-all paths; ragged stays bf16 — see moe.py);
+        # delayed-scaling state dicts cover only the attention
+        # projections in MoE layers (init_fp8_states)
         mlp_out, aux = moe_block(
-            h2, layer["moe"], cfg, mesh, rng=rng, return_aux=True
+            h2, layer["moe"], cfg, mesh, rng=rng, return_aux=True,
+            fp8=fp8,
         )
     else:
         mlp_out = _mlp_block(h2, layer, cfg, mesh, fp8=fp8)
@@ -634,14 +639,22 @@ def init_fp8_states(cfg: ModelConfig):
     per-layer stacking. Lives in the train state under ``state["fp8"]``;
     the step's gradient w.r.t. it IS the updated state (ops/fp8.py
     convention).
+
+    MoE configs: the delayed states cover the attention projections
+    only — the expert FFN GEMMs run stateless CURRENT scaling
+    (ops/fp8.py:fp8_batched_dot_current via moe.py), because per-expert
+    token routing changes which tokens each weight sees every step,
+    and a routing-dependent amax history is exactly the stale-scale
+    hazard delayed scaling is supposed to avoid.
     """
-    if cfg.n_experts > 0:
-        raise ValueError("fp8 wiring covers dense MLP layers, not MoE")
     from dlrover_tpu.ops.fp8 import init_fp8_state
 
-    mlp_names = ("gate", "up", "down") if cfg.act == "swiglu" else (
-        "up", "down"
-    )
+    if cfg.n_experts > 0:
+        mlp_names = ()
+    elif cfg.act == "swiglu":
+        mlp_names = ("gate", "up", "down")
+    else:
+        mlp_names = ("up", "down")
     names = ("wq", "wk", "wv", "wo") + mlp_names
     one = init_fp8_state()
     return {

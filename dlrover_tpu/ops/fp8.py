@@ -195,3 +195,88 @@ def _fp8_cur_fwd_vjp(x, w, native):
 
 
 fp8_dot_current.defvjp(_fp8_cur_fwd_vjp, _fp8_cur_bwd)
+
+
+# ---- batched (per-expert) current scaling --------------------------------
+
+
+def _bdot(a_q, b_q, native: bool):
+    """[E,T,D]·[E,D,F] → [E,T,F] batched over the leading expert axis."""
+    if not native:
+        a_q = a_q.astype(jnp.bfloat16)
+        b_q = b_q.astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        a_q, b_q, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _cur_scale_per_expert(t: jax.Array, fmax: float) -> jax.Array:
+    """Per-expert scale for stacked weights [E, ·, ·] → [E]: expert
+    weight magnitudes diverge as routing specializes, so one shared
+    scale would waste dynamic range on every small-weight expert."""
+    amax = jnp.maximum(
+        jnp.max(jnp.abs(t), axis=(1, 2)).astype(jnp.float32), 1e-12
+    )
+    return amax / fmax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fp8_batched_dot_current(x, w, native=None):
+    """Expert-batched ``einsum('etd,edf->etf')`` with fp8 operands and
+    CURRENT scaling — the fp8 path for MoE expert FFN GEMMs (reference:
+    TE fp8 is not dense-only, amp_optimization.py:197).
+
+    Tokens/grads use one per-tensor scale (they are one routed batch);
+    the stacked expert weights get a PER-EXPERT scale. Stateless like
+    ``fp8_dot_current``, so it composes with any mesh incl. pipeline —
+    and with the dropless ragged path being token-count-dynamic, the
+    ragged lowering intentionally stays bf16 (``lax.ragged_dot`` has no
+    scaled-fp8 lowering; quantizing there would be fake-quant cost with
+    no MXU win).
+    """
+    out, _ = _fp8_bcur_fwd(x, w, _resolve_native(native))
+    return out
+
+
+def _fp8_bcur_fwd(x, w, native):
+    sx = _cur_scale(x, E4M3_MAX)
+    sw = _cur_scale_per_expert(w, E4M3_MAX)
+    qx = quantize_fp8(x, sx, E4M3)
+    qw = quantize_fp8(w, sw[:, None, None], E4M3)
+    out = (_bdot(qx, qw, native) * (sx * sw)[:, None, None]).astype(
+        x.dtype
+    )
+    return out, (qx, qw, sx, sw,
+                 jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype))
+
+
+def _fp8_bcur_bwd(native, res, g):
+    native = _resolve_native(native)
+    qx, qw, sx, sw, xdt0, wdt0 = res
+    sg = _cur_scale(g, E5M2_MAX)
+    qg = quantize_fp8(g, sg, E5M2)
+    # dx_e = qg_e @ qw_e^T : [E,T,F]·[E,D,F] contracting F
+    dx_q = jax.lax.dot_general(
+        qg if native else qg.astype(jnp.bfloat16),
+        qw if native else qw.astype(jnp.bfloat16),
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    dx = (dx_q * (sg * sw)[:, None, None]).astype(xdt0.dtype)
+    # dw_e = qx_e^T @ qg_e : [E,T,D]·[E,T,F] contracting T
+    dw_q = jax.lax.dot_general(
+        qx if native else qx.astype(jnp.bfloat16),
+        qg if native else qg.astype(jnp.bfloat16),
+        (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    dw = (dw_q * (sx * sg)).astype(wdt0.dtype)
+    return dx, dw
+
+
+def _fp8_bcur_fwd_vjp(x, w, native):
+    return _fp8_bcur_fwd(x, w, _resolve_native(native))
+
+
+fp8_batched_dot_current.defvjp(_fp8_bcur_fwd_vjp, _fp8_bcur_bwd)
