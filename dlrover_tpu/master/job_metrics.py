@@ -127,6 +127,10 @@ class GoodputTracker:
             return 1.0
         return max(0.0, 1.0 - self.lost_seconds(ts) / wall)
 
+    def wall_seconds(self, now: Optional[float] = None) -> float:
+        ts = now if now is not None else time.time()
+        return max(0.0, ts - self._start)
+
 
 class JobMetricCollector:
     def __init__(self, max_records: int = 4096):
@@ -180,12 +184,20 @@ class JobMetricCollector:
 
     def to_json(self) -> str:
         gp = self._goodput()
+        # raw lost/wall terms let a consumer compute goodput over a
+        # WINDOW (two samples), not just since master start — the fault
+        # drill regression-gates windowed goodput this way
+        tracker = self.goodput_tracker
+        lost = tracker.lost_seconds() if tracker else None
+        wall = tracker.wall_seconds() if tracker else None
         with self._lock:
             return json.dumps(
                 {
                     "meta": asdict(self.meta),
                     "counters": dict(self.counters),
                     "goodput": gp,
+                    "goodput_lost_seconds": lost,
+                    "goodput_wall_seconds": wall,
                     "records": [asdict(r) for r in list(self.records)[-100:]],
                 }
             )

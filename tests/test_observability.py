@@ -154,7 +154,12 @@ def test_goodput_exported():
     col.goodput_tracker = GoodputTracker(now=0.0)
     col.goodput_tracker.mark_productive(now=0.0)
     assert "dlrover_tpu_goodput" in col.prometheus_text()
-    assert json.loads(col.to_json())["goodput"] is not None
+    out = json.loads(col.to_json())
+    assert out["goodput"] is not None
+    # raw terms for windowed (two-sample) goodput — the drill's
+    # regression gate computes across-failure goodput from deltas
+    assert out["goodput_lost_seconds"] >= 0.0
+    assert out["goodput_wall_seconds"] >= 0.0
 
 
 def test_metrics_export_http():
