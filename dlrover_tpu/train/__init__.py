@@ -1,7 +1,9 @@
 from dlrover_tpu.train.optimizer import make_optimizer  # noqa: F401
+from dlrover_tpu.train.prewarm import prewarm_worlds  # noqa: F401
 from dlrover_tpu.train.trainer import Trainer, TrainerArgs  # noqa: F401
 from dlrover_tpu.train.train_step import (  # noqa: F401
     TrainStepBuilder,
     batch_sharding,
     init_train_state,
+    state_shardings,
 )

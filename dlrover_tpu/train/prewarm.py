@@ -1,0 +1,181 @@
+"""Pre-warm the persistent compile cache for candidate re-mesh worlds.
+
+The re-mesh recovery story (SURVEY §7, docs/elastic_training.md): a
+SAME-shape restart hits the persistent XLA cache and recompiles
+nothing, but the FIRST restart at a new world size pays a full
+compile — at real model sizes that alone can blow the <60 s recovery
+budget. The reference never faces this (a torch restart recompiles
+nothing, elastic_agent/torch/training.py:704); an XLA framework must
+pre-pay it.
+
+This module compiles the full train step for each candidate world size
+OFF the critical path, ahead of any failure:
+
+- Compilation is **AOT** — ``jit(step).lower(abstract args).compile()``
+  over ``jax.ShapeDtypeStruct`` leaves carrying the real shardings — so
+  nothing is materialized: pre-warming a 1.5B-param world allocates no
+  parameters.
+- Each candidate world runs in its own **subprocess** pinned to that
+  world's device count, so the live training backend is never touched.
+  On CPU hosts the subprocess forces
+  ``--xla_force_host_platform_device_count``; TPU runtimes that expose
+  deviceless AOT (``jax.experimental.topologies.get_topology_desc``)
+  can compile for other slice shapes the same way — on runtimes that
+  don't, run the pre-warm before training attaches the chips (the
+  launcher fires it at job start).
+
+A warmed cache turns every re-mesh the scaler can produce into the
+same-shape-restart case: deserialize, don't compile.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_CHILD = """
+import json, os, sys
+spec = json.loads(os.environ["DLROVER_TPU_PREWARM_SPEC"])
+sys.path[:0] = spec["paths"]
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import get_config
+from dlrover_tpu.parallel import MeshConfig, build_mesh
+from dlrover_tpu.train import TrainStepBuilder, make_optimizer
+from dlrover_tpu.train.train_step import (
+    batch_sharding, init_train_state,
+)
+
+cfg = get_config(spec["model"], **spec.get("model_kw", {}))
+mesh = build_mesh(MeshConfig.from_dict(spec["mesh"]))
+opt = make_optimizer(**spec.get("opt_kw", {"learning_rate": 1e-3}))
+
+# abstract train state: same init path as the job, zero materialization
+# (eval_shape gives shapes; state_shardings re-derives the exact
+# shardings init_train_state would produce)
+from dlrover_tpu.train.train_step import state_shardings
+
+state_sh = jax.eval_shape(
+    lambda: init_train_state(jax.random.key(0), cfg, mesh, opt)
+)
+shardings = state_shardings(cfg, mesh, opt)
+state_abs = jax.tree.map(
+    lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+    state_sh, shardings,
+)
+b, s = spec["batch_size"], spec["seq"]
+bsh = batch_sharding(mesh)
+tok = jax.ShapeDtypeStruct((b, s), jnp.int32, sharding=bsh)
+batch_abs = {"tokens": tok, "targets": tok}
+
+step = TrainStepBuilder(
+    cfg, mesh, opt,
+    grad_accum=spec.get("grad_accum", 1),
+    attn_impl=spec.get("attn_impl", "auto"),
+).build()
+step.lower(state_abs, batch_abs).compile()
+print(f"prewarm ok: mesh={spec['mesh']} devices={len(jax.devices())}",
+      flush=True)
+"""
+
+
+def prewarm_worlds(
+    model: str,
+    worlds: Sequence[Dict],
+    batch_size: int,
+    seq: int,
+    *,
+    model_kw: Optional[Dict] = None,
+    opt_kw: Optional[Dict] = None,
+    grad_accum: int = 1,
+    attn_impl: str = "auto",
+    cache_dir: Optional[str] = None,
+    timeout_s: float = 1800.0,
+    background: bool = False,
+):
+    """Compile the train step for each candidate world into the cache.
+
+    ``worlds``: a list of {"n_devices": N, **mesh axis sizes} dicts —
+    one subprocess each (sequential, nice'd: pre-warming must never
+    contend with live training for cores). ``background=True`` returns
+    a started daemon thread instead of blocking.
+
+    Returns the list of world dicts that compiled successfully (or the
+    thread when ``background``).
+    """
+
+    def _run() -> List[Dict]:
+        ok = []
+        for world in worlds:
+            world = dict(world)
+            n = int(world.pop("n_devices"))
+            spec = {
+                "model": model,
+                "model_kw": model_kw or {},
+                "opt_kw": opt_kw or {"learning_rate": 1e-3},
+                "mesh": world,
+                "batch_size": batch_size,
+                "seq": seq,
+                "grad_accum": grad_accum,
+                "attn_impl": attn_impl,
+                "paths": [p for p in sys.path if p],
+            }
+            env = dict(os.environ)
+            env["DLROVER_TPU_PREWARM_SPEC"] = json.dumps(spec)
+            env["JAX_PLATFORMS"] = env.get(
+                "DLROVER_TPU_PREWARM_PLATFORM", "cpu"
+            )
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            # REPLACE (never append) the device-count flag: XLA_FLAGS
+            # feeds the persistent-cache key, so a duplicated flag
+            # string would silently produce entries the live job's key
+            # never matches
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+",
+                "",
+                env.get("XLA_FLAGS", ""),
+            ).strip()
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+            if cache_dir:
+                env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+                env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+            cmd = [sys.executable, "-c", _CHILD]
+            if os.name == "posix":
+                cmd = ["nice", "-n", "19"] + cmd
+            try:
+                proc = subprocess.run(
+                    cmd,
+                    env=env,
+                    capture_output=True,
+                    text=True,
+                    timeout=timeout_s,
+                )
+            except subprocess.TimeoutExpired:
+                logger.warning("prewarm timed out for world %s", world)
+                continue
+            if proc.returncode == 0:
+                logger.info("prewarmed compile cache for world %s", world)
+                ok.append(world)
+            else:
+                logger.warning(
+                    "prewarm failed for world %s: %s",
+                    world,
+                    (proc.stderr or "")[-2000:],
+                )
+        return ok
+
+    if background:
+        t = threading.Thread(target=_run, name="prewarm", daemon=True)
+        t.start()
+        return t
+    return _run()

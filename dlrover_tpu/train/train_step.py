@@ -105,6 +105,50 @@ def _opt_state_host_shardings(opt_shape, params, param_shardings, mesh):
     )
 
 
+def state_shardings(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    rules=None,
+):
+    """The NamedSharding tree ``init_train_state`` produces — computed
+    WITHOUT materializing anything (abstract init via eval_shape).
+
+    Exists for AOT pre-compilation (train/prewarm.py): lowering the
+    train step against ``ShapeDtypeStruct`` leaves requires the exact
+    input shardings the live job will use, or the HLO (and therefore
+    the persistent-cache key) diverges and the pre-warm buys nothing.
+    """
+    param_shardings = shd.shardings_for_tree(
+        mesh, decoder.logical_axes(cfg), rules
+    )
+    params_abs = jax.eval_shape(
+        lambda: decoder.init(jax.random.key(0), cfg)
+    )
+    opt_abs = jax.eval_shape(optimizer.init, params_abs)
+    rep = NamedSharding(mesh, P())
+    opt_sh = _map_param_subtrees(
+        opt_abs,
+        params_abs,
+        param_shardings,
+        param_leaf_fn=lambda leaf, s: (
+            jax.tree.map(lambda _: rep, leaf)
+            if _is_quantized(leaf)
+            else s
+        ),
+        other_fn=lambda sub: jax.tree.map(lambda _: rep, sub),
+    )
+    out = {
+        "params": param_shardings,
+        "opt_state": opt_sh,
+        "step": rep,
+    }
+    if cfg.fp8 and mesh.shape.get("pp", 1) == 1:
+        fp8_abs = jax.eval_shape(lambda: decoder.init_fp8_states(cfg))
+        out["fp8"] = jax.tree.map(lambda _: rep, fp8_abs)
+    return out
+
+
 def init_train_state(
     rng: jax.Array,
     cfg: ModelConfig,

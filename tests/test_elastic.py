@@ -320,3 +320,75 @@ def test_comm_perf_test_reports_bandwidth():
     # regression: sizes within a factor of device-count must not collide
     res2 = run_comm_perf_test(sizes=(1 << 16, 1 << 17))
     assert len(res2) == 2
+
+
+def test_prewarm_produces_the_exact_step_executable(tmp_path):
+    """Re-mesh pre-warming (SURVEY §7's 'pre-compile async where
+    possible'): AOT-lowering the train step for a candidate world must
+    produce the IDENTICAL persistent-cache entry the live job compiles
+    — same content key — so a later re-mesh to that world deserializes
+    instead of compiling. Proven by content-addressing: the largest
+    entry a real run writes (the train-step executable) must already
+    exist, byte-keyed, in a cache populated ONLY by prewarm."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PYTHONPATH", None)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+            "DLROVER_TPU_TEST_REPO": repo,
+        }
+    )
+
+    # NOTE: prewarm and the job must share ONE cache dir — this jax's
+    # key embeds the cache path itself (the per-fusion autotune cache
+    # dir rides in debug_options un-zeroed), so entries are only ever
+    # portable within a directory. That matches production: the agent
+    # points prewarm at the same dir it exports to workers.
+    cache = tmp_path / "cache"
+    cache.mkdir()
+
+    # 1) prewarm ONLY (AOT — no arrays materialized) for the candidate
+    #    world the job will later run at
+    from dlrover_tpu.train.prewarm import prewarm_worlds
+
+    ok = prewarm_worlds(
+        "tiny",
+        worlds=[{"n_devices": 8, "dp": 2, "fsdp": 2, "tp": 2}],
+        batch_size=8,
+        seq=64,
+        model_kw=dict(n_layer=2, d_model=64, d_ff=128, n_head=4,
+                      vocab_size=256, max_seq=64),
+        opt_kw=dict(learning_rate=1e-3, warmup_steps=2, decay_steps=10),
+        cache_dir=str(cache),
+        timeout_s=600,
+    )
+    assert ok, "prewarm subprocess failed"
+    prewarmed_steps = {
+        p.name for p in cache.rglob("*jit_step_fn*") if p.is_file()
+    }
+    assert prewarmed_steps, "prewarm produced no train-step entry"
+
+    # 2) the real job runs: its train step must be a pure cache HIT —
+    #    no new jit_step_fn entry beyond what prewarm wrote
+    env_run = dict(env, JAX_COMPILATION_CACHE_DIR=str(cache))
+    proc = subprocess.run(
+        [sys.executable, "-c", _CACHE_STEP_SCRIPT],
+        env=env_run, cwd=repo, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    steps_after = {
+        p.name for p in cache.rglob("*jit_step_fn*") if p.is_file()
+    }
+    assert steps_after == prewarmed_steps, (
+        "the live job compiled a train step the prewarm missed: "
+        f"{sorted(steps_after - prewarmed_steps)}"
+    )
