@@ -294,8 +294,24 @@ class ElasticTrainingAgent:
 
     def run(self) -> int:
         """Supervise until success, fatal failure, or restart exhaustion."""
+        # slice placement: the operator injects DLROVER_TPU_SLICE_INDEX
+        # per pod (cluster/crd.py); multislice GKE runtimes expose
+        # MEGASCALE_SLICE_ID — either way the master's SliceTopology
+        # (whole-slice scaling, rdzv node_unit) needs the real index,
+        # not a cosmetic 0
+        slice_raw = os.environ.get(
+            "DLROVER_TPU_SLICE_INDEX",
+            os.environ.get("MEGASCALE_SLICE_ID", ""),
+        )
+        try:
+            slice_index = int(slice_raw)
+        except ValueError:
+            slice_index = 0
         self.client.register_node(
-            local_chips=self.config.local_chips, tpu_type=_local_tpu_type()
+            local_chips=self.config.local_chips,
+            tpu_type=_local_tpu_type(),
+            slice_id=os.environ.get("DLROVER_TPU_SLICE_ID", slice_raw),
+            slice_index=slice_index,
         )
         self._start_heartbeats()
         self._initialize_worker()

@@ -545,3 +545,45 @@ def test_worker_restart_requeues_inflight_shards():
     servicer.report(msgs.WorkerRestartReport(node_id=0, reason="test"))
     t3 = tm.get_task("train", worker_id=0)
     assert t3.task_id >= 0, "lease was not re-queued"
+
+
+def test_agent_registration_carries_slice_placement(monkeypatch):
+    """The operator injects DLROVER_TPU_SLICE_INDEX per pod and GKE
+    multislice exposes MEGASCALE_SLICE_ID; the agent must forward the
+    real placement so the master's SliceTopology (whole-slice scaling,
+    rdzv node_unit) isn't a cosmetic all-zeros map."""
+    from dlrover_tpu.agent.agent import (
+        ElasticLaunchConfig,
+        ElasticTrainingAgent,
+    )
+
+    seen = {}
+
+    class _T:
+        addr = "localhost:1"
+
+    class _Client:
+        _t = _T()
+        node_rank = 0
+
+        def register_node(self, **kw):
+            seen.update(kw)
+            raise RuntimeError("stop after register")  # end run() early
+
+    monkeypatch.setenv("DLROVER_TPU_SLICE_INDEX", "3")
+    monkeypatch.setenv("DLROVER_TPU_SLICE_ID", "slice-3")
+    agent = ElasticTrainingAgent(ElasticLaunchConfig(), _Client())
+    with pytest.raises(RuntimeError):
+        agent.run()
+    assert seen["slice_index"] == 3
+    assert seen["slice_id"] == "slice-3"
+
+    # GKE multislice fallback
+    seen.clear()
+    monkeypatch.delenv("DLROVER_TPU_SLICE_INDEX")
+    monkeypatch.delenv("DLROVER_TPU_SLICE_ID")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    agent2 = ElasticTrainingAgent(ElasticLaunchConfig(), _Client())
+    with pytest.raises(RuntimeError):
+        agent2.run()
+    assert seen["slice_index"] == 1
