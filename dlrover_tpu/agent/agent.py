@@ -306,6 +306,12 @@ class ElasticTrainingAgent:
         try:
             slice_index = int(slice_raw)
         except ValueError:
+            logger.warning(
+                "malformed slice index %r in the environment; "
+                "registering as slice 0 — whole-slice scaling will "
+                "treat this host as slice 0's",
+                slice_raw,
+            )
             slice_index = 0
         self.client.register_node(
             local_chips=self.config.local_chips,

@@ -16,13 +16,18 @@ OFF the critical path, ahead of any failure:
   nothing is materialized: pre-warming a 1.5B-param world allocates no
   parameters.
 - Each candidate world runs in its own **subprocess** pinned to that
-  world's device count, so the live training backend is never touched.
-  On CPU hosts the subprocess forces
-  ``--xla_force_host_platform_device_count``; TPU runtimes that expose
-  deviceless AOT (``jax.experimental.topologies.get_topology_desc``)
-  can compile for other slice shapes the same way — on runtimes that
-  don't, run the pre-warm before training attaches the chips (the
-  launcher fires it at job start).
+  world's device count (``--xla_force_host_platform_device_count`` on
+  the host platform), so the live training backend is never touched.
+
+Call it from the training script at job start (typically
+``background=True`` right after the first rendezvous) — the framework
+cannot fire it for you, because only the script knows the model and
+optimizer configuration the cache keys derive from. The prewarm
+children MUST share the workers' cache dir AND platform: cache keys
+embed XLA flags and the backend, so host-platform prewarm entries only
+serve host-platform jobs. On TPU hosts run the candidates before
+training attaches the chips, or accept that only the host-platform
+fallback path is warmed.
 
 A warmed cache turns every re-mesh the scaler can produce into the
 same-shape-restart case: deserialize, don't compile.
@@ -50,26 +55,19 @@ import jax.numpy as jnp
 from dlrover_tpu.models import get_config
 from dlrover_tpu.parallel import MeshConfig, build_mesh
 from dlrover_tpu.train import TrainStepBuilder, make_optimizer
-from dlrover_tpu.train.train_step import (
-    batch_sharding, init_train_state,
-)
+from dlrover_tpu.train.train_step import batch_sharding
 
 cfg = get_config(spec["model"], **spec.get("model_kw", {}))
 mesh = build_mesh(MeshConfig.from_dict(spec["mesh"]))
 opt = make_optimizer(**spec.get("opt_kw", {"learning_rate": 1e-3}))
 
-# abstract train state: same init path as the job, zero materialization
-# (eval_shape gives shapes; state_shardings re-derives the exact
-# shardings init_train_state would produce)
-from dlrover_tpu.train.train_step import state_shardings
+# abstract train state: exact shapes AND shardings of the live job's
+# init, zero materialization, one trace
+from dlrover_tpu.train.train_step import abstract_train_state
 
-state_sh = jax.eval_shape(
-    lambda: init_train_state(jax.random.key(0), cfg, mesh, opt)
-)
-shardings = state_shardings(cfg, mesh, opt)
-state_abs = jax.tree.map(
-    lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-    state_sh, shardings,
+state_abs = abstract_train_state(
+    cfg, mesh, opt,
+    offload_opt_state=spec.get("offload_opt_state", False),
 )
 b, s = spec["batch_size"], spec["seq"]
 bsh = batch_sharding(mesh)
@@ -80,6 +78,7 @@ step = TrainStepBuilder(
     cfg, mesh, opt,
     grad_accum=spec.get("grad_accum", 1),
     attn_impl=spec.get("attn_impl", "auto"),
+    offload_opt_state=spec.get("offload_opt_state", False),
 ).build()
 step.lower(state_abs, batch_abs).compile()
 print(f"prewarm ok: mesh={spec['mesh']} devices={len(jax.devices())}",
@@ -97,6 +96,7 @@ def prewarm_worlds(
     opt_kw: Optional[Dict] = None,
     grad_accum: int = 1,
     attn_impl: str = "auto",
+    offload_opt_state: bool = False,
     cache_dir: Optional[str] = None,
     timeout_s: float = 1800.0,
     background: bool = False,
@@ -108,14 +108,14 @@ def prewarm_worlds(
     contend with live training for cores). ``background=True`` returns
     a started daemon thread instead of blocking.
 
-    Returns the list of world dicts that compiled successfully (or the
-    thread when ``background``).
+    Returns the (original) world dicts that compiled successfully (or
+    the thread when ``background``).
     """
 
     def _run() -> List[Dict]:
         ok = []
-        for world in worlds:
-            world = dict(world)
+        for orig_world in worlds:
+            world = dict(orig_world)
             n = int(world.pop("n_devices"))
             spec = {
                 "model": model,
@@ -126,6 +126,7 @@ def prewarm_worlds(
                 "seq": seq,
                 "grad_accum": grad_accum,
                 "attn_impl": attn_impl,
+                "offload_opt_state": offload_opt_state,
                 "paths": [p for p in sys.path if p],
             }
             env = dict(os.environ)
@@ -137,15 +138,20 @@ def prewarm_worlds(
             # REPLACE (never append) the device-count flag: XLA_FLAGS
             # feeds the persistent-cache key, so a duplicated flag
             # string would silently produce entries the live job's key
-            # never matches
-            flags = re.sub(
-                r"--xla_force_host_platform_device_count=\d+",
-                "",
-                env.get("XLA_FLAGS", ""),
-            ).strip()
-            env["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n}"
-            ).strip()
+            # never matches. Only the host platform honors it — on a
+            # real accelerator platform the child can only compile for
+            # the devices it actually has, so leave XLA_FLAGS alone
+            # (the live job carries none of this flag either).
+            if env["JAX_PLATFORMS"] == "cpu":
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+",
+                    "",
+                    env.get("XLA_FLAGS", ""),
+                ).strip()
+                env["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={n}"
+                ).strip()
             if cache_dir:
                 env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
                 env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
@@ -165,7 +171,7 @@ def prewarm_worlds(
                 continue
             if proc.returncode == 0:
                 logger.info("prewarmed compile cache for world %s", world)
-                ok.append(world)
+                ok.append(orig_world)
             else:
                 logger.warning(
                     "prewarm failed for world %s: %s",

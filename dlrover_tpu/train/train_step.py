@@ -105,19 +105,27 @@ def _opt_state_host_shardings(opt_shape, params, param_shardings, mesh):
     )
 
 
-def state_shardings(
+def abstract_train_state(
     cfg: ModelConfig,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     rules=None,
+    offload_opt_state: bool = False,
 ):
-    """The NamedSharding tree ``init_train_state`` produces — computed
-    WITHOUT materializing anything (abstract init via eval_shape).
+    """``ShapeDtypeStruct`` tree matching ``init_train_state``'s output
+    — shapes AND shardings — without materializing anything.
 
     Exists for AOT pre-compilation (train/prewarm.py): lowering the
-    train step against ``ShapeDtypeStruct`` leaves requires the exact
-    input shardings the live job will use, or the HLO (and therefore
-    the persistent-cache key) diverges and the pre-warm buys nothing.
+    train step against abstract leaves requires the exact input
+    shardings the live job will use, or the HLO (and therefore the
+    persistent-cache key) diverges and the pre-warm buys nothing.
+
+    ``offload_opt_state`` mirrors init's host-offload branch (moments
+    born with pinned_host memory kinds). Low-bit (int8/int4) optimizer
+    states are NOT supported: init leaves their quantized innards
+    unconstrained (compiler-chosen shardings), which an AOT caller
+    cannot reproduce deterministically — raise rather than silently
+    pre-warm a key the live job will never hit.
     """
     param_shardings = shd.shardings_for_tree(
         mesh, decoder.logical_axes(cfg), rules
@@ -126,27 +134,62 @@ def state_shardings(
         lambda: decoder.init(jax.random.key(0), cfg)
     )
     opt_abs = jax.eval_shape(optimizer.init, params_abs)
+    if any(_is_quantized(leaf) for leaf in jax.tree.leaves(
+            opt_abs, is_leaf=_is_quantized)):
+        raise NotImplementedError(
+            "abstract_train_state: low-bit optimizer states carry "
+            "compiler-chosen shardings the AOT path cannot reproduce"
+        )
     rep = NamedSharding(mesh, P())
-    opt_sh = _map_param_subtrees(
-        opt_abs,
-        params_abs,
-        param_shardings,
-        param_leaf_fn=lambda leaf, s: (
-            jax.tree.map(lambda _: rep, leaf)
-            if _is_quantized(leaf)
-            else s
-        ),
-        other_fn=lambda sub: jax.tree.map(lambda _: rep, sub),
-    )
-    out = {
+    if offload_opt_state and jax.default_backend() != "cpu":
+        opt_sh = _opt_state_host_shardings(
+            opt_abs, params_abs, param_shardings, mesh
+        )
+    else:
+        opt_sh = _map_param_subtrees(
+            opt_abs,
+            params_abs,
+            param_shardings,
+            param_leaf_fn=lambda leaf, s: s,
+            other_fn=lambda sub: jax.tree.map(lambda _: rep, sub),
+        )
+    sh = {
         "params": param_shardings,
         "opt_state": opt_sh,
         "step": rep,
     }
+    shapes = {
+        "params": params_abs,
+        "opt_state": opt_abs,
+        "step": jax.ShapeDtypeStruct((), jnp.int32),
+    }
     if cfg.fp8 and mesh.shape.get("pp", 1) == 1:
         fp8_abs = jax.eval_shape(lambda: decoder.init_fp8_states(cfg))
-        out["fp8"] = jax.tree.map(lambda _: rep, fp8_abs)
-    return out
+        sh["fp8"] = jax.tree.map(lambda _: rep, fp8_abs)
+        shapes["fp8"] = fp8_abs
+    return jax.tree.map(
+        lambda s, h: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=h),
+        shapes,
+        sh,
+    )
+
+
+def state_shardings(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    rules=None,
+    offload_opt_state: bool = False,
+):
+    """The NamedSharding tree ``init_train_state`` produces (see
+    ``abstract_train_state``, of which this is the shardings-only
+    view)."""
+    return jax.tree.map(
+        lambda a: a.sharding,
+        abstract_train_state(
+            cfg, mesh, optimizer, rules, offload_opt_state
+        ),
+    )
 
 
 def init_train_state(

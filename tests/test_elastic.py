@@ -322,7 +322,7 @@ def test_comm_perf_test_reports_bandwidth():
     assert len(res2) == 2
 
 
-def test_prewarm_produces_the_exact_step_executable(tmp_path):
+def test_prewarm_produces_the_exact_step_executable(tmp_path, monkeypatch):
     """Re-mesh pre-warming (SURVEY §7's 'pre-compile async where
     possible'): AOT-lowering the train step for a candidate world must
     produce the IDENTICAL persistent-cache entry the live job compiles
@@ -335,6 +335,15 @@ def test_prewarm_produces_the_exact_step_executable(tmp_path):
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # pin the AMBIENT env too: prewarm_worlds builds its child env from
+    # os.environ, and cache keys embed XLA flags — an ambient
+    # --xla_dump_to (common while debugging) would make the two
+    # children's keys diverge for reasons unrelated to prewarm
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    monkeypatch.delenv("DLROVER_TPU_PREWARM_PLATFORM", raising=False)
+    monkeypatch.setenv("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.pop("PYTHONPATH", None)
