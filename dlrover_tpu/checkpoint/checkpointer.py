@@ -77,13 +77,24 @@ class Checkpointer:
         target: Any,
         shardings: Any = None,
         step: Optional[int] = None,
+        partial: bool = False,
     ) -> Optional[Any]:
         """Restore into ``target``'s structure; shm-first, storage fallback.
 
         ``shardings`` may describe a *different* mesh than the one the
         checkpoint was saved under — the pack format reshard-restores.
+
+        ``partial=True``: leaves missing from the checkpoint keep the
+        target's values — pass the LIVE freshly-initialized state (not
+        a template) as ``target``. This is the state-tree-upgrade path:
+        e.g. resuming a pre-round-4 fp8 checkpoint whose state lacks
+        the attention-projection amax histories re-initializes just
+        those (they re-warm within AMAX_HISTORY steps) instead of
+        failing the whole restore.
         """
-        return self.engine.load(target, shardings=shardings, step=step)
+        return self.engine.load(
+            target, shardings=shardings, step=step, partial=partial
+        )
 
     def latest_committed_step(self) -> Optional[int]:
         return read_tracker(self.ckpt_dir, self.engine._storage)

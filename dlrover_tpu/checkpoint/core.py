@@ -303,12 +303,20 @@ def restore_tree(
     target: Any,
     pack_index: PackIndex,
     shardings: Any = None,
+    partial: bool = False,
 ) -> Any:
     """Build a pytree of (sharded) jax arrays matching ``target``'s structure.
 
     ``target`` is a pytree of ShapeDtypeStruct/arrays providing structure;
     ``shardings`` an optional matching pytree of NamedSharding for the NEW
     mesh — this is the resharded-restore path after an elastic re-election.
+
+    ``partial=True``: leaves MISSING from the pack keep the target's
+    value — the forward-compatibility path for state trees that grew
+    since the checkpoint (new fp8 amax slots, new optimizer state).
+    The target must then carry CONCRETE arrays (the freshly initialized
+    live state, not a ShapeDtypeStruct template) so there is a value to
+    keep; an abstract target with a missing leaf still raises.
     """
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(target)
     shard_leaves = (
@@ -317,8 +325,25 @@ def restore_tree(
         else [None] * len(leaves_with_path)
     )
     out = []
+    kept = []
     for (path, leaf), sharding in zip(leaves_with_path, shard_leaves):
         pstr = _path_str(path)
+        if partial and pstr not in pack_index._meta:
+            if not hasattr(leaf, "addressable_shards") and not isinstance(
+                leaf, (np.ndarray, jax.Array)
+            ):
+                raise KeyError(
+                    f"partial restore: {pstr} is missing from the "
+                    "checkpoint and the target leaf is abstract — pass "
+                    "the live initialized state as target"
+                )
+            kept.append(pstr)
+            out.append(
+                leaf
+                if sharding is None
+                else jax.device_put(leaf, sharding)
+            )
+            continue
         gshape = pack_index.global_shape(pstr)
         # restore into the TARGET's dtype: a precision change between
         # save and restore (bf16 run resumed in f32, or vice versa) must
@@ -342,4 +367,14 @@ def restore_tree(
                 ).astype(dt, copy=False),
             )
             out.append(arr)
+    if kept:
+        from dlrover_tpu.common.log import get_logger
+
+        get_logger(__name__).warning(
+            "partial restore: %d leaves not in the checkpoint kept "
+            "their fresh values (first: %s) — expected after a "
+            "state-tree upgrade (e.g. new fp8 slots), NOT for params",
+            len(kept),
+            kept[0],
+        )
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -179,17 +179,22 @@ class CheckpointEngine:
         target: Any,
         shardings: Any = None,
         step: Optional[int] = None,
+        partial: bool = False,
     ) -> Optional[Any]:
-        """Restore: shm if fresh, else committed storage. None if nothing."""
-        state = self._load_from_memory(target, shardings, step)
-        if state is not None:
-            return state
-        state = self._load_from_replica(target, shardings, step)
-        if state is not None:
-            return state
-        return self.load_from_storage(target, shardings, step)
+        """Restore: shm if fresh, else committed storage. None if nothing.
 
-    def _load_from_memory(self, target, shardings, step):
+        ``partial``: leaves absent from the checkpoint keep the
+        target's (concrete) values — the state-tree-upgrade path
+        (core.restore_tree)."""
+        state = self._load_from_memory(target, shardings, step, partial)
+        if state is not None:
+            return state
+        state = self._load_from_replica(target, shardings, step, partial)
+        if state is not None:
+            return state
+        return self.load_from_storage(target, shardings, step, partial)
+
+    def _load_from_memory(self, target, shardings, step, partial=False):
         try:
             meta = self._meta.get("latest")
             if not meta:
@@ -210,7 +215,7 @@ class CheckpointEngine:
             idx = core.PackIndex()
             try:
                 idx.add_pack(memoryview(shm.buf)[: meta["used"]])
-                state = core.restore_tree(target, idx, shardings)
+                state = core.restore_tree(target, idx, shardings, partial=partial)
                 step = idx.step
                 # restore_tree copied everything to device
                 state = jax.block_until_ready(state)
@@ -230,7 +235,7 @@ class CheckpointEngine:
             logger.warning("memory restore failed", exc_info=True)
             return None
 
-    def _load_from_replica(self, target, shardings, step):
+    def _load_from_replica(self, target, shardings, step, partial=False):
         """Local shm lost (host replaced): pull our pack from a ring peer.
 
         Reference: engine.py:349 _restore_memory_from_replica.
@@ -251,14 +256,14 @@ class CheckpointEngine:
             got_step, pack = hit
             idx = core.PackIndex()
             idx.add_pack(memoryview(pack))
-            state = core.restore_tree(target, idx, shardings)
+            state = core.restore_tree(target, idx, shardings, partial=partial)
             logger.info("restored step %d from peer replica", got_step)
             return state
         except Exception:  # noqa: BLE001
             logger.warning("replica restore failed", exc_info=True)
             return None
 
-    def load_from_storage(self, target, shardings=None, step=None):
+    def load_from_storage(self, target, shardings=None, step=None, partial=False):
         from dlrover_tpu.checkpoint.storage import read_tracker
 
         step = step if step is not None else read_tracker(
@@ -278,7 +283,7 @@ class CheckpointEngine:
         for name in packs:
             mv = self._storage.mmap(os.path.join(step_dir, name))
             idx.add_pack(mv)
-        state = core.restore_tree(target, idx, shardings)
+        state = core.restore_tree(target, idx, shardings, partial=partial)
         logger.info("restored step %d from %s", step, step_dir)
         return state
 

@@ -289,3 +289,40 @@ def test_orbax_save_load_direct(tmp_path):
     save_orbax(str(tmp_path / "o"), state)
     out = load_orbax(str(tmp_path / "o"))
     np.testing.assert_array_equal(np.asarray(out["a"]), np.asarray(state["a"]))
+
+
+def test_partial_restore_keeps_fresh_leaves_for_grown_tree(tmp_path):
+    """State-tree upgrade path (ADVICE r4): a checkpoint saved BEFORE a
+    state tree grew (e.g. fp8 gaining attention-projection amax slots)
+    restores the stored leaves and keeps the live state's fresh values
+    for the new ones — instead of failing the whole restore. Params
+    must still restore exactly; an abstract template with missing
+    leaves still raises."""
+    import jax
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), use_agent=False)
+    old_state = _state()
+    assert ckpt.save_checkpoint(7, old_state, StorageType.DISK)
+    ckpt.wait_for_persist()
+
+    # the tree grew: a new subtree exists in the live state only
+    new_state = dict(old_state)
+    new_state["fp8"] = {"wq": {"amax_x": jnp.ones((16,), jnp.float32) * 3}}
+
+    out = ckpt.load_checkpoint(new_state, partial=True)
+    assert out is not None
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]),
+        np.asarray(old_state["params"]["w"]),
+    )
+    # the new leaves kept their fresh (initialized) values
+    np.testing.assert_array_equal(
+        np.asarray(out["fp8"]["wq"]["amax_x"]),
+        np.asarray(new_state["fp8"]["wq"]["amax_x"]),
+    )
+    # an abstract template cannot provide values for missing leaves
+    with pytest.raises(KeyError):
+        ckpt.load_checkpoint(state_template(new_state), partial=True)
+    # and without partial, a grown tree still fails loudly
+    with pytest.raises(KeyError):
+        ckpt.load_checkpoint(new_state)
