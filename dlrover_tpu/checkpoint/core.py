@@ -299,6 +299,15 @@ def _covers(want, shards) -> bool:
     return False
 
 
+class RestoreMismatchError(Exception):
+    """The checkpoint's leaf set does not satisfy the restore contract
+    (missing leaves without ``partial``, missing PARAM leaves, or an
+    abstract target that cannot supply fresh values). Deliberately NOT
+    a KeyError: the engine's load fallbacks swallow KeyError as
+    "no checkpoint here" — a contract violation must propagate loudly
+    instead of silently restarting training from scratch."""
+
+
 def restore_tree(
     target: Any,
     pack_index: PackIndex,
@@ -328,11 +337,24 @@ def restore_tree(
     kept = []
     for (path, leaf), sharding in zip(leaves_with_path, shard_leaves):
         pstr = _path_str(path)
-        if partial and pstr not in pack_index._meta:
-            if not hasattr(leaf, "addressable_shards") and not isinstance(
-                leaf, (np.ndarray, jax.Array)
-            ):
-                raise KeyError(
+        if pstr not in pack_index._meta:
+            if not partial:
+                raise RestoreMismatchError(
+                    f"checkpoint has no leaf {pstr} (state tree grew "
+                    "since the save?); pass partial=True with the live "
+                    "state to keep fresh values for new leaves"
+                )
+            if pstr.startswith("params"):
+                # a missing PARAM is never an upgrade — it is a rename
+                # or corruption, and silently resuming with random
+                # weights in one subtree is the worst failure mode
+                raise RestoreMismatchError(
+                    f"partial restore: param leaf {pstr} is missing "
+                    "from the checkpoint — refusing to substitute "
+                    "fresh weights"
+                )
+            if not isinstance(leaf, (np.ndarray, jax.Array)):
+                raise RestoreMismatchError(
                     f"partial restore: {pstr} is missing from the "
                     "checkpoint and the target leaf is abstract — pass "
                     "the live initialized state as target"

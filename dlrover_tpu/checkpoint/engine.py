@@ -185,14 +185,34 @@ class CheckpointEngine:
 
         ``partial``: leaves absent from the checkpoint keep the
         target's (concrete) values — the state-tree-upgrade path
-        (core.restore_tree)."""
-        state = self._load_from_memory(target, shardings, step, partial)
-        if state is not None:
-            return state
-        state = self._load_from_replica(target, shardings, step, partial)
-        if state is not None:
-            return state
-        return self.load_from_storage(target, shardings, step, partial)
+        (core.restore_tree). A tree-contract violation
+        (core.RestoreMismatchError) in the memory/replica TIERS falls
+        through (they are caches; storage is the source of truth), but
+        if no tier produces a state the mismatch re-raises rather than
+        masquerading as "no checkpoint" — a silent from-scratch restart
+        is the worst outcome of a restore bug."""
+        mismatch: Optional[core.RestoreMismatchError] = None
+        try:
+            state = self._load_from_memory(target, shardings, step, partial)
+            if state is not None:
+                return state
+        except core.RestoreMismatchError as e:
+            mismatch = e
+        try:
+            state = self._load_from_replica(
+                target, shardings, step, partial
+            )
+            if state is not None:
+                return state
+        except core.RestoreMismatchError as e:
+            mismatch = mismatch or e
+        try:
+            state = self.load_from_storage(target, shardings, step, partial)
+        except core.RestoreMismatchError as e:
+            raise e
+        if state is None and mismatch is not None:
+            raise mismatch
+        return state
 
     def _load_from_memory(self, target, shardings, step, partial=False):
         try:
@@ -231,6 +251,8 @@ class CheckpointEngine:
             return state
         except (FileNotFoundError, KeyError):
             return None
+        except core.RestoreMismatchError:
+            raise  # tree-contract violation: load() decides the fate
         except Exception:  # noqa: BLE001
             logger.warning("memory restore failed", exc_info=True)
             return None
@@ -259,6 +281,8 @@ class CheckpointEngine:
             state = core.restore_tree(target, idx, shardings, partial=partial)
             logger.info("restored step %d from peer replica", got_step)
             return state
+        except core.RestoreMismatchError:
+            raise  # tree-contract violation: load() decides the fate
         except Exception:  # noqa: BLE001
             logger.warning("replica restore failed", exc_info=True)
             return None

@@ -54,6 +54,10 @@ class TrainerArgs:
     # resume from this exact committed step instead of the latest
     # (reference: atorch_trainer's resume_from_checkpoint semantics)
     resume_from_step: Optional[int] = None
+    # state-tree-upgrade resume: leaves missing from the checkpoint
+    # (new fp8/optimizer slots) keep the fresh init values instead of
+    # failing the restore; params still restore exactly or raise
+    resume_partial: bool = False
     grad_accum: int = 1
     attn_impl: str = "auto"
     detect_loss_spikes: bool = True
@@ -198,10 +202,14 @@ class Trainer:
             return
         from dlrover_tpu.checkpoint.checkpointer import state_template
 
+        # partial restore needs the LIVE state (missing leaves keep its
+        # fresh values); the exact-match path uses the abstract template
         restored = self.checkpointer.load_checkpoint(
-            state_template(self.state),
+            self.state if self.args.resume_partial
+            else state_template(self.state),
             shardings=jax.tree.map(lambda x: x.sharding, self.state),
             step=self.args.resume_from_step,
+            partial=self.args.resume_partial,
         )
         if restored is not None:
             self.state = restored

@@ -296,9 +296,12 @@ def test_partial_restore_keeps_fresh_leaves_for_grown_tree(tmp_path):
     state tree grew (e.g. fp8 gaining attention-projection amax slots)
     restores the stored leaves and keeps the live state's fresh values
     for the new ones — instead of failing the whole restore. Params
-    must still restore exactly; an abstract template with missing
-    leaves still raises."""
-    import jax
+    must still restore exactly (a missing param leaf refuses even with
+    partial); an abstract template with missing leaves raises; a grown
+    tree without partial raises instead of reading as "no checkpoint"
+    — and all of it holds on the DISK path (fresh engine, no shm
+    meta), not just the shm cache."""
+    from dlrover_tpu.checkpoint.core import RestoreMismatchError
 
     ckpt = Checkpointer(str(tmp_path / "ckpt"), use_agent=False)
     old_state = _state()
@@ -309,7 +312,9 @@ def test_partial_restore_keeps_fresh_leaves_for_grown_tree(tmp_path):
     new_state = dict(old_state)
     new_state["fp8"] = {"wq": {"amax_x": jnp.ones((16,), jnp.float32) * 3}}
 
-    out = ckpt.load_checkpoint(new_state, partial=True)
+    # a FRESH Checkpointer: no shm meta, restore must come from disk
+    reader = Checkpointer(str(tmp_path / "ckpt"), use_agent=False)
+    out = reader.load_checkpoint(new_state, partial=True)
     assert out is not None
     np.testing.assert_array_equal(
         np.asarray(out["params"]["w"]),
@@ -320,9 +325,23 @@ def test_partial_restore_keeps_fresh_leaves_for_grown_tree(tmp_path):
         np.asarray(out["fp8"]["wq"]["amax_x"]),
         np.asarray(new_state["fp8"]["wq"]["amax_x"]),
     )
+    # ...and the shm path of the ORIGINAL engine agrees
+    out2 = ckpt.load_checkpoint(new_state, partial=True)
+    np.testing.assert_array_equal(
+        np.asarray(out2["fp8"]["wq"]["amax_x"]),
+        np.asarray(new_state["fp8"]["wq"]["amax_x"]),
+    )
     # an abstract template cannot provide values for missing leaves
-    with pytest.raises(KeyError):
-        ckpt.load_checkpoint(state_template(new_state), partial=True)
-    # and without partial, a grown tree still fails loudly
-    with pytest.raises(KeyError):
-        ckpt.load_checkpoint(new_state)
+    with pytest.raises(RestoreMismatchError):
+        reader.load_checkpoint(state_template(new_state), partial=True)
+    # without partial, a grown tree fails loudly (never reads as
+    # "no checkpoint → fresh start")
+    with pytest.raises(RestoreMismatchError):
+        reader.load_checkpoint(new_state)
+    # a missing PARAM leaf refuses even under partial: substituting
+    # fresh weights is a rename/corruption, not an upgrade
+    renamed = dict(new_state)
+    renamed["params"] = dict(old_state["params"])
+    renamed["params"]["w_renamed"] = renamed["params"].pop("w")
+    with pytest.raises(RestoreMismatchError):
+        reader.load_checkpoint(renamed, partial=True)
