@@ -29,6 +29,7 @@ class ShardingClient:
         self.dataset_name = dataset_name
         self._lock = threading.Lock()
         self._current_task = None
+        self._consumed = 0
         client.report_dataset_shard_params(
             dataset_name,
             dataset_size,
@@ -57,6 +58,7 @@ class ShardingClient:
                 return None
             with self._lock:
                 self._current_task = task
+                self._consumed = 0
             return task.shard_start, task.shard_end, task.record_indices
 
     def report_shard_done(self, success: bool = True):
@@ -67,6 +69,30 @@ class ShardingClient:
             self._client.report_task_result(
                 self.dataset_name, task.task_id, success=success
             )
+
+    def report_batch_done(self, batch_size: int) -> bool:
+        """Count consumed records against the current shard; report the
+        shard done when fully consumed.  Reference:
+        IndexShardingClient.report_batch_done (sharding/client.py) —
+        the per-step accounting the ElasticDataShardReportHook drives.
+        Returns True when this call closed the shard."""
+        with self._lock:
+            task = self._current_task
+            if task is None:
+                return False
+            self._consumed += int(batch_size)
+            done = self._consumed >= (task.shard_end - task.shard_start)
+            if done:
+                # pop under THIS lock: a concurrent fetch_shard may
+                # install the next shard the moment we release, and
+                # report_shard_done would mark that unconsumed shard
+                # complete
+                self._current_task = None
+        if done:
+            self._client.report_task_result(
+                self.dataset_name, task.task_id, success=True
+            )
+        return done
 
     def iter_shards(self) -> Iterator[Tuple[int, int, List[int]]]:
         while True:

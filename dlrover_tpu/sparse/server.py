@@ -105,8 +105,18 @@ class KvServer:
             for spec in specs
         }
         outer = self
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+
+            def finish(self):
+                with outer._conns_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self):
                 from dlrover_tpu.common.sockets import check_auth
 
@@ -194,6 +204,24 @@ class KvServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        # sever live connections: handler threads outlive shutdown(), and
+        # a stopped server answering op errors over a still-open socket
+        # looks like a sick peer instead of a dead one (clients must see
+        # ECONNRESET — the failover signal)
+        import socket as _socket
+
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
         for t in self.tables.values():
             t.close()
 
@@ -449,6 +477,129 @@ class DistributedEmbedding:
                 moved_total += len(keys)
         return moved_total
 
+    def table_width(self, table: str) -> int:
+        """Full row width (dim × (1 + optimizer slots)) as served by the
+        ring — probed with a zero-key export (the E op always reports
+        table.width)."""
+        rows, _freqs, _ts = self._client(
+            self.server_names[0]
+        ).export_rows(table, np.empty(0, np.int64))
+        return int(rows.shape[1])
+
+    # -- ring-wide checkpoint --------------------------------------------
+
+    def save(self, dir_path: str, *, delta_only: bool = False):
+        """Ring-wide sparse checkpoint: export every server's live rows
+        per table over the wire (full width — values + optimizer slots —
+        plus frequency/timestamp admission state) into one npz per table
+        in KvTable.save's exact layout, so local (EmbeddingCollection)
+        and distributed snapshots interchange.  Reference: the tfplus
+        full export ops (ops/kv_variable_ops.cc full-or-delta
+        import/export); delta exports stay a server-side operation (the
+        dirty bits live in each shard), so ``delta_only`` is rejected
+        here rather than silently widened to a full snapshot.
+        """
+        import os
+
+        if delta_only:
+            raise NotImplementedError(
+                "ring-wide delta export is server-side state; save "
+                "deltas on the KvServers (KvTable.save(delta_only=True))"
+            )
+        os.makedirs(dir_path, exist_ok=True)
+        written: Dict[str, int] = {}
+        for table, spec in self.specs.items():
+            parts = []
+            for server in self.server_names:
+                keys = self._client(server).keys(table)
+                if not len(keys):
+                    continue
+                rows, freqs, ts = self._client(server).export_rows(
+                    table, keys
+                )
+                parts.append((keys, rows, freqs, ts))
+            if parts:
+                keys = np.concatenate([p[0] for p in parts])
+                rows = np.concatenate([p[1] for p in parts])
+                freqs = np.concatenate([p[2] for p in parts])
+                ts = np.concatenate([p[3] for p in parts])
+                # HRW ownership makes keys disjoint across servers; a
+                # mid-migration overlap keeps the first occurrence
+                keys, first = np.unique(keys, return_index=True)
+                rows, freqs, ts = rows[first], freqs[first], ts[first]
+            else:
+                # cold table: probe the live width (the E op reports
+                # table.width even for zero keys) so the snapshot still
+                # interchanges with a local KvTable carrying optimizer
+                # slots
+                width = self.table_width(table)
+                keys = np.empty(0, np.int64)
+                rows = np.empty((0, width), np.float32)
+                freqs = np.empty(0, np.uint32)
+                ts = np.empty(0, np.uint32)
+            n_slots = rows.shape[1] // spec.dim - 1
+            np.savez(
+                os.path.join(dir_path, f"{table}.full.npz"),
+                keys=keys, values=rows, freqs=freqs, ts=ts,
+                deleted=np.empty(0, np.int64),
+                dim=spec.dim, n_slots=n_slots, delta=0,
+            )
+            written[table] = int(keys.size)
+        return written
+
+    def restore(self, dir_path: str):
+        """Exact ring restore from a snapshot directory: live rows are
+        cleared first (a surviving server's newer rows must not mix with
+        checkpoint-step state), then the snapshot's rows are imported
+        routed by the CURRENT ring — so a snapshot taken on one server
+        set restores onto any other (the resharded-restore property the
+        dense checkpoint path already has)."""
+        import os
+
+        loaded: Dict[str, int] = {}
+        for table, spec in self.specs.items():
+            path = os.path.join(dir_path, f"{table}.full.npz")
+            if not os.path.exists(path):
+                continue
+            with np.load(path) as z:
+                if int(z["dim"]) != spec.dim:
+                    raise ValueError(
+                        f"snapshot dim {int(z['dim'])} != spec "
+                        f"{spec.dim} for table {table!r}"
+                    )
+                keys = np.asarray(z["keys"], np.int64)
+                rows = np.asarray(z["values"], np.float32)
+                freqs = np.asarray(z["freqs"], np.uint32)
+                ts = np.asarray(z["ts"], np.uint32)
+            # width compatibility BEFORE any destructive step: a
+            # snapshot from a different optimizer (other slot count)
+            # must fail with the ring intact, not half-wiped
+            live_width = self.table_width(table)
+            if rows.shape[1] != live_width:
+                raise ValueError(
+                    f"snapshot width {rows.shape[1]} != ring width "
+                    f"{live_width} for table {table!r} (optimizer slot "
+                    "mismatch?); ring left untouched"
+                )
+            for server in self.server_names:
+                live = self._client(server).keys(table)
+                if len(live):
+                    self._client(server).delete(table, live)
+            index = {k: i for i, k in enumerate(keys.tolist())}
+            for server, sub in partition_keys(
+                keys, self.server_names, self._weights
+            ).items():
+                if not len(sub):
+                    continue
+                pos = np.fromiter(
+                    (index[k] for k in sub.tolist()), np.int64, len(sub)
+                )
+                self._client(server).import_rows(
+                    table, sub, rows[pos], freqs[pos], ts[pos]
+                )
+            loaded[table] = int(keys.size)
+        return loaded
+
     def stats(self) -> Dict[str, Dict[str, int]]:
         return {s: self._client(s).stats() for s in self.server_names}
 
@@ -476,6 +627,34 @@ def register_server(client, name: str, address) -> None:
     )
 
 
+def resolve_ring(client, names) -> Optional[Dict[str, Tuple[str, int]]]:
+    """Resolve ring names → (host, port) via the master KV store; None
+    when any member hasn't registered yet (adopt nothing — a partial
+    ring would route keys at servers that can't be reached)."""
+    import json as _json
+
+    addrs: Dict[str, Tuple[str, int]] = {}
+    for name in names:
+        raw = client.kv_store_get(_ADDR_KV_PREFIX + name)
+        if not raw:
+            logger.warning(
+                "sparse server %s has no registered address yet; "
+                "deferring adoption", name,
+            )
+            return None
+        host, port = _json.loads(raw)
+        addrs[name] = (host, int(port))
+    return addrs
+
+
+def ring_weights(client) -> Optional[Dict[str, float]]:
+    """Brain hot-shard rebalance weights, when the client exposes them."""
+    get_w = getattr(client, "get_ps_weights", None)
+    if callable(get_w):
+        return get_w() or None
+    return None
+
+
 def sync_with_master(demb: "DistributedEmbedding", client) -> bool:
     """One poll of the master's ElasticPsService: if the sparse-tier
     version advanced, resolve the new server list's addresses from the
@@ -483,27 +662,17 @@ def sync_with_master(demb: "DistributedEmbedding", client) -> bool:
     when the routing changed. Reference: the trainer-side version check
     of dlrover's elastic PS (tensorflow_failover.py:33) — there it
     rebuilds TF_CONFIG; here it reroutes the HRW ring in place.
-    """
-    import json as _json
 
+    Crash-classifying adoption with checkpoint fallback lives in
+    train/estimator.PsFailover, built on these same helpers.
+    """
     resp = client.get_ps_version()
     if resp.version <= demb.version or not resp.servers:
         return False
-    addrs = {}
-    for name in resp.servers:
-        raw = client.kv_store_get(_ADDR_KV_PREFIX + name)
-        if not raw:
-            logger.warning(
-                "sparse server %s has no registered address yet; "
-                "deferring version %d adoption", name, resp.version,
-            )
-            return False
-        host, port = _json.loads(raw)
-        addrs[name] = (host, int(port))
-    weights = None
-    get_w = getattr(client, "get_ps_weights", None)
-    if callable(get_w):
-        weights = get_w() or None
+    addrs = resolve_ring(client, resp.servers)
+    if addrs is None:
+        return False
+    weights = ring_weights(client)
     moved = demb.set_servers(addrs, weights=weights)
     demb.version = resp.version
     logger.info(

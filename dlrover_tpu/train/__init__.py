@@ -1,3 +1,15 @@
+from dlrover_tpu.train.estimator import (  # noqa: F401
+    ClusterSpec,
+    ColumnInfo,
+    Estimator,
+    EstimatorExecutor,
+    EvalSpec,
+    FileReader,
+    PsFailover,
+    RunConfig,
+    TrainSpec,
+    train_and_evaluate,
+)
 from dlrover_tpu.train.optimizer import make_optimizer  # noqa: F401
 from dlrover_tpu.train.prewarm import prewarm_worlds  # noqa: F401
 from dlrover_tpu.train.trainer import Trainer, TrainerArgs  # noqa: F401
