@@ -638,3 +638,72 @@ def test_global_step_hook_reports(tmp_path):
         est.model.close()
     finally:
         s0.stop()
+
+
+# ---------------------------------------------------------------------------
+# real-wire composition: LocalJobMaster + MasterClient + PS ring
+# ---------------------------------------------------------------------------
+
+
+def test_estimator_over_real_master_wire(tmp_path):
+    """The full registration story over the real wire: KvServers join
+    the master as PS nodes (PsClusterCallback builds the versioned
+    ring), the estimator synthesizes its ClusterSpec from the master
+    (the TF_CONFIG-from-cluster-info path), and a PLANNED scale-out
+    mid-run is adopted live by the inline failover poll."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.sparse.server import register_server, resolve_ring
+
+    master = LocalJobMaster(port=0, num_workers=1)
+    master.prepare()
+    s0, s1, s2 = _start_server(), _start_server(), _start_server()
+    try:
+        def join_ps(node_id, server):
+            c = MasterClient(master.addr, node_id=node_id)
+            c.register_node(node_type=NodeType.PS)
+            register_server(c, f"{NodeType.PS}-{node_id}", server.address)
+            return c
+
+        join_ps(100, s0)
+        join_ps(101, s1)
+        worker = MasterClient(master.addr, node_id=0)
+        worker.register_node()
+        spec = synthesize_cluster_spec(worker)
+        assert spec.cluster["ps"] == ["ps-100", "ps-101"]
+        assert spec.is_chief  # worker 0, no explicit chief
+
+        addrs = resolve_ring(worker, spec.cluster["ps"])
+        assert addrs is not None
+        est = Estimator(
+            make_model_fn(addrs),
+            config=RunConfig(
+                model_dir=str(tmp_path), save_steps=5, log_steps=50
+            ),
+            cluster=spec,
+            master_client=worker,
+        )
+        # the ring the model adopted at build time IS the master's
+        # current version — align so the first poll is a no-op
+        est.model.coll.version = worker.get_ps_version().version
+        est.train(batch_input_fn(), max_steps=6)
+        assert est.failover is not None and est.failover.changes == []
+
+        # planned scale-out: a third PS registers; the next train's
+        # inline poll adopts it live (no restore, keys migrate)
+        join_ps(102, s2)
+        est.failover._poll = 0.0  # poll every step
+        est.train(batch_input_fn(seed=2), max_steps=12)
+        assert est.model.coll.server_names == [
+            "ps-100", "ps-101", "ps-102"
+        ]
+        assert int(est.model.coll.stats()["ps-102"]["emb"]) > 0
+        assert est.failover.changes == ["scaling"]
+        assert est.global_step == 12
+        est.model.close()
+    finally:
+        master.stop()
+        s0.stop()
+        s1.stop()
+        s2.stop()
