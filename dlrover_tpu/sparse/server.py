@@ -194,6 +194,24 @@ class KvServer:
             ts = np.frombuffer(payload[off + 4 * n :], np.uint32)
             table.import_(keys, rows, freqs, ts, mark_dirty=True)
             _send(sock, b"I", {"ok": True})
+        elif op == b"X":
+            # snapshot export: full (clears the dirty epoch — the next
+            # delta is cumulative against THIS export) or delta (dirty
+            # rows + tombstones since the last full)
+            delta = bool(ctrl.get("delta"))
+            keys, rows, freqs, ts = table.export(delta_only=delta)
+            deleted = (
+                table.export_deleted() if delta
+                else np.empty(0, np.int64)
+            )
+            _send(
+                sock,
+                b"X",
+                {"n": len(keys), "width": table.width,
+                 "n_deleted": len(deleted)},
+                keys.tobytes() + rows.tobytes() + freqs.tobytes()
+                + ts.tobytes() + deleted.tobytes(),
+            )
         elif op == b"D":
             keys = np.frombuffer(payload, dtype=np.int64)
             removed = table.delete(keys)
@@ -281,6 +299,29 @@ class KvClient:
         freqs = np.frombuffer(payload[off : off + 4 * n], np.uint32)
         ts = np.frombuffer(payload[off + 4 * n :], np.uint32)
         return rows.copy(), freqs.copy(), ts.copy()
+
+    def export_snapshot(self, table: str, *, delta: bool = False):
+        """Server-side snapshot export (X op): full clears the dirty
+        epoch; delta returns dirty rows + deletion tombstones since the
+        last full.  Returns (keys, rows, freqs, ts, deleted)."""
+        ctrl, payload = self._call(
+            b"X", {"table": table, "delta": delta}
+        )
+        n, width = ctrl["n"], ctrl["width"]
+        nd = ctrl["n_deleted"]
+        off = 8 * n
+        keys = np.frombuffer(payload[:off], np.int64)
+        rows = np.frombuffer(
+            payload[off : off + 4 * n * width], np.float32
+        ).reshape(n, width)
+        off += 4 * n * width
+        freqs = np.frombuffer(payload[off : off + 4 * n], np.uint32)
+        off += 4 * n
+        ts = np.frombuffer(payload[off : off + 4 * n], np.uint32)
+        off += 4 * n
+        deleted = np.frombuffer(payload[off : off + 8 * nd], np.int64)
+        return (keys.copy(), rows.copy(), freqs.copy(), ts.copy(),
+                deleted.copy())
 
     def import_rows(self, table, keys, rows, freqs, ts):
         self._call(
@@ -489,35 +530,54 @@ class DistributedEmbedding:
     # -- ring-wide checkpoint --------------------------------------------
 
     def save(self, dir_path: str, *, delta_only: bool = False):
-        """Ring-wide sparse checkpoint: export every server's live rows
-        per table over the wire (full width — values + optimizer slots —
+        """Ring-wide sparse checkpoint: snapshot-export every server per
+        table over the wire (full width — values + optimizer slots —
         plus frequency/timestamp admission state) into one npz per table
         in KvTable.save's exact layout, so local (EmbeddingCollection)
         and distributed snapshots interchange.  Reference: the tfplus
-        full export ops (ops/kv_variable_ops.cc full-or-delta
-        import/export); delta exports stay a server-side operation (the
-        dirty bits live in each shard), so ``delta_only`` is rejected
-        here rather than silently widened to a full snapshot.
+        full-or-delta export ops (ops/kv_variable_ops.cc).
+
+        A full save clears each server's dirty epoch; ``delta_only``
+        then writes ``{table}.delta.npz`` — dirty rows plus deletion
+        tombstones cumulative since that full — into the SAME directory
+        (overwriting the previous delta is correct because deltas are
+        cumulative).  A full save that fails midway leaves some servers
+        with a cleared epoch: retry the FULL save before trusting
+        deltas again.
         """
         import os
 
-        if delta_only:
-            raise NotImplementedError(
-                "ring-wide delta export is server-side state; save "
-                "deltas on the KvServers (KvTable.save(delta_only=True))"
-            )
+        if not self.server_names:
+            raise ValueError("cannot snapshot an empty ring")
         os.makedirs(dir_path, exist_ok=True)
         written: Dict[str, int] = {}
         for table, spec in self.specs.items():
-            parts = []
-            for server in self.server_names:
-                keys = self._client(server).keys(table)
-                if not len(keys):
-                    continue
-                rows, freqs, ts = self._client(server).export_rows(
-                    table, keys
+            # width agreement BEFORE any export: a full export clears
+            # each server's dirty epoch, so failing after exports would
+            # silently orphan every row dirtied before the failure
+            widths = {
+                server: int(
+                    self._client(server)
+                    .export_rows(table, np.empty(0, np.int64))[0]
+                    .shape[1]
                 )
-                parts.append((keys, rows, freqs, ts))
+                for server in self.server_names
+            }
+            width = next(iter(widths.values()))
+            if any(w != width for w in widths.values()):
+                raise ValueError(
+                    f"ring serves table {table!r} at mixed widths "
+                    f"{widths}; refusing to snapshot"
+                )
+            parts, deleted_parts = [], []
+            for server in self.server_names:
+                keys, rows, freqs, ts, deleted = self._client(
+                    server
+                ).export_snapshot(table, delta=delta_only)
+                if len(keys):
+                    parts.append((keys, rows, freqs, ts))
+                if len(deleted):
+                    deleted_parts.append(deleted)
             if parts:
                 keys = np.concatenate([p[0] for p in parts])
                 rows = np.concatenate([p[1] for p in parts])
@@ -528,76 +588,131 @@ class DistributedEmbedding:
                 keys, first = np.unique(keys, return_index=True)
                 rows, freqs, ts = rows[first], freqs[first], ts[first]
             else:
-                # cold table: probe the live width (the E op reports
-                # table.width even for zero keys) so the snapshot still
-                # interchanges with a local KvTable carrying optimizer
-                # slots
-                width = self.table_width(table)
                 keys = np.empty(0, np.int64)
                 rows = np.empty((0, width), np.float32)
                 freqs = np.empty(0, np.uint32)
                 ts = np.empty(0, np.uint32)
-            n_slots = rows.shape[1] // spec.dim - 1
-            np.savez(
-                os.path.join(dir_path, f"{table}.full.npz"),
-                keys=keys, values=rows, freqs=freqs, ts=ts,
-                deleted=np.empty(0, np.int64),
-                dim=spec.dim, n_slots=n_slots, delta=0,
+            deleted = (
+                np.unique(np.concatenate(deleted_parts))
+                if deleted_parts
+                else np.empty(0, np.int64)
             )
+            suffix = "delta" if delta_only else "full"
+            np.savez(
+                os.path.join(dir_path, f"{table}.{suffix}.npz"),
+                keys=keys, values=rows, freqs=freqs, ts=ts,
+                deleted=deleted,
+                dim=spec.dim, n_slots=width // spec.dim - 1,
+                delta=int(delta_only),
+            )
+            if not delta_only:
+                # a new full snapshot starts a fresh delta epoch: a
+                # leftover delta belongs to the PREVIOUS baseline and
+                # restore() would overlay it, reverting rows
+                try:
+                    os.remove(
+                        os.path.join(dir_path, f"{table}.delta.npz")
+                    )
+                except FileNotFoundError:
+                    pass
             written[table] = int(keys.size)
         return written
+
+    def _load_npz(self, path, table, spec):
+        with np.load(path) as z:
+            if int(z["dim"]) != spec.dim:
+                raise ValueError(
+                    f"snapshot dim {int(z['dim'])} != spec "
+                    f"{spec.dim} for table {table!r}"
+                )
+            return (
+                np.asarray(z["keys"], np.int64),
+                np.asarray(z["values"], np.float32),
+                np.asarray(z["freqs"], np.uint32),
+                np.asarray(z["ts"], np.uint32),
+                np.asarray(z["deleted"], np.int64)
+                if "deleted" in z.files
+                else np.empty(0, np.int64),
+            )
+
+    def _route_import(self, table, keys, rows, freqs, ts):
+        index = {k: i for i, k in enumerate(keys.tolist())}
+        for server, sub in partition_keys(
+            keys, self.server_names, self._weights
+        ).items():
+            if not len(sub):
+                continue
+            pos = np.fromiter(
+                (index[k] for k in sub.tolist()), np.int64, len(sub)
+            )
+            self._client(server).import_rows(
+                table, sub, rows[pos], freqs[pos], ts[pos]
+            )
+
+    def _route_delete(self, table, keys):
+        for server, sub in partition_keys(
+            keys, self.server_names, self._weights
+        ).items():
+            if len(sub):
+                self._client(server).delete(table, sub)
 
     def restore(self, dir_path: str):
         """Exact ring restore from a snapshot directory: live rows are
         cleared first (a surviving server's newer rows must not mix with
-        checkpoint-step state), then the snapshot's rows are imported
-        routed by the CURRENT ring — so a snapshot taken on one server
-        set restores onto any other (the resharded-restore property the
-        dense checkpoint path already has)."""
+        checkpoint-step state), then the full snapshot's rows — overlaid
+        with the latest delta (rows + deletion tombstones) when one
+        exists — are imported routed by the CURRENT ring.  A snapshot
+        taken on one server set therefore restores onto any other (the
+        resharded-restore property the dense checkpoint path already
+        has).  Imports mark rows dirty server-side, so a delta taken
+        after a restore is fat but correct; take a full save to reset
+        the epoch."""
         import os
 
         loaded: Dict[str, int] = {}
         for table, spec in self.specs.items():
             path = os.path.join(dir_path, f"{table}.full.npz")
+            delta_path = os.path.join(dir_path, f"{table}.delta.npz")
             if not os.path.exists(path):
-                continue
-            with np.load(path) as z:
-                if int(z["dim"]) != spec.dim:
+                if os.path.exists(delta_path):
                     raise ValueError(
-                        f"snapshot dim {int(z['dim'])} != spec "
-                        f"{spec.dim} for table {table!r}"
+                        f"snapshot dir has {table}.delta.npz but no "
+                        f"{table}.full.npz — a delta cannot restore "
+                        "without its full baseline"
                     )
-                keys = np.asarray(z["keys"], np.int64)
-                rows = np.asarray(z["values"], np.float32)
-                freqs = np.asarray(z["freqs"], np.uint32)
-                ts = np.asarray(z["ts"], np.uint32)
+                continue
+            keys, rows, freqs, ts, _ = self._load_npz(path, table, spec)
+            delta = (
+                self._load_npz(delta_path, table, spec)
+                if os.path.exists(delta_path)
+                else None
+            )
             # width compatibility BEFORE any destructive step: a
             # snapshot from a different optimizer (other slot count)
             # must fail with the ring intact, not half-wiped
             live_width = self.table_width(table)
-            if rows.shape[1] != live_width:
-                raise ValueError(
-                    f"snapshot width {rows.shape[1]} != ring width "
-                    f"{live_width} for table {table!r} (optimizer slot "
-                    "mismatch?); ring left untouched"
-                )
+            for name, r in (("full", rows),) + (
+                (("delta", delta[1]),) if delta is not None else ()
+            ):
+                if len(r) and r.shape[1] != live_width:
+                    raise ValueError(
+                        f"{name} snapshot width {r.shape[1]} != ring "
+                        f"width {live_width} for table {table!r} "
+                        "(optimizer slot mismatch?); ring left untouched"
+                    )
             for server in self.server_names:
                 live = self._client(server).keys(table)
                 if len(live):
                     self._client(server).delete(table, live)
-            index = {k: i for i, k in enumerate(keys.tolist())}
-            for server, sub in partition_keys(
-                keys, self.server_names, self._weights
-            ).items():
-                if not len(sub):
-                    continue
-                pos = np.fromiter(
-                    (index[k] for k in sub.tolist()), np.int64, len(sub)
-                )
-                self._client(server).import_rows(
-                    table, sub, rows[pos], freqs[pos], ts[pos]
-                )
+            self._route_import(table, keys, rows, freqs, ts)
             loaded[table] = int(keys.size)
+            if delta is not None:
+                dk, dr, df, dt, dtomb = delta
+                if len(dk):
+                    self._route_import(table, dk, dr, df, dt)
+                if len(dtomb):
+                    self._route_delete(table, dtomb)
+                loaded[table] += int(dk.size)
         return loaded
 
     def stats(self) -> Dict[str, Dict[str, int]]:

@@ -360,15 +360,22 @@ class ElasticDataShardReportHook(SessionHook):
 class CheckpointSaverHook(SessionHook):
     """Chief-only periodic checkpoint into ``model_dir/ckpt-{step}``
     with a tracker file and keep-max pruning (reference: the
-    CheckpointSaverHook wired at estimator_executor.py:183-200)."""
+    CheckpointSaverHook wired at estimator_executor.py:183-200).  With
+    ``incremental_steps``, steps between full saves write delta-only
+    snapshots into the latest full checkpoint's directory (cumulative —
+    each overwrites the last)."""
 
-    def __init__(self, estimator, save_steps: int):
+    def __init__(self, estimator, save_steps: int,
+                 incremental_steps: int = 0):
         self._est = estimator
         self._save_steps = max(int(save_steps), 1)
+        self._incr = max(int(incremental_steps), 0)
 
     def after_run(self, estimator, step, loss):
         if step > 0 and step % self._save_steps == 0:
             estimator.save_checkpoint(step)
+        elif self._incr and step > 0 and step % self._incr == 0:
+            estimator.save_incremental(step)
 
     def end(self, estimator, step):
         if step > 0:
@@ -500,12 +507,18 @@ class PsFailover:
 @dataclass
 class RunConfig:
     """reference: estimator RunConfig fields the executor sets
-    (estimator_executor.py:153-200)."""
+    (estimator_executor.py:153-200); ``incremental_save_steps`` is the
+    checkpoint_incremental_save_secs analog (estimator_executor.py:186
+    — deeprec incremental saved-model), lowered onto the sparse tier's
+    full-or-delta export: between full saves, only rows dirty since the
+    last full (plus deletion tombstones) are written."""
 
     model_dir: str = "/tmp/dlrover_tpu_estimator"
     save_steps: int = 100
     keep_checkpoint_max: int = 5
     log_steps: int = 20
+    # 0 = off; must divide into the save_steps cadence sensibly
+    incremental_save_steps: int = 0
 
 
 @dataclass
@@ -599,32 +612,88 @@ class Estimator:
     def _tracker(self) -> str:
         return os.path.join(self.config.model_dir, "checkpoint")
 
-    def latest_checkpoint(self) -> Optional[int]:
+    def _read_tracker(self) -> Optional[Dict]:
         try:
             with open(self._tracker(), "r", encoding="utf-8") as f:
-                return int(json.loads(f.read())["latest_step"])
+                obj = json.loads(f.read())
+            obj["latest_step"] = int(obj["latest_step"])
+            obj["full_step"] = int(obj.get("full_step", obj["latest_step"]))
+            return obj
         except (OSError, ValueError, KeyError):
             return None
+
+    def latest_checkpoint(self) -> Optional[int]:
+        """The step a restore resumes at (a delta step when incremental
+        saves ran after the last full checkpoint)."""
+        obj = self._read_tracker()
+        return None if obj is None else obj["latest_step"]
+
+    def _save_dataset_position(self, path: str):
+        if self.shard_client is None:
+            return
+        try:
+            pos = self.shard_client.checkpoint()
+            with open(
+                os.path.join(path, "dataset_position.json"),
+                "w",
+                encoding="utf-8",
+            ) as f:
+                f.write(pos or "{}")
+        except Exception as e:
+            logger.warning("dataset-position checkpoint failed: %s", e)
 
     def save_checkpoint(self, step: int):
         path = self._ckpt_dir(step)
         os.makedirs(path, exist_ok=True)
         self.model.save(path)
-        if self.shard_client is not None:
-            try:
-                pos = self.shard_client.checkpoint()
-                with open(
-                    os.path.join(path, "dataset_position.json"),
-                    "w",
-                    encoding="utf-8",
-                ) as f:
-                    f.write(pos or "{}")
-            except Exception as e:
-                logger.warning("dataset-position checkpoint failed: %s", e)
+        self._save_dataset_position(path)
         with open(self._tracker(), "w", encoding="utf-8") as f:
-            f.write(json.dumps({"latest_step": step}))
+            f.write(json.dumps({"latest_step": step, "full_step": step}))
         self._prune_checkpoints()
         logger.info("checkpoint saved at step %d → %s", step, path)
+
+    def save_incremental(self, step: int):
+        """Delta-only save into the latest full checkpoint's directory
+        (sparse tier: rows dirty since that full + tombstones; dense
+        params rewritten — they're small).  Cumulative, so each delta
+        overwrites the previous one."""
+        obj = self._read_tracker()
+        if obj is None:
+            # no full checkpoint yet to be incremental against
+            self.save_checkpoint(step)
+            return
+        path = self._ckpt_dir(obj["full_step"])
+        # capability probe by signature — catching TypeError around the
+        # call itself would misread an internal save error as "no
+        # delta support" and widen into a dir that still holds a stale
+        # delta file
+        import inspect
+
+        try:
+            supports_delta = (
+                "delta_only"
+                in inspect.signature(self.model.save).parameters
+            )
+        except (TypeError, ValueError):
+            supports_delta = False
+        if supports_delta:
+            self.model.save(path, delta_only=True)
+        else:
+            logger.warning(
+                "model.save has no delta_only parameter; incremental "
+                "save at step %d falls back to a full checkpoint", step,
+            )
+            self.save_checkpoint(step)
+            return
+        self._save_dataset_position(path)
+        with open(self._tracker(), "w", encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"latest_step": step, "full_step": obj["full_step"]}
+            ))
+        logger.info(
+            "incremental checkpoint at step %d → %s (full base %d)",
+            step, path, obj["full_step"],
+        )
 
     def _prune_checkpoints(self):
         keep = max(int(self.config.keep_checkpoint_max), 1)
@@ -639,10 +708,13 @@ class Estimator:
             shutil.rmtree(self._ckpt_dir(step), ignore_errors=True)
 
     def restore_latest(self) -> Optional[int]:
-        step = self.latest_checkpoint()
-        if step is None:
+        obj = self._read_tracker()
+        if obj is None:
             return None
-        path = self._ckpt_dir(step)
+        step = obj["latest_step"]
+        # the directory is the last FULL save; the sparse restore
+        # overlays its delta file, bringing state to ``step``
+        path = self._ckpt_dir(obj["full_step"])
         self.model.restore(path)
         if self.shard_client is not None:
             # dataset position travels with the model state: a resumed
@@ -663,7 +735,13 @@ class Estimator:
     def _default_hooks(self, extra: List[SessionHook]) -> List[SessionHook]:
         hooks: List[SessionHook] = list(extra)
         if self.cluster.is_chief:
-            hooks.append(CheckpointSaverHook(self, self.config.save_steps))
+            hooks.append(
+                CheckpointSaverHook(
+                    self,
+                    self.config.save_steps,
+                    self.config.incremental_save_steps,
+                )
+            )
         if self.shard_client is not None:
             if self.reader is not None and not self.reader.auto_report:
                 hooks.append(

@@ -136,8 +136,8 @@ def main():
             return {"loss": float(loss),
                     "accuracy": float(np.mean((p > 0.5) == (labels > 0.5)))}
 
-        def save(self, d):
-            self.model.save(d)
+        def save(self, d, delta_only=False):
+            self.model.save(d, delta_only=delta_only)
 
         def restore(self, d):
             self.model.restore(d)

@@ -173,8 +173,8 @@ class DeepFMAdapter:
     def predict(self, features):
         return self.model.predict(features["cat"], features["dense"])
 
-    def save(self, dir_path):
-        self.model.save(dir_path)
+    def save(self, dir_path, delta_only=False):
+        self.model.save(dir_path, delta_only=delta_only)
 
     def restore(self, dir_path):
         self.model.restore(dir_path)
@@ -389,8 +389,9 @@ def test_ring_snapshot_interchanges_with_local(tmp_path):
         })
         written = demb.save(str(tmp_path))
         assert written["emb"] == len(host["emb"])
-        with pytest.raises(NotImplementedError):
-            demb.save(str(tmp_path), delta_only=True)
+        # the full save just cleared the dirty epoch: an immediate
+        # delta is empty (cumulative-since-full contract)
+        assert demb.save(str(tmp_path), delta_only=True)["emb"] == 0
 
         # a LOCAL collection restores the ring snapshot byte-for-byte
         local = EmbeddingCollection(_specs(), optimizer=GroupAdam(lr=1e-2))
@@ -707,3 +708,170 @@ def test_estimator_over_real_master_wire(tmp_path):
         s0.stop()
         s1.stop()
         s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# incremental (delta) checkpoints: ring-wide full-or-delta export
+# ---------------------------------------------------------------------------
+
+
+def test_ring_delta_snapshot_roundtrip(tmp_path):
+    """Full save clears the dirty epoch; a later delta carries only
+    rows changed since (plus deletion tombstones); full+delta restores
+    the exact live state onto a DIFFERENT ring (tfplus full-or-delta
+    export capability, ops/kv_variable_ops.cc, at the serving tier)."""
+    s0, s1 = _start_server(), _start_server()
+    try:
+        demb = DistributedEmbedding(
+            _specs(), {"s0": s0.address, "s1": s1.address}
+        )
+        keys_a = np.arange(0, 200, dtype=np.int64)
+        dev, host = demb.pull({"emb": keys_a})
+        demb.push(host, {
+            "emb": np.ones((len(host["emb"]), CFG.emb_dim), np.float32)
+        })
+        full_written = demb.save(str(tmp_path))
+        assert full_written["emb"] == 200
+
+        # epoch cleared: an immediate delta is empty
+        assert demb.save(str(tmp_path), delta_only=True)["emb"] == 0
+
+        # mutate a subset, insert new keys, delete a few
+        keys_b = np.arange(100, 250, dtype=np.int64)  # 100-199 old, 200-249 new
+        dev, host = demb.pull({"emb": keys_b})
+        demb.push(host, {
+            "emb": np.full((len(host["emb"]), CFG.emb_dim), 2.0, np.float32)
+        })
+        gone = np.array([0, 1, 2], dtype=np.int64)
+        demb._route_delete("emb", gone)
+        delta_written = demb.save(str(tmp_path), delta_only=True)
+        # only the touched rows travel (bounded by 150 + admission noise)
+        assert 0 < delta_written["emb"] <= 160, delta_written
+
+        live = np.asarray(
+            demb.pull_frozen({"emb": np.arange(250, dtype=np.int64)})[
+                "emb"
+            ][0]
+        )
+
+        # restore full+delta onto a fresh single-server ring
+        s2 = _start_server()
+        try:
+            demb2 = DistributedEmbedding(_specs(), {"s2": s2.address})
+            demb2.restore(str(tmp_path))
+            got = np.asarray(
+                demb2.pull_frozen(
+                    {"emb": np.arange(250, dtype=np.int64)}
+                )["emb"][0]
+            )
+            np.testing.assert_allclose(got, live, atol=1e-6)
+            # tombstoned keys really are absent (zeros on frozen pull)
+            dead = np.asarray(
+                demb2.pull_frozen({"emb": gone})["emb"][0]
+            )
+            np.testing.assert_allclose(dead, 0.0)
+            demb2.close()
+        finally:
+            s2.stop()
+        demb.close()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_checkpoint_saver_hook_incremental_cadence():
+    calls = []
+
+    class FakeEst:
+        def save_checkpoint(self, step):
+            calls.append(("full", step))
+
+        def save_incremental(self, step):
+            calls.append(("delta", step))
+
+    from dlrover_tpu.train.estimator import CheckpointSaverHook
+
+    est = FakeEst()
+    hook = CheckpointSaverHook(est, save_steps=6, incremental_steps=2)
+    for step in range(1, 13):
+        hook.after_run(est, step, 0.0)
+    assert calls == [
+        ("delta", 2), ("delta", 4), ("full", 6),
+        ("delta", 8), ("delta", 10), ("full", 12),
+    ]
+
+
+def test_estimator_incremental_restore(tmp_path):
+    """A delta saved after the last full checkpoint restores forward to
+    the delta step: fresh estimator resumes at step 10 from dir ckpt-8
+    (full base) + its delta overlay, predictions matching the live
+    model."""
+    s0 = _start_server()
+    try:
+        addrs = {"s0": s0.address}
+        cfg = RunConfig(
+            model_dir=str(tmp_path), save_steps=1000, log_steps=50
+        )
+        est = Estimator(make_model_fn(addrs), config=cfg)
+        est.train(batch_input_fn(), max_steps=8)  # end-save: full ckpt-8
+        assert est._read_tracker() == {"latest_step": 8, "full_step": 8}
+
+        # two more "steps" past the full checkpoint, then a delta
+        rng = np.random.default_rng(3)
+        for _ in range(2):
+            cat, dense, labels = synthetic_ctr(rng, 128)
+            est.model.train_step(
+                {"cat": cat, "dense": dense}, labels
+            )
+        est.save_incremental(10)
+        assert est._read_tracker() == {"latest_step": 10, "full_step": 8}
+        probe = {"cat": np.arange(4 * CFG.n_fields).reshape(
+            4, CFG.n_fields).astype(np.int64),
+            "dense": np.zeros((4, CFG.n_dense), np.float32)}
+        want = est.model.predict(probe)
+        est.model.close()
+
+        est2 = Estimator(make_model_fn(addrs), config=cfg)
+        assert est2.restore_latest() == 10
+        got = est2.model.predict(probe)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        est2.model.close()
+    finally:
+        s0.stop()
+
+
+def test_full_save_invalidates_stale_delta(tmp_path):
+    """A new full snapshot starts a fresh delta epoch: the previous
+    delta file is removed (restore must never overlay an older-baseline
+    delta onto a newer full)."""
+    s0 = _start_server()
+    try:
+        demb = DistributedEmbedding(_specs(), {"s0": s0.address})
+        keys = np.arange(50, dtype=np.int64)
+        dev, host = demb.pull({"emb": keys})
+        demb.save(str(tmp_path))
+        demb.push(host, {
+            "emb": np.ones((len(host["emb"]), CFG.emb_dim), np.float32)
+        })
+        demb.save(str(tmp_path), delta_only=True)
+        assert os.path.exists(str(tmp_path / "emb.delta.npz"))
+        demb.save(str(tmp_path))  # new baseline
+        assert not os.path.exists(str(tmp_path / "emb.delta.npz"))
+        demb.close()
+    finally:
+        s0.stop()
+
+
+def test_restore_rejects_orphan_delta(tmp_path):
+    s0 = _start_server()
+    try:
+        demb = DistributedEmbedding(_specs(), {"s0": s0.address})
+        demb.pull({"emb": np.arange(10, dtype=np.int64)})
+        demb.save(str(tmp_path))
+        demb.save(str(tmp_path), delta_only=True)
+        os.remove(str(tmp_path / "emb.full.npz"))
+        with pytest.raises(ValueError, match="full baseline"):
+            demb.restore(str(tmp_path))
+        demb.close()
+    finally:
+        s0.stop()
