@@ -764,6 +764,21 @@ class Estimator:
             hooks.append(GlobalStepReportHook(self.master_client))
         return hooks
 
+    def _maybe_poll_failover(self):
+        """Inline failover poll between steps: re-routing on the calling
+        thread can never race a pull/push in flight (the background
+        PsFailover.start mode is for idle watchers only)."""
+        if self.failover is None:
+            return
+        now = time.monotonic()
+        if now - getattr(self, "_last_poll", 0.0) < self.failover._poll:
+            return
+        self._last_poll = now
+        try:
+            self.failover.poll_once()
+        except Exception as e:
+            logger.warning("PS failover poll failed: %s", e)
+
     def train(
         self,
         input_fn: Callable[[], Iterable],
@@ -775,22 +790,11 @@ class Estimator:
         for h in all_hooks:
             h.begin(self)
         last_loss = float("nan")
-        last_poll = 0.0
+        self._last_poll = 0.0
         try:
             it = iter(input_fn())
             while self.global_step < max_steps:
-                # inline failover poll between steps: re-routing on the
-                # training thread can never race a pull/push in flight
-                if (
-                    self.failover is not None
-                    and time.monotonic() - last_poll
-                    >= self.failover._poll
-                ):
-                    last_poll = time.monotonic()
-                    try:
-                        self.failover.poll_once()
-                    except Exception as e:
-                        logger.warning("PS failover poll failed: %s", e)
+                self._maybe_poll_failover()
                 if self._needs_sparse_restore:
                     self._needs_sparse_restore = False
                     if self.restore_latest() is None:
@@ -824,6 +828,9 @@ class Estimator:
         sums: Dict[str, float] = {}
         n = 0
         for features, labels in input_fn():
+            # a PS change mid-eval must re-route here too, or the next
+            # frozen pull hits a dead/stale server
+            self._maybe_poll_failover()
             metrics = model.eval_metrics(features, labels)
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
@@ -836,6 +843,7 @@ class Estimator:
         model = self.model
         out = []
         for features, _labels in input_fn():
+            self._maybe_poll_failover()
             out.append(np.asarray(model.predict(features)))
         return out
 
