@@ -519,6 +519,10 @@ class RunConfig:
     log_steps: int = 20
     # 0 = off; must divide into the save_steps cadence sensibly
     incremental_save_steps: int = 0
+    # how long a train step's sparse wire error may wait for the master
+    # to re-seal the PS ring before it propagates (the worker usually
+    # notices a dead server BEFORE the master does)
+    ps_failure_grace_s: float = 60.0
 
 
 @dataclass
@@ -764,6 +768,35 @@ class Estimator:
             hooks.append(GlobalStepReportHook(self.master_client))
         return hooks
 
+    def _await_reseal(self, err) -> bool:
+        """After a sparse wire error, poll the master until the PS ring
+        version moves (the failover path then adopts/flags a restore) or
+        the grace window expires.  Returns True when a change was
+        adopted — the caller re-enters the loop, which runs the restore
+        if one was flagged.  Reference: the worker-exit-and-restart this
+        replaces (tensorflow_failover.py:133 exits on ps_failure; here
+        the worker rides through)."""
+        logger.warning(
+            "train step hit a sparse wire error (%s); waiting up to "
+            "%.0fs for the master to re-seal the PS ring",
+            err, self.config.ps_failure_grace_s,
+        )
+        deadline = time.monotonic() + self.config.ps_failure_grace_s
+        while time.monotonic() < deadline:
+            try:
+                change = self.failover.poll_once()
+            except Exception as e:  # master hiccup: keep waiting
+                logger.warning("failover poll failed: %s", e)
+                change = None
+            if change is not None or self._needs_sparse_restore:
+                return True
+            time.sleep(min(self.failover._poll, 1.0))
+        logger.error(
+            "PS ring did not re-seal within %.0fs; propagating the "
+            "wire error", self.config.ps_failure_grace_s,
+        )
+        return False
+
     def _maybe_poll_failover(self):
         """Inline failover poll between steps: re-routing on the calling
         thread can never race a pull/push in flight (the background
@@ -807,7 +840,18 @@ class Estimator:
                 except StopIteration:
                     logger.info("input exhausted at step %d", self.global_step)
                     break
-                loss = model.train_step(features, labels)
+                try:
+                    loss = model.train_step(features, labels)
+                except OSError as e:
+                    # sparse wire error: a PS died under this step. The
+                    # worker sees it before the master does — wait for
+                    # the master to re-seal the ring (version bump),
+                    # adopt/restore through the normal failover path,
+                    # and move on (this batch is dropped; its shard
+                    # stays unreported, so the master re-queues it)
+                    if self.failover is None or not self._await_reseal(e):
+                        raise
+                    continue
                 last_loss = float(loss)
                 self.global_step += 1
                 for h in all_hooks:

@@ -1,0 +1,201 @@
+"""Estimator worker for the elastic PS job (the reference's §3.5 call
+stack: dlrover.trainer entry → EstimatorExecutor → TF_CONFIG from the
+master → TensorflowFailover → ElasticDataShardReportHook → dynamic
+shards from the TaskManager).
+
+Run under a live master (env ``DLROVER_TPU_MASTER_ADDR``) with KvServer
+processes registered as PS nodes:
+
+- synthesizes its ClusterSpec from the master (waits for the PS ring),
+- registers a dataset and reads it through a shard-fed FileReader
+  (per-batch completion closes shards; a dead worker's shards re-queue),
+- trains with periodic + incremental checkpoints,
+- rides through PS failures: a wire error waits for the master to
+  re-seal the ring, then restores the sparse tier from the latest
+  checkpoint and keeps stepping (`tests/test_estimator_fullstack.py`
+  kills a PS mid-run and asserts exactly this).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def write_csv(path, n, n_fields, n_dense, seed=11):
+    rng = np.random.default_rng(seed)
+    with open(path, "w", encoding="utf-8") as f:
+        for _ in range(n):
+            cat = rng.integers(0, 50, n_fields)
+            dense = rng.normal(size=n_dense)
+            hot = (cat % 7 == 0).sum() + dense[0]
+            p = 1.0 / (1.0 + np.exp(-(hot - 2.0)))
+            label = int(rng.random() < p)
+            f.write(
+                ",".join(str(c) for c in cat)
+                + ","
+                + ",".join(f"{d:.5f}" for d in dense)
+                + f",{label}\n"
+            )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--model-dir", default="/tmp/dlrover_tpu_est_elastic")
+    ap.add_argument("--ps-wait-s", type=float, default=60.0)
+    args = ap.parse_args()
+
+    from dlrover_tpu.agent.master_client import build_master_client
+    from dlrover_tpu.agent.sharding_client import ShardingClient
+    from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+    from dlrover_tpu.sparse import GroupAdam
+    from dlrover_tpu.sparse.embedding import EmbeddingSpec
+    from dlrover_tpu.sparse.server import DistributedEmbedding, resolve_ring
+    from dlrover_tpu.train.estimator import (
+        ColumnInfo,
+        Estimator,
+        FileReader,
+        RunConfig,
+        synthesize_cluster_spec,
+    )
+
+    client = build_master_client()
+    client.register_node()
+
+    # wait for the PS ring: names from ElasticPsService, addresses from
+    # the KV store (the reference's wait_for_tf_config analog)
+    deadline = time.monotonic() + args.ps_wait_s
+    addrs = None
+    while time.monotonic() < deadline:
+        spec = synthesize_cluster_spec(client)
+        if spec.cluster.get("ps"):
+            addrs = resolve_ring(client, spec.cluster["ps"])
+            if addrs is not None:
+                break
+        time.sleep(1.0)
+    if addrs is None:
+        print("[est-worker] no PS ring appeared", flush=True)
+        sys.exit(1)
+    print(f"[est-worker] cluster: {spec.to_json()}", flush=True)
+
+    cfg = DeepFMConfig(n_fields=6, n_dense=4, emb_dim=8, mlp_dims=(32,))
+    os.makedirs(args.model_dir, exist_ok=True)
+    csv_path = os.path.join(args.model_dir, "train.csv")
+    if not os.path.exists(csv_path):
+        write_csv(csv_path, args.rows, cfg.n_fields, cfg.n_dense)
+
+    shard_client = ShardingClient(
+        client, "est-ctr", dataset_size=args.rows,
+        shard_size=max(args.batch * 4, 512), num_epochs=100,
+    )
+    columns = (
+        [ColumnInfo(f"c{i}", "int64") for i in range(cfg.n_fields)]
+        + [ColumnInfo(f"d{i}", "float32") for i in range(cfg.n_dense)]
+        + [ColumnInfo("label", "float32", is_label=True)]
+    )
+    reader = FileReader(
+        csv_path, columns, batch_size=args.batch,
+        shard_client=shard_client, auto_report=True,
+    )
+
+    def specs():
+        return [
+            EmbeddingSpec("emb", cfg.emb_dim, initializer="normal",
+                          init_scale=0.01, seed=3),
+            EmbeddingSpec("wide", 1, initializer="zeros"),
+        ]
+
+    class Adapter:
+        def __init__(self, model):
+            self.model = model
+            self.coll = model.coll
+
+        def _unpack(self, features):
+            cat = np.stack(
+                [features[f"c{i}"] for i in range(cfg.n_fields)], axis=1
+            )
+            dense = np.stack(
+                [features[f"d{i}"] for i in range(cfg.n_dense)], axis=1
+            )
+            return cat, dense
+
+        def train_step(self, features, labels):
+            cat, dense = self._unpack(features)
+            return self.model.train_step(cat, dense, labels)
+
+        def eval_metrics(self, features, labels):
+            cat, dense = self._unpack(features)
+            p = self.model.predict(cat, dense)
+            eps = 1e-6
+            return {"loss": float(-np.mean(
+                labels * np.log(p + eps)
+                + (1 - labels) * np.log(1 - p + eps)
+            ))}
+
+        def save(self, d, delta_only=False):
+            self.model.save(d, delta_only=delta_only)
+
+        def restore(self, d):
+            self.model.restore(d)
+
+    def model_fn(mode, params, cluster):
+        model = DeepFM(cfg, optimizer=GroupAdam(lr=5e-3), dense_lr=5e-3)
+        model.coll.close()
+        model.coll = DistributedEmbedding(specs(), addrs)
+        return Adapter(model)
+
+    est = Estimator(
+        model_fn,
+        config=RunConfig(
+            model_dir=args.model_dir, save_steps=10,
+            incremental_save_steps=5, keep_checkpoint_max=2,
+            log_steps=5, ps_failure_grace_s=45.0,
+        ),
+        cluster=spec,
+        master_client=client,
+        shard_client=shard_client,
+        reader=reader,
+    )
+    est.model.coll.version = client.get_ps_version().version
+    est.failover._poll = 1.0
+
+    resumed = est.restore_latest()
+    if resumed is not None:
+        est.global_step = resumed
+        print(f"[est-worker] resumed from step {resumed}", flush=True)
+
+    class StepPrinter:
+        def begin(self, estimator):
+            pass
+
+        def after_run(self, estimator, step, loss):
+            print(f"[est-worker] step {step} loss {loss:.4f}", flush=True)
+            if estimator.failover and estimator.failover.changes:
+                changes = estimator.failover.changes
+                estimator.failover.changes = []
+                print(f"[est-worker] ps change {changes}", flush=True)
+
+        def end(self, estimator, step):
+            pass
+
+    loss = est.train(
+        lambda: iter(reader), max_steps=args.steps, hooks=[StepPrinter()]
+    )
+    from dlrover_tpu.common.constants import NodeStatus
+
+    client.report_node_status(NodeStatus.SUCCEEDED)
+    print(
+        f"[est-worker] done at step {est.global_step} loss {loss:.4f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

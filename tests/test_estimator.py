@@ -875,3 +875,56 @@ def test_restore_rejects_orphan_delta(tmp_path):
         demb.close()
     finally:
         s0.stop()
+
+
+def test_wire_error_waits_for_reseal_and_restores(tmp_path):
+    """A PS dies UNDER a train step (worker sees the wire error before
+    the master does): the step waits for the master's ring version to
+    move, adopts through the normal failover path, restores from the
+    checkpoint, and training rides through — the reference exits the
+    worker here (tensorflow_failover.py:133)."""
+    s0, s1, s2 = _start_server(), _start_server(), _start_server()
+    try:
+        master = FakePsMaster()
+        master.set_ring(
+            ["s0", "s1"], {"s0": s0.address, "s1": s1.address}
+        )
+        est = Estimator(
+            make_model_fn({"s0": s0.address, "s1": s1.address}),
+            config=RunConfig(
+                model_dir=str(tmp_path), save_steps=5, log_steps=50,
+                ps_failure_grace_s=30,
+            ),
+            master_client=master,
+        )
+        est.model.coll.version = master.version
+        est.train(batch_input_fn(), max_steps=5)  # full ckpt-5
+
+        # kill s1; the master only announces the re-sealed ring on the
+        # SECOND version query after the kill — the pre-step poll sees
+        # the stale ring, the step hits the dead socket, and
+        # _await_reseal has to wait the master out
+        s1.stop()
+        state = {"calls": 0}
+        orig = master.get_ps_version
+
+        def delayed():
+            state["calls"] += 1
+            if state["calls"] == 2:
+                master.set_ring(
+                    ["s0", "s2"],
+                    {"s0": s0.address, "s2": s2.address},
+                )
+            return orig()
+
+        master.get_ps_version = delayed
+
+        loss = est.train(batch_input_fn(seed=4), max_steps=10)
+        assert np.isfinite(loss) and est.global_step == 10
+        assert est.failover.changes == ["ps_failure"]
+        assert est.model.coll.server_names == ["s0", "s2"]
+        assert int(est.model.coll.stats()["s2"]["emb"]) > 0
+        est.model.close()
+    finally:
+        s0.stop()
+        s2.stop()

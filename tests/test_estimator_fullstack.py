@@ -1,0 +1,175 @@
+"""Estimator full-stack drill — the reference's §3.5 call stack as one
+live composition (SURVEY.md §3.5: trainer entry → EstimatorExecutor →
+TF_CONFIG from the master → TensorflowFailover → shard-report hook →
+TaskManager shards):
+
+- a real master process,
+- two KvServer PROCESSES that register as PS nodes over the wire
+  (PsClusterCallback builds the versioned ring),
+- an estimator worker process training from master-issued data shards,
+- a PS killed mid-run (the platform — this test — reports the node
+  FAILED, as the k8s watcher would), a replacement registering,
+- the worker riding through via the wire-error → ring-reseal →
+  checkpoint-restore path, to completion.
+
+The reference survives this by exiting the worker and restarting it
+from the checkpoint; here the worker never exits.
+"""
+
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+from elastic_harness import (
+    REPO,
+    collect,
+    drain,
+    drain_now,
+    kill_tree,
+    make_env,
+    start_master,
+)
+
+RECOVERY_BUDGET_S = 60.0
+
+PS_CODE = """
+import sys, threading
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.sparse import GroupAdam
+from dlrover_tpu.sparse.embedding import EmbeddingSpec
+from dlrover_tpu.sparse.server import KvServer, register_server
+
+addr, node_id = sys.argv[1], int(sys.argv[2])
+server = KvServer(
+    [
+        EmbeddingSpec("emb", 8, initializer="normal", init_scale=0.01,
+                      seed=3),
+        EmbeddingSpec("wide", 1, initializer="zeros"),
+    ],
+    optimizer=GroupAdam(lr=5e-3),
+)
+c = MasterClient(addr, node_id=node_id)
+c.register_node(node_type="ps")
+register_server(c, f"ps-{node_id}", server.address)
+print(f"[ps] ready ps-{node_id} port {server.address[1]}", flush=True)
+threading.Event().wait()
+"""
+
+
+def _spawn_ps(run_id, addr, node_id):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", PS_CODE, addr, str(node_id)],
+        cwd=REPO,
+        env=make_env(run_id),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    q = drain(proc)
+    lines = []
+    ready = collect(
+        q, lines, until=lambda l: "[ps] ready" in l,
+        deadline=time.time() + 60,
+    )
+    assert ready, f"ps-{node_id} never became ready:\n" + "".join(lines)
+    return proc, q, lines
+
+
+@pytest.mark.slow
+def test_estimator_fullstack_ps_failure(tmp_path):
+    run_id = f"estfs_{uuid.uuid4().hex[:8]}"
+    master = ps0 = ps1 = ps2 = worker = None
+    try:
+        master, mq, mlines, addr = start_master(run_id)
+        ps0, _, _ = _spawn_ps(run_id, addr, 100)
+        ps1, _, _ = _spawn_ps(run_id, addr, 101)
+
+        worker = subprocess.Popen(
+            [
+                sys.executable,
+                "examples/train_estimator_elastic.py",
+                "--steps", "40",
+                "--batch", "256",
+                "--model-dir", str(tmp_path / "model"),
+            ],
+            cwd=REPO,
+            env=make_env(
+                run_id,
+                {
+                    "DLROVER_TPU_MASTER_ADDR": addr,
+                    "DLROVER_TPU_NODE_ID": "0",
+                },
+            ),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        wq = drain(worker)
+        wlines = []
+
+        line = collect(
+            wq, wlines,
+            until=lambda l: "[est-worker] cluster" in l,
+            deadline=time.time() + 90,
+        )
+        assert line and '"ps-100"' in line and '"ps-101"' in line, (
+            "worker never synthesized the PS cluster spec:\n"
+            + "".join(wlines)
+        )
+
+        # past the first FULL checkpoint (save_steps=10) so the failure
+        # has something to restore from
+        line = collect(
+            wq, wlines,
+            until=lambda l: "[est-worker] step 12 " in l,
+            deadline=time.time() + 240,
+        )
+        assert line, "worker never reached step 12:\n" + "".join(wlines)
+
+        # ---- kill ps-100; the platform (this test) reports the node
+        # FAILED the way the pod watcher would, and a replacement joins
+        t_kill = time.time()
+        ps0.kill()
+        ps0.wait(timeout=10)
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.common.constants import NodeStatus
+
+        watcher = MasterClient(addr, node_id=100)
+        watcher.report_node_status(NodeStatus.FAILED, exit_reason="killed")
+        ps2, _, _ = _spawn_ps(run_id, addr, 102)
+
+        line = collect(
+            wq, wlines,
+            until=lambda l: "[est-worker] ps change" in l,
+            deadline=t_kill + RECOVERY_BUDGET_S,
+        )
+        assert line and "ps_failure" in line, (
+            "worker never failed over the PS ring:\n"
+            + "".join(wlines[-40:])
+        )
+        recovery_s = time.time() - t_kill
+        assert recovery_s < RECOVERY_BUDGET_S, recovery_s
+
+        line = collect(
+            wq, wlines,
+            until=lambda l: "[est-worker] done at step 40" in l,
+            deadline=time.time() + 300,
+        )
+        assert line, (
+            "worker never finished after the failover:\n"
+            + "".join(wlines[-40:])
+        )
+        assert worker.wait(timeout=60) == 0
+        assert master.poll() is None, "master died during the drill"
+        drain_now(mq, mlines)
+    finally:
+        for p in (worker, ps0, ps1, ps2, master):
+            if p is not None and p.poll() is None:
+                try:
+                    kill_tree(p)
+                except Exception:
+                    p.kill()
