@@ -928,3 +928,28 @@ def test_wire_error_waits_for_reseal_and_restores(tmp_path):
     finally:
         s0.stop()
         s2.stop()
+
+
+def test_cold_table_snapshot_keeps_slot_width(tmp_path):
+    """A table with no admitted rows still snapshots at the ring's full
+    row width (dim × (1 + optimizer slots)) — probed over the wire — so
+    a local KvTable under the same optimizer can restore the file."""
+    s0 = _start_server()
+    try:
+        demb = DistributedEmbedding(_specs(), {"s0": s0.address})
+        # touch ONLY "emb"; "wide" stays cold
+        demb.pull({"emb": np.arange(20, dtype=np.int64)})
+        written = demb.save(str(tmp_path))
+        assert written["wide"] == 0
+        with np.load(str(tmp_path / "wide.full.npz")) as z:
+            n_slots = int(z["n_slots"])
+        from dlrover_tpu.sparse import GroupAdam as GA
+
+        assert n_slots == GA(lr=1e-2).required_slots
+        # a local collection under the same optimizer restores it
+        local = EmbeddingCollection(_specs(), optimizer=GroupAdam(lr=1e-2))
+        local.restore(str(tmp_path))
+        local.close()
+        demb.close()
+    finally:
+        s0.stop()
