@@ -459,6 +459,11 @@ class PsVersionRequest:
 class PsVersionResponse:
     version: int = 0
     servers: List[str] = field(default_factory=list)
+    # Brain hot-shard rebalance weights (ElasticPsService.set_weights);
+    # trainers feed them to sparse.partition so a weight change
+    # actually re-routes keys — without this field the rebalance would
+    # bump the version but never reach the workers
+    weights: Dict[str, float] = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------

@@ -392,7 +392,9 @@ class MasterServicer:
         else:
             version = self.ps_service.get_node_version(m.node_id)
         return msgs.PsVersionResponse(
-            version=version, servers=list(self.ps_service.get_servers())
+            version=version,
+            servers=list(self.ps_service.get_servers()),
+            weights=self.ps_service.get_weights(),
         )
 
     def _get_running_nodes(self, m: msgs.RunningNodesRequest):

@@ -762,8 +762,15 @@ def resolve_ring(client, names) -> Optional[Dict[str, Tuple[str, int]]]:
     return addrs
 
 
-def ring_weights(client) -> Optional[Dict[str, float]]:
-    """Brain hot-shard rebalance weights, when the client exposes them."""
+def ring_weights(client, resp=None) -> Optional[Dict[str, float]]:
+    """Brain hot-shard rebalance weights: preferentially from the
+    PsVersionResponse itself (the wire path — servicer fills them from
+    ElasticPsService), falling back to a client-side ``get_ps_weights``
+    for duck-typed clients."""
+    if resp is not None:
+        w = getattr(resp, "weights", None)
+        if w:
+            return dict(w)
     get_w = getattr(client, "get_ps_weights", None)
     if callable(get_w):
         return get_w() or None
@@ -787,7 +794,7 @@ def sync_with_master(demb: "DistributedEmbedding", client) -> bool:
     addrs = resolve_ring(client, resp.servers)
     if addrs is None:
         return False
-    weights = ring_weights(client)
+    weights = ring_weights(client, resp)
     moved = demb.set_servers(addrs, weights=weights)
     demb.version = resp.version
     logger.info(

@@ -439,7 +439,7 @@ class PsFailover:
         addrs = resolve_ring(self._client, list(resp.servers))
         if addrs is None:
             return None
-        weights = ring_weights(self._client)
+        weights = ring_weights(self._client, resp)
         old = set(self._demb.server_names)
         new = set(resp.servers)
         change = "scaling" if len(old) != len(new) else "migrating"

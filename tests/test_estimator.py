@@ -953,3 +953,56 @@ def test_cold_table_snapshot_keeps_slot_width(tmp_path):
         demb.close()
     finally:
         s0.stop()
+
+
+def test_brain_weights_reach_trainers_over_the_wire():
+    """A Brain hot-shard rebalance (ElasticPsService.set_weights) must
+    actually move keys on the trainers: the weights ride the
+    PsVersionResponse over the real wire and sync_with_master
+    re-partitions with them.  Before this field existed the version
+    bumped but workers re-routed with their OLD weights — the rebalance
+    silently no-opped."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.sparse.server import (
+        register_server,
+        resolve_ring,
+        sync_with_master,
+    )
+
+    master = LocalJobMaster(port=0, num_workers=1)
+    master.prepare()
+    s0, s1 = _start_server(), _start_server()
+    try:
+        for node_id, server in ((100, s0), (101, s1)):
+            c = MasterClient(master.addr, node_id=node_id)
+            c.register_node(node_type=NodeType.PS)
+            register_server(c, f"ps-{node_id}", server.address)
+        worker = MasterClient(master.addr, node_id=0)
+        worker.register_node()
+        addrs = resolve_ring(worker, ["ps-100", "ps-101"])
+        demb = DistributedEmbedding(_specs(), addrs)
+        demb.version = worker.get_ps_version().version
+
+        keys = np.arange(4000, dtype=np.int64)
+        demb.pull({"emb": keys})
+        before = {k: v["emb"] for k, v in demb.stats().items()}
+        # unweighted HRW: roughly balanced
+        assert abs(before["ps-100"] - before["ps-101"]) < 1200, before
+
+        # the Brain decides ps-100 should carry 3x the keys
+        master.ps_service.set_weights({"ps-100": 3.0, "ps-101": 1.0})
+        resp = worker.get_ps_version()
+        assert resp.weights == {"ps-100": 3.0, "ps-101": 1.0}
+        assert sync_with_master(demb, worker) is True
+        assert demb._weights == {"ps-100": 3.0, "ps-101": 1.0}
+        after = {k: v["emb"] for k, v in demb.stats().items()}
+        # weighted HRW: ~75/25 split, and no rows lost
+        assert after["ps-100"] > 1.5 * after["ps-101"], after
+        assert after["ps-100"] + after["ps-101"] == len(keys)
+        demb.close()
+    finally:
+        master.stop()
+        s0.stop()
+        s1.stop()
