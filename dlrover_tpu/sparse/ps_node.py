@@ -1,0 +1,235 @@
+"""The PS node process wrapper: serve a KvServer as a first-class
+cluster member.
+
+Reference: dlrover/python/elastic_agent/tensorflow/elastic_ps.py — the
+PS-side process wrapper of the elastic TF PS stack (§3.5; the master
+side is master/elastic_ps.py here).  What this runner owns:
+
+- **Registration**: joins the master as ``node_type="ps"`` — the
+  master's PsClusterCallback adds it to the versioned HRW ring — and
+  publishes its serving address in the KV store (the discovery channel
+  trainers resolve, sparse/server.py register_server).
+- **Heartbeats**: the master's heartbeat monitor marks silent nodes
+  dead after ``heartbeat_timeout_s`` (node_manager.py) — a PS that
+  registers but never heartbeats would be evicted from the ring while
+  perfectly healthy.  The run loop heartbeats on an interval.
+- **Graceful drain** (SIGTERM/SIGINT): report SUCCEEDED — the ring
+  drops this node and bumps the version — then KEEP SERVING through a
+  grace window so trainers adopt the new ring with *migration*: their
+  ``set_servers`` exports this server's rows (values + optimizer slots
+  + admission state) to the new owners and deletes them here.  Exit
+  early once every table is empty.  Planned scale-in therefore loses
+  nothing; only a hard kill needs the checkpoint-restore path.
+
+CLI (console script ``dlrover-tpu-ps``)::
+
+    dlrover-tpu-ps --master-addr host:port --node-id 100 \
+        --table emb:16:normal:0.01 --table wide:1:zeros \
+        --optimizer group_adam --lr 5e-3
+"""
+
+import argparse
+import os
+import signal
+import threading
+import time
+from typing import List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def parse_table(spec: str):
+    """``name:dim[:initializer[:init_scale[:seed]]]`` → EmbeddingSpec.
+
+    The seed MUST match the job's trainer-side EmbeddingSpec: cold-row
+    initialization streams from it, and a divergent seed means a PS
+    replacement initializes rows differently than the job declared."""
+    from dlrover_tpu.sparse.embedding import EmbeddingSpec
+
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise ValueError(
+            f"table spec {spec!r}: want "
+            "name:dim[:initializer[:scale[:seed]]]"
+        )
+    kwargs = {}
+    if len(parts) > 2:
+        kwargs["initializer"] = parts[2]
+    if len(parts) > 3:
+        kwargs["init_scale"] = float(parts[3])
+    if len(parts) > 4:
+        kwargs["seed"] = int(parts[4])
+    return EmbeddingSpec(parts[0], int(parts[1]), **kwargs)
+
+
+def make_sparse_optimizer(name: str, lr: float):
+    from dlrover_tpu import sparse as sp
+
+    table = {
+        "group_adam": sp.GroupAdam,
+        "group_adagrad": sp.GroupAdagrad,
+        "group_amsgrad": sp.GroupAMSGrad,
+        "group_adabelief": sp.GroupAdaBelief,
+        "group_ftrl": sp.SparseGroupFtrl,
+        "sgd": sp.SparseSGD,
+    }
+    cls = table.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown sparse optimizer {name!r} (have {sorted(table)})"
+        )
+    return cls(lr=lr)
+
+
+class PsNode:
+    """One PS process: KvServer + master membership + drain choreography."""
+
+    def __init__(
+        self,
+        master_addr: str,
+        node_id: int,
+        specs,
+        optimizer=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_interval_s: float = 30.0,
+        drain_grace_s: float = 60.0,
+    ):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.sparse.server import KvServer
+
+        self.server = KvServer(specs, optimizer=optimizer, host=host,
+                               port=port)
+        self.client = MasterClient(master_addr, node_id=node_id)
+        self.node_id = node_id
+        self.name = None  # set on register
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.drain_grace_s = drain_grace_s
+        self._stop = threading.Event()
+        self._abort = threading.Event()
+
+    def register(self) -> str:
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.sparse.server import register_server
+
+        self.client.register_node(node_type=NodeType.PS)
+        self.name = f"{NodeType.PS}-{self.node_id}"
+        register_server(self.client, self.name, self.server.address)
+        logger.info(
+            "PS node %s serving %d table(s) at %s",
+            self.name, len(self.server.tables), self.server.address,
+        )
+        return self.name
+
+    def request_drain(self, *_args):
+        if self._stop.is_set():
+            # second signal during the drain: stop NOW instead of
+            # riding out the grace window
+            logger.warning("second stop signal: aborting the drain")
+            self._abort.set()
+        else:
+            self._stop.set()
+
+    def _tables_empty(self) -> bool:
+        return all(len(t) == 0 for t in self.server.tables.values())
+
+    def drain(self):
+        """Leave the ring cleanly, then serve until trainers have
+        migrated the rows away (or the grace window expires)."""
+        from dlrover_tpu.common.constants import NodeStatus
+
+        logger.info("PS node %s draining: leaving the ring", self.name)
+        reported = False
+        try:
+            self.client.report_node_status(NodeStatus.SUCCEEDED)
+            reported = True
+        except Exception as e:
+            # master unreachable: the ring can never learn we left, so
+            # no trainer will come to migrate — waiting is pointless
+            logger.warning(
+                "drain report failed (%s); skipping the grace wait", e
+            )
+        deadline = time.monotonic() + (
+            self.drain_grace_s if reported else 0.0
+        )
+        while time.monotonic() < deadline and not self._abort.is_set():
+            if self._tables_empty():
+                logger.info(
+                    "PS node %s drained: all rows migrated", self.name
+                )
+                break
+            time.sleep(0.5)
+        else:
+            left = {
+                name: len(t) for name, t in self.server.tables.items()
+                if len(t)
+            }
+            if left:
+                logger.warning(
+                    "PS node %s stopping with rows left: %s (trainers "
+                    "restore them from checkpoints)", self.name, left,
+                )
+        self.server.stop()
+
+    def run(self):
+        """Blocking serve loop: heartbeat until drain is requested."""
+        if self.name is None:
+            self.register()
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self.client.report_heartbeat()
+            except Exception as e:  # master restart: keep serving
+                logger.warning("heartbeat failed: %s", e)
+        self.drain()
+
+
+def main(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        description="Serve a sparse PS node (KvServer) under the master"
+    )
+    p.add_argument(
+        "--master-addr",
+        default=os.environ.get("DLROVER_TPU_MASTER_ADDR", ""),
+    )
+    p.add_argument(
+        "--node-id",
+        type=int,
+        default=int(os.environ.get("DLROVER_TPU_NODE_ID", "0")),
+    )
+    p.add_argument(
+        "--table", action="append", required=True,
+        help="name:dim[:initializer[:init_scale]] (repeatable)",
+    )
+    p.add_argument("--optimizer", default="group_adam")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--heartbeat-interval", type=float, default=30.0)
+    p.add_argument("--drain-grace", type=float, default=60.0)
+    args = p.parse_args(argv)
+    if not args.master_addr:
+        p.error("--master-addr (or DLROVER_TPU_MASTER_ADDR) is required")
+
+    node = PsNode(
+        args.master_addr,
+        args.node_id,
+        [parse_table(t) for t in args.table],
+        optimizer=make_sparse_optimizer(args.optimizer, args.lr),
+        host=args.host,
+        port=args.port,
+        heartbeat_interval_s=args.heartbeat_interval,
+        drain_grace_s=args.drain_grace,
+    )
+    signal.signal(signal.SIGTERM, node.request_drain)
+    signal.signal(signal.SIGINT, node.request_drain)
+    node.register()
+    # the port line is the discovery contract for process harnesses
+    print(f"[ps] ready {node.name} port {node.server.address[1]}",
+          flush=True)
+    node.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -769,7 +769,10 @@ def ring_weights(client, resp=None) -> Optional[Dict[str, float]]:
     for duck-typed clients."""
     if resp is not None:
         w = getattr(resp, "weights", None)
-        if w:
+        if w is not None:
+            # the wire value is authoritative, INCLUDING {}: a Brain
+            # weight-clear must reach trainers (set_servers treats {}
+            # as "unweighted", None as "keep current")
             return dict(w)
     get_w = getattr(client, "get_ps_weights", None)
     if callable(get_w):

@@ -1006,3 +1006,51 @@ def test_brain_weights_reach_trainers_over_the_wire():
         master.stop()
         s0.stop()
         s1.stop()
+
+
+def test_brain_weight_clear_reaches_trainers():
+    """set_weights({}) — a rebalance reset — must also reach trainers:
+    the wire value is authoritative INCLUDING the empty dict (returning
+    None would silently keep the old 3:1 routing on long-running
+    workers while fresh workers route unweighted — split-brain key
+    ownership)."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.sparse.server import (
+        register_server,
+        resolve_ring,
+        sync_with_master,
+    )
+
+    master = LocalJobMaster(port=0, num_workers=1)
+    master.prepare()
+    s0, s1 = _start_server(), _start_server()
+    try:
+        for node_id, server in ((100, s0), (101, s1)):
+            c = MasterClient(master.addr, node_id=node_id)
+            c.register_node(node_type=NodeType.PS)
+            register_server(c, f"ps-{node_id}", server.address)
+        worker = MasterClient(master.addr, node_id=0)
+        addrs = resolve_ring(worker, ["ps-100", "ps-101"])
+        demb = DistributedEmbedding(_specs(), addrs)
+        demb.version = worker.get_ps_version().version
+        keys = np.arange(3000, dtype=np.int64)
+        demb.pull({"emb": keys})
+
+        master.ps_service.set_weights({"ps-100": 4.0, "ps-101": 1.0})
+        assert sync_with_master(demb, worker) is True
+        skewed = {k: v["emb"] for k, v in demb.stats().items()}
+        assert skewed["ps-100"] > 2 * skewed["ps-101"], skewed
+
+        master.ps_service.set_weights({})  # rebalance reset
+        assert sync_with_master(demb, worker) is True
+        assert demb._weights == {}
+        flat = {k: v["emb"] for k, v in demb.stats().items()}
+        assert abs(flat["ps-100"] - flat["ps-101"]) < 900, flat
+        assert flat["ps-100"] + flat["ps-101"] == len(keys)
+        demb.close()
+    finally:
+        master.stop()
+        s0.stop()
+        s1.stop()

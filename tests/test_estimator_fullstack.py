@@ -36,33 +36,20 @@ from elastic_harness import (
 
 RECOVERY_BUDGET_S = 60.0
 
-PS_CODE = """
-import sys, threading
-from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.sparse import GroupAdam
-from dlrover_tpu.sparse.embedding import EmbeddingSpec
-from dlrover_tpu.sparse.server import KvServer, register_server
-
-addr, node_id = sys.argv[1], int(sys.argv[2])
-server = KvServer(
-    [
-        EmbeddingSpec("emb", 8, initializer="normal", init_scale=0.01,
-                      seed=3),
-        EmbeddingSpec("wide", 1, initializer="zeros"),
-    ],
-    optimizer=GroupAdam(lr=5e-3),
-)
-c = MasterClient(addr, node_id=node_id)
-c.register_node(node_type="ps")
-register_server(c, f"ps-{node_id}", server.address)
-print(f"[ps] ready ps-{node_id} port {server.address[1]}", flush=True)
-threading.Event().wait()
-"""
-
-
-def _spawn_ps(run_id, addr, node_id):
+def _spawn_ps(run_id, addr, node_id, drain_grace=30):
+    """Run the first-class PS node process (dlrover-tpu-ps): KvServer +
+    registration + heartbeats + graceful drain."""
     proc = subprocess.Popen(
-        [sys.executable, "-c", PS_CODE, addr, str(node_id)],
+        [
+            sys.executable, "-m", "dlrover_tpu.sparse.ps_node",
+            "--master-addr", addr,
+            "--node-id", str(node_id),
+            "--table", "emb:8:normal:0.01:3",
+            "--table", "wide:1:zeros",
+            "--optimizer", "group_adam", "--lr", "5e-3",
+            "--heartbeat-interval", "2",
+            "--drain-grace", str(drain_grace),
+        ],
         cwd=REPO,
         env=make_env(run_id),
         stdout=subprocess.PIPE,
@@ -168,6 +155,84 @@ def test_estimator_fullstack_ps_failure(tmp_path):
         drain_now(mq, mlines)
     finally:
         for p in (worker, ps0, ps1, ps2, master):
+            if p is not None and p.poll() is None:
+                try:
+                    kill_tree(p)
+                except Exception:
+                    p.kill()
+
+
+@pytest.mark.slow
+def test_ps_node_graceful_drain():
+    """Planned scale-in loses nothing: SIGTERM a PS node — it reports
+    SUCCEEDED (ring drops it, version bumps) but KEEPS SERVING through
+    its drain grace, so the trainers' adoption migrates its rows
+    (values + optimizer slots + admission state) to the survivors; the
+    process exits 0 once its tables are empty.  Only a hard kill needs
+    the checkpoint-restore path."""
+    import signal as sig
+
+    import numpy as np
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.sparse.embedding import EmbeddingSpec
+    from dlrover_tpu.sparse.server import (
+        DistributedEmbedding,
+        resolve_ring,
+        sync_with_master,
+    )
+
+    run_id = f"psdrain_{uuid.uuid4().hex[:8]}"
+    master = ps0 = ps1 = None
+    try:
+        master, mq, mlines, addr = start_master(run_id)
+        ps0, _, _ = _spawn_ps(run_id, addr, 100)
+        ps1, _, _ = _spawn_ps(run_id, addr, 101)
+
+        # the trainer must speak the PS processes' wire token (run id)
+        os.environ["DLROVER_TPU_RUN_ID"] = run_id
+        try:
+            worker = MasterClient(addr, node_id=0)
+            worker.register_node()
+            addrs = resolve_ring(worker, ["ps-100", "ps-101"])
+            assert addrs is not None
+            specs = [
+                EmbeddingSpec("emb", 8, initializer="normal",
+                              init_scale=0.01, seed=3),
+                EmbeddingSpec("wide", 1, initializer="zeros"),
+            ]
+            demb = DistributedEmbedding(specs, addrs)
+            demb.version = worker.get_ps_version().version
+            keys = np.arange(2000, dtype=np.int64)
+            demb.pull({"emb": keys})
+            before = np.asarray(demb.pull_frozen({"emb": keys})["emb"][0])
+            counts = {k: v["emb"] for k, v in demb.stats().items()}
+            assert counts["ps-100"] > 0  # it really holds rows to drain
+
+            # planned scale-in: SIGTERM ps-100
+            ps0.send_signal(sig.SIGTERM)
+            deadline = time.time() + 30
+            rerouted = False
+            while time.time() < deadline:
+                if sync_with_master(demb, worker):
+                    rerouted = True
+                    break
+                time.sleep(0.5)
+            assert rerouted, "ring never re-sealed after the drain signal"
+            assert demb.server_names == ["ps-101"]
+
+            # every row survived, byte for byte — migrated, not reborn
+            after = np.asarray(demb.pull_frozen({"emb": keys})["emb"][0])
+            np.testing.assert_allclose(after, before, atol=1e-6)
+            assert demb.stats()["ps-101"]["emb"] == len(keys)
+
+            # the drained process exits 0 once empty (inside its grace)
+            assert ps0.wait(timeout=30) == 0
+            demb.close()
+        finally:
+            os.environ.pop("DLROVER_TPU_RUN_ID", None)
+    finally:
+        for p in (ps0, ps1, master):
             if p is not None and p.poll() is None:
                 try:
                     kill_tree(p)
