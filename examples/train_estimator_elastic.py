@@ -67,7 +67,12 @@ def main():
     )
 
     client = build_master_client()
-    client.register_node()
+    if "DLROVER_TPU_RDZV_ROUND" not in os.environ:
+        # standalone run: register ourselves; under the elastic agent
+        # (which sets the rendezvous env) the node is already registered
+        client.register_node()
+    # the worker-kill drill (test_estimator_fullstack) targets this pid
+    print(f"[est-worker] pid {os.getpid()}", flush=True)
 
     # wait for the PS ring: names from ElasticPsService, addresses from
     # the KV store (the reference's wait_for_tf_config analog)

@@ -36,7 +36,7 @@ from elastic_harness import (
 
 RECOVERY_BUDGET_S = 60.0
 
-def _spawn_ps(run_id, addr, node_id, drain_grace=30):
+def _spawn_ps(run_id, addr, node_id, drain_grace=30, env_extra=None):
     """Run the first-class PS node process (dlrover-tpu-ps): KvServer +
     registration + heartbeats + graceful drain."""
     proc = subprocess.Popen(
@@ -51,7 +51,7 @@ def _spawn_ps(run_id, addr, node_id, drain_grace=30):
             "--drain-grace", str(drain_grace),
         ],
         cwd=REPO,
-        env=make_env(run_id),
+        env=make_env(run_id, env_extra),
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
@@ -233,6 +233,98 @@ def test_ps_node_graceful_drain():
             os.environ.pop("DLROVER_TPU_RUN_ID", None)
     finally:
         for p in (ps0, ps1, master):
+            if p is not None and p.poll() is None:
+                try:
+                    kill_tree(p)
+                except Exception:
+                    p.kill()
+
+
+@pytest.mark.slow
+def test_estimator_worker_restart_under_agent(tmp_path):
+    """§3.5's WORKER-failover leg under the real launcher/agent: the
+    estimator worker is SIGKILLed mid-run, the agent restarts it, and
+    the restarted process resumes from the latest checkpoint (model +
+    ring snapshot + dataset position) and finishes — the reference's
+    TF_CONFIG-failover restart, supervised by our agent instead of
+    torch elastic."""
+    import signal as sig
+
+    from elastic_harness import launch_agent
+
+    run_id = f"estrestart_{uuid.uuid4().hex[:8]}"
+    wire_token = f"{run_id}-wire"
+    env_extra = {"DLROVER_TPU_WIRE_TOKEN": wire_token}
+    master = ps0 = ps1 = agent = None
+    try:
+        master, mq, mlines, addr = start_master(
+            run_id, env_extra=env_extra
+        )
+        ps0, _, _ = _spawn_ps(run_id, addr, 100, env_extra=env_extra)
+        ps1, _, _ = _spawn_ps(run_id, addr, 101, env_extra=env_extra)
+
+        agent = launch_agent(
+            run_id, 0, addr,
+            train_args=[
+                "--steps", "40", "--batch", "256",
+                "--model-dir", str(tmp_path / "model"),
+            ],
+            nnodes="1",
+            script="examples/train_estimator_elastic.py",
+            env_extra=env_extra,
+        )
+        aq = drain(agent)
+        alines = []
+
+        pid_line = collect(
+            aq, alines,
+            until=lambda l: "[est-worker] pid " in l,
+            deadline=time.time() + 120,
+        )
+        assert pid_line, (
+            "worker never started under the agent:\n" + "".join(alines)
+        )
+        worker_pid = int(pid_line.split("pid", 1)[1].strip())
+
+        line = collect(
+            aq, alines,
+            until=lambda l: "[est-worker] step 12 " in l,
+            deadline=time.time() + 240,
+        )
+        assert line, "worker never reached step 12:\n" + "".join(alines)
+
+        # SIGKILL only the worker; the agent must notice, persist
+        # nothing extra (estimator checkpoints are its own), and restart
+        t_kill = time.time()
+        os.kill(worker_pid, sig.SIGKILL)
+
+        line = collect(
+            aq, alines,
+            until=lambda l: "[est-worker] resumed from step" in l,
+            deadline=t_kill + RECOVERY_BUDGET_S,
+        )
+        assert line, (
+            "restarted worker never resumed from the checkpoint:\n"
+            + "".join(alines[-40:])
+        )
+        resumed_step = int(line.rsplit("step", 1)[1].strip())
+        assert resumed_step >= 10  # at least the step-10 full save
+        recovery_s = time.time() - t_kill
+        assert recovery_s < RECOVERY_BUDGET_S, recovery_s
+
+        line = collect(
+            aq, alines,
+            until=lambda l: "[est-worker] done at step 40" in l,
+            deadline=time.time() + 300,
+        )
+        assert line, (
+            "restarted worker never finished:\n" + "".join(alines[-40:])
+        )
+        assert agent.wait(timeout=120) == 0
+        assert master.poll() is None
+        drain_now(mq, mlines)
+    finally:
+        for p in (agent, ps0, ps1, master):
             if p is not None and p.poll() is None:
                 try:
                     kill_tree(p)
