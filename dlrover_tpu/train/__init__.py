@@ -8,6 +8,7 @@ from dlrover_tpu.train.estimator import (  # noqa: F401
     PsFailover,
     RunConfig,
     TrainSpec,
+    run_evaluator,
     train_and_evaluate,
 )
 from dlrover_tpu.train.optimizer import make_optimizer  # noqa: F401

@@ -949,6 +949,44 @@ def train_and_evaluate(
     return metrics
 
 
+def run_evaluator(
+    estimator: Estimator,
+    eval_spec: EvalSpec,
+    poll_interval_s: float = 10.0,
+    stop_at_step: Optional[int] = None,
+) -> Dict[str, float]:
+    """The distributed EVALUATOR role (reference:
+    tf.estimator.train_and_evaluate's evaluator task — a separate
+    process that watches the model_dir, evaluates each new checkpoint,
+    and keeps the best export; throttle_secs becomes
+    ``poll_interval_s``).  Runs until ``stop_at_step``'s checkpoint has
+    been evaluated (None = forever).  Sparse-tier models re-route
+    through the failover poll inside evaluate(), so the evaluator
+    survives PS membership changes like a trainer does."""
+    last_evaled = None
+    metrics: Dict[str, float] = {}
+    while True:
+        step = estimator.latest_checkpoint()
+        if step is not None and step != last_evaled:
+            estimator.restore_latest()
+            estimator.global_step = step
+            metrics = estimator.evaluate(
+                eval_spec.input_fn, steps=eval_spec.steps
+            )
+            estimator.export_best(metrics, eval_spec.metric)
+            last_evaled = step
+            logger.info(
+                "evaluator: checkpoint step %d → %s", step, metrics
+            )
+        if (
+            stop_at_step is not None
+            and last_evaled is not None
+            and last_evaled >= stop_at_step
+        ):
+            return metrics
+        time.sleep(poll_interval_s)
+
+
 # ---------------------------------------------------------------------------
 # Executor (reference: EstimatorExecutor.prepare + the launcher glue)
 # ---------------------------------------------------------------------------

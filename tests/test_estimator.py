@@ -1054,3 +1054,46 @@ def test_brain_weight_clear_reaches_trainers():
         master.stop()
         s0.stop()
         s1.stop()
+
+
+def test_evaluator_role_watches_checkpoints(tmp_path):
+    """A separate evaluator-role estimator (task_type='evaluator', not
+    chief) watches the model_dir, evaluates each new checkpoint, and
+    owns the best export — the reference's evaluator task in
+    train_and_evaluate."""
+    from dlrover_tpu.train.estimator import run_evaluator
+
+    s0 = _start_server()
+    try:
+        addrs = {"s0": s0.address}
+        cfg = RunConfig(model_dir=str(tmp_path), save_steps=5,
+                        log_steps=50)
+        trainer = Estimator(make_model_fn(addrs), config=cfg)
+        trainer.train(batch_input_fn(), max_steps=10)
+        trainer.model.close()
+
+        evaluator = Estimator(
+            make_model_fn(addrs),
+            config=cfg,
+            cluster=ClusterSpec(
+                cluster={"worker": ["w-0"], "evaluator": ["e-0"]},
+                task_type="evaluator", task_index=0,
+            ),
+        )
+        assert not evaluator.cluster.is_chief
+        metrics = run_evaluator(
+            evaluator,
+            EvalSpec(batch_input_fn(seed=9), steps=4),
+            poll_interval_s=0.1,
+            stop_at_step=10,
+        )
+        assert np.isfinite(metrics["loss"])
+        assert evaluator.global_step == 10
+        meta = json.loads(
+            open(os.path.join(str(tmp_path), "export", "best",
+                              "metadata.json"), encoding="utf-8").read()
+        )
+        assert meta["step"] == 10
+        evaluator.model.close()
+    finally:
+        s0.stop()
