@@ -276,18 +276,33 @@ def synthesize_cluster_spec(
     client, task_type: str = "worker", task_index: Optional[int] = None
 ) -> ClusterSpec:
     """Build the spec from the live master: PS names come from
-    ElasticPsService (get_ps_version), this process's identity from the
-    client's node rank.  The reference synthesizes TF_CONFIG the same
-    way from master-provided cluster info (new_tf_config,
-    scheduler-side)."""
+    ElasticPsService (get_ps_version, the authoritative ring), every
+    OTHER role from the master's live node listing
+    (get_running_nodes), and this process's identity from the client's
+    node rank.  The reference synthesizes TF_CONFIG the same way from
+    master-provided cluster info (new_tf_config, scheduler-side)."""
     resp = client.get_ps_version()
     idx = task_index
     if idx is None:
         idx = max(int(getattr(client, "node_rank", 0) or 0), 0)
+    cluster: Dict[str, List[str]] = {}
+    get_running = getattr(client, "get_running_nodes", None)
+    if callable(get_running):
+        try:
+            for n in get_running() or []:
+                role = getattr(n, "type", "") or "worker"
+                if role == "ps":
+                    continue  # the versioned ring is authoritative
+                name = getattr(n, "name", "") or f"{role}-{n.id}"
+                cluster.setdefault(role, []).append(name)
+            for members in cluster.values():
+                members.sort()
+        except Exception as e:
+            logger.warning("running-node listing failed: %s", e)
+    cluster["ps"] = list(resp.servers)
+    cluster.setdefault(task_type, [f"{task_type}-{idx}"])
     return ClusterSpec(
-        cluster={"ps": list(resp.servers), task_type: [f"{task_type}-{idx}"]},
-        task_type=task_type,
-        task_index=idx,
+        cluster=cluster, task_type=task_type, task_index=idx
     )
 
 
