@@ -330,3 +330,83 @@ def test_estimator_worker_restart_under_agent(tmp_path):
                     kill_tree(p)
                 except Exception:
                     p.kill()
+
+
+@pytest.mark.slow
+def test_two_estimator_workers_share_shards():
+    """Two estimator workers under one master train against the SAME
+    KvServer ring from master-issued shards (the async-PS data-parallel
+    shape of the reference's TF PS jobs): the chief (worker-0)
+    checkpoints, worker-1 does not, both finish, and the master stays
+    up.  Shard disjointness itself is the TaskManager's property
+    (test_master); this is the two-trainers-one-ring composition."""
+    run_id = f"est2w_{uuid.uuid4().hex[:8]}"
+    master = ps0 = ps1 = w0 = w1 = None
+    try:
+        master, mq, mlines, addr = start_master(
+            run_id, argv_extra=("--num-workers", "2")
+        )
+        ps0, _, _ = _spawn_ps(run_id, addr, 100)
+        ps1, _, _ = _spawn_ps(run_id, addr, 101)
+
+        def spawn_worker(node_id, model_dir):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "examples/train_estimator_elastic.py",
+                    "--steps", "20",
+                    "--batch", "128",
+                    "--model-dir", model_dir,
+                ],
+                cwd=REPO,
+                env=make_env(
+                    run_id,
+                    {
+                        "DLROVER_TPU_MASTER_ADDR": addr,
+                        "DLROVER_TPU_NODE_ID": str(node_id),
+                    },
+                ),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+
+        import tempfile
+
+        d0 = tempfile.mkdtemp(prefix="est2w0_")
+        d1 = tempfile.mkdtemp(prefix="est2w1_")
+        w0 = spawn_worker(0, d0)
+        q0 = drain(w0)
+        l0 = []
+        assert collect(
+            q0, l0, until=lambda l: "[est-worker] cluster" in l,
+            deadline=time.time() + 90,
+        ), "worker 0 never started:\n" + "".join(l0)
+        w1 = spawn_worker(1, d1)
+        q1 = drain(w1)
+        l1 = []
+
+        done0 = collect(
+            q0, l0, until=lambda l: "[est-worker] done at step 20" in l,
+            deadline=time.time() + 300,
+        )
+        done1 = collect(
+            q1, l1, until=lambda l: "[est-worker] done at step 20" in l,
+            deadline=time.time() + 300,
+        )
+        assert done0, "worker 0 never finished:\n" + "".join(l0[-30:])
+        assert done1, "worker 1 never finished:\n" + "".join(l1[-30:])
+        assert w0.wait(timeout=60) == 0
+        assert w1.wait(timeout=60) == 0
+        # only the chief checkpointed
+        assert os.path.exists(os.path.join(d0, "checkpoint"))
+        assert not os.path.exists(os.path.join(d1, "checkpoint"))
+        assert master.poll() is None
+        drain_now(mq, mlines)
+    finally:
+        for p in (w0, w1, ps0, ps1, master):
+            if p is not None and p.poll() is None:
+                try:
+                    kill_tree(p)
+                except Exception:
+                    p.kill()
