@@ -372,6 +372,33 @@ class ElasticDataShardReportHook(SessionHook):
             logger.warning("shard report failed: %s", e)
 
 
+class ModelInfoReportHook(SessionHook):
+    """Report model statistics to the master once at train begin
+    (reference: ReportModelInfoHook wired by the executor at
+    estimator_executor.py:170) — the Brain's resource optimizer keys
+    its plans off these job metrics."""
+
+    def __init__(self, master_client, model_name: str = "",
+                 num_params: int = 0, global_batch_size: int = 0):
+        self._client = master_client
+        self._model_name = model_name
+        self._num_params = int(num_params)
+        self._batch = int(global_batch_size)
+
+    def begin(self, estimator):
+        name = self._model_name or type(
+            getattr(estimator, "_model", None) or estimator
+        ).__name__
+        try:
+            self._client.report_model_info(
+                model_name=name,
+                num_params=self._num_params,
+                global_batch_size=self._batch,
+            )
+        except Exception as e:
+            logger.warning("model-info report failed: %s", e)
+
+
 class CheckpointSaverHook(SessionHook):
     """Chief-only periodic checkpoint into ``model_dir/ckpt-{step}``
     with a tracker file and keep-max pruning (reference: the
@@ -781,6 +808,15 @@ class Estimator:
                 )
         if self.master_client is not None:
             hooks.append(GlobalStepReportHook(self.master_client))
+            hooks.append(
+                ModelInfoReportHook(
+                    self.master_client,
+                    model_name=type(self.model).__name__,
+                    num_params=int(
+                        getattr(self.model, "num_params", 0) or 0
+                    ),
+                )
+            )
         return hooks
 
     def _await_reseal(self, err) -> bool:

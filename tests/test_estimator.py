@@ -85,6 +85,10 @@ class FakePsMaster:
         self.steps.append(step)
         return True
 
+    def report_model_info(self, **kw):
+        self.model_info = dict(kw)
+        return True
+
 
 class FakeShardMaster:
     """get_task/report_task_result surface for ShardingClient: serves
@@ -636,6 +640,9 @@ def test_global_step_hook_reports(tmp_path):
         )
         est.train(batch_input_fn(), max_steps=20)
         assert 10 in master.steps and 20 in master.steps
+        # model statistics reported once at begin (ReportModelInfoHook
+        # analog): the Brain's plans key off these job metrics
+        assert master.model_info["model_name"] == "DeepFMAdapter"
         est.model.close()
     finally:
         s0.stop()
