@@ -1104,3 +1104,66 @@ def test_evaluator_role_watches_checkpoints(tmp_path):
         evaluator.model.close()
     finally:
         s0.stop()
+
+
+def test_file_reader_string_columns(tmp_path):
+    path = str(tmp_path / "s.csv")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("u1,3,1\nu2,4,0\n")
+    reader = FileReader(
+        path,
+        [
+            ColumnInfo("uid", "string"),
+            ColumnInfo("n", "int64"),
+            ColumnInfo("label", "float32", is_label=True),
+        ],
+        batch_size=2,
+    )
+    feats, labels = next(iter(reader))
+    assert feats["uid"].tolist() == ["u1", "u2"]
+    assert feats["n"].dtype == np.int64
+    with pytest.raises(ValueError, match="dtype"):
+        FileReader(
+            path, [ColumnInfo("a", "complex")], batch_size=1
+        )._batch(["x"])
+
+
+def test_estimator_executor_env_cluster_and_resume(tmp_path, monkeypatch):
+    """EstimatorExecutor end to end: cluster spec injected via env (the
+    set_tf_config path), train_and_evaluate, then a RESTARTED executor
+    resumes from the latest checkpoint instead of step 0."""
+    from dlrover_tpu.train.estimator import EstimatorExecutor
+
+    s0 = _start_server()
+    try:
+        addrs = {"s0": s0.address}
+        monkeypatch.setenv(
+            CLUSTER_SPEC_ENV,
+            json.dumps({
+                "cluster": {"ps": ["s0"], "worker": ["w-0"]},
+                "task": {"type": "worker", "index": 0},
+            }),
+        )
+        cfg = RunConfig(model_dir=str(tmp_path), save_steps=4,
+                        log_steps=50)
+        ex = EstimatorExecutor(make_model_fn(addrs), cfg)
+        assert ex.estimator.cluster.cluster["ps"] == ["s0"]
+        assert ex.estimator.cluster.is_chief
+        metrics = ex.train_and_evaluate(
+            TrainSpec(batch_input_fn(), max_steps=8),
+            EvalSpec(batch_input_fn(seed=9), steps=2, every_steps=4),
+        )
+        assert np.isfinite(metrics["loss"])
+        assert ex.estimator.global_step == 8
+        ex.estimator.model.close()
+
+        ex2 = EstimatorExecutor(make_model_fn(addrs), cfg)
+        ex2.train_and_evaluate(
+            TrainSpec(batch_input_fn(), max_steps=8),
+            EvalSpec(batch_input_fn(seed=9), steps=2, every_steps=4),
+        )
+        # resumed at the completed step: no retraining happened
+        assert ex2.estimator.global_step == 8
+        ex2.estimator.model.close()
+    finally:
+        s0.stop()
