@@ -1167,3 +1167,39 @@ def test_estimator_executor_env_cluster_and_resume(tmp_path, monkeypatch):
         ex2.estimator.model.close()
     finally:
         s0.stop()
+
+
+def test_estimator_survives_master_outage(tmp_path):
+    """Every master touchpoint (global-step report, model info, the
+    failover poll) degrades to a warning when the master dies mid-run —
+    training and checkpointing continue without it."""
+    s0 = _start_server()
+    try:
+        master = FakePsMaster()
+        master.set_ring(["s0"], {"s0": s0.address})
+        calls = {"n": 0}
+
+        def outage(*a, **k):
+            calls["n"] += 1
+            raise ConnectionRefusedError("master is down")
+
+        est = Estimator(
+            make_model_fn({"s0": s0.address}),
+            config=RunConfig(model_dir=str(tmp_path), save_steps=4,
+                             log_steps=50),
+            master_client=master,
+        )
+        est.model.coll.version = master.version
+        est.failover._poll = 0.0  # poll every step so the outage is hit
+        # the master dies before training starts
+        master.get_ps_version = outage
+        master.report_global_step = outage
+        master.report_model_info = outage
+
+        loss = est.train(batch_input_fn(), max_steps=8)
+        assert np.isfinite(loss) and est.global_step == 8
+        assert calls["n"] > 0  # the outage was really exercised
+        assert est.latest_checkpoint() == 8  # checkpoints kept flowing
+        est.model.close()
+    finally:
+        s0.stop()
