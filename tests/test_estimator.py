@@ -1203,3 +1203,46 @@ def test_estimator_survives_master_outage(tmp_path):
         est.model.close()
     finally:
         s0.stop()
+
+
+def test_failover_defers_until_replacement_registers():
+    """A ring announcement naming a server with no registered address
+    adopts NOTHING (half-routing would strand keys at an unreachable
+    host); adoption happens on the poll after the address appears."""
+    s0, s1 = _start_server(), _start_server()
+    try:
+        master = FakePsMaster()
+        master.set_ring(["s0"], {"s0": s0.address})
+        demb = DistributedEmbedding(_specs(), {"s0": s0.address})
+        demb.version = master.version
+        fo = PsFailover(master, demb)
+
+        # announce s1 WITHOUT registering its address
+        master.servers = ["s0", "s1"]
+        master.version += 1
+        assert fo.poll_once() is None
+        assert demb.server_names == ["s0"]
+
+        master.kv[_ADDR_KV_PREFIX + "s1"] = json.dumps(list(s1.address))
+        assert fo.poll_once() == "scaling"
+        assert demb.server_names == ["s0", "s1"]
+        demb.close()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_incremental_before_any_full_widens_to_full(tmp_path):
+    s0 = _start_server()
+    try:
+        est = Estimator(
+            make_model_fn({"s0": s0.address}),
+            config=RunConfig(model_dir=str(tmp_path), save_steps=1000),
+        )
+        est.model  # build
+        est.save_incremental(3)  # nothing to be incremental against
+        assert est._read_tracker() == {"latest_step": 3, "full_step": 3}
+        assert os.path.exists(str(tmp_path / "ckpt-3" / "emb.full.npz"))
+        est.model.close()
+    finally:
+        s0.stop()
