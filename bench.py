@@ -243,7 +243,7 @@ def peak_tflops(device) -> float:
 
 
 def run_config(name, batch, seq, remat, steps=30, warmup=3,
-               state_dtype="bfloat16"):
+               state_dtype="bfloat16", block_k=1):
     # steps=30: the axon relay's ~100ms host-readback latency is paid
     # once after the timed loop; at 10 steps it shaved ~3% off measured
     # MFU, at 30 it is under 1%.
@@ -269,22 +269,44 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         state_dtype=state_dtype,
     )
     state = init_train_state(jax.random.key(0), cfg, mesh, opt)
-    step = TrainStepBuilder(cfg, mesh, opt).build()
+    builder = TrainStepBuilder(cfg, mesh, opt)
 
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, 1000)
     batch_data = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
 
+    if block_k > 1:
+        # fused K-step mode: one dispatch covers block_k steps over a
+        # [K, ...]-stacked batch; whole blocks only, so the per-step
+        # numbers divide evenly
+        step = builder.build_block()
+        batch_data = jax.tree.map(
+            lambda x: jnp.stack([x] * block_k), batch_data
+        )
+        n_dispatch = max(steps // block_k, 1)
+        n_warm = max(warmup // block_k, 1)
+    else:
+        step = builder.build()
+        n_dispatch = steps
+        n_warm = warmup
+    total_steps = n_dispatch * block_k
+
     # sync via HOST READBACK, not block_until_ready: under the axon TPU
     # relay block_until_ready returns before device completion, which
     # would inflate throughput ~1000x; float() must wait for the value
-    for _ in range(warmup):
+    for _ in range(n_warm):
         state, metrics = step(state, batch_data)
-    warm_loss = float(metrics["loss"])
+    warm_loss = float(jnp.ravel(metrics["loss"])[-1])
 
+    # host dispatch time = what the fused loop amortizes: the Python/
+    # jit-call overhead per enqueue, measured call-entry to call-return
+    # (the device keeps computing after the call returns)
+    dispatch_s = 0.0
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(n_dispatch):
+        td = time.perf_counter()
         state, metrics = step(state, batch_data)
-    final_loss = float(metrics["loss"])
+        dispatch_s += time.perf_counter() - td
+    final_loss = float(jnp.ravel(metrics["loss"])[-1])
     dt = time.perf_counter() - t0
     if not math.isfinite(final_loss):
         raise RuntimeError(
@@ -292,18 +314,25 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
             "bench run is numerically invalid"
         )
 
-    tokens_per_s = steps * batch * seq / dt
+    tokens_per_s = total_steps * batch * seq / dt
     model_tflops = cfg.flops_per_token(seq) * tokens_per_s / 1e12
     dev = jax.devices()[0]
     mfu = model_tflops / peak_tflops(dev)
+    tag = f",k{block_k}" if block_k > 1 else ""
     return {
-        "metric": f"train_mfu[{cfg.name},b{batch}x{seq},{dev.device_kind}]",
+        "metric": (
+            f"train_mfu[{cfg.name},b{batch}x{seq}{tag},{dev.device_kind}]"
+        ),
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / _REFERENCE_HFU, 4),
         "tokens_per_sec": round(tokens_per_s, 1),
         "model_tflops_per_sec": round(model_tflops, 2),
         "flop_expansion_est": _FLOP_EXPANSION.get(remat, 1.0),
+        "block_k": block_k,
+        "host_dispatch_us_per_step": round(
+            dispatch_s / total_steps * 1e6, 1
+        ),
     }
 
 
@@ -338,9 +367,13 @@ def main():
             sys.argv[5] if len(sys.argv) > 5 else "none",
         )
         state_dtype = sys.argv[6] if len(sys.argv) > 6 else "bfloat16"
+        block_k = int(sys.argv[7]) if len(sys.argv) > 7 else 1
         print(
             json.dumps(
-                run_config(name, batch, seq, remat, state_dtype=state_dtype)
+                run_config(
+                    name, batch, seq, remat,
+                    state_dtype=state_dtype, block_k=block_k,
+                )
             )
         )
         return

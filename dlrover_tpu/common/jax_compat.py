@@ -1,0 +1,102 @@
+"""Version shims for jax APIs the codebase targets but older jaxlibs lack.
+
+The framework is written against current jax (top-level ``jax.shard_map``,
+``jax.sharding.AxisType`` / ``get_abstract_mesh``, ``jax.memory.Space``).
+Older 0.4.x installs ship the same capabilities under experimental names —
+or not at all, for the memory-space API. Everything that touches one of
+these surfaces imports it from here so a single module owns the fallbacks:
+
+- ``shard_map``: kwarg-normalizing wrapper. New jax spells manual axes
+  ``axis_names=`` and replication checking ``check_vma=``; the 0.4.x
+  experimental version spells them ``auto=`` (the complement set) and
+  ``check_rep=``.
+- ``AxisType`` is ``None`` when the install predates typed mesh axes;
+  meshes are then built without ``axis_types`` (every axis is implicitly
+  Auto, which is exactly what the code asks for).
+- ``manual_axis_names()`` reports axes currently in Manual mode, or an
+  empty set when the install cannot say (pre-``get_abstract_mesh`` jax
+  has no ambient-mesh query; callers treat "unknown" as "top level").
+- ``HOST_MEMORY`` / ``DEVICE_MEMORY`` are ``jax.memory.Space`` members or
+  ``None``; opt-state host offload requires them and raises a clear error
+  instead of an AttributeError mid-step when they are missing.
+"""
+
+import jax
+
+try:  # jax >= 0.5: typed mesh axes
+    from jax.sharding import AxisType
+except ImportError:
+    AxisType = None
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map as _shard_map
+
+    _NEW_SHARD_MAP = True
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_SHARD_MAP = False
+
+_MEM = getattr(jax, "memory", None)
+HOST_MEMORY = _MEM.Space.Host if _MEM is not None else None
+DEVICE_MEMORY = _MEM.Space.Device if _MEM is not None else None
+
+# Partial-manual shard_map (manual over pp only, other axes auto) with a
+# scan-of-ppermute body trips an SPMD-partitioner CHECK abort on jaxlib
+# 0.4.x; pcast's presence marks the jax generation whose partitioner
+# handles manual subgroups correctly.
+PARTIAL_MANUAL_PIPELINE = hasattr(jax.lax, "pcast")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """``jax.shard_map`` with old/new kwarg spellings normalized.
+
+    ``axis_names`` (manual axes; None means all) and ``check_vma`` follow
+    the current jax signature; on experimental shard_map they translate to
+    ``auto=`` (mesh axes NOT in axis_names) and ``check_rep=``.
+    """
+    kw = {}
+    if _NEW_SHARD_MAP:
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+    else:
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        # Bodies written for current jax express cross-axis replication
+        # via pvary/pcast, which the 0.4.x replication checker has no
+        # rules for ("No replication rule for name") — always disable it
+        # there; check_vma=True still checks on current jax.
+        kw["check_rep"] = False
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def manual_axis_names() -> frozenset:
+    """Mesh axes currently under manual control (inside a ``shard_map``).
+
+    Empty when nothing is manual — or when the installed jax predates
+    ``get_abstract_mesh`` and cannot report the ambient mesh, in which
+    case callers behave as if at top level (correct everywhere except
+    inside a partial-manual region, which those jax versions handle
+    through the ``auto=`` translation above instead).
+    """
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is None or AxisType is None:
+        return frozenset()
+    am = get()
+    return frozenset(
+        name
+        for name, t in zip(am.axis_names, am.axis_types)
+        if t == AxisType.Manual
+    )
+
+
+def mesh_axis_types_kwargs(n_axes: int) -> dict:
+    """``Mesh(...)`` kwargs pinning every axis to Auto, when expressible."""
+    if AxisType is None:
+        return {}
+    return {"axis_types": (AxisType.Auto,) * n_axes}

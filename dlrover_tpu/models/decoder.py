@@ -174,11 +174,9 @@ def _embed_lookup_hostile(mesh, table_shape, tokens_shape) -> bool:
         or s % mesh.shape.get("sp", 1)
     ):
         return False
-    am = jax.sharding.get_abstract_mesh()
-    manual = any(
-        t == jax.sharding.AxisType.Manual for t in am.axis_types
-    )
-    return not manual
+    from dlrover_tpu.common import jax_compat
+
+    return not jax_compat.manual_axis_names()
 
 
 def _vocab_parallel_embed(table: jax.Array, tokens: jax.Array, mesh):
@@ -199,11 +197,14 @@ def _vocab_parallel_embed(table: jax.Array, tokens: jax.Array, mesh):
     (atorch/modules/distributed_modules/layers.py) does the same
     masked-lookup + all-reduce with torch collectives.
     """
-    from jax import shard_map
+    from dlrover_tpu.common.jax_compat import shard_map
 
-    def body(tbl, tok):
+    def body(rank, tbl, tok):
         vs = tbl.shape[0]
-        off = jax.lax.axis_index("tp") * vs
+        # tp rank from a tp-sharded iota input, not lax.axis_index:
+        # partial-manual shard_map on jax 0.4.x lowers axis_index to a
+        # PartitionId the SPMD partitioner rejects
+        off = rank[0] * vs
         idx = tok - off
         inb = (idx >= 0) & (idx < vs)
         x = jnp.take(tbl, jnp.where(inb, idx, 0), axis=0)
@@ -213,10 +214,10 @@ def _vocab_parallel_embed(table: jax.Array, tokens: jax.Array, mesh):
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P("tp", None), P(("dp", "fsdp"), "sp")),
+        in_specs=(P("tp"), P("tp", None), P(("dp", "fsdp"), "sp")),
         out_specs=P(("dp", "fsdp"), "sp", None),
         check_vma=False,
-    )(table, tokens)
+    )(jnp.arange(mesh.shape["tp"], dtype=jnp.int32), table, tokens)
 
 
 # ---------------------------------------------------------------------------

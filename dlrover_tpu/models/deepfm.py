@@ -173,12 +173,14 @@ class DeepFM:
         return np.asarray(jax.nn.sigmoid(logits))
 
     # -- checkpoint -------------------------------------------------------
-    def save(self, dir_path: str, *, delta_only: bool = False) -> None:
+    def save(self, dir_path: str, *, delta_only: bool = False,
+             clear_dirty: Optional[bool] = None) -> None:
         import os
         import pickle
 
         os.makedirs(dir_path, exist_ok=True)
-        self.coll.save(dir_path, delta_only=delta_only)
+        self.coll.save(dir_path, delta_only=delta_only,
+                       clear_dirty=clear_dirty)
         with open(os.path.join(dir_path, "dense.pkl"), "wb") as f:
             pickle.dump(
                 jax.tree.map(np.asarray,

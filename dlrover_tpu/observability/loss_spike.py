@@ -109,6 +109,38 @@ class LossSpikeDetector:
                 f.write(f"{int(time.time())}\t{it}\t{loss:.6f}\t{culprits}\n")
         return True
 
+    def update_block(
+        self,
+        first_it: int,
+        losses,
+        sample_ids: Optional[Sequence[Sequence[int]]] = None,
+        per_sample_losses: Optional[Sequence] = None,
+    ) -> List[int]:
+        """Ingest a fused block's stacked per-step loss vector.
+
+        ``losses[i]`` is the loss of global step ``first_it + i`` (the
+        [K] array a K-step ``train_block`` returns).  Steps run through
+        the SAME rolling baseline in order, so detection fires at the
+        exact offending step — a spike at position i inside a block is
+        recorded as iteration ``first_it + i``, not at the block
+        boundary.  ``sample_ids``/``per_sample_losses``, when given, are
+        per-step sequences aligned with ``losses``.  Returns the
+        spiking iterations.
+        """
+        spiked: List[int] = []
+        for i, loss in enumerate(np.asarray(losses).reshape(-1)):
+            it = first_it + i
+            if self.update(
+                it,
+                loss,
+                sample_ids=sample_ids[i] if sample_ids is not None else None,
+                per_sample_losses=per_sample_losses[i]
+                if per_sample_losses is not None
+                else None,
+            ):
+                spiked.append(it)
+        return spiked
+
     @staticmethod
     def decode(path: str, min_loss: float = 0.0):
         """Read back spike records: [(ts, iter, loss, culprit_str), ...]."""

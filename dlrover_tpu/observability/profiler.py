@@ -187,9 +187,22 @@ class StepTimer:
             jax.block_until_ready(outputs)
         if self._t0 is None:
             return
-        self._times.append(time.perf_counter() - self._t0)
+        self.record(time.perf_counter() - self._t0)
         self._t0 = None
-        self.steps += 1
+
+    def record(self, dt: float, n_steps: int = 1):
+        """Ingest one measured duration covering ``n_steps`` steps.
+
+        Fused multi-step train blocks report once per block with
+        ``n_steps=K``; the time is attributed per step so ``mean_s``,
+        percentiles, ``steps_per_s`` and ``mfu`` keep their per-step
+        meaning regardless of block size.
+        """
+        n = max(int(n_steps), 1)
+        per = dt / n
+        for _ in range(n):
+            self._times.append(per)
+        self.steps += n
 
     @contextlib.contextmanager
     def step(self):

@@ -282,7 +282,7 @@ def moe_block(
 
 
 def _moe_block_alltoall(x, moe, cfg, mesh, rng, fp8=None):
-    from jax import shard_map
+    from dlrover_tpu.common.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ep = mesh.shape["ep"]
@@ -473,7 +473,7 @@ def _moe_block_ragged(x, moe, cfg, mesh=None, rng=None):
     if mesh.shape.get("ep", 1) > 1:
         return _moe_block_ragged_a2a(x, moe, cfg, mesh, rng)
 
-    from jax import shard_map
+    from dlrover_tpu.common.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     token_axes = ("dp", "fsdp")
@@ -543,7 +543,7 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
     Layout: tokens sharded over (dp, fsdp, ep); experts sharded over ep
     (each rank owns E/ep experts, all its FFN weights local).
     """
-    from jax import shard_map
+    from dlrover_tpu.common.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ep = mesh.shape["ep"]
@@ -554,7 +554,7 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
     b, s, d = x.shape
     token_axes = ("dp", "fsdp", "ep")
 
-    def body(xl, w_gate, w_up, w_gp, w_down):
+    def body(rank, xl, w_gate, w_up, w_gp, w_down):
         local = {
             "w_gate": w_gate,
             "w_up": w_up,
@@ -592,7 +592,10 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
             send, "ep", split_axis=0, concat_axis=0, tiled=True
         )                                                  # [ep, cap, D]
         counts_all = jax.lax.all_gather(counts, "ep")      # [ep, E]
-        my_rank = jax.lax.axis_index("ep")
+        # ep rank from an ep-sharded iota input, not lax.axis_index:
+        # partial-manual shard_map on jax 0.4.x lowers axis_index to a
+        # PartitionId the SPMD partitioner rejects
+        my_rank = rank[0]
         # per (source, local expert) counts for MY experts
         mine = jax.lax.dynamic_slice_in_dim(
             counts_all, my_rank * e_local, e_local, axis=1
@@ -673,6 +676,7 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
         body,
         mesh=mesh,
         in_specs=(
+            P("ep"),
             P(token_axes, None, None),
             P(None, None),          # router replicated
             P("ep", None, None),    # expert-sharded FFN weights
@@ -682,6 +686,7 @@ def _moe_block_ragged_a2a(x, moe, cfg, mesh, rng):
         out_specs=(P(token_axes, None, None), P()),
         check_vma=False,
     )(
+        jnp.arange(ep, dtype=jnp.int32),
         x,
         moe["w_gate"].astype(x.dtype),
         moe["w_up"].astype(x.dtype),

@@ -126,6 +126,16 @@ def pipeline_apply(
     pp = mesh.shape[axis]
     if pp == 1:
         raise ValueError("pipeline_apply requires a pp axis > 1")
+    from dlrover_tpu.common import jax_compat
+
+    if not jax_compat.PARTIAL_MANUAL_PIPELINE:
+        # fail in Python rather than let the 0.4.x SPMD partitioner
+        # CHECK-abort the whole process mid-compile
+        raise NotImplementedError(
+            "pipeline parallelism needs a jax whose partitioner supports "
+            "manual subgroups (jax >= 0.5); this install would abort "
+            "during compilation"
+        )
     v = max(1, int(interleave))
     b_global = x.shape[0]
     m = num_microbatches or pp
@@ -142,8 +152,11 @@ def pipeline_apply(
     compute_dtype = x.dtype
     bdt = jnp.dtype(boundary_dtype or compute_dtype)
 
-    def local(layers_blk, x_all, pos_all):
-        stage = jax.lax.axis_index(axis)
+    def local(stage_ids, layers_blk, x_all, pos_all):
+        # own pp rank via a pp-sharded iota input rather than
+        # lax.axis_index: partial-manual shard_map on jax 0.4.x lowers
+        # axis_index to a PartitionId the SPMD partitioner rejects
+        stage = stage_ids[0]
 
         # Split batch into microbatches WITHOUT concentrating a microbatch
         # on one dp/fsdp shard: reshape so the (auto-)sharded row dim stays
@@ -225,14 +238,15 @@ def pipeline_apply(
                 buf = jax.lax.ppermute(out, axis, perm)
             return (buf, outs), None
 
-        init = jax.lax.pcast(
-            (
-                jnp.zeros(xs.shape[1:], bdt),
-                jnp.zeros(xs.shape, jnp.float32),
-            ),
-            (axis,),
-            to="varying",
+        init = (
+            jnp.zeros(xs.shape[1:], bdt),
+            jnp.zeros(xs.shape, jnp.float32),
         )
+        if hasattr(jax.lax, "pcast"):
+            # newer jax tracks varying-manual-axes types; mark the carry
+            # as varying over pp up front (older jax has no vma typing
+            # and needs no cast)
+            init = jax.lax.pcast(init, (axis,), to="varying")
         (_, outs), _ = jax.lax.scan(
             step, init, jnp.arange(m * v + pp - 1)
         )
@@ -242,14 +256,21 @@ def pipeline_apply(
         outs = jax.lax.psum(outs, axis)
         return outs.swapaxes(0, 1).reshape(x_all.shape)
 
+    from dlrover_tpu.common.jax_compat import shard_map
+
     layer_specs = jax.tree.map(lambda _: P(axis), layers)
-    out = jax.shard_map(
+    out = shard_map(
         local,
         mesh=mesh,
         axis_names={axis},
-        in_specs=(layer_specs, P(), P()),
+        in_specs=(P(axis), layer_specs, P(), P()),
         out_specs=P(),
-    )(layers, x.astype(jnp.float32), positions)
+    )(
+        jnp.arange(pp, dtype=jnp.int32),
+        layers,
+        x.astype(jnp.float32),
+        positions,
+    )
     return out.astype(compute_dtype)
 
 

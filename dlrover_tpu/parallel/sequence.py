@@ -20,8 +20,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.common.jax_compat import shard_map
 
 from dlrover_tpu.ops.attention import _repeat_kv, mha_reference
 
@@ -378,10 +379,13 @@ def ring_attention(
             prefix_len=prefix_len, window=window,
         )
 
-    def local(q, k, v, prefix=None):
+    def local(rank, q, k, v, prefix=None):
         from dlrover_tpu.ops import pallas_attention as pa
 
-        idx = jax.lax.axis_index(axis)
+        # sp rank from an sp-sharded iota input, not lax.axis_index:
+        # partial-manual shard_map on jax 0.4.x lowers axis_index to a
+        # PartitionId the SPMD partitioner rejects
+        idx = rank[0]
         b, sq, h, d = q.shape
         q_offset = idx * sq
 
@@ -441,8 +445,8 @@ def ring_attention(
 
     # batch stays sharded over (dp, fsdp), heads over tp; seq rides the ring
     spec = P(("dp", "fsdp"), axis, _head_axis(mesh, q, k), None)
-    args = (q, k, v)
-    in_specs = (spec, spec, spec)
+    args = (jnp.arange(sp, dtype=jnp.int32), q, k, v)
+    in_specs = (P(axis), spec, spec, spec)
     if prefix_len is not None:
         args = args + (prefix_len,)
         in_specs = in_specs + (P(("dp", "fsdp")),)

@@ -16,6 +16,8 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dlrover_tpu.common import jax_compat
+
 MeshAxes = Union[None, str, Tuple[str, ...]]
 
 # rules: logical axis name -> mesh axis (or tuple, or None)
@@ -98,14 +100,10 @@ def constrain(x, mesh: Mesh, *logical_axes: Optional[str], rules=None):
     """
     rules = rules_for_mesh(mesh, rules)
     spec = logical_to_mesh_axes(logical_axes, rules)
-    am = jax.sharding.get_abstract_mesh()
-    manual = {
-        name
-        for name, t in zip(am.axis_names, am.axis_types)
-        if t == jax.sharding.AxisType.Manual
-    }
+    manual = jax_compat.manual_axis_names()
     if manual:
-        spec = P(*[_drop_axes(entry, manual) for entry in spec])
+        am = jax.sharding.get_abstract_mesh()
+        spec = P(*[_drop_axes(entry, set(manual)) for entry in spec])
         return jax.lax.with_sharding_constraint(x, NamedSharding(am, spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 

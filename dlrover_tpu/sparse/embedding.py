@@ -114,7 +114,10 @@ class EmbeddingCollection:
             self.optimizer.apply(self.tables[name], uniq, g)
 
     # -- checkpoint -------------------------------------------------------
-    def save(self, dir_path: str, *, delta_only: bool = False) -> Dict[str, int]:
+    def save(self, dir_path: str, *, delta_only: bool = False,
+             clear_dirty: Optional[bool] = None) -> Dict[str, int]:
+        """``clear_dirty=False`` exports without consuming the dirty
+        epoch (best-export: keeps the incremental chain valid)."""
         import os
 
         os.makedirs(dir_path, exist_ok=True)
@@ -124,6 +127,7 @@ class EmbeddingCollection:
             written[name] = table.save(
                 os.path.join(dir_path, f"{name}.{suffix}.npz"),
                 delta_only=delta_only,
+                clear_dirty=clear_dirty,
             )
         return written
 

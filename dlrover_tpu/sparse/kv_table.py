@@ -226,18 +226,26 @@ class KvTable:
             int(clear_table), int(mark_dirty),
         )
 
-    def save(self, path: str, *, delta_only: bool = False) -> int:
+    def save(self, path: str, *, delta_only: bool = False,
+             clear_dirty: Optional[bool] = None) -> int:
         """Write a (full or delta) snapshot; returns rows written.
 
         Delta snapshots are cumulative since the last full snapshot and
         carry tombstones, so restoring full + latest delta reproduces
         the table exactly, including TTL evictions.
+
+        ``clear_dirty=False`` on a full save makes it a SIDE-EFFECT-FREE
+        export (best-export / debugging): the dirty epoch is untouched,
+        so the ongoing incremental-checkpoint chain against the last
+        cadenced full save stays valid.
         """
         deleted = (
             self.export_deleted() if delta_only
             else np.empty(0, dtype=np.int64)
         )
-        keys, values, freqs, ts = self.export(delta_only=delta_only)
+        keys, values, freqs, ts = self.export(
+            delta_only=delta_only, clear_dirty=clear_dirty
+        )
         np.savez(
             path, keys=keys, values=values, freqs=freqs, ts=ts,
             deleted=deleted,

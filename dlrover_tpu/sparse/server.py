@@ -197,9 +197,12 @@ class KvServer:
         elif op == b"X":
             # snapshot export: full (clears the dirty epoch — the next
             # delta is cumulative against THIS export) or delta (dirty
-            # rows + tombstones since the last full)
+            # rows + tombstones since the last full). clear_dirty=False
+            # makes a full export side-effect-free (best export).
             delta = bool(ctrl.get("delta"))
-            keys, rows, freqs, ts = table.export(delta_only=delta)
+            keys, rows, freqs, ts = table.export(
+                delta_only=delta, clear_dirty=ctrl.get("clear_dirty")
+            )
             deleted = (
                 table.export_deleted() if delta
                 else np.empty(0, np.int64)
@@ -300,12 +303,16 @@ class KvClient:
         ts = np.frombuffer(payload[off + 4 * n :], np.uint32)
         return rows.copy(), freqs.copy(), ts.copy()
 
-    def export_snapshot(self, table: str, *, delta: bool = False):
+    def export_snapshot(self, table: str, *, delta: bool = False,
+                        clear_dirty: Optional[bool] = None):
         """Server-side snapshot export (X op): full clears the dirty
         epoch; delta returns dirty rows + deletion tombstones since the
-        last full.  Returns (keys, rows, freqs, ts, deleted)."""
+        last full.  ``clear_dirty=False`` keeps a full export from
+        consuming the epoch (side-effect-free best export).  Returns
+        (keys, rows, freqs, ts, deleted)."""
         ctrl, payload = self._call(
-            b"X", {"table": table, "delta": delta}
+            b"X",
+            {"table": table, "delta": delta, "clear_dirty": clear_dirty},
         )
         n, width = ctrl["n"], ctrl["width"]
         nd = ctrl["n_deleted"]
@@ -529,7 +536,8 @@ class DistributedEmbedding:
 
     # -- ring-wide checkpoint --------------------------------------------
 
-    def save(self, dir_path: str, *, delta_only: bool = False):
+    def save(self, dir_path: str, *, delta_only: bool = False,
+             clear_dirty: Optional[bool] = None):
         """Ring-wide sparse checkpoint: snapshot-export every server per
         table over the wire (full width — values + optimizer slots —
         plus frequency/timestamp admission state) into one npz per table
@@ -573,7 +581,9 @@ class DistributedEmbedding:
             for server in self.server_names:
                 keys, rows, freqs, ts, deleted = self._client(
                     server
-                ).export_snapshot(table, delta=delta_only)
+                ).export_snapshot(
+                    table, delta=delta_only, clear_dirty=clear_dirty
+                )
                 if len(keys):
                     parts.append((keys, rows, freqs, ts))
                 if len(deleted):
@@ -605,10 +615,12 @@ class DistributedEmbedding:
                 dim=spec.dim, n_slots=width // spec.dim - 1,
                 delta=int(delta_only),
             )
-            if not delta_only:
+            if not delta_only and clear_dirty is not False:
                 # a new full snapshot starts a fresh delta epoch: a
                 # leftover delta belongs to the PREVIOUS baseline and
-                # restore() would overlay it, reverting rows
+                # restore() would overlay it, reverting rows.
+                # (clear_dirty=False exports start no epoch, so they
+                # must not invalidate a delta either)
                 try:
                     os.remove(
                         os.path.join(dir_path, f"{table}.delta.npz")

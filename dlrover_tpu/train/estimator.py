@@ -420,7 +420,11 @@ class CheckpointSaverHook(SessionHook):
             estimator.save_incremental(step)
 
     def end(self, estimator, step):
-        if step > 0:
+        # exceptional exits skip the end-of-run save: post-failure state
+        # (e.g. a half-restored sparse tier) must not overwrite the last
+        # good checkpoint, and a save error here must not mask the
+        # original exception (ADVICE r5)
+        if step > 0 and not getattr(estimator, "_train_failed", False):
             estimator.save_checkpoint(step)
 
 
@@ -875,17 +879,25 @@ class Estimator:
             h.begin(self)
         last_loss = float("nan")
         self._last_poll = 0.0
+        self._train_failed = False
         try:
             it = iter(input_fn())
             while self.global_step < max_steps:
                 self._maybe_poll_failover()
                 if self._needs_sparse_restore:
                     self._needs_sparse_restore = False
-                    if self.restore_latest() is None:
+                    restored = self.restore_latest()
+                    if restored is None:
                         raise PsFailureError(
                             "sparse tier lost a server and no checkpoint "
                             "exists to restore from"
                         )
+                    # worker-restart step accounting: training resumes
+                    # FROM the restored step — steps run since that
+                    # checkpoint trained against sparse state that was
+                    # just rolled back, so keeping their count would
+                    # desync cadenced hooks from the actual state
+                    self.global_step = int(restored)
                 try:
                     features, labels = next(it)
                 except StopIteration:
@@ -911,9 +923,22 @@ class Estimator:
                     logger.info(
                         "step %d loss %.5f", self.global_step, last_loss
                     )
+        except BaseException:
+            self._train_failed = True
+            raise
         finally:
             for h in all_hooks:
-                h.end(self, self.global_step)
+                try:
+                    h.end(self, self.global_step)
+                except Exception:
+                    # on a failed run the original exception is the
+                    # story; a hook's end error must not replace it
+                    if not self._train_failed:
+                        raise
+                    logger.warning(
+                        "hook %r end failed after training error",
+                        h, exc_info=True,
+                    )
         return last_loss
 
     def evaluate(
@@ -960,7 +985,24 @@ class Estimator:
         if float(current) >= best:
             return False
         os.makedirs(export_dir, exist_ok=True)
-        self.model.save(export_dir)
+        # side-effect-free export when the model supports it: a plain
+        # full save would clear the sparse tier's dirty epoch, silently
+        # invalidating the chief's cumulative incremental checkpoints
+        # (probe by signature like save_incremental does — a TypeError
+        # from inside save must not be misread as "no support")
+        import inspect
+
+        try:
+            supports_clear = (
+                "clear_dirty"
+                in inspect.signature(self.model.save).parameters
+            )
+        except (TypeError, ValueError):
+            supports_clear = False
+        if supports_clear:
+            self.model.save(export_dir, clear_dirty=False)
+        else:
+            self.model.save(export_dir)
         with open(meta_path, "w", encoding="utf-8") as f:
             f.write(json.dumps({metric: float(current),
                                 "step": self.global_step}))
@@ -1005,6 +1047,7 @@ def run_evaluator(
     eval_spec: EvalSpec,
     poll_interval_s: float = 10.0,
     stop_at_step: Optional[int] = None,
+    allow_ring_restore: bool = False,
 ) -> Dict[str, float]:
     """The distributed EVALUATOR role (reference:
     tf.estimator.train_and_evaluate's evaluator task — a separate
@@ -1013,7 +1056,29 @@ def run_evaluator(
     ``poll_interval_s``).  Runs until ``stop_at_step``'s checkpoint has
     been evaluated (None = forever).  Sparse-tier models re-route
     through the failover poll inside evaluate(), so the evaluator
-    survives PS membership changes like a trainer does."""
+    survives PS membership changes like a trainer does.
+
+    Refuses ring-backed models by default: ``restore_latest`` on a
+    model whose embedding collection lives in the shared PS ring would
+    PUSH stale checkpoint rows into the very tables the trainers are
+    updating.  Build the evaluator's estimator with a local
+    ``EmbeddingCollection`` (the snapshot formats interchange), or —
+    when no trainer shares the ring, e.g. post-hoc evaluation after
+    training stopped — pass ``allow_ring_restore=True``."""
+    model = estimator.model
+    coll = getattr(model, "coll", None)
+    if coll is not None and not allow_ring_restore:
+        from dlrover_tpu.sparse.server import DistributedEmbedding
+
+        if isinstance(coll, DistributedEmbedding):
+            raise ValueError(
+                "run_evaluator on a ring-backed model would overwrite "
+                "live PS rows on every checkpoint restore; give the "
+                "evaluator its own model with a local "
+                "EmbeddingCollection (checkpoints interchange between "
+                "local and distributed collections), or pass "
+                "allow_ring_restore=True if no trainer shares the ring"
+            )
     last_evaled = None
     metrics: Dict[str, float] = {}
     while True:

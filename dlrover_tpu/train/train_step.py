@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dlrover_tpu.common import jax_compat
 from dlrover_tpu.models import decoder
 from dlrover_tpu.models.config import ModelConfig
 from dlrover_tpu.parallel import sharding as shd
@@ -33,8 +34,8 @@ TrainState = Dict[str, Any]  # {"params", "opt_state", "step"}
 # custom op and no separate optimizer implementation needed. (On the CPU
 # backend the Host space aliases device memory — a harmless no-op that
 # keeps the same code path testable on the virtual mesh.)
-_HOST = jax.memory.Space.Host
-_DEVICE = jax.memory.Space.Device
+_HOST = jax_compat.HOST_MEMORY
+_DEVICE = jax_compat.DEVICE_MEMORY
 
 
 def _to_memory_kind(tree, kind):
@@ -304,6 +305,15 @@ class TrainStepBuilder:
         self.grad_accum = grad_accum
         self.attn_impl = attn_impl
         self.offload_opt_state = offload_opt_state
+        if (
+            offload_opt_state
+            and _HOST is None
+            and jax.default_backend() != "cpu"
+        ):
+            raise RuntimeError(
+                "offload_opt_state needs the jax.memory.Space API; "
+                "this jax build has no host memory space"
+            )
         # switch-gating jitter needs a per-step rng; only the built-in
         # loss_fn accepts one (a custom loss_fn owns its rng handling)
         self._needs_rng = (
@@ -441,6 +451,44 @@ class TrainStepBuilder:
     def build(self) -> Callable:
         """Return the jitted step with donated state."""
         return jax.jit(self.step_fn, donate_argnums=(0,))
+
+    # ---- fused multi-step block -----------------------------------------
+
+    def block_fn(
+        self, state: TrainState, batches
+    ) -> Tuple[TrainState, Dict]:
+        """Run K train steps as ONE device program.
+
+        ``batches`` leaves carry a leading block axis: [K, ...] (e.g.
+        tokens [K, B, S]).  A ``lax.scan`` over that axis applies
+        ``step_fn`` K times — microbatch accumulation, fp8 state
+        threading, and remat policies all compose unchanged because the
+        scan body IS ``step_fn``.  Per-step metrics (loss, grad_norm,
+        spike inputs) come back STACKED as [K] arrays, so the host
+        touches the device once per block instead of once per step:
+        Python dispatch, metric readback, and callback cadence checks
+        amortize over K steps (cf. TorchTitan's overlap-everything
+        loop).  The per-step rng derivation keys off the step counter in
+        the carry, so a fused block and K sequential calls see identical
+        randomness.
+        """
+        return jax.lax.scan(self.step_fn, state, batches)
+
+    def build_block(self) -> Callable:
+        """Jitted K-step block with donated state.
+
+        One compiled program per distinct K (the trainer shrinks K at
+        cadence boundaries, so a handful of sizes compile over a run).
+        """
+        if self.offload_opt_state:
+            # the per-step HBM<->host moment streaming inside a scan
+            # body would serialize against the scan carry; run offloaded
+            # states unfused instead of silently deoptimizing
+            raise NotImplementedError(
+                "fused train blocks do not compose with "
+                "offload_opt_state; use block_k=1"
+            )
+        return jax.jit(self.block_fn, donate_argnums=(0,))
 
 
 def build_eval_step(cfg: ModelConfig, mesh, rules=None, attn_impl="auto"):

@@ -11,7 +11,7 @@ global-step reports to the elastic master when one is present.
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +72,15 @@ class TrainerArgs:
     # device_put H2D overlap — train.data_utils.prefetch_to_device, the
     # reference GPU preloader analog); 0 = off
     prefetch: int = 0
+    # fuse K train steps into ONE jitted device program (a lax.scan over
+    # stacked batches) and drain the previous block's per-step metrics
+    # while the next block computes; 1 = the classic per-step loop.
+    # Save/eval/memory-save cadences and max_steps stay exact for any K:
+    # blocks auto-shrink to land on every boundary. Callback control
+    # flags (should_save/should_eval/should_stop) and elastic events are
+    # honored at the NEXT block boundary — worst-case response is one
+    # block.
+    block_k: int = 1
 
 
 class Trainer:
@@ -123,6 +132,7 @@ class Trainer:
             attn_impl=args.attn_impl,
         )
         self._step_fn = None
+        self._block_fn = None
         self._eval_fn = eval_step_fn
         self._batch_sharding = batch_sharding(self.mesh, rules)
         if jax.process_count() == 1:
@@ -241,9 +251,41 @@ class Trainer:
                 )
             except Exception:  # noqa: BLE001
                 logger.warning("model-info report failed", exc_info=True)
-        start = int(self.state["step"])
         control = self.control
         self.callbacks.fire("on_train_begin", self, control)
+        if args.block_k > 1:
+            if self._block_fn is None:
+                self._block_fn = self._builder.build_block()
+            last_saved, last_evaled = self._train_blockwise()
+        else:
+            last_saved, last_evaled = self._train_stepwise()
+        if args.eval_at_end and int(self.state["step"]) != last_evaled:
+            eval_metrics = self.evaluate()
+            if eval_metrics:
+                self.callbacks.fire(
+                    "on_eval", self, int(self.state["step"]),
+                    eval_metrics, control,
+                )
+        # final checkpoint so a clean exit is always resumable (skipped
+        # when the loop's cadence already saved this exact step). Any
+        # save at all — including callback-forced ones with
+        # save_interval=0 — must be awaited before returning, or the
+        # process can exit mid-persist.
+        if args.save_interval:
+            final_step = int(self.state["step"])
+            if final_step != last_saved:
+                self.checkpointer.save_checkpoint(final_step, self.state)
+                last_saved = final_step
+        if last_saved >= 0:
+            self.checkpointer.wait_for_persist()
+        self.callbacks.fire("on_train_end", self, control)
+        return self.state
+
+    def _train_stepwise(self) -> Tuple[int, int]:
+        """The classic one-dispatch-per-step loop (block_k=1)."""
+        args = self.args
+        control = self.control
+        start = int(self.state["step"])
         window_loss = 0.0
         window_n = 0
         last_saved = -1
@@ -332,27 +374,184 @@ class Trainer:
             if control.should_stop:
                 logger.info("training stopped by callback at step %d", step)
                 break
-        if args.eval_at_end and int(self.state["step"]) != last_evaled:
+        return last_saved, last_evaled
+
+    # ---- fused multi-step loop ------------------------------------------
+
+    def _next_block_k(self, step: int) -> int:
+        """Largest block size from ``step`` that lands exactly on every
+        state-touching cadence boundary (save/eval/memory-save) and on
+        ``max_steps`` — the invariant that keeps fused cadences EXACT:
+        boundaries only ever coincide with block ends, never fall
+        inside a block.  Log cadence does not shrink blocks: logs need
+        only the stacked metrics, which the drain replays per step."""
+        args = self.args
+        k = min(args.block_k, args.max_steps - step)
+        for interval in (
+            args.save_interval,
+            args.eval_interval,
+            args.memory_save_interval,
+        ):
+            if interval:
+                k = min(k, interval - step % interval)
+        return max(int(k), 1)
+
+    def _train_blockwise(self) -> Tuple[int, int]:
+        """K steps per device dispatch with async metrics readback.
+
+        Each iteration dispatches one fused block, then drains the
+        PREVIOUS block's stacked metrics while the new one computes
+        (the device_get of finished results costs no device idle time).
+        Per-step host work — loss windows, spike detection, on_step_end
+        callbacks, exact-step logging — happens in the drain, against
+        the true per-step values.  State-touching cadences run at block
+        ends, which _next_block_k aligned to the boundaries; control
+        flags raised during a drain are honored at the next boundary
+        (worst-case response: one block).
+        """
+        import numpy as np
+
+        args = self.args
+        control = self.control
+        step = int(self.state["step"])
+        window = {"loss": 0.0, "n": 0, "t_log": time.perf_counter()}
+        last_saved = -1
+        last_evaled = -1
+        pending = None  # (first_step, k, device_metrics, t_dispatch)
+
+        def drain(first, k, metrics, t0):
+            host = jax.device_get(metrics)  # previous block: finished
+            self.timer.record(time.perf_counter() - t0, n_steps=k)
+            losses = np.asarray(host["loss"]).reshape(-1)
+            for i in range(k):
+                s = first + i
+                loss = float(losses[i])
+                window["loss"] += loss
+                window["n"] += 1
+                self.callbacks.fire(
+                    "on_step_end", self, s, {"loss": loss}, control
+                )
+                if control.should_log or (
+                    args.log_interval and s % args.log_interval == 0
+                ):
+                    control.should_log = False
+                    dt = time.perf_counter() - window["t_log"]
+                    window["t_log"] = time.perf_counter()
+                    logs = {
+                        "loss": window["loss"] / max(window["n"], 1),
+                        "steps_per_s": window["n"] / max(dt, 1e-9),
+                    }
+                    self.callbacks.fire("on_log", self, s, logs, control)
+                    logger.info(
+                        "step %d | loss %.4f | %.2f steps/s%s",
+                        s,
+                        logs["loss"],
+                        logs["steps_per_s"],
+                        " | lr %.3e" % logs["learning_rate"]
+                        if "learning_rate" in logs
+                        else "",
+                    )
+                    window["loss"], window["n"] = 0.0, 0
+
+        exhausted = False
+        while (
+            step < args.max_steps
+            and not control.should_stop
+            and not exhausted
+        ):
+            batches = []
+            for _ in range(self._next_block_k(step)):
+                try:
+                    batches.append(next(self.train_iter))
+                except StopIteration:
+                    exhausted = True
+                    break
+            if not batches:
+                logger.info("data exhausted at step %d", step)
+                break
+            k = len(batches)
+            block = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+            t0 = time.perf_counter()
+            if self.runtime_timer is not None:
+                # profile when a sampled step falls inside this block
+                sample = next(
+                    (
+                        s
+                        for s in range(step + 1, step + k + 1)
+                        if self.runtime_timer.should_sample(s)
+                    ),
+                    None,
+                )
+                if sample is not None:
+                    self.state, metrics = self.runtime_timer.profiled_call(
+                        sample, self._block_fn, self.state, block
+                    )
+                else:
+                    self.state, metrics = self._block_fn(self.state, block)
+            else:
+                self.state, metrics = self._block_fn(self.state, block)
+            if pending is not None:
+                drain(*pending)
+            pending = (step + 1, k, metrics, t0)
+            step += k
+            # block-boundary host actions on the just-dispatched state
+            if self.client is not None and args.report_to_master:
+                try:
+                    self.client.report_global_step(
+                        step, jax.process_count()
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "global-step report failed", exc_info=True
+                    )
+            if (
+                args.memory_save_interval
+                and step % args.memory_save_interval == 0
+            ):
+                from dlrover_tpu.checkpoint import StorageType
+
+                self.checkpointer.save_checkpoint(
+                    step, self.state, storage_type=StorageType.MEMORY
+                )
+            if control.should_save or (
+                args.save_interval and step % args.save_interval == 0
+            ):
+                self.checkpointer.save_checkpoint(step, self.state)
+                last_saved = step
+                self.callbacks.fire("on_save", self, step, control)
+            if control.should_eval or (
+                args.eval_interval and step % args.eval_interval == 0
+            ):
+                eval_metrics = self.evaluate()
+                last_evaled = step
+                if eval_metrics:
+                    logger.info(
+                        "eval @ step %d | loss %.4f",
+                        step,
+                        eval_metrics["loss"],
+                    )
+                    self.callbacks.fire(
+                        "on_eval", self, step, eval_metrics, control
+                    )
+            control.reset_step_flags()
+        if pending is not None:
+            drain(*pending)
+        # flags raised by the FINAL drain still get their boundary
+        if control.should_save:
+            self.checkpointer.save_checkpoint(step, self.state)
+            last_saved = step
+            self.callbacks.fire("on_save", self, step, control)
+        if control.should_eval:
             eval_metrics = self.evaluate()
+            last_evaled = step
             if eval_metrics:
                 self.callbacks.fire(
-                    "on_eval", self, int(self.state["step"]),
-                    eval_metrics, control,
+                    "on_eval", self, step, eval_metrics, control
                 )
-        # final checkpoint so a clean exit is always resumable (skipped
-        # when the loop's cadence already saved this exact step). Any
-        # save at all — including callback-forced ones with
-        # save_interval=0 — must be awaited before returning, or the
-        # process can exit mid-persist.
-        if args.save_interval:
-            final_step = int(self.state["step"])
-            if final_step != last_saved:
-                self.checkpointer.save_checkpoint(final_step, self.state)
-                last_saved = final_step
-        if last_saved >= 0:
-            self.checkpointer.wait_for_persist()
-        self.callbacks.fire("on_train_end", self, control)
-        return self.state
+        control.reset_step_flags()
+        if control.should_stop:
+            logger.info("training stopped by callback at step %d", step)
+        return last_saved, last_evaled
 
     def evaluate(self) -> Dict[str, float]:
         if self.eval_iter_fn is None:

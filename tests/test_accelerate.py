@@ -15,6 +15,9 @@ from dlrover_tpu.accelerate.strategy import (
 )
 from dlrover_tpu.models import get_config
 
+# end-to-end auto_accelerate runs are heavy; excluded from the tier-1 budget
+pytestmark = pytest.mark.slow
+
 
 def test_apply_strategy_builds_plan():
     plan = apply_strategy(

@@ -40,6 +40,22 @@ def test_bench_single_tiny_emits_schema():
     assert rec["value"] > 0
 
 
+def test_bench_single_block_k_mode():
+    """Fused-block bench (block_k>1): same schema as block_k=1, plus the
+    block fields, so the k=8-vs-k=1 host-overhead comparison stays
+    runnable on real hardware."""
+    out = _run(
+        ["--single", "tiny", "2", "64", "none", "bfloat16", "4"],
+        timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["block_k"] == 4
+    assert ",k4," in rec["metric"]
+    assert rec["value"] > 0
+    assert rec["host_dispatch_us_per_step"] >= 0
+
+
 def test_bench_aux_modes_cpu_safe():
     # kernel check short-circuits true off-TPU; ceiling returns {}
     out = _run(["--check"], timeout=120)

@@ -556,6 +556,7 @@ def test_ps_failover_scaling_adopts_live():
         s2.stop()
 
 
+@pytest.mark.slow
 def test_ps_failure_detected_and_restored(tmp_path):
     """Kill a server (rows gone), replace it: migration export hits the
     dead socket → 'ps_failure' → estimator restores the ring from the
@@ -627,6 +628,7 @@ def test_ps_failure_without_checkpoint_raises(tmp_path):
         s1.stop()
 
 
+@pytest.mark.slow
 def test_global_step_hook_reports(tmp_path):
     master = FakePsMaster()
     s0 = _start_server()
@@ -653,6 +655,7 @@ def test_global_step_hook_reports(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_estimator_over_real_master_wire(tmp_path):
     """The full registration story over the real wire: KvServers join
     the master as PS nodes (PsClusterCallback builds the versioned
@@ -884,6 +887,7 @@ def test_restore_rejects_orphan_delta(tmp_path):
         s0.stop()
 
 
+@pytest.mark.slow
 def test_wire_error_waits_for_reseal_and_restores(tmp_path):
     """A PS dies UNDER a train step (worker sees the wire error before
     the master does): the step waits for the master's ring version to
@@ -1088,11 +1092,14 @@ def test_evaluator_role_watches_checkpoints(tmp_path):
             ),
         )
         assert not evaluator.cluster.is_chief
+        # the trainer has stopped, so restoring into the shared ring is
+        # safe here — opt in past the live-ring guard
         metrics = run_evaluator(
             evaluator,
             EvalSpec(batch_input_fn(seed=9), steps=4),
             poll_interval_s=0.1,
             stop_at_step=10,
+            allow_ring_restore=True,
         )
         assert np.isfinite(metrics["loss"])
         assert evaluator.global_step == 10
@@ -1244,5 +1251,155 @@ def test_incremental_before_any_full_widens_to_full(tmp_path):
         assert est._read_tracker() == {"latest_step": 3, "full_step": 3}
         assert os.path.exists(str(tmp_path / "ckpt-3" / "emb.full.npz"))
         est.model.close()
+    finally:
+        s0.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-of-run save semantics, restore rewind, best-export side effects
+# ---------------------------------------------------------------------------
+
+
+class _RecordingModel:
+    """Dense-only fake: records saves, optionally fails mid-run."""
+
+    def __init__(self, fail_after=None):
+        self.save_calls = []
+        self.fail_after = fail_after
+        self.steps_run = 0
+
+    def train_step(self, features, labels):
+        self.steps_run += 1
+        if self.fail_after is not None and self.steps_run > self.fail_after:
+            raise RuntimeError("boom")
+        return 0.5
+
+    def eval_metrics(self, features, labels):
+        return {"loss": 0.1}
+
+    def save(self, dir_path, delta_only=False, clear_dirty=None):
+        self.save_calls.append((dir_path, delta_only, clear_dirty))
+
+    def restore(self, dir_path):
+        pass
+
+    def close(self):
+        pass
+
+
+def _dense_input_fn():
+    def input_fn():
+        while True:
+            yield {"x": np.zeros(2, np.float32)}, np.zeros(2, np.float32)
+
+    return input_fn
+
+
+def test_exceptional_exit_skips_end_of_run_save(tmp_path):
+    """A crash must propagate unmasked and must NOT checkpoint the
+    post-failure state over the last good one (ADVICE r5)."""
+    model = _RecordingModel(fail_after=3)
+    est = Estimator(
+        lambda mode, params, cluster: model,
+        config=RunConfig(
+            model_dir=str(tmp_path), save_steps=1000, log_steps=1000
+        ),
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        est.train(_dense_input_fn(), max_steps=10)
+    assert model.save_calls == []
+    assert est._train_failed
+
+
+def test_clean_exit_still_saves_end_of_run(tmp_path):
+    model = _RecordingModel()
+    est = Estimator(
+        lambda mode, params, cluster: model,
+        config=RunConfig(
+            model_dir=str(tmp_path), save_steps=1000, log_steps=1000
+        ),
+    )
+    est.train(_dense_input_fn(), max_steps=4)
+    assert len(model.save_calls) == 1  # CheckpointSaverHook.end
+    assert not est._train_failed
+
+
+def test_mid_run_restore_rewinds_global_step(tmp_path, monkeypatch):
+    """After an unplanned PS restore, step accounting resumes FROM the
+    restored step (reference worker-restart semantics)."""
+    model = _RecordingModel()
+    est = Estimator(
+        lambda mode, params, cluster: model,
+        config=RunConfig(
+            model_dir=str(tmp_path), save_steps=1000, log_steps=1000
+        ),
+    )
+    est.model  # build
+    est.global_step = 7
+    est._needs_sparse_restore = True
+    monkeypatch.setattr(est, "restore_latest", lambda: 5)
+    est.train(_dense_input_fn(), max_steps=9)
+    assert est.global_step == 9
+    assert model.steps_run == 4  # steps 6..9, not 8..9
+
+
+def test_export_best_is_side_effect_free(tmp_path):
+    """Best export passes clear_dirty=False when the model supports it,
+    so it cannot consume the sparse tier's dirty epoch (ADVICE r5)."""
+    model = _RecordingModel()
+    est = Estimator(
+        lambda mode, params, cluster: model,
+        config=RunConfig(model_dir=str(tmp_path)),
+    )
+    est.model
+    assert est.export_best({"loss": 0.5}, "loss") is True
+    assert len(model.save_calls) == 1
+    assert model.save_calls[0][2] is False  # clear_dirty=False
+    # a worse metric does not export
+    assert est.export_best({"loss": 0.9}, "loss") is False
+    assert len(model.save_calls) == 1
+
+
+def test_run_evaluator_rejects_ring_backed_model(tmp_path):
+    """An evaluator restoring into the SHARED PS ring would clobber the
+    rows trainers are updating; the guard demands a local collection."""
+    from dlrover_tpu.train.estimator import run_evaluator
+
+    model = _RecordingModel()
+    model.coll = DistributedEmbedding(_specs(), {"s0": ("localhost", 1)})
+    est = Estimator(
+        lambda mode, params, cluster: model,
+        config=RunConfig(model_dir=str(tmp_path)),
+    )
+    with pytest.raises(ValueError, match="ring-backed"):
+        run_evaluator(
+            est, EvalSpec(input_fn=_dense_input_fn()), stop_at_step=1
+        )
+
+
+def test_ring_full_export_with_clear_dirty_false_keeps_delta_epoch(tmp_path):
+    """A clear_dirty=False full export (best export) must leave the
+    dirty epoch intact: the next delta still carries every row dirtied
+    since the last CADENCED full save."""
+    s0 = _start_server()
+    try:
+        demb = DistributedEmbedding(_specs(), {"s0": s0.address})
+        keys = np.arange(6, dtype=np.int64)
+        _dev, host = demb.pull({"emb": keys})
+        demb.push(host, {
+            "emb": np.ones((len(host["emb"]), CFG.emb_dim), np.float32)
+        })
+        # cadenced full save starts the delta epoch
+        demb.save(str(tmp_path / "ckpt"))
+        _dev, host = demb.pull({"emb": keys})
+        demb.push(host, {
+            "emb": np.ones((len(host["emb"]), CFG.emb_dim), np.float32)
+        })
+        # side-effect-free best export between cadenced saves
+        demb.save(str(tmp_path / "best"), clear_dirty=False)
+        # the 6 re-dirtied rows still land in the next delta
+        written = demb.save(str(tmp_path / "ckpt"), delta_only=True)
+        assert written["emb"] == 6
+        demb.close()
     finally:
         s0.stop()

@@ -25,6 +25,9 @@ from dlrover_tpu.train import (
 )
 from dlrover_tpu.train.train_step import batch_sharding
 
+# fp8 wiring compiles are heavy on the CPU mesh; excluded from the tier-1 budget
+pytestmark = pytest.mark.slow
+
 
 def _cfg(fp8: bool):
     return get_config(

@@ -90,6 +90,7 @@ def test_offload_attn_remat_matches_no_remat():
         )
 
 
+@pytest.mark.slow
 def test_offloaded_opt_state_matches_resident(mesh):
     """Host-offloaded moments (CPU-offload-Adam parity): same numerics
     as HBM-resident state, and the moments actually live in pinned_host."""
@@ -139,6 +140,7 @@ def test_offload_opt_strategy_method():
     assert AccelerationPlan.from_json(plan.to_json()).offload_opt_state
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch(mesh):
     cfg = get_config("tiny")
     opt = make_optimizer(
@@ -183,6 +185,7 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_streamed_offload_adamw_matches_resident(mesh):
     """Per-leaf streamed host-offload (VERDICT r2 #8): same numerics as
     plain AdamW, no whole-tree device_put — the builder-level offload

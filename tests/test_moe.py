@@ -261,6 +261,7 @@ def test_ragged_grads_flow_and_router_trains():
     assert float(jnp.abs(g["w_down"]).sum()) > 0.0
 
 
+@pytest.mark.slow
 def test_ragged_ep_matches_dense_oracle(ep_mesh):
     """Dropless EP: bounded all-to-all + ragged compute over an
     ep=4 mesh must match the no-drop dense oracle."""
@@ -282,6 +283,7 @@ def test_ragged_ep_matches_dense_oracle(ep_mesh):
     assert np.isfinite(float(aux["moe_lb_loss"]))
 
 
+@pytest.mark.slow
 def test_ragged_ep_dropless_under_total_imbalance(ep_mesh):
     """Every token to ONE expert on one rank: bound=ep guarantees no
     drops (the worst case the bound is sized for) and the output still

@@ -11,6 +11,8 @@ slice-grain elasticity drill).
 import os
 import time
 
+import pytest
+
 from elastic_harness import (
     collect as _collect,
     drain as _drain,
@@ -19,6 +21,9 @@ from elastic_harness import (
     launch_agent as _launch_agent,
     start_master as _start_master,
 )
+
+# multi-process elastic drills take minutes; excluded from the tier-1 budget
+pytestmark = pytest.mark.slow
 
 def test_world_shrink_resharded_recovery(tmp_path):
     """The composed elasticity path (SURVEY §7 hard part #1): 2-node

@@ -198,6 +198,7 @@ def test_quantized4_optimizer_trains():
     assert float(loss(params)) < 128 * 64
 
 
+@pytest.mark.slow
 def test_lowbit_adamw_chunking_is_exact():
     """Streaming in many chunks must be bit-identical to one big chunk."""
     from dlrover_tpu.ops.quant import BLOCK, lowbit_adamw

@@ -24,6 +24,9 @@ from dlrover_tpu.train import (
     make_optimizer,
 )
 
+# pipeline compiles are heavy on the CPU mesh; excluded from the tier-1 budget
+pytestmark = pytest.mark.slow
+
 CFG = get_config(
     "tiny", n_layer=4, max_seq=64, param_dtype="float32", dtype="float32"
 )

@@ -26,6 +26,7 @@ def _step(w, x):
     return jax.lax.psum(h.sum(), None) if False else h.sum()
 
 
+@pytest.mark.slow
 def test_kernel_census_finds_dots_and_collectives():
     mesh = jax.make_mesh((8,), ("dp",))
 

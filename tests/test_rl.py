@@ -463,6 +463,7 @@ def test_kl_k3_nonnegative_and_zero_at_match():
     assert float(grpo.kl_k3(drift, lp, mask)) > 0.0
 
 
+@pytest.mark.slow
 def test_grpo_increases_rewarded_token_probability():
     """Same toy task as the PPO test, critic-free: reward = fraction of
     response tokens equal to TARGET; the group baseline alone must be

@@ -10,6 +10,9 @@ from dlrover_tpu.ops.attention import mha_reference
 from dlrover_tpu.parallel import MeshConfig, build_mesh
 from dlrover_tpu.parallel.sequence import ring_attention, ulysses_attention
 
+# ring-attention compiles are heavy on the CPU mesh; excluded from the tier-1 budget
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

@@ -32,6 +32,8 @@ import os
 import re
 import time
 
+import pytest
+
 from elastic_harness import (
     collect,
     drain,
@@ -46,6 +48,7 @@ CHIPS_PER_HOST = 2
 HOST_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
 
 
+@pytest.mark.slow
 def test_slice_shrink_grow_elasticity(tmp_path):
     run_id = f"se{os.getpid()}"
     master, master_q, master_lines, addr = start_master(

@@ -95,6 +95,7 @@ def test_lookup_update_over_wire(two_servers):
     demb.close()
 
 
+@pytest.mark.slow
 def test_deepfm_trains_and_survives_rebalance(two_servers):
     """The headline drive: train -> scale OUT (migrate) -> train ->
     scale IN (migrate back) -> train; convergence must continue and

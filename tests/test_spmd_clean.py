@@ -28,6 +28,9 @@ from dlrover_tpu.train import (
     make_optimizer,
 )
 
+# full train-step compile inspection is heavy; excluded from the tier-1 budget
+pytestmark = pytest.mark.slow
+
 MARKER = "Involuntary full rematerialization"
 
 

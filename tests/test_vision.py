@@ -23,6 +23,9 @@ from dlrover_tpu.models.vision import (
 from dlrover_tpu.parallel import MeshConfig, build_mesh
 from dlrover_tpu.parallel import sharding as shd
 
+# CLIP training runs are heavy on the CPU mesh; excluded from the tier-1 budget
+pytestmark = pytest.mark.slow
+
 
 def test_patchify_layout():
     # pixel (y, x) of patch (gy, gx) must land at patch index gy*gw+gx
