@@ -19,9 +19,16 @@ these surfaces imports it from here so a single module owns the fallbacks:
 - ``HOST_MEMORY`` / ``DEVICE_MEMORY`` are ``jax.memory.Space`` members or
   ``None``; opt-state host offload requires them and raises a clear error
   instead of an AttributeError mid-step when they are missing.
+- ``offload_names_policy(*names)`` wraps the checkpoint policy
+  ``save_and_offload_only_these_names`` (activation offload for remat
+  residuals — a distinct capability from the ``jax.memory`` array-placement
+  API above, and present on 0.4.x installs that lack ``jax.memory``);
+  ``supports_activation_offload()`` reports whether it exists so callers
+  can gate config validation instead of crashing at trace time.
 """
 
 import jax
+from jax.ad_checkpoint import checkpoint_policies as _cp
 
 try:  # jax >= 0.5: typed mesh axes
     from jax.sharding import AxisType
@@ -92,6 +99,35 @@ def manual_axis_names() -> frozenset:
         name
         for name, t in zip(am.axis_names, am.axis_types)
         if t == AxisType.Manual
+    )
+
+
+def supports_activation_offload() -> bool:
+    """True when the checkpoint-policy layer can place named residuals in
+    pinned host memory (``save_and_offload_only_these_names``)."""
+    return hasattr(_cp, "save_and_offload_only_these_names")
+
+
+def offload_names_policy(*names):
+    """Checkpoint policy saving ``names`` to pinned host memory.
+
+    Everything unnamed is recomputed in backward, exactly like
+    ``save_only_these_names(*names)`` — only the residency differs.
+    Raises at policy-build time (config/trace setup) rather than deep in
+    a remat trace when the installed jax lacks the API.
+    """
+    if not supports_activation_offload():
+        raise RuntimeError(
+            "this jax install lacks checkpoint_policies."
+            "save_and_offload_only_these_names; offloading remat policies "
+            "(save_qkv_offload, offload_attn) need it — pick a "
+            "non-offloading remat policy instead"
+        )
+    return _cp.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=list(names),
+        offload_src="device",
+        offload_dst="pinned_host",
     )
 
 

@@ -46,6 +46,11 @@ class ModelConfig:
     # (less grid overhead); _fit_block caps them to the actual sequence.
     attn_block_q: int = 1024
     attn_block_k: int = 1024
+    # heads per flash-kernel program (narrow-head packing; 0 = auto:
+    # 128 // head_dim when head_dim < 128 and the layout is MHA, so
+    # gpt2-family d=64 shapes amortize mask/iota work and grid overhead
+    # across 2 heads per program; 1 disables)
+    attn_head_pack: int = 0
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
     # numerics
@@ -53,13 +58,22 @@ class ModelConfig:
     param_dtype: str = "float32"
     # rematerialisation policy:
     # none | full | dots_saveable | save_attn | save_qkv |
-    # save_qkv_gate | save_dots | offload_attn
+    # save_qkv_gate | save_dots | offload_attn | save_qkv_offload
     # (save_qkv/save_qkv_gate/save_dots = save_attn plus the qkv /
     # qkv+gate / qkv+gate+up matmul outputs — graded memory/recompute
     # tradeoffs between full and dots_saveable; offload_attn =
     # save_attn with residuals in pinned host memory — reference:
-    # atorch selective_offloading_checkpoint.py)
+    # atorch selective_offloading_checkpoint.py; save_qkv_offload =
+    # save_qkv's residual set offloaded the same way, for models whose
+    # pinned save_qkv residuals OOM the chip but full remat's ~30%
+    # backward recompute is too slow — e.g. gpt2-1.5b's tied 50k-vocab
+    # embedding)
     remat: str = "none"
+    # dtype the NAMED remat residuals are stored in (None = compute
+    # dtype). "bfloat16" halves pinned/offloaded residual bytes; the
+    # values re-enter backward matmuls that run in bf16 anyway, so the
+    # precision loss is confined to the storage round-trip.
+    remat_dtype: Optional[str] = None
     # MoE (0 = dense)
     n_experts: int = 0
     expert_top_k: int = 2
@@ -124,10 +138,22 @@ class ModelConfig:
         if self.remat not in (
             "none", "full", "dots_saveable", "save_attn", "save_qkv",
             "save_qkv_gate", "save_dots", "offload_attn",
+            "save_qkv_offload",
         ):
             # a typo'd policy would silently train with NO remat and
             # OOM configs that only fit WITH one — fail at build time
             raise ValueError(f"unknown remat policy {self.remat!r}")
+        if self.remat_dtype is not None and self.remat_dtype not in (
+            "bfloat16", "float32",
+        ):
+            raise ValueError(
+                f"remat_dtype must be None, 'bfloat16' or 'float32', "
+                f"got {self.remat_dtype!r}"
+            )
+        if self.attn_head_pack < 0:
+            raise ValueError(
+                f"attn_head_pack must be >= 0, got {self.attn_head_pack}"
+            )
         for name in ("attn_block_q", "attn_block_k"):
             b = getattr(self, name)
             if b <= 0 or b % 128:

@@ -303,9 +303,9 @@ def _project_qkv(
     # trig mix, never the projections — and the tag stays off the
     # attention input, whose `name` barrier XLA:CPU's thunk runtime
     # answers with an unsupported BF16xBF16=F32 DotThunk.
-    q = jax.ad_checkpoint.checkpoint_name(q, "q_proj")
-    k = jax.ad_checkpoint.checkpoint_name(k, "k_proj")
-    v = jax.ad_checkpoint.checkpoint_name(v, "v_proj")
+    q = _tag_residual(q, "q_proj", cfg)
+    k = _tag_residual(k, "k_proj", cfg)
+    v = _tag_residual(v, "v_proj", cfg)
     if cfg.pos == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -349,6 +349,23 @@ def _attention_block(
     return out @ layer["attn"]["wo"].astype(x.dtype)
 
 
+def _tag_residual(x, name, cfg: ModelConfig):
+    """``checkpoint_name`` with the optional ``cfg.remat_dtype`` cast.
+
+    When set, the tagged (= saved/offloaded) tensor is the narrow cast
+    and BOTH passes compute from the round-tripped value, so forward
+    and backward see identical numerics; identity outside
+    ``jax.checkpoint``, where nothing is saved and the cast would only
+    lose precision."""
+    rd = cfg.remat_dtype
+    if rd is None or cfg.remat in ("none", "full") or x.dtype == rd:
+        return jax.ad_checkpoint.checkpoint_name(x, name)
+    wide = x.dtype
+    return jax.ad_checkpoint.checkpoint_name(
+        x.astype(rd), name
+    ).astype(wide)
+
+
 def _mlp_block(x, layer, cfg: ModelConfig, mesh, fp8=None):
     mlp = layer["mlp"]
     if fp8 is not None:
@@ -371,12 +388,12 @@ def _mlp_block(x, layer, cfg: ModelConfig, mesh, fp8=None):
     if cfg.act == "swiglu":
         gate = x @ mlp["w_gate"].astype(x.dtype)
         up = x @ mlp["w_up"].astype(x.dtype)
-        gate = jax.ad_checkpoint.checkpoint_name(gate, "mlp_gate")
-        up = jax.ad_checkpoint.checkpoint_name(up, "mlp_up")
+        gate = _tag_residual(gate, "mlp_gate", cfg)
+        up = _tag_residual(up, "mlp_up", cfg)
         h = jax.nn.silu(gate) * up
     else:
         h = jax.nn.gelu(x @ mlp["w_up"].astype(x.dtype))
-        h = jax.ad_checkpoint.checkpoint_name(h, "mlp_up")
+        h = _tag_residual(h, "mlp_up", cfg)
     if mesh is not None:
         h = shd.constrain(h, mesh, "batch", "seq", "mlp")
     return h @ mlp["w_down"].astype(x.dtype)
@@ -401,7 +418,7 @@ def _layer_body(
     if tag_attn_out:
         # non-flash attention tags no flash_out/flash_lse, so save_attn
         # would otherwise pin nothing and recompute O(S²) attention
-        attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
+        attn = _tag_residual(attn, "attn_out", cfg)
     aux = {
         "moe_lb_loss": jnp.zeros([], jnp.float32),
         "moe_z_loss": jnp.zeros([], jnp.float32),
@@ -523,15 +540,28 @@ def run_trunk(
         # checkpoint, auto/opt_lib/selective_offloading_checkpoint.py) —
         # activation memory ~frees the O(L·B·S·D) attention outputs at
         # the cost of host DMA traffic in backward
+        from dlrover_tpu.common import jax_compat
+
         body = jax.checkpoint(
             body,
-            policy=cp.save_and_offload_only_these_names(
-                names_which_can_be_saved=[],
-                names_which_can_be_offloaded=[
-                    "attn_out", "flash_out", "flash_lse"
-                ],
-                offload_src="device",
-                offload_dst="pinned_host",
+            policy=jax_compat.offload_names_policy(
+                "attn_out", "flash_out", "flash_lse"
+            ),
+        )
+    elif cfg.remat == "save_qkv_offload":
+        # save_qkv's residual set, offloaded like offload_attn: for
+        # models whose pinned save_qkv residuals don't fit HBM (the
+        # gpt2-1.5b tied 50k-vocab embedding leaves no headroom on a
+        # 16 GiB chip) but full remat's ~30% recompute is too slow.
+        # Backward pays host DMA instead of matmul+kernel re-runs; the
+        # DMA overlaps the MLP recompute it replaced.
+        from dlrover_tpu.common import jax_compat
+
+        body = jax.checkpoint(
+            body,
+            policy=jax_compat.offload_names_policy(
+                "attn_out", "flash_out", "flash_lse",
+                "q_proj", "k_proj", "v_proj",
             ),
         )
 
@@ -766,6 +796,7 @@ def forward(
                     causal=cfg.causal,
                     block_q=cfg.attn_block_q,
                     block_k=cfg.attn_block_k,
+                    head_pack=cfg.attn_head_pack,
                 ),
                 prefix_len=prefix_len,
                 window=cfg.attn_window,
@@ -786,6 +817,7 @@ def forward(
             block_k=cfg.attn_block_k,
             prefix_len=prefix_len,
             window=cfg.attn_window,
+            head_pack=cfg.attn_head_pack,
         )
 
     x, aux = run_trunk(

@@ -24,6 +24,21 @@ fallback.
 Both paths support GLM-style prefix-LM masking (per-batch prefix scalar in
 SMEM) and GQA (K/V shared across head groups via BlockSpec index maps, no
 materialized repeats).
+
+Narrow-head packing (``head_pack``): heads narrower than the 128-lane MXU
+quantum (gpt2's head_dim=64) pack ``128 // head_dim`` heads into ONE grid
+program along a leading block axis ([pack, block, d] tiles). The per-head
+matmuls are unrolled inside the program with their m/l/acc/lse bookkeeping
+kept per-head, so numerics are identical to the unpacked kernels. What the
+packing buys is NOT more MXU lanes per matmul — the 128-lane quantum makes
+a d=64 contraction cost the same executed MXU passes packed or not — it is
+everything around the matmuls: the causal/prefix/window mask and its iotas
+are computed once per program and shared by all packed heads (VPU work that
+otherwise rivals the d<128 matmul cost), there are pack× fewer grid
+programs/epilogues, and K/V tiles DMA in pack-head batches. Heads that
+don't divide evenly are zero-padded at the jnp level (a zeroed q/k/v head
+yields out=0 and a finite lse, sliced off after); GQA keeps the unpacked
+path (every GQA config here runs full-width d=128 heads anyway).
 """
 
 import functools
@@ -106,31 +121,56 @@ def _block_runs(causal, has_prefix, pref, q_start, k_start, block_q,
     return run
 
 
+# sentinel distinguishing "compute the mask here" from a precomputed
+# mask (which may legitimately be None for non-causal attention)
+_MASK_UNSET = object()
+
+
+def _allowed_mask(q_start, k_start, block_q, block_k, causal, has_prefix,
+                  pref, window=0):
+    """The [block_q, block_k] visibility mask (None when unmasked) — the
+    ONE place the mask rule's geometry lives; every kernel reaches it
+    through ``_masked_scores`` so forward and backward cannot drift.
+    Packed kernels call it directly ONCE per program and share the
+    result across all packed heads (the mask depends only on positions,
+    never on the head)."""
+    if not causal:
+        return None
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    allowed = q_pos >= k_pos
+    if window:
+        # Mistral-style sliding window: each query sees the last
+        # `window` positions (itself included)
+        allowed = jnp.logical_and(allowed, q_pos - k_pos < window)
+    if has_prefix:
+        # GLM-style prefix-LM: keys inside the prefix are visible
+        # to every query (bidirectional prefix, causal tail)
+        allowed = jnp.logical_or(allowed, k_pos < pref)
+    return allowed
+
+
 def _masked_scores(q, k, scale, q_start, k_start, block_q, block_k,
-                   causal, has_prefix, pref, window=0):
-    """q @ kᵀ with the causal / prefix-LM / sliding-window mask — the
-    ONE place the mask rule lives; forward and both backward kernels
-    call it so they cannot drift apart."""
+                   causal, has_prefix, pref, window=0,
+                   allowed=_MASK_UNSET):
+    """q @ kᵀ with the causal / prefix-LM / sliding-window mask.
+    ``allowed`` short-circuits the mask computation with a precomputed
+    ``_allowed_mask`` result (head-packed kernels build it once and
+    apply it to every packed head)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
-    if causal:
-        q_pos = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+    if allowed is _MASK_UNSET:
+        allowed = _allowed_mask(
+            q_start, k_start, block_q, block_k, causal, has_prefix,
+            pref, window=window,
         )
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        allowed = q_pos >= k_pos
-        if window:
-            # Mistral-style sliding window: each query sees the last
-            # `window` positions (itself included)
-            allowed = jnp.logical_and(allowed, q_pos - k_pos < window)
-        if has_prefix:
-            # GLM-style prefix-LM: keys inside the prefix are visible
-            # to every query (bidirectional prefix, causal tail)
-            allowed = jnp.logical_or(allowed, k_pos < pref)
+    if allowed is not None:
         s = jnp.where(allowed, s, NEG_INF)
     return s
 
@@ -145,6 +185,24 @@ def _p_and_ds(s, do, v, lse_col, delta_col, scale):
     )
     ds = p * (dp - delta_col) * scale
     return p, ds
+
+
+def _fwd_head_step(s, v, m_prev, l_prev, acc_prev):
+    """One head's online-softmax update from masked scores ``s`` — the
+    math shared verbatim by the unpacked and head-packed forward
+    kernels. Returns (m_new [bq,1], l_new [bq,1], acc_new [bq,d])."""
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_new = acc_prev * alpha + jax.lax.dot_general(
+        p.astype(v.dtype),
+        v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
 
 
 def _fwd_kernel(
@@ -194,20 +252,10 @@ def _fwd_kernel(
             q_ref[0], k_ref[0], scale, q_start, k_start,
             block_q, block_k, causal, has_prefix, pref, window=window,
         )
-
-        m_prev = m_scratch[:, :1]  # [bq, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l_scratch[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-
-        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype),
-            v_ref[0],
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        m_new, l_new, acc_new = _fwd_head_step(
+            s, v_ref[0], m_scratch[:, :1], l_scratch[:, :1], acc_scratch[:]
         )
+        acc_scratch[:] = acc_new
         m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
         l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
 
@@ -220,6 +268,82 @@ def _fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(
             m_scratch[:, :1] + jnp.log(l), lse_ref.shape[1:]
         )
+
+
+def _fwd_kernel_packed(
+    q_ref,  # [1, pack, block_q, d]
+    k_ref,  # [1, pack, block_k, d]
+    v_ref,  # [1, pack, block_k, d]
+    prefix_ref,  # [B, 1] int32 in SMEM (None w/o prefix)
+    offs_ref,  # [1, 2] int32 in SMEM (None w/o offsets)
+    o_ref,  # [1, pack, block_q, d]
+    lse_ref,  # [1, pack, block_q, 8] f32
+    m_scratch,  # [pack, block_q, 128] f32
+    l_scratch,  # [pack, block_q, 128] f32
+    acc_scratch,  # [pack, block_q, d] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    has_prefix: bool,
+    has_offsets: bool = False,
+    n_head: int = 1,  # grid-dim-0 entries per batch = h // pack
+    window: int = 0,
+    pack: int = 2,
+):
+    """Head-packed forward: ``pack`` heads of the same batch share one
+    grid program. The per-head online softmax is unrolled with m/l/acc
+    kept per-head, so the results are identical to the unpacked kernel;
+    the mask (the VPU-side cost that rivals a d<128 matmul) is computed
+    ONCE and shared — that, the pack× fewer programs, and the batched
+    K/V DMA are the whole point of packing."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    pref = (
+        prefix_ref[pl.program_id(0) // n_head, 0] if has_prefix else None
+    )
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q + (offs_ref[0, 0] if has_offsets else 0)
+    k_start = ki * block_k + (offs_ref[0, 1] if has_offsets else 0)
+
+    @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
+                         block_q, block_k, window))
+    def _body():
+        allowed = _allowed_mask(
+            q_start, k_start, block_q, block_k, causal, has_prefix,
+            pref, window=window,
+        )
+        for p in range(pack):
+            s = _masked_scores(
+                q_ref[0, p], k_ref[0, p], scale, q_start, k_start,
+                block_q, block_k, causal, has_prefix, pref,
+                window=window, allowed=allowed,
+            )
+            m_new, l_new, acc_new = _fwd_head_step(
+                s, v_ref[0, p],
+                m_scratch[p, :, :1], l_scratch[p, :, :1], acc_scratch[p],
+            )
+            acc_scratch[p] = acc_new
+            m_scratch[p] = jnp.broadcast_to(m_new, m_scratch.shape[1:])
+            l_scratch[p] = jnp.broadcast_to(l_new, l_scratch.shape[1:])
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        for p in range(pack):
+            l = l_scratch[p, :, :1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, p] = (acc_scratch[p] / l).astype(o_ref.dtype)
+            lse_ref[0, p] = jnp.broadcast_to(
+                m_scratch[p, :, :1] + jnp.log(l), lse_ref.shape[2:]
+            )
 
 
 def _insert_none_args(kernel, idxs):
@@ -352,21 +476,161 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
+def _bwd_dq_kernel_packed(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, prefix_ref,
+    offs_ref,
+    dq_ref,
+    acc_scratch,  # [pack, block_q, d] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    has_prefix: bool,
+    has_offsets: bool = False,
+    n_head: int = 1,
+    window: int = 0,
+    pack: int = 2,
+):
+    """Head-packed dq pass: q/k/v/do/lse/delta blocks carry a leading
+    ``pack`` head axis; the recomputed-p backward is unrolled per head
+    under ONE shared mask (see _fwd_kernel_packed)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    pref = (
+        prefix_ref[pl.program_id(0) // n_head, 0] if has_prefix else None
+    )
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q + (offs_ref[0, 0] if has_offsets else 0)
+    k_start = ki * block_k + (offs_ref[0, 1] if has_offsets else 0)
+
+    @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
+                         block_q, block_k, window))
+    def _body():
+        allowed = _allowed_mask(
+            q_start, k_start, block_q, block_k, causal, has_prefix,
+            pref, window=window,
+        )
+        for p in range(pack):
+            k = k_ref[0, p]
+            s = _masked_scores(
+                q_ref[0, p], k, scale, q_start, k_start,
+                block_q, block_k, causal, has_prefix, pref,
+                window=window, allowed=allowed,
+            )
+            _, ds = _p_and_ds(
+                s, do_ref[0, p], v_ref[0, p],
+                lse_ref[0, p][:, :1], delta_ref[0, p][:, :1], scale,
+            )
+            acc_scratch[p] = acc_scratch[p] + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        for p in range(pack):
+            dq_ref[0, p] = acc_scratch[p].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_packed(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, prefix_ref,
+    offs_ref,
+    dk_ref, dv_ref,
+    dk_scratch,  # [pack, block_k, d] f32
+    dv_scratch,  # [pack, block_k, d] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    has_prefix: bool,
+    has_offsets: bool = False,
+    n_head: int = 1,
+    window: int = 0,
+    pack: int = 2,
+):
+    """Head-packed dk/dv pass (q-blocks innermost), unrolled per head
+    under one shared mask."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    pref = (
+        prefix_ref[pl.program_id(0) // n_head, 0] if has_prefix else None
+    )
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = qi * block_q + (offs_ref[0, 0] if has_offsets else 0)
+    k_start = ki * block_k + (offs_ref[0, 1] if has_offsets else 0)
+
+    @pl.when(_block_runs(causal, has_prefix, pref, q_start, k_start,
+                         block_q, block_k, window))
+    def _body():
+        allowed = _allowed_mask(
+            q_start, k_start, block_q, block_k, causal, has_prefix,
+            pref, window=window,
+        )
+        for p in range(pack):
+            q = q_ref[0, p]
+            do = do_ref[0, p]
+            s = _masked_scores(
+                q, k_ref[0, p], scale, q_start, k_start,
+                block_q, block_k, causal, has_prefix, pref,
+                window=window, allowed=allowed,
+            )
+            pr, ds = _p_and_ds(
+                s, do, v_ref[0, p],
+                lse_ref[0, p][:, :1], delta_ref[0, p][:, :1], scale,
+            )
+            dv_scratch[p] = dv_scratch[p] + jax.lax.dot_general(
+                pr.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dk_scratch[p] = dk_scratch[p] + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        for p in range(pack):
+            dk_ref[0, p] = dk_scratch[p].astype(dk_ref.dtype)
+            dv_ref[0, p] = dv_scratch[p].astype(dv_ref.dtype)
+
+
 def _pallas_backward(q, k, v, out, lse, g, causal, scale,
                      block_q, block_k, prefix=None,
                      interpret: Optional[bool] = None,
-                     g_lse=None, window: int = 0, offsets=None):
+                     g_lse=None, window: int = 0, offsets=None,
+                     head_pack: int = 1):
     """FA2-style pallas backward: returns (dq, dk, dv).
 
     All [B,S,H,D] layouts like the forward; GQA dk/dv are group-summed
     back to the kv head count. ``g_lse`` [B,H,S] (ring attention's lse
     cotangent) folds into the per-row delta — ∂lse/∂s_j = p_j, so it
     enters ds as an additive term and the kernels need no change.
+
+    ``head_pack`` > 1 runs the head-packed kernel variants (MHA only;
+    h must divide by the pack — the jnp wrapper pads heads first).
     """
     interpret = INTERPRET if interpret is None else interpret
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     groups = h // hkv
+    pack = max(int(head_pack), 1)
+    if pack > 1:
+        assert h == hkv and h % pack == 0, (
+            "head packing needs MHA with heads divisible by the pack"
+        )
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     assert sq % block_q == 0 and sk % block_k == 0
@@ -424,7 +688,9 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
         block_k=block_k,
         has_prefix=has_prefix,
         has_offsets=has_offsets,
-        n_head=h,
+        # grid-dim-0 entries per batch (the prefix SMEM row index is
+        # program_id(0) // n_head): h unpacked, h/pack packed
+        n_head=h // pack,
         window=window,
     )
     # with traced global offsets the diagonal's grid position is unknown
@@ -457,6 +723,97 @@ def _pallas_backward(q, k, v, out, lse, g, causal, scale,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         )
     )
+
+    if pack > 1:
+        # head-packed variants: same grids with dim 0 shrunk pack×, all
+        # q/k/v/do/lse/delta blocks carrying a leading pack axis. groups
+        # == 1 here (MHA only), so no GQA index sharing or group-sum.
+        gp = b * h // pack
+        qt4 = qt.reshape(gp, pack, sq, d)
+        kt4 = kt.reshape(gp, pack, sk, d)
+        vt4 = vt.reshape(gp, pack, sk, d)
+        dot4 = dot.reshape(gp, pack, sq, d)
+        delta84 = delta8.reshape(gp, pack, sq, 8)
+        lse84 = lse8.reshape(gp, pack, sq, 8)
+        common_p = dict(common, pack=pack)
+
+        def k_idx4(g_, i, j):
+            if causal_clamp:
+                j = jnp.minimum(
+                    j, _last_visible_k_block(i, block_q, block_k)
+                )
+                if window:
+                    j = jnp.maximum(
+                        j,
+                        _first_window_k_block(i, block_q, block_k, window),
+                    )
+            return (g_, 0, j, 0)
+
+        q_spec4 = pl.BlockSpec(
+            (1, pack, block_q, d), lambda g_, i, j: (g_, 0, i, 0)
+        )
+        row8_spec4 = pl.BlockSpec(
+            (1, pack, block_q, 8), lambda g_, i, j: (g_, 0, i, 0)
+        )
+        k_spec4 = pl.BlockSpec((1, pack, block_k, d), k_idx4)
+        dq = pl.pallas_call(
+            wrap(functools.partial(_bwd_dq_kernel_packed, **common_p)),
+            grid=(gp, sq // block_q, sk // block_k),
+            in_specs=[q_spec4, k_spec4, k_spec4, q_spec4, row8_spec4,
+                      row8_spec4, *extra_specs],
+            out_specs=q_spec4,
+            out_shape=jax.ShapeDtypeStruct((gp, pack, sq, d), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((pack, block_q, d), jnp.float32)
+            ],
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(qt4, kt4, vt4, dot4, lse84, delta84, *extra)
+
+        nq4 = sq // block_q
+
+        def q_idx4(g_, j, i):
+            if causal_clamp:
+                i = jnp.maximum(
+                    i, _first_visible_q_block(j, nq4, block_q, block_k)
+                )
+                if window:
+                    i = jnp.minimum(
+                        i,
+                        _last_window_q_block(
+                            j, nq4, block_q, block_k, window
+                        ),
+                    )
+            return (g_, 0, i, 0)
+
+        qkv_spec4 = pl.BlockSpec((1, pack, block_q, d), q_idx4)
+        row8_spec42 = pl.BlockSpec((1, pack, block_q, 8), q_idx4)
+        kv_spec4 = pl.BlockSpec(
+            (1, pack, block_k, d), lambda g_, j, i: (g_, 0, j, 0)
+        )
+        dk, dv = pl.pallas_call(
+            wrap(functools.partial(_bwd_dkv_kernel_packed, **common_p)),
+            grid=(gp, sk // block_k, sq // block_q),
+            in_specs=[qkv_spec4, kv_spec4, kv_spec4, qkv_spec4,
+                      row8_spec42, row8_spec42, *extra_specs],
+            out_specs=[kv_spec4, kv_spec4],
+            out_shape=[
+                jax.ShapeDtypeStruct((gp, pack, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((gp, pack, sk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((pack, block_k, d), jnp.float32),
+                pltpu.VMEM((pack, block_k, d), jnp.float32),
+            ],
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(qt4, kt4, vt4, dot4, lse84, delta84, *extra)
+        dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+        dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+        dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+        return (
+            dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        )
 
     dq = pl.pallas_call(
         wrap(functools.partial(_bwd_dq_kernel, **common)),
@@ -534,12 +891,18 @@ def _flash_fwd(
     prefix: Optional[jax.Array] = None,  # [B] int32 prefix-LM lengths
     window: int = 0,  # sliding window (causal only; 0 = unlimited)
     offsets: Optional[jax.Array] = None,  # [2] int32 global (q_off, k_off)
+    head_pack: int = 1,  # heads per grid program (MHA only; h % pack == 0)
 ) -> jax.Array:
     interpret = INTERPRET if interpret is None else interpret
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     assert h % hkv == 0
     groups = h // hkv
+    pack = max(int(head_pack), 1)
+    if pack > 1:
+        assert h == hkv and h % pack == 0, (
+            "head packing needs MHA with heads divisible by the pack"
+        )
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     assert sq % block_q == 0 and sk % block_k == 0, (
@@ -548,22 +911,29 @@ def _flash_fwd(
 
     # layout: [B, H, S, D] so the matmul dims are the minor two. K/V stay
     # at hkv heads — GQA sharing happens in the BlockSpec index_map
-    # (g // groups), never as a materialized jnp.repeat in HBM
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
-
-    grid = (b * h, sq // block_q, sk // block_k)
+    # (g // groups), never as a materialized jnp.repeat in HBM.
+    # Packed: [B·H/pack, pack, S, D] — pack heads ride one grid program.
+    if pack > 1:
+        qt = q.transpose(0, 2, 1, 3).reshape(b * h // pack, pack, sq, d)
+        kt = k.transpose(0, 2, 1, 3).reshape(b * h // pack, pack, sk, d)
+        vt = v.transpose(0, 2, 1, 3).reshape(b * h // pack, pack, sk, d)
+        grid = (b * h // pack, sq // block_q, sk // block_k)
+    else:
+        qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+        kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+        vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+        grid = (b * h, sq // block_q, sk // block_k)
     kernel = functools.partial(
-        _fwd_kernel,
+        _fwd_kernel_packed if pack > 1 else _fwd_kernel,
         causal=causal,
         scale=scale,
         block_q=block_q,
         block_k=block_k,
         has_prefix=prefix is not None,
         has_offsets=offsets is not None,
-        n_head=h,
+        n_head=h // pack,
         window=window,
+        **({"pack": pack} if pack > 1 else {}),
     )
     inputs = (qt, kt, vt)
     prefix_specs = []
@@ -591,40 +961,83 @@ def _flash_fwd(
         # map still DMAs them; clamping j re-addresses the SAME block,
         # which pallas does not refetch — saves the dead K/V traffic.
         # (A prefix can make above-diagonal blocks live, so no clamp.)
-        def kv_index(g, i, j):
-            j_max = _last_visible_k_block(i, block_q, block_k)
-            j = jnp.minimum(j, j_max)
+        def _kv_j(i, j):
+            j = jnp.minimum(j, _last_visible_k_block(i, block_q, block_k))
             if window:
                 j = jnp.maximum(
                     j, _first_window_k_block(i, block_q, block_k, window)
                 )
-            return (g // groups, j, 0)
+            return j
     else:
-        def kv_index(g, i, j):
-            return (g // groups, j, 0)
+        def _kv_j(i, j):
+            return j
+
+    if pack > 1:
+        in_specs = [
+            pl.BlockSpec(
+                (1, pack, block_q, d), lambda g, i, j: (g, 0, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, pack, block_k, d),
+                lambda g, i, j: (g, 0, _kv_j(i, j), 0),
+            ),
+            pl.BlockSpec(
+                (1, pack, block_k, d),
+                lambda g, i, j: (g, 0, _kv_j(i, j), 0),
+            ),
+        ]
+        out_specs = [
+            pl.BlockSpec(
+                (1, pack, block_q, d), lambda g, i, j: (g, 0, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, pack, block_q, 8), lambda g, i, j: (g, 0, i, 0)
+            ),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((b * h // pack, pack, sq, d), q.dtype),
+            jax.ShapeDtypeStruct(
+                (b * h // pack, pack, sq, 8), jnp.float32
+            ),
+        ]
+        scratch_shapes = [
+            pltpu.VMEM((pack, block_q, 128), jnp.float32),
+            pltpu.VMEM((pack, block_q, 128), jnp.float32),
+            pltpu.VMEM((pack, block_q, d), jnp.float32),
+        ]
+    else:
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda g, i, j: (g // groups, _kv_j(i, j), 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda g, i, j: (g // groups, _kv_j(i, j), 0),
+            ),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda g, i, j: (g, i, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32),
+        ]
+        scratch_shapes = [
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ]
 
     out, lse = pl.pallas_call(
         kernel_fn,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            *prefix_specs,
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda g, i, j: (g, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq, 8), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
+        in_specs=[*in_specs, *prefix_specs],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
         compiler_params=None
         if interpret
         else pltpu.CompilerParams(
@@ -633,7 +1046,11 @@ def _flash_fwd(
         interpret=interpret,
     )(*inputs)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    lse = lse[:, :, 0].reshape(b, h, sq)  # [B, H, S]
+    lse = (
+        lse[..., 0].reshape(b, h, sq)
+        if pack > 1
+        else lse[:, :, 0].reshape(b, h, sq)
+    )  # [B, H, S]
     return out, lse
 
 
@@ -750,22 +1167,22 @@ def _chunked_backward(q, k, v, out, lse, g, causal, scale, chunk,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
 )
 def _flash_attention(q, k, v, prefix, offsets, causal, scale, block_q,
-                     block_k, window=0):
+                     block_k, window=0, head_pack=1):
     out, _ = _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window, offsets=offsets,
+        window=window, offsets=offsets, head_pack=head_pack,
     )
     return out
 
 
 def _fwd_rule(q, k, v, prefix, offsets, causal, scale, block_q, block_k,
-              window=0):
+              window=0, head_pack=1):
     out, lse = _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window, offsets=offsets,
+        window=window, offsets=offsets, head_pack=head_pack,
     )
     # named so remat policies can pin the kernel residuals in memory and
     # skip re-running the forward kernel in backward (decoder save_attn)
@@ -774,19 +1191,21 @@ def _fwd_rule(q, k, v, prefix, offsets, causal, scale, block_q, block_k,
     return out, (q, k, v, prefix, offsets, out, lse)
 
 
-def _bwd_rule(causal, scale, block_q, block_k, window, residuals, g):
+def _bwd_rule(causal, scale, block_q, block_k, window, head_pack,
+              residuals, g):
     # same dispatch as the lse-carrying variant, with no lse cotangent
     return _bwd_rule_lse(
-        causal, scale, block_q, block_k, window, residuals, (g, None)
+        causal, scale, block_q, block_k, window, head_pack, residuals,
+        (g, None),
     )
 
 
 _flash_attention.defvjp(_fwd_rule, _bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def flash_attention_with_lse(q, k, v, prefix, offsets, causal, scale,
-                             block_q, block_k, window=0):
+                             block_q, block_k, window=0, head_pack=1):
     """Flash attention returning (out, lse) with BOTH differentiable —
     the primitive ring attention composes (the lse feeds the cross-block
     softmax merge, so its gradient is load-bearing). ``prefix`` [B] int32
@@ -796,15 +1215,15 @@ def flash_attention_with_lse(q, k, v, prefix, offsets, causal, scale,
     window-boundary and prefix-reach blocks run this kernel too."""
     return _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window, offsets=offsets,
+        window=window, offsets=offsets, head_pack=head_pack,
     )
 
 
 def _fwd_rule_lse(q, k, v, prefix, offsets, causal, scale, block_q,
-                  block_k, window=0):
+                  block_k, window=0, head_pack=1):
     out, lse = _flash_fwd(
         q, k, v, causal, scale, block_q, block_k, prefix=prefix,
-        window=window, offsets=offsets,
+        window=window, offsets=offsets, head_pack=head_pack,
     )
     # same tags as _fwd_rule: lets remat policies (and the ring's scan
     # checkpoint) pin the residuals instead of re-running the kernel
@@ -813,8 +1232,8 @@ def _fwd_rule_lse(q, k, v, prefix, offsets, causal, scale, block_q,
     return (out, lse), (q, k, v, prefix, offsets, out, lse)
 
 
-def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
-                  cot):
+def _bwd_rule_lse(causal, scale, block_q, block_k, window, head_pack,
+                  residuals, cot):
     """The ONE backward dispatch (plain _bwd_rule delegates here with a
     None lse cotangent): FA2 pallas kernels on TPU/interpret with tiles
     capped per head width (BWD_BLOCK / BWD_BLOCK_WIDE — ~4 [bq,bk] f32
@@ -836,6 +1255,7 @@ def _bwd_rule_lse(causal, scale, block_q, block_k, window, residuals,
         dq, dk, dv = _pallas_backward(
             q, k, v, out, lse, g_out, causal, scale, bq, bk,
             prefix=prefix, g_lse=g_lse, window=window, offsets=offsets,
+            head_pack=head_pack,
         )
     else:
         dq, dk, dv = _chunked_backward(
@@ -873,6 +1293,7 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     prefix_len: Optional[jax.Array] = None,  # [B] int32: prefix-LM
     window: int = 0,  # sliding window (causal only; 0 = unlimited)
+    head_pack: int = 0,  # heads per kernel program (0 = auto)
 ) -> jax.Array:
     """Flash attention; falls back to the jnp path off-TPU.
 
@@ -881,9 +1302,17 @@ def flash_attention(
     visible to every query — GLM-style bidirectional-prefix attention.
     ``window`` (causal only) limits each query to the last ``window``
     positions — Mistral-style sliding-window attention.
+    ``head_pack`` packs that many narrow heads into one kernel program
+    (module docstring, "narrow-head packing"): 0 picks 128 // D when
+    D < 128 divides the lane width and the layout is MHA, 1 disables.
+    Head counts that don't divide the pack are zero-padded (a zero
+    q/k/v head yields zero out and zero grads, so the slice is exact);
+    GQA always runs unpacked — packing would replicate kv DMA per
+    group and the kernels keep the simple grid//groups indexing.
     """
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     sq, sk = q.shape[1], k.shape[1]
+    h, hkv, d = q.shape[2], k.shape[2], q.shape[-1]
     bq = _fit_block(sq, block_q)
     bk = _fit_block(sk, block_k)
     if prefix_len is not None and not causal:
@@ -895,7 +1324,9 @@ def flash_attention(
             raise ValueError("window requires causal=True")
         if prefix_len is not None:
             raise ValueError("window and prefix_len are mutually exclusive")
-    if pltpu is None or not _on_tpu() or bq is None or bk is None:
+    if head_pack < 0:
+        raise ValueError(f"head_pack must be >= 0, got {head_pack}")
+    if pltpu is None or not (_on_tpu() or INTERPRET) or bq is None or bk is None:
         # off-TPU (incl. GPU — this is a Mosaic-TPU kernel), or seq not
         # tileable to a lane-aligned block: plain jnp, never a trace-time
         # crash
@@ -905,8 +1336,22 @@ def flash_attention(
             q, k, v, causal=causal, softmax_scale=scale,
             prefix_len=prefix_len, window=window,
         )
+    if head_pack == 0:
+        pack = 128 // d if (d < 128 and 128 % d == 0 and h == hkv) else 1
+    else:
+        pack = head_pack
+        if h != hkv or d * pack > 128 or 128 % d != 0:
+            pack = 1  # demote: GQA or pack overflows the lane width
+    if pack > 1 and h % pack:
+        pad = -h % pack
+        zpad = [(0, 0), (0, 0), (0, pad), (0, 0)]
+        out = _flash_attention(
+            jnp.pad(q, zpad), jnp.pad(k, zpad), jnp.pad(v, zpad),
+            prefix_len, None, causal, scale, bq, bk, window, pack,
+        )
+        return out[:, :, :h]
     return _flash_attention(
-        q, k, v, prefix_len, None, causal, scale, bq, bk, window
+        q, k, v, prefix_len, None, causal, scale, bq, bk, window, pack
     )
 
 

@@ -50,6 +50,8 @@ re-route.  models/deepfm.DeepFM fits with a two-line adapter.
 
 import json
 import os
+import shutil
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -289,11 +291,17 @@ def synthesize_cluster_spec(
     get_running = getattr(client, "get_running_nodes", None)
     if callable(get_running):
         try:
-            for n in get_running() or []:
+            for i, n in enumerate(get_running() or []):
                 role = getattr(n, "type", "") or "worker"
                 if role == "ps":
                     continue  # the versioned ring is authoritative
-                name = getattr(n, "name", "") or f"{role}-{n.id}"
+                # enumerate index as the id fallback: one node object
+                # missing BOTH attrs must not raise and drop the whole
+                # listing (the except below bails wholesale)
+                name = (
+                    getattr(n, "name", "")
+                    or f"{role}-{getattr(n, 'id', i)}"
+                )
                 cluster.setdefault(role, []).append(name)
             for members in cluster.values():
                 members.sort()
@@ -971,8 +979,16 @@ class Estimator:
 
     def export_best(self, metrics: Dict[str, float], metric: str) -> bool:
         """Keep ``model_dir/export/best`` at the checkpoint with the best
-        (lowest) value of ``metric``.  Returns True when exported."""
-        export_dir = os.path.join(self.config.model_dir, "export", "best")
+        (lowest) value of ``metric``.  Returns True when exported.
+
+        The export is atomic: the model saves into a fresh temp dir
+        under ``export/`` which then REPLACES ``best`` by rename, so a
+        reader (or a second writer — chief and evaluator can both call
+        this, see train_and_evaluate) never observes a half-written
+        tree where ``metadata.json`` promises a model that isn't fully
+        there."""
+        export_root = os.path.join(self.config.model_dir, "export")
+        export_dir = os.path.join(export_root, "best")
         meta_path = os.path.join(export_dir, "metadata.json")
         current = metrics.get(metric)
         if current is None:
@@ -984,7 +1000,8 @@ class Estimator:
             best = float("inf")
         if float(current) >= best:
             return False
-        os.makedirs(export_dir, exist_ok=True)
+        os.makedirs(export_root, exist_ok=True)
+        tmp_dir = tempfile.mkdtemp(prefix=".best-", dir=export_root)
         # side-effect-free export when the model supports it: a plain
         # full save would clear the sparse tier's dirty epoch, silently
         # invalidating the chief's cumulative incremental checkpoints
@@ -999,13 +1016,30 @@ class Estimator:
             )
         except (TypeError, ValueError):
             supports_clear = False
-        if supports_clear:
-            self.model.save(export_dir, clear_dirty=False)
-        else:
-            self.model.save(export_dir)
-        with open(meta_path, "w", encoding="utf-8") as f:
-            f.write(json.dumps({metric: float(current),
-                                "step": self.global_step}))
+        try:
+            if supports_clear:
+                self.model.save(tmp_dir, clear_dirty=False)
+            else:
+                self.model.save(tmp_dir)
+            with open(
+                os.path.join(tmp_dir, "metadata.json"),
+                "w",
+                encoding="utf-8",
+            ) as f:
+                f.write(json.dumps({metric: float(current),
+                                    "step": self.global_step}))
+            # dir renames aren't atomic-replace like os.replace on a
+            # file, so move the old tree aside first; a crash between
+            # the two renames leaves NO best (plus a recoverable
+            # .best-* temp) rather than a torn one
+            old_dir = tmp_dir + ".old"
+            if os.path.isdir(export_dir):
+                os.rename(export_dir, old_dir)
+            os.rename(tmp_dir, export_dir)
+            shutil.rmtree(old_dir, ignore_errors=True)
+        except Exception:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            raise
         logger.info(
             "best export updated: %s=%.5f at step %d",
             metric, float(current), self.global_step,
@@ -1035,7 +1069,13 @@ def train_and_evaluate(
         logger.info(
             "eval at step %d: %s", estimator.global_step, metrics
         )
-        if estimator.cluster.is_chief:
+        if estimator.cluster.is_chief and not estimator.cluster.cluster.get(
+            "evaluator"
+        ):
+            # with a dedicated evaluator in the spec, run_evaluator owns
+            # the best export — two writers would race the rename swap
+            # and could pin "best" to whichever finished last, not the
+            # better metric
             estimator.export_best(metrics, eval_spec.metric)
         if estimator.global_step == before:
             break  # input exhausted: stop instead of spinning

@@ -314,6 +314,17 @@ class TrainStepBuilder:
                 "offload_opt_state needs the jax.memory.Space API; "
                 "this jax build has no host memory space"
             )
+        if cfg.remat in ("offload_attn", "save_qkv_offload"):
+            from dlrover_tpu.common import jax_compat
+
+            if not jax_compat.supports_activation_offload():
+                # fail at builder construction, not deep in the remat
+                # trace of the first step
+                raise RuntimeError(
+                    f"remat={cfg.remat!r} needs checkpoint_policies."
+                    "save_and_offload_only_these_names, which this jax "
+                    "build lacks; use save_qkv or full instead"
+                )
         # switch-gating jitter needs a per-step rng; only the built-in
         # loss_fn accepts one (a custom loss_fn owns its rng handling)
         self._needs_rng = (

@@ -1403,3 +1403,111 @@ def test_ring_full_export_with_clear_dirty_false_keeps_delta_epoch(tmp_path):
         demb.close()
     finally:
         s0.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 3 satellites: node-listing resilience + best-export race/atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_synthesize_cluster_spec_survives_malformed_node():
+    """One node object missing BOTH name and id must fall back to its
+    enumerate index — not raise inside the loop and drop the whole
+    running-node listing (ADVICE r5 low #1)."""
+
+    class _Node:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    master = FakePsMaster()
+    master.set_ring(["s0"], {"s0": ("h", 1)})
+    master.get_running_nodes = lambda: [
+        _Node(type="worker", name="w-a"),
+        _Node(type="worker"),            # no name, no id → index fallback
+        _Node(type="evaluator", id=7),   # no name → role-id
+    ]
+    spec = synthesize_cluster_spec(master)
+    assert spec.cluster["worker"] == ["w-a", "worker-1"]
+    assert spec.cluster["evaluator"] == ["evaluator-7"]
+    assert spec.cluster["ps"] == ["s0"]
+
+
+def test_export_best_atomic_replace(tmp_path):
+    """Best export lands via temp-dir + rename: after each export the
+    ``best`` tree is complete (model + metadata agree) and no ``.best-``
+    temp dirs linger (ADVICE r5 low #2)."""
+    model = _RecordingModel()
+    est = Estimator(
+        lambda mode, params, cluster: model,
+        config=RunConfig(model_dir=str(tmp_path)),
+    )
+    est.model
+    assert est.export_best({"loss": 0.5}, "loss") is True
+    export_root = os.path.join(str(tmp_path), "export")
+    best = os.path.join(export_root, "best")
+    with open(os.path.join(best, "metadata.json")) as f:
+        assert json.load(f)["loss"] == 0.5
+    # the model saved into the TEMP dir, which became best by rename
+    assert model.save_calls[-1][0] != best
+    est.global_step = 5
+    assert est.export_best({"loss": 0.3}, "loss") is True
+    with open(os.path.join(best, "metadata.json")) as f:
+        assert json.load(f) == {"loss": 0.3, "step": 5}
+    leftovers = [d for d in os.listdir(export_root) if d != "best"]
+    assert leftovers == []
+
+
+def test_export_best_failed_save_keeps_previous(tmp_path):
+    """A save() crash mid-export must leave the previous best intact
+    (the swap only happens after a complete temp tree) and clean up its
+    temp dir."""
+    model = _RecordingModel()
+    est = Estimator(
+        lambda mode, params, cluster: model,
+        config=RunConfig(model_dir=str(tmp_path)),
+    )
+    est.model
+    assert est.export_best({"loss": 0.5}, "loss") is True
+
+    def _boom(dir_path, delta_only=False, clear_dirty=None):
+        raise RuntimeError("save died")
+
+    model.save = _boom
+    with pytest.raises(RuntimeError, match="save died"):
+        est.export_best({"loss": 0.2}, "loss")
+    export_root = os.path.join(str(tmp_path), "export")
+    best = os.path.join(export_root, "best")
+    with open(os.path.join(best, "metadata.json")) as f:
+        assert json.load(f)["loss"] == 0.5  # previous best survives
+    assert [d for d in os.listdir(export_root) if d != "best"] == []
+
+
+def test_train_and_evaluate_chief_defers_export_to_evaluator(tmp_path):
+    """With an evaluator role in the ClusterSpec the chief must NOT race
+    it on export/best: run_evaluator owns the export (ADVICE r5 low #2);
+    without one the chief exports as before."""
+    for evaluator, expect_saves in ((["e-0"], 0), ([], 1)):
+        model = _RecordingModel()
+        cluster = {"worker": ["w-0"]}
+        if evaluator:
+            cluster["evaluator"] = evaluator
+        est = Estimator(
+            lambda mode, params, cluster: model,
+            config=RunConfig(
+                model_dir=str(tmp_path / ("ev" if evaluator else "noev")),
+                save_steps=10_000, log_steps=10_000,
+            ),
+            cluster=ClusterSpec(
+                cluster=cluster, task_type="worker", task_index=0
+            ),
+        )
+        assert est.cluster.is_chief
+        train_and_evaluate(
+            est,
+            TrainSpec(input_fn=_dense_input_fn(), max_steps=2),
+            EvalSpec(input_fn=_dense_input_fn(), steps=1, every_steps=2),
+        )
+        best_saves = [
+            c for c in model.save_calls if ".best-" in str(c[0])
+        ]
+        assert len(best_saves) == expect_saves, (evaluator, model.save_calls)

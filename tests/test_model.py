@@ -90,6 +90,50 @@ def test_offload_attn_remat_matches_no_remat():
         )
 
 
+def test_save_qkv_offload_matches_save_qkv():
+    """remat='save_qkv_offload' pins the SAME residual set as save_qkv —
+    only the residency differs — so on CPU (where Host space aliases
+    device memory) loss and grads must be bitwise identical."""
+    cfgs = get_config("tiny", dtype="float32", remat="save_qkv")
+    cfgo = get_config("tiny", dtype="float32", remat="save_qkv_offload")
+    params = decoder.init(jax.random.key(0), cfgs)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, 1000)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    ls, gs = jax.value_and_grad(
+        lambda p: decoder.loss_fn(p, batch, cfgs)[0]
+    )(params)
+    lo, go = jax.value_and_grad(
+        lambda p: decoder.loss_fn(p, batch, cfgo)[0]
+    )(params)
+    assert float(ls) == float(lo)
+    for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(go)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_remat_dtype_cast_close_to_full_precision():
+    """remat_dtype='bfloat16' narrows only the SAVED residuals; grads
+    stay close to the uncast policy (storage round-trip noise only)."""
+    cfgs = get_config("tiny", dtype="float32", remat="save_qkv")
+    cfgc = get_config(
+        "tiny", dtype="float32", remat="save_qkv",
+        remat_dtype="bfloat16",
+    )
+    params = decoder.init(jax.random.key(0), cfgs)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0, 1000)
+    batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+    ls, gs = jax.value_and_grad(
+        lambda p: decoder.loss_fn(p, batch, cfgs)[0]
+    )(params)
+    lc, gc = jax.value_and_grad(
+        lambda p: decoder.loss_fn(p, batch, cfgc)[0]
+    )(params)
+    assert abs(float(ls) - float(lc)) < 5e-2
+    for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(gc)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-2
+        )
+
+
 @pytest.mark.slow
 def test_offloaded_opt_state_matches_resident(mesh):
     """Host-offloaded moments (CPU-offload-Adam parity): same numerics

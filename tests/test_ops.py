@@ -656,3 +656,127 @@ def test_factored_adamw_trains_tiny_model():
         if first is None:
             first = float(loss)
     assert float(loss) < first * 0.7, (first, float(loss))
+
+
+# -- narrow-head packing (pallas_attention head_pack) -----------------------
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("d,pack", [(64, 2), (32, 4)])
+def test_flash_fwd_packed_matches_unpacked(causal, d, pack):
+    """Packed forward is the SAME online-softmax math per head, so it
+    must be bitwise-identical to the unpacked kernel (and close to the
+    reference)."""
+    from dlrover_tpu.ops import pallas_attention as pa
+
+    q, k, v = _qkv(jax.random.key(20), s=256, h=pack, d=d)
+    scale = d ** -0.5
+    out_p, lse_p = pa._flash_fwd(
+        q, k, v, causal, scale, block_q=128, block_k=128,
+        interpret=True, head_pack=pack,
+    )
+    out_u, lse_u = pa._flash_fwd(
+        q, k, v, causal, scale, block_q=128, block_k=128, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_u))
+    np.testing.assert_array_equal(np.asarray(lse_p), np.asarray(lse_u))
+    ref = mha_reference(q, k, v, causal=causal, softmax_scale=scale)
+    np.testing.assert_allclose(
+        np.asarray(out_p), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_flash_fwd_packed_prefix():
+    """Prefix-LM masking under packing: the SMEM prefix ref is indexed
+    by grid entry (h // pack per batch), a different stride than the
+    unpacked kernel's."""
+    from dlrover_tpu.ops import pallas_attention as pa
+
+    q, k, v = _qkv(jax.random.key(21), s=256, h=4, d=64)
+    scale = 64 ** -0.5
+    pref = jnp.array([17, 100], jnp.int32)
+    out_p, _ = pa._flash_fwd(
+        q, k, v, True, scale, block_q=128, block_k=128, prefix=pref,
+        interpret=True, head_pack=2,
+    )
+    ref = mha_reference(
+        q, k, v, causal=True, softmax_scale=scale, prefix_len=pref
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_p), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("d,pack", [(64, 2), (32, 4)])
+def test_pallas_backward_packed_matches_reference(causal, d, pack):
+    from dlrover_tpu.ops import pallas_attention as pa
+
+    q, k, v = _qkv(jax.random.key(22), s=256, h=pack, d=d)
+    scale = d ** -0.5
+    out, lse = pa._flash_fwd(
+        q, k, v, causal, scale, block_q=128, block_k=128, interpret=True
+    )
+    g = jax.random.normal(jax.random.key(23), out.shape)
+    dq, dk, dv = pa._pallas_backward(
+        q, k, v, out, lse, g, causal, scale, 128, 128, interpret=True,
+        head_pack=pack,
+    )
+    # bitwise vs the unpacked kernel: same math, different grid layout
+    uq, uk, uv = pa._pallas_backward(
+        q, k, v, out, lse, g, causal, scale, 128, 128, interpret=True
+    )
+    for a, u in zip((dq, dk, dv), (uq, uk, uv)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(u))
+    ref = lambda q, k, v: jnp.vdot(  # noqa: E731
+        mha_reference(q, k, v, causal=causal, softmax_scale=scale), g
+    )
+    rq, rk, rv = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip((dq, dk, dv), (rq, rk, rv)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), rtol=2e-3, atol=2e-3
+        )
+
+
+@pytest.mark.parametrize("h", [5, 4])
+def test_flash_attention_autopack_end_to_end(monkeypatch, h):
+    """Public flash_attention with head_pack=0 (auto) at d=64: packs 2
+    heads per program, zero-padding the odd h=5 (gpt2-1.5b has 25);
+    fwd AND grads must match the reference, including the pad slice."""
+    from dlrover_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "INTERPRET", True)
+    q, k, v = _qkv(jax.random.key(24), s=128, h=h, d=64)
+    scale = 64 ** -0.5
+    g = jax.random.normal(jax.random.key(25), q.shape)
+    f = lambda q, k, v: jnp.vdot(  # noqa: E731
+        pa.flash_attention(q, k, v, causal=True, block_q=128,
+                           block_k=128), g
+    )
+    fr = lambda q, k, v: jnp.vdot(  # noqa: E731
+        mha_reference(q, k, v, causal=True, softmax_scale=scale), g
+    )
+    (lo, go) = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+    (lr, gr) = jax.value_and_grad(fr, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(lo), float(lr), rtol=2e-3)
+    for a, r in zip(go, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), rtol=2e-3, atol=2e-3
+        )
+
+
+def test_flash_attention_gqa_demotes_head_pack(monkeypatch):
+    """GQA layouts run unpacked even when head_pack is forced: numerics
+    must still match the reference (the demotion, not a crash)."""
+    from dlrover_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "INTERPRET", True)
+    q, k, v = _qkv(jax.random.key(26), s=128, h=4, hkv=2, d=64)
+    scale = 64 ** -0.5
+    out = pa.flash_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, head_pack=2
+    )
+    ref = mha_reference(q, k, v, causal=True, softmax_scale=scale)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
