@@ -374,6 +374,64 @@ _FLOP_EXPANSION = {
 }
 
 
+def _classify_failure(returncode, stderr_text: str) -> str:
+    """Bucket a failed attempt for the per-attempt JSON line: the
+    BENCH_*.json consumer needs to tell a too-small budget (timeout)
+    from a config that no longer fits (oom) from a code regression
+    (compile_error / error) without digging through driver stderr."""
+    txt = stderr_text or ""
+    low = txt.lower()
+    if any(
+        pat in txt
+        for pat in ("RESOURCE_EXHAUSTED", "ResourceExhausted")
+    ) or "out of memory" in low or "allocation failure" in low:
+        return "oom"
+    if any(
+        pat in txt
+        for pat in (
+            "Compilation failure",
+            "XlaCompile",
+            "Mosaic",
+            "INVALID_ARGUMENT",
+        )
+    ) or "lowering" in low or "compilation" in low:
+        return "compile_error"
+    if returncode is None:
+        return "timeout"
+    return "error"
+
+
+def _nonmatmul_us_per_step(record, name, batch, seq, remat):
+    """Non-matmul residue per step, from the matmuls-only
+    counterfactual: if every EXECUTED flop (counted × remat expansion)
+    ran at the measured chained-matmul rate for this shape set, the
+    step would take executed/rate seconds — the remainder is
+    elementwise/HBM time the MXU never sees (norms, residual adds,
+    rope, optimizer). Estimate only: attention flops run through the
+    flash kernel, not the matmul chain, so at long seq this reads as a
+    LOWER bound (clamped at 0). None when the ceiling wasn't measured
+    (CPU smoke runs)."""
+    ceiling_key = (
+        "mxu_ceiling_frac_gpt2_shapes"
+        if name.startswith("gpt2")
+        else "mxu_ceiling_frac"
+    )
+    if not (
+        record.get(ceiling_key)
+        and record.get("mxu_ceiling_frac")
+        and record.get("mxu_tflops")
+        and record.get("tokens_per_sec")
+    ):
+        return None
+    step_us = batch * seq / record["tokens_per_sec"] * 1e6
+    peak_rate = record["mxu_tflops"] / record["mxu_ceiling_frac"]
+    shape_rate = peak_rate * record[ceiling_key]
+    executed = record["model_tflops_per_sec"] * _FLOP_EXPANSION.get(
+        remat, 1.0
+    )
+    return round(max(0.0, step_us * (1 - executed / shape_rate)), 1)
+
+
 def main():
     if len(sys.argv) >= 2 and sys.argv[1] == "--check":
         print(json.dumps({"kernels_ok": check_kernels()}))
@@ -401,7 +459,9 @@ def main():
         return
 
     t0 = time.monotonic()
+    failed_attempts = []
     for name, batch, seq, remat, budget_s in _ATTEMPTS:
+        attempt_id = f"{name},b{batch}x{seq},{remat}"
         try:
             out = subprocess.run(
                 [
@@ -459,6 +519,11 @@ def main():
                     if name.startswith("gpt2")
                     else "mxu_ceiling_frac"
                 )
+                nonmatmul = _nonmatmul_us_per_step(
+                    record, name, batch, seq, remat
+                )
+                if nonmatmul is not None:
+                    record["nonmatmul_us_per_step"] = nonmatmul
                 # the interpretation only holds while trunk matmuls
                 # dominate: at long seq the flash kernel's attention
                 # flops (not represented in the matmul-chain ceiling,
@@ -521,13 +586,35 @@ def main():
                         sys.stderr.write(
                             "gpt2 fallback skipped: budget exhausted\n"
                         )
+                if failed_attempts:
+                    # larger configs that died before this one won:
+                    # carried in the winning record so BENCH_*.json
+                    # alone shows WHY the bench fell through
+                    record["failed_attempts"] = failed_attempts
                 print(json.dumps(record))
                 return
+            fail = {
+                "attempt": attempt_id,
+                "failure": _classify_failure(
+                    out.returncode, out.stderr
+                ),
+            }
+            failed_attempts.append(fail)
+            print(json.dumps(fail))
             sys.stderr.write(
                 f"bench config {name} rc={out.returncode}: "
                 f"{out.stderr[-800:]}\n"
             )
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            stderr = e.stderr
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode("utf-8", "replace")
+            fail = {
+                "attempt": attempt_id,
+                "failure": _classify_failure(None, stderr),
+            }
+            failed_attempts.append(fail)
+            print(json.dumps(fail))
             sys.stderr.write(f"bench config {name} timed out ({budget_s}s)\n")
     raise SystemExit("all bench configs failed")
 

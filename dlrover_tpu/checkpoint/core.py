@@ -27,6 +27,10 @@ import numpy as np
 HEADER_LEN_BYTES = 8
 ALIGN = 128
 
+# module-level so the compiled copy is cached across leaves that share a
+# shape/sharding (a fresh jax.jit per leaf would recompile every time)
+_owned_copy = jax.jit(jax.numpy.copy)
+
 
 def _path_str(path) -> str:
     parts = []
@@ -373,13 +377,20 @@ def restore_tree(
         dtype = np.dtype(
             getattr(leaf, "dtype", None) or pack_index.dtype(pstr)
         )
+        # Both branches must hand back jax-OWNED buffers, never a
+        # zero-copy alias of the assembled numpy arrays: jax's CPU
+        # backend aliases any 64-byte-aligned numpy buffer, and the
+        # train step DONATES the restored state — XLA then releases
+        # memory that numpy's allocator owns, which corrupts the glibc
+        # heap a step or two after an in-place resume. Alignment of
+        # np.empty is luck-of-the-malloc, so the crash is flaky.
         if sharding is None:
             full = pack_index.read_slice(
                 pstr, tuple(slice(0, d) for d in gshape)
             )
-            # copy=False: a no-op when the pack already matches the
-            # target dtype (the normal resume path — no double copy)
-            out.append(jax.numpy.asarray(full.astype(dtype, copy=False)))
+            # astype copy=False: a no-op when the pack already matches
+            # the target dtype; jnp.array then makes the owned copy
+            out.append(jax.numpy.array(full.astype(dtype, copy=False)))
         else:
             arr = jax.make_array_from_callback(
                 gshape,
@@ -388,7 +399,9 @@ def restore_tree(
                     p, idx
                 ).astype(dt, copy=False),
             )
-            out.append(arr)
+            # device-to-device copy off the aliased callback shards;
+            # jit keeps the sharding and works on multi-host globals
+            out.append(_owned_copy(arr))
     if kept:
         from dlrover_tpu.common.log import get_logger
 

@@ -123,6 +123,14 @@ class ModelConfig:
     # accelerate strategy enables it by default only where the MXU
     # consumes fp8 natively (v6e+, device_context.fp8_supported).
     fp8: bool = False
+    # fused norm/residual kernels (ops/pallas_norm.py): rmsnorm /
+    # layernorm with f32 statistics in one VMEM visit, and the
+    # pre-norm residual add folded into the same kernel so
+    # `x + attn_out -> norm(...)` is one HBM round-trip instead of
+    # three. None = auto (on when the Pallas TPU path is available,
+    # jnp fallback elsewhere — CPU/GPU programs are byte-identical to
+    # the unfused build); True/False force it either way.
+    fused_norm: Optional[bool] = None
 
     def __post_init__(self):
         if self.moe_impl not in ("dense", "ragged"):

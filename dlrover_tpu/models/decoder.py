@@ -28,6 +28,7 @@ from jax.ad_checkpoint import checkpoint_policies as cp
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.ops import pallas_norm
 from dlrover_tpu.ops.attention import mha_reference
 from dlrover_tpu.parallel import sharding as shd
 
@@ -231,8 +232,12 @@ def _norm(x, scale, bias, kind: str):
         rms = jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6)
         out = x32 * rms * scale.astype(jnp.float32)
     else:
+        # single pass over the f32 upcast: E[x] and E[x²] share one
+        # reduction sweep (jnp.var would re-read the activations);
+        # var clamped at 0 against catastrophic cancellation
         mean = jnp.mean(x32, -1, keepdims=True)
-        var = jnp.var(x32, -1, keepdims=True)
+        ex2 = jnp.mean(x32 * x32, -1, keepdims=True)
+        var = jnp.maximum(ex2 - mean * mean, 0.0)
         out = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
         out = out * scale.astype(jnp.float32)
         if bias is not None:
@@ -240,16 +245,55 @@ def _norm(x, scale, bias, kind: str):
     return out.astype(x.dtype)
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding. x:[B,S,H,D], positions:[B,S]."""
-    d = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+def _fused_norm_enabled(cfg: ModelConfig) -> bool:
+    if cfg.fused_norm is not None:
+        return cfg.fused_norm
+    return pallas_norm.kernels_available()
+
+
+def _norm_block(x, ln, cfg: ModelConfig, residual=None):
+    """The layer-body norm: Pallas fused kernel when enabled
+    (``cfg.fused_norm``; auto = TPU/interpret only), jnp ``_norm``
+    otherwise — the fallback keeps untouched configs on the exact
+    prior program. With ``residual``, returns
+    ``(norm(x + residual), x + residual)`` — on the kernel path the
+    summed stream comes out of the same HBM visit."""
+    if _fused_norm_enabled(cfg):
+        return pallas_norm.norm(
+            x, ln["scale"], ln.get("bias"), cfg.norm, residual=residual
+        )
+    if residual is not None:
+        h = x + residual
+        return _norm(h, ln["scale"], ln.get("bias"), cfg.norm), h
+    return _norm(x, ln["scale"], ln.get("bias"), cfg.norm)
+
+
+def _rope_tables(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin rope tables [B,S,1,D/2] f32 from positions [B,S] —
+    computed ONCE per forward (run_trunk / prefill / decode_step) and
+    threaded to every layer; rebuilding them per layer costs a
+    transcendental sweep per call that XLA does not hoist out of the
+    scan body."""
+    freqs = theta ** (
+        -jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    )
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
-    return out.astype(x.dtype)
+    return jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+
+def _rope(x: jax.Array, rope) -> jax.Array:
+    """Apply rotary embedding. x:[B,S,H,D], rope: (cos, sin) tables
+    from ``_rope_tables``. Rotate-half via strided reshape — the f32
+    view [..., 2, D/2] pairs lane i with i+D/2 exactly like the old
+    split+concatenate, without materializing two half-width
+    temporaries, and is bitwise-identical to it (pinned in
+    tests/test_model.py)."""
+    d = x.shape[-1]
+    cos, sin = rope
+    xr = x.astype(jnp.float32).reshape(x.shape[:-1] + (2, d // 2))
+    x1, x2 = xr[..., 0, :], xr[..., 1, :]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-2)
+    return out.reshape(x.shape).astype(x.dtype)
 
 
 def _fp8_gemm(x, w, fp8, name):
@@ -271,6 +315,7 @@ def _project_qkv(
     *,
     mup_full_scale: bool = False,
     fp8=None,
+    rope=None,
 ):
     """QKV projection + rope + muP q-scaling — the ONE place this math
     lives; the batch forward (_attention_block), prefill and decode_step
@@ -283,7 +328,11 @@ def _project_qkv(
 
     ``fp8``: per-layer delayed-scaling states for the q/k/v GEMMs
     (keys "wq"/"wk"/"wv"; cfg.fp8 training only — the cache paths pass
-    None and stay bf16)."""
+    None and stay bf16).
+
+    ``rope``: precomputed (cos, sin) tables from ``_rope_tables`` —
+    the trunk/prefill/decode loops build them once and pass them to
+    every layer; None recomputes here (external callers, pp bodies)."""
     b, s, _ = x.shape
     nh, nkv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     if fp8 is not None:
@@ -307,8 +356,10 @@ def _project_qkv(
     k = _tag_residual(k, "k_proj", cfg)
     v = _tag_residual(v, "v_proj", cfg)
     if cfg.pos == "rope":
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        if rope is None:
+            rope = _rope_tables(positions, hd, cfg.rope_theta)
+        q = _rope(q, rope)
+        k = _rope(k, rope)
     if cfg.mup_base_width:
         q = q * (hd ** (-1.0 if mup_full_scale else -0.5))
     return q, k, v
@@ -333,11 +384,12 @@ def _cache_layer_tail(x, attn_out, layer, cfg: ModelConfig):
 
 
 def _attention_block(
-    x, layer, cfg: ModelConfig, mesh, positions, attn_fn, fp8=None
+    x, layer, cfg: ModelConfig, mesh, positions, attn_fn, fp8=None,
+    rope=None,
 ):
     b, s, d = x.shape
     nh, hd = cfg.n_head, cfg.head_dim
-    q, k, v = _project_qkv(x, layer, cfg, positions, fp8=fp8)
+    q, k, v = _project_qkv(x, layer, cfg, positions, fp8=fp8, rope=rope)
     if mesh is not None:
         q = shd.constrain(q, mesh, "batch", "seq", "heads", None)
         k = shd.constrain(k, mesh, "batch", "seq", "kv", None)
@@ -409,11 +461,12 @@ def _layer_body(
     rng=None,
     tag_attn_out: bool = False,
     fp8=None,
+    rope=None,
 ):
     ln1, ln2 = layer["ln1"], layer["ln2"]
-    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+    h = _norm_block(x, ln1, cfg)
     attn = _attention_block(
-        h, layer, cfg, mesh, positions, attn_fn, fp8=fp8
+        h, layer, cfg, mesh, positions, attn_fn, fp8=fp8, rope=rope
     )
     if tag_attn_out:
         # non-flash attention tags no flash_out/flash_lse, so save_attn
@@ -427,10 +480,12 @@ def _layer_body(
         # GPTNeoX-style: both branches read the LAYER INPUT —
         # x + attn(ln1 x) + mlp(ln2 x); the attn and mlp matmul chains
         # have no data dependence, so XLA can overlap them
-        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+        h2 = _norm_block(x, ln2, cfg)
     else:
-        x = x + attn
-        h2 = _norm(x, ln2["scale"], ln2.get("bias"), cfg.norm)
+        # fused path: the residual add rides in the norm kernel —
+        # x + attn is written once, from the same VMEM visit that
+        # computes the statistics
+        h2, x = _norm_block(x, ln2, cfg, residual=attn)
     if cfg.n_experts > 0:
         from dlrover_tpu.parallel.moe import moe_block
 
@@ -624,6 +679,15 @@ def run_trunk(
             )
             layers = jax.tree.map(lambda t: jnp.take(t, perm, 0), layers)
 
+        # rope tables hoisted out of the layer scan: one [B,S,1,D/2]
+        # cos/sin build per forward instead of one per layer. Passed as
+        # a call-time kwarg (tracers through jax.checkpoint, like rng)
+        # so the remat-wrapped body needn't close over them.
+        rope = (
+            _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+            if cfg.pos == "rope"
+            else None
+        )
         if fp8_layers is not None and fp8_layers != "current":
 
             def scan_fn8(carry, inp):
@@ -633,7 +697,9 @@ def run_trunk(
                     if rng is not None
                     else None
                 )
-                out, aux = body(carry, layer, positions, rng=r, fp8=fp8)
+                out, aux = body(
+                    carry, layer, positions, rng=r, fp8=fp8, rope=rope
+                )
                 return out, aux
 
             x, auxs = jax.lax.scan(
@@ -649,7 +715,7 @@ def run_trunk(
                     if rng is not None
                     else None
                 )
-                out, aux = body(carry, layer, positions, rng=r)
+                out, aux = body(carry, layer, positions, rng=r, rope=rope)
                 return out, aux
 
             x, auxs = jax.lax.scan(
@@ -833,7 +899,7 @@ def forward(
     )
 
     fn = params["final_norm"]
-    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    x = _norm_block(x, fn, cfg)
     if features_only:
         return (x, aux) if return_aux else x
     w_out, head_scale = head_weight_scale(params, cfg)
@@ -1025,13 +1091,19 @@ def prefill(
 
     nh, hd = cfg.n_head, cfg.head_dim
     scale = 1.0 if cfg.mup_base_width else hd**-0.5
+    # rope tables built once for the whole prompt, shared by all layers
+    rope = (
+        _rope_tables(positions, hd, cfg.rope_theta)
+        if cfg.pos == "rope"
+        else None
+    )
 
     def layer_fn(carry, layer):
         x = carry
         ln1 = layer["ln1"]
         h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
         q, k, v = _project_qkv(
-            h, layer, cfg, positions, mup_full_scale=True
+            h, layer, cfg, positions, mup_full_scale=True, rope=rope
         )
         attn = mha_reference(
             q, k, v,
@@ -1108,13 +1180,20 @@ def decode_step(
             params["pos_embed"]["table"], positions, axis=0
         ).astype(dt)
 
+    # single-position rope tables, built once outside the layer scan
+    rope = (
+        _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        if cfg.pos == "rope"
+        else None
+    )
+
     def layer_fn(carry, inp):
         x = carry
         layer, ck, cv = inp
         ln1 = layer["ln1"]
         h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
         q, k, v = _project_qkv(
-            h, layer, cfg, positions, mup_full_scale=True
+            h, layer, cfg, positions, mup_full_scale=True, rope=rope
         )
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)

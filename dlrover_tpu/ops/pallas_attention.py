@@ -42,6 +42,7 @@ path (every GQA config here runs full-width d=128 heads anyway).
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -65,8 +66,12 @@ NEG_INF = -1e30
 
 # test hook: run every kernel in pallas interpret mode (CPU-executable);
 # lets composition layers (ring attention) exercise the real kernel path
-# on the virtual CPU mesh
-INTERPRET = False
+# on the virtual CPU mesh. Seeded from DLROVER_TPU_PALLAS_INTERPRET so
+# a whole test run can flip every kernel module (this one and
+# ops/pallas_norm.py) without per-module monkeypatching.
+INTERPRET = os.environ.get(
+    "DLROVER_TPU_PALLAS_INTERPRET", ""
+).lower() in ("1", "true", "yes")
 
 # pallas FA2 backward kernels (vs the jnp chunked recompute); tiles
 # capped separately from the forward (see _bwd_rule)

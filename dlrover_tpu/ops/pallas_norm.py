@@ -1,0 +1,379 @@
+"""Pallas TPU fused norm kernels: rmsnorm / layernorm with an optional
+fused residual add.
+
+Why a kernel for a memory-bound op: with the matmul side saturated
+(flash attention + fused CE, see docs/performance.md), the residue of
+the step is elementwise HBM traffic. XLA lowers the jnp norm as a
+reduce pass plus a broadcast-apply pass, and the residual add that
+precedes the second norm of every layer body is a third full
+[B,S,d_model] round-trip (write x+attn, read it back, write the normed
+value). Here each grid program holds a row block in VMEM, computes the
+f32 statistics and the normed output in one visit, and — when
+``residual`` is passed — also emits the summed stream, so
+``x + attn_out -> norm(...)`` costs one read and two writes instead of
+three round-trips.
+
+Numerics mirror ``models/decoder.py::_norm`` exactly: the (optional)
+residual add happens in the input dtype, statistics are f32
+(single-pass E[x], E[x^2] for layernorm), the output is cast back to
+the input dtype. Padded-lane handling: a non-128-multiple last dim is
+zero-padded at the jnp level — zero lanes contribute nothing to the
+sums (the divisor is the TRUE dim), and the padded output lanes are
+sliced off, so no in-kernel masking is needed.
+
+Backward is a custom_vjp with row-local Pallas kernels that recompute
+the statistics from the saved summed stream (cheaper than storing
+per-row stats: in the fused-residual case the stream is a forward
+OUTPUT already, so the residuals cost nothing extra). The per-program
+scale/bias cotangent partials are summed at the jnp level.
+
+Off-TPU the public entry point falls back to the jnp reference; the
+``INTERPRET`` hook (or the ``DLROVER_TPU_PALLAS_INTERPRET`` env var,
+which also flips ``pallas_attention``) runs the real kernels through
+the pallas interpreter so the CPU test mesh exercises the kernel path.
+"""
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds of jaxlib
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from dlrover_tpu.ops.pallas_attention import _on_tpu
+
+# test hook: run every kernel in pallas interpret mode (CPU-executable).
+# Seeded from the environment so a whole pytest run can flip it without
+# monkeypatching each module.
+INTERPRET = os.environ.get(
+    "DLROVER_TPU_PALLAS_INTERPRET", ""
+).lower() in ("1", "true", "yes")
+
+# eps defaults matching models/decoder.py::_norm — the decoder wires
+# this module in WITHOUT passing eps, so these two constants are the
+# single point of truth shared by kernel and fallback
+RMS_EPS = 1e-6
+LN_EPS = 1e-5
+
+# per-program f32 row-block VMEM budget: bounds [rows, dp] f32
+# transients to ~2 MB each (the kernel holds a handful alongside the
+# input-dtype block), far under the ~16 MB VMEM/core
+_ROW_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def kernels_available(interpret=None) -> bool:
+    """True when the Pallas path would actually run (real TPU or
+    interpret mode) — what ``cfg.fused_norm=None`` (auto) keys off."""
+    interpret = INTERPRET if interpret is None else interpret
+    return pltpu is not None and (_on_tpu() or interpret)
+
+
+def _fit_rows(n: int, dp: int, dtype) -> int:
+    """Rows per grid program: largest power-of-two block that divides
+    the row count, respects the dtype's min sublane tile, and keeps
+    [rows, dp] f32 under the VMEM budget. None = shape untileable
+    (fall back to the jnp reference)."""
+    min_rows = 16 if jnp.dtype(dtype) == jnp.bfloat16 else 8
+    budget = _ROW_BLOCK_BYTES // (4 * dp)
+    for bn in (512, 256, 128, 64, 32, 16, 8):
+        if bn <= budget and bn >= min_rows and n % bn == 0:
+            return bn
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(*refs, kind, eps, d, has_bias, has_res):
+    it = iter(refs)
+    x_ref = next(it)
+    scale_ref = next(it)
+    bias_ref = next(it) if has_bias else None
+    res_ref = next(it) if has_res else None
+    out_ref = next(it)
+    h_ref = next(it) if has_res else None
+
+    x = x_ref[...]
+    if has_res:
+        # input-dtype add, matching the jnp path's `x = x + attn`
+        x = x + res_ref[...]
+        h_ref[...] = x
+    x32 = x.astype(jnp.float32)
+    s32 = scale_ref[...].astype(jnp.float32)
+    if kind == "rmsnorm":
+        # padded lanes are zero: they add nothing to the sum, and the
+        # divisor is the true dim
+        ms = jnp.sum(x32 * x32, axis=-1, keepdims=True) / d
+        out = x32 * jax.lax.rsqrt(ms + eps) * s32
+    else:
+        mean = jnp.sum(x32, axis=-1, keepdims=True) / d
+        ex2 = jnp.sum(x32 * x32, axis=-1, keepdims=True) / d
+        var = jnp.maximum(ex2 - mean * mean, 0.0)
+        out = (x32 - mean) * jax.lax.rsqrt(var + eps) * s32
+        if has_bias:
+            out = out + bias_ref[...].astype(jnp.float32)
+    out_ref[...] = out.astype(out_ref.dtype)
+
+
+def _bwd_kernel(*refs, kind, eps, d, has_bias, has_res):
+    it = iter(refs)
+    g_ref = next(it)
+    h_ref = next(it)
+    scale_ref = next(it)
+    gh_ref = next(it) if has_res else None
+    dx_ref = next(it)
+    ds_ref = next(it)
+    db_ref = next(it) if has_bias else None
+
+    g32 = g_ref[...].astype(jnp.float32)
+    h32 = h_ref[...].astype(jnp.float32)
+    s32 = scale_ref[...].astype(jnp.float32)
+    # recompute the f32 statistics from the saved stream — one VPU
+    # reduction instead of storing per-row stats in HBM
+    if kind == "rmsnorm":
+        ms = jnp.sum(h32 * h32, axis=-1, keepdims=True) / d
+        r = jax.lax.rsqrt(ms + eps)
+        gx = g32 * s32
+        dot = jnp.sum(gx * h32, axis=-1, keepdims=True) / d
+        dx = r * gx - (r * r * r) * dot * h32
+        ds_ref[...] = jnp.sum(g32 * h32 * r, axis=0, keepdims=True)
+    else:
+        mean = jnp.sum(h32, axis=-1, keepdims=True) / d
+        ex2 = jnp.sum(h32 * h32, axis=-1, keepdims=True) / d
+        var = jnp.maximum(ex2 - mean * mean, 0.0)
+        r = jax.lax.rsqrt(var + eps)
+        xhat = (h32 - mean) * r
+        gx = g32 * s32
+        m1 = jnp.sum(gx, axis=-1, keepdims=True) / d
+        m2 = jnp.sum(gx * xhat, axis=-1, keepdims=True) / d
+        dx = r * (gx - m1 - xhat * m2)
+        ds_ref[...] = jnp.sum(g32 * xhat, axis=0, keepdims=True)
+        if has_bias:
+            db_ref[...] = jnp.sum(g32, axis=0, keepdims=True)
+    if has_res:
+        # the summed stream's own downstream cotangent folds in here so
+        # backward too is one visit per row block
+        dx = dx + gh_ref[...].astype(jnp.float32)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp (operates on [N, dp] padded 2-D views)
+# ---------------------------------------------------------------------------
+
+
+def _compiler_params(interpret):
+    if interpret:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=("parallel",))
+
+
+def _call_fwd(kind, eps, dims, interpret, x, scale, bias, res):
+    d, dp, bn = dims
+    n = x.shape[0]
+    has_bias = bias is not None
+    has_res = res is not None
+    row_spec = pl.BlockSpec((bn, dp), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, dp), lambda i: (0, 0))
+    in_specs = [row_spec, vec_spec]
+    inputs = [x, scale]
+    if has_bias:
+        in_specs.append(vec_spec)
+        inputs.append(bias)
+    if has_res:
+        in_specs.append(row_spec)
+        inputs.append(res)
+    out_specs = [row_spec]
+    out_shape = [jax.ShapeDtypeStruct((n, dp), x.dtype)]
+    if has_res:
+        out_specs.append(row_spec)
+        out_shape.append(jax.ShapeDtypeStruct((n, dp), x.dtype))
+    outs = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, kind=kind, eps=eps, d=d,
+            has_bias=has_bias, has_res=has_res,
+        ),
+        grid=(n // bn,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(*inputs)
+    if has_res:
+        return outs[0], outs[1]
+    return outs[0], x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _norm_call(kind, eps, dims, interpret, x, scale, bias, res):
+    out, h = _call_fwd(kind, eps, dims, interpret, x, scale, bias, res)
+    return (out, h) if res is not None else out
+
+
+def _norm_call_fwd(kind, eps, dims, interpret, x, scale, bias, res):
+    out, h = _call_fwd(kind, eps, dims, interpret, x, scale, bias, res)
+    primal = (out, h) if res is not None else out
+    # h IS the residual set: in the fused-residual case it's already a
+    # forward output (free), otherwise it's the input x
+    return primal, (h, scale, bias, res is not None)
+
+
+def _norm_call_bwd(kind, eps, dims, interpret, saved, g):
+    d, dp, bn = dims
+    h, scale, bias, has_res = saved
+    has_bias = bias is not None
+    if has_res:
+        gout, gh = g
+    else:
+        gout, gh = g, None
+    n = h.shape[0]
+    grid = n // bn
+    row_spec = pl.BlockSpec((bn, dp), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, dp), lambda i: (0, 0))
+    part_spec = pl.BlockSpec((1, dp), lambda i: (i, 0))
+    in_specs = [row_spec, row_spec, vec_spec]
+    inputs = [gout, h, scale]
+    if has_res:
+        in_specs.append(row_spec)
+        inputs.append(gh)
+    out_specs = [row_spec, part_spec]
+    out_shape = [
+        jax.ShapeDtypeStruct((n, dp), h.dtype),
+        jax.ShapeDtypeStruct((grid, dp), jnp.float32),
+    ]
+    if has_bias:
+        out_specs.append(part_spec)
+        out_shape.append(jax.ShapeDtypeStruct((grid, dp), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(
+            _bwd_kernel, kind=kind, eps=eps, d=d,
+            has_bias=has_bias, has_res=has_res,
+        ),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(*inputs)
+    dx = outs[0]
+    dscale = outs[1].sum(axis=0, keepdims=True).astype(scale.dtype)
+    dbias = (
+        outs[2].sum(axis=0, keepdims=True).astype(bias.dtype)
+        if has_bias
+        else None
+    )
+    # d(x + res)/dx = d(x + res)/dres = identity: both get the stream
+    # cotangent (gh already folded into dx inside the kernel)
+    dres = dx if has_res else None
+    return dx, dscale, dbias, dres
+
+
+_norm_call.defvjp(_norm_call_fwd, _norm_call_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+
+def _reference(x, scale, bias, kind, eps, residual):
+    """jnp fallback — the exact math of models/decoder.py::_norm (with
+    the pre-norm residual add in the input dtype when fused)."""
+    h = x + residual if residual is not None else x
+    x32 = h.astype(jnp.float32)
+    if kind == "rmsnorm":
+        rms = jax.lax.rsqrt(
+            jnp.mean(x32 * x32, -1, keepdims=True) + eps
+        )
+        out = x32 * rms * scale.astype(jnp.float32)
+    else:
+        mean = jnp.mean(x32, -1, keepdims=True)
+        ex2 = jnp.mean(x32 * x32, -1, keepdims=True)
+        var = jnp.maximum(ex2 - mean * mean, 0.0)
+        out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+        out = out * scale.astype(jnp.float32)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+    out = out.astype(x.dtype)
+    return (out, h) if residual is not None else out
+
+
+def norm(
+    x,
+    scale,
+    bias=None,
+    kind: str = "rmsnorm",
+    *,
+    residual=None,
+    eps: float = None,
+    interpret: bool = None,
+):
+    """Fused norm over the last axis of ``x`` ([..., D]).
+
+    Without ``residual``: returns ``norm(x)``. With ``residual``:
+    returns ``(norm(x + residual), x + residual)`` — the summed stream
+    is emitted from the same kernel visit so the caller's residual
+    carry costs no extra HBM round-trip.
+
+    ``kind``: "rmsnorm" (bias ignored) | "layernorm". Off-TPU (and for
+    untileable shapes) this is the jnp reference with identical
+    numerics semantics (f32 statistics, output in ``x.dtype``).
+    """
+    if kind not in ("rmsnorm", "layernorm"):
+        raise ValueError(f"unknown norm kind {kind!r}")
+    interpret = INTERPRET if interpret is None else interpret
+    if eps is None:
+        eps = RMS_EPS if kind == "rmsnorm" else LN_EPS
+    if kind == "rmsnorm":
+        bias = None
+    d = x.shape[-1]
+    if not (pltpu is not None and (_on_tpu() or interpret)):
+        return _reference(x, scale, bias, kind, eps, residual)
+    n = math.prod(x.shape[:-1])
+    dp = (d + 127) // 128 * 128
+    bn = _fit_rows(n, dp, x.dtype)
+    if bn is None:
+        return _reference(x, scale, bias, kind, eps, residual)
+
+    lead = x.shape[:-1]
+
+    def rows(a):
+        a = a.reshape(n, d)
+        if dp != d:
+            a = jnp.pad(a, ((0, 0), (0, dp - d)))
+        return a
+
+    def vec(a):
+        a = a.reshape(1, d)
+        if dp != d:
+            a = jnp.pad(a, ((0, 0), (0, dp - d)))
+        return a
+
+    def unrows(a):
+        if dp != d:
+            a = a[:, :d]
+        return a.reshape(lead + (d,))
+
+    out = _norm_call(
+        kind,
+        eps,
+        (d, dp, bn),
+        interpret,
+        rows(x),
+        vec(scale),
+        vec(bias) if bias is not None else None,
+        rows(residual) if residual is not None else None,
+    )
+    if residual is not None:
+        return unrows(out[0]), unrows(out[1])
+    return unrows(out)

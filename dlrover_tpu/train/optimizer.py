@@ -11,6 +11,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import optax
+import optax.tree_utils as _otu
 
 
 def warmup_cosine(
@@ -88,6 +89,134 @@ def build_schedule(
     raise ValueError(f"unknown schedule {name!r}")
 
 
+def _make_clip_fn(updates, grad_clip: float):
+    """Per-leaf global-norm clip closure, numerically identical to
+    ``optax.clip_by_global_norm(grad_clip)``: one global-norm
+    reduction, then each leaf is scaled in its own dtype. Lets the
+    fused/streamed optimizers fold clipping into their single state
+    traversal instead of materializing a clipped gradient tree as a
+    separate chain link."""
+    if not grad_clip or grad_clip <= 0:
+        return lambda g: g
+    g_norm = optax.global_norm(updates)
+    trigger = jnp.squeeze(g_norm < grad_clip)
+
+    def clip_fn(g):
+        return jax.lax.select(
+            trigger, g, (g / g_norm.astype(g.dtype)) * grad_clip
+        )
+
+    return clip_fn
+
+
+def fused_adamw(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_clip: float = 0.0,
+    state_dtype: Optional[str] = None,
+) -> optax.GradientTransformation:
+    """Single-traversal AdamW: global-norm clipping, the moment
+    updates, decoupled weight decay, and the lr scaling all happen in
+    one walk over the gradient tree — one read and one write per
+    optimizer-state leaf.
+
+    Why: ``optax.chain(clip_by_global_norm, adamw)`` is four chained
+    transforms (clip, scale_by_adam, add_decayed_weights, scale_by_lr),
+    each materializing a full update tree between links. At 1.4B params
+    that is ~11 GiB of optimizer state + gradients walked repeatedly in
+    an HBM-bound phase of the step. Here the chain's per-leaf math is
+    applied verbatim inside one tree.map, so XLA sees a single fused
+    elementwise region per leaf and the state streams through VMEM
+    once.
+
+    Numerics match the optax chain EXACTLY (pinned in
+    tests/test_fused_optimizer.py): the clip trigger/scale formula is
+    ``clip_by_global_norm``'s, the moment/bias-correction arithmetic is
+    ``scale_by_adam``'s (including the safe int32 count increment and
+    the schedule reading the PRE-increment count), decay is
+    ``add_decayed_weights``, the sign flip is ``scale_by_learning_rate``.
+
+    ``state_dtype``: None (f32 moments, matching ``optax.adamw`` on f32
+    params) | "bfloat16" (bf16 mu like ``mu_dtype=bfloat16``) |
+    "factored" (delegates to ``factored_adamw`` with the clip folded
+    into ITS single traversal).
+    """
+    if state_dtype == "factored":
+        return factored_adamw(
+            learning_rate, b1=b1, b2=b2, eps=eps,
+            weight_decay=weight_decay, grad_clip=grad_clip,
+        )
+    if state_dtype not in (None, "bfloat16"):
+        raise ValueError(
+            "fused_adamw supports state_dtype None/'bfloat16'/'factored'; "
+            f"got {state_dtype!r} (quantized states keep their own fused "
+            "streaming paths in ops/quant.py)"
+        )
+    mu_dtype = jnp.bfloat16 if state_dtype == "bfloat16" else None
+
+    def _lr(step):
+        return learning_rate(step) if callable(learning_rate) else learning_rate
+
+    def init_fn(params):
+        return {
+            "step": jnp.zeros([], jnp.int32),
+            # optax scale_by_adam state layout: mu in mu_dtype (param
+            # dtype when None), nu in the param dtype
+            "m": jax.tree.map(
+                lambda p: jnp.zeros_like(p, mu_dtype or p.dtype), params
+            ),
+            "v": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update_fn(updates, state, params=None):
+        if weight_decay and params is None:
+            raise ValueError("fused_adamw with weight_decay needs params")
+        # optax numerics.safe_increment: saturate instead of wrapping
+        max_t = jnp.iinfo(jnp.int32).max
+        step = jnp.where(state["step"] < max_t, state["step"] + 1, max_t)
+        # schedule parity with optax.scale_by_schedule: the lr for
+        # update t reads schedule(count BEFORE increment)
+        lr = _lr(state["step"])
+        p_tree = params if params is not None else updates
+        clip = _make_clip_fn(updates, grad_clip)
+
+        def leaf(g, m, v, p):
+            gc = clip(g)
+            m2 = (1 - b1) * gc + b1 * m
+            v2 = (1 - b2) * (gc * gc) + b2 * v
+            # optax's tree_bias_correction is a jitted region, where
+            # XLA rewrites the scalar divide to a reciprocal multiply;
+            # route through it so eager parity is BITWISE, not 1-ulp
+            mhat = _otu.tree_bias_correction(m2, b1, step)
+            vhat = _otu.tree_bias_correction(v2, b2, step)
+            u = mhat / (jnp.sqrt(vhat) + eps)
+            if weight_decay:
+                u = u + weight_decay * p
+            if callable(learning_rate):
+                u = jnp.array(-lr, dtype=u.dtype) * u
+            else:
+                u = -lr * u
+            return u, m2.astype(mu_dtype) if mu_dtype else m2, v2
+
+        out = jax.tree.map(
+            leaf, updates, state["m"], state["v"], p_tree
+        )
+        is_triple = lambda x: isinstance(x, tuple)
+        return (
+            jax.tree.map(lambda o: o[0], out, is_leaf=is_triple),
+            {
+                "step": step,
+                "m": jax.tree.map(lambda o: o[1], out, is_leaf=is_triple),
+                "v": jax.tree.map(lambda o: o[2], out, is_leaf=is_triple),
+            },
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def factored_adamw(
     learning_rate,
     b1: float = 0.9,
@@ -96,6 +225,7 @@ def factored_adamw(
     weight_decay: float = 0.0,
     m_dtype=jnp.bfloat16,
     min_factored_size: int = 128,
+    grad_clip: float = 0.0,
 ) -> optax.GradientTransformation:
     """AdamW momentum + Adafactor-style factored second moment.
 
@@ -157,11 +287,13 @@ def factored_adamw(
         # correction uses the incremented count
         lr = _lr(state["step"])
         p_tree = params if params is not None else updates
+        # grad_clip folded into this same traversal (fused_adamw path)
+        clip = _make_clip_fn(updates, grad_clip)
 
         from dlrover_tpu.ops.quant import adamw_direction, adamw_m_ema
 
         def leaf(g, m, v, p):
-            g32 = g.astype(jnp.float32)
+            g32 = clip(g).astype(jnp.float32)
             m2 = adamw_m_ema(g32, m.astype(jnp.float32), b1)
             g2 = g32 * g32
             if isinstance(v, dict):
@@ -211,6 +343,7 @@ def streamed_offload_adamw(
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    grad_clip: float = 0.0,
 ) -> optax.GradientTransformation:
     """AdamW whose moments live in pinned host memory, streamed per leaf.
 
@@ -272,6 +405,10 @@ def streamed_offload_adamw(
         v_leaves = gdef.flatten_up_to(state["v"])
         p_leaves = gdef.flatten_up_to(p_tree)
 
+        # grad_clip folded into the streamed walk: the norm reduction
+        # runs on the device-resident grads before any moment transfer
+        clip = _make_clip_fn(updates, grad_clip)
+
         token = step.astype(jnp.float32)
         out_u, out_m, out_v = [], [], []
         for g, m_h, v_h, p in zip(g_leaves, m_leaves, v_leaves, p_leaves):
@@ -283,7 +420,7 @@ def streamed_offload_adamw(
             )
             m32 = jax.device_put(m_h, _dev)
             v32 = jax.device_put(v_h, _dev)
-            g32 = g.astype(jnp.float32)
+            g32 = clip(g).astype(jnp.float32)
             m2, v2 = adamw_moments(g32, m32, v32, b1, b2)
             upd = adamw_direction(
                 m2, v2, bc1, bc2, eps, weight_decay,
@@ -438,6 +575,7 @@ def make_optimizer(
     schedule: str = "warmup_cosine",
     state_dtype: Optional[str] = None,
     offload_states: bool = False,
+    fused: bool = False,
 ) -> optax.GradientTransformation:
     """Build the training optimizer.
 
@@ -450,6 +588,12 @@ def make_optimizer(
     host memory, streamed through HBM one leaf at a time
     (streamed_offload_adamw) — pair with
     ``init_train_state(offload_opt_state=True)``.
+    ``fused=True`` (adamw only) folds the global-norm clip, weight
+    decay and moment/param updates into one tree traversal
+    (``fused_adamw``) — numerically identical to the chain, one read +
+    one write per state leaf. Composes with state_dtype
+    None/"bfloat16"/"factored" and with ``offload_states`` (the
+    streamed walk absorbs the clip).
     """
     if schedule in ("none", "const", "constant"):
         lr = learning_rate
@@ -458,8 +602,19 @@ def make_optimizer(
             schedule, learning_rate, warmup_steps, decay_steps
         )
 
+    if fused and name != "adamw":
+        raise ValueError(
+            f"fused=True is an adamw fast path; got name={name!r}"
+        )
+    if fused and state_dtype not in (None, "bfloat16", "factored"):
+        raise ValueError(
+            "fused=True composes with state_dtype None/'bfloat16'/"
+            f"'factored' (got {state_dtype!r}); the int8/int4/mixed "
+            "paths already stream their own fused updates"
+        )
+
     chain = []
-    if grad_clip and grad_clip > 0:
+    if grad_clip and grad_clip > 0 and not fused:
         chain.append(optax.clip_by_global_norm(grad_clip))
 
     if offload_states:
@@ -471,10 +626,17 @@ def make_optimizer(
             )
         chain.append(
             streamed_offload_adamw(
-                lr, b1=b1, b2=b2, weight_decay=weight_decay
+                lr, b1=b1, b2=b2, weight_decay=weight_decay,
+                grad_clip=grad_clip if fused else 0.0,
             )
         )
         return optax.chain(*chain)
+
+    if fused:
+        return fused_adamw(
+            lr, b1=b1, b2=b2, weight_decay=weight_decay,
+            grad_clip=grad_clip or 0.0, state_dtype=state_dtype,
+        )
 
     if name == "adamw" and state_dtype == "factored":
         # Adafactor-factored nu + bf16 momentum (see factored_adamw):

@@ -108,3 +108,68 @@ def test_gpt2_attempt_promoted_off_full_remat():
     assert any(d == 64 for _h, d in bench._KERNEL_CHECK_SHAPES)
     # the narrow shape must exercise auto head-packing incl. odd heads
     assert (25, 64) in bench._KERNEL_CHECK_SHAPES
+
+
+def test_failure_classifier_buckets():
+    """Failed attempts now emit a machine-readable `failure` field so
+    the round-end driver can tell an OOM (retry smaller batch) from a
+    compile error (fix the kernel) from a deadline kill."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    cf = bench._classify_failure
+    assert cf(1, "RESOURCE_EXHAUSTED: out of HBM") == "oom"
+    assert cf(1, "jaxlib ... ResourceExhausted while allocating") == "oom"
+    assert cf(1, "Allocation failure on device") == "oom"
+    assert cf(1, "Mosaic lowering failed for fused kernel") == \
+        "compile_error"
+    assert cf(1, "XlaCompile: Compilation failure in backend") == \
+        "compile_error"
+    assert cf(None, "") == "timeout"
+    assert cf(None, "anything at all") == "timeout"
+    assert cf(2, "Traceback (most recent call last): ValueError") == \
+        "error"
+    # OOM wins over compile wording when both appear (an OOM during
+    # compilation is still actionable as an OOM)
+    assert cf(1, "Compilation failure: RESOURCE_EXHAUSTED") == "oom"
+
+
+def test_nonmatmul_residue_derivation():
+    """`nonmatmul_us_per_step` = step time minus the matmuls-only
+    counterfactual (executed flops at the shape's measured chained-
+    matmul rate), clamped at 0, absent without a measured ceiling."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    rec = {
+        "tokens_per_sec": 100_000.0,
+        "mxu_tflops": 150.0,
+        "mxu_ceiling_frac": 0.75,
+        "model_tflops_per_sec": 100.0,
+    }
+    # step = 8192/1e5 s = 81920us; shape_rate = (150/0.75)*0.75 = 150;
+    # residue = 81920 * (1 - 100/150)
+    got = bench._nonmatmul_us_per_step(rec, "llama-1.4b", 1, 8192, "none")
+    assert got == pytest.approx(81920 * (1 - 100 / 150), abs=0.1)
+    # faster-than-ceiling (long-seq flash) clamps to 0, never negative
+    fast = dict(rec, model_tflops_per_sec=200.0)
+    assert bench._nonmatmul_us_per_step(
+        fast, "llama-1.4b", 1, 8192, "none"
+    ) == 0.0
+    # CPU smoke runs carry no ceiling -> no field
+    assert bench._nonmatmul_us_per_step(
+        {"tokens_per_sec": 1.0}, "llama-1.4b", 1, 8192, "none"
+    ) is None
+    # gpt2 family is judged against its own shape-set ceiling
+    g = dict(rec, mxu_ceiling_frac_gpt2_shapes=0.5)
+    got_g = bench._nonmatmul_us_per_step(g, "gpt2-1.5b", 1, 8192, "none")
+    # shape_rate = (150/0.75)*0.5 = 100 -> executed == rate -> 0 residue
+    assert got_g == 0.0
+    # remat expansion raises executed flops and shrinks the residue
+    assert bench._nonmatmul_us_per_step(
+        rec, "llama-1.4b", 1, 8192, "full"
+    ) < bench._nonmatmul_us_per_step(rec, "llama-1.4b", 1, 8192, "none")

@@ -345,3 +345,71 @@ def test_partial_restore_keeps_fresh_leaves_for_grown_tree(tmp_path):
     renamed["params"]["w_renamed"] = renamed["params"].pop("w")
     with pytest.raises(RestoreMismatchError):
         reader.load_checkpoint(renamed, partial=True)
+
+
+def test_restore_tree_returns_owned_buffers(monkeypatch):
+    """Restored leaves must be jax-OWNED copies, never zero-copy
+    aliases of the numpy arrays assembled from the pack: the train step
+    donates the restored state, and XLA releasing a buffer that numpy's
+    malloc owns corrupts the glibc heap (flakily — jax's CPU backend
+    only aliases 64-byte-aligned buffers, so the elastic resume crashed
+    on roughly the malloc alignment rate). Pin the ownership contract
+    by forcing read_slice to hand back guaranteed-aligned arrays and
+    asserting the restored jax buffers live elsewhere."""
+    state = _state()
+    entries, payload = core.plan_pack(state)
+    header = core.header_bytes(7, entries)
+    buf = memoryview(bytearray(core.pack_size(header, payload)))
+    used = core.write_pack(buf, 7, state, entries)
+    idx = core.PackIndex()
+    idx.add_pack(buf[:used])
+
+    def _aligned(a):
+        # view into an oversized buffer at a 64-byte-aligned offset —
+        # the deterministic worst case for the zero-copy alias
+        raw = np.empty(a.nbytes + 64, np.uint8)
+        off = (-raw.ctypes.data) % 64
+        v = raw[off : off + a.nbytes].view(a.dtype).reshape(a.shape)
+        v[...] = a
+        assert v.ctypes.data % 64 == 0
+        return v
+
+    src_ptrs = []
+    orig = core.PackIndex.read_slice
+
+    def read_aligned(self, path, index):
+        v = _aligned(orig(self, path, index))
+        src_ptrs.append((v.ctypes.data, v))  # keep alive for the check
+        return v
+
+    monkeypatch.setattr(core.PackIndex, "read_slice", read_aligned)
+    out = core.restore_tree(state_template(state), idx)
+    restored = [
+        leaf.unsafe_buffer_pointer() for leaf in jax.tree.leaves(out)
+    ]
+    assert not (set(restored) & {p for p, _ in src_ptrs})
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+
+    # the resharding path (make_array_from_callback) must not alias its
+    # callback arrays either
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    sh = {
+        "params": {
+            "w": NamedSharding(mesh, P(("dp", "fsdp"), "tp")),
+            "b": NamedSharding(mesh, P("tp")),
+        },
+        "step": NamedSharding(mesh, P()),
+    }
+    src_ptrs.clear()
+    out_s = core.restore_tree(state_template(state), idx, sh)
+    shard_ptrs = {
+        s.data.unsafe_buffer_pointer()
+        for leaf in jax.tree.leaves(out_s)
+        for s in leaf.addressable_shards
+    }
+    assert not (shard_ptrs & {p for p, _ in src_ptrs})
+    np.testing.assert_array_equal(
+        np.asarray(out_s["params"]["w"]), np.asarray(state["params"]["w"])
+    )

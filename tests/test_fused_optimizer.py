@@ -1,0 +1,164 @@
+"""fused_adamw vs the optax.chain(clip_by_global_norm, adamw)
+reference it replaces.
+
+Parity is asserted BITWISE after multi-step rollouts: the fused update
+applies the chain's per-leaf arithmetic verbatim (including optax's
+jitted bias-correction region, whose scalar divide XLA rewrites to a
+reciprocal multiply — reproducing the formula eagerly lands 1 ulp
+off). Both clip regimes run: grads scaled so the global norm
+alternates above/below the threshold, exercising both sides of the
+clip trigger select.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.train import optimizer as O
+
+_B1, _B2, _WD = 0.9, 0.95, 0.1
+
+
+def _params():
+    return {
+        "w": jax.random.normal(jax.random.key(0), (64, 32)),
+        "emb": jax.random.normal(jax.random.key(1), (130, 128)),
+        "b": jax.random.normal(jax.random.key(2), (32,)),
+    }
+
+
+def _grad(params, i, big):
+    # alternate large/small global norm so the clip trigger flips
+    scale = 40.0 if big else 0.001
+    return jax.tree.map(
+        lambda p: jax.random.normal(jax.random.key(100 + i), p.shape)
+        * scale,
+        params,
+    )
+
+
+def _rollout(opt, params, steps=6, clip_active_on_odd=True):
+    state = opt.init(params)
+    p = params
+    for i in range(steps):
+        g = _grad(params, i, big=bool(i % 2) if clip_active_on_odd else True)
+        u, state = opt.update(g, state, p)
+        p = optax.apply_updates(p, u)
+    return p, state
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("sd", [None, "bfloat16"])
+@pytest.mark.parametrize("clip", [1.0, 1e-4, 0.0])
+def test_fused_matches_chain_bitwise(sd, clip):
+    """clip=1.0 alternates active/inactive; 1e-4 is always-active;
+    0.0 disables clipping entirely."""
+    params = _params()
+    sched = O.warmup_cosine(3e-4, warmup_steps=3, decay_steps=50)
+    mu_dtype = jnp.bfloat16 if sd == "bfloat16" else None
+    links = [optax.clip_by_global_norm(clip)] if clip else []
+    links.append(
+        optax.adamw(sched, b1=_B1, b2=_B2, weight_decay=_WD,
+                    mu_dtype=mu_dtype)
+    )
+    ref = optax.chain(*links)
+    fus = O.fused_adamw(
+        sched, b1=_B1, b2=_B2, weight_decay=_WD, grad_clip=clip,
+        state_dtype=sd,
+    )
+    pr, _ = _rollout(ref, params)
+    pf, sf = _rollout(fus, params)
+    _assert_trees_equal(pr, pf)
+    assert int(sf["step"]) == 6
+
+
+def test_fused_constant_lr_no_decay():
+    params = _params()
+    ref = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(1e-3, b1=_B1, b2=_B2, weight_decay=0.0),
+    )
+    fus = O.fused_adamw(1e-3, b1=_B1, b2=_B2, grad_clip=1.0)
+    pr, _ = _rollout(ref, params)
+    pf, _ = _rollout(fus, params)
+    _assert_trees_equal(pr, pf)
+
+
+def test_fused_factored_matches_chained_factored():
+    """state_dtype='factored' delegates to factored_adamw with the
+    clip folded into its single traversal — must equal the chained
+    clip + factored_adamw composition bitwise."""
+    params = _params()  # "emb" is 130x128 -> actually factored
+    sched = O.warmup_cosine(3e-4, warmup_steps=3, decay_steps=50)
+    ref = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        O.factored_adamw(sched, b1=_B1, b2=_B2, weight_decay=_WD),
+    )
+    fus = O.fused_adamw(
+        sched, b1=_B1, b2=_B2, weight_decay=_WD, grad_clip=1.0,
+        state_dtype="factored",
+    )
+    pr, _ = _rollout(ref, params)
+    pf, sf = _rollout(fus, params)
+    _assert_trees_equal(pr, pf)
+    # the factored state actually factored the matrix leaf
+    assert isinstance(sf["v"]["emb"], dict) and "r" in sf["v"]["emb"]
+
+
+def test_streamed_offload_grad_clip_fold():
+    """streamed_offload_adamw(grad_clip=...) equals the chained
+    clip + streamed composition (the fused offload_states recipe)."""
+    params = _params()
+    ref = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        O.streamed_offload_adamw(1e-3, b1=_B1, b2=_B2, weight_decay=_WD),
+    )
+    fus = O.streamed_offload_adamw(
+        1e-3, b1=_B1, b2=_B2, weight_decay=_WD, grad_clip=1.0
+    )
+    pr, _ = _rollout(ref, params)
+    pf, _ = _rollout(fus, params)
+    _assert_trees_equal(pr, pf)
+
+
+def test_make_optimizer_fused_variants():
+    params = _params()
+    g = jax.tree.map(jnp.ones_like, params)
+    for kw in (
+        dict(fused=True),
+        dict(fused=True, state_dtype="bfloat16"),
+        dict(fused=True, state_dtype="factored"),
+        dict(fused=True, offload_states=True),
+    ):
+        opt = O.make_optimizer(**kw)
+        s = opt.init(params)
+        u, s = opt.update(g, s, params)
+        for leaf in jax.tree.leaves(u):
+            assert np.isfinite(np.asarray(leaf)).all(), kw
+
+
+def test_make_optimizer_fused_matches_default_recipe():
+    """The headline recipe: make_optimizer(fused=True) must train
+    bit-identically to make_optimizer() (same defaults, chained)."""
+    params = _params()
+    ref = O.make_optimizer()
+    fus = O.make_optimizer(fused=True)
+    # default state_dtype=None -> both keep f32 moments
+    pr, _ = _rollout(ref, params)
+    pf, _ = _rollout(fus, params)
+    _assert_trees_equal(pr, pf)
+
+
+def test_make_optimizer_fused_rejects_unsupported():
+    with pytest.raises(ValueError, match="adamw fast path"):
+        O.make_optimizer(name="lion", fused=True)
+    with pytest.raises(ValueError, match="composes with state_dtype"):
+        O.make_optimizer(fused=True, state_dtype="int8")
+    with pytest.raises(ValueError, match="state_dtype"):
+        O.fused_adamw(1e-3, state_dtype="mixed8")

@@ -1,0 +1,175 @@
+"""Interpret-mode parity for the fused norm kernels (ops/pallas_norm.py).
+
+The kernels only compile on TPU; ``interpret=True`` runs the same
+kernel bodies through the pallas interpreter on the CPU mesh, so the
+grid/BlockSpec plumbing, the in-kernel f32 statistics, the fused
+residual add, and both custom_vjp backward kernels are exercised here
+— against the jnp reference that IS the production fallback (and the
+decoder's ``_norm`` math).
+
+Tolerances: f32 cases compare at a few ulp (the kernel reduces by
+sum/d where the reference uses mean — same value, different op order);
+bf16 cases at 1-2 bf16 ulp. The fused-residual summed stream is pinned
+BITWISE: it is an input-dtype add in both implementations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops import pallas_norm
+
+
+def _ref(x, scale, bias, kind, residual=None):
+    eps = pallas_norm.RMS_EPS if kind == "rmsnorm" else pallas_norm.LN_EPS
+    return pallas_norm._reference(
+        x, scale, bias if kind == "layernorm" else None, kind, eps, residual
+    )
+
+
+def _make(kind, dt, d, with_res, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 4)
+    x = jax.random.normal(ks[0], (2, 16, d), dt)
+    s = (1.0 + 0.1 * jax.random.normal(ks[1], (d,))).astype(dt)
+    b = (
+        (0.1 * jax.random.normal(ks[2], (d,))).astype(dt)
+        if kind == "layernorm"
+        else None
+    )
+    res = jax.random.normal(ks[3], (2, 16, d), dt) if with_res else None
+    return x, s, b, res
+
+
+@pytest.mark.parametrize("kind", ["rmsnorm", "layernorm"])
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16])
+# 256 = clean lanes; 192/100 exercise the zero-pad-to-128 path (100 is
+# the odd last-dim case: pad 28 lanes, slice them back off)
+@pytest.mark.parametrize("d", [256, 192, 100])
+@pytest.mark.parametrize("with_res", [False, True])
+def test_forward_parity(kind, dt, d, with_res):
+    tol = 2e-5 if dt == jnp.float32 else 2e-2
+    x, s, b, res = _make(kind, dt, d, with_res)
+    out_k = pallas_norm.norm(x, s, b, kind, residual=res, interpret=True)
+    out_r = _ref(x, s, b, kind, residual=res)
+    if with_res:
+        np.testing.assert_allclose(
+            np.asarray(out_k[0], np.float32),
+            np.asarray(out_r[0], np.float32),
+            rtol=tol, atol=tol,
+        )
+        # the summed stream is an input-dtype add in both paths: bitwise
+        np.testing.assert_array_equal(
+            np.asarray(out_k[1]), np.asarray(out_r[1])
+        )
+    else:
+        np.testing.assert_allclose(
+            np.asarray(out_k, np.float32),
+            np.asarray(out_r, np.float32),
+            rtol=tol, atol=tol,
+        )
+
+
+@pytest.mark.parametrize("kind", ["rmsnorm", "layernorm"])
+@pytest.mark.parametrize("dt", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("d", [256, 100])
+@pytest.mark.parametrize("with_res", [False, True])
+def test_grad_parity(kind, dt, d, with_res):
+    """Backward kernels vs jnp autodiff: dx, dscale, dbias, dres —
+    with distinct cotangents on the normed output and the summed
+    stream, so the in-kernel gh fold is actually exercised."""
+    x, s, b, res = _make(kind, dt, d, with_res, seed=3)
+
+    def loss(fn):
+        def go(x, s, b, res):
+            o = fn(x, s, b, res)
+            if with_res:
+                return (o[0] * 1.3).sum() + (o[1] * 0.7).sum()
+            return (o * 1.3).sum()
+
+        return go
+
+    k_fn = loss(
+        lambda x, s, b, res: pallas_norm.norm(
+            x, s, b, kind, residual=res, interpret=True
+        )
+    )
+    r_fn = loss(lambda x, s, b, res: _ref(x, s, b, kind, residual=res))
+    argn = [0, 1]
+    if kind == "layernorm":
+        argn.append(2)
+    if with_res:
+        argn.append(3)
+    gk = jax.grad(k_fn, argnums=tuple(argn))(x, s, b, res)
+    gr = jax.grad(r_fn, argnums=tuple(argn))(x, s, b, res)
+    tol = 5e-5 if dt == jnp.float32 else 6e-2
+    for a, (u, v) in zip(argn, zip(gk, gr)):
+        np.testing.assert_allclose(
+            np.asarray(u, np.float32),
+            np.asarray(v, np.float32),
+            rtol=tol, atol=tol,
+            err_msg=f"grad argnum {a}",
+        )
+
+
+def test_untileable_rows_fall_back():
+    """Row counts below the dtype's min sublane tile can't grid — the
+    public entry must return the jnp reference, not crash."""
+    x = jax.random.normal(jax.random.key(0), (1, 3, 128), jnp.bfloat16)
+    s = jnp.ones((128,), jnp.bfloat16)
+    out = pallas_norm.norm(x, s, None, "rmsnorm", interpret=True)
+    ref = _ref(x, s, None, "rmsnorm")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_cpu_default_is_reference():
+    """Without interpret and off-TPU, norm() must be the exact jnp
+    reference — the gate that keeps untouched configs bitwise stable."""
+    assert not pallas_norm.kernels_available(interpret=False)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 64), jnp.float32)
+    s = jnp.ones((64,), jnp.float32)
+    out = pallas_norm.norm(x, s, None, "rmsnorm", interpret=False)
+    ref = _ref(x, s, None, "rmsnorm")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_unknown_kind_raises():
+    x = jnp.ones((2, 2, 8))
+    with pytest.raises(ValueError, match="unknown norm kind"):
+        pallas_norm.norm(x, jnp.ones((8,)), None, "batchnorm")
+
+
+def test_decoder_fused_norm_matches_unfused():
+    """End-to-end: a tiny decoder forward+grad with cfg.fused_norm=True
+    (kernels in interpret mode) matches the default jnp build within
+    f32 tolerance — the wiring in _layer_body/_norm_block, including
+    the fused ln2 residual add, agrees with the reference program."""
+    from dlrover_tpu.models import decoder, get_config
+
+    prev = pallas_norm.INTERPRET
+    pallas_norm.INTERPRET = True
+    try:
+        cfg_f = get_config("tiny", fused_norm=True, dtype="float32",
+                           param_dtype="float32")
+        cfg_r = get_config("tiny", fused_norm=False, dtype="float32",
+                           param_dtype="float32")
+        params = decoder.init(jax.random.key(0), cfg_f)
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                    cfg_f.vocab_size)
+        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, 1)}
+
+        def loss(cfg):
+            def f(p):
+                return decoder.loss_fn(p, batch, cfg)[0]
+
+            return f
+
+        lf, gf = jax.value_and_grad(loss(cfg_f))(params)
+        lr, gr = jax.value_and_grad(loss(cfg_r))(params)
+        np.testing.assert_allclose(float(lf), float(lr), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gr)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+    finally:
+        pallas_norm.INTERPRET = prev
