@@ -261,6 +261,67 @@ def peak_tflops(device) -> float:
     return 197.0
 
 
+# bytes per element for the HLO shape dtypes that ride collectives
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "s8": 1, "u8": 1, "pred": 1,
+}
+
+_COLLECTIVE_OPS = (
+    "reduce-scatter",
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+)
+
+
+def collective_stats(hlo_text: str) -> dict:
+    """Per-step collective profile of an optimized HLO module.
+
+    Returns ``{"counts": {op: n}, "bytes_by_dtype": {dtype: B}}`` —
+    op counts for each collective kind and the summed RESULT payload
+    bytes grouped by wire dtype. This is what the MULTICHIP dryrun
+    embeds in its record so a replicated-update regression (full-
+    gradient all-reduce sneaking back in) or a wire-dtype change is
+    visible in the trajectory, not just in local tests.
+    """
+    import re
+
+    shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+    counts = {op: 0 for op in _COLLECTIVE_OPS}
+    bytes_by_dtype: dict = {}
+    for line in hlo_text.splitlines():
+        parts = line.split(" = ", 1)
+        if len(parts) != 2:
+            continue
+        rhs = parts[1]
+        hit = None
+        for op in _COLLECTIVE_OPS:
+            k = rhs.find(op + "(")
+            if k >= 0:
+                hit = (op, k)
+                break
+        if hit is None:
+            continue
+        op, k = hit
+        counts[op] += 1
+        for dt, dims in shape_re.findall(rhs[:k]):
+            if dt not in _HLO_DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            bytes_by_dtype[dt] = (
+                bytes_by_dtype.get(dt, 0) + n * _HLO_DTYPE_BYTES[dt]
+            )
+    return {
+        "counts": {k: v for k, v in counts.items() if v},
+        "bytes_by_dtype": bytes_by_dtype,
+    }
+
+
 def run_config(name, batch, seq, remat, steps=30, warmup=3,
                state_dtype="bfloat16", block_k=1):
     # steps=30: the axon relay's ~100ms host-readback latency is paid

@@ -96,6 +96,18 @@ def analyse(
         plan.optimizer_state_dtype or plan.param_dtype, pbytes
     )
     opt_b = n * slots * opt_dtype_b / param_shards
+    if (
+        getattr(plan, "update_sharding", False)
+        and sizes["dp"] > 1
+        and param_shards == 1
+        and not plan.offload_opt_state
+    ):
+        # ZeRO-1 weight-update sharding: each dp rank owns 1/dp of the
+        # flattened optimizer state, padded up to whole comm buckets
+        # (parallel.sharding.PackPlan). Same gate as
+        # resolve_update_sharding — it only engages on pure-dp meshes.
+        bucket_b = getattr(plan, "comm_bucket_mb", 4.0) * 2**20
+        opt_b = opt_b / sizes["dp"] + slots * bucket_b
     if offload_streams(plan):
         # moments live in pinned host memory and the streamed update
         # (train/optimizer.py streamed_offload_adamw) serializes the

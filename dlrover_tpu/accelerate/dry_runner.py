@@ -80,6 +80,7 @@ def build_from_plan(cfg: ModelConfig, plan, devices=None):
         grad_accum=plan.grad_accum,
         attn_impl=attn_impl,
         offload_opt_state=plan.offload_opt_state and not streamed,
+        comm=getattr(plan, "comm_config", lambda: None)(),
     )
     return mesh, builder, opt, batch_sharding(mesh), cfg
 
@@ -107,6 +108,7 @@ def dry_run(
         state = init_train_state(
             jax.random.key(0), cfg2, mesh, opt,
             offload_opt_state=plan.offload_opt_state,
+            comm=builder.comm_resolved,
         )
         if cost_only:
             lowered = jax.jit(builder.step_fn).lower(state, batch)

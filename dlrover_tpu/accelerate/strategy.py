@@ -43,6 +43,28 @@ class AccelerationPlan:
     grad_accum: int = 1
     # sequence parallelism flavour: none | ulysses | ring
     sp_mode: str = "none"
+    # ZeRO-1 weight-update sharding over dp (parallel.sharding.CommConfig):
+    # reduce-scatter grads, 1/dp optimizer shard, all-gather params
+    update_sharding: bool = False
+    # gradient-collective bucket size (MB of f32 payload)
+    comm_bucket_mb: float = 4.0
+    # wire dtype for the bucketed exchange: float32 | bfloat16 | int8
+    comm_wire_dtype: str = "float32"
+    # override wire dtype when dp crosses DCN; None = same everywhere
+    comm_wire_dtype_dcn: Optional[str] = None
+
+    def comm_config(self):
+        """The resolved CommConfig, or None when update sharding is off."""
+        if not self.update_sharding:
+            return None
+        from dlrover_tpu.parallel.sharding import CommConfig
+
+        return CommConfig(
+            update_sharding=True,
+            bucket_mb=self.comm_bucket_mb,
+            wire_dtype=self.comm_wire_dtype,
+            wire_dtype_dcn=self.comm_wire_dtype_dcn,
+        )
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
@@ -149,6 +171,22 @@ def _data_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
     plan.mesh.dp = int(cfg.get("size", -1))
 
 
+def _zero1(plan: AccelerationPlan, cfg: Dict) -> None:
+    """ZeRO-1 weight-update sharding over dp (reference: atorch
+    zero_optimization stage 1). Grads reduce-scatter in fixed-byte
+    buckets, each rank steps 1/dp of the optimizer state, params
+    all-gather back. Wire dtype of the bucketed exchange is tunable
+    (float32 is bitwise vs the unsharded step; bfloat16/int8 use
+    per-bucket scales, EQuARX-style)."""
+    plan.update_sharding = cfg.get("enabled", True)
+    if "bucket_mb" in cfg:
+        plan.comm_bucket_mb = float(cfg["bucket_mb"])
+    if "wire_dtype" in cfg:
+        plan.comm_wire_dtype = str(cfg["wire_dtype"])
+    if "wire_dtype_dcn" in cfg:
+        plan.comm_wire_dtype_dcn = cfg["wire_dtype_dcn"]
+
+
 def _mixed_parallel(plan: AccelerationPlan, cfg: Dict) -> None:
     """Arbitrary axis combination in one method (reference:
     mixed_parallel_optimization.py:32)."""
@@ -176,6 +214,7 @@ OPTIMIZATION_LIBRARY: Dict[str, Callable[[AccelerationPlan, Dict], None]] = {
     "grad_accum": _grad_accum,
     "optimizer": _optimizer,
     "data_parallel": _data_parallel,
+    "zero1": _zero1,
     "mixed_parallel": _mixed_parallel,
 }
 

@@ -921,7 +921,10 @@ def head_weight_scale(params: Params, cfg: ModelConfig):
     multiplier too would doubly suppress the logits.
     """
     if cfg.tie_embeddings:
-        w = params["embed"]["tokens"].T
+        # tied_head_table is the table itself except inside the
+        # update-sharding shard_map, where it splits the head cotangent
+        # off the lookup's (see parallel/sharding.py)
+        w = shd.tied_head_table(params["embed"]["tokens"]).T
     else:
         w = params["lm_head"]["w"]
     scale = 1.0
@@ -939,10 +942,15 @@ def loss_fn(
     attn_impl: str = "auto",
     rng: Optional[jax.Array] = None,
     fp8_states=None,
+    denom: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """batch: {"tokens": [B,S], "targets": [B,S], optional "mask": [B,S],
     optional "prefix_len": [B] (prefix-LM; mask usually zeroes the prefix
-    targets so loss falls only on the causal tail)}."""
+    targets so loss falls only on the causal tail)}.
+
+    ``denom`` overrides the loss normalizer (default: this batch's mask
+    sum). The update-sharding step passes the psum'd GLOBAL token count
+    so per-rank cotangents match the data-parallel program exactly."""
     targets = batch["targets"]
     use_fused = cfg.fused_ce and not (
         mesh is not None and mesh.shape.get("tp", 1) > 1
@@ -992,7 +1000,8 @@ def loss_fn(
         mask = jnp.ones_like(targets, dtype=jnp.float32)
     mask = mask.astype(jnp.float32)
     nll = (logz - tgt_logit) * mask
-    denom = jnp.maximum(mask.sum(), 1.0)
+    if denom is None:
+        denom = jnp.maximum(mask.sum(), 1.0)
     loss = nll.sum() / denom
     metrics = {"loss": loss, "tokens": mask.sum()}
     if z_loss > 0.0:

@@ -12,6 +12,8 @@ scales — a ~3.5× optimizer-memory cut.
 """
 
 
+import math
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -136,6 +138,83 @@ def dequantize_tree(state):
 # intra-module aliases (historical names)
 _quantize_tree = quantize_tree
 _dequantize_tree = dequantize_tree
+
+
+# ---------------------------------------------------------------------------
+# Bucketed wire format for gradient collectives
+# ---------------------------------------------------------------------------
+# One flat stream, fixed-size buckets, blockwise int8 scales. The
+# update-sharding gradient exchange (parallel/sharding.py) rides the
+# row-wise pair below inside its shard_map; local-SGD outer-group syncs
+# (parallel/local_sgd.py) ship whole pseudo-gradient trees in the same
+# encoding via the tree-level pair.
+
+
+def wire_encode_rows(rows: jax.Array):
+    """Encode ``[r, n]`` f32 (n a multiple of BLOCK) → (int8 ``[r, n]``,
+    f32 scales ``[r, n // BLOCK]``), one scale per block per row."""
+    r, n = rows.shape
+    q, scale = _quant_blocks(rows.reshape(r, n // BLOCK, BLOCK), 8)
+    return q.reshape(r, n), scale[..., 0]
+
+
+def wire_decode_sum(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Decode ``wire_encode_rows`` output and sum the rows in f32 → ``[n]``."""
+    r, n = q.shape
+    blocks = _dequant_blocks(
+        q.reshape(r, n // BLOCK, BLOCK), scale[..., None], 8
+    )
+    return jnp.sum(blocks.reshape(r, n), axis=0)
+
+
+def _wire_layout(like, bucket_bytes: int):
+    sizes = [
+        int(math.prod(l.shape)) for l in jax.tree.leaves(like)
+    ]
+    total = sum(sizes)
+    bucket_elems = max(bucket_bytes // 4, BLOCK)
+    bucket_elems = -(-bucket_elems // BLOCK) * BLOCK
+    n_buckets = max(1, -(-total // bucket_elems))
+    return sizes, total, bucket_elems, n_buckets
+
+
+def wire_encode_tree(tree, bits: int = 8, bucket_bytes: int = 4 * 2**20):
+    """Pytree of float arrays → ``{"q", "scale"}`` bucketed wire payload.
+
+    Every leaf (small ones included, unlike ``quantize_tree``) joins one
+    flat f32 stream, zero-padded to ``n_buckets`` fixed-size buckets;
+    each bucket is quantized blockwise (``BLOCK``-sized scales). The
+    payload is a plain pytree of two arrays, so it drops straight into
+    npz/socket transports.
+    """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    _, total, bucket_elems, n_buckets = _wire_layout(tree, bucket_bytes)
+    flat = jnp.concatenate(
+        [jnp.asarray(l).astype(jnp.float32).reshape(-1) for l in jax.tree.leaves(tree)]
+    )
+    flat = jnp.pad(flat, (0, n_buckets * bucket_elems - total))
+    blocks = flat.reshape(n_buckets, bucket_elems // BLOCK, BLOCK)
+    q, scale = _quant_blocks(blocks, bits)
+    return {"q": q.reshape(n_buckets, -1), "scale": scale[..., 0]}
+
+
+def wire_decode_tree(payload, like, bits: int = 8,
+                     bucket_bytes: int = 4 * 2**20):
+    """Inverse of ``wire_encode_tree``: payload → pytree shaped like ``like``."""
+    sizes, _, bucket_elems, n_buckets = _wire_layout(like, bucket_bytes)
+    q, scale = payload["q"], payload["scale"]
+    blocks = _dequant_blocks(
+        jnp.asarray(q).reshape(n_buckets, bucket_elems // BLOCK, -1),
+        jnp.asarray(scale)[..., None],
+        bits,
+    )
+    stream = blocks.reshape(-1)
+    leaves, off = [], 0
+    for l, s in zip(jax.tree.leaves(like), sizes):
+        leaves.append(stream[off : off + s].reshape(l.shape).astype(l.dtype))
+        off += s
+    return jax.tree.unflatten(jax.tree.structure(like), leaves)
 
 
 def quantize_optimizer_state(

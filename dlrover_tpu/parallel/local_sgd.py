@@ -382,14 +382,16 @@ class LocalSGDConfig:
     outer_lr: float = 1.0
     outer_momentum: float = 0.0
     nesterov: bool = False
-    # quantized outer reduce (reference capability: atorch's CUDA
-    # quantized collective payloads, ops/csrc/quantization/
-    # quant_reduce.cu): pseudo-gradients cross DCN as blockwise int8/int4
-    # (~4x/8x fewer bits on the wire); the local quantization residual is
-    # carried into the next round (error feedback), so the compression
-    # error does not bias the trajectory
+    # quantized outer reduce: pseudo-gradients cross DCN in the bucketed
+    # wire format shared with the in-step gradient collectives
+    # (ops.quant.wire_encode_tree — fixed-size rows of blockwise int8/
+    # int4, ~4x/8x fewer bits on the wire); the local quantization
+    # residual is carried into the next round (error feedback), so the
+    # compression error does not bias the trajectory
     compress: Optional[str] = None       # None | "int8" | "int4"
     error_feedback: bool = True
+    # wire bucket size (MB of f32 payload) for the compressed exchange
+    compress_bucket_mb: float = 4.0
 
 
 def _pack_tree(tree) -> bytes:
@@ -505,32 +507,32 @@ class LocalSGDSynchronizer:
         )
         if cfg.compress:
             from dlrover_tpu.ops.quant import (
-                QuantizedArray,
-                dequantize_tree,
-                quantize_tree,
+                wire_decode_tree,
+                wire_encode_tree,
             )
 
             bits = 8 if cfg.compress == "int8" else 4
+            bb = int(cfg.compress_bucket_mb * 2**20)
             if cfg.error_feedback and self._error is not None:
                 delta = jax.tree.map(jnp.add, delta, self._error)
-            qtree = quantize_tree(delta, bits=bits)
+            # the same fixed-bucket {q, scale} wire format the in-step
+            # gradient collectives use — a plain pytree of arrays, so
+            # the npz socket transport carries it unchanged
+            payload = wire_encode_tree(
+                delta, bits=bits, bucket_bytes=bb
+            )
             if cfg.error_feedback:
                 # residual = what this slice wanted to send minus what
                 # the wire actually carried; re-injected next round
-                sent = dequantize_tree(qtree)
-                self._error = jax.tree.map(
-                    lambda d, s, q: (d - s)
-                    if isinstance(q, QuantizedArray)
-                    else jnp.zeros_like(d),
-                    delta,
-                    sent,
-                    qtree,
-                    is_leaf=lambda x: isinstance(x, QuantizedArray),
+                sent = wire_decode_tree(
+                    payload, delta, bits=bits, bucket_bytes=bb
                 )
-            # every slice dequantizes the same int payloads, so the
-            # merged result stays bit-identical across slices
+                self._error = jax.tree.map(jnp.subtract, delta, sent)
+            # every slice decodes the same int payloads, so the merged
+            # result stays bit-identical across slices
             all_deltas = [
-                dequantize_tree(t) for t in self.exchange(qtree)
+                wire_decode_tree(t, delta, bits=bits, bucket_bytes=bb)
+                for t in self.exchange(payload)
             ]
         else:
             all_deltas = self.exchange(delta)

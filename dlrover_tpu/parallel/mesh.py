@@ -159,3 +159,21 @@ def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
 def data_axes() -> tuple:
     """Mesh axes over which the global batch is sharded."""
     return ("dp", "fsdp")
+
+
+def axis_crosses_dcn(mesh: Mesh, axis: str) -> bool:
+    """True when stepping along ``axis`` can change TPU slice — i.e. a
+    collective over ``axis`` pays DCN bandwidth, not just ICI. Devices
+    without a ``slice_index`` (CPU, single-slice) never cross."""
+    if mesh.shape.get(axis, 1) <= 1:
+        return False
+    dev = mesh.devices
+    idx = mesh.axis_names.index(axis)
+    # one pencil along `axis` through each point of the complementary grid
+    moved = np.moveaxis(dev, idx, 0)
+    for pencil in moved.reshape(moved.shape[0], -1).T:
+        ids = {getattr(d, "slice_index", None) for d in pencil}
+        ids.discard(None)
+        if len(ids) > 1:
+            return True
+    return False

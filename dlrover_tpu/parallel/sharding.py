@@ -11,9 +11,13 @@ Each parameter carries logical axes, e.g. ``("vocab", "embed")`` for the
 embedding table; rules map logical axis → mesh axis (or None = replicate).
 """
 
+import contextlib
+import threading
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common import jax_compat
@@ -98,6 +102,12 @@ def constrain(x, mesh: Mesh, *logical_axes: Optional[str], rules=None):
     pipeline's pp region): there the constraint must be built against the
     ambient abstract mesh, with any manual axes stripped from the spec.
     """
+    if in_update_sharding_region():
+        # inside the weight-update-sharding shard_map every mesh axis is
+        # manual (dp-only meshes; see CommConfig) and jax 0.4.x cannot
+        # report that via manual_axis_names — constraints are no-ops on
+        # local values anyway, so drop them
+        return x
     rules = rules_for_mesh(mesh, rules)
     spec = logical_to_mesh_axes(logical_axes, rules)
     manual = jax_compat.manual_axis_names()
@@ -115,3 +125,302 @@ def _drop_axes(entry: MeshAxes, names: set) -> MeshAxes:
         kept = tuple(a for a in entry if a not in names)
         return kept or None
     return None if entry in names else entry
+
+
+# ---------------------------------------------------------------------------
+# Gradient-collective comm config (weight-update sharding + wire dtypes)
+# ---------------------------------------------------------------------------
+
+_WIRE_DTYPES = ("float32", "bfloat16", "int8")
+
+
+@dataclass(frozen=True)
+class CommConfig:
+    """How gradients cross the mesh and where the optimizer runs.
+
+    ``update_sharding`` turns on the ZeRO-1 weight-update path
+    (arxiv 2004.13336): gradients ride a reduce-scatter instead of an
+    all-reduce, each dp rank runs the optimizer on its 1/dp shard of a
+    flat bucketed view of the parameters, and the updated params come
+    back through one all-gather. Optimizer state (Adam moments) lives
+    permanently dp-sharded, cutting its HBM per replica by ~dp.
+
+    ``bucket_mb`` sizes the fixed buckets the flattened gradients are
+    packed into: each bucket is an independent reduce-scatter, so XLA's
+    latency-hiding scheduler can start shipping early buckets while the
+    tail of backward still computes.
+
+    ``wire_dtype`` is the on-the-wire encoding of the dp gradient
+    exchange: "float32" (bitwise-exact psum_scatter), "bfloat16" (half
+    the bytes), or "int8" (EQuARX-style, arxiv 2506.17615: blockwise
+    scales from ops/quant.py, ~4x fewer bytes). ``wire_dtype_dcn``
+    overrides it when the dp axis crosses DCN slices — the hop where
+    compression pays for itself.
+    """
+
+    update_sharding: bool = False
+    bucket_mb: float = 4.0
+    wire_dtype: str = "float32"
+    wire_dtype_dcn: Optional[str] = None
+
+    def __post_init__(self):
+        if self.wire_dtype not in _WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype must be one of {_WIRE_DTYPES}, "
+                f"got {self.wire_dtype!r}"
+            )
+        if (
+            self.wire_dtype_dcn is not None
+            and self.wire_dtype_dcn not in _WIRE_DTYPES
+        ):
+            raise ValueError(
+                f"wire_dtype_dcn must be one of {_WIRE_DTYPES} or None, "
+                f"got {self.wire_dtype_dcn!r}"
+            )
+        if self.bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be > 0, got {self.bucket_mb}")
+
+    @property
+    def bucket_bytes(self) -> int:
+        return int(self.bucket_mb * 2**20)
+
+    def wire_for(self, mesh: Mesh, axis: str = "dp") -> str:
+        """Wire dtype for the gradient exchange over ``axis``."""
+        if self.wire_dtype_dcn is not None:
+            from dlrover_tpu.parallel.mesh import axis_crosses_dcn
+
+            if axis_crosses_dcn(mesh, axis):
+                return self.wire_dtype_dcn
+        return self.wire_dtype
+
+
+# ---------------------------------------------------------------------------
+# Update-sharding trace-time region
+# ---------------------------------------------------------------------------
+
+# Trace-time marker for "model code is being traced inside the
+# update-sharding shard_map". jax 0.4.x cannot tell us we are inside a
+# manual region (jax_compat.manual_axis_names() is pinned empty there),
+# so the train step raises this flag around the shard_map body trace:
+# `constrain` turns into a no-op and the tied-embedding head read routes
+# through the cotangent-splitting alias below.
+_REGION = threading.local()
+
+
+def in_update_sharding_region() -> bool:
+    return getattr(_REGION, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def update_sharding_region(tie_zero=None):
+    prev_zero = getattr(_REGION, "tie_zero", None)
+    _REGION.depth = getattr(_REGION, "depth", 0) + 1
+    _REGION.tie_zero = tie_zero
+    try:
+        yield
+    finally:
+        _REGION.depth -= 1
+        _REGION.tie_zero = prev_zero
+
+
+def tied_head_table(table: jax.Array) -> jax.Array:
+    """The tied lm-head's read of the embedding table.
+
+    Outside an update-sharding region: the table itself. Inside one: a
+    ``stop_gradient(table) + z`` alias, where ``z`` is the zeros array
+    the region registered — so the head matmul's cotangent lands on
+    ``z`` instead of fanning into the lookup's scatter cotangent. The
+    two contributions then ride SEPARATE reduce-scatters, reproducing
+    GSPMD's unsharded lowering (two all-reduces, added after), which is
+    what makes the f32-wire path bitwise-identical to it.
+    """
+    z = getattr(_REGION, "tie_zero", None)
+    if not in_update_sharding_region() or z is None:
+        return table
+    return jax.lax.stop_gradient(table) + z.astype(table.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Flat bucketed gradient/param packing
+# ---------------------------------------------------------------------------
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@dataclass(frozen=True)
+class PackPlan:
+    """Static layout of a parameter tree flattened into comm buckets.
+
+    The flat stream is the tree's canonical leaf order (jax sorted-key
+    flatten), zero-padded to ``n_buckets * bucket_elems``; each bucket
+    row is one collective. ``bucket_elems`` is a multiple of
+    ``dp * quant BLOCK`` so every dp shard of every bucket quantizes on
+    block boundaries. For tied embeddings the table must sit at offset
+    0 (bucket-aligned): the split-off head cotangent is packed into its
+    own ``n_tie_buckets`` rows and added shard-wise after the exchange.
+    """
+
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    total: int
+    bucket_elems: int
+    n_buckets: int
+    dp: int
+    tie_size: int          # 0 when embeddings are untied
+    n_tie_buckets: int
+
+    @property
+    def padded(self) -> int:
+        return self.n_buckets * self.bucket_elems
+
+    @property
+    def shard_elems(self) -> int:
+        """Per-rank elements of the flat view (optimizer-state rows)."""
+        return self.padded // self.dp
+
+
+def build_pack_plan(
+    params_abs,
+    dp: int,
+    bucket_bytes: int = 4 * 2**20,
+    tie_embeddings: bool = False,
+) -> PackPlan:
+    """Lay a parameter tree out into fixed-size comm buckets."""
+    from dlrover_tpu.ops.quant import BLOCK
+
+    leaves = jax.tree.leaves(params_abs)
+    bad = [l for l in leaves if jnp.dtype(l.dtype) != jnp.float32]
+    if bad:
+        raise ValueError(
+            "update sharding packs a uniform f32 master-param stream; "
+            f"found non-f32 leaves: {[str(l.dtype) for l in bad]}"
+        )
+    sizes, offsets, shapes, off = [], [], [], 0
+    for l in leaves:
+        shapes.append(tuple(l.shape))
+        sizes.append(int(l.size))
+        offsets.append(off)
+        off += int(l.size)
+    align = dp * BLOCK
+    bucket_elems = _round_up(max(bucket_bytes // 4, align), align)
+    n_buckets = max(1, -(-off // bucket_elems))
+    tie_size = 0
+    if tie_embeddings:
+        with_path = jax.tree_util.tree_leaves_with_path(params_abs)
+        tie_idx = next(
+            (
+                i
+                for i, (kp, _) in enumerate(with_path)
+                if "embed" in jax.tree_util.keystr(kp)
+                and "tokens" in jax.tree_util.keystr(kp)
+            ),
+            None,
+        )
+        if tie_idx is None or offsets[tie_idx] != 0:
+            raise ValueError(
+                "tied update sharding needs embed/tokens at flat offset "
+                f"0 of the canonical leaf order, found index {tie_idx}"
+            )
+        tie_size = sizes[tie_idx]
+    n_tie = -(-tie_size // bucket_elems) if tie_size else 0
+    return PackPlan(
+        shapes=tuple(shapes),
+        sizes=tuple(sizes),
+        offsets=tuple(offsets),
+        total=off,
+        bucket_elems=bucket_elems,
+        n_buckets=n_buckets,
+        dp=dp,
+        tie_size=tie_size,
+        n_tie_buckets=n_tie,
+    )
+
+
+def pack_flat(tree, plan: PackPlan, n_buckets: Optional[int] = None):
+    """Pytree → ``[n_buckets, bucket_elems]`` f32 stream (zero-padded).
+
+    Works on local values inside the update-sharding region and on
+    replicated leaves outside it (dp-only meshes keep every param
+    replicated, so no cross-sharding concat hazards exist here).
+    """
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate(
+        [l.reshape(-1).astype(jnp.float32) for l in leaves]
+    )
+    nb = plan.n_buckets if n_buckets is None else n_buckets
+    flat = jnp.pad(flat, (0, nb * plan.bucket_elems - flat.size))
+    return flat.reshape(nb, plan.bucket_elems)
+
+
+def unpack_flat(flat, like, plan: PackPlan):
+    """Inverse of ``pack_flat``: flat stream → pytree shaped like ``like``."""
+    stream = flat.reshape(-1)
+    leaves = jax.tree.leaves(like)
+    out = [
+        stream[o : o + s].reshape(shp).astype(l.dtype)
+        for o, s, shp, l in zip(
+            plan.offsets, plan.sizes, plan.shapes, leaves
+        )
+    ]
+    return jax.tree.unflatten(jax.tree.structure(like), out)
+
+
+# ---------------------------------------------------------------------------
+# Bucketed gradient exchange (runs inside the full-manual shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _exchange_bucket(row: jax.Array, axis: str, wire: str, dp: int):
+    """One bucket: local partial ``[E]`` → this rank's ``[E/dp]`` of the sum."""
+    if wire == "float32":
+        # bitwise-identical to all-reduce + slice on this backend
+        return jax.lax.psum_scatter(
+            row, axis, scatter_dimension=0, tiled=True
+        )
+    rows = row.reshape(dp, -1)  # rows[r] = my partial of rank r's shard
+    if wire == "bfloat16":
+        got = jax.lax.all_to_all(
+            rows.astype(jnp.bfloat16), axis, split_axis=0, concat_axis=0
+        )
+        return jnp.sum(got.astype(jnp.float32), axis=0)
+    from dlrover_tpu.ops.quant import wire_decode_sum, wire_encode_rows
+
+    q, scale = wire_encode_rows(rows)
+    q = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
+    scale = jax.lax.all_to_all(scale, axis, split_axis=0, concat_axis=0)
+    return wire_decode_sum(q, scale)
+
+
+def exchange_buckets(
+    g: jax.Array,
+    plan: PackPlan,
+    wire: str,
+    axis: str = "dp",
+    tie_extra: Optional[jax.Array] = None,
+):
+    """Reduce-scatter the packed gradient stream bucket-by-bucket.
+
+    ``g``: local partial gradients ``[n_buckets, bucket_elems]``.
+    Returns this rank's ``[n_buckets, bucket_elems/dp]`` of the summed
+    stream. Each bucket is its own collective so the scheduler can
+    overlap early buckets with the tail of backward. ``tie_extra`` (the
+    split-off tied-head cotangent, ``[tie_size]``) rides its own
+    buckets and is added shard-wise onto the leading rows — its zero
+    padding makes the adds past the table's end exact no-ops.
+    """
+    shards = [
+        _exchange_bucket(g[i], axis, wire, plan.dp)
+        for i in range(plan.n_buckets)
+    ]
+    if tie_extra is not None and plan.tie_size:
+        extra = pack_flat(
+            [tie_extra], plan, n_buckets=plan.n_tie_buckets
+        )
+        for i in range(plan.n_tie_buckets):
+            shards[i] = shards[i] + _exchange_bucket(
+                extra[i], axis, wire, plan.dp
+            )
+    return jnp.stack(shards)

@@ -727,3 +727,30 @@ def make_optimizer(
         bits = 8 if state_dtype == "int8" else 4
         return quantize_optimizer_state(optax.chain(*chain), bits=bits)
     return optax.chain(*chain)
+
+
+def opt_state_bytes_per_replica(opt_state) -> int:
+    """Bytes of optimizer state ONE data-parallel replica holds.
+
+    Leaves carrying a sharding count only their per-device shard (the
+    ZeRO-1 flat moments are ``P(None, "dp")``-sharded, so each replica
+    holds 1/dp of them); replicated or host-side leaves count in full.
+    Works on live arrays and on ``jax.eval_shape``/abstract states with
+    ``.sharding`` attached.
+    """
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(opt_state):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:
+                pass
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total

@@ -13,6 +13,7 @@ trainer/torch/elastic/trainer.py:48).
 """
 
 import functools
+import logging
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -24,6 +25,8 @@ from dlrover_tpu.common import jax_compat
 from dlrover_tpu.models import decoder
 from dlrover_tpu.models.config import ModelConfig
 from dlrover_tpu.parallel import sharding as shd
+
+logger = logging.getLogger(__name__)
 
 TrainState = Dict[str, Any]  # {"params", "opt_state", "step"}
 
@@ -106,12 +109,118 @@ def _opt_state_host_shardings(opt_shape, params, param_shardings, mesh):
     )
 
 
+# ---------------------------------------------------------------------------
+# Weight-update sharding (ZeRO-1): gate resolution + flat optimizer state
+# ---------------------------------------------------------------------------
+
+
+def _flat_abs(plan: shd.PackPlan):
+    return {
+        "flat": jax.ShapeDtypeStruct(
+            (plan.n_buckets, plan.bucket_elems), jnp.float32
+        )
+    }
+
+
+def _probe_flat_optimizer(
+    optimizer: optax.GradientTransformation, plan: shd.PackPlan
+) -> Optional[str]:
+    """None when the optimizer's state is elementwise over the flat
+    bucketed param view (so dp-sharding the flat axis shards the state),
+    else the reason it is not."""
+    try:
+        opt_abs = jax.eval_shape(optimizer.init, _flat_abs(plan))
+    except Exception as e:  # noqa: BLE001
+        return f"optimizer.init rejected the flat param view: {e}"
+    flat_shape = (plan.n_buckets, plan.bucket_elems)
+    for leaf in jax.tree.leaves(opt_abs, is_leaf=_is_quantized):
+        if _is_quantized(leaf):
+            return "low-bit optimizer state (compiler-chosen shardings)"
+        if tuple(leaf.shape) not in ((), flat_shape):
+            return (
+                f"optimizer state leaf of shape {tuple(leaf.shape)} is "
+                "not elementwise over the flat view (factored states "
+                "would mis-factor the bucket matrix)"
+            )
+    return None
+
+
+def resolve_update_sharding(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    comm: Optional[shd.CommConfig],
+    loss_fn: Optional[Callable] = None,
+    offload_opt_state: bool = False,
+) -> Tuple[bool, Optional[str], Optional[shd.PackPlan]]:
+    """(active, fallback_reason, pack_plan) for a requested CommConfig.
+
+    Update sharding is an optimization, not a semantics change, so an
+    unsupported combination falls back to the replicated update with a
+    recorded reason instead of failing the job. Currently supported:
+    pure data-parallel meshes (every non-dp axis 1 — params replicated,
+    which is what lets the optimizer shard by flat offset rather than by
+    parameter), built-in loss, f32 params, elementwise optimizer state,
+    no fp8/MoE/host-offload.
+    """
+    if comm is None or not comm.update_sharding:
+        return False, None, None
+    dp = mesh.shape.get("dp", 1)
+    others = sorted(
+        a for a, s in mesh.shape.items() if a != "dp" and s > 1
+    )
+    reason = None
+    if dp <= 1:
+        reason = "mesh has dp<=1"
+    elif others:
+        reason = f"non-dp mesh axes in use: {others}"
+    elif cfg.fp8:
+        reason = "fp8 state threading not supported in the manual region"
+    elif cfg.n_experts > 0:
+        reason = "MoE routing/aux losses not supported in the manual region"
+    elif offload_opt_state:
+        reason = "offload_opt_state keeps moments host-resident already"
+    elif loss_fn is not None:
+        reason = "custom loss_fn (denom override unavailable)"
+    plan = None
+    if reason is None:
+        params_abs = jax.eval_shape(
+            lambda: decoder.init(jax.random.key(0), cfg)
+        )
+        try:
+            plan = shd.build_pack_plan(
+                params_abs,
+                dp,
+                comm.bucket_bytes,
+                tie_embeddings=cfg.tie_embeddings,
+            )
+        except ValueError as e:
+            reason = str(e)
+    if reason is None:
+        reason = _probe_flat_optimizer(optimizer, plan)
+    if reason is not None:
+        logger.warning(
+            "update sharding requested but falling back to the "
+            "replicated update: %s",
+            reason,
+        )
+        return False, reason, None
+    return True, None, plan
+
+
+def _flat_opt_sharding(leaf, plan: shd.PackPlan, mesh: Mesh):
+    if tuple(leaf.shape) == (plan.n_buckets, plan.bucket_elems):
+        return NamedSharding(mesh, P(None, "dp"))
+    return NamedSharding(mesh, P())
+
+
 def abstract_train_state(
     cfg: ModelConfig,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     rules=None,
     offload_opt_state: bool = False,
+    comm: Optional[shd.CommConfig] = None,
 ):
     """``ShapeDtypeStruct`` tree matching ``init_train_state``'s output
     — shapes AND shardings — without materializing anything.
@@ -134,6 +243,30 @@ def abstract_train_state(
     params_abs = jax.eval_shape(
         lambda: decoder.init(jax.random.key(0), cfg)
     )
+    active, _, plan = resolve_update_sharding(
+        cfg, mesh, optimizer, comm, offload_opt_state=offload_opt_state
+    )
+    if active:
+        # ZeRO-1: the optimizer state lives on the flat bucketed view,
+        # dp-sharded along the bucket axis (1/dp of the moments per
+        # replica); params themselves stay in their usual shardings
+        opt_abs = jax.eval_shape(optimizer.init, _flat_abs(plan))
+        rep = NamedSharding(mesh, P())
+        return jax.tree.map(
+            lambda s, h: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=h),
+            {
+                "params": params_abs,
+                "opt_state": opt_abs,
+                "step": jax.ShapeDtypeStruct((), jnp.int32),
+            },
+            {
+                "params": param_shardings,
+                "opt_state": jax.tree.map(
+                    lambda l: _flat_opt_sharding(l, plan, mesh), opt_abs
+                ),
+                "step": rep,
+            },
+        )
     opt_abs = jax.eval_shape(optimizer.init, params_abs)
     if any(_is_quantized(leaf) for leaf in jax.tree.leaves(
             opt_abs, is_leaf=_is_quantized)):
@@ -181,6 +314,7 @@ def state_shardings(
     optimizer: optax.GradientTransformation,
     rules=None,
     offload_opt_state: bool = False,
+    comm: Optional[shd.CommConfig] = None,
 ):
     """The NamedSharding tree ``init_train_state`` produces (see
     ``abstract_train_state``, of which this is the shardings-only
@@ -188,7 +322,7 @@ def state_shardings(
     return jax.tree.map(
         lambda a: a.sharding,
         abstract_train_state(
-            cfg, mesh, optimizer, rules, offload_opt_state
+            cfg, mesh, optimizer, rules, offload_opt_state, comm
         ),
     )
 
@@ -200,6 +334,7 @@ def init_train_state(
     optimizer: optax.GradientTransformation,
     rules=None,
     offload_opt_state: bool = False,
+    comm: Optional[shd.CommConfig] = None,
 ) -> TrainState:
     """Jit-initialise params + optimizer state directly into their shardings.
 
@@ -207,10 +342,41 @@ def init_train_state(
     ``out_shardings`` derived from the logical-axis rules, so a 7B model
     initialises straight into per-device shards (contrast the reference's
     meta-init + rematerialisation dance, atorch fsdp_init_util.py).
+
+    With ``comm.update_sharding`` resolved active, the optimizer state is
+    born on the flat bucketed param view, dp-sharded (see
+    ``resolve_update_sharding``); pass the SAME comm the step builder
+    resolved (``TrainStepBuilder.comm_resolved``) so state layout and
+    step agree.
     """
     param_shardings = shd.shardings_for_tree(
         mesh, decoder.logical_axes(cfg), rules
     )
+    us_active, _, plan = resolve_update_sharding(
+        cfg, mesh, optimizer, comm, offload_opt_state=offload_opt_state
+    )
+    if us_active:
+
+        def f_us(rng):
+            params = decoder.init(rng, cfg)
+            params = jax.tree.map(
+                jax.lax.with_sharding_constraint, params, param_shardings
+            )
+            flat = {"flat": shd.pack_flat(params, plan)}
+            opt_state = optimizer.init(flat)
+            opt_state = jax.tree.map(
+                lambda l: jax.lax.with_sharding_constraint(
+                    l, _flat_opt_sharding(l, plan, mesh)
+                ),
+                opt_state,
+            )
+            return {
+                "params": params,
+                "opt_state": opt_state,
+                "step": jnp.zeros([], jnp.int32),
+            }
+
+        return jax.jit(f_us)(rng)
     # optimizer-state leaves (Adam moments etc.) mirror param shapes and
     # must be born with the SAME shardings — otherwise every step starts
     # by involuntarily resharding the moments (XLA's "involuntary full
@@ -297,6 +463,7 @@ class TrainStepBuilder:
         loss_fn: Optional[Callable] = None,
         attn_impl: str = "auto",
         offload_opt_state: bool = False,
+        comm: Optional[shd.CommConfig] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -305,6 +472,22 @@ class TrainStepBuilder:
         self.grad_accum = grad_accum
         self.attn_impl = attn_impl
         self.offload_opt_state = offload_opt_state
+        self.comm = comm
+        # resolved ZeRO-1 state: active flag, fallback reason (None when
+        # active or never requested), and the static flat pack layout
+        self.update_sharding, self.update_sharding_reason, self._plan = (
+            resolve_update_sharding(
+                cfg,
+                mesh,
+                optimizer,
+                comm,
+                loss_fn=loss_fn,
+                offload_opt_state=offload_opt_state,
+            )
+        )
+        self._wire = (
+            comm.wire_for(mesh, "dp") if self.update_sharding else None
+        )
         if (
             offload_opt_state
             and _HOST is None
@@ -405,7 +588,170 @@ class TrainStepBuilder:
         grads = jax.tree.map(lambda g: g / a, grads)
         return loss / a, {"loss": loss / a}, grads, new_fp8
 
+    @property
+    def comm_resolved(self) -> Optional[shd.CommConfig]:
+        """The CommConfig iff update sharding resolved active — pass this
+        to ``init_train_state``/``state_shardings`` so the optimizer
+        state is laid out for the step that will actually run."""
+        return self.comm if self.update_sharding else None
+
+    def _sharded_step_fn(
+        self, state: TrainState, batch
+    ) -> Tuple[TrainState, Dict]:
+        """ZeRO-1 step: reduce-scatter grads → 1/dp optimizer shard →
+        all-gather params (arxiv 2004.13336).
+
+        One full-manual shard_map region computes per-rank local grads
+        (loss normalized by the psum'd GLOBAL token count, so cotangents
+        match the data-parallel program bit-for-bit), packs them into
+        the plan's fixed buckets, and reduce-scatters bucket-by-bucket
+        (f32 wire = bitwise psum_scatter; bf16/int8 = all_to_all with
+        f32 accumulation, blockwise scales for int8). The optimizer then
+        runs OUTSIDE the region on the flat ``P(None, "dp")``-sharded
+        view — clip/fused/state_dtype compose unchanged, the partitioner
+        keeps every elementwise op local — and a second tiny manual
+        region applies ``p + u`` per rank and all-gathers the result.
+        """
+        cfg, mesh, plan = self.cfg, self.mesh, self._plan
+        a, wire = self.grad_accum, self._wire
+        tie = cfg.tie_embeddings
+        if a > 1:
+            # microbatch split OUTSIDE the region so the (rank,
+            # microbatch) data assignment matches _accumulated_grads
+            batch = jax.tree.map(
+                lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]),
+                batch,
+            )
+            batch_spec = P(None, "dp")
+        else:
+            batch_spec = P("dp")
+
+        def local_grads(params, mb):
+            mask = mb.get("mask")
+            if mask is None:
+                mask = jnp.ones_like(mb["targets"], dtype=jnp.float32)
+            local_tokens = jnp.sum(mask.astype(jnp.float32))
+            denom = jnp.maximum(jax.lax.psum(local_tokens, "dp"), 1.0)
+
+            def lf(p, z):
+                # the region flag makes shd.constrain a no-op and (when
+                # tied) aliases the lm-head's table read to z, so the
+                # head cotangent separates from the lookup's — the two
+                # ride separate reduce-scatters exactly like GSPMD's two
+                # all-reduces in the unsharded lowering
+                with shd.update_sharding_region(tie_zero=z):
+                    return decoder.loss_fn(
+                        p,
+                        mb,
+                        cfg=cfg,
+                        mesh=mesh,
+                        attn_impl=self.attn_impl,
+                        denom=denom,
+                    )
+
+            if tie:
+                z = jnp.zeros(plan.shapes[0], jnp.float32)
+                (loss, metrics), (g, gz) = jax.value_and_grad(
+                    lf, argnums=(0, 1), has_aux=True
+                )(params, z)
+            else:
+                (loss, metrics), g = jax.value_and_grad(
+                    lambda p: lf(p, None), has_aux=True
+                )(params)
+                gz = None
+            return loss, metrics, g, gz
+
+        def region(params, batch):
+            if a > 1:
+                # reduce-scatter EVERY microbatch and accumulate the
+                # shards — the order the unsharded program rounds in
+                # (GSPMD all-reduces each microbatch's grads before the
+                # scan carry add), so the f32 wire stays bitwise. Same
+                # collective count as the baseline, half the bytes.
+                def micro(carry, mb):
+                    sh_acc, loss_acc = carry
+                    loss, _, g, gz = local_grads(params, mb)
+                    shards = shd.exchange_buckets(
+                        shd.pack_flat(g, plan),
+                        plan,
+                        wire,
+                        axis="dp",
+                        tie_extra=gz if tie else None,
+                    )
+                    return (sh_acc + shards, loss_acc + loss), None
+
+                zeros = jnp.zeros(
+                    (plan.n_buckets, plan.bucket_elems // plan.dp),
+                    jnp.float32,
+                )
+                (shards, loss_acc), _ = jax.lax.scan(
+                    micro, (zeros, jnp.zeros([], jnp.float32)), batch
+                )
+                metrics = {
+                    "loss": jax.lax.psum(loss_acc, "dp") / a
+                }
+            else:
+                _, metrics, g, gz = local_grads(params, batch)
+                metrics = {
+                    k: jax.lax.psum(v, "dp") for k, v in metrics.items()
+                }
+                shards = shd.exchange_buckets(
+                    shd.pack_flat(g, plan),
+                    plan,
+                    wire,
+                    axis="dp",
+                    tie_extra=gz if tie else None,
+                )
+            return metrics, shards
+
+        metrics, grads_flat = jax_compat.shard_map(
+            region,
+            mesh=mesh,
+            in_specs=(P(), batch_spec),
+            out_specs=(P(), P(None, "dp")),
+        )(state["params"], batch)
+        if a > 1:
+            # divide AFTER the exchange, where GSPMD's unsharded program
+            # divides after its all-reduce — keeps the f32 wire bitwise
+            grads_flat = grads_flat / a
+        flat_params = {"flat": shd.pack_flat(state["params"], plan)}
+        updates, new_opt = self.optimizer.update(
+            {"flat": grads_flat}, state["opt_state"], flat_params
+        )
+        def apply_region(fp, u):
+            # per-rank `p + u` BEFORE the all-gather. Done in auto mode
+            # the partitioner is free to gather `u` first, which splits
+            # the optimizer's trailing `-lr * y` multiply from this add
+            # and changes how the backend contracts the pair — a 1-ulp
+            # params drift vs the unsharded step. Keeping the add inside
+            # the manual region pins mult→add adjacency on every rank.
+            idx = jax.lax.axis_index("dp")
+            sh = u.shape[1]
+            fp_shard = jax.lax.dynamic_slice(
+                fp, (0, idx * sh), (fp.shape[0], sh)
+            )
+            return jax.lax.all_gather(
+                fp_shard + u, "dp", axis=1, tiled=True
+            )
+
+        new_flat = jax_compat.shard_map(
+            apply_region,
+            mesh=mesh,
+            in_specs=(P(), P(None, "dp")),
+            out_specs=P(),
+        )(flat_params["flat"], updates["flat"])
+        params = shd.unpack_flat(new_flat, state["params"], plan)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads_flat)
+        return {
+            "params": params,
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+        }, metrics
+
     def step_fn(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        if self.update_sharding:
+            return self._sharded_step_fn(state, batch)
         batch = jax.tree.map(
             lambda x: shd.constrain(
                 x, self.mesh, "batch", "seq", rules=self.rules

@@ -81,6 +81,20 @@ class TrainerArgs:
     # honored at the NEXT block boundary — worst-case response is one
     # block.
     block_k: int = 1
+    # ZeRO-1 weight-update sharding: reduce-scatter grads, run the
+    # optimizer on 1/dp of the flat stream, all-gather params
+    # (parallel.sharding.CommConfig / train_step.resolve_update_sharding;
+    # silently falls back to the replicated step when the config or
+    # optimizer is incompatible — the builder logs why)
+    update_sharding: bool = False
+    # fixed gradient-collective bucket size (MB of f32 payload)
+    comm_bucket_mb: float = 4.0
+    # wire dtype for the bucketed exchange: "float32" (bitwise),
+    # "bfloat16", or "int8" (blockwise-scaled, EQuARX-style)
+    comm_wire_dtype: str = "float32"
+    # override wire dtype when the dp axis crosses DCN slices; None =
+    # use comm_wire_dtype everywhere
+    comm_wire_dtype_dcn: Optional[str] = None
 
 
 class Trainer:
@@ -122,6 +136,16 @@ class Trainer:
         self.eval_iter_fn = eval_iter_fn
         self.client = master_client
         self._init_state_fn = init_state_fn
+        comm = None
+        if args.update_sharding:
+            from dlrover_tpu.parallel.sharding import CommConfig
+
+            comm = CommConfig(
+                update_sharding=True,
+                bucket_mb=args.comm_bucket_mb,
+                wire_dtype=args.comm_wire_dtype,
+                wire_dtype_dcn=args.comm_wire_dtype_dcn,
+            )
         self._builder = step_builder or TrainStepBuilder(
             cfg,
             self.mesh,
@@ -130,6 +154,7 @@ class Trainer:
             grad_accum=args.grad_accum,
             loss_fn=loss_fn,
             attn_impl=args.attn_impl,
+            comm=comm,
         )
         self._step_fn = None
         self._block_fn = None
@@ -144,14 +169,24 @@ class Trainer:
                 self.train_iter, args.prefetch, self._batch_sharding
             )
         elif args.prefetch > 0:
-            # multi-host batches must go through form_global_batch (the
-            # caller's iterator) — say so instead of silently dropping
-            # the knob
-            logger.warning(
-                "prefetch=%d ignored on multi-host runs: wrap your "
-                "iterator with form_global_batch + prefetch_to_device "
-                "instead",
+            # multi-host: prefetch>0 opts the iterator into the trainer's
+            # placement — each host yields its LOCAL rows, form_global_batch
+            # assembles the global array (no cross-host exchange), and the
+            # queue keeps `prefetch` assembled batches in flight ahead of
+            # the step. prefetch=0 keeps the legacy contract (the caller's
+            # iterator yields already-global arrays).
+            from dlrover_tpu.train.data_utils import (
+                form_global_batch,
+                prefetch_to_device,
+            )
+
+            self.train_iter = prefetch_to_device(
+                (
+                    form_global_batch(b, self._batch_sharding)
+                    for b in self.train_iter
+                ),
                 args.prefetch,
+                self._batch_sharding,
             )
         self.state: Any = None
         self.timer = StepTimer(
@@ -207,6 +242,7 @@ class Trainer:
                 self.cfg,
                 self.mesh,
                 self.optimizer,
+                comm=self._builder.comm_resolved,
             )
         if not self.args.resume:
             return

@@ -202,9 +202,9 @@ def test_error_feedback_conserves_delta():
     # real quantization error
     delta = jnp.where(jnp.arange(8192) % 2 == 0, 3.0, 1e-3)
     sync.maybe_sync(1, {"w": delta})
-    from dlrover_tpu.ops.quant import dequantize_tree
+    from dlrover_tpu.ops.quant import wire_decode_tree
 
-    sent = dequantize_tree(sent_trees[0])["w"]
+    sent = wire_decode_tree(sent_trees[0], {"w": delta})["w"]
     resid = sync._error["w"]
     np.testing.assert_allclose(
         np.asarray(sent + resid), np.asarray(delta), rtol=1e-6

@@ -1,0 +1,73 @@
+"""Marker lint: every ``pytest.mark.X`` in tests/ must be declared.
+
+Tier-1 excludes ``-m 'not slow'`` work to stay under its time budget —
+but a typo'd marker (``@pytest.mark.slw``) silently keeps an expensive
+test IN tier-1, and an undeclared one only warns. This AST scan turns
+both into a hard failure: the set of markers used across the test tree
+must be a subset of pyproject's declared markers plus pytest builtins.
+"""
+
+import ast
+import pathlib
+
+_TESTS = pathlib.Path(__file__).parent
+_PYPROJECT = _TESTS.parent / "pyproject.toml"
+
+# markers pytest itself defines; always legal
+_BUILTIN = {
+    "parametrize",
+    "skip",
+    "skipif",
+    "xfail",
+    "usefixtures",
+    "filterwarnings",
+}
+
+
+def declared_markers():
+    try:
+        import tomllib
+    except ImportError:  # py<3.11
+        import tomli as tomllib  # type: ignore[no-redef]
+    with open(_PYPROJECT, "rb") as f:
+        data = tomllib.load(f)
+    lines = data["tool"]["pytest"]["ini_options"].get("markers", [])
+    return {line.split(":", 1)[0].strip() for line in lines}
+
+
+def used_markers():
+    """(marker, file, lineno) for every pytest.mark.<name> attribute."""
+    used = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            val = node.value
+            if (
+                isinstance(val, ast.Attribute)
+                and val.attr == "mark"
+                and isinstance(val.value, ast.Name)
+                and val.value.id == "pytest"
+            ):
+                used.append((node.attr, path.name, node.lineno))
+    return used
+
+
+def test_all_markers_declared():
+    legal = declared_markers() | _BUILTIN
+    rogue = [
+        f"{fn}:{ln}: pytest.mark.{m}"
+        for m, fn, ln in used_markers()
+        if m not in legal
+    ]
+    assert not rogue, (
+        "undeclared pytest markers (declare in pyproject.toml "
+        "[tool.pytest.ini_options] markers, or fix the typo):\n"
+        + "\n".join(rogue)
+    )
+
+
+def test_slow_marker_still_declared():
+    """Tier-1's ``-m 'not slow'`` filter depends on this declaration."""
+    assert "slow" in declared_markers()
