@@ -89,16 +89,24 @@ assert _GPT2_FALLBACK[0].startswith("gpt2")
 
 
 # (n_head, head_dim) pairs the flash gate runs: the flagship's clean
-# 128-wide heads AND the gpt2-1.5b narrow-head shape, whose odd 25
-# heads exercise auto head-packing (pack=2) plus the zero-pad path
-_KERNEL_CHECK_SHAPES = [(16, 128), (25, 64)]
+# 128-wide heads AND the gpt2-family narrow-head shapes — gpt2-1.5b's
+# odd 25 heads exercise auto head-packing (pack=2) plus the zero-pad
+# path; gpt2-355m's even 16×64 packs without padding. The d<128
+# entries double as the fp8 gate's shape source: the fp8 train path
+# targets exactly this shape family (see _check_fp8_shape).
+_KERNEL_CHECK_SHAPES = [(16, 128), (25, 64), (16, 64)]
 
 
 def check_kernels(b=2, s=1024) -> bool:
-    """On-chip numerics gate for BOTH hand-written gradients in the hot
+    """On-chip numerics gate for the hand-written gradients in the hot
     path: the Pallas flash kernels (fwd+bwd vs mha_reference, at every
-    _KERNEL_CHECK_SHAPES head geometry) and the fused lm-head
-    cross-entropy custom_vjp (vs the materialized-logits path).
+    _KERNEL_CHECK_SHAPES head geometry), the fused lm-head
+    cross-entropy custom_vjp (vs the materialized-logits path), and the
+    fp8 delayed-scaling GEMM (vs the plain dot, at the narrow-head
+    family's projection shapes). Which gates run comes from the one
+    capability table (accelerate.device_context.kernel_capabilities),
+    the same gating the train step uses — so the bench checks exactly
+    the kernel set that will execute.
 
     Runs at bench-like shapes on the REAL device (tests/test_ops.py and
     tests/test_fused_ce.py cover CPU/interpret mode only), so silent
@@ -111,6 +119,10 @@ def check_kernels(b=2, s=1024) -> bool:
     if jax.default_backend() == "cpu":
         return True  # the CPU fall-through path has no kernel to check
 
+    from dlrover_tpu.accelerate.device_context import kernel_capabilities
+
+    caps = kernel_capabilities()
+
     def close(a, b, tol):
         a = np.asarray(a, np.float32)
         b = np.asarray(b, np.float32)
@@ -118,9 +130,60 @@ def check_kernels(b=2, s=1024) -> bool:
         return float(np.abs(a - b).max() / denom) < tol
 
     ok = True
+    if caps.flash_attention:
+        for h, d in _KERNEL_CHECK_SHAPES:
+            ok = ok and _check_flash_shape(close, b, s, h, d)
+    ok = ok and _check_fused_ce(close)
+    # fp8 gate at the narrow-head family's GEMM shapes (d_model = h·d,
+    # ff = 4·d_model — the gpt2 projections the fp8 path targets);
+    # runs everywhere the bench runs on-device: non-native hardware
+    # executes the same recipe through bf16 upcasts
     for h, d in _KERNEL_CHECK_SHAPES:
-        ok = ok and _check_flash_shape(close, b, s, h, d)
-    return bool(ok) and _check_fused_ce(close)
+        if d < 128:
+            ok = ok and _check_fp8_shape(
+                close, h * d, 4 * h * d, caps.fp8_native
+            )
+    return bool(ok)
+
+
+def _check_fp8_shape(close, k, n, native) -> bool:
+    """fp8_dot (delayed scaling) vs the plain f32 GEMM at one (K, N):
+    quantization noise only after the amax histories warm up, plus the
+    state-on-cotangent convention (the backward's state output is a
+    pushed amax history, not a gradient). On fp8-native hardware also
+    pins native MXU dots against the bf16-upcast of the SAME quantized
+    values — the documented everywhere-identical-numerics contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.ops import fp8
+
+    kx, kw = jax.random.split(jax.random.key(23))
+    x = jax.random.normal(kx, (256, k), jnp.bfloat16)
+    w = jax.random.normal(kw, (k, n), jnp.bfloat16) * 0.02
+
+    def loss(x, w, st):
+        out = fp8.fp8_dot(x, w, st)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    # warm one step so the delayed scales reflect this data (the init
+    # histories of ones would clip a unit-normal x)
+    st = jax.jit(jax.grad(loss, argnums=2))(x, w, fp8.init_fp8_state())
+    out = jax.jit(fp8.fp8_dot)(x, w, st)
+    ref = jnp.dot(
+        x.astype(jnp.float32), w.astype(jnp.float32)
+    )
+    ok = close(out, ref, 0.1)  # e4m3 quantization noise
+    st2 = jax.jit(jax.grad(loss, argnums=2))(x, w, st)
+    amax_x = float(jnp.max(jnp.abs(x.astype(jnp.float32))))
+    ok = ok and abs(float(st2["amax_x"][-1]) - amax_x) < 1e-3 * amax_x
+    ok = ok and st2["amax_g"].shape == st["amax_g"].shape
+    if native:
+        out_bf16 = jax.jit(
+            lambda x, w, st: fp8.fp8_dot(x, w, st, native=False)
+        )(x, w, st)
+        ok = ok and close(out, out_bf16, 1e-2)
+    return bool(ok)
 
 
 def _check_flash_shape(close, b, s, h, d) -> bool:
@@ -279,18 +342,20 @@ _COLLECTIVE_OPS = (
 def collective_stats(hlo_text: str) -> dict:
     """Per-step collective profile of an optimized HLO module.
 
-    Returns ``{"counts": {op: n}, "bytes_by_dtype": {dtype: B}}`` —
-    op counts for each collective kind and the summed RESULT payload
-    bytes grouped by wire dtype. This is what the MULTICHIP dryrun
-    embeds in its record so a replicated-update regression (full-
-    gradient all-reduce sneaking back in) or a wire-dtype change is
-    visible in the trajectory, not just in local tests.
+    Returns ``{"counts": {op: n}, "bytes_by_dtype": {dtype: B},
+    "bytes_by_op": {op: B}}`` — op counts for each collective kind and
+    the summed RESULT payload bytes grouped by wire dtype and by op.
+    This is what the MULTICHIP dryrun embeds in its record so a
+    replicated-update regression (full-gradient all-reduce sneaking
+    back in) or a wire-dtype change is visible in the trajectory, not
+    just in local tests. ``bytes_by_op`` feeds ``overlap_report``.
     """
     import re
 
     shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
     counts = {op: 0 for op in _COLLECTIVE_OPS}
     bytes_by_dtype: dict = {}
+    bytes_by_op: dict = {}
     for line in hlo_text.splitlines():
         parts = line.split(" = ", 1)
         if len(parts) != 2:
@@ -313,13 +378,133 @@ def collective_stats(hlo_text: str) -> dict:
             for d in dims.split(","):
                 if d:
                     n *= int(d)
-            bytes_by_dtype[dt] = (
-                bytes_by_dtype.get(dt, 0) + n * _HLO_DTYPE_BYTES[dt]
-            )
+            b = n * _HLO_DTYPE_BYTES[dt]
+            bytes_by_dtype[dt] = bytes_by_dtype.get(dt, 0) + b
+            bytes_by_op[op] = bytes_by_op.get(op, 0) + b
     return {
         "counts": {k: v for k, v in counts.items() if v},
         "bytes_by_dtype": bytes_by_dtype,
+        "bytes_by_op": bytes_by_op,
     }
+
+
+# Aggregate per-chip ICI bandwidth (GB/s) by device-kind substring —
+# rough planning numbers for the overlap estimate, not spec-sheet
+# precision; the report rounds to whole µs anyway. CPU gets a token
+# value so virtual-device dryruns produce a structurally-valid report.
+_ICI_GBPS = {
+    "v4": 300.0,
+    "v5 lite": 400.0,
+    "v5e": 400.0,
+    "v5p": 800.0,
+    "v6 lite": 900.0,
+    "v6e": 900.0,
+    "v7": 1200.0,
+    "cpu": 10.0,
+}
+
+# which step-phase window each collective class can hide under: the
+# gradient wire (reduce-scatter / all-reduce / all-to-all) is issuable
+# while the backward pass still computes earlier layers' grads; the
+# param return (all-gather) overlaps the next forward. permute is
+# pipeline traffic, on the critical path by construction — no window.
+_BWD_OPS = ("reduce-scatter", "all-reduce", "all-to-all")
+_FWD_OPS = ("all-gather",)
+
+# bytes actually moved per chip, per RESULT byte, in a ring
+# implementation at large dp: all-reduce moves ~2x its payload
+# (reduce-scatter phase + all-gather phase), the others ~1x
+_WIRE_FACTOR = {"all-reduce": 2.0}
+
+
+def _ici_gbps(device_kind: str) -> float:
+    kind = (device_kind or "").lower()
+    for key, val in _ICI_GBPS.items():
+        if key in kind:
+            return val
+    return 400.0
+
+
+def overlap_report(stats, step_us, device_kind="", bwd_frac=2 / 3):
+    """Exposed-vs-hidden time estimate for one step's collectives.
+
+    For each collective class, wire time = payload bytes × ring factor
+    / ICI bandwidth; the hiding window is the share of the step the
+    scheduler can issue it under (backward ≈ ``bwd_frac`` of the step
+    for gradient traffic, the rest for the all-gather param return;
+    collective-permute gets no window — pipeline traffic is the
+    critical path). Classes sharing a window compete for it, so
+    exposure is computed per window and attributed to ops pro rata by
+    their wire time. An ESTIMATE in the same counterfactual spirit as
+    ``_nonmatmul_us_per_step``, not a profile: it exists so the bench
+    record shows whether the ZeRO-1 wire is latency we pay or latency
+    we hide, and how that moves when bucket size / wire dtype change.
+
+    Returns ``{"per_op": {op: {wire_us, window_us, exposed_us}},
+    "exposed_us_total", "hidden_us_total", "assumed_ici_gbps"}``.
+    """
+    gbps = _ici_gbps(device_kind)
+    by_op = stats.get("bytes_by_op", {})
+    windows = {
+        "bwd": step_us * bwd_frac,
+        "fwd": step_us * (1 - bwd_frac),
+        "none": 0.0,
+    }
+    wire = {}
+    for op, b in by_op.items():
+        wire[op] = b * _WIRE_FACTOR.get(op, 1.0) / (gbps * 1e3)
+    per_op = {}
+    exposed_total = 0.0
+    hidden_total = 0.0
+    for wname, ops in (
+        ("bwd", _BWD_OPS),
+        ("fwd", _FWD_OPS),
+        ("none", ("collective-permute",)),
+    ):
+        w_total = sum(wire.get(op, 0.0) for op in ops)
+        if w_total <= 0.0:
+            continue
+        win = windows[wname]
+        exposed = max(0.0, w_total - win)
+        for op in ops:
+            if op not in wire:
+                continue
+            share = wire[op] / w_total
+            per_op[op] = {
+                "wire_us": round(wire[op], 1),
+                "window_us": round(win, 1),
+                "exposed_us": round(exposed * share, 1),
+            }
+        exposed_total += exposed
+        hidden_total += w_total - exposed
+    return {
+        "per_op": per_op,
+        "exposed_us_total": round(exposed_total, 1),
+        "hidden_us_total": round(hidden_total, 1),
+        "assumed_ici_gbps": gbps,
+    }
+
+
+def suggest_bucket_mb(total_grad_bytes, device_kind="", launch_us=5.0):
+    """Bucket size for the ZeRO-1 reduce-scatter wire, from the same
+    bandwidth model as ``overlap_report``.
+
+    Two constraints pull against each other: each bucket's wire time
+    should dominate its launch latency (≥ ~4× ``launch_us``, else the
+    exchange is launch-bound and fewer/bigger buckets win), and there
+    should be ≥ 4 buckets so the first reduce-scatters issue while the
+    backward tail still computes (one mega-bucket serializes the whole
+    wire after the last gradient — see sharding.exchange_buckets'
+    reverse issue order). Clamped to [1, 64] MB; the result is a
+    starting point for ``CommConfig.bucket_mb``, not an oracle.
+    """
+    gbps = _ici_gbps(device_kind)
+    # smallest bucket whose wire time is >= 4x the launch latency
+    min_bytes = 4.0 * launch_us * gbps * 1e3
+    mb = max(1.0, min_bytes / 2**20)
+    # but keep at least 4 buckets in flight
+    mb = min(mb, max(1.0, total_grad_bytes / 4 / 2**20))
+    return round(min(mb, 64.0), 2)
 
 
 def run_config(name, batch, seq, remat, steps=30, warmup=3,
@@ -370,6 +555,20 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         n_warm = warmup
     total_steps = n_dispatch * block_k
 
+    # AOT-compile so the OPTIMIZED HLO (post-layout, post-fusion — the
+    # module the scheduler actually runs) is in hand for the collective
+    # profile; the compiled executable then serves as the step, so the
+    # timed loop measures exactly the module that was profiled. Falls
+    # back to plain jit if the AOT path is unavailable (relay backends
+    # without serializable executables).
+    hlo_text = ""
+    try:
+        compiled = step.lower(state, batch_data).compile()
+        hlo_text = compiled.as_text()
+        step = compiled
+    except Exception:  # noqa: BLE001
+        pass
+
     # sync via HOST READBACK, not block_until_ready: under the axon TPU
     # relay block_until_ready returns before device completion, which
     # would inflate throughput ~1000x; float() must wait for the value
@@ -399,6 +598,22 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
     dev = jax.devices()[0]
     mfu = model_tflops / peak_tflops(dev)
     tag = f",k{block_k}" if block_k > 1 else ""
+    overlap = None
+    stats = None
+    if hlo_text:
+        stats = collective_stats(hlo_text)
+        if stats["counts"]:
+            # per-STEP collective budget: the block HLO carries K steps
+            overlap = overlap_report(
+                {
+                    "bytes_by_op": {
+                        op: b / block_k
+                        for op, b in stats["bytes_by_op"].items()
+                    }
+                },
+                dt / total_steps * 1e6,
+                device_kind=getattr(dev, "device_kind", ""),
+            )
     return {
         "metric": (
             f"train_mfu[{cfg.name},b{batch}x{seq}{tag},{dev.device_kind}]"
@@ -413,6 +628,8 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         "host_dispatch_us_per_step": round(
             dispatch_s / total_steps * 1e6, 1
         ),
+        "collectives": stats,
+        "overlap": overlap,
     }
 
 

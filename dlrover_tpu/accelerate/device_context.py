@@ -84,3 +84,61 @@ def detect_device_context() -> DeviceContext:
 
 def fp8_supported() -> bool:
     return detect_device_context().supports_fp8
+
+
+# ---------------------------------------------------------------------------
+# Kernel capability table
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KernelCapabilities:
+    """One gating table for every hand-written kernel path.
+
+    Before this existed the gates lived scattered: the flash kernels
+    keyed off ``pallas_attention._on_tpu``, the fused norms off
+    ``pallas_norm.kernels_available`` (what ``cfg.fused_norm=None``
+    auto resolves to), and fp8 off ``fp8_supported`` — three probes
+    that could silently disagree (e.g. a relay backend that looks like
+    TPU to one and not another). Consumers: ``decoder`` (fused norm
+    auto), ``ops.fp8._resolve_native`` (native vs bf16-upcast dots),
+    and ``bench.check_kernels`` (which kernel numerics gates to run).
+
+    ``fp8_native`` means the quantized operands feed the MXU directly;
+    False still runs the fp8 recipe with bf16-upcast of the SAME
+    quantized values — identical numerics, no speedup (ops/fp8.py).
+    """
+
+    flash_attention: bool  # Pallas flash attention kernels usable
+    fused_norm: bool       # Pallas fused norm/residual kernels usable
+    fp8_native: bool       # native fp8 MXU dots (else bf16 upcast)
+    interpret: bool        # kernels run in Pallas interpret mode
+
+
+def kernel_capabilities(interpret=None) -> KernelCapabilities:
+    """The capability table for this process's backend.
+
+    ``interpret=None`` honors the DLROVER_TPU_PALLAS_INTERPRET test
+    hook (kernels execute in interpret mode on CPU); pass True/False
+    to force. Cheap: the device probe underneath is lru-cached, the
+    rest is module lookups — so callers needn't cache the table and
+    env-flipping tests see fresh answers.
+    """
+    from dlrover_tpu.ops import pallas_attention, pallas_norm
+
+    if interpret is None:
+        # both kernel modules seed from the same env var; norm's copy
+        # is authoritative for defaulting
+        interpret = pallas_norm.INTERPRET
+    ctx = detect_device_context()
+    # one Pallas-usability predicate for both kernel families: pltpu
+    # importable AND (real TPU — pallas_attention._on_tpu, which also
+    # recognizes TPU relays — or interpret mode)
+    pallas_ok = pallas_norm.kernels_available(interpret)
+    on_tpu = pallas_attention._on_tpu()
+    return KernelCapabilities(
+        flash_attention=pallas_ok,
+        fused_norm=pallas_ok,
+        fp8_native=ctx.supports_fp8,
+        interpret=bool(interpret) and not on_tpu,
+    )

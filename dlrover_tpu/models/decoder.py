@@ -248,7 +248,9 @@ def _norm(x, scale, bias, kind: str):
 def _fused_norm_enabled(cfg: ModelConfig) -> bool:
     if cfg.fused_norm is not None:
         return cfg.fused_norm
-    return pallas_norm.kernels_available()
+    from dlrover_tpu.accelerate.device_context import kernel_capabilities
+
+    return kernel_capabilities().fused_norm
 
 
 def _norm_block(x, ln, cfg: ModelConfig, residual=None):

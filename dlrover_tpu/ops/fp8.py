@@ -78,9 +78,9 @@ def _dot(a_q, b_q, native: bool):
 def _resolve_native(native):
     if native is not None:
         return bool(native)
-    from dlrover_tpu.accelerate.device_context import fp8_supported
+    from dlrover_tpu.accelerate.device_context import kernel_capabilities
 
-    return fp8_supported()
+    return kernel_capabilities().fp8_native
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

@@ -355,6 +355,42 @@ def pack_flat(tree, plan: PackPlan, n_buckets: Optional[int] = None):
     return flat.reshape(nb, plan.bucket_elems)
 
 
+def pack_buckets(tree, plan: PackPlan):
+    """Pytree → list of ``n_buckets`` independent ``[bucket_elems]`` rows.
+
+    Same values as ``pack_flat(tree, plan)``'s rows, but each row is
+    built from ONLY the leaf slices overlapping its flat range — so a
+    bucket's reduce-scatter depends on just the gradients inside it,
+    not on every leaf (``pack_flat``'s single concatenate makes each
+    bucket data-dependent on ALL grads, which pins every collective
+    behind the end of backward). This is what lets XLA's latency-hiding
+    scheduler issue early buckets while the backward tail computes.
+    """
+    leaves = jax.tree.leaves(tree)
+    e = plan.bucket_elems
+    rows = []
+    for i in range(plan.n_buckets):
+        lo, hi = i * e, (i + 1) * e
+        parts = []
+        for off, size, leaf in zip(plan.offsets, plan.sizes, leaves):
+            if off + size <= lo or off >= hi:
+                continue
+            a = max(lo, off) - off
+            b = min(hi, off + size) - off
+            parts.append(
+                leaf.reshape(-1)[a:b].astype(jnp.float32)
+            )
+        row = (
+            jnp.concatenate(parts)
+            if parts
+            else jnp.zeros((0,), jnp.float32)
+        )
+        if row.size < e:
+            row = jnp.pad(row, (0, e - row.size))
+        rows.append(row)
+    return rows
+
+
 def unpack_flat(flat, like, plan: PackPlan):
     """Inverse of ``pack_flat``: flat stream → pytree shaped like ``like``."""
     stream = flat.reshape(-1)
@@ -395,26 +431,45 @@ def _exchange_bucket(row: jax.Array, axis: str, wire: str, dp: int):
 
 
 def exchange_buckets(
-    g: jax.Array,
+    g,
     plan: PackPlan,
     wire: str,
     axis: str = "dp",
     tie_extra: Optional[jax.Array] = None,
+    issue_order: str = "reverse",
 ):
     """Reduce-scatter the packed gradient stream bucket-by-bucket.
 
-    ``g``: local partial gradients ``[n_buckets, bucket_elems]``.
-    Returns this rank's ``[n_buckets, bucket_elems/dp]`` of the summed
-    stream. Each bucket is its own collective so the scheduler can
-    overlap early buckets with the tail of backward. ``tie_extra`` (the
+    ``g``: local partial gradients — a ``[n_buckets, bucket_elems]``
+    array (``pack_flat``) or a list of per-bucket rows
+    (``pack_buckets``, the overlap-friendly form). Returns this rank's
+    ``[n_buckets, bucket_elems/dp]`` of the summed stream. Each bucket
+    is its own collective so the scheduler can overlap early buckets
+    with the tail of backward. ``issue_order="reverse"`` emits the
+    collectives from the LAST bucket down: backward produces gradients
+    roughly output-to-input, and the canonical flat order starts with
+    the embedding table — whose gradient lands last — so reverse
+    issue order matches gradient availability (the overlap-report
+    heuristic in bench.py measures what this buys). Values are
+    order-independent (each bucket is an independent collective), so
+    the f32 wire stays bitwise whatever the order. ``tie_extra`` (the
     split-off tied-head cotangent, ``[tie_size]``) rides its own
     buckets and is added shard-wise onto the leading rows — its zero
     padding makes the adds past the table's end exact no-ops.
     """
-    shards = [
-        _exchange_bucket(g[i], axis, wire, plan.dp)
-        for i in range(plan.n_buckets)
-    ]
+    rows = (
+        list(g)
+        if isinstance(g, (list, tuple))
+        else [g[i] for i in range(plan.n_buckets)]
+    )
+    order = (
+        range(plan.n_buckets - 1, -1, -1)
+        if issue_order == "reverse"
+        else range(plan.n_buckets)
+    )
+    shards: List = [None] * plan.n_buckets
+    for i in order:
+        shards[i] = _exchange_bucket(rows[i], axis, wire, plan.dp)
     if tie_extra is not None and plan.tie_size:
         extra = pack_flat(
             [tie_extra], plan, n_buckets=plan.n_tie_buckets

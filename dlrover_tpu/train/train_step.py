@@ -145,6 +145,15 @@ def _probe_flat_optimizer(
     return None
 
 
+# fallback reasons already logged, keyed (reason, config name): the
+# resolver runs on every trace (builder init, abstract/init state, AOT
+# prewarm), and re-warning the same fallback each time buries real
+# warnings. The chosen reason also rides the bench/MULTICHIP records
+# (TrainStepBuilder.update_sharding_reason), which is where a fallback
+# should be noticed.
+_LOGGED_FALLBACKS: set = set()
+
+
 def resolve_update_sharding(
     cfg: ModelConfig,
     mesh: Mesh,
@@ -161,7 +170,9 @@ def resolve_update_sharding(
     pure data-parallel meshes (every non-dp axis 1 — params replicated,
     which is what lets the optimizer shard by flat offset rather than by
     parameter), built-in loss, f32 params, elementwise optimizer state,
-    no fp8/MoE/host-offload.
+    no MoE/host-offload. ``cfg.fp8`` composes: a pure-dp mesh never
+    pipelines, so the delayed-scaling state threads the manual region
+    as an explicit carry (see ``_sharded_step_fn``).
     """
     if comm is None or not comm.update_sharding:
         return False, None, None
@@ -174,8 +185,6 @@ def resolve_update_sharding(
         reason = "mesh has dp<=1"
     elif others:
         reason = f"non-dp mesh axes in use: {others}"
-    elif cfg.fp8:
-        reason = "fp8 state threading not supported in the manual region"
     elif cfg.n_experts > 0:
         reason = "MoE routing/aux losses not supported in the manual region"
     elif offload_opt_state:
@@ -199,11 +208,15 @@ def resolve_update_sharding(
     if reason is None:
         reason = _probe_flat_optimizer(optimizer, plan)
     if reason is not None:
-        logger.warning(
-            "update sharding requested but falling back to the "
-            "replicated update: %s",
-            reason,
-        )
+        key = (reason, getattr(cfg, "name", ""))
+        if key not in _LOGGED_FALLBACKS:
+            _LOGGED_FALLBACKS.add(key)
+            logger.warning(
+                "update sharding requested but falling back to the "
+                "replicated update (config %s): %s",
+                key[1] or "<unnamed>",
+                reason,
+            )
         return False, reason, None
     return True, None, plan
 
@@ -252,20 +265,29 @@ def abstract_train_state(
         # replica); params themselves stay in their usual shardings
         opt_abs = jax.eval_shape(optimizer.init, _flat_abs(plan))
         rep = NamedSharding(mesh, P())
+        shapes = {
+            "params": params_abs,
+            "opt_state": opt_abs,
+            "step": jax.ShapeDtypeStruct((), jnp.int32),
+        }
+        sh = {
+            "params": param_shardings,
+            "opt_state": jax.tree.map(
+                lambda l: _flat_opt_sharding(l, plan, mesh), opt_abs
+            ),
+            "step": rep,
+        }
+        if cfg.fp8:
+            # pure-dp meshes never pipeline, so the delayed-scaling
+            # state always rides the sharded step (replicated: the
+            # histories are pmax-merged over dp every step)
+            fp8_abs = jax.eval_shape(lambda: decoder.init_fp8_states(cfg))
+            shapes["fp8"] = fp8_abs
+            sh["fp8"] = jax.tree.map(lambda _: rep, fp8_abs)
         return jax.tree.map(
             lambda s, h: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=h),
-            {
-                "params": params_abs,
-                "opt_state": opt_abs,
-                "step": jax.ShapeDtypeStruct((), jnp.int32),
-            },
-            {
-                "params": param_shardings,
-                "opt_state": jax.tree.map(
-                    lambda l: _flat_opt_sharding(l, plan, mesh), opt_abs
-                ),
-                "step": rep,
-            },
+            shapes,
+            sh,
         )
     opt_abs = jax.eval_shape(optimizer.init, params_abs)
     if any(_is_quantized(leaf) for leaf in jax.tree.leaves(
@@ -370,11 +392,14 @@ def init_train_state(
                 ),
                 opt_state,
             )
-            return {
+            state = {
                 "params": params,
                 "opt_state": opt_state,
                 "step": jnp.zeros([], jnp.int32),
             }
+            if cfg.fp8:
+                state["fp8"] = decoder.init_fp8_states(cfg)
+            return state
 
         return jax.jit(f_us)(rng)
     # optimizer-state leaves (Adam moments etc.) mirror param shapes and
@@ -556,9 +581,16 @@ class TrainStepBuilder:
     def _accumulated_grads(self, params, batch, rng=None, fp8=None):
         """Microbatch scan: batch leading dim is [accum, micro_b, ...].
 
-        The fp8 delayed-scaling state (when present) threads through
-        the scan carry so each microbatch's amax observations roll into
-        the next; the stateless "current" mode has no carry entry."""
+        fp8 delayed-scaling state advances ONCE per optimizer step, not
+        once per microbatch: every microbatch quantizes against the
+        SAME step-start scales (what an accum=1 step over the whole
+        global batch would use), and the per-microbatch updated states
+        merge by elementwise max. Each microbatch's new state is
+        ``concat(hist[1:], amax_i)`` over the shared step-start history
+        — all ≥ 0 — so the max is ``concat(hist[1:], max_i amax_i)``:
+        exactly one history push carrying the global-batch amax,
+        bitwise-matching the unfused single-step path (f32 max is
+        exact). The stateless "current" mode has no carry entry."""
         a = self.grad_accum
         is_cur = fp8 == "current"
 
@@ -566,22 +598,29 @@ class TrainStepBuilder:
             mb, idx = inp
             if is_cur:
                 g_acc, loss_acc = carry
-                f8 = "current"
+                f8_acc = None
             else:
-                g_acc, loss_acc, f8 = carry
+                g_acc, loss_acc, f8_acc = carry
             r = jax.random.fold_in(rng, idx) if rng is not None else None
+            f8 = "current" if is_cur else fp8
             loss, _, g, new_f8 = self._grads(params, mb, rng=r, fp8=f8)
             g_acc = jax.tree.map(jnp.add, g_acc, g)
             if is_cur:
                 return (g_acc, loss_acc + loss), None
-            return (g_acc, loss_acc + loss, new_f8), None
+            if fp8 is not None:
+                f8_acc = jax.tree.map(jnp.maximum, f8_acc, new_f8)
+            return (g_acc, loss_acc + loss, f8_acc), None
 
         zeros = jax.tree.map(jnp.zeros_like, params)
         mb_batch = jax.tree.map(
             lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]), batch
         )
         loss0 = jnp.zeros([], jnp.float32)
-        init = (zeros, loss0) if is_cur else (zeros, loss0, fp8)
+        # zeros are a safe max-identity: histories hold amaxes (>= 0)
+        f8_zero = (
+            None if fp8 is None else jax.tree.map(jnp.zeros_like, fp8)
+        )
+        init = (zeros, loss0) if is_cur else (zeros, loss0, f8_zero)
         out, _ = jax.lax.scan(micro, init, (mb_batch, jnp.arange(a)))
         grads, loss = out[0], out[1]
         new_fp8 = None if is_cur else out[2]
@@ -611,10 +650,25 @@ class TrainStepBuilder:
         view — clip/fused/state_dtype compose unchanged, the partitioner
         keeps every elementwise op local — and a second tiny manual
         region applies ``p + u`` per rank and all-gathers the result.
+
+        fp8 (``cfg.fp8``): the delayed-scaling state enters the region
+        replicated (``P()``), each rank differentiates w.r.t. it (its
+        cotangent IS the updated state, ops/fp8.py convention), and the
+        per-rank updated histories merge with ``lax.pmax`` over dp —
+        per-rank state differs ONLY in the freshly-pushed slot (local
+        activation/grad amax over this rank's tokens; the prefix and
+        the weight amax are replicated), so the pmax yields exactly the
+        global-batch amax the unsharded program observes with its
+        all-reduce-max, keeping the f32 wire bitwise. Quantization
+        scales come from the step-START history, so gradients are
+        unaffected by the merge order. Under grad_accum the microbatch
+        states merge by elementwise max first (same once-per-step
+        semantics as ``_accumulated_grads``).
         """
         cfg, mesh, plan = self.cfg, self.mesh, self._plan
         a, wire = self.grad_accum, self._wire
         tie = cfg.tie_embeddings
+        fp8 = state.get("fp8") if cfg.fp8 else None
         if a > 1:
             # microbatch split OUTSIDE the region so the (rank,
             # microbatch) data assignment matches _accumulated_grads
@@ -626,14 +680,14 @@ class TrainStepBuilder:
         else:
             batch_spec = P("dp")
 
-        def local_grads(params, mb):
+        def local_grads(params, f8, mb):
             mask = mb.get("mask")
             if mask is None:
                 mask = jnp.ones_like(mb["targets"], dtype=jnp.float32)
             local_tokens = jnp.sum(mask.astype(jnp.float32))
             denom = jnp.maximum(jax.lax.psum(local_tokens, "dp"), 1.0)
 
-            def lf(p, z):
+            def lf(p, z, f):
                 # the region flag makes shd.constrain a no-op and (when
                 # tied) aliases the lm-head's table read to z, so the
                 # head cotangent separates from the lookup's — the two
@@ -647,21 +701,37 @@ class TrainStepBuilder:
                         mesh=mesh,
                         attn_impl=self.attn_impl,
                         denom=denom,
+                        fp8_states=f,
                     )
 
+            nf8 = None
             if tie:
                 z = jnp.zeros(plan.shapes[0], jnp.float32)
-                (loss, metrics), (g, gz) = jax.value_and_grad(
-                    lf, argnums=(0, 1), has_aux=True
-                )(params, z)
+                if f8 is not None:
+                    (loss, metrics), (g, gz, nf8) = jax.value_and_grad(
+                        lf, argnums=(0, 1, 2), has_aux=True
+                    )(params, z, f8)
+                else:
+                    (loss, metrics), (g, gz) = jax.value_and_grad(
+                        lambda p, z_: lf(p, z_, None),
+                        argnums=(0, 1),
+                        has_aux=True,
+                    )(params, z)
             else:
-                (loss, metrics), g = jax.value_and_grad(
-                    lambda p: lf(p, None), has_aux=True
-                )(params)
+                if f8 is not None:
+                    (loss, metrics), (g, nf8) = jax.value_and_grad(
+                        lambda p, f: lf(p, None, f),
+                        argnums=(0, 1),
+                        has_aux=True,
+                    )(params, f8)
+                else:
+                    (loss, metrics), g = jax.value_and_grad(
+                        lambda p: lf(p, None, None), has_aux=True
+                    )(params)
                 gz = None
-            return loss, metrics, g, gz
+            return loss, metrics, g, gz, nf8
 
-        def region(params, batch):
+        def region(params, f8, batch):
             if a > 1:
                 # reduce-scatter EVERY microbatch and accumulate the
                 # shards — the order the unsharded program rounds in
@@ -669,47 +739,63 @@ class TrainStepBuilder:
                 # scan carry add), so the f32 wire stays bitwise. Same
                 # collective count as the baseline, half the bytes.
                 def micro(carry, mb):
-                    sh_acc, loss_acc = carry
-                    loss, _, g, gz = local_grads(params, mb)
+                    sh_acc, loss_acc, f8_acc = carry
+                    loss, _, g, gz, nf8 = local_grads(params, f8, mb)
                     shards = shd.exchange_buckets(
-                        shd.pack_flat(g, plan),
+                        shd.pack_buckets(g, plan),
                         plan,
                         wire,
                         axis="dp",
                         tie_extra=gz if tie else None,
                     )
-                    return (sh_acc + shards, loss_acc + loss), None
+                    if f8 is not None:
+                        f8_acc = jax.tree.map(jnp.maximum, f8_acc, nf8)
+                    return (sh_acc + shards, loss_acc + loss, f8_acc), None
 
                 zeros = jnp.zeros(
                     (plan.n_buckets, plan.bucket_elems // plan.dp),
                     jnp.float32,
                 )
-                (shards, loss_acc), _ = jax.lax.scan(
-                    micro, (zeros, jnp.zeros([], jnp.float32)), batch
+                f8_zero = (
+                    None
+                    if f8 is None
+                    else jax.tree.map(jnp.zeros_like, f8)
+                )
+                (shards, loss_acc, nf8), _ = jax.lax.scan(
+                    micro,
+                    (zeros, jnp.zeros([], jnp.float32), f8_zero),
+                    batch,
                 )
                 metrics = {
                     "loss": jax.lax.psum(loss_acc, "dp") / a
                 }
             else:
-                _, metrics, g, gz = local_grads(params, batch)
+                _, metrics, g, gz, nf8 = local_grads(params, f8, batch)
                 metrics = {
                     k: jax.lax.psum(v, "dp") for k, v in metrics.items()
                 }
                 shards = shd.exchange_buckets(
-                    shd.pack_flat(g, plan),
+                    shd.pack_buckets(g, plan),
                     plan,
                     wire,
                     axis="dp",
                     tie_extra=gz if tie else None,
                 )
-            return metrics, shards
+            if f8 is not None:
+                # global amax: per-rank states differ only in the new
+                # slot (this rank's local amax); max over dp = the
+                # unsharded program's all-reduce-max, exactly
+                nf8 = jax.tree.map(
+                    lambda h: jax.lax.pmax(h, "dp"), nf8
+                )
+            return metrics, shards, nf8
 
-        metrics, grads_flat = jax_compat.shard_map(
+        metrics, grads_flat, new_fp8 = jax_compat.shard_map(
             region,
             mesh=mesh,
-            in_specs=(P(), batch_spec),
-            out_specs=(P(), P(None, "dp")),
-        )(state["params"], batch)
+            in_specs=(P(), P(), batch_spec),
+            out_specs=(P(), P(None, "dp"), P()),
+        )(state["params"], fp8, batch)
         if a > 1:
             # divide AFTER the exchange, where GSPMD's unsharded program
             # divides after its all-reduce — keeps the f32 wire bitwise
@@ -743,11 +829,14 @@ class TrainStepBuilder:
         params = shd.unpack_flat(new_flat, state["params"], plan)
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads_flat)
-        return {
+        new_state = {
             "params": params,
             "opt_state": new_opt,
             "step": state["step"] + 1,
-        }, metrics
+        }
+        if fp8 is not None:
+            new_state["fp8"] = new_fp8
+        return new_state, metrics
 
     def step_fn(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
         if self.update_sharding:

@@ -81,8 +81,11 @@ def test_fp8_state_updates_and_loss_tracks_bf16():
 
 
 def test_fp8_with_grad_accum_threads_state():
-    """The microbatch scan must roll the fp8 state across microbatches
-    (amax from micro i visible to micro i+1's scales next step)."""
+    """The microbatch scan must merge the fp8 state across microbatches:
+    every microbatch quantizes against the SAME step-start scales, the
+    per-microbatch updated histories max-merge in the scan carry, and
+    the history advances exactly ONE slot per optimizer step (the
+    once-per-step semantics test_fp8_sharded pins numerically)."""
     mesh = build_mesh(MeshConfig(dp=-1))
     cfg = _cfg(True)
     opt = make_optimizer(learning_rate=1e-3, warmup_steps=2,

@@ -71,3 +71,52 @@ def test_all_markers_declared():
 def test_slow_marker_still_declared():
     """Tier-1's ``-m 'not slow'`` filter depends on this declaration."""
     assert "slow" in declared_markers()
+
+
+def _module_slow_marked(tree) -> bool:
+    """True when the module sets a top-level ``pytestmark`` that
+    includes ``pytest.mark.slow`` (whole file excluded from tier-1)."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "pytestmark"
+            for t in node.targets
+        ):
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Attribute) and sub.attr == "slow":
+                return True
+    return False
+
+
+def test_bench_imports_are_slow_or_local():
+    """Module-level ``import bench`` is reserved for slow-marked files.
+
+    ``bench`` is the benchmark ENTRY SCRIPT, not a library: importing
+    it at module scope runs its argv/env setup and heavyweight imports
+    during tier-1 COLLECTION, for every test in the file — even when
+    the only consumer is one HLO-guard test. Files whose whole module
+    is ``pytestmark = pytest.mark.slow`` may import it at top level
+    (they never collect into tier-1's budget); everyone else imports
+    it inside the test function that needs it.
+    """
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if _module_slow_marked(tree):
+            continue
+        for node in tree.body:  # module level only, by design
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            if any(n == "bench" or n.startswith("bench.") for n in names):
+                rogue.append(f"{path.name}:{node.lineno}")
+    assert not rogue, (
+        "module-level bench import in non-slow test files (move the "
+        "import inside the test, or mark the whole module slow):\n"
+        + "\n".join(rogue)
+    )

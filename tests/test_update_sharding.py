@@ -35,7 +35,6 @@ import numpy as np
 import optax
 import pytest
 
-from bench import collective_stats
 from dlrover_tpu.models.config import get_config
 from dlrover_tpu.parallel import sharding as shd
 from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh, single_device_mesh
@@ -214,6 +213,10 @@ def compiled_sharded():
 
 
 def test_hlo_has_rs_and_ag(compiled_sharded):
+    # function-local: bench is the slow-suite module (see
+    # test_marker_lint's bench-import rule)
+    from bench import collective_stats
+
     _, _, _, _, compiled = compiled_sharded
     stats = collective_stats(compiled.as_text())
     counts = stats["counts"]
