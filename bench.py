@@ -614,6 +614,25 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
                 dt / total_steps * 1e6,
                 device_kind=getattr(dev, "device_kind", ""),
             )
+    if overlap is not None:
+        # compile-time planning numbers become runtime telemetry gauges
+        # (plan_* / overlap_* in the metric collectors) so the tuner and
+        # brain can compare plan vs measurement without re-running bench
+        from dlrover_tpu.observability import telemetry
+
+        hub = telemetry.get_hub()
+        if hub.enabled:
+            hub.publish(
+                telemetry.plan_record_from_overlap(
+                    f"{cfg.name},b{batch}x{seq}{tag}",
+                    overlap,
+                    suggest_bucket_mb(
+                        cfg.num_params() * 4,
+                        device_kind=getattr(dev, "device_kind", ""),
+                    ),
+                    getattr(builder, "update_sharding_reason", ""),
+                )
+            )
     return {
         "metric": (
             f"train_mfu[{cfg.name},b{batch}x{seq}{tag},{dev.device_kind}]"

@@ -31,6 +31,8 @@ from dlrover_tpu.agent.rendezvous import (
     MasterRendezvousHandler,
     RendezvousOutcome,
 )
+from dlrover_tpu.observability import telemetry
+from dlrover_tpu.observability.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -225,7 +227,12 @@ class ElasticTrainingAgent:
             self.config.local_chips,
             timeout_s=self.config.rdzv_timeout_s,
         )
-        outcome = handler.next_rendezvous()
+        with get_tracer().span(
+            "failover.rendezvous", node=self.config.node_id
+        ) as sp:
+            outcome = handler.next_rendezvous()
+            sp.args["rdzv_round"] = outcome.round
+            sp.args["world_size"] = outcome.num_processes
         logger.info(
             "rendezvous round %d: %d processes, %d chips, coordinator=%s",
             outcome.round,
@@ -250,6 +257,10 @@ class ElasticTrainingAgent:
             "DLROVER_TPU_RESTART_COUNT": str(
                 self.config.max_restarts - self._remaining_restarts
             ),
+            # flight recorder: the worker's spans carry role=worker so the
+            # merged timeline separates it from this agent's (the trace/
+            # telemetry dirs themselves inherit via the environment copy)
+            GraftEnv.TRACE_ROLE: "worker",
             # the entrypoint script must resolve the framework (and the
             # user's project) the same way the agent did
             "PYTHONPATH": os.pathsep.join(
@@ -284,6 +295,13 @@ class ElasticTrainingAgent:
         self._outcome = self._rendezvous()
         env = self._worker_env(self._outcome)
         self._worker = WorkerProcess(self.config.entrypoint, env)
+        get_tracer().instant(
+            "failover.spawn",
+            node=self.config.node_id,
+            worker_pid=self._worker.pid,
+            rdzv_round=self._outcome.round,
+            restart=self.config.max_restarts - self._remaining_restarts,
+        )
         logger.info(
             "spawned worker pid=%d round=%d",
             self._worker.pid,
@@ -387,6 +405,23 @@ class ElasticTrainingAgent:
                 return 0
             # failure path (reference: training.py:687,665,704)
             logger.warning("worker exited rc=%d", rc)
+            # detect mark: the agent's poll is the first component to
+            # learn the worker died — everything downstream (persist,
+            # rendezvous, respawn, first step back) is measured from here
+            get_tracer().instant(
+                "failover.worker_exit", node=self.config.node_id, rc=rc
+            )
+            hub = telemetry.get_hub()
+            if hub.enabled:
+                hub.publish(
+                    telemetry.ElasticEvent(
+                        kind="worker_exit",
+                        node_id=self.config.node_id,
+                        restart=self.config.max_restarts
+                        - self._remaining_restarts,
+                        detail=f"rc={rc}",
+                    )
+                )
             self._safe_report(
                 self.client.report_failure,
                 f"worker exit code {rc}\n{self._worker.stderr_tail()}",
@@ -440,10 +475,13 @@ class ElasticTrainingAgent:
     def _save_ckpt_to_storage(self):
         """Persist any staged in-memory checkpoint before losing the world."""
         if self._ckpt_saver is not None:
-            try:
-                self._ckpt_saver.save_shm_to_storage()
-            except Exception:  # noqa: BLE001
-                logger.exception("emergency checkpoint persist failed")
+            with get_tracer().span(
+                "failover.ckpt_persist", node=self.config.node_id
+            ):
+                try:
+                    self._ckpt_saver.save_shm_to_storage()
+                except Exception:  # noqa: BLE001
+                    logger.exception("emergency checkpoint persist failed")
 
 
 def _local_tpu_type() -> str:

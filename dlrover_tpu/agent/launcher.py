@@ -162,6 +162,12 @@ def run(args: argparse.Namespace) -> int:
         if args.node_id is not None
         else int(os.environ.get(GraftEnv.NODE_ID, "0"))
     )
+    if os.environ.get(GraftEnv.TRACE_DIR):
+        # flight recorder on: this process's failover spans stream as
+        # role=agent (workers it spawns stream as role=worker)
+        from dlrover_tpu.observability.tracing import configure_tracer
+
+        configure_tracer("agent")
     local_chips = args.nproc or _detect_local_chips()
 
     master = None

@@ -248,7 +248,15 @@ class MasterClient:
                 global_step=step,
                 timestamp=time.time(),
                 worker_num=worker_num,
+                node_id=self.node_id,
             )
+        )
+
+    def report_telemetry(self, payload: str) -> bool:
+        """Forward one serialized telemetry record (``record.to_json()``)
+        onto the master's bus (observability/telemetry.py MasterSink)."""
+        return self._t.report(
+            msgs.TelemetryEventReport(node_id=self.node_id, payload=payload)
         )
 
     # ---- kv / sync -------------------------------------------------------

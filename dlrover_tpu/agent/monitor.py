@@ -15,16 +15,30 @@ logger = get_logger(__name__)
 
 
 def get_tpu_stats() -> dict:
+    """HBM usage aggregated over ALL local devices.
+
+    A host owns several chips (4 per v4/v5p host); reading only
+    ``devices()[0]`` under-reports host HBM pressure by the chip count
+    and misses a single hot chip entirely.  ``peak_bytes_in_use`` is
+    the per-device high watermark since process start — its sum is the
+    "would we have OOMed at a smaller HBM" signal the analyser's
+    memory estimates get compared against.
+    """
     try:
         import jax
 
-        dev = jax.devices()[0]
-        stats = dev.memory_stats() or {}
+        used = 0
+        peak = 0
+        for dev in jax.local_devices():
+            stats = dev.memory_stats() or {}
+            used += stats.get("bytes_in_use", 0)
+            peak += stats.get("peak_bytes_in_use", 0)
         return {
-            "hbm_used_mb": stats.get("bytes_in_use", 0) / 1e6,
+            "hbm_used_mb": used / 1e6,
+            "hbm_peak_mb": max(peak, used) / 1e6,
         }
     except Exception:  # noqa: BLE001
-        return {"hbm_used_mb": 0.0}
+        return {"hbm_used_mb": 0.0, "hbm_peak_mb": 0.0}
 
 
 class ResourceMonitor:
@@ -56,6 +70,7 @@ class ResourceMonitor:
                 cpu_percent=cpu,
                 used_memory_mb=mem.used / 1e6,
                 hbm_used_mb=tpu["hbm_used_mb"],
+                hbm_peak_mb=tpu.get("hbm_peak_mb", 0.0),
             )
         except Exception:  # noqa: BLE001
             logger.warning("resource report failed", exc_info=True)

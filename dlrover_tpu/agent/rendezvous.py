@@ -14,6 +14,7 @@ from typing import Dict
 from dlrover_tpu.common.constants import DefaultValues, RendezvousName
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.observability.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -58,6 +59,14 @@ class MasterRendezvousHandler:
     def next_rendezvous(self) -> RendezvousOutcome:
         rdzv_round = self._client.join_rendezvous(
             self._local_world_size, rdzv_name=self._rdzv_name
+        )
+        # split the rendezvous span: join is one RPC, the poll below is
+        # where waiting-for-peers time accumulates
+        get_tracer().instant(
+            "failover.rdzv_joined",
+            node=self._node_rank,
+            rdzv=self._rdzv_name,
+            rdzv_round=rdzv_round,
         )
         logger.info(
             "node %d joined %s round %s",

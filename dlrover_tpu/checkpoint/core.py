@@ -24,6 +24,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from dlrover_tpu.observability.tracing import get_tracer
+
 HEADER_LEN_BYTES = 8
 ALIGN = 128
 
@@ -168,23 +170,24 @@ def write_pack(
     start = payload_start(header)
 
     leaves = [leaf for _, leaf in jax.tree_util.tree_flatten_with_path(state)[0]]
-    # kick off async D2H for everything first
-    for leaf in leaves:
-        if hasattr(leaf, "copy_to_host_async"):
-            leaf.copy_to_host_async()
-    used = start
-    for leaf, entry in zip(leaves, entries):
-        shards = _replica0_shards(leaf)
-        for shard, sentry in zip(shards, entry.shards):
-            data = np.asarray(shard.data)
-            raw = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
-            lo = start + sentry.offset
-            hi = lo + sentry.nbytes
-            # direct buffer-protocol assignment: .tobytes() would copy
-            # through an intermediate bytes object (measured ~9x slower
-            # for large shards — this is the staging hot loop)
-            buf[lo:hi] = raw
-            used = max(used, hi)
+    with get_tracer().span("ckpt.write_pack", step=step, leaves=len(leaves)):
+        # kick off async D2H for everything first
+        for leaf in leaves:
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        used = start
+        for leaf, entry in zip(leaves, entries):
+            shards = _replica0_shards(leaf)
+            for shard, sentry in zip(shards, entry.shards):
+                data = np.asarray(shard.data)
+                raw = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+                lo = start + sentry.offset
+                hi = lo + sentry.nbytes
+                # direct buffer-protocol assignment: .tobytes() would copy
+                # through an intermediate bytes object (measured ~9x slower
+                # for large shards — this is the staging hot loop)
+                buf[lo:hi] = raw
+                used = max(used, hi)
     return used
 
 
@@ -337,6 +340,12 @@ def restore_tree(
         if shardings is not None
         else [None] * len(leaves_with_path)
     )
+    restore_span = get_tracer().span(
+        "ckpt.restore_tree",
+        step=pack_index.step if pack_index.step is not None else -1,
+        leaves=len(leaves_with_path),
+        resharded=shardings is not None,
+    )
     out = []
     kept = []
     for (path, leaf), sharding in zip(leaves_with_path, shard_leaves):
@@ -412,4 +421,7 @@ def restore_tree(
             len(kept),
             kept[0],
         )
+    # mismatch raises above leave the span un-ended, which records
+    # nothing — only completed restores land on the timeline
+    restore_span.end(kept=len(kept))
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -26,6 +26,8 @@ from dlrover_tpu.common.multi_process import (
 )
 from dlrover_tpu.checkpoint import core
 from dlrover_tpu.checkpoint.storage import PosixStorage
+from dlrover_tpu.observability import telemetry
+from dlrover_tpu.observability.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -82,6 +84,9 @@ class CheckpointEngine:
             # (reference: engine.py:53 check_all_rank_ready skip path)
             logger.warning("step %d: saver busy, skipping memory save", step)
             return False
+        stage_span = get_tracer().span(
+            "ckpt.save_memory", step=step, nbytes=total
+        )
         try:
             if self._shm is None or self._shm.size < total:
                 name = shm_name()
@@ -109,6 +114,18 @@ class CheckpointEngine:
             self._local_step = step
         finally:
             self._release()
+            stage_span.end()
+        hub = telemetry.get_hub()
+        if hub.enabled:
+            hub.publish(
+                telemetry.CheckpointRecord(
+                    kind="save_memory",
+                    step=step,
+                    seconds=stage_span.dur_us / 1e6,
+                    nbytes=total,
+                    tier="memory",
+                )
+            )
         if self._replica is not None:
             # stream the fresh pack to ring peers off the critical path
             # (reference: replica.py backup hooked at engine.py:328)
@@ -192,27 +209,54 @@ class CheckpointEngine:
         masquerading as "no checkpoint" — a silent from-scratch restart
         is the worst outcome of a restore bug."""
         mismatch: Optional[core.RestoreMismatchError] = None
-        try:
-            state = self._load_from_memory(target, shardings, step, partial)
-            if state is not None:
-                return state
-        except core.RestoreMismatchError as e:
-            mismatch = e
-        try:
-            state = self._load_from_replica(
-                target, shardings, step, partial
-            )
-            if state is not None:
-                return state
-        except core.RestoreMismatchError as e:
-            mismatch = mismatch or e
-        try:
-            state = self.load_from_storage(target, shardings, step, partial)
-        except core.RestoreMismatchError as e:
-            raise e
-        if state is None and mismatch is not None:
-            raise mismatch
+        # "failover." prefix: restore is a phase of the recovery timeline,
+        # so the drill's phase extraction picks it up with the rest
+        span = get_tracer().span("failover.restore")
+        with span:
+            tier = "none"
+            try:
+                state = self._load_from_memory(
+                    target, shardings, step, partial
+                )
+                if state is not None:
+                    tier = "memory"
+            except core.RestoreMismatchError as e:
+                mismatch = e
+                state = None
+            if state is None:
+                try:
+                    state = self._load_from_replica(
+                        target, shardings, step, partial
+                    )
+                    if state is not None:
+                        tier = "replica"
+                except core.RestoreMismatchError as e:
+                    mismatch = mismatch or e
+                    state = None
+            if state is None:
+                state = self.load_from_storage(
+                    target, shardings, step, partial
+                )
+                if state is not None:
+                    tier = "storage"
+            span.args["tier"] = tier
+            if state is None and mismatch is not None:
+                raise mismatch
+        self._publish_restore(tier, span.end())
         return state
+
+    def _publish_restore(self, tier: str, seconds: float):
+        hub = telemetry.get_hub()
+        if hub.enabled:
+            hub.publish(
+                telemetry.CheckpointRecord(
+                    kind="restore",
+                    step=self._local_step,
+                    seconds=seconds,
+                    ok=tier != "none",
+                    tier=tier,
+                )
+            )
 
     def _load_from_memory(self, target, shardings, step, partial=False):
         try:

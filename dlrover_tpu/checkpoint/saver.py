@@ -25,6 +25,8 @@ from dlrover_tpu.checkpoint.storage import (
     PosixStorage,
     write_tracker,
 )
+from dlrover_tpu.observability import telemetry
+from dlrover_tpu.observability.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -44,22 +46,37 @@ def persist_pack(
     on the shared filesystem; whichever host observes the full done set
     writes the tracker file. Idempotent across hosts.
     """
-    step_dir = os.path.join(ckpt_dir, f"step_{step}")
-    storage.makedirs(step_dir)
-    storage.write_bytes(
-        buf, os.path.join(step_dir, f"host_{process_index}.pack")
-    )
-    done_dir = os.path.join(step_dir, "done")
-    storage.makedirs(done_dir)
-    storage.write_bytes(
-        memoryview(b"1"), os.path.join(done_dir, f"host_{process_index}.done")
-    )
-    done = len(
-        [f for f in storage.listdir(done_dir) if f.endswith(".done")]
-    )
-    if done >= process_count:
-        write_tracker(ckpt_dir, step, storage)
-        logger.info("committed checkpoint step %d (%d hosts)", step, done)
+    span = get_tracer().span("ckpt.persist", step=step, nbytes=len(buf))
+    with span:
+        step_dir = os.path.join(ckpt_dir, f"step_{step}")
+        storage.makedirs(step_dir)
+        storage.write_bytes(
+            buf, os.path.join(step_dir, f"host_{process_index}.pack")
+        )
+        done_dir = os.path.join(step_dir, "done")
+        storage.makedirs(done_dir)
+        storage.write_bytes(
+            memoryview(b"1"),
+            os.path.join(done_dir, f"host_{process_index}.done"),
+        )
+        done = len(
+            [f for f in storage.listdir(done_dir) if f.endswith(".done")]
+        )
+        committed = done >= process_count
+        if committed:
+            write_tracker(ckpt_dir, step, storage)
+            logger.info("committed checkpoint step %d (%d hosts)", step, done)
+    hub = telemetry.get_hub()
+    if hub.enabled:
+        hub.publish(
+            telemetry.CheckpointRecord(
+                kind="persist",
+                step=step,
+                seconds=span.end(),
+                nbytes=len(buf),
+                tier="storage",
+            )
+        )
 
 
 class AsyncCheckpointSaver:

@@ -172,6 +172,9 @@ class ResourceStats:
     used_memory_mb: float = 0.0
     tpu_duty_cycle: float = 0.0
     hbm_used_mb: float = 0.0
+    # high-watermark of HBM in use across all local devices since
+    # process start (jax memory_stats peak_bytes_in_use, summed)
+    hbm_peak_mb: float = 0.0
 
 
 @message
@@ -349,6 +352,22 @@ class GlobalStepRecord:
     global_step: int = 0
     timestamp: float = 0.0
     worker_num: int = 0
+    # reporting worker's node id so the master can keep per-worker step
+    # watermarks; -1 (default) keeps old senders wire-compatible
+    node_id: int = -1
+
+
+@message
+class TelemetryEventReport:
+    """One telemetry record forwarded to the master's bus.
+
+    ``payload`` is the record's own ``to_json`` line (the telemetry
+    registry's envelope, see observability/telemetry.py) so the wire
+    layer stays agnostic of record schemas.
+    """
+
+    node_id: int = -1
+    payload: str = ""
 
 
 @message

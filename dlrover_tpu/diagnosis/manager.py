@@ -83,6 +83,49 @@ class DiagnosisManager:
         # node_id → actions queued for that node's next heartbeat
         self._pending_actions: Dict[int, List[str]] = {}
 
+    # ---- telemetry-bus subscription --------------------------------------
+
+    def attach(self, hub) -> None:
+        """Subscribe to the master's telemetry bus instead of being
+        hand-wired per report type: resource records feed the hang
+        detector's history, straggler flags and numeric incidents land
+        as diagnosis evidence."""
+        hub.subscribe(
+            self._on_record,
+            types=("ResourceRecord", "StragglerRecord", "NumericEvent"),
+        )
+
+    def _on_record(self, record) -> None:
+        tname = type(record).__name__
+        if tname == "ResourceRecord":
+            with self._lock:
+                hist = self.resource_history.setdefault(
+                    record.node_id, deque(maxlen=64)
+                )
+                hist.append(
+                    {
+                        "t": time.time(),
+                        "cpu": record.cpu_percent,
+                        "mem_mb": record.mem_mb,
+                        "hbm_mb": record.hbm_mb,
+                        "hbm_peak_mb": record.hbm_peak_mb,
+                    }
+                )
+        elif tname == "StragglerRecord":
+            self.collect_diagnosis_data(
+                record.node_id,
+                f"straggler: step={record.step} max_step={record.max_step}"
+                f" lag={record.lag_steps} ratio={record.ratio:.2f}",
+            )
+        elif tname == "NumericEvent":
+            # NumericEvent carries no node id (worker-originated via the
+            # wire); filed under the synthetic node -1 job bucket
+            self.collect_diagnosis_data(
+                -1,
+                f"numeric {record.kind} at step {record.step}: "
+                f"value={record.value} {record.detail}",
+            )
+
     # ---- collection ------------------------------------------------------
 
     def collect_failure(self, msg, worker_alive: bool = False) -> FailureRecord:

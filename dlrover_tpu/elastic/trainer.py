@@ -44,6 +44,14 @@ class ElasticTrainer:
         replicas = max(1, self._data_replicas_fn())
         if replicas == self._replicas and self._step_fn is not None:
             return
+        from dlrover_tpu.observability import telemetry
+        from dlrover_tpu.observability.tracing import get_tracer
+
+        replan_span = get_tracer().span(
+            "failover.mesh_replan",
+            replicas_from=self._replicas,
+            replicas_to=replicas,
+        )
         per_step = self.micro_batch_size * replicas
         self.grad_accum = max(
             1, math.ceil(self.global_batch_size / per_step)
@@ -66,6 +74,16 @@ class ElasticTrainer:
         )
         self._replicas = replicas
         self._step_fn = self._build_step(self.grad_accum)
+        seconds = replan_span.end(grad_accum=self.grad_accum)
+        hub = telemetry.get_hub()
+        if hub.enabled:
+            hub.publish(
+                telemetry.ElasticEvent(
+                    kind="mesh_replan",
+                    seconds=seconds,
+                    detail=f"replicas={replicas} accum={self.grad_accum}",
+                )
+            )
 
     @property
     def local_batch_size(self) -> int:

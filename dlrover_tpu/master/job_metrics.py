@@ -45,16 +45,26 @@ class GoodputTracker:
     STALLED from startup and from every node failure / hang kick until
     the next global-step report arrives — so rendezvous, restart,
     restore, and recompilation spans all land in lost time.
+
+    Interval arithmetic defaults to ``time.monotonic()`` so an NTP
+    step on the master can never inflate (or un-count) lost time.  The
+    one wall-clock comparison — ``report_ts`` (a WORKER's wall clock)
+    vs the stall detection time — keeps a separate wall-clock guard,
+    because cross-host ordering is only expressible in wall time.
+    Tests inject coherent ``now`` floats for both clocks.
     """
 
     def __init__(self, now: Optional[float] = None):
         self._lock = threading.Lock()
-        self._start = now if now is not None else time.time()
+        self._start = now if now is not None else time.monotonic()
         self._stalled_since: Optional[float] = self._start
-        self._stall_guard_ts: float = self._start
+        self._stall_guard_ts: float = (
+            now if now is not None else time.time()
+        )
         self._stall_step: Optional[int] = None
         self._last_close: float = self._start
         self._lost = 0.0
+        self._completed = False
 
     def mark_stalled(
         self,
@@ -75,11 +85,17 @@ class GoodputTracker:
         but their steps cannot advance past ``at_step`` while hung.
         """
         with self._lock:
+            if self._completed:
+                return  # training finished — see mark_completed
             if self._stalled_since is None:
-                ts = now if now is not None else time.time()
+                ts = now if now is not None else time.monotonic()
                 acct = accounted_from if accounted_from is not None else ts
                 self._stalled_since = max(acct, self._last_close)
-                self._stall_guard_ts = ts
+                # wall-clock guard for worker-reported timestamps; a
+                # single injected ``now`` serves both clocks in tests
+                self._stall_guard_ts = (
+                    now if now is not None else time.time()
+                )
                 self._stall_step = at_step
 
     def mark_productive(
@@ -106,29 +122,47 @@ class GoodputTracker:
                 and step <= self._stall_step
             ):
                 return  # stale report from before/at the stall point
-            ts = now if now is not None else time.time()
+            ts = now if now is not None else time.monotonic()
             self._lost += max(0.0, ts - self._stalled_since)
             self._stalled_since = None
             self._stall_step = None
             self._last_close = ts
 
+    def mark_completed(self, now: Optional[float] = None):
+        """A worker ran to its final training step: the job's training
+        objective is reached, so there is no productive time left to
+        lose. Any open stall closes here (charged up to completion) and
+        later ``mark_stalled`` calls become no-ops — otherwise a failure
+        *detected* after the job finished (a heartbeat timeout racing
+        teardown: the dead node's stall can never be closed by a step
+        report, since no step will ever advance past the final one)
+        would accrue lost time forever."""
+        with self._lock:
+            if self._stalled_since is not None:
+                ts = now if now is not None else time.monotonic()
+                self._lost += max(0.0, ts - self._stalled_since)
+                self._stalled_since = None
+                self._stall_step = None
+                self._last_close = ts
+            self._completed = True
+
     def lost_seconds(self, now: Optional[float] = None) -> float:
         with self._lock:
-            ts = now if now is not None else time.time()
+            ts = now if now is not None else time.monotonic()
             lost = self._lost
             if self._stalled_since is not None:
                 lost += max(0.0, ts - self._stalled_since)
             return lost
 
     def goodput(self, now: Optional[float] = None) -> float:
-        ts = now if now is not None else time.time()
+        ts = now if now is not None else time.monotonic()
         wall = ts - self._start
         if wall <= 0:
             return 1.0
         return max(0.0, 1.0 - self.lost_seconds(ts) / wall)
 
     def wall_seconds(self, now: Optional[float] = None) -> float:
-        ts = now if now is not None else time.time()
+        ts = now if now is not None else time.monotonic()
         return max(0.0, ts - self._start)
 
 
@@ -144,6 +178,9 @@ class JobMetricCollector:
             "rdzv_rounds_total": 0,
             "ckpt_commits_total": 0,
         }
+        # free-form gauges set by the telemetry bus (MetricsSink): plan
+        # numbers, overlap drift, failover phase seconds, HBM watermark
+        self.gauges: Dict[str, float] = {}
 
     def set_job_meta(self, **kw):
         with self._lock:
@@ -175,6 +212,10 @@ class JobMetricCollector:
         with self._lock:
             self.counters[counter] = self.counters.get(counter, 0) + delta
 
+    def set_gauge(self, name: str, value: float):
+        with self._lock:
+            self.gauges[name] = float(value)
+
     # ---- export ----------------------------------------------------------
 
     def _goodput(self) -> Optional[float]:
@@ -195,6 +236,7 @@ class JobMetricCollector:
                 {
                     "meta": asdict(self.meta),
                     "counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
                     "goodput": gp,
                     "goodput_lost_seconds": lost,
                     "goodput_wall_seconds": wall,
@@ -209,6 +251,9 @@ class JobMetricCollector:
             lines = []
             for name, value in self.counters.items():
                 lines.append(f"# TYPE dlrover_tpu_{name} counter")
+                lines.append(f"dlrover_tpu_{name} {value}")
+            for name, value in sorted(self.gauges.items()):
+                lines.append(f"# TYPE dlrover_tpu_{name} gauge")
                 lines.append(f"dlrover_tpu_{name} {value}")
             if gp is not None:
                 lines.append("# TYPE dlrover_tpu_goodput gauge")

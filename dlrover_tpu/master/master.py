@@ -5,6 +5,7 @@ local_master.py:38 (LocalJobMaster for single-node ``run`` CLI). One master
 process per job; agents talk to it over the typed gRPC transport.
 """
 
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -12,9 +13,11 @@ from typing import Dict, Optional
 from dlrover_tpu.common.comm import MasterTransportServer
 from dlrover_tpu.common.constants import (
     DefaultValues,
+    GraftEnv,
     JobExitReason,
     RendezvousName,
 )
+from dlrover_tpu.observability import telemetry, tracing
 from dlrover_tpu.common.global_context import get_context
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.node_manager import JobManager, Scaler
@@ -100,6 +103,32 @@ class JobMaster:
         self.goodput_tracker = GoodputTracker()
         self.metric_collector.goodput_tracker = self.goodput_tracker
         self.metrics_server = MetricsHTTPServer(self.metric_collector, port=0)
+        # master-side telemetry bus: the servicer translates wire reports
+        # onto it; metrics export + diagnosis subscribe rather than being
+        # hand-wired call-by-call.  A master-local hub (not the process
+        # singleton) so tests composing several masters don't cross wires.
+        self.telemetry_hub = telemetry.TelemetryHub()
+        self.telemetry_hub.add_sink(
+            telemetry.MetricsSink(self.metric_collector)
+        )
+        tdir = os.getenv(GraftEnv.TELEMETRY_DIR)
+        if tdir:
+            self.telemetry_hub.add_sink(
+                telemetry.JsonlSink(
+                    os.path.join(
+                        tdir, f"telemetry-master-{os.getpid()}.jsonl"
+                    )
+                )
+            )
+        self.diagnosis_manager.attach(self.telemetry_hub)
+        self.speed_monitor.attach_hub(self.telemetry_hub)
+        # flight-recorder spans: real tracer only when a trace dir is
+        # set, the pinned null tracer otherwise
+        self.tracer = (
+            tracing.configure_tracer("master")
+            if os.getenv(GraftEnv.TRACE_DIR)
+            else tracing.get_tracer()
+        )
         from dlrover_tpu.master.elastic_ps import ElasticPsService
 
         self.ps_service = ElasticPsService()
@@ -114,6 +143,7 @@ class JobMaster:
             ps_service=self.ps_service,
             goodput_tracker=self.goodput_tracker,
             metric_collector=self.metric_collector,
+            telemetry_hub=self.telemetry_hub,
         )
         self.server = MasterTransportServer(self.servicer, port=port)
 
@@ -202,12 +232,27 @@ class JobMaster:
         # master-local accounting (shard requeue + rdzv prune live in
         # the registry callbacks above)
         self.speed_monitor.reset_running_speed()
+        self.speed_monitor.drop_node(node.id)
         self.metric_collector.inc("node_failures_total")
         # goodput: lost time runs from here until a step report ADVANCES
         # past the step training had reached when the node died
         self.goodput_tracker.mark_stalled(
             at_step=self.speed_monitor.global_step
         )
+        # flight recorder: the master's detect mark anchors the failover
+        # timeline (heartbeat timeout means the node itself may never
+        # have gotten a span out)
+        self.tracer.instant(
+            "failover.detect", node=node.id, source="heartbeat_timeout"
+        )
+        if self.telemetry_hub.enabled:
+            self.telemetry_hub.publish(
+                telemetry.ElasticEvent(
+                    kind="node_down",
+                    node_id=node.id,
+                    detail="heartbeat_timeout",
+                )
+            )
 
     @property
     def port(self) -> int:
@@ -276,7 +321,7 @@ class JobMaster:
                     # accounting (clamped inside the tracker)
                     self.goodput_tracker.mark_stalled(
                         at_step=self.speed_monitor.global_step,
-                        accounted_from=time.time()
+                        accounted_from=time.monotonic()
                         - self.diagnosis_manager.HANG_WINDOW_S,
                     )
                     logger.warning("all nodes idle — prescribing restart")

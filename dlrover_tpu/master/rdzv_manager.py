@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import DefaultValues, RendezvousName
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.observability.tracing import get_tracer
 
 logger = get_logger(__name__)
 
@@ -115,6 +116,13 @@ class RendezvousManager:
                 node_id, node_rank, local_world_size, host_addr
             )
             self._alive_nodes.add(node_rank)
+            get_tracer().instant(
+                "failover.rdzv_join",
+                rdzv=self.name,
+                node=node_rank,
+                rdzv_round=self._rdzv_round,
+                waiting=len(self._waiting),
+            )
             logger.info(
                 "%s round %d: node %s joined (%d waiting, min=%d max=%d)",
                 self.name,
@@ -153,6 +161,12 @@ class RendezvousManager:
         self._world_coordinator = f"{host}:{self._coordinator_port}"
         for r in chosen:
             self._waiting.pop(r)
+        get_tracer().instant(
+            "failover.rdzv_seal",
+            rdzv=self.name,
+            rdzv_round=self._rdzv_round,
+            world_size=len(self._world),
+        )
         logger.info(
             "%s round %d sealed: world=%s coordinator=%s",
             self.name,

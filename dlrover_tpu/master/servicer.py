@@ -9,8 +9,9 @@ import time
 from typing import Optional
 
 from dlrover_tpu.common import messages as msgs
-from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.constants import NodeStatus, RendezvousName
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.observability import telemetry
 
 logger = get_logger(__name__)
 
@@ -28,6 +29,7 @@ class MasterServicer:
         ps_service=None,
         goodput_tracker=None,
         metric_collector=None,
+        telemetry_hub=None,
     ):
         self.job_manager = job_manager
         self.task_manager = task_manager
@@ -39,6 +41,7 @@ class MasterServicer:
         self.ps_service = ps_service
         self.goodput_tracker = goodput_tracker
         self.metric_collector = metric_collector
+        self.telemetry_hub = telemetry_hub
         self._ckpt_steps = {}  # node_rank -> step (flash-ckpt rank sync)
 
     # ---- report: fire-and-forget ----------------------------------------
@@ -71,6 +74,13 @@ class MasterServicer:
             self.job_manager.handle_status_report(
                 m.node_id, m.status, m.exit_reason
             )
+        if m.status == NodeStatus.SUCCEEDED and self.goodput_tracker:
+            # a worker ran to its final step: training is over, so stop
+            # goodput lost-time accounting — a peer-death detected after
+            # this point (heartbeat timeout racing job teardown) has no
+            # training left to stall, and its stall could never be
+            # closed by a step report anyway
+            self.goodput_tracker.mark_completed()
         return True
 
     def _report_worker_restart(self, m: msgs.WorkerRestartReport) -> bool:
@@ -145,8 +155,35 @@ class MasterServicer:
         return True
 
     def _report_resource(self, m: msgs.ResourceStats) -> bool:
-        if self.diagnosis_manager:
+        if self.telemetry_hub is not None and self.telemetry_hub.enabled:
+            # diagnosis (and any other consumer) subscribes to the bus;
+            # the servicer only translates wire → record
+            self.telemetry_hub.publish(
+                telemetry.ResourceRecord(
+                    node_id=m.node_id,
+                    cpu_percent=m.cpu_percent,
+                    mem_mb=m.used_memory_mb,
+                    hbm_mb=m.hbm_used_mb,
+                    hbm_peak_mb=m.hbm_peak_mb,
+                )
+            )
+        elif self.diagnosis_manager:
+            # no bus wired (unit tests building a bare servicer): keep
+            # the direct path so resource history still accumulates
             self.diagnosis_manager.collect_resource(m)
+        return True
+
+    def _report_telemetry(self, m: msgs.TelemetryEventReport) -> bool:
+        if self.telemetry_hub is None or not self.telemetry_hub.enabled:
+            return True  # accepted, nobody listening
+        try:
+            record = telemetry.from_json(m.payload)
+        except (KeyError, ValueError) as e:
+            logger.warning(
+                "undecodable telemetry record from node %d: %s", m.node_id, e
+            )
+            return False
+        self.telemetry_hub.publish(record)
         return True
 
     def _report_task_result(self, m: msgs.TaskResult) -> bool:
@@ -172,7 +209,7 @@ class MasterServicer:
     def _report_global_step(self, m: msgs.GlobalStepRecord) -> bool:
         if self.speed_monitor:
             self.speed_monitor.collect_global_step(
-                m.global_step, m.timestamp or time.time()
+                m.global_step, m.timestamp or time.time(), node_id=m.node_id
             )
         if self.goodput_tracker:
             # a step report means training is making forward progress —
@@ -248,6 +285,7 @@ class MasterServicer:
         "WorkerRestartReport": _report_worker_restart,
         "NodeFailureReport": _report_node_failure,
         "ResourceStats": _report_resource,
+        "TelemetryEventReport": _report_telemetry,
         "TaskResult": _report_task_result,
         "DatasetShardParams": _report_dataset,
         "GlobalStepRecord": _report_global_step,

@@ -1,4 +1,5 @@
-"""Worker-side observability: profiler, kernel census, loss-spike, numerics.
+"""Worker-side observability: profiler, kernel census, loss-spike, numerics,
+and the unified telemetry bus + trace spans joining them.
 
 TPU-native analog of the reference's xpu_timer (atorch/dev/xpu_timer —
 LD_PRELOAD CUDA hook timing GEMMs clustered by B/M/N/K and NCCL collectives,
@@ -9,6 +10,12 @@ On TPU there is nothing to LD_PRELOAD: every kernel is compiled by XLA from
 a traced program, so the census comes from the compiled HLO itself
 (exact, ahead of time) and step timing comes from host wall-clock around
 the dispatched step plus the XLA profiler for deep dives.
+
+The point tools publish into one stream: producers emit typed records
+onto the :class:`~dlrover_tpu.observability.telemetry.TelemetryHub` and
+trace spans through :mod:`~dlrover_tpu.observability.tracing`, so one
+merged timeline covers train step → checkpoint → failover across the
+worker, agent and master processes.
 """
 
 from dlrover_tpu.observability.loss_spike import LossSpikeDetector
@@ -25,6 +32,37 @@ from dlrover_tpu.observability.profiler import (
     profile_compiled,
     xla_trace,
 )
+from dlrover_tpu.observability.telemetry import (
+    CheckpointRecord,
+    CollectiveRecord,
+    ElasticEvent,
+    JsonlSink,
+    KernelSample,
+    MasterSink,
+    MetricsSink,
+    NumericEvent,
+    OverlapDriftRecord,
+    PlanRecord,
+    ResourceRecord,
+    StepRecord,
+    StragglerRecord,
+    TelemetryHub,
+    configure_hub,
+    from_json,
+    get_hub,
+    record_types,
+    reset_hub,
+)
+from dlrover_tpu.observability.tracing import (
+    NullTracer,
+    Span,
+    Tracer,
+    configure_tracer,
+    get_tracer,
+    merge_trace_dir,
+    reset_tracer,
+    span_intervals,
+)
 
 __all__ = [
     "KernelCensus",
@@ -37,4 +75,33 @@ __all__ = [
     "GradSanitizer",
     "check_finite",
     "sanitize_grads",
+    # telemetry bus
+    "TelemetryHub",
+    "configure_hub",
+    "get_hub",
+    "reset_hub",
+    "from_json",
+    "record_types",
+    "JsonlSink",
+    "MetricsSink",
+    "MasterSink",
+    "StepRecord",
+    "CollectiveRecord",
+    "CheckpointRecord",
+    "ElasticEvent",
+    "NumericEvent",
+    "KernelSample",
+    "PlanRecord",
+    "OverlapDriftRecord",
+    "StragglerRecord",
+    "ResourceRecord",
+    # tracing
+    "Tracer",
+    "NullTracer",
+    "Span",
+    "configure_tracer",
+    "get_tracer",
+    "reset_tracer",
+    "merge_trace_dir",
+    "span_intervals",
 ]

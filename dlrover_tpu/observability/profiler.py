@@ -212,6 +212,10 @@ class StepTimer:
         self.stop(out_box[0] if out_box else None)
 
     @property
+    def last_s(self) -> float:
+        return self._times[-1] if self._times else 0.0
+
+    @property
     def mean_s(self) -> float:
         return sum(self._times) / len(self._times) if self._times else 0.0
 

@@ -1,0 +1,492 @@
+"""Unified telemetry bus: typed records, pluggable sinks, one stream.
+
+The observability layer grew as disconnected point tools (StepTimer,
+KernelCensus, the runtime sampler, loss-spike/numeric checks,
+GoodputTracker) with nothing consuming them at runtime.  This module
+is the substrate that joins them: producers publish small, typed,
+JSON-serializable records into a :class:`TelemetryHub`; consumers
+(JSONL flight-recorder files, the Prometheus surfaces in
+``profiler.WorkerMetrics`` / ``master/job_metrics.py``, master
+reporting over the wire, the diagnosis manager) attach as sinks.
+
+Contracts:
+
+* **Lossless wire format.**  ``record.to_json()`` /
+  ``from_json(line)`` round-trip every registered record exactly
+  (pinned by the tier-1 schema lint) — the same envelope discipline as
+  ``common/messages.py``, so master-side code can rehydrate a record a
+  worker serialized.
+* **Zero-cost when off.**  ``get_hub()`` returns a module-pinned
+  ``_NullHub`` unless telemetry is configured; producers guard with
+  ``if hub.enabled:`` so on the hot path a disabled hub costs one
+  attribute load — no record construction, no publish, no allocation
+  (pinned by the tier-1 overhead guard).
+* **Sinks never break training.**  A sink raising is logged once and
+  detached; the publisher never sees the exception.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+from dlrover_tpu.common.constants import GraftEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+# ---- record registry ------------------------------------------------------
+
+_RECORD_TYPES: Dict[str, type] = {}
+
+
+def _to_json(self) -> str:
+    return json.dumps(
+        {"r": type(self).__name__, "d": dataclasses.asdict(self)},
+        sort_keys=True,
+    )
+
+
+def telemetry_record(cls):
+    """Class decorator: dataclass + registry entry + ``to_json``."""
+    cls = dataclasses.dataclass(cls)
+    cls.to_json = _to_json
+    _RECORD_TYPES[cls.__name__] = cls
+    return cls
+
+
+def from_json(line: str):
+    """Rehydrate any registered record from its ``to_json`` line."""
+    obj = json.loads(line)
+    cls = _RECORD_TYPES[obj["r"]]
+    return cls(**obj["d"])
+
+
+def record_types() -> Dict[str, type]:
+    """Registered name → class map (schema lint iterates this)."""
+    return dict(_RECORD_TYPES)
+
+
+# ---- record types ---------------------------------------------------------
+# All fields are JSON scalars (str/int/float/bool) or plain dicts so
+# asdict → json round-trips losslessly.  ``ts`` is seconds since epoch,
+# stamped by the hub at publish when left 0.
+
+
+@telemetry_record
+class StepRecord:
+    """One optimizer step as seen by the trainer."""
+
+    step: int = 0
+    loss: float = 0.0
+    step_time_s: float = 0.0
+    tokens_per_s: float = 0.0
+    accum: int = 1
+    ts: float = 0.0
+
+
+@telemetry_record
+class CollectiveRecord:
+    """One collective class's wire traffic (planned or measured)."""
+
+    op: str = ""
+    bytes: int = 0
+    wire_dtype: str = ""
+    wire_us: float = 0.0
+    exposed_us: float = 0.0
+    ts: float = 0.0
+
+
+@telemetry_record
+class CheckpointRecord:
+    """One save/restore action at any tier of the checkpoint stack."""
+
+    kind: str = ""  # save_memory | persist | emergency | restore_* ...
+    step: int = -1
+    seconds: float = 0.0
+    nbytes: int = 0
+    ok: bool = True
+    tier: str = ""  # memory | replica | storage
+    ts: float = 0.0
+
+
+@telemetry_record
+class ElasticEvent:
+    """A failover / membership phase transition."""
+
+    kind: str = ""  # detect | rendezvous | mesh_replan | restore |
+    #                 first_step | node_down | worker_exit ...
+    node_id: int = -1
+    rdzv_round: int = -1
+    restart: int = -1
+    seconds: float = 0.0
+    detail: str = ""
+    ts: float = 0.0
+
+
+@telemetry_record
+class NumericEvent:
+    """A numeric-health incident (loss spike, non-finite grads, ...)."""
+
+    kind: str = ""
+    step: int = -1
+    value: float = 0.0
+    detail: str = ""
+    ts: float = 0.0
+
+
+@telemetry_record
+class KernelSample:
+    """One op from a sampled runtime-profiler step breakdown."""
+
+    step: int = -1
+    op: str = ""
+    us: float = 0.0
+    share: float = 0.0
+    ts: float = 0.0
+
+
+@telemetry_record
+class PlanRecord:
+    """Bench/accelerate compile-time planning numbers, surfaced at
+    runtime so tuners can compare plan vs reality."""
+
+    config: str = ""
+    suggested_bucket_mb: float = 0.0
+    planned_exposed_us: float = 0.0
+    planned_hidden_us: float = 0.0
+    assumed_ici_gbps: float = 0.0
+    update_sharding_reason: str = ""
+    ts: float = 0.0
+
+
+@telemetry_record
+class OverlapDriftRecord:
+    """Planned exposed-collective µs vs measured (from the sampled
+    ``xla_trace``) — the signal ``config_tuner``/``brain`` consume."""
+
+    step: int = -1
+    planned_exposed_us: float = 0.0
+    measured_collective_us: float = 0.0
+    drift_us: float = 0.0
+    drift_frac: float = 0.0
+    ts: float = 0.0
+
+
+@telemetry_record
+class StragglerRecord:
+    """A worker lagging the per-worker step watermark front."""
+
+    node_id: int = -1
+    step: int = 0
+    max_step: int = 0
+    lag_steps: int = 0
+    ratio: float = 0.0
+    ts: float = 0.0
+
+
+@telemetry_record
+class ResourceRecord:
+    """Per-node host/HBM usage as reported by the agent monitor."""
+
+    node_id: int = -1
+    cpu_percent: float = 0.0
+    mem_mb: float = 0.0
+    hbm_mb: float = 0.0
+    hbm_peak_mb: float = 0.0
+    ts: float = 0.0
+
+
+# ---- sinks ----------------------------------------------------------------
+
+
+class JsonlSink:
+    """Append one ``to_json`` line per record (line-buffered, so records
+    survive the process dying mid-failover)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def emit(self, record) -> None:
+        with self._lock:
+            self._f.write(record.to_json() + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+# gauge/counter mappings per record type for any collector duck-typing
+# inc(name)/set_gauge(name, value) — WorkerMetrics on the worker,
+# JobMetricCollector on the master.
+_GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
+    "StepRecord": [
+        ("telemetry_step_time_s", "step_time_s"),
+        ("telemetry_loss", "loss"),
+        ("telemetry_tokens_per_s", "tokens_per_s"),
+    ],
+    "PlanRecord": [
+        ("plan_suggested_bucket_mb", "suggested_bucket_mb"),
+        ("plan_exposed_collective_us", "planned_exposed_us"),
+        ("plan_hidden_collective_us", "planned_hidden_us"),
+    ],
+    "OverlapDriftRecord": [
+        ("overlap_planned_exposed_us", "planned_exposed_us"),
+        ("overlap_measured_collective_us", "measured_collective_us"),
+        ("overlap_drift_us", "drift_us"),
+        ("overlap_drift_frac", "drift_frac"),
+    ],
+    "CheckpointRecord": [("ckpt_last_seconds", "seconds")],
+    "ResourceRecord": [
+        ("hbm_used_mb", "hbm_mb"),
+        ("hbm_peak_mb", "hbm_peak_mb"),
+    ],
+    "StragglerRecord": [("straggler_lag_steps", "lag_steps")],
+}
+_COUNTER_MAP: Dict[str, str] = {
+    "ElasticEvent": "elastic_events_total",
+    "NumericEvent": "numeric_events_total",
+    "CheckpointRecord": "ckpt_records_total",
+    "StragglerRecord": "straggler_flags_total",
+}
+
+
+class MetricsSink:
+    """Project records onto a Prometheus-style collector.
+
+    ``collector`` is duck-typed: anything with ``inc(name)`` and
+    ``set_gauge(name, value)`` (``profiler.WorkerMetrics`` worker-side,
+    ``master.job_metrics.JobMetricCollector`` master-side).
+    """
+
+    def __init__(self, collector):
+        self._c = collector
+
+    def emit(self, record) -> None:
+        tname = type(record).__name__
+        for gauge, attr in _GAUGE_MAP.get(tname, ()):
+            self._c.set_gauge(gauge, float(getattr(record, attr)))
+        counter = _COUNTER_MAP.get(tname)
+        if counter:
+            self._c.inc(counter)
+        if tname == "ElasticEvent" and record.seconds > 0 and record.kind:
+            self._c.set_gauge(f"failover_{record.kind}_s", record.seconds)
+
+
+class MasterSink:
+    """Forward selected record types to the master over the existing
+    agent↔master wire (``MasterClient.report_telemetry``).
+
+    Per-step records are excluded by default: the bus must not turn the
+    hot path into an RPC-per-step — the speed monitor already gets step
+    reports through ``report_global_step``.
+    """
+
+    DEFAULT_TYPES = (
+        "CheckpointRecord",
+        "ElasticEvent",
+        "NumericEvent",
+        "OverlapDriftRecord",
+        "PlanRecord",
+    )
+
+    def __init__(self, client, types: Optional[Tuple[str, ...]] = None):
+        self._client = client
+        self._types = frozenset(
+            types if types is not None else self.DEFAULT_TYPES
+        )
+
+    def emit(self, record) -> None:
+        if type(record).__name__ in self._types:
+            self._client.report_telemetry(record.to_json())
+
+
+class CallbackSink:
+    """Deliver records to a plain callable (diagnosis subscription)."""
+
+    def __init__(self, fn: Callable, types: Optional[Tuple[str, ...]] = None):
+        self._fn = fn
+        self._types = frozenset(types) if types is not None else None
+
+    def emit(self, record) -> None:
+        if self._types is None or type(record).__name__ in self._types:
+            self._fn(record)
+
+
+# ---- hub ------------------------------------------------------------------
+
+
+class TelemetryHub:
+    """Fan records out to attached sinks; a failing sink is detached
+    after logging once, never propagated to the producer."""
+
+    enabled = True
+
+    def __init__(self):
+        self._sinks: List = []
+        self._lock = threading.Lock()
+
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
+    def subscribe(
+        self, fn: Callable, types: Optional[Tuple[str, ...]] = None
+    ) -> CallbackSink:
+        sink = CallbackSink(fn, types)
+        self.add_sink(sink)
+        return sink
+
+    def publish(self, record) -> None:
+        if not record.ts:
+            record.ts = time.time()
+        # snapshot under the lock; emit outside it so a slow sink
+        # (file write, RPC) never serializes other publishers
+        with self._lock:
+            sinks = tuple(self._sinks)
+        for sink in sinks:
+            try:
+                sink.emit(record)
+            except Exception as e:
+                logger.warning(
+                    "telemetry sink %s failed (%s); detaching",
+                    type(sink).__name__,
+                    e,
+                )
+                self.remove_sink(sink)
+
+
+def _noop(record) -> None:
+    pass
+
+
+class _NullHub:
+    """Disabled hub: ``enabled`` is False and every method is a pinned
+    no-op.  Producers guard ``if hub.enabled:`` so records are never
+    even constructed on the disabled path."""
+
+    __slots__ = ()
+    enabled = False
+    publish = staticmethod(_noop)
+
+    def add_sink(self, sink) -> None:
+        pass
+
+    def remove_sink(self, sink) -> None:
+        pass
+
+    def subscribe(self, fn, types=None):
+        return None
+
+
+_NULL_HUB = _NullHub()
+_hub = None
+_hub_lock = threading.Lock()
+
+
+def configure_hub(
+    sinks: Optional[List] = None, jsonl_path: Optional[str] = None
+):
+    """Install the process hub (idempotent: reconfiguring adds sinks)."""
+    global _hub
+    with _hub_lock:
+        if _hub is None or _hub is _NULL_HUB:
+            _hub = TelemetryHub()
+        for s in sinks or ():
+            _hub.add_sink(s)
+        if jsonl_path:
+            _hub.add_sink(JsonlSink(jsonl_path))
+        return _hub
+
+
+def get_hub():
+    """The process hub, or the pinned ``_NullHub`` when telemetry is
+    off.  Auto-enables with a JSONL sink when
+    ``DLROVER_TPU_TELEMETRY_DIR`` is set (one file per process, role
+    from ``DLROVER_TPU_TRACE_ROLE``)."""
+    if _hub is not None:
+        return _hub
+    tdir = os.getenv(GraftEnv.TELEMETRY_DIR)
+    if tdir:
+        role = os.getenv(GraftEnv.TRACE_ROLE, "proc")
+        return configure_hub(
+            jsonl_path=os.path.join(
+                tdir, f"telemetry-{role}-{os.getpid()}.jsonl"
+            )
+        )
+    return _NULL_HUB
+
+
+def reset_hub() -> None:
+    """Drop the installed hub (tests)."""
+    global _hub
+    with _hub_lock:
+        _hub = None
+
+
+# ---- producers' helpers ---------------------------------------------------
+
+
+def plan_record_from_overlap(
+    config_name: str,
+    overlap: Optional[Dict],
+    suggested_bucket_mb: float = 0.0,
+    update_sharding_reason: str = "",
+) -> PlanRecord:
+    """Build a :class:`PlanRecord` from ``bench.overlap_report`` output."""
+    overlap = overlap or {}
+    return PlanRecord(
+        config=config_name,
+        suggested_bucket_mb=float(suggested_bucket_mb or 0.0),
+        planned_exposed_us=float(overlap.get("exposed_us_total", 0.0)),
+        planned_hidden_us=float(overlap.get("hidden_us_total", 0.0)),
+        assumed_ici_gbps=float(overlap.get("assumed_ici_gbps", 0.0)),
+        update_sharding_reason=update_sharding_reason or "",
+    )
+
+
+_COLLECTIVE_MARKERS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+
+def measured_collective_us(breakdown: List) -> float:
+    """Sum the measured µs of collective ops in a runtime-timer
+    breakdown (list of objects with ``.name`` and ``.total_us``)."""
+    total = 0.0
+    for op in breakdown:
+        name = op.name.lower()
+        if any(m in name for m in _COLLECTIVE_MARKERS):
+            total += op.total_us
+    return total
+
+
+def overlap_drift(
+    step: int, planned_exposed_us: float, breakdown: List
+) -> OverlapDriftRecord:
+    """Planned exposed-collective time vs measured collective time from
+    one sampled step.  ``drift_frac`` is relative to the plan (0 when
+    nothing was planned — pure-measurement mode)."""
+    measured = measured_collective_us(breakdown)
+    drift = measured - planned_exposed_us
+    frac = drift / planned_exposed_us if planned_exposed_us > 0 else 0.0
+    return OverlapDriftRecord(
+        step=step,
+        planned_exposed_us=float(planned_exposed_us),
+        measured_collective_us=float(measured),
+        drift_us=float(drift),
+        drift_frac=float(frac),
+    )

@@ -121,8 +121,19 @@ class LossSpikeCallback(Callback):
         self.detector = detector
 
     def on_step_end(self, trainer, step, metrics, control):
-        if "loss" in metrics:
-            self.detector.update(step, metrics["loss"])
+        if "loss" not in metrics:
+            return
+        loss = metrics["loss"]
+        if self.detector.update(step, loss):
+            from dlrover_tpu.observability import telemetry
+
+            hub = telemetry.get_hub()
+            if hub.enabled:
+                hub.publish(
+                    telemetry.NumericEvent(
+                        kind="loss_spike", step=step, value=float(loss)
+                    )
+                )
 
 
 class EarlyStoppingCallback(Callback):

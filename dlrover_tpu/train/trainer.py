@@ -17,10 +17,13 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from dlrover_tpu.common.constants import GraftEnv
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.observability import telemetry
 from dlrover_tpu.observability.loss_spike import LossSpikeDetector
 from dlrover_tpu.observability.profiler import StepTimer
+from dlrover_tpu.observability.tracing import get_tracer
 from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
 from dlrover_tpu.train.callbacks import (
     Callback,
@@ -213,6 +216,15 @@ class Trainer:
         self.callbacks = CallbackList(callbacks)
         if self.spike_detector is not None:
             self.callbacks.add(LossSpikeCallback(self.spike_detector))
+        # planned exposed-collective µs from the compile-time overlap
+        # report (bench sets this); compared against the measured
+        # runtime-trace collective time → OverlapDriftRecord
+        self.planned_exposed_us = 0.0
+        # restart>0 means we are recovering: the first completed step
+        # closes the failover timeline ("first-step-back")
+        self._first_step_pending = (
+            int(os.environ.get(GraftEnv.RESTART_COUNT, "0") or 0) > 0
+        )
 
     def add_callback(self, cb: Callback):
         self.callbacks.add(cb)
@@ -317,6 +329,67 @@ class Trainer:
         self.callbacks.fire("on_train_end", self, control)
         return self.state
 
+    # ---- telemetry producers --------------------------------------------
+
+    def _emit_step_telemetry(
+        self, step: int, loss: float, step_time_s: float,
+        batch=None, n_steps: int = 1,
+    ):
+        """Per-step StepRecord onto the bus; closes the failover timeline
+        on the first step after a restart. Disabled hub: two attribute
+        reads and out — no allocation, no publish."""
+        if self._first_step_pending:
+            self._first_step_pending = False
+            get_tracer().instant("failover.first_step", step=step)
+            hub = telemetry.get_hub()
+            if hub.enabled:
+                hub.publish(
+                    telemetry.ElasticEvent(
+                        kind="first_step_back", detail=f"step={step}"
+                    )
+                )
+        hub = telemetry.get_hub()
+        if not hub.enabled:
+            return
+        tokens = 0
+        if batch is not None:
+            tok = batch.get("tokens")
+            if tok is not None:
+                tokens = int(getattr(tok, "size", 0)) // max(n_steps, 1)
+        hub.publish(
+            telemetry.StepRecord(
+                step=step,
+                loss=loss,
+                step_time_s=step_time_s,
+                tokens_per_s=(
+                    tokens / step_time_s if step_time_s > 0 else 0.0
+                ),
+                accum=self.args.grad_accum,
+            )
+        )
+
+    def _emit_kernel_telemetry(self, step: int):
+        """After a runtime-timer sampled step: top-op KernelSamples plus
+        the planned-vs-measured exposed-collective drift record."""
+        rt = self.runtime_timer
+        if rt is None or rt.sampled_at != step:
+            return
+        hub = telemetry.get_hub()
+        if not hub.enabled:
+            return
+        for op in rt.breakdown[:8]:
+            hub.publish(
+                telemetry.KernelSample(
+                    step=step, op=op.name, us=op.total_us,
+                    share=op.fraction,
+                )
+            )
+        hub.publish(
+            telemetry.overlap_drift(
+                step, self.planned_exposed_us, rt.breakdown
+            )
+        )
+
     def _train_stepwise(self) -> Tuple[int, int]:
         """The classic one-dispatch-per-step loop (block_k=1)."""
         args = self.args
@@ -345,6 +418,9 @@ class Trainer:
                 self.state, metrics = self._step_fn(self.state, batch)
             self.timer.stop(outputs=metrics["loss"])
             loss = float(metrics["loss"])
+            self._emit_step_telemetry(step, loss, self.timer.last_s, batch)
+            if self.runtime_timer is not None:
+                self._emit_kernel_telemetry(step)
             window_loss += loss
             window_n += 1
             self.callbacks.fire(
@@ -458,10 +534,12 @@ class Trainer:
         def drain(first, k, metrics, t0):
             host = jax.device_get(metrics)  # previous block: finished
             self.timer.record(time.perf_counter() - t0, n_steps=k)
+            per_step_s = self.timer.last_s
             losses = np.asarray(host["loss"]).reshape(-1)
             for i in range(k):
                 s = first + i
                 loss = float(losses[i])
+                self._emit_step_telemetry(s, loss, per_step_s, n_steps=k)
                 window["loss"] += loss
                 window["n"] += 1
                 self.callbacks.fire(
@@ -522,6 +600,7 @@ class Trainer:
                     self.state, metrics = self.runtime_timer.profiled_call(
                         sample, self._block_fn, self.state, block
                     )
+                    self._emit_kernel_telemetry(sample)
                 else:
                     self.state, metrics = self._block_fn(self.state, block)
             else:

@@ -22,13 +22,16 @@ sparse server mid-run.
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+from dlrover_tpu.common.constants import GraftEnv
 from dlrover_tpu.data.coworker import BatchFeedServer, BatchRing
 from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+from dlrover_tpu.observability.tracing import get_tracer
 from dlrover_tpu.sparse import GroupAdam
 from dlrover_tpu.sparse.embedding import EmbeddingSpec
 from dlrover_tpu.sparse.server import DistributedEmbedding, KvClient
@@ -68,6 +71,15 @@ def main():
     servers = {
         k: tuple(v) for k, v in json.loads(args.kv_addrs).items()
     }
+    # tracer auto-enables from DLROVER_TPU_TRACE_DIR (role=worker comes
+    # from the env the agent injected); restart>0 means this process is
+    # the recovery — its model/sparse-tier re-setup is the restore phase
+    tracer = get_tracer()
+    restart = int(os.environ.get(GraftEnv.RESTART_COUNT, "0") or 0)
+    restore_span = (
+        tracer.span("failover.restore", tier="kv_ring") if restart > 0
+        else None
+    )
     cfg = DeepFMConfig(
         n_fields=args.fields, n_dense=args.dense,
         emb_dim=args.emb_dim, mlp_dims=(32,),
@@ -76,6 +88,8 @@ def main():
     model.coll.close()
     demb = DistributedEmbedding(_specs(cfg.emb_dim), servers)
     model.coll = demb
+    if restore_span is not None:
+        restore_span.end(servers=len(servers))
 
     ring = BatchRing("drill", slots=4, slot_bytes=1 << 20, create=True)
     feed = BatchFeedServer(ring, host="127.0.0.1")
@@ -87,8 +101,6 @@ def main():
 
     master = None
     try:
-        import os
-
         addr = os.environ.get("DLROVER_TPU_MASTER_ADDR")
         if addr:
             from dlrover_tpu.agent.master_client import MasterClient
@@ -110,18 +122,29 @@ def main():
                 batch["labels"].astype(np.float32),
             )
         except Exception as e:  # noqa: BLE001 — sparse-tier wire error
-            survivors = _probe_survivors(servers)
+            tracer.instant("failover.sparse_detect", step=step)
+            with tracer.span(
+                "failover.sparse_probe", servers=len(servers)
+            ) as probe:
+                survivors = _probe_survivors(servers)
+                probe.args["alive"] = len(survivors)
             if not survivors:
                 print(f"[fullstack] sparse ring gone: {e}", flush=True)
                 raise
             servers = survivors
-            demb.set_servers(survivors, migrate=False)
+            with tracer.span(
+                "failover.sparse_adopt", survivors=len(survivors)
+            ):
+                demb.set_servers(survivors, migrate=False)
             print(
                 f"[fullstack] sparse failover to {sorted(survivors)}",
                 flush=True,
             )
             continue
         step += 1
+        if step == 1 and restart > 0:
+            # recovery timeline closes: the respawned worker stepped
+            tracer.instant("failover.first_step", step=step)
         print(f"[fullstack] step {step} loss {loss:.4f}", flush=True)
         if master is not None and step % 5 == 0:
             try:

@@ -17,6 +17,7 @@ import json
 import multiprocessing as mp
 import os
 import re
+import signal
 import subprocess
 import sys
 import threading
@@ -36,10 +37,14 @@ from elastic_harness import (
 )
 from test_sparse_serving import _spawn_server
 
+from dlrover_tpu.observability.tracing import merge_trace_dir
+
 RECOVERY_BUDGET_S = 60.0
 
 
-def _launch_drill_agent(run_id, node_id, addr, kv_json, steps, wire_token):
+def _launch_drill_agent(
+    run_id, node_id, addr, kv_json, steps, wire_token, trace_dir
+):
     return subprocess.Popen(
         [
             sys.executable,
@@ -70,6 +75,11 @@ def _launch_drill_agent(run_id, node_id, addr, kv_json, steps, wire_token):
                 # here (shm isolation on one box), so the cross-host
                 # planes authenticate with this instead
                 "DLROVER_TPU_WIRE_TOKEN": wire_token,
+                # the flight recorder: one JOB-wide trace dir (run ids
+                # are node-scoped, so this is the cross-process merge
+                # key); the agent streams role=agent spans, its workers
+                # inherit the dir and stream role=worker
+                "DLROVER_TPU_TRACE_DIR": trace_dir,
             },
         ),
         stdout=subprocess.PIPE,
@@ -77,6 +87,74 @@ def _launch_drill_agent(run_id, node_id, addr, kv_json, steps, wire_token):
         text=True,
         start_new_session=True,
     )
+
+
+def _find_worker_pid(agent_pid, script="train_deepfm_fullstack.py",
+                     deadline_s=30.0):
+    """The agent's worker child: ppid == agent AND running the drill
+    script (the launcher itself also matches the script name in argv)."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for pid_dir in os.listdir("/proc"):
+            if not pid_dir.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid_dir}/stat") as f:
+                    ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+                if ppid != agent_pid:
+                    continue
+                with open(f"/proc/{pid_dir}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        errors="replace"
+                    )
+                if script in cmd:
+                    return int(pid_dir)
+            except (OSError, ValueError, IndexError):
+                continue
+        time.sleep(0.5)
+    return None
+
+
+def _failover_phases(events, t0, t1):
+    """Attribute the recovery inside wall window [t0, t1] to phases from
+    the merged ``failover.*`` events (``ts`` is wall-anchored epoch µs).
+
+    Returns ({phase: seconds}, window_events). Spans/instants that carry
+    a ``node`` arg are pinned to node 0 — the node whose worker was
+    killed; master-side events (rdzv seal) carry no node and pass."""
+    lo, hi = (t0 - 2.0) * 1e6, (t1 + 5.0) * 1e6
+    win = [
+        e
+        for e in events
+        if e.get("name", "").startswith("failover.")
+        and lo <= e.get("ts", 0.0) <= hi
+    ]
+
+    def first(name, ph):
+        for e in win:
+            if e.get("name") != name or e.get("ph") != ph:
+                continue
+            if (e.get("args") or {}).get("node", 0) != 0:
+                continue
+            return e
+        return None
+
+    phases = {}
+    exit_ev = first("failover.worker_exit", "i")
+    if exit_ev:
+        phases["detect_s"] = round(exit_ev["ts"] / 1e6 - t0, 3)
+    for span_name, key in (
+        ("failover.ckpt_persist", "ckpt_persist_s"),
+        ("failover.rendezvous", "rendezvous_s"),
+        ("failover.restore", "restore_s"),
+    ):
+        ev = first(span_name, "X")
+        if ev:
+            phases[key] = round(ev.get("dur", 0.0) / 1e6, 3)
+    fs = first("failover.first_step", "i")
+    if fs:
+        phases["first_step_s"] = round(fs["ts"] / 1e6 - t0, 3)
+    return phases, win
 
 
 def _synthetic_ctr(rng, n, fields, n_dense):
@@ -124,9 +202,12 @@ def _master_metrics(port: int) -> dict:
 
 
 @pytest.mark.slow
-def test_fullstack_elasticity_drill(monkeypatch):
+def test_fullstack_elasticity_drill(monkeypatch, tmp_path):
     run_id = f"drill{os.getpid()}"
     wire_token = f"{run_id}-wire"
+    # job-wide flight-recorder dir: every process (master, agents,
+    # workers) streams its spans here; the merge is the drill artifact
+    trace_dir = str(tmp_path / "trace")
     # the KvServer children (mp spawn) inherit this env
     monkeypatch.setenv("DLROVER_TPU_WIRE_TOKEN", wire_token)
     ctx = mp.get_context("spawn")
@@ -149,6 +230,7 @@ def test_fullstack_elasticity_drill(monkeypatch):
                 # 300 s default would outlive the whole test), so the
                 # goodput tracker sees the failure
                 "DLROVER_TPU_CTX_HEARTBEAT_TIMEOUT_S": "35",
+                "DLROVER_TPU_TRACE_DIR": trace_dir,
             },
         )
         # the metrics endpoint is logged during prepare(), before the
@@ -162,7 +244,7 @@ def test_fullstack_elasticity_drill(monkeypatch):
         agents = [
             _launch_drill_agent(
                 run_id, i, maddr, kv_json, steps=60,
-                wire_token=wire_token,
+                wire_token=wire_token, trace_dir=trace_dir,
             )
             for i in (0, 1)
         ]
@@ -217,13 +299,81 @@ def test_fullstack_elasticity_drill(monkeypatch):
             ), f"worker {i} stalled:\n" + "".join(logs[i][-40:])
         first_losses = steps_seen(logs[0])
         first = first_losses[min(first_losses)]
+
+        # ---- failure 1: kill worker 0's PROCESS (agent survives) ------
+        # the one failure that exercises the full per-phase recovery
+        # chain the flight recorder attributes: the agent's poll detects
+        # the exit, persists the staged ckpt, re-rendezvouses (agent 1
+        # sees the waiting node and rejoins too), respawns with
+        # restart=1, and the new worker's first step closes the timeline
+        worker_pid = _find_worker_pid(agents[0].pid)
+        assert worker_pid, "could not locate worker 0's process"
+        # keep BOTH producers feeding through the kill: starving worker 1
+        # here would let it drain its ring and exit CLEANLY — its agent
+        # then reports SUCCEEDED and leaves, and the re-rendezvous can
+        # never seal. The producer threads exit on their own when the
+        # kill/respawn tears down the old ingress sockets.
+        old_producers = producers
+        producers = []
+        t_kill_worker = time.time()
+        os.kill(worker_pid, signal.SIGKILL)
+        # BOTH workers respawn (coordinated re-rendezvous): re-discover
+        # the new ingress ports and become their producers again
+        for i in (0, 1):
+            line = _collect(
+                queues[i],
+                logs[i],
+                until=lambda l: bool(port_re.search(l)),
+                deadline=t_kill_worker + RECOVERY_BUDGET_S,
+            )
+            assert line, (
+                f"worker {i} never re-served its feed port after the "
+                "worker kill:\n" + "".join(logs[i][-40:])
+            )
+            port = int(port_re.search(line).group(1))
+            prod = _Producer(port, batch)
+            prod.start()
+            producers.append(prod)
+        for prod in old_producers:
+            prod.stop_ev.set()  # hygiene — their sockets are gone
+        line = _collect(
+            queues[0],
+            logs[0],
+            until=lambda l: bool(_STEP_RE.search(l)),
+            deadline=t_kill_worker + RECOVERY_BUDGET_S,
+        )
+        assert line, (
+            "worker 0 made no step within 60s of the worker kill:\n"
+            + "".join(logs[0][-40:])
+        )
+        recovery_worker_s = time.time() - t_kill_worker
+        assert recovery_worker_s < RECOVERY_BUDGET_S
+
         # goodput window opens here: startup (rendezvous + first jit
-        # compile) is excluded — the reference's 95% headline is a
-        # steady-state number too, not a cold-start one
+        # compile) AND the worker-kill recovery above are excluded — the
+        # reference's 95% headline is a steady-state number too, not a
+        # cold-start one. The stall the kill opened closes only once a
+        # respawned worker's report ADVANCES past the pre-kill watermark
+        # (restarted workers count from step 0 again), so wait for
+        # lost-seconds to stop growing before sampling the baseline.
+        deadline = time.time() + 60
+        prev_lost = -1.0
+        while time.time() < deadline:
+            lost_now = _master_metrics(metrics_port)[
+                "goodput_lost_seconds"
+            ]
+            if lost_now == prev_lost:
+                break
+            prev_lost = lost_now
+            time.sleep(1.0)
+        else:
+            raise AssertionError(
+                "worker-kill goodput stall never closed"
+            )
         gp0 = _master_metrics(metrics_port)
         t_window_open = time.time()
 
-        # ---- failure 1: kill agent 1 (whole process group) ------------
+        # ---- failure 2: kill agent 1 (whole process group) ------------
         t_kill_agent = time.time()
         producers[1].stop_ev.set()
         _kill_tree(agents[1])
@@ -247,7 +397,7 @@ def test_fullstack_elasticity_drill(monkeypatch):
         assert recovery_agent_s < RECOVERY_BUDGET_S
         assert master.poll() is None, "master died with the agent"
 
-        # ---- failure 2: kill sparse server s0 -------------------------
+        # ---- failure 3: kill sparse server s0 -------------------------
         t_kill_kv = time.time()
         kv_procs[0].kill()
         kv_procs[0].join(timeout=10)
@@ -277,7 +427,7 @@ def test_fullstack_elasticity_drill(monkeypatch):
         recovery_kv_s = time.time() - t_kill_kv
         assert recovery_kv_s < RECOVERY_BUDGET_S
 
-        # the master must have SEEN failure 1 (heartbeat timeout) before
+        # the master must have SEEN the agent kill (heartbeat timeout) before
         # the goodput window closes — otherwise the goodput number would
         # be vacuous (no stall ever marked)
         deadline = time.time() + 60
@@ -321,9 +471,37 @@ def test_fullstack_elasticity_drill(monkeypatch):
             f"goodput {goodput:.3f} across the two failures "
             f"(lost {lost:.1f}s of {window_wall:.1f}s)"
         )
+
+        # ---- flight recorder: merged timeline + phase attribution -----
+        # one time-sorted JSONL of every process's spans; the worker-kill
+        # failover must decompose into detect → (persist) → rendezvous →
+        # restore → first-step, with all three roles on the timeline
+        trace_out = os.path.join(REPO, "DRILL_r07_trace.jsonl")
+        events = merge_trace_dir(trace_dir, out_path=trace_out)
+        phases, win = _failover_phases(
+            events, t_kill_worker, t_kill_worker + recovery_worker_s
+        )
+        roles = {(e.get("args") or {}).get("role", "") for e in win}
+        assert {"worker", "agent", "master"} <= roles, (
+            f"failover window roles {roles} "
+            f"({len(events)} events total, {len(win)} in window)"
+        )
+        for key in (
+            "detect_s", "rendezvous_s", "restore_s", "first_step_s"
+        ):
+            assert key in phases, (
+                phases,
+                sorted({e.get("name") for e in win}),
+            )
+
         artifact = {
             "drill": "test_fullstack_elasticity_drill",
             "failures": [
+                {
+                    "kind": "worker_killed",
+                    "recovery_s": round(recovery_worker_s, 2),
+                    "phases": phases,
+                },
                 {"kind": "agent_killed", "recovery_s": round(recovery_agent_s, 2)},
                 {"kind": "sparse_server_killed", "recovery_s": round(recovery_kv_s, 2)},
             ],
@@ -335,15 +513,36 @@ def test_fullstack_elasticity_drill(monkeypatch):
             "node_failures_seen_by_master": gp1["counters"][
                 "node_failures_total"
             ],
+            "trace_events": len(events),
+            "trace_path": os.path.basename(trace_out),
         }
         out_path = os.environ.get(
             "DLROVER_TPU_DRILL_ARTIFACT",
-            os.path.join(REPO, "DRILL_r05.json"),
+            os.path.join(REPO, "DRILL_r07.json"),
         )
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=1)
         print(f"\n[drill] {json.dumps(artifact)}")
     finally:
+        dump_dir = os.environ.get("DLROVER_TPU_DRILL_DEBUG_DIR")
+        if dump_dir:
+            # post-mortem: the failing assert only shows ONE process's
+            # tail — dump every captured stream for cross-correlation
+            os.makedirs(dump_dir, exist_ok=True)
+            try:
+                for i, (q, log) in enumerate(zip(queues, logs)):
+                    _drain_now(q, log)
+                    with open(
+                        os.path.join(dump_dir, f"worker{i}.log"), "w"
+                    ) as f:
+                        f.writelines(log)
+                _drain_now(mq, mlines)
+                with open(
+                    os.path.join(dump_dir, "master.log"), "w"
+                ) as f:
+                    f.writelines(mlines)
+            except Exception:  # noqa: BLE001 — best-effort diagnostics
+                pass
         for prod in producers:
             prod.stop_ev.set()
         for a in agents or []:

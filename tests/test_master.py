@@ -404,9 +404,10 @@ def test_kv_and_sync(master):
 
 def test_speed_monitor_and_ckpt_sync(master):
     c0 = _client(master, 0)
-    now = time.time()
-    master.speed_monitor.collect_global_step(0, now - 10)
-    master.speed_monitor.collect_global_step(100, now)
+    # rate math uses the master-side monotonic arrival clock (injected
+    # here); the wall timestamp is watermark metadata only
+    master.speed_monitor.collect_global_step(0, now=90.0)
+    master.speed_monitor.collect_global_step(100, now=100.0)
     assert master.speed_monitor.running_speed == pytest.approx(10.0, rel=0.1)
     c0.report_ckpt_step(120)
     assert c0.get_min_ckpt_step() == 120

@@ -74,9 +74,9 @@ def test_autoscaler_scale_out_and_in():
     # both workers running & speed healthy → scale out by node_unit
     for i in range(2):
         jm.register_node(msgs.NodeMeta(node_id=i, node_rank=i))
-    now = time.time()
-    sm.collect_global_step(0, now - 10)
-    sm.collect_global_step(50, now)
+    # interval math runs on the injectable monotonic arrival clock
+    sm.collect_global_step(0, now=90.0)
+    sm.collect_global_step(50, now=100.0)
     asc.adjust_once()
     assert jm.worker_num == 4
     assert scaler.plans and scaler.plans[-1].worker_num == 4
@@ -145,6 +145,23 @@ def test_goodput_tracker():
     t.mark_stalled(now=530.0, at_step=90, accounted_from=400.0)
     t.mark_productive(now=540.0, step=91, report_ts=539.0)
     assert t.lost_seconds(now=540.0) == pytest.approx(140.0 + 20.0)
+
+
+def test_goodput_completion_freezes_lost_time():
+    from dlrover_tpu.master.job_metrics import GoodputTracker
+
+    t = GoodputTracker(now=0.0)
+    t.mark_productive(now=5.0)            # startup stall closes at t+5
+    # a worker finishes training at t+100 while a stall is open: the
+    # stall is charged up to completion, then accounting freezes
+    t.mark_stalled(now=90.0, at_step=60)
+    t.mark_completed(now=100.0)
+    assert t.lost_seconds(now=100.0) == pytest.approx(5.0 + 10.0)
+    # a peer death detected AFTER completion (heartbeat timeout racing
+    # teardown) opens no stall — its at_step equals the final step, so
+    # no report could ever close it
+    t.mark_stalled(now=120.0, at_step=60)
+    assert t.lost_seconds(now=500.0) == pytest.approx(15.0)
 
 
 def test_goodput_exported():

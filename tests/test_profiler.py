@@ -52,6 +52,78 @@ def test_kernel_census_finds_dots_and_collectives():
     assert s["num_matmul_buckets"] >= 1
 
 
+# canned HLO exercising exactly the parsing hazards from_compiled must
+# handle without a device: TPU async collective pairs (-start/-done),
+# fp8 wire dtypes, and bucket clustering by (op, dtype, shape)
+_CANNED_HLO = """\
+HloModule jit_train_step, entry_computation_layout={...}
+
+ENTRY %main.42 {
+  %p0 = f32[8,128]{1,0} parameter(0)
+  %p1 = bf16[128,1024]{1,0} parameter(1)
+  %dot.1 = bf16[8,1024]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}
+  %dot.2 = bf16[8,1024]{1,0} dot(%p0, %p1), rhs_contracting_dims={0}
+  %q = f8e4m3[8,128]{1,0} convert(%p0)
+  %dot.3 = f8e4m3[8,1024]{1,0} dot(%q, %kq)
+  %ar-start.1 = bf16[1024]{0} all-reduce-start(%g), replica_groups={{0,1}}
+  %ar-done.1 = bf16[1024]{0} all-reduce-done(%ar-start.1)
+  %ag.1 = f8e5m2[2048]{0} all-gather(%w8), dimensions={0}
+  %rs-start.1 = f32[512]{0} reduce-scatter-start(%acc)
+  %rs-done.1 = f32[512]{0} reduce-scatter-done(%rs-start.1)
+  ROOT %tuple = (bf16[8,1024]{1,0}) tuple(%dot.1)
+}
+"""
+
+
+class _FakeCompiled:
+    """Duck-typed stand-in for jax's Compiled (as_text + cost_analysis)."""
+
+    def __init__(self, hlo, cost=None):
+        self._hlo = hlo
+        self._cost = cost
+
+    def as_text(self):
+        return self._hlo
+
+    def cost_analysis(self):
+        return self._cost
+
+
+def test_kernel_census_canned_hlo_async_dedup_and_fp8():
+    census = KernelCensus.from_compiled(
+        _FakeCompiled(_CANNED_HLO, cost=[{"flops": 123.0,
+                                          "bytes accessed": 456.0}])
+    )
+    # async pairs count once: the -start is censused, the -done skipped
+    ar = [r for r in census.collectives if r.kind == "all-reduce"]
+    assert len(ar) == 1 and ar[0].count == 1
+    assert ar[0].dtype == "bf16" and ar[0].shape == (1024,)
+    rs = [r for r in census.collectives if r.kind == "reduce-scatter"]
+    assert len(rs) == 1 and rs[0].count == 1
+    # fp8 wire dtypes parse (both e4m3 and e5m2 variants)
+    ag = [r for r in census.collectives if r.kind == "all-gather"]
+    assert ag[0].dtype == "f8e5m2" and ag[0].shape == (2048,)
+    fp8_dots = [r for r in census.matmuls if r.dtype == "f8e4m3"]
+    assert len(fp8_dots) == 1
+    # identical (op, dtype, shape) dots cluster into one bucket
+    bf16_dots = [r for r in census.matmuls if r.dtype == "bf16"]
+    assert len(bf16_dots) == 1 and bf16_dots[0].count == 2
+    s = census.summary()
+    assert s["num_collective_buckets"] == 3
+    assert s["num_matmul_buckets"] == 2
+    # older-jax list-of-dict cost shape unwraps
+    assert s["flops"] == 123.0 and s["bytes_accessed"] == 456.0
+
+
+def test_kernel_census_cost_analysis_failure_is_nonfatal():
+    class _Broken(_FakeCompiled):
+        def cost_analysis(self):
+            raise RuntimeError("unsupported backend")
+
+    census = KernelCensus.from_compiled(_Broken(_CANNED_HLO))
+    assert census.matmuls and census.flops == 0.0
+
+
 def test_profile_compiled_reports_flops():
     w = jnp.ones((64, 64), jnp.float32)
     x = jnp.ones((8, 64), jnp.float32)
