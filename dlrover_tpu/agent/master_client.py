@@ -167,6 +167,38 @@ class MasterClient:
         resp = self._t.get(msgs.NumNodesWaitingRequest(rdzv_name=rdzv_name))
         return resp.waiting_num if resp else 0
 
+    def report_eviction(
+        self,
+        lost_dp_ranks,
+        dp_size: int,
+        deadline_s: float = 30.0,
+        reason: str = "",
+    ) -> bool:
+        """Announce departing dp ranks; the master answers future
+        ``get_reshard_plan`` polls with a live-reshard directive."""
+        return self._t.report(
+            msgs.EvictionNotice(
+                node_id=self.node_id,
+                node_rank=self.node_rank,
+                lost_dp_ranks=[int(r) for r in lost_dp_ranks],
+                dp_size=int(dp_size),
+                deadline_s=deadline_s,
+                reason=reason,
+            )
+        )
+
+    def get_reshard_plan(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> msgs.ReshardPlanResponse:
+        resp = self._t.get(
+            msgs.ReshardPlanRequest(
+                node_id=self.node_id,
+                node_rank=self.node_rank,
+                rdzv_name=rdzv_name,
+            )
+        )
+        return resp if resp else msgs.ReshardPlanResponse()
+
     def report_network_check_result(
         self, elapsed_time: float, succeeded: bool
     ) -> bool:

@@ -56,9 +56,9 @@ class CheckpointEngine:
         self._shm = None
         self._local_step = -1
         if use_agent is None:
-            from dlrover_tpu.common.multi_process import _socket_path
+            from dlrover_tpu.common.multi_process import broker_alive
 
-            use_agent = os.path.exists(_socket_path("queue_ckpt"))
+            use_agent = broker_alive("queue_ckpt")
         self._use_agent = use_agent
         if use_agent:
             self._queue = SharedQueueClient("ckpt")
@@ -159,19 +159,49 @@ class CheckpointEngine:
         self._persist_thread.start()
         return True
 
-    def wait_for_persist(self, timeout: float = 300.0):
+    def wait_for_persist(self, timeout: float = 300.0) -> bool:
+        """Block until the latest staged step is committed to storage.
+
+        Returns False — and publishes a failed ``persist_wait``
+        CheckpointRecord — when the commit does not land inside
+        ``timeout``; a silent return here previously let callers tear
+        down hosts believing the disk tier was durable."""
+        ok = True
         if self._use_agent:
             from dlrover_tpu.checkpoint.storage import read_tracker
 
             deadline = time.time() + timeout
-            while time.time() < deadline:
+            while True:
                 if read_tracker(self.ckpt_dir, self._storage) == (
                     self._local_step
                 ):
-                    return
+                    break
+                if time.time() >= deadline:
+                    ok = False
+                    break
                 time.sleep(0.1)
         elif self._persist_thread:
             self._persist_thread.join(timeout)
+            ok = not self._persist_thread.is_alive()
+        if not ok:
+            logger.error(
+                "persist of step %d did not commit within %.0fs; the "
+                "storage tier is STALE for this step",
+                self._local_step,
+                timeout,
+            )
+            hub = telemetry.get_hub()
+            if hub.enabled:
+                hub.publish(
+                    telemetry.CheckpointRecord(
+                        kind="persist_wait",
+                        step=self._local_step,
+                        seconds=timeout,
+                        ok=False,
+                        tier="storage",
+                    )
+                )
+        return ok
 
     def _persist_standalone(self, meta):
         from dlrover_tpu.checkpoint.saver import persist_pack
@@ -316,15 +346,39 @@ class CheckpointEngine:
                 min_step = self._client.get_min_ckpt_step()
                 if min_step > 0:
                     step = min_step
-            hit = self._replica.fetch(step=step)
-            if hit is None:
-                return None
-            got_step, pack = hit
-            idx = core.PackIndex()
-            idx.add_pack(memoryview(pack))
-            state = core.restore_tree(target, idx, shardings, partial=partial)
-            logger.info("restored step %d from peer replica", got_step)
-            return state
+            # one dead/corrupt donor must not abort the tier: exclude the
+            # failing holder and ask the next ring peer for the same pack
+            tried: set = set()
+            while True:
+                hit = self._replica.fetch(
+                    step=step, exclude=tuple(tried), with_holder=True
+                )
+                if hit is None:
+                    return None
+                got_step, pack, holder = hit
+                try:
+                    idx = core.PackIndex()
+                    idx.add_pack(memoryview(pack))
+                    state = core.restore_tree(
+                        target, idx, shardings, partial=partial
+                    )
+                except core.RestoreMismatchError:
+                    raise  # tree-contract violation: load() decides the fate
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "replica restore from holder rank %d failed; "
+                        "trying next peer",
+                        holder,
+                        exc_info=True,
+                    )
+                    tried.add(holder)
+                    continue
+                logger.info(
+                    "restored step %d from peer replica (holder rank %d)",
+                    got_step,
+                    holder,
+                )
+                return state
         except core.RestoreMismatchError:
             raise  # tree-contract violation: load() decides the fate
         except Exception:  # noqa: BLE001

@@ -417,19 +417,29 @@ class ReplicaManager:
     # ---- restore (fetch side) --------------------------------------------
 
     def fetch(
-        self, src: Optional[int] = None, step: Optional[int] = None
-    ) -> Optional[Tuple[int, bytes]]:
+        self,
+        src: Optional[int] = None,
+        step: Optional[int] = None,
+        exclude: Tuple[int, ...] = (),
+        with_holder: bool = False,
+    ):
         """Recover rank ``src``'s pack from whichever ring peer holds it.
 
         The holders of rank i's pack are its ring successors, so a replaced
-        host asks the nodes that rank i backed up onto. Returns
-        (step, pack bytes) or None.
+        host asks the nodes that rank i backed up onto. ``exclude`` skips
+        holder ranks that already failed a restore attempt (the caller's
+        next-peer retry); ``with_holder=True`` returns
+        (step, pack bytes, holder_rank) instead of (step, pack bytes).
+        Returns None when no usable holder remains.
         """
         src = self.process_index if src is None else src
         n = self.process_count
         r = min(self.config.num_replicas, n - 1)
         holders = [(src + i) % n for i in range(1, r + 1)]
+        skip = frozenset(exclude)
         for rank in holders:
+            if rank in skip:
+                continue
             if rank == self.process_index:
                 hit = self._store.get(src)
             else:
@@ -449,6 +459,8 @@ class ReplicaManager:
                 len(pack) / 1e6,
                 rank,
             )
+            if with_holder:
+                return got_step, pack, rank
             return got_step, pack
         return None
 

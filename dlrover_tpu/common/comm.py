@@ -9,6 +9,7 @@ Service: ``/dlrover_tpu.Master/report`` (fire-and-forget, returns Response)
          ``/dlrover_tpu.Master/get``    (request → typed response message)
 """
 
+import random
 import threading
 from concurrent import futures
 from typing import Callable, Optional
@@ -26,6 +27,21 @@ _GRPC_OPTIONS = [
     ("grpc.max_send_message_length", 64 * 1024 * 1024),
     ("grpc.max_receive_message_length", 64 * 1024 * 1024),
 ]
+
+# retry backoff: full-jittered exponential, bounded. A synchronized
+# retry storm after a master restart is exactly the moment the master
+# can least afford one — jitter decorrelates the herd.
+_BACKOFF_BASE_S = 0.5
+_BACKOFF_CAP_S = 15.0
+
+
+def _backoff_delay(attempt: int) -> float:
+    """Delay before retry ``attempt`` (0-based): exp growth from
+    ``_BACKOFF_BASE_S`` capped at ``_BACKOFF_CAP_S``, with uniform
+    jitter in [0.5, 1.0]× so concurrent clients decorrelate."""
+    return min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * 2**attempt) * random.uniform(
+        0.5, 1.0
+    )
 
 
 def _identity(b: bytes) -> bytes:
@@ -129,7 +145,7 @@ class MasterTransportClient:
                     grpc.StatusCode.DEADLINE_EXCEEDED,
                 ):
                     # master may be restarting / re-electing
-                    threading.Event().wait(min(2.0 * (attempt + 1), 15.0))
+                    threading.Event().wait(_backoff_delay(attempt))
                     continue
                 raise
         raise last_err  # type: ignore[misc]

@@ -287,6 +287,40 @@ class NetworkCheckStatusResponse:
     stragglers: List[int] = field(default_factory=list)
 
 
+@message
+class EvictionNotice:
+    """A node (or the scheduler, relayed by a worker) announces dp ranks
+    leaving the job — graceful eviction with a donation grace window."""
+
+    node_id: int = 0
+    node_rank: int = -1
+    lost_dp_ranks: List[int] = field(default_factory=list)
+    dp_size: int = 0             # dp size the notice is relative to
+    deadline_s: float = 30.0     # donation grace window
+    reason: str = ""
+
+
+@message
+class ReshardPlanRequest:
+    node_id: int = 0
+    node_rank: int = -1
+    rdzv_name: str = "elastic-training"
+
+
+@message
+class ReshardPlanResponse:
+    """The master's live-reshard directive. ``version`` increments per
+    directive; 0 means no reshard is pending."""
+
+    version: int = 0
+    rdzv_round: int = -1
+    dp_old: int = 0
+    dp_new: int = 0
+    lost_ranks: List[int] = field(default_factory=list)
+    deadline_s: float = 30.0
+    reason: str = ""
+
+
 # ---------------------------------------------------------------------------
 # Data sharding (reference: task_manager.py + sharding/client.py)
 # ---------------------------------------------------------------------------

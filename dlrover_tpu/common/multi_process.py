@@ -31,6 +31,34 @@ def _socket_path(name: str) -> str:
     return os.path.join(_SOCKET_DIR, f"{run_id}_{name}.sock")
 
 
+def broker_alive(name: str) -> bool:
+    """True iff a live broker is serving ``name``'s socket.
+
+    The socket FILE alone proves nothing: a SIGKILLed agent leaves its
+    socket behind, and a later process keying "is an agent hosting the
+    brokers?" off ``os.path.exists`` would run as a client against a
+    broker that will never answer. Probe with a real connect and unlink
+    the corpse on refusal so the namespace heals for the next caller.
+    """
+    path = _socket_path(name)
+    if not os.path.exists(path):
+        return False
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(1.0)
+        try:
+            s.connect(path)
+            return True
+        except OSError:
+            logger.warning(
+                "stale IPC socket %s (broker gone); removing it", path
+            )
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+
+
 def attach_shared_memory(name: str) -> shared_memory.SharedMemory:
     """Attach without registering in the resource tracker.
 

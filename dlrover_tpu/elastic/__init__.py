@@ -1,3 +1,24 @@
 from dlrover_tpu.elastic.sampler import ElasticDistributedSampler  # noqa: F401
 from dlrover_tpu.elastic.dataloader import ElasticDataLoader  # noqa: F401
 from dlrover_tpu.elastic.trainer import ElasticTrainer  # noqa: F401
+from dlrover_tpu.elastic.faults import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    InjectedKill,
+    TornDonation,
+    get_injector,
+    parse_faults,
+    reset_injector,
+)
+from dlrover_tpu.elastic.resharding import (  # noqa: F401
+    LiveResharder,
+    MigrationError,
+    PhaseBudgets,
+    PhaseDeadlineExceeded,
+    ReshardOutcome,
+    donation_plan,
+    migrate_flat,
+    reshard_flat,
+    reshard_train_state,
+    shard_intervals,
+)

@@ -1,0 +1,381 @@
+"""Live resharding of ZeRO-1 state across a dp-size change.
+
+Reference: ElaWave-style elastic-native failover (arxiv 2510.00606) — on
+membership change, re-plan the mesh and migrate sharded state between
+survivors instead of restarting from a checkpoint. Our wire format is the
+PackPlan flat bucket layout (``parallel/sharding.py``): optimizer state
+lives on flat leaves of shape ``(n_buckets, bucket_elems)`` sharded
+``P(None, "dp")``, so rank ``r`` holds columns ``[r*S, (r+1)*S)`` of every
+bucket, ``S = bucket_elems / dp``. Bucket geometry *changes* with dp
+(``bucket_elems`` is aligned to ``dp * BLOCK``), so resharding translates
+through canonical flat-stream coordinates: canonical coord ``c < total``
+lives at bucket ``c // E``, column ``c % E``; everything at and beyond
+``total`` is tail padding.
+
+Padding correctness: AdamW on a zero-padded region stays identically zero
+(grad 0 → mu = nu = 0 → update 0; param 0 → weight-decay term 0), so
+migrating only the canonical ``[0, total)`` stream and zero-filling the
+new plan's padding is bitwise-exact.
+
+The :class:`LiveResharder` runs the failover phases
+(detect / replan / migrate / rebuild / first_step) under per-phase
+deadline budgets with retry/backoff on retryable faults, emitting one
+``failover.reshard_<phase>`` trace span + ``ElasticEvent`` per phase and a
+final ``reshard_recovery`` event, and degrades to a caller-supplied
+fallback (the checkpoint tier ladder) instead of hanging.
+"""
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.elastic.faults import FaultInjector, InjectedKill, TornDonation
+from dlrover_tpu.parallel.sharding import PackPlan
+
+logger = get_logger(__name__)
+
+Interval = Tuple[int, int]
+
+
+class MigrationError(RuntimeError):
+    """Live migration cannot complete (e.g. a dead donor held the only
+    copy of a shard); not retryable — fall back to the checkpoint tiers."""
+
+
+class PhaseDeadlineExceeded(RuntimeError):
+    def __init__(self, phase: str, budget_s: float, elapsed_s: float):
+        super().__init__(
+            f"failover phase {phase!r} exceeded its {budget_s:.1f}s budget "
+            f"(took {elapsed_s:.1f}s)"
+        )
+        self.phase = phase
+        self.budget_s = budget_s
+        self.elapsed_s = elapsed_s
+
+
+# ---------------------------------------------------------------- intervals
+
+
+def shard_intervals(plan: PackPlan, rank: int) -> List[Interval]:
+    """Canonical-coordinate intervals held by ``rank`` under ``plan``.
+
+    One interval per bucket, clipped to ``plan.total`` (tail padding is
+    not part of the canonical stream); empty intervals dropped.
+    """
+    if not 0 <= rank < plan.dp:
+        raise ValueError(f"rank {rank} out of range for dp={plan.dp}")
+    E = plan.bucket_elems
+    S = E // plan.dp
+    out: List[Interval] = []
+    for i in range(plan.n_buckets):
+        a = i * E + rank * S
+        b = min(a + S, plan.total)
+        if a < b:
+            out.append((a, b))
+    return out
+
+
+def donation_plan(
+    old_plan: PackPlan, new_plan: PackPlan
+) -> Dict[Tuple[int, int], List[Interval]]:
+    """Pairwise ``(src_rank, dst_rank) -> canonical intervals`` to move.
+
+    Each interval is the intersection of one old-rank slice with one
+    new-rank slice, so it lies within a single bucket of *both* plans.
+    """
+    if old_plan.total != new_plan.total:
+        raise ValueError(
+            "plans describe different parameter streams: "
+            f"{old_plan.total} vs {new_plan.total} canonical elements"
+        )
+    new_iv = [shard_intervals(new_plan, d) for d in range(new_plan.dp)]
+    out: Dict[Tuple[int, int], List[Interval]] = {}
+    for src in range(old_plan.dp):
+        for a, b in shard_intervals(old_plan, src):
+            for dst in range(new_plan.dp):
+                for c, d in new_iv[dst]:
+                    lo, hi = max(a, c), min(b, d)
+                    if lo < hi:
+                        out.setdefault((src, dst), []).append((lo, hi))
+    for ivs in out.values():
+        ivs.sort()
+    return out
+
+
+# ---------------------------------------------------------------- migration
+
+
+def reshard_flat(flat, old_plan: PackPlan, new_plan: PackPlan) -> np.ndarray:
+    """Reference path: repack a flat ``(nb, E)`` leaf straight through the
+    canonical stream (no per-rank donation machinery)."""
+    arr = np.asarray(flat)
+    if arr.shape != (old_plan.n_buckets, old_plan.bucket_elems):
+        raise ValueError(
+            f"flat leaf shape {arr.shape} does not match old plan "
+            f"({old_plan.n_buckets}, {old_plan.bucket_elems})"
+        )
+    stream = arr.reshape(-1)[: old_plan.total]
+    out = np.zeros(new_plan.padded, dtype=arr.dtype)
+    out[: new_plan.total] = stream
+    return out.reshape(new_plan.n_buckets, new_plan.bucket_elems)
+
+
+def migrate_flat(
+    flat,
+    old_plan: PackPlan,
+    new_plan: PackPlan,
+    faults: Optional[FaultInjector] = None,
+    dead_ranks: Sequence[int] = (),
+) -> np.ndarray:
+    """Donation path: move a flat leaf from the old to the new layout via
+    per-``(src, dst)`` rank-local transfers.
+
+    ``dead_ranks`` are old-plan dp ranks whose HBM is gone (hard kill):
+    any donation sourced from one raises :class:`MigrationError` — the
+    shard is unrecoverable live and the caller must fall back to the
+    checkpoint tiers. A :class:`TornDonation` injected at the
+    ``"donation"`` point on a *surviving* donor is retryable and
+    propagates as-is.
+    """
+    src_global = np.asarray(flat)
+    if src_global.shape != (old_plan.n_buckets, old_plan.bucket_elems):
+        raise ValueError(
+            f"flat leaf shape {src_global.shape} does not match old plan "
+            f"({old_plan.n_buckets}, {old_plan.bucket_elems})"
+        )
+    dead = frozenset(dead_ranks)
+    E_old, E_new = old_plan.bucket_elems, new_plan.bucket_elems
+    S_old = E_old // old_plan.dp
+    S_new = E_new // new_plan.dp
+    out = np.zeros(
+        (new_plan.n_buckets, new_plan.bucket_elems), dtype=src_global.dtype
+    )
+    for (src, dst), intervals in sorted(donation_plan(old_plan, new_plan).items()):
+        if src in dead:
+            raise MigrationError(
+                f"donor dp rank {src} is dead; canonical intervals "
+                f"{intervals} are unrecoverable from survivors' HBM"
+            )
+        if faults is not None:
+            faults.at("donation", rank=src)
+        src_view = src_global[:, src * S_old : (src + 1) * S_old]
+        dst_view = out[:, dst * S_new : (dst + 1) * S_new]
+        for a, b in intervals:
+            i_old, col_src = divmod(a, E_old)
+            col_src -= src * S_old
+            i_new, col_dst = divmod(a, E_new)
+            col_dst -= dst * S_new
+            n = b - a
+            assert 0 <= col_src and col_src + n <= S_old, (a, b, src)
+            assert 0 <= col_dst and col_dst + n <= S_new, (a, b, dst)
+            dst_view[i_new, col_dst : col_dst + n] = src_view[
+                i_old, col_src : col_src + n
+            ]
+    return out
+
+
+def reshard_train_state(
+    state,
+    old_plan: PackPlan,
+    new_plan: PackPlan,
+    shardings_new,
+    faults: Optional[FaultInjector] = None,
+    dead_ranks: Sequence[int] = (),
+):
+    """Move a whole train state onto the new plan/mesh.
+
+    Flat optimizer leaves (shape ``(old nb, old E)``) migrate through
+    :func:`migrate_flat`; every other leaf (params, step, counts) is
+    device_put onto its new sharding unchanged. ``shardings_new`` must be
+    the new mesh's sharding tree (``state_shardings`` under the new plan).
+    """
+    import jax
+
+    flat_shape = (old_plan.n_buckets, old_plan.bucket_elems)
+
+    def move(leaf, shd):
+        arr = np.asarray(leaf)
+        if arr.shape == flat_shape:
+            arr = migrate_flat(
+                arr, old_plan, new_plan, faults=faults, dead_ranks=dead_ranks
+            )
+        return jax.device_put(arr, shd)
+
+    return jax.tree.map(move, state, shardings_new)
+
+
+# ------------------------------------------------------------ phase machine
+
+
+@dataclass
+class PhaseBudgets:
+    """Per-phase deadline budgets (seconds) for the failover state machine."""
+
+    detect_s: float = 15.0
+    replan_s: float = 15.0
+    migrate_s: float = 60.0
+    rebuild_s: float = 120.0
+    first_step_s: float = 120.0
+    fallback_s: float = 300.0
+
+    def for_phase(self, name: str) -> float:
+        return float(getattr(self, f"{name}_s", 60.0))
+
+
+@dataclass
+class ReshardOutcome:
+    ok: bool
+    path: str  # "live" | "fallback"
+    phase_seconds: Dict[str, float]
+    recovery_s: float
+    result: Any = None
+    failed_phase: str = ""
+    reason: str = ""
+
+
+class LiveResharder:
+    """Runs failover phases under budgets; degrades to a fallback.
+
+    ``execute`` threads each phase's return value into the next phase's
+    callable. Retryable faults (:class:`TornDonation` by default) are
+    retried with jittered exponential backoff inside the phase budget;
+    anything else — including :class:`MigrationError` and a blown
+    deadline — aborts the live path and runs ``fallback(exc)`` (the
+    checkpoint tier ladder) instead of hanging.
+    """
+
+    def __init__(
+        self,
+        budgets: Optional[PhaseBudgets] = None,
+        faults: Optional[FaultInjector] = None,
+        retries: int = 2,
+        backoff_base_s: float = 0.2,
+        backoff_cap_s: float = 5.0,
+        retryable: Tuple[type, ...] = (TornDonation,),
+    ):
+        self.budgets = budgets or PhaseBudgets()
+        self.faults = faults
+        self.retries = retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.retryable = retryable
+
+    def _run_phase(
+        self, name: str, fn: Callable[[Any], Any], prev: Any
+    ) -> Tuple[Any, float]:
+        from dlrover_tpu.observability import telemetry
+        from dlrover_tpu.observability.tracing import get_tracer
+
+        budget = self.budgets.for_phase(name)
+        span = get_tracer().span(f"failover.reshard_{name}", budget_s=budget)
+        t0 = time.monotonic()
+        ok = False
+        attempt = 0
+        err = ""
+        try:
+            while True:
+                try:
+                    out = fn(prev)
+                    break
+                except self.retryable as e:
+                    attempt += 1
+                    elapsed = time.monotonic() - t0
+                    if attempt > self.retries or elapsed >= budget:
+                        raise
+                    delay = min(
+                        self.backoff_cap_s,
+                        self.backoff_base_s * 2 ** (attempt - 1),
+                    ) * random.uniform(0.5, 1.0)
+                    delay = min(delay, max(0.0, budget - elapsed))
+                    logger.warning(
+                        "phase %s attempt %d failed (%s); retrying in %.2fs",
+                        name,
+                        attempt,
+                        e,
+                        delay,
+                    )
+                    time.sleep(delay)
+            elapsed = time.monotonic() - t0
+            if elapsed > budget:
+                raise PhaseDeadlineExceeded(name, budget, elapsed)
+            ok = True
+            return out, elapsed
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            span.end(ok=ok, retries=attempt)
+            # clock the event off the monotonic phase window, not the
+            # span (the tracer may be disabled and its NullSpan reports 0)
+            secs = time.monotonic() - t0
+            hub = telemetry.get_hub()
+            if hub.enabled:
+                hub.publish(
+                    telemetry.ElasticEvent(
+                        kind=f"reshard_{name}",
+                        seconds=secs,
+                        detail=f"ok={ok} retries={attempt}"
+                        + (f" err={err}" if err else ""),
+                    )
+                )
+
+    def execute(
+        self,
+        phases: Sequence[Tuple[str, Callable[[Any], Any]]],
+        fallback: Optional[Callable[[BaseException], Any]] = None,
+    ) -> ReshardOutcome:
+        from dlrover_tpu.observability import telemetry
+
+        phase_seconds: Dict[str, float] = {}
+        t0 = time.monotonic()
+        prev: Any = None
+        outcome: Optional[ReshardOutcome] = None
+        current = ""
+        try:
+            for name, fn in phases:
+                current = name
+                prev, secs = self._run_phase(name, fn, prev)
+                phase_seconds[name] = secs
+            outcome = ReshardOutcome(
+                ok=True,
+                path="live",
+                phase_seconds=phase_seconds,
+                recovery_s=time.monotonic() - t0,
+                result=prev,
+            )
+        except InjectedKill:
+            raise  # process death: nothing to degrade to in this process
+        except Exception as e:
+            reason = f"{type(e).__name__}: {e}"
+            failed = getattr(e, "phase", "") or current
+            logger.error(
+                "live reshard failed (%s); degrading to fallback tier", reason
+            )
+            if fallback is None:
+                raise
+            prev, secs = self._run_phase("fallback", lambda _: fallback(e), None)
+            phase_seconds["fallback"] = secs
+            outcome = ReshardOutcome(
+                ok=True,
+                path="fallback",
+                phase_seconds=phase_seconds,
+                recovery_s=time.monotonic() - t0,
+                result=prev,
+                failed_phase=failed,
+                reason=reason,
+            )
+        hub = telemetry.get_hub()
+        if hub.enabled:
+            hub.publish(
+                telemetry.ElasticEvent(
+                    kind="reshard_recovery",
+                    seconds=outcome.recovery_s,
+                    detail=f"path={outcome.path}"
+                    + (f" reason={outcome.reason}" if outcome.reason else ""),
+                )
+            )
+        return outcome

@@ -84,6 +84,22 @@ class ElasticTrainer:
                     detail=f"replicas={replicas} accum={self.grad_accum}",
                 )
             )
+            if effective != self.global_batch_size:
+                # the LR schedule assumes global_batch_size; any drift in
+                # the effective batch silently reshapes the schedule, so
+                # surface it as a metric, not just a one-shot warning
+                hub.publish(
+                    telemetry.NumericEvent(
+                        kind="effective_batch_drift",
+                        value=float(effective - self.global_batch_size),
+                        detail=(
+                            f"global={self.global_batch_size} "
+                            f"micro={self.micro_batch_size} "
+                            f"replicas={replicas} accum={self.grad_accum} "
+                            f"effective={effective}"
+                        ),
+                    )
+                )
 
     @property
     def local_batch_size(self) -> int:

@@ -49,6 +49,9 @@ class RendezvousManager:
         self._start_waiting_time = 0.0
         self._coordinator_port = 7010
         self._alive_nodes: set = set()
+        # live-reshard directive (see plan_reshard); version 0 = none
+        self._reshard: Optional[Dict] = None
+        self._reshard_version = 0
 
     # ---- config ---------------------------------------------------------
 
@@ -79,20 +82,100 @@ class RendezvousManager:
             self._alive_nodes.add(node_rank)
 
     def remove_alive_node(self, node_rank: int):
-        """A node died: drop it and force a new round if it was in-world."""
+        """A node died: drop it and force a new round if it was in-world.
+
+        Exception: when a pending live-reshard directive already names
+        this rank as lost, the survivors are migrating state in place —
+        excise the rank from the sealed world without tearing the round
+        down (the whole point of the live path is not to restart)."""
         with self._lock:
             self._alive_nodes.discard(node_rank)
             self._waiting.pop(node_rank, None)
-            if node_rank in self._world:
+            if node_rank not in self._world:
+                return
+            directive = self._reshard
+            if directive is not None and node_rank in directive["lost_ranks"]:
+                self._world.pop(node_rank)
                 logger.info(
-                    "%s: node %s left the sealed world; next joins start "
-                    "round %d",
+                    "%s: node %s excised from sealed world by reshard "
+                    "directive v%d; survivors keep round %d",
                     self.name,
                     node_rank,
-                    self._rdzv_round + 1,
+                    directive["version"],
+                    self._rdzv_round,
                 )
-                self._world = {}
-                self._world_coordinator = ""
+                return
+            logger.info(
+                "%s: node %s left the sealed world; next joins start "
+                "round %d",
+                self.name,
+                node_rank,
+                self._rdzv_round + 1,
+            )
+            self._world = {}
+            self._world_coordinator = ""
+
+    # ---- live reshard ---------------------------------------------------
+
+    def plan_reshard(
+        self,
+        lost_dp_ranks: List[int],
+        dp_size: int,
+        deadline_s: float = 30.0,
+        reason: str = "",
+    ) -> int:
+        """Issue a live-reshard directive: survivors migrate ZeRO-1
+        shards to the shrunken dp layout instead of restarting.
+
+        Returns the directive version (monotonic, starts at 1). Lost
+        ranks already in the sealed world are excised immediately —
+        the round stays sealed for the survivors."""
+        lost = sorted(set(int(r) for r in lost_dp_ranks))
+        with self._lock:
+            dp_old = int(dp_size)
+            dp_new = dp_old - len(lost)
+            if dp_new <= 0:
+                raise ValueError(
+                    f"reshard would leave no survivors: dp={dp_old}, "
+                    f"lost={lost}"
+                )
+            self._reshard_version += 1
+            self._reshard = {
+                "version": self._reshard_version,
+                "rdzv_round": self._rdzv_round,
+                "dp_old": dp_old,
+                "dp_new": dp_new,
+                "lost_ranks": lost,
+                "deadline_s": float(deadline_s),
+                "reason": reason,
+            }
+            for r in lost:
+                self._world.pop(r, None)
+            get_tracer().instant(
+                "failover.reshard_plan",
+                rdzv=self.name,
+                version=self._reshard_version,
+                dp_old=dp_old,
+                dp_new=dp_new,
+                lost=len(lost),
+            )
+            logger.info(
+                "%s: reshard directive v%d: dp %d -> %d, lost=%s (%s)",
+                self.name,
+                self._reshard_version,
+                dp_old,
+                dp_new,
+                lost,
+                reason or "eviction",
+            )
+            return self._reshard_version
+
+    def get_reshard_plan(self) -> Dict:
+        """The pending directive, or ``{"version": 0}`` when none."""
+        with self._lock:
+            if self._reshard is None:
+                return {"version": 0}
+            return dict(self._reshard)
 
     # ---- join / poll ----------------------------------------------------
 

@@ -230,6 +230,38 @@ class MasterServicer:
             )
         return True
 
+    def _report_eviction(self, m: msgs.EvictionNotice) -> bool:
+        """A worker announced departing dp ranks: issue the live-reshard
+        directive so survivors migrate in-HBM state instead of
+        restarting from a checkpoint."""
+        mgr = self.rdzv_managers.get(RendezvousName.TRAINING)
+        if mgr is None:
+            return False
+        try:
+            version = mgr.plan_reshard(
+                m.lost_dp_ranks,
+                m.dp_size,
+                deadline_s=m.deadline_s,
+                reason=m.reason,
+            )
+        except ValueError as e:
+            logger.warning(
+                "rejecting eviction notice from node %d: %s", m.node_id, e
+            )
+            return False
+        if self.telemetry_hub is not None and self.telemetry_hub.enabled:
+            self.telemetry_hub.publish(
+                telemetry.ElasticEvent(
+                    kind="eviction_notice",
+                    node_id=m.node_id,
+                    detail=(
+                        f"v{version} lost={m.lost_dp_ranks} "
+                        f"dp={m.dp_size} {m.reason}"
+                    ).strip(),
+                )
+            )
+        return True
+
     def _report_kv(self, m: msgs.KeyValuePair) -> bool:
         if self.kv_store:
             self.kv_store.set(m.key, m.value)
@@ -290,6 +322,7 @@ class MasterServicer:
         "DatasetShardParams": _report_dataset,
         "GlobalStepRecord": _report_global_step,
         "NetworkCheckResult": _report_network_check,
+        "EvictionNotice": _report_eviction,
         "KeyValuePair": _report_kv,
         "SyncJoin": _report_sync_join,
         "CheckpointStepSync": _report_ckpt_step,
@@ -347,6 +380,23 @@ class MasterServicer:
             group=group,
             world={str(k): v for k, v in world.items()},
             coordinator=coord,
+        )
+
+    def _get_reshard_plan(self, m: msgs.ReshardPlanRequest):
+        mgr = self.rdzv_managers.get(m.rdzv_name)
+        if mgr is None:
+            return msgs.ReshardPlanResponse()
+        plan = mgr.get_reshard_plan()
+        if not plan.get("version"):
+            return msgs.ReshardPlanResponse()
+        return msgs.ReshardPlanResponse(
+            version=plan["version"],
+            rdzv_round=plan["rdzv_round"],
+            dp_old=plan["dp_old"],
+            dp_new=plan["dp_new"],
+            lost_ranks=list(plan["lost_ranks"]),
+            deadline_s=plan["deadline_s"],
+            reason=plan["reason"],
         )
 
     def _get_num_nodes_waiting(self, m: msgs.NumNodesWaitingRequest):
@@ -460,6 +510,7 @@ class MasterServicer:
         "JoinRendezvousRequest": _get_join_rdzv,
         "CommWorldRequest": _get_comm_world,
         "NetworkCheckStatusRequest": _get_network_status,
+        "ReshardPlanRequest": _get_reshard_plan,
         "NumNodesWaitingRequest": _get_num_nodes_waiting,
         "TaskRequest": _get_task,
         "ShardCheckpointRequest": _get_shard_ckpt,

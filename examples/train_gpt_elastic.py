@@ -12,6 +12,7 @@ restart.
 """
 
 import argparse
+import json
 import sys
 
 sys.path.insert(0, ".")  # repo-root run: `python examples/...`
@@ -26,13 +27,22 @@ from dlrover_tpu.agent.master_client import build_master_client
 from dlrover_tpu.agent.sharding_client import ShardingClient
 from dlrover_tpu.checkpoint import Checkpointer, StorageType
 from dlrover_tpu.checkpoint.checkpointer import state_template
+from dlrover_tpu.elastic import (
+    ElasticTrainer,
+    LiveResharder,
+    PhaseBudgets,
+    get_injector,
+    reshard_train_state,
+)
 from dlrover_tpu.models import get_config
 from dlrover_tpu.parallel import MeshConfig, build_mesh
+from dlrover_tpu.parallel import sharding as shd
 from dlrover_tpu.train import (
     TrainStepBuilder,
     batch_sharding,
     init_train_state,
     make_optimizer,
+    state_shardings,
 )
 from dlrover_tpu.train.data_utils import form_global_batch, iter_shards_spmd
 from dlrover_tpu.train.distributed import init_distributed
@@ -46,6 +56,125 @@ def synthetic_batch(start: int, end: int, batch: int, seq: int, vocab: int):
         "tokens": jnp.asarray(data[:, :-1], jnp.int32),
         "targets": jnp.asarray(data[:, 1:], jnp.int32),
     }
+
+
+def _live_reshard(args, client, ckpt, cfg, opt, comm, ctx, trainer, state):
+    """Graceful host eviction: survivors keep their in-HBM state, the
+    master issues a reshard directive, and training resumes at the new
+    dp size without a restart or a disk restore. Every phase runs under
+    a deadline budget; any failure degrades to the checkpoint ladder."""
+    old_mesh = ctx["mesh"]
+    old_dp = old_mesh.shape["dp"]
+    lost = sorted(
+        int(r) for r in args.evict_dp_ranks.split(",") if r.strip()
+    )
+    if not lost:
+        lost = list(range(old_dp // 2, old_dp))
+    old_plan = ctx["builder"]._plan
+    old_shardings = jax.tree.map(lambda x: x.sharding, state)
+
+    client.report_eviction(lost, dp_size=old_dp, reason="drill eviction")
+
+    def detect(_):
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            directive = client.get_reshard_plan()
+            if directive.version > 0:
+                return directive
+            time.sleep(0.05)
+        raise RuntimeError("reshard directive never arrived")
+
+    def replan(directive):
+        lost_set = set(directive.lost_ranks if directive else lost)
+        survivors = [
+            d
+            for i, d in enumerate(old_mesh.devices.flat)
+            if i not in lost_set
+        ]
+        new_mesh = build_mesh(MeshConfig(dp=-1), devices=survivors)
+        nb = TrainStepBuilder(cfg, new_mesh, opt, comm=comm)
+        assert nb.update_sharding, nb.update_sharding_reason
+        return {
+            "mesh": new_mesh,
+            "plan": nb._plan,
+            "shardings": state_shardings(cfg, new_mesh, opt, comm=comm),
+        }
+
+    def migrate(rp):
+        rp["state"] = reshard_train_state(
+            state, old_plan, rp["plan"], rp["shardings"],
+            faults=get_injector(),
+        )
+        return rp
+
+    def rebuild(rp):
+        ctx["mesh"] = rp["mesh"]
+        trainer.on_membership_change()
+        return rp
+
+    def first_step(rp):
+        batch = form_global_batch(
+            synthetic_batch(
+                int(rp["state"]["step"]) * args.batch,
+                0,
+                args.batch,
+                args.seq,
+                cfg.vocab_size,
+            ),
+            batch_sharding(rp["mesh"]),
+        )
+        rp["state"], metrics = trainer.step(rp["state"], batch)
+        print(
+            f"[reshard] first step loss={float(metrics['loss']):.4f}",
+            flush=True,
+        )
+        return rp
+
+    def fallback(exc):
+        # tier ladder: restore at the OLD geometry from the checkpoint
+        # stack, then repack to the survivor layout (no HBM donors
+        # involved, so a dead donor cannot poison this path)
+        print(
+            f"[reshard] live path failed ({exc!r}); "
+            "falling back to checkpoint ladder",
+            flush=True,
+        )
+        restored = ckpt.load_checkpoint(
+            state_template(state), shardings=old_shardings
+        )
+        if restored is None:
+            raise RuntimeError("no checkpoint tier answered")
+        rp = replan(None)
+        rp["state"] = reshard_train_state(
+            restored, old_plan, rp["plan"], rp["shardings"]
+        )
+        return first_step(rebuild(rp))
+
+    out = LiveResharder(budgets=PhaseBudgets()).execute(
+        [
+            ("detect", detect),
+            ("replan", replan),
+            ("migrate", migrate),
+            ("rebuild", rebuild),
+            ("first_step", first_step),
+        ],
+        fallback=fallback,
+    )
+    print(
+        "[reshard] done "
+        + json.dumps(
+            {
+                "path": out.path,
+                "recovery_s": round(out.recovery_s, 3),
+                "dp": f"{old_dp}->{ctx['mesh'].shape['dp']}",
+                "phases": {
+                    k: round(v, 3) for k, v in out.phase_seconds.items()
+                },
+            }
+        ),
+        flush=True,
+    )
+    return out.result["state"]
 
 
 def main():
@@ -63,6 +192,21 @@ def main():
         help="build a hybrid multi-slice mesh: every hosts-per-slice "
         "processes form one emulated ICI slice, dp rides DCN across "
         "slices (num_slices = process_count // hosts_per_slice)",
+    )
+    p.add_argument(
+        "--zero1", action="store_true",
+        help="ZeRO-1 update sharding (bucketed flat optimizer state); "
+        "required for --evict-at",
+    )
+    p.add_argument(
+        "--evict-at", type=int, default=-1,
+        help="at this step, simulate a graceful host eviction and "
+        "live-reshard onto the survivors (no restart, no disk restore)",
+    )
+    p.add_argument(
+        "--evict-dp-ranks", default="",
+        help="comma-separated dp ranks lost at --evict-at "
+        "(default: the top half of the mesh)",
     )
     args = p.parse_args()
 
@@ -86,7 +230,39 @@ def main():
     cfg = get_config(args.model, max_seq=args.seq)
     opt = make_optimizer(learning_rate=1e-3, warmup_steps=5, decay_steps=1000)
 
-    state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+    # --zero1 routes stepping through ElasticTrainer so a live reshard
+    # can rebuild the jitted step for the new (replicas, grad_accum)
+    comm = (
+        shd.CommConfig(update_sharding=True, bucket_mb=0.05)
+        if args.zero1
+        else None
+    )
+    ctx = {"mesh": mesh, "builder": None}
+
+    def build_step(accum):
+        b = TrainStepBuilder(
+            cfg, ctx["mesh"], opt, grad_accum=accum, comm=comm
+        )
+        ctx["builder"] = b
+        return b.build()
+
+    trainer = None
+    if args.zero1:
+        micro = max(1, args.batch // mesh.shape["dp"])
+        trainer = ElasticTrainer(
+            args.batch,
+            micro,
+            build_step,
+            data_replicas_fn=lambda: ctx["mesh"].shape["dp"],
+        )
+        run_step = trainer.step
+        state = init_train_state(
+            jax.random.key(0), cfg, mesh, opt,
+            comm=ctx["builder"].comm_resolved,
+        )
+    else:
+        run_step = build_step(1)
+        state = init_train_state(jax.random.key(0), cfg, mesh, opt)
     ckpt = Checkpointer(args.ckpt_dir, master_client=client)
     restored = ckpt.load_checkpoint(
         state_template(state),
@@ -95,8 +271,6 @@ def main():
     if restored is not None:
         state = restored
         print(f"[worker] resumed from step {int(state['step'])}", flush=True)
-
-    step_fn = TrainStepBuilder(cfg, mesh, opt).build()
     # SPMD: one shard = one GLOBAL step (batch rows × processes); rank 0
     # fetches from the master and broadcasts so all processes stay in
     # lockstep; each process slices its own rows out of the shard.
@@ -110,6 +284,7 @@ def main():
 
     bsh = batch_sharding(mesh)
     t0 = time.time()
+    evicted = False
     for start, end in iter_shards_spmd(sharding):
         local_start = start + jax.process_index() * args.batch
         step = int(state["step"])
@@ -120,6 +295,17 @@ def main():
         ):
             print(f"[worker] simulating crash at step {step}", flush=True)
             os._exit(17)
+        if (
+            args.evict_at >= 0
+            and trainer is not None
+            and not evicted
+            and step >= args.evict_at
+        ):
+            state = _live_reshard(
+                args, client, ckpt, cfg, opt, comm, ctx, trainer, state
+            )
+            evicted = True
+            bsh = batch_sharding(ctx["mesh"])
         batch = form_global_batch(
             synthetic_batch(
                 local_start,
@@ -130,7 +316,7 @@ def main():
             ),
             bsh,
         )
-        state, metrics = step_fn(state, batch)
+        state, metrics = run_step(state, batch)
         step = int(state["step"])
         client.report_global_step(step)
         if step % args.ckpt_every == 0:

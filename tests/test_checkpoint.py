@@ -413,3 +413,76 @@ def test_restore_tree_returns_owned_buffers(monkeypatch):
     np.testing.assert_array_equal(
         np.asarray(out_s["params"]["w"]), np.asarray(state["params"]["w"])
     )
+
+
+def test_wait_for_persist_timeout_publishes_failure(tmp_path):
+    """A blown persist deadline must return False and leave a failed
+    ``persist_wait`` CheckpointRecord — a silent return here let callers
+    tear down hosts believing the disk tier was durable."""
+    import threading
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.observability import telemetry
+
+    telemetry.reset_hub()
+    hub = telemetry.configure_hub()
+    events = []
+    hub.subscribe(events.append)
+    try:
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), use_agent=False)
+        engine._local_step = 42
+        # a persist that will not finish inside the deadline
+        engine._persist_thread = threading.Thread(
+            target=time.sleep, args=(1.5,), daemon=True
+        )
+        engine._persist_thread.start()
+        assert engine.wait_for_persist(timeout=0.05) is False
+        fails = [
+            e
+            for e in events
+            if isinstance(e, telemetry.CheckpointRecord)
+            and e.kind == "persist_wait"
+        ]
+        assert len(fails) == 1
+        assert fails[0].ok is False
+        assert fails[0].step == 42 and fails[0].tier == "storage"
+        # once the thread finishes, the wait succeeds and stays quiet
+        engine._persist_thread.join()
+        assert engine.wait_for_persist(timeout=0.05) is True
+        assert len([e for e in events if e.kind == "persist_wait"]) == 1
+    finally:
+        telemetry.reset_hub()
+
+
+def test_stale_broker_socket_heals_to_standalone(tmp_path, monkeypatch):
+    """A SIGKILLed agent leaves its IPC socket file behind; the next
+    engine in that namespace must NOT become a client of the dead
+    broker — it probes the socket, unlinks the corpse, and runs
+    standalone."""
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.common import multi_process as mp
+
+    monkeypatch.setenv("DLROVER_TPU_RUN_ID", f"stale{os.getpid()}")
+    path = mp._socket_path("queue_ckpt")
+    # the corpse: a bound-then-abandoned unix socket (no listener)
+    import socket as socket_mod
+
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.bind(path)
+    s.close()
+    assert os.path.exists(path)
+
+    eng = CheckpointEngine(str(tmp_path))
+    assert eng._use_agent is False
+    assert not os.path.exists(path), "stale socket should be unlinked"
+
+    # a LIVE broker still routes the engine into client mode
+    from dlrover_tpu.common.multi_process import SharedQueue
+
+    broker = SharedQueue("ckpt")
+    try:
+        assert mp.broker_alive("queue_ckpt") is True
+        eng2 = CheckpointEngine(str(tmp_path))
+        assert eng2._use_agent is True
+    finally:
+        broker.close()

@@ -401,3 +401,118 @@ def test_prewarm_produces_the_exact_step_executable(tmp_path, monkeypatch):
         "the live job compiled a train step the prewarm missed: "
         f"{sorted(steps_after - prewarmed_steps)}"
     )
+
+
+def test_elastic_trainer_shrink_grow_keeps_global_batch():
+    """8→6→8 hosts with global batch 48: grad_accum re-derives to 3→4→3
+    and the EFFECTIVE batch — what the LR schedule sees — never moves."""
+    from dlrover_tpu.observability import telemetry
+
+    replicas = {"n": 8}
+    telemetry.reset_hub()
+    hub = telemetry.configure_hub()
+    events = []
+    hub.subscribe(events.append)
+    try:
+        t = ElasticTrainer(
+            global_batch_size=48,
+            micro_batch_size=2,
+            build_step=lambda accum: (lambda s, b: (s, {})),
+            data_replicas_fn=lambda: replicas["n"],
+        )
+        seen = [(t.grad_accum, t.grad_accum * 2 * replicas["n"])]
+        for n in (6, 8):
+            replicas["n"] = n
+            t.on_membership_change()
+            seen.append((t.grad_accum, t.grad_accum * 2 * n))
+        assert seen == [(3, 48), (4, 48), (3, 48)]
+        # no drift: the schedule's global batch was preserved throughout
+        assert not [e for e in events if e.kind == "effective_batch_drift"]
+    finally:
+        telemetry.reset_hub()
+
+
+def test_elastic_trainer_drift_published_as_numeric_event():
+    """global=50 is not reachable with micro=2 × replicas=8: accum
+    rounds up to 4 → effective 64. The +14 drift must surface as a
+    NumericEvent, not just a log line."""
+    from dlrover_tpu.observability import telemetry
+
+    telemetry.reset_hub()
+    hub = telemetry.configure_hub()
+    events = []
+    hub.subscribe(events.append)
+    try:
+        t = ElasticTrainer(
+            global_batch_size=50,
+            micro_batch_size=2,
+            build_step=lambda accum: (lambda s, b: (s, {})),
+            data_replicas_fn=lambda: 8,
+        )
+        assert t.grad_accum == 4
+        drifts = [e for e in events if e.kind == "effective_batch_drift"]
+        assert len(drifts) == 1
+        assert isinstance(drifts[0], telemetry.NumericEvent)
+        assert drifts[0].value == 14.0  # 64 - 50
+        assert "effective=64" in drifts[0].detail
+    finally:
+        telemetry.reset_hub()
+
+
+@pytest.mark.parametrize("drop_last", [False, True])
+@pytest.mark.parametrize("shuffle", [False, True])
+def test_sampler_mid_epoch_eviction_no_loss_no_dup(drop_last, shuffle):
+    """Property: an eviction mid-epoch (num_replicas 8→6, every rank
+    re-assigned) neither drops nor duplicates samples. drop_last=False
+    may duplicate only pad indices (tail tiling for lockstep SPMD);
+    drop_last=True may drop only a tail shorter than the new world."""
+    rng = np.random.RandomState(11)
+    for trial in range(12):
+        n = int(rng.randint(50, 300))
+        r1 = int(rng.choice([4, 6, 8]))
+        r2 = int(rng.choice([2, 3, 4, 6]))
+        bs = int(rng.randint(1, 5))
+        steps = int(rng.randint(1, max(2, n // (bs * r1))))
+
+        ranks1 = [
+            ElasticDistributedSampler(
+                n, num_replicas=r1, rank=r, shuffle=shuffle,
+                seed=7, drop_last=drop_last,
+            )
+            for r in range(r1)
+        ]
+        consumed = []
+        iters = [iter(s) for s in ranks1]
+        for _ in range(steps):
+            for it in iters:
+                for _ in range(bs):
+                    consumed.append(next(it))
+        for s in ranks1:
+            for _ in range(steps):
+                s.record_batch(bs)
+        state = ranks1[0].state_dict()
+        assert state["completed"] == steps * bs * r1
+
+        remaining = []
+        for r in range(r2):
+            s = ElasticDistributedSampler(
+                n, num_replicas=r2, rank=r, shuffle=shuffle,
+                seed=0, drop_last=drop_last,
+            )
+            s.load_state_dict(state)
+            remaining.extend(list(s))
+
+        consumed_set, remaining_set = set(consumed), set(remaining)
+        # nothing consumed pre-eviction is replayed post-eviction
+        assert not (consumed_set & remaining_set), (trial, drop_last)
+        if drop_last:
+            # only a tail shorter than the new world may be dropped
+            missed = set(range(n)) - consumed_set - remaining_set
+            assert len(missed) < r2, (trial, len(missed), r2)
+            assert len(remaining) == len(remaining_set)
+        else:
+            # full coverage; duplicates are exactly the lockstep pad
+            assert consumed_set | remaining_set == set(range(n)), trial
+            assert len(remaining) - len(remaining_set) == (
+                (-(n - state["completed"])) % r2
+            ), trial

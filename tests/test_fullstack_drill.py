@@ -32,6 +32,7 @@ from elastic_harness import (
     drain as _drain,
     drain_now as _drain_now,
     kill_tree as _kill_tree,
+    launch_agent as _launch_agent,
     make_env as _env,
     start_master as _start_master,
 )
@@ -476,7 +477,7 @@ def test_fullstack_elasticity_drill(monkeypatch, tmp_path):
         # one time-sorted JSONL of every process's spans; the worker-kill
         # failover must decompose into detect → (persist) → rendezvous →
         # restore → first-step, with all three roles on the timeline
-        trace_out = os.path.join(REPO, "DRILL_r07_trace.jsonl")
+        trace_out = os.path.join(REPO, "DRILL_r08_trace.jsonl")
         events = merge_trace_dir(trace_dir, out_path=trace_out)
         phases, win = _failover_phases(
             events, t_kill_worker, t_kill_worker + recovery_worker_s
@@ -518,7 +519,7 @@ def test_fullstack_elasticity_drill(monkeypatch, tmp_path):
         }
         out_path = os.environ.get(
             "DLROVER_TPU_DRILL_ARTIFACT",
-            os.path.join(REPO, "DRILL_r07.json"),
+            os.path.join(REPO, "DRILL_r08.json"),
         )
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=1)
@@ -553,3 +554,153 @@ def test_fullstack_elasticity_drill(monkeypatch, tmp_path):
             if p.is_alive():
                 p.terminate()
             p.join(timeout=10)
+
+
+@pytest.mark.slow
+def test_live_reshard_eviction_drill(tmp_path):
+    """Host-eviction stage: a mid-training ``EvictionNotice`` turns into
+    a master reshard directive; the worker live-reshards dp 8→4 from
+    in-HBM state (survivors donate ZeRO-1 shards over the PackPlan
+    wire), the step rebuilds, and training finishes at the new size.
+    The happy path must land inside the recovery budget WITHOUT a
+    storage-tier restore, and the artifact records per-phase seconds."""
+    run_id = f"reshard{os.getpid()}"
+    tel_dir = str(tmp_path / "telemetry")
+    os.makedirs(tel_dir, exist_ok=True)
+    master = agent = None
+    lines = []
+    try:
+        master, mq, mlines, maddr = _start_master(
+            run_id,
+            env_extra={"DLROVER_TPU_TELEMETRY_DIR": tel_dir},
+        )
+        agent = _launch_agent(
+            run_id,
+            0,
+            maddr,
+            train_args=[
+                "--steps", "12", "--batch", "8", "--seq", "16",
+                "--zero1", "--evict-at", "6",
+                "--ckpt-dir", str(tmp_path / "ckpt"),
+            ],
+            nnodes="1:1",
+            env_extra={
+                # the eviction is emulated INSIDE one worker: 8 virtual
+                # CPU devices so the mesh can shrink 8 -> 4 in-process
+                # (the harness default of one device per worker would
+                # leave nothing to reshard)
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                "DLROVER_TPU_TELEMETRY_DIR": tel_dir,
+                # hermetic compile cache: jaxlib's CPU backend segfaults
+                # re-executing a persistent-cache-deserialized executable
+                # compiled for a device SUBSET (the dp=4 survivor mesh),
+                # so never share the cache across drill runs
+                "JAX_COMPILATION_CACHE_DIR": str(tmp_path / "jit_cache"),
+            },
+        )
+        q = _drain(agent)
+        done = _collect(
+            q,
+            lines,
+            until=lambda l: "[reshard] done" in l,
+            deadline=time.time() + 420,
+        )
+        assert done, "worker never reported reshard:\n" + "".join(
+            lines[-40:]
+        )
+        summary = json.loads(done.split("[reshard] done", 1)[1])
+        assert summary["path"] == "live", summary
+        assert summary["dp"] == "8->4", summary
+        assert summary["recovery_s"] < RECOVERY_BUDGET_S, summary
+        for phase in (
+            "detect", "replan", "migrate", "rebuild", "first_step"
+        ):
+            assert phase in summary["phases"], summary
+
+        # training must CONTINUE at dp=4 to the end — the reshard is a
+        # recovery, not a shutdown
+        assert _collect(
+            q,
+            lines,
+            until=lambda l: "[worker] done" in l,
+            deadline=time.time() + 240,
+        ), "worker never finished after reshard:\n" + "".join(lines[-40:])
+
+        # flight recorder: rehydrate the telemetry stream and check the
+        # phase events landed and the disk was never read
+        from dlrover_tpu.observability import telemetry as tel
+
+        records = []
+        for fname in sorted(os.listdir(tel_dir)):
+            with open(os.path.join(tel_dir, fname)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(tel.from_json(line))
+                    except Exception:  # noqa: BLE001 — torn tail line
+                        continue
+        elastic = [r for r in records if isinstance(r, tel.ElasticEvent)]
+        kinds = [r.kind for r in elastic]
+        assert "eviction_notice" in kinds, kinds
+        phase_s = {}
+        for phase in (
+            "detect", "replan", "migrate", "rebuild", "first_step"
+        ):
+            ev = [r for r in elastic if r.kind == f"reshard_{phase}"]
+            assert ev, (phase, kinds)
+            assert "ok=True" in ev[-1].detail, ev[-1]
+            phase_s[phase] = round(ev[-1].seconds, 3)
+        recovery = [r for r in elastic if r.kind == "reshard_recovery"]
+        assert recovery, kinds
+        assert "path=live" in recovery[-1].detail, recovery[-1]
+        assert recovery[-1].seconds < RECOVERY_BUDGET_S, recovery[-1]
+        # the defining property of tier 0: NO successful storage-tier
+        # restore anywhere in the run (engine only stamps tier="storage"
+        # when the disk actually answered)
+        disk_restores = [
+            r
+            for r in records
+            if isinstance(r, tel.CheckpointRecord)
+            and r.kind == "restore"
+            and r.tier == "storage"
+        ]
+        assert not disk_restores, disk_restores
+
+        # ---- artifact: append the eviction stage ----------------------
+        out_path = os.environ.get(
+            "DLROVER_TPU_DRILL_ARTIFACT",
+            os.path.join(REPO, "DRILL_r08.json"),
+        )
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except (OSError, ValueError):
+            # test-order independence: a minimal shell when the main
+            # drill has not written the artifact yet
+            artifact = {
+                "drill": "test_fullstack_elasticity_drill",
+                "failures": [],
+                "recovery_budget_s": RECOVERY_BUDGET_S,
+            }
+        artifact.setdefault("failures", [])
+        artifact["failures"] = [
+            f
+            for f in artifact["failures"]
+            if f.get("kind") != "host_eviction_live_reshard"
+        ] + [
+            {
+                "kind": "host_eviction_live_reshard",
+                "recovery_s": round(float(summary["recovery_s"]), 2),
+                "phases": phase_s,
+                "restore_tier": "live",
+            }
+        ]
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"\n[drill] {json.dumps(artifact['failures'][-1])}")
+    finally:
+        _kill_tree(agent)
+        if master is not None:
+            master.kill()

@@ -120,3 +120,60 @@ def test_bench_imports_are_slow_or_local():
         "import inside the test, or mark the whole module slow):\n"
         + "\n".join(rogue)
     )
+
+
+def _test_functions(tree):
+    """Top-level (incl. class-nested) test functions with their decorator
+    lists."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.startswith("test_"):
+                out.append(node)
+    return out
+
+
+def _fn_slow_marked(fn) -> bool:
+    for dec in fn.decorator_list:
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.Attribute) and sub.attr == "slow":
+                return True
+    return False
+
+
+def test_process_spawning_fault_tests_are_slow():
+    """Files importing ``elastic_harness`` at module level spawn real
+    master/agent/worker PROCESSES — the fault-injection drills. Every
+    test in such a file must carry ``slow`` (module ``pytestmark`` or a
+    per-function mark): a process-spawning eviction/kill drill that
+    slips into tier-1 blows its time budget and flakes under load.
+    In-process injectors (elastic/faults.py used directly) stay fast
+    and belong in tier-1.
+    """
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        imports_harness = False
+        for node in tree.body:  # module level only, by design
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            if any(
+                n == "elastic_harness" or n.startswith("elastic_harness.")
+                for n in names
+            ):
+                imports_harness = True
+                break
+        if not imports_harness or _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if not _fn_slow_marked(fn):
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "process-spawning fault-injection tests not marked slow (add "
+        "@pytest.mark.slow, or a module-level pytestmark):\n"
+        + "\n".join(rogue)
+    )

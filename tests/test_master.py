@@ -588,3 +588,71 @@ def test_agent_registration_carries_slice_placement(monkeypatch):
     with pytest.raises(RuntimeError):
         agent2.run()
     assert seen["slice_index"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Live-reshard directive (eviction → survivors migrate instead of restart)
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_plan_versioning_and_world_excision():
+    from dlrover_tpu.master.rdzv_manager import RendezvousManager
+
+    mgr = RendezvousManager()
+    mgr.update_rdzv_params(min_nodes=1, max_nodes=8, waiting_timeout=0.0)
+    for r in range(4):
+        mgr.join_rendezvous(node_id=r, node_rank=r, local_world_size=1)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert set(world) == {0, 1, 2, 3}
+    assert mgr.get_reshard_plan() == {"version": 0}
+
+    v = mgr.plan_reshard([2, 3], dp_size=4, deadline_s=10.0, reason="drill")
+    assert v == 1
+    plan = mgr.get_reshard_plan()
+    assert plan["dp_old"] == 4 and plan["dp_new"] == 2
+    assert plan["lost_ranks"] == [2, 3]
+    # lost ranks are excised but the round stays sealed for survivors
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert set(world) == {0, 1}
+    # the prune callback firing for a directive-listed rank is a no-op
+    mgr.remove_alive_node(3)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert set(world) == {0, 1}
+    # a SURVIVOR dying is a real failure: the world tears down
+    mgr.remove_alive_node(0)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert world == {}
+
+    # evicting everyone is rejected; versions stay monotonic
+    with pytest.raises(ValueError):
+        mgr.plan_reshard([0, 1], dp_size=2)
+    assert mgr.plan_reshard([1], dp_size=2) == 2
+
+
+def test_eviction_notice_issues_reshard_directive(master):
+    c0, c1 = _client(master, 0), _client(master, 1)
+    c0.join_rendezvous(4)
+    c1.join_rendezvous(4)
+    _, _, world, _ = c0.get_comm_world()
+    assert len(world) == 2
+    assert c0.get_reshard_plan().version == 0
+
+    assert c0.report_eviction(
+        [1], dp_size=2, deadline_s=5.0, reason="maintenance"
+    )
+    plan = c1.get_reshard_plan()
+    assert plan.version == 1
+    assert plan.dp_old == 2 and plan.dp_new == 1
+    assert plan.lost_ranks == [1]
+    assert plan.deadline_s == 5.0
+    # survivor keeps the sealed round with rank 1 excised
+    _, _, world, _ = c0.get_comm_world()
+    assert world == {0: 4}
+    # the evicted node failing afterwards must not tear the round down
+    c1.report_node_status(NodeStatus.FAILED, exit_reason="evicted")
+    time.sleep(0.1)
+    _, _, world, _ = c0.get_comm_world()
+    assert world == {0: 4}
+
+    # an eviction that would leave no survivors is refused
+    assert not c0.report_eviction([0, 1], dp_size=2)

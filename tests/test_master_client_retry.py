@@ -1,0 +1,119 @@
+"""RPC retry backoff: bounded exponential with jitter, flaky-server
+recovery (satellite of the live-resharding PR — a master hiccup during a
+rendezvous round must surface as a delayed success, not a failure)."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common import messages as msgs
+from dlrover_tpu.common.comm import (
+    MasterTransportClient,
+    MasterTransportServer,
+    find_free_port,
+)
+
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+def test_backoff_delay_bounded_and_growing():
+    for attempt in range(12):
+        raw = min(comm._BACKOFF_CAP_S, comm._BACKOFF_BASE_S * 2**attempt)
+        for _ in range(20):
+            d = comm._backoff_delay(attempt)
+            assert 0.5 * raw <= d <= raw
+            assert d <= comm._BACKOFF_CAP_S
+    # jitter: repeated draws are not all identical
+    draws = {comm._backoff_delay(3) for _ in range(20)}
+    assert len(draws) > 1
+
+
+def test_call_retries_unavailable_with_backoff(monkeypatch):
+    delays = []
+    monkeypatch.setattr(
+        comm, "_backoff_delay", lambda a: delays.append(a) or 0.0
+    )
+    client = MasterTransportClient("localhost:1", retries=5)
+    calls = {"n": 0}
+
+    def flaky(payload, timeout):
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise _FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+        return payload
+
+    assert client._call(flaky, b"ping") == b"ping"
+    assert calls["n"] == 4
+    assert delays == [0, 1, 2]  # attempt index fed to the backoff
+
+
+def test_call_gives_up_after_retry_budget(monkeypatch):
+    monkeypatch.setattr(comm, "_backoff_delay", lambda a: 0.0)
+    client = MasterTransportClient("localhost:1", retries=3)
+
+    def always_down(payload, timeout):
+        raise _FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+
+    with pytest.raises(grpc.RpcError):
+        client._call(always_down, b"ping")
+
+
+def test_call_non_retryable_raises_immediately(monkeypatch):
+    monkeypatch.setattr(
+        comm, "_backoff_delay", lambda a: pytest.fail("must not back off")
+    )
+    client = MasterTransportClient("localhost:1", retries=5)
+    calls = {"n": 0}
+
+    def denied(payload, timeout):
+        calls["n"] += 1
+        raise _FakeRpcError(grpc.StatusCode.PERMISSION_DENIED)
+
+    with pytest.raises(grpc.RpcError):
+        client._call(denied, b"ping")
+    assert calls["n"] == 1
+
+
+class _EchoServicer:
+    def report(self, msg):
+        return True
+
+    def get(self, msg):
+        return None
+
+
+def test_flaky_server_call_survives_late_start(monkeypatch):
+    """Nothing listens when the call starts; the server comes up ~0.5s
+    later and the retried RPC succeeds instead of surfacing the outage."""
+    monkeypatch.setattr(comm, "_BACKOFF_BASE_S", 0.1)
+    port = find_free_port()
+    holder = {}
+
+    def start_late():
+        time.sleep(0.5)
+        server = MasterTransportServer(_EchoServicer(), port=port)
+        server.start()
+        holder["server"] = server
+
+    t = threading.Thread(target=start_late, daemon=True)
+    t.start()
+    client = MasterTransportClient(
+        f"localhost:{port}", timeout_s=5.0, retries=20
+    )
+    try:
+        t0 = time.monotonic()
+        assert client.report(msgs.HeartbeatReport(node_id=1))
+        assert time.monotonic() - t0 >= 0.3  # it actually waited the outage out
+    finally:
+        t.join()
+        client.close()
+        holder["server"].stop()

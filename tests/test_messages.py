@@ -92,3 +92,18 @@ def test_grpc_transport_concurrent():
         assert len(servicer.reported) == 160
     finally:
         server.stop()
+
+
+def test_roundtrip_reshard_messages():
+    m = msgs.EvictionNotice(
+        node_id=2, node_rank=2, lost_dp_ranks=[4, 5], dp_size=8,
+        deadline_s=12.5, reason="maintenance",
+    )
+    assert msgs.deserialize(msgs.serialize(m)) == m
+    r = msgs.ReshardPlanResponse(
+        version=3, rdzv_round=1, dp_old=8, dp_new=6, lost_ranks=[6, 7],
+    )
+    out = msgs.deserialize(msgs.serialize(r))
+    assert out == r and out.lost_ranks == [6, 7]
+    req = msgs.ReshardPlanRequest(node_id=1, node_rank=1)
+    assert msgs.deserialize(msgs.serialize(req)) == req

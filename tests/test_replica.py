@@ -180,3 +180,72 @@ def test_fetch_wrong_step_returns_none():
     finally:
         m0.close()
         m1.close()
+
+
+def test_fetch_exclude_and_with_holder():
+    """exclude skips a holder that failed restore; with_holder reports
+    which ring peer served the pack (the next-peer retry in
+    engine._load_from_replica is built on both)."""
+    m1 = _mk_manager(1, 3, num_replicas=2)
+    m2 = _mk_manager(2, 3, num_replicas=2)
+    m0 = _mk_manager(0, 3, peers={1: m1.addr, 2: m2.addr}, num_replicas=2)
+    try:
+        engine = CheckpointEngine("/tmp/unused", use_agent=False, replica=m0)
+        assert engine.save_to_memory(7, _state())
+        m0.wait_backup()
+        assert wait_peer_steps(m1, {0: 7}, timeout=10)
+        assert wait_peer_steps(m2, {0: 7}, timeout=10)
+        got = m0.fetch(with_holder=True)
+        assert got is not None and got[0] == 7 and got[2] == 1
+        got2 = m0.fetch(exclude=(1,), with_holder=True)
+        assert got2 is not None and got2[0] == 7 and got2[2] == 2
+        assert m0.fetch(exclude=(1, 2)) is None
+    finally:
+        m0.close()
+        m1.close()
+        m2.close()
+
+
+class _FlakyReplica:
+    """Holder 1 serves a corrupt pack; holder 2 a good one."""
+
+    def __init__(self, good_pack, step):
+        self.calls = []
+        self._good = good_pack
+        self._step = step
+
+    def fetch(self, src=None, step=None, exclude=(), with_holder=False):
+        self.calls.append(tuple(sorted(exclude)))
+        if 1 not in exclude:
+            return self._step, b"garbage-not-a-pack", 1
+        if 2 not in exclude:
+            return self._step, self._good, 2
+        return None
+
+
+def test_load_from_replica_retries_next_peer():
+    from dlrover_tpu.checkpoint import core
+
+    state = _state()
+    entries, payload = core.plan_pack(state)
+    header = core.header_bytes(9, entries)
+    buf = bytearray(core.pack_size(header, payload))
+    core.write_pack(memoryview(buf), 9, state, entries, header=header)
+
+    replica = _FlakyReplica(bytes(buf), 9)
+    engine = CheckpointEngine("/tmp/unused", use_agent=False, replica=replica)
+    out = engine._load_from_replica(state_template(state), None, 9)
+    assert out is not None
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(state["w"]))
+    # first try hit the corrupt holder, retry excluded it
+    assert replica.calls == [(), (1,)]
+
+
+def test_load_from_replica_gives_up_when_all_holders_corrupt():
+    class _AllBad:
+        def fetch(self, src=None, step=None, exclude=(), with_holder=False):
+            nxt = next((r for r in (1, 2) if r not in exclude), None)
+            return None if nxt is None else (9, b"garbage", nxt)
+
+    engine = CheckpointEngine("/tmp/unused", use_agent=False, replica=_AllBad())
+    assert engine._load_from_replica(state_template(_state()), None, 9) is None
