@@ -425,7 +425,8 @@ def _ici_gbps(device_kind: str) -> float:
     return 400.0
 
 
-def overlap_report(stats, step_us, device_kind="", bwd_frac=2 / 3):
+def overlap_report(stats, step_us, device_kind="", bwd_frac=2 / 3,
+                   grad_accum=1, update_mode=""):
     """Exposed-vs-hidden time estimate for one step's collectives.
 
     For each collective class, wire time = payload bytes × ring factor
@@ -437,14 +438,25 @@ def overlap_report(stats, step_us, device_kind="", bwd_frac=2 / 3):
     exposure is computed per window and attributed to ops pro rata by
     their wire time. An ESTIMATE in the same counterfactual spirit as
     ``_nonmatmul_us_per_step``, not a profile: it exists so the bench
-    record shows whether the ZeRO-1 wire is latency we pay or latency
+    record shows whether the ZeRO wire is latency we pay or latency
     we hide, and how that moves when bucket size / wire dtype change.
+
+    ``update_mode="zero2"`` with ``grad_accum > 1`` scales the gradient
+    wire (reduce-scatter / all-to-all) by ``grad_accum``: ZeRO-2 pays
+    the exchange once per MICROBATCH (the scattered accumulator is what
+    frees the full-grad buffer), and ``collective_stats`` counts the
+    accum scan's body once. ZeRO-1 defers to one exchange per step, so
+    its bytes pass through unscaled.
 
     Returns ``{"per_op": {op: {wire_us, window_us, exposed_us}},
     "exposed_us_total", "hidden_us_total", "assumed_ici_gbps"}``.
     """
     gbps = _ici_gbps(device_kind)
-    by_op = stats.get("bytes_by_op", {})
+    by_op = dict(stats.get("bytes_by_op", {}))
+    if update_mode == "zero2" and grad_accum > 1:
+        for op in ("reduce-scatter", "all-to-all"):
+            if op in by_op:
+                by_op[op] = by_op[op] * grad_accum
     windows = {
         "bwd": step_us * bwd_frac,
         "fwd": step_us * (1 - bwd_frac),
@@ -485,8 +497,9 @@ def overlap_report(stats, step_us, device_kind="", bwd_frac=2 / 3):
     }
 
 
-def suggest_bucket_mb(total_grad_bytes, device_kind="", launch_us=5.0):
-    """Bucket size for the ZeRO-1 reduce-scatter wire, from the same
+def suggest_bucket_mb(total_grad_bytes, device_kind="", launch_us=5.0,
+                      grad_accum=1, update_mode=""):
+    """Bucket size for the ZeRO reduce-scatter wire, from the same
     bandwidth model as ``overlap_report``.
 
     Two constraints pull against each other: each bucket's wire time
@@ -495,16 +508,74 @@ def suggest_bucket_mb(total_grad_bytes, device_kind="", launch_us=5.0):
     should be ≥ 4 buckets so the first reduce-scatters issue while the
     backward tail still computes (one mega-bucket serializes the whole
     wire after the last gradient — see sharding.exchange_buckets'
-    reverse issue order). Clamped to [1, 64] MB; the result is a
+    reverse issue order). Under ``update_mode="zero2"`` the exchange
+    runs once per microbatch, so the launch cost recurs ``grad_accum``
+    times per step against the SAME per-exchange payload — the
+    launch-bound floor scales with ``grad_accum`` (bigger buckets,
+    fewer total launches), while the ≥4-bucket cap still uses the
+    per-microbatch bytes. Clamped to [1, 64] MB; the result is a
     starting point for ``CommConfig.bucket_mb``, not an oracle.
     """
     gbps = _ici_gbps(device_kind)
-    # smallest bucket whose wire time is >= 4x the launch latency
-    min_bytes = 4.0 * launch_us * gbps * 1e3
+    passes = grad_accum if (update_mode == "zero2" and grad_accum > 1) else 1
+    # smallest bucket whose wire time is >= 4x the per-step launch cost
+    min_bytes = 4.0 * launch_us * passes * gbps * 1e3
     mb = max(1.0, min_bytes / 2**20)
-    # but keep at least 4 buckets in flight
+    # but keep at least 4 buckets in flight per exchange
     mb = min(mb, max(1.0, total_grad_bytes / 4 / 2**20))
     return round(min(mb, 64.0), 2)
+
+
+def drill_recovery_metric(path=None):
+    """The latest eviction drill's ``recovery_s``, for the bench record.
+
+    MFU says how fast training goes; ``recovery_s`` says how long a
+    failure stops it. They are produced by different drivers into
+    different artifacts (BENCH_*.json vs DRILL_*.json), so the bench
+    record embeds the drill's number and the two trajectories share one
+    comparable entry. Reads the drill artifact
+    (``DLROVER_TPU_DRILL_ARTIFACT``, else the newest ``DRILL_r*.json``
+    beside this file); returns ``None`` when no drill has run — the
+    record then shows the metric as unmeasured rather than omitting it.
+    """
+    import glob
+
+    if path is None:
+        path = os.environ.get("DLROVER_TPU_DRILL_ARTIFACT")
+    if path is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        candidates = sorted(glob.glob(os.path.join(here, "DRILL_r*.json")))
+        path = candidates[-1] if candidates else None
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        return None
+    failures = artifact.get("failures") or []
+    if not failures:
+        return None
+    worst = max(
+        (f for f in failures if "recovery_s" in f),
+        key=lambda f: float(f["recovery_s"]),
+        default=None,
+    )
+    if worst is None:
+        return None
+    out = {
+        "recovery_s": float(worst["recovery_s"]),
+        "kind": worst.get("kind", ""),
+        "budget_s": artifact.get("recovery_budget_s"),
+        "n_failures": len(failures),
+    }
+    evict = [
+        f for f in failures
+        if f.get("kind") == "host_eviction_live_reshard"
+    ]
+    if evict:
+        out["live_reshard_recovery_s"] = float(evict[-1]["recovery_s"])
+    return out
 
 
 def run_config(name, batch, seq, remat, steps=30, warmup=3,
@@ -649,6 +720,9 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         ),
         "collectives": stats,
         "overlap": overlap,
+        # the elastic half of the trajectory: how long the last drilled
+        # failure stopped training (None until a drill has run)
+        "elastic_recovery": drill_recovery_metric(),
     }
 
 

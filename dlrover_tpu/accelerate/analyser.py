@@ -99,15 +99,20 @@ def analyse(
     if (
         getattr(plan, "update_sharding", False)
         and sizes["dp"] > 1
-        and param_shards == 1
+        and sizes["pp"] == 1
         and not plan.offload_opt_state
     ):
-        # ZeRO-1 weight-update sharding: each dp rank owns 1/dp of the
-        # flattened optimizer state, padded up to whole comm buckets
+        # ZeRO update sharding: each dp rank owns 1/dp of the flattened
+        # optimizer state, padded up to whole comm buckets
         # (parallel.sharding.PackPlan). Same gate as
-        # resolve_update_sharding — it only engages on pure-dp meshes.
+        # resolve_update_sharding — it engages on pure-dp and hybrid
+        # dp×fsdp / dp×tp meshes (pp still falls back). On hybrid
+        # meshes the flat state is REPLICATED over the model axes and
+        # sharded over dp only, so the moments' divisor is dp, not
+        # dp × param_shards — fsdp's per-leaf opt sharding is traded
+        # for the flat dp shard.
         bucket_b = getattr(plan, "comm_bucket_mb", 4.0) * 2**20
-        opt_b = opt_b / sizes["dp"] + slots * bucket_b
+        opt_b = n * slots * opt_dtype_b / sizes["dp"] + slots * bucket_b
     if offload_streams(plan):
         # moments live in pinned host memory and the streamed update
         # (train/optimizer.py streamed_offload_adamw) serializes the

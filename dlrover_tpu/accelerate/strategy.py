@@ -14,7 +14,7 @@ shape as the reference's strategy objects (auto/accelerate.py:246-305).
 import dataclasses
 import json
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from dlrover_tpu.parallel.mesh import MeshConfig
 
@@ -43,9 +43,14 @@ class AccelerationPlan:
     grad_accum: int = 1
     # sequence parallelism flavour: none | ulysses | ring
     sp_mode: str = "none"
-    # ZeRO-1 weight-update sharding over dp (parallel.sharding.CommConfig):
-    # reduce-scatter grads, 1/dp optimizer shard, all-gather params
-    update_sharding: bool = False
+    # ZeRO update sharding over dp (parallel.sharding.CommConfig):
+    # reduce-scatter grads, 1/dp optimizer shard, all-gather params.
+    # False = off; "zero1" = deferred exchange (one reduce-scatter per
+    # step, full grad accumulator); "zero2" = per-microbatch scattered
+    # accumulation (no full-gradient residency across the accum scan);
+    # True = legacy alias for "zero2". Engages on pure-dp AND hybrid
+    # dp×fsdp / dp×tp meshes (train_step.resolve_update_sharding).
+    update_sharding: Union[bool, str] = False
     # gradient-collective bucket size (MB of f32 payload)
     comm_bucket_mb: float = 4.0
     # wire dtype for the bucketed exchange: float32 | bfloat16 | int8
@@ -60,7 +65,7 @@ class AccelerationPlan:
         from dlrover_tpu.parallel.sharding import CommConfig
 
         return CommConfig(
-            update_sharding=True,
+            update_sharding=self.update_sharding,
             bucket_mb=self.comm_bucket_mb,
             wire_dtype=self.comm_wire_dtype,
             wire_dtype_dcn=self.comm_wire_dtype_dcn,
@@ -177,8 +182,25 @@ def _zero1(plan: AccelerationPlan, cfg: Dict) -> None:
     buckets, each rank steps 1/dp of the optimizer state, params
     all-gather back. Wire dtype of the bucketed exchange is tunable
     (float32 is bitwise vs the unsharded step; bfloat16/int8 use
-    per-bucket scales, EQuARX-style)."""
-    plan.update_sharding = cfg.get("enabled", True)
+    per-bucket scales, EQuARX-style). Under gradient accumulation the
+    exchange is deferred: one reduce-scatter of the full accumulated
+    gradient per step (classic stage 1 — gradients stay unsharded)."""
+    plan.update_sharding = "zero1" if cfg.get("enabled", True) else False
+    _comm_tuning(plan, cfg)
+
+
+def _zero2(plan: AccelerationPlan, cfg: Dict) -> None:
+    """ZeRO-2 gradient + weight-update sharding over dp (reference:
+    atorch zero_optimization stage 2). Same bucketed wire and 1/dp
+    optimizer shard as ``zero1``, but each microbatch's gradients are
+    reduce-scattered immediately and accumulated in the scattered 1/dp
+    form — no full-gradient buffer survives the accum scan, trading
+    (grad_accum−1) extra reduce-scatters for grad memory."""
+    plan.update_sharding = "zero2" if cfg.get("enabled", True) else False
+    _comm_tuning(plan, cfg)
+
+
+def _comm_tuning(plan: AccelerationPlan, cfg: Dict) -> None:
     if "bucket_mb" in cfg:
         plan.comm_bucket_mb = float(cfg["bucket_mb"])
     if "wire_dtype" in cfg:
@@ -215,6 +237,7 @@ OPTIMIZATION_LIBRARY: Dict[str, Callable[[AccelerationPlan, Dict], None]] = {
     "optimizer": _optimizer,
     "data_parallel": _data_parallel,
     "zero1": _zero1,
+    "zero2": _zero2,
     "mixed_parallel": _mixed_parallel,
 }
 

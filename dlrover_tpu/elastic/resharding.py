@@ -192,8 +192,27 @@ def reshard_train_state(
     :func:`migrate_flat`; every other leaf (params, step, counts) is
     device_put onto its new sharding unchanged. ``shardings_new`` must be
     the new mesh's sharding tree (``state_shardings`` under the new plan).
+
+    Plans spanning a hybrid mesh (``mesh_axes`` beyond ``("dp",)``) are
+    REFUSED: the donation plan maps flat intervals between dp shards
+    only, but on dp×fsdp / dp×tp meshes the params feeding those
+    intervals are additionally sharded over the model axes, so a
+    rank-local HBM donation cannot reconstruct the canonical stream
+    without cross-axis gathers the live path doesn't perform. Raising
+    :class:`MigrationError` here sends :class:`LiveResharder` down the
+    checkpoint-tier fallback ladder (``reshard_recovery path=fallback``
+    with this reason) instead of migrating silently-wrong shards.
     """
     import jax
+
+    for which, plan in (("old", old_plan), ("new", new_plan)):
+        axes = getattr(plan, "mesh_axes", ("dp",))
+        if tuple(axes) != ("dp",):
+            raise MigrationError(
+                f"live donation refused: {which} PackPlan spans mesh axes "
+                f"{tuple(axes)}; in-HBM donation is only defined over a "
+                f"pure-dp mesh — fall back to the checkpoint ladder"
+            )
 
     flat_shape = (old_plan.n_buckets, old_plan.bucket_elems)
 

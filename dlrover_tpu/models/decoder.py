@@ -707,6 +707,20 @@ def run_trunk(
             x, auxs = jax.lax.scan(
                 scan_fn8, x, (layers, fp8_layers, jnp.arange(n_layers))
             )
+        elif shd.unroll_layer_scans():
+            # hybrid-mesh update-sharding region: the stacked layer
+            # params are auto-axis-sharded (fsdp/tp) and the 0.4.x
+            # partitioner check-fails on a scan over them inside a
+            # partial-manual region — unroll the layer loop instead
+            aux_list = []
+            for i in range(n_layers):
+                layer = jax.tree.map(lambda t: t[i], layers)
+                r = jax.random.fold_in(rng, i) if rng is not None else None
+                x, a_i = body(x, layer, positions, rng=r, rope=rope)
+                aux_list.append(a_i)
+            auxs = jax.tree.map(
+                lambda *ls: jnp.stack(ls), *aux_list
+            )
         else:
             # fp8="current" (when set) is baked into the body partial
 

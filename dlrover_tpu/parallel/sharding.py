@@ -133,6 +133,15 @@ def _drop_axes(entry: MeshAxes, names: set) -> MeshAxes:
 
 _WIRE_DTYPES = ("float32", "bfloat16", "int8")
 
+# update_sharding mode strings. "zero1" defers the gradient exchange to
+# one reduce-scatter per step (full local gradient accumulates on-rank);
+# "zero2" reduce-scatters every microbatch so only the 1/dp shard of the
+# summed gradient is ever resident across the accumulation loop. The
+# legacy boolean True maps to "zero2" — that per-microbatch exchange IS
+# the behaviour the boolean has always selected, so existing configs
+# stay bitwise identical.
+_UPDATE_MODES = ("zero1", "zero2")
+
 
 @dataclass(frozen=True)
 class CommConfig:
@@ -150,6 +159,13 @@ class CommConfig:
     latency-hiding scheduler can start shipping early buckets while the
     tail of backward still computes.
 
+    ``update_sharding`` also accepts a mode string: ``"zero2"`` (what
+    ``True`` means — gradients are reduce-scattered per microbatch, so
+    only the 1/dp shard is resident across the grad-accum loop) or
+    ``"zero1"`` (accumulate the full local gradient, one deferred
+    reduce-scatter per step — fewer collectives when accumulating, at
+    the cost of full-gradient residency).
+
     ``wire_dtype`` is the on-the-wire encoding of the dp gradient
     exchange: "float32" (bitwise-exact psum_scatter), "bfloat16" (half
     the bytes), or "int8" (EQuARX-style, arxiv 2506.17615: blockwise
@@ -158,12 +174,20 @@ class CommConfig:
     compression pays for itself.
     """
 
-    update_sharding: bool = False
+    update_sharding: Union[bool, str] = False
     bucket_mb: float = 4.0
     wire_dtype: str = "float32"
     wire_dtype_dcn: Optional[str] = None
 
     def __post_init__(self):
+        if (
+            not isinstance(self.update_sharding, bool)
+            and self.update_sharding not in _UPDATE_MODES
+        ):
+            raise ValueError(
+                f"update_sharding must be a bool or one of {_UPDATE_MODES},"
+                f" got {self.update_sharding!r}"
+            )
         if self.wire_dtype not in _WIRE_DTYPES:
             raise ValueError(
                 f"wire_dtype must be one of {_WIRE_DTYPES}, "
@@ -183,6 +207,15 @@ class CommConfig:
     @property
     def bucket_bytes(self) -> int:
         return int(self.bucket_mb * 2**20)
+
+    @property
+    def update_mode(self) -> str:
+        """Resolved mode string: "" (off), "zero1", or "zero2"."""
+        if self.update_sharding is False:
+            return ""
+        if self.update_sharding is True:
+            return "zero2"
+        return self.update_sharding
 
     def wire_for(self, mesh: Mesh, axis: str = "dp") -> str:
         """Wire dtype for the gradient exchange over ``axis``."""
@@ -211,16 +244,29 @@ def in_update_sharding_region() -> bool:
     return getattr(_REGION, "depth", 0) > 0
 
 
+def unroll_layer_scans() -> bool:
+    """True inside a PARTIAL-manual update-sharding region (hybrid
+    dp×fsdp / dp×tp meshes): the jax 0.4.x partitioner check-fails on a
+    ``lax.scan`` whose xs carry auto-axis-sharded values (the stacked
+    layer params), so the model trunk must unroll its layer loop."""
+    return in_update_sharding_region() and getattr(
+        _REGION, "unroll_scans", False
+    )
+
+
 @contextlib.contextmanager
-def update_sharding_region(tie_zero=None):
+def update_sharding_region(tie_zero=None, unroll_scans=False):
     prev_zero = getattr(_REGION, "tie_zero", None)
+    prev_unroll = getattr(_REGION, "unroll_scans", False)
     _REGION.depth = getattr(_REGION, "depth", 0) + 1
     _REGION.tie_zero = tie_zero
+    _REGION.unroll_scans = unroll_scans
     try:
         yield
     finally:
         _REGION.depth -= 1
         _REGION.tie_zero = prev_zero
+        _REGION.unroll_scans = prev_unroll
 
 
 def tied_head_table(table: jax.Array) -> jax.Array:
@@ -260,6 +306,13 @@ class PackPlan:
     block boundaries. For tied embeddings the table must sit at offset
     0 (bucket-aligned): the split-off head cotangent is packed into its
     own ``n_tie_buckets`` rows and added shard-wise after the exchange.
+
+    ``mesh_axes`` records which mesh axes the plan was built under:
+    ``("dp",)`` for the pure-dp layout, or e.g. ``("dp", "fsdp")`` when
+    the update shards over the dp axis of a hybrid mesh. The flat
+    stream coordinates are only canonical within one mesh_axes family —
+    consumers that repack across geometries (elastic/resharding.py)
+    key off this field to refuse streams they cannot line up.
     """
 
     shapes: Tuple[Tuple[int, ...], ...]
@@ -271,6 +324,7 @@ class PackPlan:
     dp: int
     tie_size: int          # 0 when embeddings are untied
     n_tie_buckets: int
+    mesh_axes: Tuple[str, ...] = ("dp",)
 
     @property
     def padded(self) -> int:
@@ -287,6 +341,7 @@ def build_pack_plan(
     dp: int,
     bucket_bytes: int = 4 * 2**20,
     tie_embeddings: bool = False,
+    mesh_axes: Tuple[str, ...] = ("dp",),
 ) -> PackPlan:
     """Lay a parameter tree out into fixed-size comm buckets."""
     from dlrover_tpu.ops.quant import BLOCK
@@ -336,22 +391,32 @@ def build_pack_plan(
         dp=dp,
         tie_size=tie_size,
         n_tie_buckets=n_tie,
+        mesh_axes=tuple(mesh_axes),
     )
 
 
 def pack_flat(tree, plan: PackPlan, n_buckets: Optional[int] = None):
     """Pytree → ``[n_buckets, bucket_elems]`` f32 stream (zero-padded).
 
-    Works on local values inside the update-sharding region and on
-    replicated leaves outside it (dp-only meshes keep every param
-    replicated, so no cross-sharding concat hazards exist here).
+    The flat buffer is built with ``dynamic_update_slice`` writes into a
+    zeros buffer rather than one ``concatenate`` + ``pad``. Both of the
+    obvious spellings miscompile on jax 0.4.x when the leaves carry
+    model-axis (fsdp/tp) shardings: a ``concatenate`` whose operands mix
+    auto-axis-sharded leaves with fresh zeros comes back with its values
+    scaled by the size of an unrelated mesh axis, and ``jnp.pad``
+    check-fails the SPMD partitioner inside a partial-manual region
+    (hlo_sharding_util ``IsManualSubgroup``). The slice writes lower
+    cleanly in both auto and manual contexts.
     """
     leaves = jax.tree.leaves(tree)
-    flat = jnp.concatenate(
-        [l.reshape(-1).astype(jnp.float32) for l in leaves]
-    )
     nb = plan.n_buckets if n_buckets is None else n_buckets
-    flat = jnp.pad(flat, (0, nb * plan.bucket_elems - flat.size))
+    flat = jnp.zeros((nb * plan.bucket_elems,), jnp.float32)
+    off = 0
+    for leaf in leaves:
+        flat = jax.lax.dynamic_update_slice(
+            flat, leaf.reshape(-1).astype(jnp.float32), (off,)
+        )
+        off += int(leaf.size)
     return flat.reshape(nb, plan.bucket_elems)
 
 
@@ -361,7 +426,7 @@ def pack_buckets(tree, plan: PackPlan):
     Same values as ``pack_flat(tree, plan)``'s rows, but each row is
     built from ONLY the leaf slices overlapping its flat range — so a
     bucket's reduce-scatter depends on just the gradients inside it,
-    not on every leaf (``pack_flat``'s single concatenate makes each
+    not on every leaf (``pack_flat``'s single flat buffer makes each
     bucket data-dependent on ALL grads, which pins every collective
     behind the end of backward). This is what lets XLA's latency-hiding
     scheduler issue early buckets while the backward tail computes.
@@ -371,22 +436,19 @@ def pack_buckets(tree, plan: PackPlan):
     rows = []
     for i in range(plan.n_buckets):
         lo, hi = i * e, (i + 1) * e
-        parts = []
+        # slice writes into zeros, not concatenate + pad — see pack_flat
+        # for why both miscompile on sharded leaves under jax 0.4.x
+        row = jnp.zeros((e,), jnp.float32)
+        pos = 0
         for off, size, leaf in zip(plan.offsets, plan.sizes, leaves):
             if off + size <= lo or off >= hi:
                 continue
             a = max(lo, off) - off
             b = min(hi, off + size) - off
-            parts.append(
-                leaf.reshape(-1)[a:b].astype(jnp.float32)
+            row = jax.lax.dynamic_update_slice(
+                row, leaf.reshape(-1)[a:b].astype(jnp.float32), (pos,)
             )
-        row = (
-            jnp.concatenate(parts)
-            if parts
-            else jnp.zeros((0,), jnp.float32)
-        )
-        if row.size < e:
-            row = jnp.pad(row, (0, e - row.size))
+            pos += b - a
         rows.append(row)
     return rows
 

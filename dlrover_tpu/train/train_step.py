@@ -122,20 +122,44 @@ def _flat_abs(plan: shd.PackPlan):
     }
 
 
+def _effective_flat_optimizer(
+    optimizer: optax.GradientTransformation, plan: shd.PackPlan
+) -> optax.GradientTransformation:
+    """The transformation actually run on the flat bucketed view.
+
+    Most optimizers are elementwise over the view and run as-is. An
+    optimizer whose init fn carries a ``_flat_factory`` attribute
+    (optimizer.py's factored path) instead supplies a plan-aware flat
+    equivalent: the factory knows the pack layout, so it can rebuild
+    per-leaf views out of the flat stream and keep non-elementwise
+    state (Adafactor row/col accumulators) per leaf rather than
+    mis-factoring the bucket matrix.
+    """
+    factory = getattr(optimizer.init, "_flat_factory", None)
+    return factory(plan) if factory is not None else optimizer
+
+
 def _probe_flat_optimizer(
     optimizer: optax.GradientTransformation, plan: shd.PackPlan
 ) -> Optional[str]:
     """None when the optimizer's state is elementwise over the flat
-    bucketed param view (so dp-sharding the flat axis shards the state),
-    else the reason it is not."""
+    bucketed param view (so dp-sharding the flat axis shards the state)
+    or the optimizer supplies a plan-aware flat equivalent
+    (``_flat_factory``), else the reason it is not."""
+    eff = _effective_flat_optimizer(optimizer, plan)
     try:
-        opt_abs = jax.eval_shape(optimizer.init, _flat_abs(plan))
+        opt_abs = jax.eval_shape(eff.init, _flat_abs(plan))
     except Exception as e:  # noqa: BLE001
         return f"optimizer.init rejected the flat param view: {e}"
     flat_shape = (plan.n_buckets, plan.bucket_elems)
     for leaf in jax.tree.leaves(opt_abs, is_leaf=_is_quantized):
         if _is_quantized(leaf):
             return "low-bit optimizer state (compiler-chosen shardings)"
+        if eff is not optimizer:
+            # plan-aware flat optimizer: per-leaf factored state is
+            # expected; only (n_buckets, bucket_elems)-shaped leaves
+            # get dp-sharded (_flat_opt_sharding), the rest replicate
+            continue
         if tuple(leaf.shape) not in ((), flat_shape):
             return (
                 f"optimizer state leaf of shape {tuple(leaf.shape)} is "
@@ -153,6 +177,14 @@ def _probe_flat_optimizer(
 # should be noticed.
 _LOGGED_FALLBACKS: set = set()
 
+# pack-plan cache: the resolver runs at least three times per job
+# (builder init, abstract/init state, AOT prewarm) and each run used to
+# re-trace the full model via jax.eval_shape(decoder.init) just to size
+# buckets. ModelConfig is a frozen (hashable) dataclass, so the plan —
+# a pure function of (config, dp, bucket_bytes, tie, mesh_axes) — is
+# memoized on those inputs.
+_PLAN_CACHE: Dict[Tuple, shd.PackPlan] = {}
+
 
 def resolve_update_sharding(
     cfg: ModelConfig,
@@ -166,13 +198,18 @@ def resolve_update_sharding(
 
     Update sharding is an optimization, not a semantics change, so an
     unsupported combination falls back to the replicated update with a
-    recorded reason instead of failing the job. Currently supported:
-    pure data-parallel meshes (every non-dp axis 1 — params replicated,
-    which is what lets the optimizer shard by flat offset rather than by
-    parameter), built-in loss, f32 params, elementwise optimizer state,
-    no MoE/host-offload. ``cfg.fp8`` composes: a pure-dp mesh never
-    pipelines, so the delayed-scaling state threads the manual region
-    as an explicit carry (see ``_sharded_step_fn``).
+    recorded reason instead of failing the job. Supported meshes: any
+    whose non-dp axes are confined to fsdp/tp — on a pure-dp mesh the
+    whole step runs in one fully-manual region; with fsdp/tp in play
+    the gradient exchange runs in a PARTIAL-manual region (manual over
+    dp, fsdp/tp left to the auto partitioner) and the plan still packs
+    GLOBAL leaf shapes, because auto-axis values appear global-shaped
+    inside the region. Also required: built-in loss, f32 params,
+    flat-compatible optimizer state (elementwise, or a plan-aware
+    ``_flat_factory`` equivalent — optimizer.py's factored path), no
+    MoE/host-offload. ``cfg.fp8`` composes on pure-dp meshes only, and
+    quantized wire dtypes (bf16/int8) need the pure-dp full-manual
+    region — their ``all_to_all`` cannot lower partial-manually.
     """
     if comm is None or not comm.update_sharding:
         return False, None, None
@@ -180,19 +217,40 @@ def resolve_update_sharding(
     others = sorted(
         a for a, s in mesh.shape.items() if a != "dp" and s > 1
     )
+    unsupported = [a for a in others if a not in ("fsdp", "tp")]
     reason = None
     if dp <= 1:
         reason = "mesh has dp<=1"
-    elif others:
-        reason = f"non-dp mesh axes in use: {others}"
+    elif unsupported:
+        reason = f"non-dp mesh axes beyond fsdp/tp in use: {unsupported}"
     elif cfg.n_experts > 0:
         reason = "MoE routing/aux losses not supported in the manual region"
     elif offload_opt_state:
         reason = "offload_opt_state keeps moments host-resident already"
     elif loss_fn is not None:
         reason = "custom loss_fn (denom override unavailable)"
+    elif others and cfg.fp8:
+        reason = (
+            "fp8 delayed-scaling state threads the pure-dp manual "
+            "region only (no carry across a partial-manual region)"
+        )
+    elif others and comm.wire_for(mesh, "dp") != "float32":
+        reason = (
+            "quantized wire dtypes need a pure-dp mesh (all_to_all "
+            "over dp cannot lower inside the partial-manual region)"
+        )
+    mesh_axes = ("dp",) + tuple(others)
     plan = None
     if reason is None:
+        cache_key: Optional[Tuple] = None
+        try:
+            cache_key = (
+                cfg, dp, comm.bucket_bytes, cfg.tie_embeddings, mesh_axes
+            )
+            plan = _PLAN_CACHE.get(cache_key)
+        except TypeError:  # unhashable config subclass: skip the cache
+            cache_key = None
+    if reason is None and plan is None:
         params_abs = jax.eval_shape(
             lambda: decoder.init(jax.random.key(0), cfg)
         )
@@ -202,7 +260,10 @@ def resolve_update_sharding(
                 dp,
                 comm.bucket_bytes,
                 tie_embeddings=cfg.tie_embeddings,
+                mesh_axes=mesh_axes,
             )
+            if cache_key is not None:
+                _PLAN_CACHE[cache_key] = plan
         except ValueError as e:
             reason = str(e)
     if reason is None:
@@ -263,7 +324,8 @@ def abstract_train_state(
         # ZeRO-1: the optimizer state lives on the flat bucketed view,
         # dp-sharded along the bucket axis (1/dp of the moments per
         # replica); params themselves stay in their usual shardings
-        opt_abs = jax.eval_shape(optimizer.init, _flat_abs(plan))
+        flat_opt = _effective_flat_optimizer(optimizer, plan)
+        opt_abs = jax.eval_shape(flat_opt.init, _flat_abs(plan))
         rep = NamedSharding(mesh, P())
         shapes = {
             "params": params_abs,
@@ -385,7 +447,9 @@ def init_train_state(
                 jax.lax.with_sharding_constraint, params, param_shardings
             )
             flat = {"flat": shd.pack_flat(params, plan)}
-            opt_state = optimizer.init(flat)
+            opt_state = _effective_flat_optimizer(optimizer, plan).init(
+                flat
+            )
             opt_state = jax.tree.map(
                 lambda l: jax.lax.with_sharding_constraint(
                     l, _flat_opt_sharding(l, plan, mesh)
@@ -512,6 +576,25 @@ class TrainStepBuilder:
         )
         self._wire = (
             comm.wire_for(mesh, "dp") if self.update_sharding else None
+        )
+        # resolved mode ("zero1" defers the gradient exchange to one
+        # reduce-scatter per step; "zero2" exchanges every microbatch so
+        # only the 1/dp shard survives the accumulation loop) and the
+        # transformation actually run on the flat view (the optimizer
+        # itself, or its plan-aware flat equivalent for factored state)
+        self.update_mode = comm.update_mode if self.update_sharding else ""
+        self._flat_opt = (
+            _effective_flat_optimizer(optimizer, self._plan)
+            if self.update_sharding
+            else None
+        )
+        # hybrid (dp×fsdp / dp×tp) update sharding: the partial-manual
+        # region suppresses the model's internal constraints, so params
+        # are re-pinned to their rule shardings after the flat unpack
+        self._param_shardings = (
+            shd.shardings_for_tree(mesh, decoder.logical_axes(cfg), rules)
+            if self.update_sharding and len(self._plan.mesh_axes) > 1
+            else None
         )
         if (
             offload_opt_state
@@ -664,10 +747,32 @@ class TrainStepBuilder:
         unaffected by the merge order. Under grad_accum the microbatch
         states merge by elementwise max first (same once-per-step
         semantics as ``_accumulated_grads``).
+
+        Hybrid meshes (dp×fsdp / dp×tp): the gradient region goes
+        PARTIAL-manual — manual over dp only, fsdp/tp left to the auto
+        partitioner, which inserts the model-axis collectives exactly
+        as in the replicated program. Auto-axis values appear
+        global-shaped inside the region, so the pack plan and the
+        bucket exchange are unchanged; only the region's lowering mode
+        and the accumulation structure differ (the 0.4.x partitioner
+        cannot partition a ``lax.scan`` whose carry touches auto-axis-
+        sharded values inside a partial-manual region, so accumulation
+        unrolls as a Python loop there).
+
+        Modes: ``zero2`` (the boolean default) reduce-scatters every
+        microbatch and accumulates 1/dp shards — no full-gradient
+        buffer survives the accumulation loop, and on the f32 wire the
+        rounding order matches the unsharded program (which all-reduces
+        per microbatch). ``zero1`` accumulates the full local gradient
+        and defers to ONE exchange per step — a×fewer collectives, at
+        the cost of full-gradient residency and a different (still
+        deterministic) summation order.
         """
         cfg, mesh, plan = self.cfg, self.mesh, self._plan
         a, wire = self.grad_accum, self._wire
         tie = cfg.tie_embeddings
+        zoo = len(plan.mesh_axes) > 1
+        defer = self.update_mode == "zero1"
         fp8 = state.get("fp8") if cfg.fp8 else None
         if a > 1:
             # microbatch split OUTSIDE the region so the (rank,
@@ -693,7 +798,9 @@ class TrainStepBuilder:
                 # head cotangent separates from the lookup's — the two
                 # ride separate reduce-scatters exactly like GSPMD's two
                 # all-reduces in the unsharded lowering
-                with shd.update_sharding_region(tie_zero=z):
+                with shd.update_sharding_region(
+                    tie_zero=z, unroll_scans=zoo
+                ):
                     return decoder.loss_fn(
                         p,
                         mb,
@@ -731,23 +838,85 @@ class TrainStepBuilder:
                 gz = None
             return loss, metrics, g, gz, nf8
 
+        def exchange(g, gz):
+            return shd.exchange_buckets(
+                shd.pack_buckets(g, plan),
+                plan,
+                wire,
+                axis="dp",
+                tie_extra=gz if tie else None,
+            )
+
         def region(params, f8, batch):
-            if a > 1:
-                # reduce-scatter EVERY microbatch and accumulate the
-                # shards — the order the unsharded program rounds in
-                # (GSPMD all-reduces each microbatch's grads before the
-                # scan carry add), so the f32 wire stays bitwise. Same
-                # collective count as the baseline, half the bytes.
+            if a > 1 and zoo:
+                # UNROLLED microbatch loop: the 0.4.x partitioner dies
+                # on a lax.scan touching auto-axis-sharded values inside
+                # a partial-manual region, so hybrid meshes unroll.
+                # zero2 exchanges per microbatch (shard-sized carry);
+                # zero1 accumulates full local grads, one exchange.
+                sh_acc = jnp.zeros(
+                    (plan.n_buckets, plan.bucket_elems // plan.dp),
+                    jnp.float32,
+                )
+                g_acc = gz_acc = None
+                loss_acc = jnp.zeros([], jnp.float32)
+                for i in range(a):
+                    mb = jax.tree.map(lambda x: x[i], batch)
+                    loss, _, g, gz, _ = local_grads(params, None, mb)
+                    loss_acc = loss_acc + loss
+                    if defer:
+                        g_acc = (
+                            g
+                            if g_acc is None
+                            else jax.tree.map(jnp.add, g_acc, g)
+                        )
+                        if tie:
+                            gz_acc = gz if gz_acc is None else gz_acc + gz
+                    else:
+                        sh_acc = sh_acc + exchange(g, gz)
+                shards = exchange(g_acc, gz_acc) if defer else sh_acc
+                metrics = {"loss": jax.lax.psum(loss_acc, "dp") / a}
+                nf8 = None
+            elif a > 1 and defer:
+                # ZeRO-1 deferred exchange: accumulate the full local
+                # gradient across the scan (like the replicated accum
+                # path), then reduce-scatter ONCE — a×fewer collectives
+                # than zero2, at full-gradient residency.
+                def micro(carry, mb):
+                    g_acc, gz_acc, loss_acc, f8_acc = carry
+                    loss, _, g, gz, nf8 = local_grads(params, f8, mb)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    if tie:
+                        gz_acc = gz_acc + gz
+                    if f8 is not None:
+                        f8_acc = jax.tree.map(jnp.maximum, f8_acc, nf8)
+                    return (g_acc, gz_acc, loss_acc + loss, f8_acc), None
+
+                init = (
+                    jax.tree.map(jnp.zeros_like, params),
+                    jnp.zeros(plan.shapes[0], jnp.float32) if tie else None,
+                    jnp.zeros([], jnp.float32),
+                    None if f8 is None else jax.tree.map(jnp.zeros_like, f8),
+                )
+                (g_acc, gz_acc, loss_acc, nf8), _ = jax.lax.scan(
+                    micro, init, batch
+                )
+                shards = exchange(g_acc, gz_acc)
+                metrics = {
+                    "loss": jax.lax.psum(loss_acc, "dp") / a
+                }
+            elif a > 1:
+                # zero2 (the boolean default): reduce-scatter EVERY
+                # microbatch and accumulate the shards — the order the
+                # unsharded program rounds in (GSPMD all-reduces each
+                # microbatch's grads before the scan carry add), so the
+                # f32 wire stays bitwise. Same collective count as the
+                # baseline, half the bytes, and no full-gradient buffer
+                # across the scan.
                 def micro(carry, mb):
                     sh_acc, loss_acc, f8_acc = carry
                     loss, _, g, gz, nf8 = local_grads(params, f8, mb)
-                    shards = shd.exchange_buckets(
-                        shd.pack_buckets(g, plan),
-                        plan,
-                        wire,
-                        axis="dp",
-                        tie_extra=gz if tie else None,
-                    )
+                    shards = exchange(g, gz)
                     if f8 is not None:
                         f8_acc = jax.tree.map(jnp.maximum, f8_acc, nf8)
                     return (sh_acc + shards, loss_acc + loss, f8_acc), None
@@ -774,13 +943,7 @@ class TrainStepBuilder:
                 metrics = {
                     k: jax.lax.psum(v, "dp") for k, v in metrics.items()
                 }
-                shards = shd.exchange_buckets(
-                    shd.pack_buckets(g, plan),
-                    plan,
-                    wire,
-                    axis="dp",
-                    tie_extra=gz if tie else None,
-                )
+                shards = exchange(g, gz)
             if f8 is not None:
                 # global amax: per-rank states differ only in the new
                 # slot (this rank's local amax); max over dp = the
@@ -790,18 +953,37 @@ class TrainStepBuilder:
                 )
             return metrics, shards, nf8
 
+        sm_kwargs = {}
+        if zoo:
+            # partial-manual: dp is manual (the explicit psum_scatter /
+            # psum collectives), fsdp/tp stay with the auto partitioner
+            sm_kwargs["axis_names"] = {"dp"}
         metrics, grads_flat, new_fp8 = jax_compat.shard_map(
             region,
             mesh=mesh,
             in_specs=(P(), P(), batch_spec),
             out_specs=(P(), P(None, "dp"), P()),
+            **sm_kwargs,
         )(state["params"], fp8, batch)
         if a > 1:
             # divide AFTER the exchange, where GSPMD's unsharded program
             # divides after its all-reduce — keeps the f32 wire bitwise
             grads_flat = grads_flat / a
+        flat_sh = NamedSharding(mesh, P(None, "dp"))
+        if zoo:
+            # pin the flat stream's layout: the bucket axis dp-sharded,
+            # replicated over fsdp/tp, so the optimizer sweep below is
+            # purely elementwise-local (the HLO guard pins zero
+            # cross-axis collectives on the moments)
+            grads_flat = jax.lax.with_sharding_constraint(
+                grads_flat, flat_sh
+            )
         flat_params = {"flat": shd.pack_flat(state["params"], plan)}
-        updates, new_opt = self.optimizer.update(
+        if zoo:
+            flat_params["flat"] = jax.lax.with_sharding_constraint(
+                flat_params["flat"], flat_sh
+            )
+        updates, new_opt = self._flat_opt.update(
             {"flat": grads_flat}, state["opt_state"], flat_params
         )
         def apply_region(fp, u):
@@ -827,6 +1009,15 @@ class TrainStepBuilder:
             out_specs=P(),
         )(flat_params["flat"], updates["flat"])
         params = shd.unpack_flat(new_flat, state["params"], plan)
+        if zoo:
+            # the region suppressed the model's internal constraints;
+            # re-pin the unpacked params to their rule shardings so the
+            # next step (and checkpointing) sees the canonical layout
+            params = jax.tree.map(
+                jax.lax.with_sharding_constraint,
+                params,
+                self._param_shardings,
+            )
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads_flat)
         new_state = {

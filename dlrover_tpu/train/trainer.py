@@ -11,7 +11,9 @@ global-step reports to the elastic master when one is present.
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from typing import (
+    Any, Callable, Dict, Iterable, List, Optional, Tuple, Union,
+)
 
 import jax
 import jax.numpy as jnp
@@ -84,12 +86,15 @@ class TrainerArgs:
     # honored at the NEXT block boundary — worst-case response is one
     # block.
     block_k: int = 1
-    # ZeRO-1 weight-update sharding: reduce-scatter grads, run the
-    # optimizer on 1/dp of the flat stream, all-gather params
+    # ZeRO update sharding: reduce-scatter grads, run the optimizer on
+    # 1/dp of the flat stream, all-gather params
     # (parallel.sharding.CommConfig / train_step.resolve_update_sharding;
     # silently falls back to the replicated step when the config or
-    # optimizer is incompatible — the builder logs why)
-    update_sharding: bool = False
+    # optimizer is incompatible — the builder logs why). False = off;
+    # "zero1" = one deferred reduce-scatter per step; "zero2" =
+    # per-microbatch scattered accumulation (no full-grad buffer across
+    # the accum scan); True = legacy alias for "zero2"
+    update_sharding: Union[bool, str] = False
     # fixed gradient-collective bucket size (MB of f32 payload)
     comm_bucket_mb: float = 4.0
     # wire dtype for the bucketed exchange: "float32" (bitwise),
@@ -144,7 +149,7 @@ class Trainer:
             from dlrover_tpu.parallel.sharding import CommConfig
 
             comm = CommConfig(
-                update_sharding=True,
+                update_sharding=args.update_sharding,
                 bucket_mb=args.comm_bucket_mb,
                 wire_dtype=args.comm_wire_dtype,
                 wire_dtype_dcn=args.comm_wire_dtype_dcn,

@@ -36,6 +36,49 @@ def test_apply_strategy_builds_plan():
     assert plan2 == plan
 
 
+def test_zero_strategy_modes_reach_comm_config():
+    """The zero1/zero2 library methods set the mode string on the plan
+    and the mode survives into the resolved CommConfig (the builder
+    keys per-microbatch vs deferred exchange off update_mode)."""
+    for name, mode in (("zero1", "zero1"), ("zero2", "zero2")):
+        plan = apply_strategy(
+            [
+                ("mixed_parallel", {"dp": 4, "tp": 2}),
+                (name, {"bucket_mb": 2.0}),
+            ]
+        )
+        assert plan.update_sharding == mode
+        comm = plan.comm_config()
+        assert comm.update_mode == mode
+        assert comm.bucket_mb == 2.0
+        plan2 = AccelerationPlan.from_json(plan.to_json())
+        assert plan2.update_sharding == mode
+    off = apply_strategy([("zero1", {"enabled": False})])
+    assert off.update_sharding is False
+    assert off.comm_config() is None
+
+
+def test_analyser_update_sharding_hybrid_mesh():
+    """On a dp×fsdp mesh with update sharding the flat moments divide
+    by dp (replicated over the model axes), not dp × param shards —
+    and the saving still beats the per-leaf fsdp sharding it trades
+    away whenever dp > fsdp."""
+    cfg = get_config("gpt2-1.5b")
+    base = apply_strategy([("mixed_parallel", {"dp": 4, "fsdp": 2})])
+    zoo = apply_strategy(
+        [("mixed_parallel", {"dp": 4, "fsdp": 2}), ("zero1", {})]
+    )
+    a_base = analyse(cfg, base, 8, 8, 1024, hbm_bytes=16e9)
+    a_zoo = analyse(cfg, zoo, 8, 8, 1024, hbm_bytes=16e9)
+    n = cfg.num_params()
+    # replicated-over-dp per-leaf fsdp sharding: /2; flat dp shard: /4
+    assert a_base.opt_bytes_per_chip == pytest.approx(n * 2 * 4 / 2)
+    bucket = zoo.comm_bucket_mb * 2**20
+    assert a_zoo.opt_bytes_per_chip == pytest.approx(
+        n * 2 * 4 / 4 + 2 * bucket
+    )
+
+
 def test_strategy_json_roundtrip():
     s = [("fsdp", {"size": 4}), ("checkpoint", {"policy": "full"})]
     assert strategy_from_json(strategy_to_json(s)) == s

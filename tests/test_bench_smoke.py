@@ -136,6 +136,82 @@ def test_failure_classifier_buckets():
     assert cf(1, "Compilation failure: RESOURCE_EXHAUSTED") == "oom"
 
 
+def test_overlap_and_bucket_models_scale_with_zero2():
+    """zero2 pays the gradient exchange once per microbatch: the
+    overlap estimate scales the reduce-scatter wire by grad_accum, and
+    the suggested bucket grows so the recurring launch cost stays
+    amortized. zero1 (one deferred exchange) passes through unscaled."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    stats = {"bytes_by_op": {"reduce-scatter": 1e8, "all-gather": 1e8}}
+    base = bench.overlap_report(stats, step_us=10_000.0)
+    z1 = bench.overlap_report(
+        stats, step_us=10_000.0, grad_accum=4, update_mode="zero1"
+    )
+    z2 = bench.overlap_report(
+        stats, step_us=10_000.0, grad_accum=4, update_mode="zero2"
+    )
+    rs = lambda r: r["per_op"]["reduce-scatter"]["wire_us"]  # noqa: E731
+    assert rs(z1) == rs(base)
+    assert rs(z2) == pytest.approx(4 * rs(base))
+    # the all-gather param return happens once per step either way
+    assert z2["per_op"]["all-gather"]["wire_us"] == \
+        pytest.approx(base["per_op"]["all-gather"]["wire_us"])
+
+    grad_bytes = 4e9
+    mb1 = bench.suggest_bucket_mb(grad_bytes, launch_us=100.0)
+    mb2 = bench.suggest_bucket_mb(
+        grad_bytes, launch_us=100.0, grad_accum=4, update_mode="zero2"
+    )
+    assert mb2 >= mb1
+    # zero1 with accum is a single exchange: same answer as accum=1
+    assert bench.suggest_bucket_mb(
+        grad_bytes, launch_us=100.0, grad_accum=4, update_mode="zero1"
+    ) == mb1
+
+
+def test_drill_recovery_metric_reads_artifact(tmp_path, monkeypatch):
+    """The bench record embeds the eviction drill's recovery_s so the
+    BENCH and DRILL artifacts share one comparable trajectory number."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    p = tmp_path / "DRILL_test.json"
+    p.write_text(json.dumps({
+        "recovery_budget_s": 30,
+        "failures": [
+            {"kind": "slice_loss", "recovery_s": 4.2},
+            {
+                "kind": "host_eviction_live_reshard",
+                "recovery_s": 1.7,
+                "restore_tier": "live",
+            },
+        ],
+    }))
+    got = bench.drill_recovery_metric(str(p))
+    assert got["recovery_s"] == pytest.approx(4.2)
+    assert got["kind"] == "slice_loss"
+    assert got["live_reshard_recovery_s"] == pytest.approx(1.7)
+    assert got["budget_s"] == 30
+    assert got["n_failures"] == 2
+    # env override wins; missing/corrupt artifacts degrade to None
+    monkeypatch.setenv("DLROVER_TPU_DRILL_ARTIFACT", str(p))
+    assert bench.drill_recovery_metric()["recovery_s"] == \
+        pytest.approx(4.2)
+    assert bench.drill_recovery_metric(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bench.drill_recovery_metric(str(bad)) is None
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"failures": []}))
+    assert bench.drill_recovery_metric(str(empty)) is None
+
+
 def test_nonmatmul_residue_derivation():
     """`nonmatmul_us_per_step` = step time minus the matmuls-only
     counterfactual (executed flops at the shape's measured chained-

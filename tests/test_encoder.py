@@ -72,6 +72,7 @@ def test_mlm_loss_respects_mask():
     assert float(metrics["tokens"]) == float(mlm_mask.sum())
 
 
+@pytest.mark.slow
 def test_encoder_trains_on_mesh():
     from dlrover_tpu.train import (
         TrainStepBuilder,

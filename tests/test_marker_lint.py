@@ -141,6 +141,76 @@ def _fn_slow_marked(fn) -> bool:
     return False
 
 
+_MESH_AXES = ("dp", "fsdp", "tp", "pp", "ep", "sp")
+
+
+def _multi_axis_mesh_devices(fn) -> int:
+    """Largest statically-known device count among MULTI-AXIS
+    ``MeshConfig(...)`` calls in a function; 0 when there is none.
+    ``-1`` (fill the remaining devices) counts as reaching the suite's
+    8 virtual devices."""
+    best = 0
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "MeshConfig"
+        ):
+            continue
+        sizes = [
+            kw.value.value
+            for kw in node.keywords
+            if kw.arg in _MESH_AXES
+            and isinstance(kw.value, ast.Constant)
+            and isinstance(kw.value.value, int)
+        ]
+        explicit = [s for s in sizes if s > 1]
+        fills = any(s == -1 for s in sizes)
+        if len(explicit) + (1 if fills else 0) < 2:
+            continue
+        total = 1
+        for s in explicit:
+            total *= s
+        if fills:
+            total = max(total, 8)
+        best = max(best, total)
+    return best
+
+
+def _compiles_train_step(fn) -> bool:
+    return any(
+        isinstance(node, ast.Name) and node.id == "TrainStepBuilder"
+        for node in ast.walk(fn)
+    )
+
+
+def test_mesh_zoo_step_compiles_are_slow():
+    """A test that builds a multi-axis mesh over all 8 virtual devices
+    AND compiles a train step through it is a mesh-zoo matrix entry —
+    each one costs multiple multi-device SPMD compiles (~10s each on
+    this backend), and the update-sharding matrix keeps growing. Those
+    tests must carry ``slow`` (per-function mark or module
+    ``pytestmark``) so tier-1 stays inside its 870s budget. Cheap
+    multi-axis uses — plan resolution, eval_shape, checkpoint layout
+    math — stay fast; the lint keys on the mesh build AND the
+    ``TrainStepBuilder`` reference together."""
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if _fn_slow_marked(fn):
+                continue
+            if _multi_axis_mesh_devices(fn) >= 8 and _compiles_train_step(fn):
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "multi-axis mesh (≥8 devices) train-step compiles must be "
+        "marked slow (add @pytest.mark.slow or a module pytestmark):\n"
+        + "\n".join(rogue)
+    )
+
+
 def test_process_spawning_fault_tests_are_slow():
     """Files importing ``elastic_harness`` at module level spawn real
     master/agent/worker PROCESSES — the fault-injection drills. Every

@@ -312,6 +312,7 @@ def test_analyser_offload_bound_is_leaf_sized():
     )
 
 
+@pytest.mark.slow
 def test_multi_slice_hybrid_mesh_trains():
     """num_slices>1 (the DCN layout: dp split across slices, model axes
     inside each slice) must build and train off multi-slice hardware —

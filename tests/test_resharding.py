@@ -156,6 +156,67 @@ def test_reshard_train_state_moves_flat_leaves():
     assert int(out["step"]) == 7
 
 
+def _hybrid_plan(dp=4):
+    """A real PackPlan built under a dp×fsdp mesh_axes family."""
+    tree = {
+        "w": jax.ShapeDtypeStruct((64, 32), jnp.float32),
+        "b": jax.ShapeDtypeStruct((37,), jnp.float32),
+    }
+    return shd.build_pack_plan(
+        tree, dp, bucket_bytes=512, mesh_axes=("dp", "fsdp")
+    )
+
+
+def test_reshard_train_state_refuses_hybrid_mesh_plans():
+    """Flat-stream coordinates are only canonical within one mesh_axes
+    family: a PackPlan built under dp×fsdp must be refused for live
+    donation (either side of the migration) instead of silently
+    repacking a stream whose offsets mean something else."""
+    hybrid = _hybrid_plan(dp=4)
+    pure = synth_plan(8, 3, 768, hybrid.total)
+    mesh4 = build_mesh(MeshConfig(dp=-1), devices=jax.devices()[:4])
+    P = jax.sharding.PartitionSpec
+    flat_shd = jax.sharding.NamedSharding(mesh4, P(None, "dp"))
+    state = {"opt": {"mu": canonical_fill(hybrid)}}
+    shardings = {"opt": {"mu": flat_shd}}
+    with pytest.raises(MigrationError, match="pure-dp"):
+        reshard_train_state(state, hybrid, pure, shardings)
+    with pytest.raises(MigrationError, match="pure-dp"):
+        reshard_train_state(
+            {"opt": {"mu": canonical_fill(pure)}}, pure, hybrid, shardings
+        )
+
+
+def test_resharder_hybrid_plan_degrades_to_fallback(hub_events):
+    """The zoo refusal rides the existing failover ladder: the
+    resharder catches the MigrationError, runs the checkpoint fallback,
+    and publishes reshard_recovery path=fallback with the reason."""
+    hybrid = _hybrid_plan(dp=4)
+    pure = synth_plan(8, 3, 768, hybrid.total)
+    mesh4 = build_mesh(MeshConfig(dp=-1), devices=jax.devices()[:4])
+    P = jax.sharding.PartitionSpec
+    flat_shd = jax.sharding.NamedSharding(mesh4, P(None, "dp"))
+    state = {"opt": {"mu": canonical_fill(hybrid)}}
+    shardings = {"opt": {"mu": flat_shd}}
+    rs = LiveResharder(retries=2, backoff_base_s=0.01)
+    outcome = rs.execute(
+        [
+            ("replan", lambda _: (hybrid, pure)),
+            (
+                "migrate",
+                lambda plans: reshard_train_state(
+                    state, *plans, shardings
+                ),
+            ),
+        ],
+        fallback=lambda e: "restored-from-checkpoint",
+    )
+    assert outcome.ok and outcome.path == "fallback"
+    assert outcome.result == "restored-from-checkpoint"
+    assert "pure-dp" in outcome.reason
+    assert "path=fallback" in hub_events[-1].detail
+
+
 # ---------------------------------------------------------------- faults
 
 

@@ -132,13 +132,74 @@ def test_gate_dp1_falls_back():
 
 
 def test_gate_non_dp_axes():
+    """Axes beyond fsdp/tp (here pp) still refuse — the exchange is
+    only defined over dp with fsdp/tp left to the auto partitioner."""
     cfg = tiny_cfg()
-    mesh = build_mesh(MeshConfig(dp=-1, tp=2))
+    mesh = build_mesh(MeshConfig(dp=-1, pp=2))
     active, reason, _ = resolve_update_sharding(
         cfg, mesh, optax.adamw(1e-3), comm_cfg()
     )
     assert not active
     assert "non-dp" in reason
+
+
+def test_gate_hybrid_meshes_activate():
+    """dp×tp and dp×fsdp are in the zoo now: the resolve must come back
+    active with the mesh axes recorded on the plan (the partial-manual
+    region and the resharding refusal both key off mesh_axes)."""
+    cfg = tiny_cfg()
+    for kw, axes in (
+        ({"tp": 2}, ("dp", "tp")),
+        ({"fsdp": 2}, ("dp", "fsdp")),
+    ):
+        mesh = build_mesh(MeshConfig(dp=-1, **kw))
+        active, reason, plan = resolve_update_sharding(
+            cfg, mesh, optax.adamw(1e-3), comm_cfg()
+        )
+        assert active, reason
+        assert plan.mesh_axes == axes
+        assert plan.dp == mesh.shape["dp"]
+
+
+def test_gate_hybrid_mesh_quantized_wire_falls_back():
+    """bf16/int8 wires ride all_to_all, which cannot lower inside the
+    partial-manual region — hybrid meshes must refuse, pure-dp keeps
+    working."""
+    cfg = tiny_cfg()
+    mesh = build_mesh(MeshConfig(dp=-1, tp=2))
+    active, reason, _ = resolve_update_sharding(
+        cfg, mesh, optax.adamw(1e-3), comm_cfg(wire_dtype="bfloat16")
+    )
+    assert not active
+    assert "wire" in reason or "pure-dp" in reason
+    active, reason, _ = resolve_update_sharding(
+        cfg, dp_mesh(), optax.adamw(1e-3), comm_cfg(wire_dtype="bfloat16")
+    )
+    assert active, reason
+
+
+def test_gate_hybrid_mesh_fp8_falls_back():
+    """fp8 delayed-scaling state threads the pure-dp manual region
+    only; on a hybrid mesh the resolve refuses rather than dropping the
+    scaling state."""
+    cfg = tiny_cfg(fp8=True)
+    mesh = build_mesh(MeshConfig(dp=-1, tp=2))
+    active, reason, _ = resolve_update_sharding(
+        cfg, mesh, optax.adamw(1e-3), comm_cfg()
+    )
+    assert not active
+    assert "fp8" in reason
+
+
+def test_update_mode_semantics():
+    """CommConfig mode strings: False=off, "zero1"=deferred exchange,
+    "zero2"=per-microbatch scatter, True=legacy alias for zero2."""
+    assert shd.CommConfig().update_mode == ""
+    assert shd.CommConfig(update_sharding="zero1").update_mode == "zero1"
+    assert shd.CommConfig(update_sharding="zero2").update_mode == "zero2"
+    assert shd.CommConfig(update_sharding=True).update_mode == "zero2"
+    with pytest.raises(ValueError):
+        shd.CommConfig(update_sharding="zero3")
 
 
 def test_gate_offload_and_custom_loss():
