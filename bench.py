@@ -702,8 +702,40 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
                         device_kind=getattr(dev, "device_kind", ""),
                     ),
                     getattr(builder, "update_sharding_reason", ""),
+                    planned_step_time_s=dt / total_steps,
                 )
             )
+
+    # sentinel cost at this shape: a short back-to-back pair (sentinels
+    # on vs the already-compiled off step) — the <1% acceptance number
+    # the docs' cost model quotes. None when the probe fails or is
+    # disabled (the probe pays a second step compile, which smoke tests
+    # on tiny hosts opt out of via DLROVER_TPU_SENTINEL_PROBE=0).
+    sentinel_overhead_frac = None
+    try:
+        if os.environ.get("DLROVER_TPU_SENTINEL_PROBE", "1") == "0":
+            raise RuntimeError("probe disabled")
+        sb = TrainStepBuilder(cfg, mesh, opt, health_sentinels=True)
+        s_step = sb.build_block() if block_k > 1 else sb.build()
+        s_state = init_train_state(jax.random.key(0), cfg, mesh, opt)
+        n_probe = max(min(n_dispatch, 10), 3)
+        for _ in range(2):
+            s_state, s_metrics = s_step(s_state, batch_data)
+        float(jnp.ravel(s_metrics["loss"])[-1])  # sync (relay-safe)
+        ts = time.perf_counter()
+        for _ in range(n_probe):
+            s_state, s_metrics = s_step(s_state, batch_data)
+        float(jnp.ravel(s_metrics["loss"])[-1])
+        t_on = time.perf_counter() - ts
+        ts = time.perf_counter()
+        for _ in range(n_probe):
+            state, metrics = step(state, batch_data)
+        float(jnp.ravel(metrics["loss"])[-1])
+        t_off = time.perf_counter() - ts
+        if t_off > 0:
+            sentinel_overhead_frac = round(t_on / t_off - 1.0, 4)
+    except Exception:  # noqa: BLE001
+        pass
     return {
         "metric": (
             f"train_mfu[{cfg.name},b{batch}x{seq}{tag},{dev.device_kind}]"
@@ -718,6 +750,7 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         "host_dispatch_us_per_step": round(
             dispatch_s / total_steps * 1e6, 1
         ),
+        "sentinel_overhead_frac": sentinel_overhead_frac,
         "collectives": stats,
         "overlap": overlap,
         # the elastic half of the trajectory: how long the last drilled

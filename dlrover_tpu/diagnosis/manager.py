@@ -88,11 +88,18 @@ class DiagnosisManager:
     def attach(self, hub) -> None:
         """Subscribe to the master's telemetry bus instead of being
         hand-wired per report type: resource records feed the hang
-        detector's history, straggler flags and numeric incidents land
-        as diagnosis evidence."""
+        detector's history, straggler flags, numeric incidents, worker
+        anomalies, and cross-host health verdicts land as diagnosis
+        evidence."""
         hub.subscribe(
             self._on_record,
-            types=("ResourceRecord", "StragglerRecord", "NumericEvent"),
+            types=(
+                "AnomalyRecord",
+                "HealthSummary",
+                "NumericEvent",
+                "ResourceRecord",
+                "StragglerRecord",
+            ),
         )
 
     def _on_record(self, record) -> None:
@@ -125,6 +132,25 @@ class DiagnosisManager:
                 f"numeric {record.kind} at step {record.step}: "
                 f"value={record.value} {record.detail}",
             )
+        elif tname == "AnomalyRecord":
+            self.collect_diagnosis_data(
+                record.node_id,
+                f"anomaly {record.kind} at step {record.step}: "
+                f"value={record.value} {record.detail}"
+                + (f" capture={record.capture}" if record.capture else ""),
+            )
+        elif tname == "HealthSummary":
+            # the correlated verdict: filed job-wide AND per affected
+            # rank so a node's evidence trail shows the attribution
+            content = (
+                f"health {record.kind}: verdict={record.verdict} "
+                f"ranks=[{record.ranks}] of world={record.world}, "
+                f"first bad step {record.first_step}"
+            )
+            self.collect_diagnosis_data(-1, content)
+            for rank in record.ranks.split(","):
+                if rank.strip():
+                    self.collect_diagnosis_data(int(rank), content)
 
     # ---- collection ------------------------------------------------------
 

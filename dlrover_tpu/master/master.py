@@ -122,6 +122,14 @@ class JobMaster:
             )
         self.diagnosis_manager.attach(self.telemetry_hub)
         self.speed_monitor.attach_hub(self.telemetry_hub)
+        # cross-host anomaly correlation: worker AnomalyRecords arriving
+        # over the wire (MasterSink → report_telemetry) fold into
+        # HealthSummary verdicts the diagnosis manager subscribes to
+        from dlrover_tpu.observability.watchdog import HealthAggregator
+
+        self.health_aggregator = HealthAggregator(
+            hub=self.telemetry_hub, world=num_workers
+        )
         # flight-recorder spans: real tracer only when a trace dir is
         # set, the pinned null tracer otherwise
         self.tracer = (

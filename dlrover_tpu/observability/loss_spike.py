@@ -19,6 +19,31 @@ from typing import Deque, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def format_culprits(
+    sample_ids: Optional[Sequence[int]] = None,
+    per_sample_losses=None,
+    top_k: int = 8,
+) -> str:
+    """``id:loss`` pairs for the worst offending samples (reference:
+    TokenLossSpike's sample decoding), or the raw ids when no per-sample
+    losses are available. Shared by the dated-file record and the
+    NumericEvent detail the detector publishes."""
+    if per_sample_losses is not None:
+        ps = np.asarray(per_sample_losses).reshape(-1)
+        order = np.argsort(-ps)[: min(top_k, ps.size)]
+        ids = (
+            [int(sample_ids[i]) for i in order]
+            if sample_ids is not None
+            else [int(i) for i in order]
+        )
+        return ",".join(
+            f"{i}:{ps_i:.4f}" for i, ps_i in zip(ids, ps[order])
+        )
+    if sample_ids is not None:
+        return ",".join(str(int(i)) for i in sample_ids)
+    return ""
+
+
 class LossSpikeDetector:
     """Detect + persist loss spikes.
 
@@ -32,6 +57,11 @@ class LossSpikeDetector:
             window, so a run that merely plateaus above the floor does
             not flag every step.
         window: trailing window length for the rolling statistics.
+        publish_events: publish every detected spike onto the telemetry
+            hub as a ``NumericEvent(kind="loss_spike")`` carrying the
+            offending sample ids in ``detail``. Off for auxiliary
+            detectors (e.g. the watchdog's internal one) so a spike is
+            published exactly once per run.
     """
 
     def __init__(
@@ -41,6 +71,7 @@ class LossSpikeDetector:
         min_loss: float = 4.0,
         zscore: Optional[float] = 4.0,
         window: int = 200,
+        publish_events: bool = True,
     ):
         self.save_dir = save_dir
         if save_dir:
@@ -48,6 +79,7 @@ class LossSpikeDetector:
         self.min_iter = min_iter
         self.min_loss = min_loss
         self.zscore = zscore
+        self.publish_events = publish_events
         self._window: Deque[float] = deque(maxlen=window)
         self.spikes: List[Tuple[int, float]] = []
 
@@ -86,21 +118,21 @@ class LossSpikeDetector:
             self._window.append(loss)
             return False
         self.spikes.append((it, loss))
+        culprits = format_culprits(sample_ids, per_sample_losses)
+        if self.publish_events:
+            from dlrover_tpu.observability import telemetry
+
+            hub = telemetry.get_hub()
+            if hub.enabled:
+                hub.publish(
+                    telemetry.NumericEvent(
+                        kind="loss_spike",
+                        step=it,
+                        value=loss,
+                        detail=culprits,
+                    )
+                )
         if self.save_dir:
-            culprits = ""
-            if per_sample_losses is not None:
-                ps = np.asarray(per_sample_losses).reshape(-1)
-                order = np.argsort(-ps)[: min(8, ps.size)]
-                ids = (
-                    [int(sample_ids[i]) for i in order]
-                    if sample_ids is not None
-                    else [int(i) for i in order]
-                )
-                culprits = ",".join(
-                    f"{i}:{ps_i:.4f}" for i, ps_i in zip(ids, ps[order])
-                )
-            elif sample_ids is not None:
-                culprits = ",".join(str(int(i)) for i in sample_ids)
             fname = os.path.join(
                 self.save_dir,
                 time.strftime("loss_spike_%Y%m%d.txt"),

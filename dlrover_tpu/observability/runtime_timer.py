@@ -89,23 +89,44 @@ class RuntimeKernelTimer:
         top_k: int = 15,
         logdir: Optional[str] = None,
     ):
-        if interval_steps <= 0:
-            raise ValueError("interval_steps must be positive")
+        """``interval_steps=0`` disables the cadence: the timer only
+        samples when ``force_next()`` arms it (the watchdog's triggered
+        captures). Negative intervals are a config error."""
+        if interval_steps < 0:
+            raise ValueError("interval_steps must be >= 0")
         self.interval_steps = interval_steps
         self.top_k = top_k
         self._logdir = logdir
         self._breakdown: List[OpTime] = []
         self._sampled_at: int = -1
+        self._sampled_block_k: int = 1
+        self._forced: bool = False
 
     def should_sample(self, step: int) -> bool:
-        return step % self.interval_steps == 0
+        if self._forced:
+            return True
+        return (
+            self.interval_steps > 0 and step % self.interval_steps == 0
+        )
 
-    def profiled_call(self, step: int, fn, *args, **kwargs):
+    def force_next(self) -> None:
+        """Arm a one-shot sample: the next ``profiled_call`` traces
+        regardless of the cadence (anomaly-triggered captures)."""
+        self._forced = True
+
+    def profiled_call(self, step: int, fn, *args, n_steps: int = 1, **kwargs):
         """Run ``fn``; when the cadence hits, run it under a trace and
         refresh the breakdown. Tracing failures degrade to an untimed
-        call (the relay/backend may not support device tracing)."""
+        call (the relay/backend may not support device tracing).
+
+        ``n_steps``: how many train steps ``fn`` executes as one device
+        program (the trainer's fused ``block_k`` path). The breakdown
+        then covers the WHOLE block — ``sampled_block_k`` labels it so
+        consumers never mistake a K-step capture for one step's budget.
+        """
         if not self.should_sample(step):
             return fn(*args, **kwargs)
+        self._forced = False
         import jax
 
         logdir = self._logdir or tempfile.mkdtemp(prefix="dlrover_prof_")
@@ -115,6 +136,7 @@ class RuntimeKernelTimer:
                 jax.block_until_ready(out)
             self._breakdown = parse_perfetto_dir(logdir, self.top_k)
             self._sampled_at = step
+            self._sampled_block_k = max(int(n_steps), 1)
         except Exception:  # noqa: BLE001
             logger.warning(
                 "runtime trace sampling failed at step %d", step,
@@ -133,6 +155,11 @@ class RuntimeKernelTimer:
     @property
     def sampled_at(self) -> int:
         return self._sampled_at
+
+    @property
+    def sampled_block_k(self) -> int:
+        """Steps covered by the current breakdown (1 = a single step)."""
+        return self._sampled_block_k
 
     def summary(self) -> Dict[str, float]:
         return {o.name: o.total_us for o in self._breakdown}

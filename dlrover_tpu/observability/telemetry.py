@@ -139,12 +139,17 @@ class NumericEvent:
 
 @telemetry_record
 class KernelSample:
-    """One op from a sampled runtime-profiler step breakdown."""
+    """One op from a sampled runtime-profiler step breakdown.
+
+    ``block`` is the number of train steps the trace covered: 1 for the
+    classic per-step loop, K when the profiled dispatch was a fused
+    K-step block (the µs then span the whole block, not one step)."""
 
     step: int = -1
     op: str = ""
     us: float = 0.0
     share: float = 0.0
+    block: int = 1
     ts: float = 0.0
 
 
@@ -159,6 +164,9 @@ class PlanRecord:
     planned_hidden_us: float = 0.0
     assumed_ici_gbps: float = 0.0
     update_sharding_reason: str = ""
+    # measured mean step wall time at the bench shape — the watchdog's
+    # baseline for step_time_regression (0 = no plan available)
+    planned_step_time_s: float = 0.0
     ts: float = 0.0
 
 
@@ -196,6 +204,42 @@ class ResourceRecord:
     mem_mb: float = 0.0
     hbm_mb: float = 0.0
     hbm_peak_mb: float = 0.0
+    ts: float = 0.0
+
+
+@telemetry_record
+class AnomalyRecord:
+    """One classified training anomaly from the host-side watchdog.
+
+    ``kind`` is one of observability.watchdog.ANOMALY_KINDS
+    (nan_grads | loss_spike | fp8_saturation | step_time_regression |
+    straggler).  ``capture`` is the path of the triggered-capture
+    artifact when the rate limiter granted one, else ""."""
+
+    kind: str = ""
+    step: int = -1
+    node_id: int = -1
+    value: float = 0.0
+    detail: str = ""
+    capture: str = ""
+    ts: float = 0.0
+
+
+@telemetry_record
+class HealthSummary:
+    """Master-side cross-host correlation of worker AnomalyRecords.
+
+    ``verdict`` encodes the attribution rule: one rank reporting →
+    suspect data/hardware on that host; every rank reporting → suspect
+    model/config.  ``ranks`` is a comma-joined sorted rank list."""
+
+    kind: str = ""
+    first_step: int = -1
+    ranks: str = ""
+    n_ranks: int = 0
+    world: int = 0
+    verdict: str = ""
+    detail: str = ""
     ts: float = 0.0
 
 
@@ -246,12 +290,15 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("hbm_peak_mb", "hbm_peak_mb"),
     ],
     "StragglerRecord": [("straggler_lag_steps", "lag_steps")],
+    "AnomalyRecord": [("anomaly_last_step", "step")],
 }
 _COUNTER_MAP: Dict[str, str] = {
     "ElasticEvent": "elastic_events_total",
     "NumericEvent": "numeric_events_total",
     "CheckpointRecord": "ckpt_records_total",
     "StragglerRecord": "straggler_flags_total",
+    "AnomalyRecord": "anomaly_records_total",
+    "HealthSummary": "health_summaries_total",
 }
 
 
@@ -287,6 +334,7 @@ class MasterSink:
     """
 
     DEFAULT_TYPES = (
+        "AnomalyRecord",
         "CheckpointRecord",
         "ElasticEvent",
         "NumericEvent",
@@ -441,6 +489,7 @@ def plan_record_from_overlap(
     overlap: Optional[Dict],
     suggested_bucket_mb: float = 0.0,
     update_sharding_reason: str = "",
+    planned_step_time_s: float = 0.0,
 ) -> PlanRecord:
     """Build a :class:`PlanRecord` from ``bench.overlap_report`` output."""
     overlap = overlap or {}
@@ -451,6 +500,7 @@ def plan_record_from_overlap(
         planned_hidden_us=float(overlap.get("hidden_us_total", 0.0)),
         assumed_ici_gbps=float(overlap.get("assumed_ici_gbps", 0.0)),
         update_sharding_reason=update_sharding_reason or "",
+        planned_step_time_s=float(planned_step_time_s or 0.0),
     )
 
 

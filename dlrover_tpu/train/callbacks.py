@@ -123,17 +123,9 @@ class LossSpikeCallback(Callback):
     def on_step_end(self, trainer, step, metrics, control):
         if "loss" not in metrics:
             return
-        loss = metrics["loss"]
-        if self.detector.update(step, loss):
-            from dlrover_tpu.observability import telemetry
-
-            hub = telemetry.get_hub()
-            if hub.enabled:
-                hub.publish(
-                    telemetry.NumericEvent(
-                        kind="loss_spike", step=step, value=float(loss)
-                    )
-                )
+        # the detector itself publishes the NumericEvent (with culprit
+        # sample ids when it has them) — no hub duplication here
+        self.detector.update(step, metrics["loss"])
 
 
 class EarlyStoppingCallback(Callback):

@@ -719,6 +719,31 @@ def wsam(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def with_grad_sanitizer(
+    tx: optax.GradientTransformation, mode: str
+) -> optax.GradientTransformation:
+    """Chain ``numeric.sanitize_grads(mode)`` IN FRONT of ``tx`` (the
+    guard must see the raw gradients, before any clip rescales a spike
+    into range).
+
+    Keeps the wrapped optimizer reachable from the ZeRO update-sharding
+    path: the sanitizer's state is a scalar counter (which the flat
+    probe threads natively), and when ``tx`` advertises a plan-aware
+    ``_flat_factory`` it is re-advertised with the same guard chained
+    onto the flat stream — "skip"/"zero" act elementwise, so they mean
+    the same thing on the packed ``[n_buckets, bucket_elems]`` view.
+    """
+    from dlrover_tpu.observability.numeric import sanitize_grads
+
+    wrapped = optax.chain(sanitize_grads(mode), tx)
+    factory = getattr(tx.init, "_flat_factory", None)
+    if factory is not None:
+        wrapped.init._flat_factory = lambda plan: optax.chain(
+            sanitize_grads(mode), factory(plan)
+        )
+    return wrapped
+
+
 def make_optimizer(
     name: str = "adamw",
     learning_rate: float = 3e-4,
@@ -732,6 +757,7 @@ def make_optimizer(
     state_dtype: Optional[str] = None,
     offload_states: bool = False,
     fused: bool = False,
+    sanitize_grads: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Build the training optimizer.
 
@@ -750,7 +776,28 @@ def make_optimizer(
     one write per state leaf. Composes with state_dtype
     None/"bfloat16"/"factored" and with ``offload_states`` (the
     streamed walk absorbs the clip).
+    ``sanitize_grads`` ("skip"/"zero") chains the non-finite gradient
+    guard from ``observability/numeric.py`` in front of everything (see
+    ``with_grad_sanitizer``).
     """
+    if sanitize_grads is not None:
+        return with_grad_sanitizer(
+            make_optimizer(
+                name=name,
+                learning_rate=learning_rate,
+                weight_decay=weight_decay,
+                b1=b1,
+                b2=b2,
+                grad_clip=grad_clip,
+                warmup_steps=warmup_steps,
+                decay_steps=decay_steps,
+                schedule=schedule,
+                state_dtype=state_dtype,
+                offload_states=offload_states,
+                fused=fused,
+            ),
+            sanitize_grads,
+        )
     if schedule in ("none", "const", "constant"):
         lr = learning_rate
     else:

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dlrover_tpu.common import jax_compat
 from dlrover_tpu.models import decoder
 from dlrover_tpu.models.config import ModelConfig
+from dlrover_tpu.observability import sentinels as snt
 from dlrover_tpu.parallel import sharding as shd
 
 logger = logging.getLogger(__name__)
@@ -553,6 +554,7 @@ class TrainStepBuilder:
         attn_impl: str = "auto",
         offload_opt_state: bool = False,
         comm: Optional[shd.CommConfig] = None,
+        health_sentinels: bool = False,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -562,6 +564,11 @@ class TrainStepBuilder:
         self.attn_impl = attn_impl
         self.offload_opt_state = offload_opt_state
         self.comm = comm
+        # in-graph numeric-health scalars appended to the step metrics
+        # (observability/sentinels.py); rides the existing metrics
+        # readback — no extra host syncs, no extra collectives beyond
+        # widening the metric psum the sharded region already issues
+        self.health_sentinels = health_sentinels
         # resolved ZeRO-1 state: active flag, fallback reason (None when
         # active or never requested), and the static flat pack layout
         self.update_sharding, self.update_sharding_reason, self._plan = (
@@ -717,6 +724,24 @@ class TrainStepBuilder:
         state is laid out for the step that will actually run."""
         return self.comm if self.update_sharding else None
 
+    def _sentinel_metrics(
+        self, params, updates, loss, new_fp8, new_opt, counts
+    ) -> Dict[str, Any]:
+        """The health-sentinel scalars for one step (see
+        observability/sentinels.py for the key contract).  ``counts`` is
+        the [5] grad-count vector — computed on the gradient tree in the
+        replicated path, or inside the sharded region's packed psum so
+        the reduction rides the existing collective."""
+        out = snt.counts_to_metrics(counts, snt.static_size(params))
+        out["sent_update_ratio"] = snt.update_ratio(updates, params)
+        out["sent_loss_nonfinite"] = snt.loss_nonfinite(loss)
+        if new_fp8 is not None:
+            out["sent_fp8_sat"] = snt.fp8_saturation(new_fp8)
+        skips = snt.sanitizer_count(new_opt)
+        if skips is not None:
+            out["sent_sanitizer_skips"] = skips
+        return out
+
     def _sharded_step_fn(
         self, state: TrainState, batch
     ) -> Tuple[TrainState, Dict]:
@@ -773,6 +798,7 @@ class TrainStepBuilder:
         tie = cfg.tie_embeddings
         zoo = len(plan.mesh_axes) > 1
         defer = self.update_mode == "zero1"
+        sent = self.health_sentinels
         fp8 = state.get("fp8") if cfg.fp8 else None
         if a > 1:
             # microbatch split OUTSIDE the region so the (rank,
@@ -875,7 +901,7 @@ class TrainStepBuilder:
                     else:
                         sh_acc = sh_acc + exchange(g, gz)
                 shards = exchange(g_acc, gz_acc) if defer else sh_acc
-                metrics = {"loss": jax.lax.psum(loss_acc, "dp") / a}
+                loc = {"loss": loss_acc}
                 nf8 = None
             elif a > 1 and defer:
                 # ZeRO-1 deferred exchange: accumulate the full local
@@ -902,9 +928,7 @@ class TrainStepBuilder:
                     micro, init, batch
                 )
                 shards = exchange(g_acc, gz_acc)
-                metrics = {
-                    "loss": jax.lax.psum(loss_acc, "dp") / a
-                }
+                loc = {"loss": loss_acc}
             elif a > 1:
                 # zero2 (the boolean default): reduce-scatter EVERY
                 # microbatch and accumulate the shards — the order the
@@ -935,15 +959,42 @@ class TrainStepBuilder:
                     (zeros, jnp.zeros([], jnp.float32), f8_zero),
                     batch,
                 )
-                metrics = {
-                    "loss": jax.lax.psum(loss_acc, "dp") / a
-                }
+                loc = {"loss": loss_acc}
             else:
-                _, metrics, g, gz, nf8 = local_grads(params, f8, batch)
-                metrics = {
-                    k: jax.lax.psum(v, "dp") for k, v in metrics.items()
-                }
+                _, loc, g, gz, nf8 = local_grads(params, f8, batch)
                 shards = exchange(g, gz)
+            if sent:
+                # sentinel counts over THIS RANK's post-exchange shard of
+                # the averaged gradient, packed with the metric scalars
+                # into a single psum — the counts ride the metrics'
+                # existing all-reduce instead of adding a collective.
+                # Elementwise psum over the concatenation reduces each
+                # lane exactly like a standalone scalar psum, so "loss"
+                # stays bitwise identical to the sentinels-off lowering.
+                cnt = snt.grad_counts(shards / a if a > 1 else shards)
+                keys = list(loc)
+                vec = jax.lax.psum(
+                    jnp.concatenate(
+                        [
+                            jnp.stack(
+                                [
+                                    loc[k].astype(jnp.float32)
+                                    for k in keys
+                                ]
+                            ),
+                            cnt,
+                        ]
+                    ),
+                    "dp",
+                )
+                metrics = {k: vec[i] for i, k in enumerate(keys)}
+                metrics["_sent_counts"] = vec[len(keys):]
+            else:
+                metrics = {
+                    k: jax.lax.psum(v, "dp") for k, v in loc.items()
+                }
+            if a > 1:
+                metrics["loss"] = metrics["loss"] / a
             if f8 is not None:
                 # global amax: per-rank states differ only in the new
                 # slot (this rank's local amax); max over dp = the
@@ -1019,7 +1070,19 @@ class TrainStepBuilder:
                 self._param_shardings,
             )
         metrics = dict(metrics)
+        counts = metrics.pop("_sent_counts", None)
         metrics["grad_norm"] = optax.global_norm(grads_flat)
+        if self.health_sentinels:
+            metrics.update(
+                self._sentinel_metrics(
+                    state["params"],
+                    updates,
+                    metrics["loss"],
+                    new_fp8,
+                    new_opt,
+                    counts,
+                )
+            )
         new_state = {
             "params": params,
             "opt_state": new_opt,
@@ -1076,6 +1139,17 @@ class TrainStepBuilder:
             new_opt = _to_memory_kind(new_opt, _HOST)
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
+        if self.health_sentinels:
+            metrics.update(
+                self._sentinel_metrics(
+                    state["params"],
+                    updates,
+                    loss,
+                    new_fp8,
+                    new_opt,
+                    snt.grad_counts(grads),
+                )
+            )
         new_state = {
             "params": params,
             "opt_state": new_opt,

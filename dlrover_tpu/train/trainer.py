@@ -103,6 +103,18 @@ class TrainerArgs:
     # override wire dtype when the dp axis crosses DCN slices; None =
     # use comm_wire_dtype everywhere
     comm_wire_dtype_dcn: Optional[str] = None
+    # in-graph health sentinels (observability/sentinels.py): numeric
+    # health scalars computed inside the jitted step, riding the
+    # existing metrics drain (zero extra host syncs). Also enables the
+    # host-side watchdog — anomaly classification (AnomalyRecords on
+    # the hub) plus rate-limited triggered captures when a runtime
+    # timer is available.
+    health_sentinels: bool = False
+    # chain the non-finite gradient guard (observability/numeric.py) in
+    # front of the optimizer: None = off, "skip" = drop the whole
+    # update when any entry is non-finite, "zero" = zero just the
+    # offending entries
+    sanitize_grads: Optional[str] = None
 
 
 class Trainer:
@@ -139,6 +151,22 @@ class Trainer:
         self.mesh = mesh if mesh is not None else build_mesh(
             MeshConfig(dp=-1)
         )
+        if args.sanitize_grads:
+            if step_builder is None:
+                from dlrover_tpu.train.optimizer import with_grad_sanitizer
+
+                optimizer = with_grad_sanitizer(
+                    optimizer, args.sanitize_grads
+                )
+            else:
+                # the handed-in builder already baked its optimizer;
+                # wrapping ours now would desync init_state from the step
+                logger.warning(
+                    "sanitize_grads=%r ignored: an external step_builder "
+                    "was supplied — wrap its optimizer with "
+                    "with_grad_sanitizer instead",
+                    args.sanitize_grads,
+                )
         self.optimizer = optimizer
         self.train_iter = iter(train_iter)
         self.eval_iter_fn = eval_iter_fn
@@ -163,6 +191,7 @@ class Trainer:
             loss_fn=loss_fn,
             attn_impl=args.attn_impl,
             comm=comm,
+            health_sentinels=args.health_sentinels,
         )
         self._step_fn = None
         self._block_fn = None
@@ -209,13 +238,31 @@ class Trainer:
         )
         self._ckpt = None
         self.runtime_timer = None
-        if args.profile_interval:
+        if args.profile_interval or args.health_sentinels:
             from dlrover_tpu.observability.runtime_timer import (
                 RuntimeKernelTimer,
             )
 
+            # profile_interval=0 + sentinels: a forced-only timer so the
+            # watchdog's triggered captures can still sample a step
             self.runtime_timer = RuntimeKernelTimer(
                 interval_steps=args.profile_interval
+            )
+        self.watchdog = None
+        if args.health_sentinels:
+            from dlrover_tpu.observability.watchdog import (
+                Watchdog,
+                WatchdogConfig,
+            )
+
+            self.watchdog = Watchdog(
+                WatchdogConfig(
+                    node_id=int(
+                        os.environ.get(GraftEnv.NODE_ID, "-1") or -1
+                    ),
+                    capture_dir=os.environ.get(GraftEnv.TRACE_DIR)
+                    or os.path.join(args.output_dir, "captures"),
+                )
             )
         self.control = TrainerControl()
         self.callbacks = CallbackList(callbacks)
@@ -225,6 +272,10 @@ class Trainer:
         # report (bench sets this); compared against the measured
         # runtime-trace collective time → OverlapDriftRecord
         self.planned_exposed_us = 0.0
+        # bench-measured step time for this shape (PlanRecord.
+        # planned_step_time_s); the watchdog's step_time_regression
+        # baseline. 0 = no plan, drift detection off.
+        self.planned_step_time_s = 0.0
         # restart>0 means we are recovering: the first completed step
         # closes the failover timeline ("first-step-back")
         self._first_step_pending = (
@@ -386,7 +437,7 @@ class Trainer:
             hub.publish(
                 telemetry.KernelSample(
                     step=step, op=op.name, us=op.total_us,
-                    share=op.fraction,
+                    share=op.fraction, block=rt.sampled_block_k,
                 )
             )
         hub.publish(
@@ -422,10 +473,38 @@ class Trainer:
             else:
                 self.state, metrics = self._step_fn(self.state, batch)
             self.timer.stop(outputs=metrics["loss"])
-            loss = float(metrics["loss"])
+            # ONE device→host transfer per step, sentinels or not — the
+            # sentinel scalars ride the same readback as the loss
+            # (dispatch-guard-pinned in tests/test_sentinels.py)
+            host = jax.device_get(metrics)
+            loss = float(host["loss"])
             self._emit_step_telemetry(step, loss, self.timer.last_s, batch)
             if self.runtime_timer is not None:
                 self._emit_kernel_telemetry(step)
+            if self.watchdog is not None:
+                if (
+                    self.watchdog.capture_pending
+                    and self.runtime_timer is not None
+                    and self.runtime_timer.sampled_at == step
+                ):
+                    # the force-armed sample just ran: attach it
+                    self.watchdog.write_capture(
+                        step,
+                        self.runtime_timer.breakdown,
+                        planned_exposed_us=self.planned_exposed_us,
+                        block=self.runtime_timer.sampled_block_k,
+                    )
+                self.watchdog.observe(
+                    step,
+                    {k: float(v) for k, v in host.items()},
+                    step_time_s=self.timer.last_s,
+                    planned_step_time_s=self.planned_step_time_s,
+                )
+                if (
+                    self.watchdog.capture_pending
+                    and self.runtime_timer is not None
+                ):
+                    self.runtime_timer.force_next()
             window_loss += loss
             window_n += 1
             self.callbacks.fire(
@@ -536,6 +615,14 @@ class Trainer:
         last_evaled = -1
         pending = None  # (first_step, k, device_metrics, t_dispatch)
 
+        def per_step_metrics(host, i, k):
+            # one step's slice of the block's stacked [K] metric arrays
+            out = {}
+            for key, val in host.items():
+                arr = np.asarray(val).reshape(-1)
+                out[key] = float(arr[i] if arr.size == k else arr[0])
+            return out
+
         def drain(first, k, metrics, t0):
             host = jax.device_get(metrics)  # previous block: finished
             self.timer.record(time.perf_counter() - t0, n_steps=k)
@@ -545,6 +632,13 @@ class Trainer:
                 s = first + i
                 loss = float(losses[i])
                 self._emit_step_telemetry(s, loss, per_step_s, n_steps=k)
+                if self.watchdog is not None:
+                    self.watchdog.observe(
+                        s,
+                        per_step_metrics(host, i, k),
+                        step_time_s=per_step_s,
+                        planned_step_time_s=self.planned_step_time_s,
+                    )
                 window["loss"] += loss
                 window["n"] += 1
                 self.callbacks.fire(
@@ -571,6 +665,13 @@ class Trainer:
                         else "",
                     )
                     window["loss"], window["n"] = 0.0, 0
+            if (
+                self.watchdog is not None
+                and self.watchdog.capture_pending
+                and self.runtime_timer is not None
+            ):
+                # anomaly in this drain: force-sample the next block
+                self.runtime_timer.force_next()
 
         exhausted = False
         while (
@@ -603,9 +704,23 @@ class Trainer:
                 )
                 if sample is not None:
                     self.state, metrics = self.runtime_timer.profiled_call(
-                        sample, self._block_fn, self.state, block
+                        sample, self._block_fn, self.state, block,
+                        n_steps=k,
                     )
                     self._emit_kernel_telemetry(sample)
+                    if (
+                        self.watchdog is not None
+                        and self.watchdog.capture_pending
+                        and self.runtime_timer.sampled_at == sample
+                    ):
+                        # labeled as a K-step block capture, never
+                        # passed off as one step's budget
+                        self.watchdog.write_capture(
+                            sample,
+                            self.runtime_timer.breakdown,
+                            planned_exposed_us=self.planned_exposed_us,
+                            block=self.runtime_timer.sampled_block_k,
+                        )
                 else:
                     self.state, metrics = self._block_fn(self.state, block)
             else:

@@ -704,3 +704,128 @@ def test_live_reshard_eviction_drill(tmp_path):
         _kill_tree(agent)
         if master is not None:
             master.kill()
+
+
+@pytest.mark.slow
+def test_nan_fault_health_drill(monkeypatch, tmp_path):
+    """Health-sentinel stage of the drill: a worker whose batch poisons
+    the gradients at step 4 must produce — across the REAL wire — an
+    AnomalyRecord on the master's flight recorder, a triggered runtime
+    capture on the worker, a HealthSummary verdict from the master's
+    aggregator, and a healthcheck CLI report (run as the operator
+    would, `python -m ...healthcheck`) naming the failing rank and the
+    first bad step."""
+    import glob as _glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.models import decoder, get_config
+    from dlrover_tpu.observability import telemetry
+    from dlrover_tpu.parallel import MeshConfig, build_mesh
+    from dlrover_tpu.train import Trainer, TrainerArgs, make_optimizer
+
+    run_id = f"nandrill{os.getpid()}"
+    tel_dir = str(tmp_path / "telemetry")
+    monkeypatch.setenv("DLROVER_TPU_RUN_ID", run_id)
+    monkeypatch.setenv("DLROVER_TPU_NODE_ID", "1")
+    master = None
+    telemetry.reset_hub()
+    try:
+        master, mq, mlines, maddr = _start_master(
+            run_id,
+            argv_extra=("--num-workers", "2"),
+            env_extra={"DLROVER_TPU_TELEMETRY_DIR": tel_dir},
+        )
+        client = MasterClient(maddr, node_id=1)
+        telemetry.configure_hub(sinks=[telemetry.MasterSink(client)])
+
+        cfg = get_config(
+            "tiny", n_layer=2, d_model=64, d_ff=128, n_head=4,
+            vocab_size=128, max_seq=32,
+        )
+        mesh = build_mesh(MeshConfig(dp=8))
+
+        def poison_loss(params, batch, **kw):
+            clean = {k: v for k, v in batch.items() if k != "poison"}
+            loss, metrics = decoder.loss_fn(
+                params, clean, cfg=cfg, mesh=mesh
+            )
+            bad = jnp.max(batch["poison"]) > 0
+            return loss * jnp.where(bad, jnp.float32(jnp.nan), 1.0), metrics
+
+        def data():
+            rng = np.random.RandomState(0)
+            step = 0
+            while True:
+                step += 1
+                base = rng.randint(0, 8, size=(8, 33))
+                yield {
+                    "tokens": np.asarray(base[:, :-1], np.int32),
+                    "targets": np.asarray(base[:, 1:], np.int32),
+                    "poison": np.full(
+                        (8, 32), 1 if step == 4 else 0, np.int32
+                    ),
+                }
+
+        args = TrainerArgs(
+            output_dir=str(tmp_path / "out"), max_steps=6,
+            save_interval=0, log_interval=0, report_to_master=False,
+            detect_loss_spikes=False, resume=False,
+            health_sentinels=True, sanitize_grads="skip",
+        )
+        t = Trainer(
+            cfg, args, data(), make_optimizer(learning_rate=1e-3),
+            mesh=mesh, loss_fn=poison_loss,
+        )
+        t.train()
+
+        # worker side: classified anomaly with a triggered capture
+        (rec,) = [r for r in t.watchdog.anomalies if r.kind == "nan_grads"]
+        assert rec.step == 4 and rec.node_id == 1
+        assert rec.capture and os.path.exists(rec.capture)
+        assert json.load(open(rec.capture))["ops"]
+
+        # master side: the wire-forwarded record and the aggregator's
+        # verdict both land on the master's flight recorder
+        deadline = time.time() + 30
+        jsonl = None
+        while time.time() < deadline:
+            for path in _glob.glob(
+                os.path.join(tel_dir, "telemetry-master-*.jsonl")
+            ):
+                body = open(path).read()
+                if '"AnomalyRecord"' in body and '"HealthSummary"' in body:
+                    jsonl = path
+                    break
+            if jsonl:
+                break
+            time.sleep(0.5)
+        assert jsonl, "master flight recorder never saw the anomaly"
+
+        # operator side: the offline CLI replays the jsonl to the same
+        # diagnosis, exit code 1 because anomalies are present
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "dlrover_tpu.observability.healthcheck",
+                jsonl,
+                "--world",
+                "2",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "nan_grads" in proc.stdout
+        assert "failing rank(s) 1" in proc.stdout
+        assert "first bad step 4" in proc.stdout
+        assert "suspect_data_or_hardware" in proc.stdout
+    finally:
+        telemetry.reset_hub()
+        if master is not None:
+            master.kill()

@@ -268,3 +268,125 @@ def test_runtime_timer_in_trainer(tmp_path):
     tr.train()
     assert tr.runtime_timer.sampled_at in (2, 4)
     assert tr.runtime_timer.breakdown
+
+
+# ---------------------------------------------------------------------------
+# runtime-timer plumbing the watchdog's triggered captures rely on
+# ---------------------------------------------------------------------------
+
+
+def _write_trace(root, events, sub="plugins/profile/run1"):
+    import gzip
+    import os
+
+    d = os.path.join(str(root), sub)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "perfetto_trace.json.gz")
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return path
+
+
+def test_parse_perfetto_canned_fixture(tmp_path):
+    """Canned perfetto payload: aggregation, ordering, fraction
+    normalization, noise filtering, and top_k truncation — without a
+    live profiler run."""
+    from dlrover_tpu.observability.runtime_timer import parse_perfetto_dir
+
+    assert parse_perfetto_dir(str(tmp_path)) == []  # no trace yet
+    _write_trace(
+        tmp_path,
+        [
+            {"ph": "X", "name": "fusion.1", "dur": 100.0},
+            {"ph": "X", "name": "fusion.1", "dur": 50.0},
+            {"ph": "X", "name": "dot.2", "dur": 300.0},
+            # noise: python frames, runtime threads, non-complete events
+            {"ph": "X", "name": "$py_frame", "dur": 999.0},
+            {"ph": "X", "name": "jit/fn/call", "dur": 999.0},
+            {"ph": "X", "name": "PjitFunction(step)", "dur": 999.0},
+            {"ph": "X", "name": "Thread 12", "dur": 999.0},
+            {"ph": "M", "name": "dot.2", "dur": 999.0},
+            {"ph": "X", "name": "", "dur": 999.0},
+        ],
+    )
+    bd = parse_perfetto_dir(str(tmp_path))
+    assert [o.name for o in bd] == ["dot.2", "fusion.1"]
+    assert bd[0].total_us == 300.0 and bd[0].count == 1
+    assert bd[1].total_us == 150.0 and bd[1].count == 2
+    assert bd[0].fraction == pytest.approx(300.0 / 450.0)
+    assert sum(o.fraction for o in bd) == pytest.approx(1.0)
+    top = parse_perfetto_dir(str(tmp_path), top_k=1)
+    assert [o.name for o in top] == ["dot.2"]
+
+
+def test_parse_perfetto_picks_newest_trace(tmp_path):
+    import os
+    import time as _time
+
+    from dlrover_tpu.observability.runtime_timer import parse_perfetto_dir
+
+    old = _write_trace(
+        tmp_path, [{"ph": "X", "name": "old_op", "dur": 1.0}], sub="a"
+    )
+    new = _write_trace(
+        tmp_path, [{"ph": "X", "name": "new_op", "dur": 1.0}], sub="b"
+    )
+    now = _time.time()
+    os.utime(old, (now - 60, now - 60))
+    os.utime(new, (now, now))
+    assert [o.name for o in parse_perfetto_dir(str(tmp_path))] == ["new_op"]
+
+
+def test_runtime_timer_forced_one_shot(tmp_path):
+    """interval_steps=0 is forced-only mode: the cadence never fires,
+    force_next() arms exactly one sample, and profiled_call records the
+    block size it actually traced."""
+    from dlrover_tpu.observability.runtime_timer import RuntimeKernelTimer
+
+    with pytest.raises(ValueError):
+        RuntimeKernelTimer(interval_steps=-1)
+
+    timer = RuntimeKernelTimer(interval_steps=0, logdir=str(tmp_path))
+    assert not any(timer.should_sample(s) for s in range(1, 50))
+    timer.force_next()
+    assert timer.should_sample(7)
+
+    out = timer.profiled_call(7, lambda a, b: a + b, 2, 3, n_steps=4)
+    assert out == 5
+    assert timer.sampled_at == 7
+    # a 4-step fused block is labeled as such, never as one step
+    assert timer.sampled_block_k == 4
+    # one-shot: the forced flag is consumed by the sample
+    assert not any(timer.should_sample(s) for s in range(8, 50))
+
+
+def test_loss_spike_publishes_numeric_event_with_culprits():
+    """The spike detector is a telemetry producer: a detected spike
+    lands on the hub as a NumericEvent whose detail names the worst
+    offending sample ids (satellite: sample-id attribution)."""
+    from dlrover_tpu.observability import telemetry
+    from dlrover_tpu.observability.loss_spike import LossSpikeDetector
+
+    telemetry.reset_hub()
+    try:
+        hub = telemetry.configure_hub()
+        got = []
+        hub.subscribe(got.append, types=("NumericEvent",))
+        det = LossSpikeDetector(
+            save_dir="", min_iter=0, min_loss=0.0, publish_events=True
+        )
+        for i in range(30):  # jittered baseline: sd > 0
+            det.update(i, 1.0 + 0.01 * (i % 5))
+        assert det.update(
+            30,
+            10.0,
+            sample_ids=[3, 7, 9],
+            per_sample_losses=[0.5, 9.0, 2.0],
+        )
+        (ev,) = got
+        assert ev.kind == "loss_spike" and ev.step == 30
+        assert ev.value == pytest.approx(10.0)
+        assert ev.detail.startswith("7:9.0000")  # worst sample first
+        assert "9:2.0000" in ev.detail and "3:0.5000" in ev.detail
+    finally:
+        telemetry.reset_hub()

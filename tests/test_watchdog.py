@@ -1,0 +1,578 @@
+"""Watchdog classification, capture budget, cross-host correlation,
+and the offline healthcheck CLI (observability/watchdog.py +
+observability/healthcheck.py)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.observability import healthcheck, telemetry
+from dlrover_tpu.observability.telemetry import configure_hub, reset_hub
+from dlrover_tpu.observability.watchdog import (
+    HealthAggregator,
+    Watchdog,
+    WatchdogConfig,
+    verdict_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    reset_hub()
+    yield
+    reset_hub()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _Op:
+    """Duck-typed OpTime for write_capture."""
+
+    def __init__(self, name, us=100.0):
+        self.name = name
+        self.total_us = us
+        self.count = 4
+        self.fraction = 0.5
+
+
+def _watchdog(tmp_path=None, **kw):
+    clock = kw.pop("clock", None) or FakeClock()
+    cfg = WatchdogConfig(
+        node_id=kw.pop("node_id", 0),
+        capture_dir=str(tmp_path / "caps") if tmp_path else "",
+        **kw,
+    )
+    return Watchdog(cfg, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# classification (table-driven over every anomaly kind)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "metrics,kw,kind",
+    [
+        ({"sent_nonfinite": 3.0}, {}, "nan_grads"),
+        ({"sent_loss_nonfinite": 1.0}, {}, "nan_grads"),
+        ({"sent_fp8_sat": 0.9}, {}, "fp8_saturation"),
+        (
+            {"loss": 1.0},
+            {"step_time_s": 2.0, "planned_step_time_s": 1.0},
+            "step_time_regression",
+        ),
+    ],
+)
+def test_classifies_kind(metrics, kw, kind):
+    wd = _watchdog()
+    out = wd.observe(10, metrics, **kw)
+    assert [r.kind for r in out] == [kind]
+    rec = out[0]
+    assert rec.step == 10 and rec.node_id == 0
+    assert rec.capture == ""  # no capture_dir → classification only
+
+
+def test_no_anomaly_on_healthy_step():
+    wd = _watchdog()
+    assert wd.observe(
+        5,
+        {"loss": 2.0, "sent_nonfinite": 0.0, "sent_fp8_sat": 0.1},
+        step_time_s=1.0,
+        planned_step_time_s=1.0,
+    ) == []
+    assert wd.anomalies == []
+
+
+def test_loss_spike_classified():
+    wd = _watchdog(
+        spike_min_iter=5, spike_min_loss=0.0, spike_zscore=3.0,
+        spike_window=50,
+    )
+    out = []
+    for s in range(1, 40):
+        # slight jitter: a perfectly flat baseline has zero std and the
+        # z-score gate (sd > 0) deliberately stays quiet on it
+        out += wd.observe(s, {"loss": 2.0 + 0.001 * (s % 5)})
+    out += wd.observe(40, {"loss": 50.0})
+    assert [r.kind for r in out] == ["loss_spike"]
+    assert out[0].value == 50.0
+
+
+def test_step_time_regression_gates():
+    wd = _watchdog(step_time_factor=1.5, min_step_for_drift=3)
+    # no plan → never fires, however slow
+    assert wd.observe(10, {}, step_time_s=99.0) == []
+    # warmup steps skipped (recompiles)
+    assert wd.observe(
+        2, {}, step_time_s=99.0, planned_step_time_s=1.0
+    ) == []
+    # within factor → quiet
+    assert wd.observe(
+        10, {}, step_time_s=1.4, planned_step_time_s=1.0
+    ) == []
+    out = wd.observe(11, {}, step_time_s=1.6, planned_step_time_s=1.0)
+    assert [r.kind for r in out] == ["step_time_regression"]
+
+
+def test_observe_straggler():
+    wd = _watchdog(node_id=3)
+    rec = wd.observe_straggler(20, lag_steps=15, ratio=0.4)
+    assert rec.kind == "straggler" and rec.node_id == 3
+    assert "lag_steps=15" in rec.detail
+
+
+def test_nan_grads_detail_carries_sanitizer_skips():
+    wd = _watchdog()
+    (rec,) = wd.observe(
+        7,
+        {
+            "sent_nonfinite": 12.0,
+            "sent_loss_nonfinite": 1.0,
+            "sent_sanitizer_skips": 2.0,
+        },
+    )
+    assert "sanitizer_skips=2" in rec.detail
+    assert "nonfinite_grad_entries=12" in rec.detail
+
+
+# ---------------------------------------------------------------------------
+# capture reservation: rate limit + budget under an anomaly storm
+# ---------------------------------------------------------------------------
+
+
+def test_capture_storm_rate_limit_and_budget(tmp_path):
+    clock = FakeClock()
+    cfg = WatchdogConfig(
+        node_id=0,
+        capture_dir=str(tmp_path / "caps"),
+        min_capture_interval_s=60.0,
+        max_captures=2,
+    )
+    wd = Watchdog(cfg, clock=clock)
+
+    # a NaN storm: every step anomalous, but only ONE capture reserved
+    reserved = []
+    for s in range(100):
+        clock.t = float(s)
+        for r in wd.observe(s, {"sent_nonfinite": 1.0}):
+            if r.capture:
+                reserved.append(r.capture)
+    assert len(reserved) == 1
+    assert reserved[0].endswith("capture_step0_nan_grads.json")
+    assert wd.capture_pending == reserved[0]
+
+    # writing frees the in-flight slot, but the rate limit still holds
+    wd.write_capture(1, [_Op("fusion")])
+    assert wd.capture_pending == ""
+    clock.t = 130.0
+    (r2,) = wd.observe(130, {"sent_nonfinite": 1.0})
+    assert r2.capture  # interval elapsed → second capture (budget: 2)
+    wd.write_capture(131, [_Op("fusion")])
+
+    # budget exhausted: no further captures no matter how much time
+    clock.t = 10_000.0
+    (r3,) = wd.observe(10_000, {"sent_nonfinite": 1.0})
+    assert r3.capture == ""
+
+
+def test_write_capture_artifact_content(tmp_path):
+    wd = _watchdog(tmp_path, min_capture_interval_s=0.0)
+    (rec,) = wd.observe(4, {"sent_nonfinite": 2.0})
+    assert rec.capture and wd.capture_pending == rec.capture
+    path = wd.write_capture(
+        5,
+        [_Op("fusion", 300.0), _Op("all-reduce", 100.0)],
+        planned_exposed_us=50.0,
+        block=3,
+        plan={"config": "tiny"},
+    )
+    assert path == rec.capture and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["anomaly"] == {"kind": "nan_grads", "step": 4, "node_id": 0}
+    assert doc["captured_step"] == 5
+    assert doc["block"] == 3  # fused K-step capture is labeled, not hidden
+    assert [o["op"] for o in doc["ops"]] == ["fusion", "all-reduce"]
+    assert doc["plan_diff"]["planned_exposed_us"] == 50.0
+    assert doc["plan"] == {"config": "tiny"}
+    # nothing pending anymore → a second write is a no-op
+    assert wd.write_capture(6, [_Op("x")]) == ""
+
+
+def test_anomalies_publish_to_hub(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    configure_hub(jsonl_path=str(path))
+    wd = _watchdog()
+    wd.observe(3, {"sent_nonfinite": 1.0})
+    lines = path.read_text().strip().splitlines()
+    recs = [telemetry.from_json(line) for line in lines]
+    assert any(
+        isinstance(r, telemetry.AnomalyRecord) and r.kind == "nan_grads"
+        for r in recs
+    )
+
+
+def test_master_sink_forwards_anomaly_records():
+    class FakeClient:
+        def __init__(self):
+            self.sent = []
+
+        def report_telemetry(self, line):
+            self.sent.append(line)
+
+    cl = FakeClient()
+    sink = telemetry.MasterSink(cl)
+    sink.emit(telemetry.StepRecord(step=1))  # hot path: stays local
+    sink.emit(telemetry.AnomalyRecord(kind="nan_grads", step=4, node_id=1))
+    assert len(cl.sent) == 1
+    back = telemetry.from_json(cl.sent[0])
+    assert isinstance(back, telemetry.AnomalyRecord)
+    assert back.kind == "nan_grads" and back.node_id == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-host correlation
+# ---------------------------------------------------------------------------
+
+
+def test_verdict_for_attribution_rule():
+    assert verdict_for(1, 4) == "suspect_data_or_hardware"
+    assert verdict_for(2, 4) == "suspect_partial"
+    assert verdict_for(4, 4) == "suspect_model_or_config"
+    assert verdict_for(5, 4) == "suspect_model_or_config"
+    # unknown world: a single rank still points at the host
+    assert verdict_for(1, 0) == "suspect_data_or_hardware"
+    assert verdict_for(3, 0) == "suspect_partial"
+
+
+def test_aggregator_refines_verdict_as_ranks_join():
+    hub = configure_hub()
+    seen = []
+    hub.subscribe(lambda r: seen.append(r), types=("HealthSummary",))
+    agg = HealthAggregator(hub=hub, world=4)
+
+    hub.publish(telemetry.AnomalyRecord(kind="nan_grads", step=9, node_id=2))
+    assert agg.summaries["nan_grads"].verdict == "suspect_data_or_hardware"
+    assert agg.summaries["nan_grads"].ranks == "2"
+
+    # same rank again: no rank-set growth → no re-publish
+    hub.publish(telemetry.AnomalyRecord(kind="nan_grads", step=11, node_id=2))
+    assert len(seen) == 1
+    # an EARLIER step from the same rank is folded in silently; the
+    # refreshed first_step surfaces with the next rank-set growth
+    hub.publish(telemetry.AnomalyRecord(kind="nan_grads", step=5, node_id=2))
+    assert len(seen) == 1
+
+    for nid in (0, 1, 3):
+        hub.publish(
+            telemetry.AnomalyRecord(kind="nan_grads", step=12, node_id=nid)
+        )
+    s = agg.summaries["nan_grads"]
+    assert s.verdict == "suspect_model_or_config"
+    assert s.ranks == "0,1,2,3" and s.n_ranks == 4 and s.world == 4
+    assert s.first_step == 5
+    assert "2:5" in s.detail  # per-rank first bad step
+    assert len(seen) == 4  # one publish per rank-set growth
+
+
+def test_aggregator_folds_in_straggler_records():
+    hub = configure_hub()
+    agg = HealthAggregator(hub=hub, world=3)
+    hub.publish(
+        telemetry.StragglerRecord(
+            node_id=1, step=40, max_step=55, lag_steps=15, ratio=0.4
+        )
+    )
+    s = agg.summaries["straggler"]
+    assert s.verdict == "suspect_data_or_hardware" and s.ranks == "1"
+
+
+# ---------------------------------------------------------------------------
+# offline healthcheck CLI
+# ---------------------------------------------------------------------------
+
+
+def _write_flight(path, world=2):
+    hub = configure_hub(jsonl_path=str(path))
+    for s in range(1, 6):
+        hub.publish(telemetry.StepRecord(step=s, loss=3.0 - 0.1 * s))
+    hub.publish(
+        telemetry.AnomalyRecord(
+            kind="nan_grads", step=4, node_id=1, value=12.0,
+            detail="nonfinite_grad_entries=12",
+            capture="/caps/capture_step4_nan_grads.json",
+        )
+    )
+    hub.publish(
+        telemetry.NumericEvent(kind="loss_spike", step=3, value=9.0,
+                               detail="samples=[7]")
+    )
+    reset_hub()
+
+
+def test_healthcheck_replay_names_rank_and_step(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    _write_flight(path)
+    # torn tail + foreign line: the replay must skip, not crash
+    with open(path, "a") as f:
+        f.write('{"not": "ours"}\n{"r": "StepRecord", "d": {"st')
+
+    records = healthcheck.load_records(str(path))
+    diag = healthcheck.diagnose(records, world=2)
+    assert not diag["healthy"]
+    info = diag["anomalies"]["nan_grads"]
+    assert info["first_step"] == 4
+    assert info["failing_ranks"] == [1]
+    assert info["verdict"] == "suspect_data_or_hardware"
+    assert info["captures"] == ["/caps/capture_step4_nan_grads.json"]
+    assert diag["steps"]["last_step"] == 5
+
+    report = healthcheck.format_report(diag)
+    assert "failing rank(s) 1" in report
+    assert "first bad step 4" in report
+    assert "suspect_data_or_hardware" in report
+    assert "loss_spike" in report  # numeric events section
+
+
+def test_healthcheck_recorded_summary_takes_precedence(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    hub = configure_hub(jsonl_path=str(path))
+    hub.publish(telemetry.AnomalyRecord(kind="nan_grads", step=4, node_id=1))
+    # the live master saw MORE ranks than this worker's file shows
+    hub.publish(
+        telemetry.HealthSummary(
+            kind="nan_grads", first_step=4, ranks="0,1", n_ranks=2,
+            world=2, verdict="suspect_model_or_config",
+        )
+    )
+    reset_hub()
+    diag = healthcheck.diagnose(
+        healthcheck.load_records(str(path)), world=2
+    )
+    assert diag["anomalies"]["nan_grads"]["verdict"] == (
+        "suspect_model_or_config"
+    )
+
+
+def test_healthcheck_main_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.jsonl"
+    _write_flight(bad)
+    assert healthcheck.main([str(bad), "--world", "2"]) == 1
+    out = capsys.readouterr().out
+    assert "failing rank(s) 1" in out
+
+    ok = tmp_path / "ok.jsonl"
+    hub = configure_hub(jsonl_path=str(ok))
+    hub.publish(telemetry.StepRecord(step=1, loss=2.0))
+    reset_hub()
+    assert healthcheck.main([str(ok)]) == 0
+    assert "healthy" in capsys.readouterr().out
+
+    # --json mode emits the machine-readable diagnosis
+    assert healthcheck.main([str(bad), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["anomalies"]["nan_grads"]["first_step"] == 4
+
+
+def test_anomaly_records_reach_diagnosis_manager():
+    from dlrover_tpu.diagnosis.manager import DiagnosisManager
+
+    hub = configure_hub()
+    dm = DiagnosisManager()
+    dm.attach(hub)
+    hub.publish(
+        telemetry.AnomalyRecord(
+            kind="nan_grads", step=4, node_id=1, value=12.0,
+            capture="/caps/c.json",
+        )
+    )
+    hub.publish(
+        telemetry.HealthSummary(
+            kind="nan_grads", first_step=4, ranks="1", n_ranks=1,
+            world=2, verdict="suspect_data_or_hardware",
+        )
+    )
+    ev1 = [d["content"] for d in dm.diagnosis_data[1]]
+    assert any("anomaly nan_grads at step 4" in c for c in ev1)
+    assert any("capture=/caps/c.json" in c for c in ev1)
+    # the correlated verdict files job-wide AND under the named rank
+    assert any("suspect_data_or_hardware" in c for c in ev1)
+    evj = [d["content"] for d in dm.diagnosis_data[-1]]
+    assert any("suspect_data_or_hardware" in c for c in evj)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end NaN drill: poisoned batch → sentinel → AnomalyRecord →
+# capture artifact → HealthSummary → healthcheck report
+# ---------------------------------------------------------------------------
+
+
+def _drill_pieces(monkeypatch, tmp_path, node_id=1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models import decoder, get_config
+    from dlrover_tpu.parallel import MeshConfig, build_mesh
+
+    monkeypatch.setenv(
+        "DLROVER_TPU_RUN_ID", f"wd{os.getpid()}_{time.time_ns()}"
+    )
+    monkeypatch.setenv("DLROVER_TPU_NODE_ID", str(node_id))
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=64, d_ff=128, n_head=4,
+        vocab_size=128, max_seq=32,
+    )
+    mesh = build_mesh(MeshConfig(dp=8))
+
+    def poison_loss(params, batch, **kw):
+        clean = {k: v for k, v in batch.items() if k != "poison"}
+        loss, metrics = decoder.loss_fn(params, clean, cfg=cfg, mesh=mesh)
+        bad = jnp.max(batch["poison"]) > 0
+        # multiplicative: the GRADIENTS go NaN, not just the loss
+        return loss * jnp.where(bad, jnp.float32(jnp.nan), 1.0), metrics
+
+    def data(poison_step):
+        rng = np.random.RandomState(0)
+        step = 0
+        while True:
+            step += 1
+            base = rng.randint(0, 8, size=(8, 33))
+            yield {
+                "tokens": jnp.asarray(base[:, :-1], jnp.int32),
+                "targets": jnp.asarray(base[:, 1:], jnp.int32),
+                "poison": jnp.full(
+                    (8, 32), 1 if step == poison_step else 0, jnp.int32
+                ),
+            }
+
+    return cfg, mesh, poison_loss, data
+
+
+def test_nan_drill_end_to_end(monkeypatch, tmp_path):
+    """The acceptance drill: one rank hits NaN grads at step 4 → the
+    sentinel trips in-graph, the watchdog classifies an AnomalyRecord
+    with a reserved capture, the next step is force-profiled into the
+    capture artifact, the master-side aggregator attributes the fault
+    to the failing host, and the offline healthcheck replay names the
+    rank and the first bad step."""
+    from dlrover_tpu.train import Trainer, TrainerArgs, make_optimizer
+
+    flight = tmp_path / "flight.jsonl"
+    hub = configure_hub(jsonl_path=str(flight))
+    agg = HealthAggregator(hub=hub, world=2)
+    cfg, mesh, poison_loss, data = _drill_pieces(monkeypatch, tmp_path)
+
+    args = TrainerArgs(
+        output_dir=str(tmp_path), max_steps=6, save_interval=0,
+        log_interval=0, report_to_master=False, detect_loss_spikes=False,
+        resume=False, health_sentinels=True, sanitize_grads="skip",
+    )
+    t = Trainer(
+        cfg, args, data(poison_step=4),
+        make_optimizer(learning_rate=1e-3), mesh=mesh,
+        loss_fn=poison_loss,
+    )
+    state = t.train()
+    assert int(state["step"]) == 6
+
+    # classified on the failing worker, capture attached
+    kinds = {(r.kind, r.step) for r in t.watchdog.anomalies}
+    assert ("nan_grads", 4) in kinds
+    (rec,) = [r for r in t.watchdog.anomalies if r.kind == "nan_grads"]
+    assert rec.node_id == 1 and rec.capture
+    assert os.path.exists(rec.capture)
+    doc = json.load(open(rec.capture))
+    assert doc["anomaly"]["step"] == 4
+    assert doc["captured_step"] == 5  # the next (force-profiled) step
+    assert doc["ops"], "capture carries a runtime breakdown"
+
+    # the sanitizer skipped the poisoned update: weights stayed finite
+    import jax
+    import numpy as np
+
+    assert all(
+        np.isfinite(np.asarray(x)).all()
+        for x in jax.tree.leaves(state["params"])
+    )
+
+    # master-side correlation: 1 of 2 ranks → data/hardware suspicion
+    s = agg.summaries["nan_grads"]
+    assert s.verdict == "suspect_data_or_hardware"
+    assert s.ranks == "1" and s.first_step == 4
+
+    # offline replay reaches the same diagnosis from the jsonl alone
+    diag = healthcheck.diagnose(
+        healthcheck.load_records(str(flight)), world=2
+    )
+    report = healthcheck.format_report(diag)
+    assert "failing rank(s) 1" in report
+    assert "first bad step 4" in report
+    assert "suspect_data_or_hardware" in report
+    assert rec.capture in report
+
+
+@pytest.mark.slow
+def test_nan_drill_fused_block_capture_labeled(monkeypatch, tmp_path):
+    """block_k > 1: the anomaly is detected in the block drain, the
+    NEXT block is force-profiled, and the capture (and its
+    KernelSamples) are labeled with the block size — a K-step trace is
+    never passed off as one step's budget (the profile_interval ×
+    block_k contract)."""
+    from dlrover_tpu.train import Trainer, TrainerArgs, make_optimizer
+
+    flight = tmp_path / "flight.jsonl"
+    configure_hub(jsonl_path=str(flight))
+    cfg, mesh, poison_loss, data = _drill_pieces(monkeypatch, tmp_path)
+
+    args = TrainerArgs(
+        output_dir=str(tmp_path), max_steps=8, block_k=2,
+        save_interval=0, log_interval=0, report_to_master=False,
+        detect_loss_spikes=False, resume=False, health_sentinels=True,
+        sanitize_grads="skip",
+    )
+    t = Trainer(
+        cfg, args, data(poison_step=3),
+        make_optimizer(learning_rate=1e-3), mesh=mesh,
+        loss_fn=poison_loss,
+    )
+    t.train()
+
+    (rec,) = [r for r in t.watchdog.anomalies if r.kind == "nan_grads"]
+    assert rec.step == 3 and rec.capture
+    assert os.path.exists(rec.capture)
+    doc = json.load(open(rec.capture))
+    assert doc["block"] == 2
+    assert doc["captured_step"] > 3  # a later block carried the trace
+    assert doc["ops"]
+
+    samples = [
+        r
+        for r in healthcheck.load_records(str(flight))
+        if isinstance(r, telemetry.KernelSample)
+    ]
+    assert samples and all(r.block == 2 for r in samples)
+
+
+def test_schema_roundtrip_new_records():
+    """AnomalyRecord / HealthSummary survive the wire losslessly (the
+    generic lint in test_telemetry covers defaults; this pins a fully
+    populated instance)."""
+    rec = telemetry.AnomalyRecord(
+        kind="fp8_saturation", step=123, node_id=7, value=0.75,
+        detail="threshold=0.5", capture="/x/y.json", ts=111.5,
+    )
+    back = telemetry.from_json(rec.to_json())
+    assert back == rec
+    s = telemetry.HealthSummary(
+        kind="straggler", first_step=9, ranks="0,3", n_ranks=2, world=8,
+        verdict="suspect_partial", detail="first bad step per rank: 0:9 3:11",
+        ts=222.25,
+    )
+    assert telemetry.from_json(s.to_json()) == s
