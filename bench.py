@@ -578,6 +578,124 @@ def drill_recovery_metric(path=None):
     return out
 
 
+def serving_trajectory_metric(path=None):
+    """The latest serving bench's headline numbers, for the train record.
+
+    Same cross-artifact embed as ``drill_recovery_metric``: the serving
+    bench writes ``SERVE_*.json`` (``bench.py serve`` with
+    ``DLROVER_TPU_SERVE_ARTIFACT_OUT``); the train record carries its
+    tokens/s-at-p99 so one trajectory file compares training AND serving
+    across commits. Reads ``DLROVER_TPU_SERVE_ARTIFACT``, else the
+    newest ``SERVE_*.json`` beside this file; None when serving has not
+    been benched."""
+    import glob
+
+    if path is None:
+        path = os.environ.get("DLROVER_TPU_SERVE_ARTIFACT")
+    if path is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        candidates = sorted(glob.glob(os.path.join(here, "SERVE_*.json")))
+        path = candidates[-1] if candidates else None
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if artifact.get("serve_tokens_per_s") is None:
+        return None
+    return {
+        "serve_tokens_per_s": artifact["serve_tokens_per_s"],
+        "serve_p99_ms": artifact.get("serve_p99_ms"),
+        "p99_target_ms": artifact.get("p99_target_ms"),
+        "p99_met": artifact.get("p99_met"),
+    }
+
+
+def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
+              max_len=64, page_size=8, prefill_chunk=8, max_new=8,
+              p99_target_ms=60000.0, seed=0):
+    """Serving throughput: tokens/sec at a fixed p99 latency target.
+
+    Drives the continuous-batching engine (dlrover_tpu/serving/) with
+    ``n_requests`` mixed-length concurrent requests through the threaded
+    server, after one warmup request that eats both jit compiles
+    (prefill chunk + decode batch). The headline is decode tokens/sec
+    over the timed window, REPORTED AGAINST the p99 end-to-end latency —
+    throughput is only comparable across commits at a fixed tail-latency
+    budget, so ``p99_met`` rides along and a p99 regression shows up
+    even when tokens/s improves. Also records the paged-KV memory story:
+    int8+scales resident bytes vs the bf16 reference geometry (the
+    ≥1.7× reduction the serving docs quote)."""
+    import numpy as np
+
+    import jax
+
+    from dlrover_tpu.models import decoder, get_config
+    from dlrover_tpu.serving import kv_cache as kvc
+    from dlrover_tpu.serving.server import GenerationServer
+
+    cfg = get_config(
+        name, n_layer=2, d_model=64, d_ff=128, n_head=4,
+        vocab_size=128, max_seq=max_len,
+    ) if name == "tiny" else get_config(name, max_seq=max_len)
+    params = decoder.init(jax.random.key(seed), cfg)
+    srv = GenerationServer(
+        params, cfg, replica="bench", n_slots=n_slots, max_len=max_len,
+        page_size=page_size, mode=mode, prefill_chunk=prefill_chunk,
+    ).start()
+    try:
+        # warmup: pays the prefill-chunk + decode-batch compiles
+        srv.generate([1, 2, 3], 2, timeout=600.0)
+        srv.scheduler.reset_latencies()
+        srv.engine._tokens = 0
+        srv.engine._t0 = None
+
+        rng = np.random.default_rng(seed)
+        lens = rng.integers(2, max(3, max_len - max_new - 1), n_requests)
+        t0 = time.perf_counter()
+        futs = [
+            srv.submit(
+                list(rng.integers(1, cfg.vocab_size, int(n))), max_new
+            ).future
+            for n in lens
+        ]
+        for f in futs:
+            f.result(timeout=600.0)
+        dt = time.perf_counter() - t0
+        lat = srv.scheduler.latency_ms()
+        new_tokens = n_requests * max_new
+    finally:
+        srv.stop()
+
+    geom = srv.engine.geom
+    bf16_geom = geom._replace(mode="bf16")
+    b_int8 = kvc.resident_bytes(geom._replace(mode="int8"))
+    b_bf16 = kvc.resident_bytes(bf16_geom)
+    tokens_per_s = new_tokens / dt if dt > 0 else 0.0
+    return {
+        "metric": f"serve_tokens_per_s[{cfg.name},{mode},{n_slots}slots]",
+        "value": round(tokens_per_s, 2),
+        "unit": "new_tokens_per_sec",
+        "serve_tokens_per_s": round(tokens_per_s, 2),
+        "serve_p50_ms": round(lat["p50"], 2),
+        "serve_p99_ms": round(lat["p99"], 2),
+        "p99_target_ms": p99_target_ms,
+        "p99_met": lat["p99"] <= p99_target_ms,
+        "n_requests": n_requests,
+        "max_new_tokens": max_new,
+        "kv_cache": {
+            "mode": mode,
+            "page_size": page_size,
+            "resident_bytes": kvc.resident_bytes(geom),
+            "resident_bytes_int8": b_int8,
+            "resident_bytes_bf16": b_bf16,
+            "reduction_vs_bf16": round(b_bf16 / b_int8, 3),
+        },
+    }
+
+
 def run_config(name, batch, seq, remat, steps=30, warmup=3,
                state_dtype="bfloat16", block_k=1):
     # steps=30: the axon relay's ~100ms host-readback latency is paid
@@ -756,6 +874,9 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         # the elastic half of the trajectory: how long the last drilled
         # failure stopped training (None until a drill has run)
         "elastic_recovery": drill_recovery_metric(),
+        # the serving half: tokens/s at fixed p99 from the last
+        # `bench.py serve` artifact (None until serving has been benched)
+        "serving": serving_trajectory_metric(),
     }
 
 
@@ -842,6 +963,16 @@ def main():
         return
     if len(sys.argv) >= 2 and sys.argv[1] == "--ceiling":
         print(json.dumps(measure_mxu_ceiling()))
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] in ("serve", "--serve"):
+        mode = sys.argv[2] if len(sys.argv) > 2 else "int8"
+        n_requests = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+        record = run_serve(mode=mode, n_requests=n_requests)
+        out = os.environ.get("DLROVER_TPU_SERVE_ARTIFACT_OUT")
+        if out:
+            with open(out, "w") as f:
+                json.dump(record, f)
+        print(json.dumps(record))
         return
     if len(sys.argv) >= 5 and sys.argv[1] == "--single":
         name, batch, seq, remat = (

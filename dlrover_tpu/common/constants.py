@@ -16,6 +16,7 @@ class NodeType:
     CHIEF = "chief"            # rank-0 coordination anchor (TF lineage)
     EVALUATOR = "evaluator"    # side-car eval host, outside the train mesh
     PS = "ps"                  # sparse-tier KvServer host (sparse/server.py)
+    SERVING = "serving"        # generation-serving replica (serving/replica.py)
 
 
 class NodeStatus:
@@ -75,6 +76,7 @@ class JobExitReason:
 class RendezvousName:
     TRAINING = "elastic-training"
     NETWORK_CHECK = "network-check"
+    SERVING = "serving"
 
 
 class TaskType:

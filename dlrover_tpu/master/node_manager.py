@@ -324,6 +324,13 @@ class JobManager:
         with self._lock:
             return [n for n in self._nodes.values() if n.type == node_type]
 
+    def serving_nodes(self) -> List[Node]:
+        """Generation-serving replicas (serving/replica.py). They register
+        like trainer nodes — heartbeats, failure detection and eviction
+        flow through the same machinery — but live outside the train
+        rendezvous, so job completion never waits on them."""
+        return self.nodes_of_type(NodeType.SERVING)
+
     def all_workers_exited(self) -> bool:
         with self._lock:
             return all(

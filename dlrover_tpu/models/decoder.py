@@ -29,7 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models.config import ModelConfig
 from dlrover_tpu.ops import pallas_norm
-from dlrover_tpu.ops.attention import mha_reference
+from dlrover_tpu.ops.attention import _repeat_kv, mha_reference
 from dlrover_tpu.parallel import sharding as shd
 
 Params = Dict[str, Any]
@@ -1040,15 +1040,27 @@ def loss_fn(
 # ---------------------------------------------------------------------------
 
 
-def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> Dict:
-    """Per-layer stacked K/V buffers for incremental decoding."""
-    dt = jnp.dtype(cfg.dtype)
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, max_len: int, dtype=None
+) -> Dict:
+    """Per-layer stacked K/V buffers for incremental decoding.
+
+    ``dtype`` defaults to the model compute dtype; the serving tier
+    passes an explicit dtype when it gathers reference bf16 buffers
+    next to its int8 page pools."""
+    dt = jnp.dtype(cfg.dtype if dtype is None else dtype)
     shape = (cfg.n_layer, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
 def _cached_attention(q, ck, cv, pos, cfg: ModelConfig):
-    """q:[B,1,H,D] over cached ck/cv:[B,Smax,Hkv,D]; attends ≤ pos."""
+    """q:[B,1,H,D] over cached ck/cv:[B,Smax,Hkv,D]; attends ≤ pos.
+
+    ``pos`` is a scalar (lockstep batch — offline sampling) or ``[B]``
+    (per-slot positions — the serving engine's continuous batch, where
+    every slot sits at its own depth). The scalar path is untouched so
+    offline rollouts stay bitwise; the per-slot path computes the same
+    elementwise math with a per-row mask."""
     b, _, h, d = q.shape
     smax, hkv = ck.shape[1], ck.shape[2]
     groups = h // hkv
@@ -1061,11 +1073,19 @@ def _cached_attention(q, ck, cv, pos, cfg: ModelConfig):
         qg.astype(jnp.float32),
         ck.astype(jnp.float32),
     ) * scale
-    mask = jnp.arange(smax) <= pos
-    if cfg.attn_window:
-        # sliding window in decode: only the last attn_window cache slots
-        mask = mask & (jnp.arange(smax) > pos - cfg.attn_window)
-    s = jnp.where(mask[None, None, None, :], s, -1e30)
+    pos = jnp.asarray(pos)
+    kpos = jnp.arange(smax)
+    if pos.ndim == 0:
+        mask = kpos <= pos
+        if cfg.attn_window:
+            # sliding window in decode: only the last attn_window slots
+            mask = mask & (kpos > pos - cfg.attn_window)
+        s = jnp.where(mask[None, None, None, :], s, -1e30)
+    else:
+        mask = kpos[None, :] <= pos[:, None]
+        if cfg.attn_window:
+            mask = mask & (kpos[None, :] > pos[:, None] - cfg.attn_window)
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgs,bskd->bkgd", p, cv.astype(jnp.float32))
     return out.reshape(b, 1, h * d).astype(q.dtype)
@@ -1165,7 +1185,7 @@ def decode_step(
     params: Params,
     tokens: jax.Array,  # [B] int32 — token at position ``pos``
     cache: Dict,
-    pos: jax.Array,     # scalar int32
+    pos: jax.Array,     # scalar int32, or [B] int32 per-slot positions
     cfg: ModelConfig,
     prefilled: bool = False,
 ) -> Tuple[jax.Array, Dict]:
@@ -1175,6 +1195,12 @@ def decode_step(
     ``forward`` — the standard KV-cache inference path (the reference
     leans on transformers.generate; here it is native). Single-mesh only
     (no pp/sp); MoE layers route the single token through moe_block.
+
+    ``pos`` may be ``[B]`` — SLOT-INDEXED decoding for the serving
+    engine's continuous batch: every row advances at its own position
+    (its own rope angle, cache write offset and attention mask), so
+    requests at different depths share one step. The scalar path is the
+    original lockstep batch, untouched.
 
     ``prefilled`` asserts the cache came from ``prefill``: required for
     prefix-LM models, whose prompt K/V depend on bidirectional attention
@@ -1197,9 +1223,14 @@ def decode_step(
         )
     dt = jnp.dtype(cfg.dtype)
     b = tokens.shape[0]
+    pos = jnp.asarray(pos)
+    per_slot = pos.ndim == 1
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)[:, None, :]
     x = x.astype(dt)
-    positions = jnp.broadcast_to(pos, (b, 1)).astype(jnp.int32)
+    if per_slot:
+        positions = pos[:, None].astype(jnp.int32)
+    else:
+        positions = jnp.broadcast_to(pos, (b, 1)).astype(jnp.int32)
     if cfg.pos == "learned":
         x = x + jnp.take(
             params["pos_embed"]["table"], positions, axis=0
@@ -1220,8 +1251,19 @@ def decode_step(
         q, k, v = _project_qkv(
             h, layer, cfg, positions, mup_full_scale=True, rope=rope
         )
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+        # external caches may hold a different dtype (f32 reference
+        # buffers); the write adopts it — a no-op at the default dtype
+        k, v = k.astype(ck.dtype), v.astype(cv.dtype)
+        if per_slot:
+            # each slot writes its token row at its OWN position
+            upd = lambda c, u, p: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+                c, u, p, axis=0
+            )
+            ck = jax.vmap(upd)(ck, k, pos)
+            cv = jax.vmap(upd)(cv, v, pos)
+        else:
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
         attn = _cached_attention(q, ck, cv, pos, cfg)
         attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
         x = _cache_layer_tail(x, attn_out, layer, cfg)
@@ -1240,6 +1282,133 @@ def decode_step(
         "bsd,dv->bsv", x, w_out.astype(dt),
         preferred_element_type=jnp.float32,
     )[:, 0]
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _chunk_cached_attention(q, ck, cv, positions, cfg: ModelConfig, scale):
+    """q:[B,C,H,D] over cached ck/cv:[B,Smax,Hkv,D]; query ci attends
+    keys ≤ positions[b, ci].
+
+    The C-query generalization of ``_cached_attention`` used by chunked
+    prefill, written with ``mha_reference``'s exact op sequence
+    (repeat-kv, f32 qk einsum, -1e30 mask, softmax cast to q.dtype) so a
+    chunk that covers a whole prompt reproduces ``prefill``'s logits —
+    cache slots past each query's position contribute exact zeros."""
+    h, hkv = q.shape[2], ck.shape[2]
+    smax = ck.shape[1]
+    if hkv != h:
+        ck = _repeat_kv(ck, h // hkv)
+        cv = _repeat_kv(cv, h // hkv)
+    if jax.default_backend() == "cpu":
+        # mirror mha_reference's CPU-vs-MXU precision split exactly
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q.astype(jnp.float32),
+            ck.astype(jnp.float32),
+        )
+    else:
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
+        )
+    logits = logits * scale
+    kpos = jnp.arange(smax)
+    mask = kpos[None, None, :] <= positions[:, :, None]  # [B, C, Smax]
+    if cfg.attn_window:
+        mask = mask & (kpos[None, None, :] > positions[:, :, None]
+                       - cfg.attn_window)
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+
+def prefill_chunk(
+    params: Params,
+    tokens: jax.Array,  # [B, C] int32 — one prompt chunk per slot
+    cache: Dict,
+    start: jax.Array,   # scalar or [B] int32 — chunk start positions
+    cfg: ModelConfig,
+) -> Tuple[jax.Array, Dict]:
+    """Prefill ``C`` prompt tokens per slot INTO an existing cache.
+
+    The chunked-prefill primitive for the serving engine: a long prompt
+    runs as ceil(P/C) of these between decode steps instead of one
+    monolithic ``prefill``, so admitted long prompts never stall the
+    decode batch. Each slot's chunk starts at its own ``start`` (the
+    tokens already cached for that slot); chunk K/V are written at
+    [start, start+C) and queries attend causally against the whole
+    cache. Chunk tails past a slot's true prompt write garbage the
+    position mask hides — callers route them to scratch storage (the
+    serving tier's trash page) or let later writes overwrite them.
+
+    Returns (logits [B, C, V] f32, updated cache). Causal-only:
+    prefix-LM prompts need the bidirectional masking of ``prefill``.
+    """
+    if not cfg.causal:
+        raise ValueError("prefill_chunk requires a causal model")
+    if cfg.prefix_lm:
+        raise ValueError(
+            "prefill_chunk is causal-only; prefix-LM prompts must be "
+            "prefilled bidirectionally in one prefill() call"
+        )
+    if getattr(cfg, "pp_interleave", 1) > 1:
+        raise ValueError(
+            "prefill_chunk scans layers in storage order; use forward() "
+            "paths for interleave-stacked checkpoints"
+        )
+    dt = jnp.dtype(cfg.dtype)
+    b, c = tokens.shape
+    start = jnp.asarray(start, jnp.int32)
+    if start.ndim == 0:
+        start = jnp.broadcast_to(start, (b,))
+    positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+    nh, hd = cfg.n_head, cfg.head_dim
+    scale = 1.0 if cfg.mup_base_width else hd**-0.5
+    rope = (
+        _rope_tables(positions, hd, cfg.rope_theta)
+        if cfg.pos == "rope"
+        else None
+    )
+
+    def layer_fn(carry, inp):
+        x = carry
+        layer, ck, cv = inp
+        ln1 = layer["ln1"]
+        h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+        q, k, v = _project_qkv(
+            h, layer, cfg, positions, mup_full_scale=True, rope=rope
+        )
+        upd = lambda cc, u, p: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+            cc, u, p, axis=0
+        )
+        ck = jax.vmap(upd)(ck, k.astype(ck.dtype), start)
+        cv = jax.vmap(upd)(cv, v.astype(cv.dtype), start)
+        attn = _chunk_cached_attention(
+            q, ck, cv, positions, cfg, scale
+        ).reshape(b, c, nh * hd)
+        attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
+        x = _cache_layer_tail(x, attn_out, layer, cfg)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt),
+        preferred_element_type=jnp.float32,
+    )
     if cfg.mup_base_width and cfg.tie_embeddings:
         logits = logits * (cfg.mup_base_width / cfg.d_model)
     return logits, {"k": new_k, "v": new_v}

@@ -18,6 +18,18 @@ import jax.numpy as jnp
 from dlrover_tpu.models import decoder
 
 
+def init_kv_cache(cfg, batch: int, max_len: int, dtype=None):
+    """Allocate the KV cache offline sampling and the serving engine
+    share: ``{"k","v"}`` zeros of [n_layer, batch, max_len, Hkv, D].
+
+    ONE allocation site (delegating to ``decoder.init_kv_cache``) so the
+    two consumers can never drift on layout or fill value — the engine's
+    gathered page views and the sampler's inline buffers are the same
+    object shape, pinned bitwise by tests/test_generate_cache.py.
+    ``dtype`` defaults to the model compute dtype."""
+    return decoder.init_kv_cache(cfg, batch, max_len, dtype=dtype)
+
+
 def sample(
     params,
     cfg,
@@ -30,6 +42,7 @@ def sample(
     pad_id: int = 0,
     use_cache: bool = True,
     prompt_lens: Optional[jax.Array] = None,  # [B] int32 true lengths
+    kv_cache: Optional[dict] = None,
 ) -> jax.Array:
     """Sample continuations; returns [B, P + max_new_tokens].
 
@@ -51,6 +64,14 @@ def sample(
     bidirectionally-visible context for every query. (Pad tokens between
     a sequence's true length and P remain ordinarily causally visible on
     every path — left-pad ragged prompts when that matters.)
+
+    ``kv_cache`` (cache path only): an externally allocated
+    ``init_kv_cache(cfg, b, p + max_new_tokens, dtype)`` buffer the
+    rollout decodes in — the serving tier and RL rollout engine allocate
+    caches up front (pooled / donated) instead of per call. Prefill
+    K/V land in its first ``p`` slots at the buffer's dtype; with the
+    default dtype and a zero buffer the rollout is bitwise identical to
+    the inline allocation.
 
     Sampling draws use ``fold_in(rng, position)``, so both paths consume
     the same rng stream. Greedy (temperature=0) rollouts match token for
@@ -93,7 +114,13 @@ def sample(
     ):
         return _sample_cached(
             params, cfg, prompts, max_new_tokens, rng, temperature,
-            pad_id, prefix,
+            pad_id, prefix, kv_cache,
+        )
+    if kv_cache is not None:
+        raise ValueError(
+            "kv_cache was provided but this config/mesh takes the "
+            "full-prefix (cacheless) path; drop the buffer or use a "
+            "cacheable setup"
         )
     total = p + max_new_tokens
     buf = jnp.full((b, total), pad_id, dtype=jnp.int32)
@@ -125,7 +152,8 @@ def sample(
 
 
 def _sample_cached(
-    params, cfg, prompts, max_new_tokens, rng, temperature, pad_id, prefix
+    params, cfg, prompts, max_new_tokens, rng, temperature, pad_id,
+    prefix, kv_cache=None,
 ):
     """Prefill + incremental decode: one batch forward fills the KV
     cache for the whole prompt (prefix-LM masking included), then the
@@ -141,6 +169,23 @@ def _sample_cached(
         params, prompts, cfg, total, prefix_len=prefix
     )
     # grow the cache buffers to total via prefill's max_len — done there
+    if kv_cache is not None:
+        # decode in the caller's buffer: prefill K/V land in its first
+        # p slots at the BUFFER's dtype (prefill pads with zeros, so a
+        # zero buffer at the default dtype stays bitwise identical)
+        for key in ("k", "v"):
+            if kv_cache[key].shape != cache[key].shape:
+                raise ValueError(
+                    f"kv_cache[{key!r}] shape {kv_cache[key].shape} != "
+                    f"required {cache[key].shape} "
+                    f"(init_kv_cache(cfg, {b}, {total}))"
+                )
+        cache = {
+            key: kv_cache[key]
+            .at[:, :, :p]
+            .set(cache[key][:, :, :p].astype(kv_cache[key].dtype))
+            for key in ("k", "v")
+        }
 
     def draw(step_logits, i):
         if temperature > 0.0:

@@ -243,6 +243,28 @@ class HealthSummary:
     ts: float = 0.0
 
 
+@telemetry_record
+class ServingRecord:
+    """Periodic serving-replica snapshot (serving/scheduler.py publish).
+
+    Latencies are end-to-end request milliseconds (submit → complete)
+    over the scheduler's sliding window; ``tokens_per_s`` is the
+    engine's decode throughput since its first step. ``re_admitted``
+    counts failover re-admissions this replica ABSORBED from dead
+    peers (serving/replica.py ReplicaRouter)."""
+
+    replica: str = ""
+    active_slots: int = 0
+    queue_depth: int = 0
+    admitted: int = 0
+    completed: int = 0
+    re_admitted: int = 0
+    tokens_per_s: float = 0.0
+    p50_ms: float = 0.0
+    p99_ms: float = 0.0
+    ts: float = 0.0
+
+
 # ---- sinks ----------------------------------------------------------------
 
 
@@ -291,6 +313,12 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
     ],
     "StragglerRecord": [("straggler_lag_steps", "lag_steps")],
     "AnomalyRecord": [("anomaly_last_step", "step")],
+    "ServingRecord": [
+        ("serving_tokens_per_s", "tokens_per_s"),
+        ("serving_p50_ms", "p50_ms"),
+        ("serving_p99_ms", "p99_ms"),
+        ("serving_queue_depth", "queue_depth"),
+    ],
 }
 _COUNTER_MAP: Dict[str, str] = {
     "ElasticEvent": "elastic_events_total",
@@ -299,6 +327,7 @@ _COUNTER_MAP: Dict[str, str] = {
     "StragglerRecord": "straggler_flags_total",
     "AnomalyRecord": "anomaly_records_total",
     "HealthSummary": "health_summaries_total",
+    "ServingRecord": "serving_records_total",
 }
 
 

@@ -167,6 +167,52 @@ def wire_decode_sum(q: jax.Array, scale: jax.Array) -> jax.Array:
     return jnp.sum(blocks.reshape(r, n), axis=0)
 
 
+def kv_block_size(row_elems: int) -> int:
+    """Scale-block width for one KV token row of ``row_elems`` floats.
+
+    A token row is ``kv_heads * head_dim`` elements — often smaller than
+    the optimizer-state ``BLOCK`` (256). ``_quant_blocks`` is generic
+    over the trailing dim, so narrow rows get one scale per whole row
+    instead of being padded out to 256 (which would inflate the int8
+    cache by the pad and wreck the resident-bytes win)."""
+    if row_elems <= 0:
+        raise ValueError(f"row_elems must be positive, got {row_elems}")
+    if row_elems <= BLOCK:
+        return row_elems
+    # wide rows: largest divisor of the row that fits in BLOCK keeps
+    # blocks uniform (no ragged tail inside a row)
+    for cand in range(BLOCK, 0, -1):
+        if row_elems % cand == 0:
+            return cand
+    return 1
+
+
+def kv_encode_rows(rows: jax.Array, block: int):
+    """Encode KV token rows ``[..., n]`` (n % block == 0) → int8 blocks.
+
+    Returns ``(q [..., n//block, block] int8, scale [..., n//block] f32)``
+    — the serving tier's paged-cache storage encoding, the same
+    EQuARX-style per-block max/127 scheme the gradient wire uses
+    (``wire_encode_rows``), kept unflattened so page pools can index
+    whole blocks."""
+    *lead, n = rows.shape
+    if n % block:
+        raise ValueError(f"row width {n} not a multiple of block {block}")
+    blocks = rows.astype(jnp.float32).reshape(*lead, n // block, block)
+    q, scale = _quant_blocks(blocks, 8)
+    return q, scale[..., 0]
+
+
+def kv_decode_rows(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of ``kv_encode_rows``: ``[..., nb, block]`` → ``[..., n]``.
+
+    Dequantizes in f32 then casts to ``dtype`` (the model compute dtype)
+    — the per-page dequant that runs INSIDE the jitted decode step."""
+    out = _dequant_blocks(q, scale[..., None], 8)
+    *lead, nb, blk = out.shape
+    return out.reshape(*lead, nb * blk).astype(dtype)
+
+
 def _wire_layout(like, bucket_bytes: int):
     sizes = [
         int(math.prod(l.shape)) for l in jax.tree.leaves(like)

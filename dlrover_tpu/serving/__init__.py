@@ -1,0 +1,20 @@
+"""Continuous-batching generation serving tier.
+
+Layers (bottom up):
+
+- ``kv_cache``   — paged KV cache: block-table allocator over fixed-size
+  pages, int8 storage with per-block scales (``ops/quant.py`` encode) or
+  a bf16 reference mode, gather/write helpers that run inside jit.
+- ``engine``     — the continuous-batching decode loop: fixed decode
+  slots, admit/evict at step boundaries, chunked prefill.
+- ``scheduler``  — threaded request queue: priority by arrival,
+  admission control, p50/p99 latency accounting → ``ServingRecord``.
+- ``server``     — the threaded frontend owning the engine loop.
+- ``replica``    — elastic integration: replicas register with the
+  master like trainer nodes; a router re-admits an evicted replica's
+  in-flight requests on survivors.
+
+Import submodules directly (``from dlrover_tpu.serving import engine``)
+— this package init stays import-light so allocator/scheduler unit
+tests never pay the model-stack import.
+"""

@@ -1,0 +1,294 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Orca/vLLM-style iteration-level scheduling on a FIXED decode batch of
+``n_slots`` lanes: requests are admitted into free slots and evicted at
+step boundaries — never mid-step — so the jitted decode step compiles
+once and every iteration runs the full batch with a per-lane ``valid``
+mask. Each step is:
+
+1. finish: resolve slots that hit ``max_new_tokens``/EOS, free pages;
+2. admit: pop queued requests into free slots (head-of-line admission —
+   the scheduler's top request waits for pages rather than being jumped);
+3. prefill one chunk: ONE slot advances its prompt by ``prefill_chunk``
+   tokens per engine step (chunked prefill — long prompts interleave
+   with decode instead of stalling the whole batch);
+4. decode: one token for every decoding slot in a single jitted call.
+
+Greedy decoding only: the argmax lives in-graph so each step ships one
+int32 per slot to the host. Sampling (per-request temperature, top-k)
+needs per-slot rng plumbing through the fixed batch and is a documented
+follow-on in docs/serving.md.
+
+Alignment invariant: the slot capacity ``S_max`` must be a multiple of
+``prefill_chunk``. Chunk starts are always multiples of the chunk width,
+and ``lax.dynamic_slice`` CLAMPS out-of-bounds starts — an unaligned
+tail window would silently shift the slice and corrupt earlier cache
+rows. ``__init__`` enforces it.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models import decoder
+from dlrover_tpu.serving import kv_cache as kvc
+from dlrover_tpu.serving.scheduler import Request, Scheduler
+
+
+@dataclass
+class _Slot:
+    """Host-side state of one decode lane."""
+
+    req: Request
+    phase: str                  # "prefill" | "decode"
+    prompt: np.ndarray          # int32 [P]
+    n_prefilled: int = 0
+    generated: List[int] = field(default_factory=list)
+
+
+class ServingEngine:
+    """Single-replica continuous-batching engine (host loop + 2 jits)."""
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        scheduler: Scheduler,
+        *,
+        n_slots: int = 4,
+        max_len: int = 128,
+        page_size: int = 16,
+        mode: str = "int8",
+        prefill_chunk: int = 8,
+        slack_pages: int = 0,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.scheduler = scheduler
+        self.n_slots = n_slots
+        self.prefill_chunk = prefill_chunk
+        self.geom = kvc.make_geometry(
+            cfg, n_slots=n_slots, max_len=max_len, page_size=page_size,
+            mode=mode, slack_pages=slack_pages,
+        )
+        if self.geom.max_len % prefill_chunk:
+            raise ValueError(
+                f"slot capacity {self.geom.max_len} (pages*page_size) must "
+                f"be a multiple of prefill_chunk={prefill_chunk}: chunk "
+                "starts are chunk-aligned and dynamic_slice clamps "
+                "out-of-bounds starts, which would corrupt earlier rows"
+            )
+        self.alloc = kvc.PageAllocator(self.geom, n_slots)
+        self.pools = kvc.init_pools(self.geom)
+        self.slots: List[Optional[_Slot]] = [None] * n_slots
+        self._tokens = 0
+        self._t0: Optional[float] = None
+
+        geom = self.geom
+        chunk_w = prefill_chunk
+        # buffer donation is a no-op (with a warning) on the CPU backend
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+
+        def decode_fn(params, pools, tables, tokens, pos, valid):
+            """One token for every slot: gather pages → decode_step →
+            scatter the new K/V row back (invalid lanes → trash page)."""
+            views = kvc.gather(pools, tables, geom)
+            logits, new_cache = decoder.decode_step(
+                params, tokens, views, pos, cfg, prefilled=True
+            )
+            take = jax.vmap(
+                lambda c, p: jax.lax.dynamic_slice_in_dim(
+                    c, p, 1, axis=1
+                )[:, 0],
+                in_axes=(1, 0),
+                out_axes=1,
+            )
+            rows_k = take(new_cache["k"], pos)[:, :, None]
+            rows_v = take(new_cache["v"], pos)[:, :, None]
+            pools = kvc.write_rows(
+                pools, tables, pos[:, None], valid[:, None],
+                rows_k, rows_v, geom,
+            )
+            return jnp.argmax(logits, -1).astype(jnp.int32), pools
+
+        def chunk_fn(params, pools, tables, tokens, start, chunk_len):
+            """One prefill chunk for ONE slot (batch dim kept at 1):
+            gather → prefill_chunk → scatter the chunk's K/V rows →
+            argmax at the last VALID position (only meaningful on the
+            final chunk, where it is token 0 of the continuation)."""
+            views = kvc.gather(pools, tables, geom)
+            logits, new_cache = decoder.prefill_chunk(
+                params, tokens, views, start, cfg
+            )
+            take = jax.vmap(
+                lambda c, s: jax.lax.dynamic_slice_in_dim(
+                    c, s, chunk_w, axis=1
+                ),
+                in_axes=(1, 0),
+                out_axes=1,
+            )
+            rows_k = take(new_cache["k"], start)
+            rows_v = take(new_cache["v"], start)
+            positions = start[:, None] + jnp.arange(chunk_w, dtype=jnp.int32)
+            valid = jnp.arange(chunk_w)[None, :] < chunk_len[:, None]
+            pools = kvc.write_rows(
+                pools, tables, positions, valid, rows_k, rows_v, geom,
+            )
+            last = jnp.take_along_axis(
+                logits, (chunk_len - 1)[:, None, None], axis=1
+            )[:, 0]
+            return jnp.argmax(last, -1).astype(jnp.int32), pools
+
+        self._decode_fn = jax.jit(decode_fn, donate_argnums=donate)
+        self._chunk_fn = jax.jit(chunk_fn, donate_argnums=donate)
+
+    # ---- queries ---------------------------------------------------------
+
+    @property
+    def max_len(self) -> int:
+        """Longest prompt+generation one slot can hold."""
+        return self.geom.max_len
+
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    def stats(self) -> dict:
+        dt = time.monotonic() - self._t0 if self._t0 else 0.0
+        return {
+            "active_slots": self.active_slots(),
+            "free_pages": self.alloc.free_pages,
+            "tokens_generated": self._tokens,
+            "tokens_per_s": self._tokens / dt if dt > 0 else 0.0,
+        }
+
+    def resident_kv_bytes(self) -> int:
+        return kvc.resident_bytes(self.geom)
+
+    # ---- the step loop ---------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine iteration; returns False when fully idle (the
+        server thread uses that to sleep instead of spinning)."""
+        worked = self._finish_and_evict()
+        worked = self._admit() or worked
+        if self._t0 is None and any(self.slots):
+            self._t0 = time.monotonic()
+        worked = self._prefill_one() or worked
+        worked = self._decode_batch() or worked
+        return worked
+
+    def drain(self, timeout: float = 120.0) -> None:
+        """Step until queue and slots are empty (tests / bench)."""
+        deadline = time.monotonic() + timeout
+        while self.scheduler.queue_depth() or self.active_slots():
+            self.step()
+            if time.monotonic() > deadline:
+                raise TimeoutError("engine did not drain in time")
+
+    def _finish_and_evict(self) -> bool:
+        worked = False
+        for i, s in enumerate(self.slots):
+            if s is None or s.phase != "decode":
+                continue
+            req = s.req
+            done = len(s.generated) >= req.max_new_tokens or (
+                req.eos_id is not None
+                and s.generated
+                and s.generated[-1] == req.eos_id
+            )
+            if not done:
+                continue
+            self.scheduler.complete(
+                req, [int(t) for t in s.prompt] + s.generated
+            )
+            self.alloc.evict(i)
+            self.slots[i] = None
+            worked = True
+        return worked
+
+    def _admit(self) -> bool:
+        worked = False
+        while True:
+            try:
+                idx = self.slots.index(None)
+            except ValueError:
+                return worked
+
+            def can(req):
+                # oversize requests pass so they can be popped and FAILED
+                # (they would block the head of the line forever)
+                if req.total_tokens > self.geom.max_len:
+                    return True
+                return self.alloc.can_admit(req.total_tokens)
+
+            req = self.scheduler.pop_next(can)
+            if req is None:
+                return worked
+            if req.total_tokens > self.geom.max_len:
+                self.scheduler.fail(req, ValueError(
+                    f"request {req.rid} needs {req.total_tokens} tokens "
+                    f"> slot capacity {self.geom.max_len}"
+                ))
+                continue
+            # reserve the FULL prompt+generation footprint up front so a
+            # decoding slot can never deadlock waiting for pages
+            self.alloc.admit(idx, req.total_tokens)
+            self.slots[idx] = _Slot(
+                req=req, phase="prefill",
+                prompt=np.asarray(req.prompt, np.int32),
+            )
+            worked = True
+
+    def _prefill_one(self) -> bool:
+        for i, s in enumerate(self.slots):
+            if s is None or s.phase != "prefill":
+                continue
+            p = len(s.prompt)
+            clen = min(self.prefill_chunk, p - s.n_prefilled)
+            chunk = np.zeros(self.prefill_chunk, np.int32)
+            chunk[:clen] = s.prompt[s.n_prefilled:s.n_prefilled + clen]
+            tables = jnp.asarray(self.alloc.block_tables()[i:i + 1])
+            tok0, self.pools = self._chunk_fn(
+                self.params, self.pools, tables,
+                jnp.asarray(chunk[None]),
+                jnp.asarray([s.n_prefilled], jnp.int32),
+                jnp.asarray([clen], jnp.int32),
+            )
+            s.n_prefilled += clen
+            if s.n_prefilled == p:
+                s.generated = [int(np.asarray(tok0)[0])]
+                s.phase = "decode"
+                self.scheduler.record_first_token(s.req)
+                self._tokens += 1
+            return True
+        return False
+
+    def _decode_batch(self) -> bool:
+        live = [
+            i for i, s in enumerate(self.slots)
+            if s is not None and s.phase == "decode"
+        ]
+        if not live:
+            return False
+        tokens = np.zeros(self.n_slots, np.int32)
+        pos = np.zeros(self.n_slots, np.int32)
+        valid = np.zeros(self.n_slots, bool)
+        for i in live:
+            s = self.slots[i]
+            tokens[i] = s.generated[-1]
+            pos[i] = len(s.prompt) + len(s.generated) - 1
+            valid[i] = True
+        tok, self.pools = self._decode_fn(
+            self.params, self.pools,
+            jnp.asarray(self.alloc.block_tables()),
+            jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(valid),
+        )
+        tok = np.asarray(tok)
+        for i in live:
+            self.slots[i].generated.append(int(tok[i]))
+            self._tokens += 1
+        return True

@@ -1,0 +1,269 @@
+"""Paged KV cache for the serving engine.
+
+vLLM-style paged attention, TPU-native and CPU-testable: physical KV
+storage is a pool of fixed-size pages; each decode slot owns a row of a
+block table mapping logical page index → physical page. Admission grabs
+pages from a free list, eviction returns them — no compaction, no
+per-request contiguous buffers, so slot lifetimes can interleave freely.
+
+Two storage modes share one geometry:
+
+- ``bf16`` — reference mode: pages hold the model compute dtype
+  verbatim, so a gather reproduces a contiguous ``decoder.init_kv_cache``
+  buffer bitwise (the parity baseline).
+- ``int8`` — pages hold int8 payloads + per-block f32 scales using the
+  same EQuARX-style max/127 block encode as the gradient wire
+  (``ops/quant.py`` ``kv_encode_rows``), dequantized per-page INSIDE the
+  jitted decode step. A token row of ``kv_heads*head_dim`` bf16 elements
+  (2 bytes each) becomes ``row`` int8 bytes + ``row/kv_block`` f32
+  scales — ≥1.7× resident-bytes reduction at every real shape (1.94× at
+  the tiny row=128, 1.97× at llama rows).
+
+Physical page 0 is the TRASH page: never allocated, the write target
+for masked-out lanes (inactive slots, prefill-chunk padding). Gathers
+clamp unassigned block-table entries (-1) onto it; whatever lands there
+is garbage by construction and every reader masks it by slot position.
+
+Host side (``PageAllocator``) is plain numpy + a free list — the engine
+ships ``block_tables()`` into jit each step. Device side (``gather`` /
+``write_rows``) is pure jnp so it fuses into the decode step. The
+follow-on (documented in docs/serving.md, not blocking): migrating live
+pages between replicas over the PR 8 resharding wire instead of
+re-prefilling on failover.
+"""
+
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.ops import quant
+
+TRASH_PAGE = 0
+
+
+class PageGeometry(NamedTuple):
+    """Static shape/layout contract between allocator, pools and jit."""
+
+    n_layers: int
+    kv_heads: int
+    head_dim: int
+    page_size: int           # tokens per page
+    n_pages: int             # physical pages incl. the trash page
+    max_pages_per_slot: int  # block-table width
+    mode: str                # "bf16" | "int8"
+    dtype: str               # model compute dtype (gather output / bf16 pools)
+    kv_block: int            # int8 scale-block width (elements)
+
+    @property
+    def row_elems(self) -> int:
+        return self.kv_heads * self.head_dim
+
+    @property
+    def n_blocks(self) -> int:
+        return self.row_elems // self.kv_block
+
+    @property
+    def max_len(self) -> int:
+        """Longest sequence one slot can hold (gather width S_max)."""
+        return self.max_pages_per_slot * self.page_size
+
+
+def make_geometry(
+    cfg,
+    *,
+    n_slots: int,
+    max_len: int,
+    page_size: int = 16,
+    mode: str = "int8",
+    slack_pages: int = 0,
+) -> PageGeometry:
+    """Geometry sized so ``n_slots`` concurrent sequences of ``max_len``
+    tokens always fit, plus ``slack_pages`` headroom and the trash page."""
+    if mode not in ("bf16", "int8"):
+        raise ValueError(f"mode must be bf16|int8, got {mode}")
+    max_pages = -(-max_len // page_size)
+    row = cfg.kv_heads * cfg.head_dim
+    return PageGeometry(
+        n_layers=cfg.n_layer,
+        kv_heads=cfg.kv_heads,
+        head_dim=cfg.head_dim,
+        page_size=page_size,
+        n_pages=1 + n_slots * max_pages + slack_pages,
+        max_pages_per_slot=max_pages,
+        mode=mode,
+        dtype=str(cfg.dtype),
+        kv_block=quant.kv_block_size(row),
+    )
+
+
+def init_pools(geom: PageGeometry) -> Dict[str, jax.Array]:
+    """Allocate the physical page pools (layer-leading, so the decoder's
+    layer scan can carry gathered views as xs)."""
+    g = geom
+    if g.mode == "bf16":
+        shape = (g.n_layers, g.n_pages, g.page_size, g.kv_heads, g.head_dim)
+        dt = jnp.dtype(g.dtype)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    qshape = (g.n_layers, g.n_pages, g.page_size, g.n_blocks, g.kv_block)
+    sshape = (g.n_layers, g.n_pages, g.page_size, g.n_blocks)
+    return {
+        "k_q": jnp.zeros(qshape, jnp.int8),
+        "k_scale": jnp.zeros(sshape, jnp.float32),
+        "v_q": jnp.zeros(qshape, jnp.int8),
+        "v_scale": jnp.zeros(sshape, jnp.float32),
+    }
+
+
+def resident_bytes(geom: PageGeometry) -> int:
+    """Resident KV pool bytes at this geometry — the bench memory stat."""
+    g = geom
+    rows = g.n_layers * g.n_pages * g.page_size
+    if g.mode == "bf16":
+        return 2 * rows * g.row_elems * jnp.dtype(g.dtype).itemsize
+    return 2 * rows * (g.row_elems + 4 * g.n_blocks)
+
+
+def gather(pools: Dict, block_tables: jax.Array, geom: PageGeometry) -> Dict:
+    """Materialize per-slot contiguous caches from the page pools.
+
+    ``block_tables`` [B, max_pages] int32 (-1 = unassigned → trash page)
+    → ``{"k","v"}`` [L, B, S_max, Hkv, D] in the model compute dtype,
+    the exact layout ``decoder.decode_step`` scans. Unassigned/garbage
+    positions carry finite trash values; callers mask by slot position.
+    """
+    t = jnp.maximum(block_tables, 0)
+    g = geom
+    b = block_tables.shape[0]
+
+    def _shape(x):
+        return x.reshape(g.n_layers, b, g.max_len, g.kv_heads, g.head_dim)
+
+    if g.mode == "bf16":
+        return {"k": _shape(pools["k"][:, t]), "v": _shape(pools["v"][:, t])}
+    dt = jnp.dtype(g.dtype)
+    k = quant.kv_decode_rows(pools["k_q"][:, t], pools["k_scale"][:, t], dt)
+    v = quant.kv_decode_rows(pools["v_q"][:, t], pools["v_scale"][:, t], dt)
+    return {"k": _shape(k), "v": _shape(v)}
+
+
+def write_rows(
+    pools: Dict,
+    block_tables: jax.Array,  # [B, max_pages] int32
+    positions: jax.Array,     # [B, C] int32 absolute token positions
+    valid: jax.Array,         # [B, C] bool — invalid lanes → trash page
+    k_rows: jax.Array,        # [L, B, C, Hkv, D]
+    v_rows: jax.Array,        # [L, B, C, Hkv, D]
+    geom: PageGeometry,
+) -> Dict:
+    """Scatter token K/V rows into their slots' pages (jit-side).
+
+    Distinct live (slot, position) pairs always map to distinct
+    (page, offset) cells because the allocator never double-assigns a
+    page; only trash-page lanes may collide, and those are garbage by
+    contract."""
+    g = geom
+    page_idx = positions // g.page_size
+    offs = positions % g.page_size
+    phys = jnp.take_along_axis(block_tables, page_idx, axis=1)
+    phys = jnp.where(valid, jnp.maximum(phys, 0), TRASH_PAGE)
+    offs = jnp.where(valid, offs, 0)
+    if g.mode == "bf16":
+        dt = pools["k"].dtype
+        return {
+            "k": pools["k"].at[:, phys, offs].set(k_rows.astype(dt)),
+            "v": pools["v"].at[:, phys, offs].set(v_rows.astype(dt)),
+        }
+    lead = k_rows.shape[:3]
+    kq, ks = quant.kv_encode_rows(k_rows.reshape(*lead, g.row_elems),
+                                  g.kv_block)
+    vq, vs = quant.kv_encode_rows(v_rows.reshape(*lead, g.row_elems),
+                                  g.kv_block)
+    return {
+        "k_q": pools["k_q"].at[:, phys, offs].set(kq),
+        "k_scale": pools["k_scale"].at[:, phys, offs].set(ks),
+        "v_q": pools["v_q"].at[:, phys, offs].set(vq),
+        "v_scale": pools["v_scale"].at[:, phys, offs].set(vs),
+    }
+
+
+class PageAllocator:
+    """Host-side block-table allocator over the physical page pool.
+
+    Invariants (pinned by the property test in
+    tests/test_serving_kv_cache.py):
+
+    - a physical page is assigned to at most one (slot, logical) cell;
+    - page 0 (trash) is never handed out;
+    - ``evict`` returns every page the slot held to the free list;
+    - free + assigned is a partition of pages 1..n_pages-1.
+    """
+
+    def __init__(self, geom: PageGeometry, n_slots: int):
+        self.geom = geom
+        self.n_slots = n_slots
+        # pop() yields ascending physical pages — deterministic layouts
+        self._free = list(range(geom.n_pages - 1, TRASH_PAGE, -1))
+        self._tables = np.full(
+            (n_slots, geom.max_pages_per_slot), -1, np.int32
+        )
+        self._n_pages = np.zeros(n_slots, np.int32)
+
+    # ---- queries ---------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-max(int(n_tokens), 0) // self.geom.page_size)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        need = self.pages_needed(n_tokens)
+        return (
+            need <= self.geom.max_pages_per_slot
+            and need <= len(self._free)
+        )
+
+    def slot_pages(self, slot: int) -> int:
+        return int(self._n_pages[slot])
+
+    def block_tables(self) -> np.ndarray:
+        """The live [n_slots, max_pages] table (copy — jit inputs must
+        not alias a buffer ``evict``/``ensure`` mutates mid-step)."""
+        return self._tables.copy()
+
+    # ---- transitions -----------------------------------------------------
+
+    def admit(self, slot: int, n_tokens: int) -> bool:
+        """Assign pages covering ``n_tokens`` to an EMPTY slot."""
+        if self._n_pages[slot]:
+            raise ValueError(f"slot {slot} already holds pages")
+        return self.ensure(slot, n_tokens)
+
+    def ensure(self, slot: int, n_tokens: int) -> bool:
+        """Grow ``slot`` to cover ``n_tokens`` total; False (state
+        unchanged) when the free list cannot cover the growth."""
+        need = self.pages_needed(n_tokens)
+        if need > self.geom.max_pages_per_slot:
+            return False
+        have = int(self._n_pages[slot])
+        grow = need - have
+        if grow <= 0:
+            return True
+        if grow > len(self._free):
+            return False
+        for i in range(have, need):
+            self._tables[slot, i] = self._free.pop()
+        self._n_pages[slot] = need
+        return True
+
+    def evict(self, slot: int) -> int:
+        """Free every page the slot holds; returns the count freed."""
+        n = int(self._n_pages[slot])
+        for i in range(n):
+            self._free.append(int(self._tables[slot, i]))
+        self._tables[slot, :] = -1
+        self._n_pages[slot] = 0
+        return n

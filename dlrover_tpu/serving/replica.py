@@ -1,0 +1,219 @@
+"""Elastic serving replicas: master registration + failover routing.
+
+A ``ServingReplica`` wraps one ``GenerationServer`` and, when given a
+master address, registers with the job master EXACTLY like a trainer
+node (``NodeType.SERVING``): same heartbeat/failure machinery, same KV
+store for discovery (address published under
+``serving_replica_addr_<name>``, mirroring sparse/server.py's
+``sparse_server_addr_`` channel). The master's node manager lists them
+via ``serving_nodes()`` without treating them as part of the train
+rendezvous.
+
+``ReplicaRouter`` is the client-side elastic story: round-robin
+dispatch over live replicas, and on replica death (``poll``) every
+in-flight request of the dead replica is RE-ADMITTED on a survivor
+under its original admission ticket — exactly once, no lost and no
+duplicated requests (the failover drill in tests/test_serving_replica.py
+pins this). Re-admitted requests re-prefill from the prompt on the
+survivor; migrating their live KV pages over the resharding wire
+instead is the documented follow-on (docs/serving.md).
+"""
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.serving.scheduler import Request
+from dlrover_tpu.serving.server import GenerationServer
+
+logger = get_logger(__name__)
+
+ADDR_KV_PREFIX = "serving_replica_addr_"
+
+
+class ServingReplica:
+    """One serving host: a GenerationServer plus master-plane plumbing."""
+
+    def __init__(
+        self,
+        name: str,
+        params,
+        cfg,
+        *,
+        master_addr: Optional[str] = None,
+        node_id: int = 0,
+        hub=None,
+        **server_kw,
+    ):
+        self.name = name
+        self.node_id = node_id
+        self.master_addr = master_addr
+        self.server = GenerationServer(
+            params, cfg, hub=hub, replica=name, **server_kw
+        )
+        self._client = None
+
+    @property
+    def alive(self) -> bool:
+        return self.server.alive
+
+    def start(self) -> "ServingReplica":
+        self.server.start()
+        if self.master_addr:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            self._client = MasterClient(
+                self.master_addr, node_id=self.node_id
+            )
+            self._client.register_node(node_type=NodeType.SERVING)
+            self._client.kv_store_set(
+                ADDR_KV_PREFIX + self.name,
+                json.dumps({"name": self.name, "node_id": self.node_id}),
+            )
+        return self
+
+    def stop(self) -> None:
+        self.server.stop()
+        if self._client is not None:
+            self._client.report_node_status("exited", retries=1)
+            self._client.close()
+            self._client = None
+
+    def kill(self) -> None:
+        """Simulated host eviction: the serve loop halts, in-flight
+        futures stay unresolved, and (unlike ``stop``) the master is
+        NOT told about a clean exit — failure detection or the router's
+        liveness poll must notice."""
+        self.server.kill()
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    # convenience passthroughs
+    def submit(self, *a, **kw) -> Request:
+        return self.server.submit(*a, **kw)
+
+    def generate(self, *a, **kw):
+        return self.server.generate(*a, **kw)
+
+
+def discover_replicas(client, names) -> Optional[Dict[str, dict]]:
+    """Resolve replica names → registration payloads via the master KV
+    store; None when any member hasn't registered yet (mirrors
+    sparse/server.py resolve_ring: never adopt a partial set)."""
+    out: Dict[str, dict] = {}
+    for name in names:
+        raw = client.kv_store_get(ADDR_KV_PREFIX + name)
+        if not raw:
+            logger.warning(
+                "serving replica %s has no registration yet; deferring",
+                name,
+            )
+            return None
+        out[name] = json.loads(raw)
+    return out
+
+
+class _Entry:
+    """Router-side view of one request: which replica holds it and
+    whether its result already landed."""
+
+    __slots__ = ("req", "replica", "done")
+
+    def __init__(self, req: Request, replica: ServingReplica):
+        self.req = req
+        self.replica = replica
+        self.done = False
+
+
+class ReplicaRouter:
+    """Round-robin request router with exactly-once failover.
+
+    Requests fan out over live replicas. ``poll`` detects dead replicas
+    and re-admits their incomplete requests on survivors under the
+    ORIGINAL admission ticket (the ``Request`` object travels — its
+    future resolves wherever the survivor finishes it). Completed
+    entries are never resubmitted; ``Scheduler.complete`` resolves each
+    future at most once even if a race double-delivers.
+    """
+
+    def __init__(self, replicas: List[ServingReplica]):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self._entries: List[_Entry] = []
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _mark_done(self, entry: _Entry):
+        def _cb(_future):
+            entry.done = True
+
+        return _cb
+
+    def _live(self) -> List[ServingReplica]:
+        return [r for r in self.replicas if r.alive]
+
+    def submit(
+        self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0
+    ) -> Request:
+        with self._lock:
+            live = self._live()
+            if not live:
+                raise RuntimeError("no live serving replicas")
+            replica = live[self._rr % len(live)]
+            self._rr += 1
+            req = replica.submit(
+                prompt, max_new_tokens, eos_id=eos_id, priority=priority
+            )
+            entry = _Entry(req, replica)
+            req.future.add_done_callback(self._mark_done(entry))
+            self._entries.append(entry)
+        return req
+
+    def poll(self) -> int:
+        """Failover sweep: re-admit every incomplete request whose
+        replica died onto a survivor. Returns how many moved."""
+        with self._lock:
+            live = self._live()
+            moved = 0
+            for entry in self._entries:
+                if entry.done or entry.replica.alive:
+                    continue
+                if not live:
+                    raise RuntimeError(
+                        "all serving replicas died with requests in flight"
+                    )
+                survivor = live[self._rr % len(live)]
+                self._rr += 1
+                logger.info(
+                    "re-admitting %s from dead replica %s onto %s",
+                    entry.req.rid, entry.replica.name, survivor.name,
+                )
+                survivor.server.re_admit(entry.req)
+                entry.replica = survivor
+                moved += 1
+            return moved
+
+    def wait_all(self, timeout: float = 120.0) -> List:
+        """Poll for failovers while gathering every outstanding result
+        (submission order). Raises on per-request failure or timeout."""
+        import concurrent.futures
+        import time
+
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            entries = list(self._entries)
+        results = []
+        for entry in entries:
+            while True:
+                self.poll()
+                try:
+                    results.append(entry.req.future.result(timeout=0.05))
+                    break
+                except concurrent.futures.TimeoutError:
+                    if time.monotonic() > deadline:
+                        raise
+        return results

@@ -1,0 +1,205 @@
+"""Threaded request queue for the generation server.
+
+Pure host-side Python (no jax import): a priority heap ordered by
+(priority, arrival) — lower priority value first, FIFO within a class —
+with admission control (bounded depth → ``AdmissionError``), and
+latency accounting that publishes ``ServingRecord`` telemetry on the
+shared ``TelemetryHub``. The engine pops work at step boundaries; user
+threads submit concurrently.
+
+Re-admission (``re_admit``) keeps a request's ORIGINAL arrival ticket:
+a request bumped by allocator pressure or replica failover re-enters
+ahead of later arrivals instead of going to the back of the line — the
+elastic story's no-starvation guarantee.
+"""
+
+import heapq
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+class AdmissionError(RuntimeError):
+    """Queue at capacity — the caller should back off and retry."""
+
+
+@dataclass
+class Request:
+    """One generation request as the engine sees it."""
+
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    priority: int = 0
+    arrival: int = 0            # admission ticket, stable across re-admits
+    submit_t: float = 0.0
+    first_token_t: float = 0.0  # 0 until the prefill emits token 0
+    done_t: float = 0.0
+    future: Future = field(default_factory=Future)
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+
+class Scheduler:
+    """Thread-safe request queue + latency bookkeeping for ONE engine."""
+
+    def __init__(
+        self,
+        *,
+        max_queue: int = 256,
+        max_latencies: int = 4096,
+        hub=None,
+        replica: str = "replica-0",
+    ):
+        self._heap: list = []
+        self._lock = threading.Lock()
+        self._ticket = itertools.count()
+        # heap tiebreak: arrival tickets are per-scheduler, so a request
+        # RE-ADMITTED from a dead peer can tie a local one exactly —
+        # and Request is deliberately not orderable
+        self._seq = itertools.count()
+        self.max_queue = max_queue
+        self.hub = hub
+        self.replica = replica
+        self._latencies_ms: List[float] = []
+        self._max_latencies = max_latencies
+        self.admitted = 0
+        self.completed = 0
+        self.re_admitted = 0
+
+    # ---- intake ----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        eos_id: Optional[int] = None,
+        priority: int = 0,
+    ) -> Request:
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._lock:
+            if len(self._heap) >= self.max_queue:
+                raise AdmissionError(
+                    f"queue at capacity ({self.max_queue}); retry later"
+                )
+            arrival = next(self._ticket)
+            req = Request(
+                rid=f"{self.replica}/r{arrival}",
+                prompt=[int(t) for t in prompt],
+                max_new_tokens=int(max_new_tokens),
+                eos_id=eos_id,
+                priority=int(priority),
+                arrival=arrival,
+                submit_t=time.monotonic(),
+            )
+            heapq.heappush(
+                self._heap,
+                (req.priority, req.arrival, next(self._seq), req),
+            )
+            self.admitted += 1
+        return req
+
+    def re_admit(self, req: Request) -> None:
+        """Re-queue a preempted/failed-over request under its ORIGINAL
+        (priority, arrival) ticket — it outranks later arrivals. The
+        admission-control bound is deliberately not applied: the request
+        was already admitted once."""
+        with self._lock:
+            heapq.heappush(
+                self._heap,
+                (req.priority, req.arrival, next(self._seq), req),
+            )
+            self.re_admitted += 1
+
+    # ---- engine side -----------------------------------------------------
+
+    def pop_next(self, can_admit=None) -> Optional[Request]:
+        """Pop the highest-priority request, or None when empty or when
+        ``can_admit(req)`` rejects the head (head-of-line admission:
+        lower-ranked requests never jump a head waiting on pages)."""
+        with self._lock:
+            while self._heap:
+                req = self._heap[0][-1]
+                if req.future.cancelled():
+                    heapq.heappop(self._heap)
+                    continue
+                if can_admit is not None and not can_admit(req):
+                    return None
+                heapq.heappop(self._heap)
+                return req
+        return None
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def record_first_token(self, req: Request) -> None:
+        req.first_token_t = time.monotonic()
+
+    def complete(self, req: Request, output) -> None:
+        """Resolve a request exactly once and record its latency."""
+        req.done_t = time.monotonic()
+        with self._lock:
+            self.completed += 1
+            self._latencies_ms.append((req.done_t - req.submit_t) * 1e3)
+            if len(self._latencies_ms) > self._max_latencies:
+                del self._latencies_ms[: -self._max_latencies]
+        if not req.future.done():
+            req.future.set_result(output)
+
+    def fail(self, req: Request, exc: Exception) -> None:
+        if not req.future.done():
+            req.future.set_exception(exc)
+
+    # ---- accounting ------------------------------------------------------
+
+    @staticmethod
+    def _percentile(sorted_vals: List[float], q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[idx]
+
+    def latency_ms(self) -> dict:
+        with self._lock:
+            vals = sorted(self._latencies_ms)
+        return {
+            "p50": self._percentile(vals, 0.50),
+            "p99": self._percentile(vals, 0.99),
+            "n": len(vals),
+        }
+
+    def reset_latencies(self) -> None:
+        """Drop warmup samples (compile time) before a timed window."""
+        with self._lock:
+            self._latencies_ms.clear()
+
+    def publish(self, engine_stats: Optional[dict] = None):
+        """Emit one ``ServingRecord`` on the hub; returns the record
+        (also when no hub is attached, for callers that sink it
+        themselves)."""
+        from dlrover_tpu.observability.telemetry import ServingRecord
+
+        lat = self.latency_ms()
+        es = engine_stats or {}
+        rec = ServingRecord(
+            replica=self.replica,
+            active_slots=int(es.get("active_slots", 0)),
+            queue_depth=self.queue_depth(),
+            admitted=self.admitted,
+            completed=self.completed,
+            re_admitted=self.re_admitted,
+            tokens_per_s=float(es.get("tokens_per_s", 0.0)),
+            p50_ms=round(lat["p50"], 3),
+            p99_ms=round(lat["p99"], 3),
+        )
+        if self.hub is not None:
+            self.hub.publish(rec)
+        return rec

@@ -1,0 +1,116 @@
+"""Threaded generation server: one engine, one scheduler, one loop.
+
+``GenerationServer`` owns a ``Scheduler`` (request intake, latency
+accounting) and a ``ServingEngine`` (continuous batching over the paged
+KV cache) and drives the engine from a background thread. User threads
+call ``submit`` (non-blocking, returns a ``concurrent.futures.Future``)
+or ``generate`` (blocking convenience); the engine loop sleeps briefly
+when fully idle instead of spinning.
+
+``kill`` stops the loop abruptly WITHOUT resolving in-flight futures —
+that is the eviction drill: a replica dying mid-stream leaves its
+requests dangling until ``ReplicaRouter.poll`` re-admits them on a
+survivor (serving/replica.py).
+"""
+
+import threading
+import time
+
+from dlrover_tpu.serving.engine import ServingEngine
+from dlrover_tpu.serving.scheduler import Request, Scheduler
+
+
+class GenerationServer:
+    """Single-replica serving front end (threaded loop around the engine)."""
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        *,
+        hub=None,
+        replica: str = "replica-0",
+        max_queue: int = 256,
+        publish_every: float = 0.5,
+        idle_sleep: float = 0.002,
+        **engine_kw,
+    ):
+        self.replica = replica
+        self.scheduler = Scheduler(
+            max_queue=max_queue, hub=hub, replica=replica
+        )
+        self.engine = ServingEngine(params, cfg, self.scheduler, **engine_kw)
+        self.publish_every = publish_every
+        self.idle_sleep = idle_sleep
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "GenerationServer":
+        if self.alive:
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serving-{self.replica}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful: finish nothing extra, just stop the loop and join."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def kill(self) -> None:
+        """Abrupt stop simulating a host eviction: the loop halts at the
+        next step boundary and in-flight futures stay UNRESOLVED — the
+        router's failover path picks them up."""
+        self.stop()
+
+    def _loop(self) -> None:
+        last_pub = time.monotonic()
+        while not self._stop_evt.is_set():
+            worked = self.engine.step()
+            now = time.monotonic()
+            if now - last_pub >= self.publish_every:
+                self.scheduler.publish(self.engine.stats())
+                last_pub = now
+            if not worked:
+                self._stop_evt.wait(self.idle_sleep)
+        # final snapshot so short-lived servers still leave telemetry
+        self.scheduler.publish(self.engine.stats())
+
+    # ---- intake ----------------------------------------------------------
+
+    def submit(
+        self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0
+    ) -> Request:
+        if len(prompt) + max_new_tokens > self.engine.max_len:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new_tokens}) "
+                f"exceeds slot capacity {self.engine.max_len}"
+            )
+        return self.scheduler.submit(
+            prompt, max_new_tokens, eos_id=eos_id, priority=priority
+        )
+
+    def re_admit(self, req: Request) -> None:
+        """Failover intake: requeue another replica's in-flight request
+        under its original admission ticket (generation restarts from
+        the prompt — live-page migration is the documented follow-on)."""
+        self.scheduler.re_admit(req)
+
+    def generate(
+        self, prompt, max_new_tokens: int, eos_id=None, timeout: float = 120.0
+    ):
+        """Blocking convenience: submit and wait for the full sequence."""
+        return self.submit(
+            prompt, max_new_tokens, eos_id=eos_id
+        ).future.result(timeout)

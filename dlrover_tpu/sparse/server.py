@@ -31,6 +31,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -248,25 +249,80 @@ class KvServer:
 
 
 class KvClient:
-    """One connection to one KvServer."""
+    """One connection to one KvServer.
+
+    Transport failures retry on the job-wide full-jitter backoff policy
+    (``common.comm._backoff_delay`` — the same curve the master client
+    uses) with a fresh connection per attempt, so a KvServer restart
+    during elastic repartitioning doesn't fail every in-flight trainer.
+    Server-reported errors (``!`` frames) are NOT retried: the server
+    answered, the request is wrong. Note a retried ``push`` is
+    at-least-once: if the server applied the update but the ack was
+    lost, the gradient lands twice — acceptable for sparse optimizer
+    updates (same contract as the reference PS), unlike e.g. ``import``
+    which is idempotent by key.
+    """
 
     def __init__(
-        self, addr, timeout: float = 60.0, token: Optional[str] = None
+        self,
+        addr,
+        timeout: float = 60.0,
+        token: Optional[str] = None,
+        retries: int = 3,
     ):
-        from dlrover_tpu.common.sockets import default_token, send_auth
+        from dlrover_tpu.common.sockets import default_token
 
         self.addr = tuple(addr)
-        self._sock = socket.create_connection(self.addr, timeout=timeout)
-        self._sock.settimeout(timeout)
-        send_auth(
-            self._sock, default_token() if token is None else token
-        )
+        self.timeout = timeout
+        self.retries = max(int(retries), 1)
+        self._token = default_token() if token is None else token
+        self._sock = None
         self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self):
+        from dlrover_tpu.common.sockets import send_auth
+
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = socket.create_connection(self.addr, timeout=self.timeout)
+        self._sock.settimeout(self.timeout)
+        send_auth(self._sock, self._token)
 
     def _call(self, op, ctrl, payload=b""):
+        from dlrover_tpu.common.comm import _backoff_delay
+
         with self._lock:
-            _send(self._sock, op, ctrl, payload)
-            rop, rctrl, rpayload = _recv(self._sock)
+            last = None
+            for attempt in range(self.retries):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    _send(self._sock, op, ctrl, payload)
+                    rop, rctrl, rpayload = _recv(self._sock)
+                    break
+                except (ConnectionError, EOFError, OSError) as e:
+                    last = e
+                    # a half-written frame poisons the stream: always
+                    # reconnect before the next attempt
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt + 1 >= self.retries:
+                        raise
+                    logger.warning(
+                        "kv %s to %s failed (%s); retry %d/%d",
+                        op, self.addr, e, attempt + 1, self.retries - 1,
+                    )
+                    time.sleep(_backoff_delay(attempt))
+            else:  # pragma: no cover - loop always breaks or raises
+                raise last
         if rop == b"!":
             raise RuntimeError(f"kv server error: {rctrl.get('error')}")
         return rctrl, rpayload
@@ -349,7 +405,9 @@ class KvClient:
         return ctrl
 
     def close(self):
-        self._sock.close()
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
 
 
 class DistributedEmbedding:

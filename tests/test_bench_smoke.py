@@ -261,3 +261,64 @@ def test_nonmatmul_residue_derivation():
     assert bench._nonmatmul_us_per_step(
         rec, "llama-1.4b", 1, 8192, "full"
     ) < bench._nonmatmul_us_per_step(rec, "llama-1.4b", 1, 8192, "none")
+
+
+@pytest.mark.slow  # a full threaded serve run (two jit compiles) in a
+# subprocess — the one bench smoke too heavy for the tier-1 budget
+def test_bench_serve_mode_emits_schema():
+    """`bench.py serve` is the serving half of the trajectory: decode
+    tokens/sec at a fixed p99 target plus the paged-KV memory story.
+    The headline fields must be present AND measured (non-None), and
+    the int8 geometry must beat bf16 residency by >= 1.7x."""
+    out = _run(["serve", "int8", "4"], timeout=420)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["unit"] == "new_tokens_per_sec"
+    assert rec["serve_tokens_per_s"] is not None
+    assert rec["serve_tokens_per_s"] > 0
+    assert rec["serve_p99_ms"] is not None
+    assert rec["serve_p99_ms"] >= rec["serve_p50_ms"] > 0
+    assert rec["p99_target_ms"] > 0
+    assert rec["kv_cache"]["mode"] == "int8"
+    assert rec["kv_cache"]["reduction_vs_bf16"] >= 1.7
+    assert (
+        rec["kv_cache"]["resident_bytes_int8"]
+        < rec["kv_cache"]["resident_bytes_bf16"]
+    )
+
+
+def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
+    """The train bench record embeds the last serving bench's
+    tokens/s-at-p99 (same cross-artifact pattern as the drill metric)."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    p = tmp_path / "SERVE_test.json"
+    p.write_text(json.dumps({
+        "serve_tokens_per_s": 123.4,
+        "serve_p99_ms": 80.5,
+        "p99_target_ms": 200.0,
+        "p99_met": True,
+    }))
+    got = bench.serving_trajectory_metric(str(p))
+    assert got == {
+        "serve_tokens_per_s": 123.4,
+        "serve_p99_ms": 80.5,
+        "p99_target_ms": 200.0,
+        "p99_met": True,
+    }
+    monkeypatch.setenv("DLROVER_TPU_SERVE_ARTIFACT", str(p))
+    assert bench.serving_trajectory_metric()["serve_tokens_per_s"] == \
+        pytest.approx(123.4)
+    # missing/corrupt/unmeasured artifacts degrade to None
+    assert bench.serving_trajectory_metric(
+        str(tmp_path / "nope.json")
+    ) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bench.serving_trajectory_metric(str(bad)) is None
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"serve_tokens_per_s": None}))
+    assert bench.serving_trajectory_metric(str(empty)) is None

@@ -1,0 +1,77 @@
+"""External KV-cache plumbing in models/generate.py (serving satellite).
+
+``generate.init_kv_cache`` is the ONE allocation site the sampler and
+the serving tier share; a rollout decoding into an externally allocated
+buffer must be bitwise identical to the inline allocation, and the
+helper itself must stay pinned to ``decoder.init_kv_cache``.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models import decoder, generate  # noqa: E402
+from dlrover_tpu.models.config import get_config  # noqa: E402
+
+
+def _cfg(**kw):
+    base = dict(
+        n_layer=2, d_model=32, d_ff=64, n_head=4, vocab_size=32, max_seq=64
+    )
+    base.update(kw)
+    return get_config("tiny", **base)
+
+
+def test_init_kv_cache_pinned_to_decoder():
+    cfg = _cfg()
+    a = generate.init_kv_cache(cfg, 2, 10)
+    b = decoder.init_kv_cache(cfg, 2, 10)
+    assert set(a) == set(b) == {"k", "v"}
+    for key in ("k", "v"):
+        assert a[key].shape == b[key].shape
+        assert a[key].dtype == b[key].dtype
+        np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
+    # explicit dtype flows through (the serving bf16 reference mode)
+    c = generate.init_kv_cache(cfg, 2, 10, dtype=jnp.float32)
+    assert c["k"].dtype == jnp.float32
+
+
+def test_external_cache_rollout_bitwise_identical():
+    cfg = _cfg()
+    params = decoder.init(jax.random.key(0), cfg)
+    prompts = jnp.asarray(
+        np.random.default_rng(0).integers(1, 32, size=(2, 4)), jnp.int32
+    )
+    inline = generate.greedy(params, cfg, prompts, max_new_tokens=6)
+    buf = generate.init_kv_cache(cfg, 2, 10)
+    external = generate.sample(
+        params, cfg, prompts, 6, rng=jax.random.key(0),
+        temperature=0.0, kv_cache=buf,
+    )
+    np.testing.assert_array_equal(np.asarray(inline), np.asarray(external))
+
+
+def test_external_cache_shape_mismatch_raises():
+    cfg = _cfg()
+    params = decoder.init(jax.random.key(0), cfg)
+    prompts = jnp.ones((2, 4), jnp.int32)
+    wrong = generate.init_kv_cache(cfg, 2, 9)  # needs p+max_new = 10
+    with pytest.raises(ValueError, match="init_kv_cache"):
+        generate.sample(
+            params, cfg, prompts, 6, rng=jax.random.key(0),
+            temperature=0.0, kv_cache=wrong,
+        )
+
+
+def test_external_cache_rejected_on_cacheless_path():
+    cfg = _cfg(n_experts=2)  # MoE always takes the full-prefix path
+    params = decoder.init(jax.random.key(0), cfg)
+    prompts = jnp.ones((2, 4), jnp.int32)
+    buf = generate.init_kv_cache(cfg, 2, 10)
+    with pytest.raises(ValueError, match="cacheless"):
+        generate.sample(
+            params, cfg, prompts, 6, rng=jax.random.key(0),
+            temperature=0.0, kv_cache=buf,
+        )

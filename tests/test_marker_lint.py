@@ -247,3 +247,50 @@ def test_process_spawning_fault_tests_are_slow():
         "@pytest.mark.slow, or a module-level pytestmark):\n"
         + "\n".join(rogue)
     )
+
+
+def _imports_serving_e2e(tree) -> bool:
+    """Module-level import of the serving SERVER or REPLICA layer —
+    both spin background serve threads and jit-compile the decode
+    engine. Engine/scheduler/kv_cache unit imports stay fast."""
+    e2e = ("dlrover_tpu.serving.server", "dlrover_tpu.serving.replica")
+    for node in tree.body:  # module level only, by design
+        if isinstance(node, ast.Import):
+            if any(
+                a.name == m or a.name.startswith(m + ".")
+                for a in node.names
+                for m in e2e
+            ):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if any(mod == m or mod.startswith(m + ".") for m in e2e):
+                return True
+            if mod == "dlrover_tpu.serving" and any(
+                a.name in ("server", "replica") for a in node.names
+            ):
+                return True
+    return False
+
+
+def test_serving_e2e_tests_are_slow():
+    """Files importing the serving server/replica layer at module level
+    run end-to-end serving loops: background threads driving jitted
+    prefill+decode over the paged KV cache, and (replica) failover
+    drills. Every test in such a file must carry ``slow`` — an e2e
+    serving run that slips into tier-1 pays two jit compiles per config
+    and flakes under load. Allocator/scheduler/engine-math unit tests
+    import those modules directly and stay in tier-1.
+    """
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if not _imports_serving_e2e(tree) or _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if not _fn_slow_marked(fn):
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "serving e2e tests not marked slow (add @pytest.mark.slow, or "
+        "a module-level pytestmark):\n" + "\n".join(rogue)
+    )

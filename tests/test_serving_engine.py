@@ -1,0 +1,122 @@
+"""Continuous-batching engine parity (serving/engine.py).
+
+The acceptance pin: N mixed-length concurrent requests through the
+continuous-batching engine produce sequences BITWISE equal to running
+each request alone through ``generate.greedy`` (bf16 page mode), and
+length-equal within quantization tolerance in int8 mode. The engine's
+chunked prefill, per-slot positions, paged gather/scatter and fixed
+decode batch must all be invisible to the math.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models import decoder, generate  # noqa: E402
+from dlrover_tpu.models.config import get_config  # noqa: E402
+from dlrover_tpu.serving.engine import ServingEngine  # noqa: E402
+from dlrover_tpu.serving.scheduler import Scheduler  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+        vocab_size=32, max_seq=64,
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 32, size=n)) for n in (3, 7, 5, 11, 2)]
+    max_new = [6, 4, 8, 5, 7]
+    refs = [
+        [
+            int(t)
+            for t in np.asarray(
+                generate.greedy(
+                    params, cfg, jnp.asarray([p], jnp.int32), m
+                )[0]
+            )
+        ]
+        for p, m in zip(prompts, max_new)
+    ]
+    return cfg, params, prompts, max_new, refs
+
+
+def _serve_all(cfg, params, prompts, max_new, mode):
+    sched = Scheduler(replica="t")
+    eng = ServingEngine(
+        params, cfg, sched, n_slots=3, max_len=32, page_size=4,
+        mode=mode, prefill_chunk=4,
+    )
+    reqs = [sched.submit(p, m) for p, m in zip(prompts, max_new)]
+    eng.drain(timeout=600)
+    outs = [r.future.result(timeout=5) for r in reqs]
+    return eng, outs
+
+
+def test_bf16_concurrent_mixed_lengths_bitwise_equal_greedy(setup):
+    cfg, params, prompts, max_new, refs = setup
+    eng, outs = _serve_all(cfg, params, prompts, max_new, "bf16")
+    assert outs == refs
+    # everything drained: slots empty, all pages back on the free list
+    assert eng.active_slots() == 0
+    assert eng.alloc.free_pages == eng.geom.n_pages - 1
+    assert eng.stats()["tokens_generated"] == sum(max_new)
+
+
+def test_int8_concurrent_mixed_lengths_within_tolerance(setup):
+    cfg, params, prompts, max_new, refs = setup
+    _, outs = _serve_all(cfg, params, prompts, max_new, "int8")
+    for out, ref, p in zip(outs, refs, prompts):
+        assert len(out) == len(ref)
+        assert out[: len(p)] == ref[: len(p)]  # prompt echoed verbatim
+    # int8 KV is lossy per token but must not derail generation wholesale:
+    # the vast majority of greedy tokens survive quantization
+    total = sum(m for m in max_new)
+    agree = sum(
+        o == r
+        for out, ref in zip(outs, refs)
+        for o, r in zip(out, ref)
+    ) - sum(len(p) for p in prompts)
+    assert agree >= int(0.75 * total), (agree, total)
+
+
+def test_eos_stops_early_and_frees_slot(setup):
+    cfg, params, prompts, max_new, refs = setup
+    p, ref = prompts[0], refs[0]
+    eos = ref[len(p) + 2]  # the third generated token of the reference
+    sched = Scheduler(replica="t2")
+    eng = ServingEngine(
+        params, cfg, sched, n_slots=1, max_len=32, page_size=4,
+        mode="bf16", prefill_chunk=4,
+    )
+    r = sched.submit(p, max_new[0], eos_id=eos)
+    eng.drain(timeout=600)
+    assert r.future.result(timeout=5) == ref[: len(p) + 3]
+    assert eng.alloc.free_pages == eng.geom.n_pages - 1
+
+
+def test_oversize_request_fails_fast(setup):
+    cfg, params, *_ = setup
+    sched = Scheduler(replica="t3")
+    eng = ServingEngine(
+        params, cfg, sched, n_slots=1, max_len=16, page_size=4,
+        mode="bf16", prefill_chunk=4,
+    )
+    r = sched.submit(list(range(1, 15)), 10)  # 24 tokens > 16 capacity
+    eng.step()
+    with pytest.raises(ValueError):
+        r.future.result(timeout=5)
+    assert eng.active_slots() == 0
+
+
+def test_unaligned_prefill_chunk_rejected(setup):
+    cfg, params, *_ = setup
+    sched = Scheduler(replica="t4")
+    with pytest.raises(ValueError, match="multiple of prefill_chunk"):
+        ServingEngine(
+            params, cfg, sched, n_slots=1, max_len=16, page_size=4,
+            mode="bf16", prefill_chunk=3,
+        )

@@ -1,0 +1,208 @@
+"""Paged KV cache invariants (serving/kv_cache.py).
+
+Property test over random admit/grow/evict traces: the allocator never
+double-assigns a physical page, never hands out the trash page, and
+eviction returns the slot's full page set — free + assigned stays a
+partition of pages 1..n_pages-1 at every step. Device-side: bf16 pages
+round-trip bitwise, int8 pages round-trip within the per-block scale
+bound, and the int8 geometry's resident bytes beat bf16 by ≥1.7×.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models.config import get_config  # noqa: E402
+from dlrover_tpu.ops import quant  # noqa: E402
+from dlrover_tpu.serving import kv_cache as kvc  # noqa: E402
+
+
+def _cfg(**kw):
+    base = dict(
+        n_layer=2, d_model=32, d_ff=64, n_head=4, vocab_size=32, max_seq=64
+    )
+    base.update(kw)
+    return get_config("tiny", **base)
+
+
+def _check_partition(alloc, geom):
+    """free + assigned must partition pages 1..n_pages-1, trash excluded."""
+    assigned = [
+        int(p)
+        for row in alloc._tables
+        for p in row
+        if p >= 0
+    ]
+    assert len(assigned) == len(set(assigned)), "double-assigned page"
+    assert kvc.TRASH_PAGE not in assigned, "trash page handed out"
+    universe = set(range(1, geom.n_pages))
+    assert set(assigned) | set(alloc._free) == universe
+    assert set(assigned) & set(alloc._free) == set()
+
+
+def test_allocator_random_trace_property():
+    geom = kvc.make_geometry(
+        _cfg(), n_slots=4, max_len=40, page_size=4, mode="int8"
+    )
+    alloc = kvc.PageAllocator(geom, 4)
+    rng = np.random.default_rng(0)
+    held = [0, 0, 0, 0]  # tokens covered per slot
+    for _ in range(400):
+        slot = int(rng.integers(0, 4))
+        op = rng.choice(["admit", "grow", "evict"])
+        if op == "admit" and held[slot] == 0:
+            n = int(rng.integers(1, geom.max_len + 5))
+            before = alloc.free_pages
+            ok = alloc.admit(slot, n)
+            assert ok == (
+                alloc.pages_needed(n) <= geom.max_pages_per_slot
+                and alloc.pages_needed(n) <= before
+            )
+            if ok:
+                held[slot] = n
+        elif op == "grow" and held[slot] > 0:
+            n = held[slot] + int(rng.integers(0, 8))
+            before_free = alloc.free_pages
+            before_pages = alloc.slot_pages(slot)
+            ok = alloc.ensure(slot, n)
+            if ok:
+                held[slot] = max(held[slot], n)
+            else:
+                # failed growth must not leak or steal pages
+                assert alloc.free_pages == before_free
+                assert alloc.slot_pages(slot) == before_pages
+        elif op == "evict":
+            n_pages = alloc.slot_pages(slot)
+            freed = alloc.evict(slot)
+            assert freed == n_pages
+            held[slot] = 0
+            assert alloc.slot_pages(slot) == 0
+        _check_partition(alloc, geom)
+    # drain: after evicting everything the free list is whole again
+    for s in range(4):
+        alloc.evict(s)
+    assert alloc.free_pages == geom.n_pages - 1
+    _check_partition(alloc, geom)
+
+
+def test_admit_rejects_nonempty_slot():
+    geom = kvc.make_geometry(
+        _cfg(), n_slots=2, max_len=16, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    assert alloc.admit(0, 5)
+    with pytest.raises(ValueError):
+        alloc.admit(0, 3)
+
+
+def test_bf16_pages_roundtrip_bitwise():
+    cfg = _cfg()
+    geom = kvc.make_geometry(
+        cfg, n_slots=2, max_len=16, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    assert alloc.admit(0, 9) and alloc.admit(1, 6)
+    pools = kvc.init_pools(geom)
+    tables = jnp.asarray(alloc.block_tables())
+    L, B, C = cfg.n_layer, 2, 3
+    shape = (L, B, C, cfg.kv_heads, cfg.head_dim)
+    k = jax.random.normal(jax.random.key(1), shape).astype(jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), shape).astype(jnp.bfloat16)
+    positions = jnp.array([[0, 4, 8], [1, 3, 5]], jnp.int32)
+    valid = jnp.ones((B, C), bool)
+    pools = kvc.write_rows(pools, tables, positions, valid, k, v, geom)
+    got = kvc.gather(pools, tables, geom)
+    for b in range(B):
+        for ci in range(C):
+            pos = int(positions[b, ci])
+            np.testing.assert_array_equal(
+                np.asarray(got["k"][:, b, pos]), np.asarray(k[:, b, ci])
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got["v"][:, b, pos]), np.asarray(v[:, b, ci])
+            )
+
+
+def test_int8_pages_roundtrip_within_scale_bound():
+    cfg = _cfg()
+    geom = kvc.make_geometry(
+        cfg, n_slots=2, max_len=16, page_size=4, mode="int8"
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    assert alloc.admit(0, 8) and alloc.admit(1, 8)
+    pools = kvc.init_pools(geom)
+    tables = jnp.asarray(alloc.block_tables())
+    L, B, C = cfg.n_layer, 2, 4
+    shape = (L, B, C, cfg.kv_heads, cfg.head_dim)
+    k = jax.random.normal(jax.random.key(3), shape).astype(jnp.float32)
+    v = jax.random.normal(jax.random.key(4), shape).astype(jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
+    valid = jnp.ones((B, C), bool)
+    pools = kvc.write_rows(pools, tables, positions, valid, k, v, geom)
+    got = kvc.gather(pools, tables, geom)
+    row = geom.row_elems
+    for b in range(B):
+        for ci in range(C):
+            ref = np.asarray(k[:, b, ci], np.float32).reshape(L, row)
+            dec = np.asarray(
+                got["k"][:, b, ci], np.float32
+            ).reshape(L, row)
+            # per-block bound: quantization error ≤ scale/2 + bf16
+            # rounding of the dequantized value
+            blocks = ref.reshape(L, geom.n_blocks, geom.kv_block)
+            scale = np.abs(blocks).max(-1, keepdims=True) / 127.0
+            bound = np.broadcast_to(
+                scale * 0.51 + 2e-2, blocks.shape
+            ).reshape(L, row)
+            assert (np.abs(ref - dec) <= bound).all()
+
+
+def test_invalid_lanes_hit_trash_page_only():
+    cfg = _cfg()
+    geom = kvc.make_geometry(
+        cfg, n_slots=2, max_len=16, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    assert alloc.admit(0, 8)
+    pools = kvc.init_pools(geom)
+    tables = jnp.asarray(alloc.block_tables())
+    L, B, C = cfg.n_layer, 2, 2
+    shape = (L, B, C, cfg.kv_heads, cfg.head_dim)
+    k = jnp.ones(shape, jnp.bfloat16)
+    v = jnp.ones(shape, jnp.bfloat16)
+    positions = jnp.zeros((B, C), jnp.int32)
+    # slot 1 has NO pages (table row all -1) and is fully invalid
+    valid = jnp.array([[True, True], [False, False]])
+    pools = kvc.write_rows(pools, tables, positions, valid, k, v, geom)
+    # every allocated page except slot 0's first stays zero
+    pool_k = np.asarray(pools["k"], np.float32)
+    slot0_page = int(alloc.block_tables()[0, 0])
+    for page in range(1, geom.n_pages):
+        if page == slot0_page:
+            continue
+        assert (pool_k[:, page] == 0).all(), page
+
+
+def test_resident_bytes_reduction_vs_bf16():
+    for d_model, n_head in ((32, 4), (64, 4), (128, 8)):
+        cfg = _cfg(d_model=d_model, n_head=n_head)
+        g8 = kvc.make_geometry(
+            cfg, n_slots=2, max_len=32, page_size=8, mode="int8"
+        )
+        g16 = g8._replace(mode="bf16")
+        ratio = kvc.resident_bytes(g16) / kvc.resident_bytes(g8)
+        assert ratio >= 1.7, (d_model, ratio)
+
+
+def test_kv_block_size_divides_rows():
+    for row in (8, 32, 96, 128, 256, 320, 384, 1024):
+        blk = quant.kv_block_size(row)
+        assert 1 <= blk <= 256
+        assert row % blk == 0
+
+
+def test_geometry_validates_mode():
+    with pytest.raises(ValueError):
+        kvc.make_geometry(_cfg(), n_slots=1, max_len=8, mode="fp4")

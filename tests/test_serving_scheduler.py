@@ -1,0 +1,113 @@
+"""Scheduler unit tests (serving/scheduler.py) — pure host side.
+
+Priority-then-arrival ordering, bounded-queue admission control,
+re-admission under the original ticket, head-of-line blocking, and the
+ServingRecord telemetry snapshot.
+"""
+
+import pytest
+
+from dlrover_tpu.observability.telemetry import ServingRecord, TelemetryHub
+from dlrover_tpu.serving.scheduler import AdmissionError, Scheduler
+
+
+def test_fifo_within_priority_class():
+    s = Scheduler()
+    r1 = s.submit([1], 4)
+    r2 = s.submit([2], 4)
+    r3 = s.submit([3], 4)
+    assert [s.pop_next().rid for _ in range(3)] == [r1.rid, r2.rid, r3.rid]
+    assert s.pop_next() is None
+
+
+def test_priority_outranks_arrival():
+    s = Scheduler()
+    s.submit([1], 4, priority=5)
+    hi = s.submit([2], 4, priority=0)
+    assert s.pop_next().rid == hi.rid
+
+
+def test_admission_control_bounds_queue():
+    s = Scheduler(max_queue=2)
+    s.submit([1], 4)
+    s.submit([2], 4)
+    with pytest.raises(AdmissionError):
+        s.submit([3], 4)
+    # draining reopens admission
+    s.pop_next()
+    s.submit([3], 4)
+
+
+def test_re_admit_preserves_original_ticket():
+    s = Scheduler()
+    first = s.submit([1], 4)
+    s.submit([2], 4)
+    popped = s.pop_next()
+    assert popped.rid == first.rid
+    # preempted: first re-enters AHEAD of the later arrival
+    s.re_admit(popped)
+    assert s.pop_next().rid == first.rid
+    assert s.re_admitted == 1
+
+
+def test_re_admit_tolerates_foreign_ticket_collision():
+    """A request re-admitted from a DEAD PEER can carry the exact same
+    (priority, arrival) as a local one — the heap must not compare
+    Request objects (the failover bug class)."""
+    a, b = Scheduler(replica="a"), Scheduler(replica="b")
+    local = a.submit([1], 4)
+    foreign = b.submit([2], 4)
+    assert local.arrival == foreign.arrival
+    a.re_admit(foreign)
+    got = {a.pop_next().rid, a.pop_next().rid}
+    assert got == {local.rid, foreign.rid}
+
+
+def test_head_of_line_admission():
+    s = Scheduler()
+    big = s.submit([1] * 10, 4)
+    s.submit([2], 4)
+    # can_admit rejects the head → nothing pops, later arrivals wait
+    assert s.pop_next(lambda r: len(r.prompt) < 5) is None
+    assert s.queue_depth() == 2
+    assert s.pop_next(lambda r: True).rid == big.rid
+
+
+def test_cancelled_future_is_skipped():
+    s = Scheduler()
+    r1 = s.submit([1], 4)
+    r2 = s.submit([2], 4)
+    r1.future.cancel()
+    assert s.pop_next().rid == r2.rid
+    assert s.pop_next() is None
+
+
+def test_complete_resolves_future_once_and_records_latency():
+    s = Scheduler()
+    r = s.submit([1, 2], 2)
+    s.complete(r, [1, 2, 3, 4])
+    assert r.future.result(timeout=1) == [1, 2, 3, 4]
+    # double delivery (failover race) must not blow up or re-resolve
+    s.complete(r, [9, 9, 9, 9])
+    assert r.future.result(timeout=1) == [1, 2, 3, 4]
+    lat = s.latency_ms()
+    assert lat["n"] == 2 and lat["p99"] >= lat["p50"] >= 0.0
+
+
+def test_publish_emits_serving_record():
+    hub = TelemetryHub()
+    seen = []
+    hub.add_sink(type("S", (), {"emit": lambda self, r: seen.append(r)})())
+    s = Scheduler(hub=hub, replica="rep-7")
+    r = s.submit([1], 1)
+    s.complete(r, [1, 2])
+    rec = s.publish({"active_slots": 3, "tokens_per_s": 12.5})
+    assert isinstance(rec, ServingRecord)
+    assert seen and seen[-1] is rec
+    assert rec.replica == "rep-7"
+    assert rec.active_slots == 3
+    assert rec.tokens_per_s == 12.5
+    assert rec.completed == 1 and rec.admitted == 1
+    assert rec.ts > 0  # hub stamps publish time
+    # round-trips as JSON scalars (schema lint contract)
+    assert "rep-7" in rec.to_json()

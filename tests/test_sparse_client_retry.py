@@ -1,0 +1,68 @@
+"""KvClient transport retry (sparse/server.py satellite).
+
+The client's ``_call`` must survive a dropped connection by
+reconnecting under the job-wide full-jitter backoff policy
+(``common.comm._backoff_delay`` — the master client's curve), and must
+NOT retry server-reported (``!``) errors: the server answered, the
+request is wrong.
+"""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.sparse.embedding import EmbeddingSpec
+from dlrover_tpu.sparse.server import KvClient, KvServer
+
+
+@pytest.fixture()
+def server():
+    srv = KvServer(
+        [EmbeddingSpec("emb", 4, initializer="normal",
+                       init_scale=0.01, seed=0)]
+    )
+    yield srv
+    srv.stop()
+
+
+def test_call_reconnects_after_dropped_connection(server, monkeypatch):
+    delays = []
+    monkeypatch.setattr(
+        comm, "_backoff_delay", lambda a: delays.append(a) or 0.0
+    )
+    client = KvClient(server.address, timeout=10.0)
+    keys = np.arange(3, dtype=np.int64)
+    rows = client.pull("emb", keys, train=True)
+    assert rows.shape == (3, 4)
+    # sever the live connection underneath the client (server restart /
+    # repartition); the next call must transparently reconnect
+    client._sock.close()
+    again = client.pull("emb", keys, train=False)
+    np.testing.assert_allclose(again, rows)
+    assert delays == [0], "exactly one retry, on the shared backoff curve"
+    client.close()
+
+
+def test_retries_exhaust_when_server_is_gone(monkeypatch):
+    monkeypatch.setattr(comm, "_backoff_delay", lambda a: 0.0)
+    srv = KvServer(
+        [EmbeddingSpec("emb", 4, initializer="zeros")]
+    )
+    client = KvClient(srv.address, timeout=2.0, retries=2)
+    srv.stop()
+    with pytest.raises((ConnectionError, OSError, EOFError)):
+        client.pull("emb", np.arange(2, dtype=np.int64), train=True)
+    client.close()
+
+
+def test_server_reported_errors_are_not_retried(server, monkeypatch):
+    attempts = []
+    monkeypatch.setattr(
+        comm, "_backoff_delay",
+        lambda a: attempts.append(a) or 0.0,
+    )
+    client = KvClient(server.address, timeout=10.0)
+    with pytest.raises(RuntimeError, match="kv server error"):
+        client.keys("no_such_table")
+    assert attempts == [], "a '!' frame is an answer, not a failure"
+    client.close()
