@@ -133,6 +133,11 @@ def check_kernels(b=2, s=1024) -> bool:
     if caps.flash_attention:
         for h, d in _KERNEL_CHECK_SHAPES:
             ok = ok and _check_flash_shape(close, b, s, h, d)
+    # paged decode kernel at the same head geometries: the serving path
+    # gates on caps.paged_attention exactly like the engine does
+    if caps.paged_attention:
+        for h, d in _KERNEL_CHECK_SHAPES:
+            ok = ok and _check_paged_shape(close, h, d)
     ok = ok and _check_fused_ce(close)
     # fp8 gate at the narrow-head family's GEMM shapes (d_model = h·d,
     # ff = 4·d_model — the gpt2 projections the fp8 path targets);
@@ -218,6 +223,70 @@ def _check_flash_shape(close, b, s, h, d) -> bool:
     ok = close(of, orr, 2e-2)
     for a, b_ in zip(gf, gr):
         ok = ok and close(a, b_, 3e-2)
+    return bool(ok)
+
+
+def _check_paged_shape(close, h, d, b=4, page_size=8, pages=6) -> bool:
+    """Fused paged-decode kernel vs the pure-jnp block-table reference
+    at one head geometry, on the REAL device: ragged per-slot lengths
+    (pages partially filled, tables partially assigned), GQA when the
+    head count allows it, decode (C=1) and chunk (C=4) variants, plus
+    one sliding-window decode. The reference gathers only the pages the
+    table names, so a kernel that walks one page too few/too many or
+    mis-masks the tail shows up here as kernels_ok=false."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.ops import pallas_paged
+
+    hkv = h // 4 if h % 4 == 0 else h  # GQA groups=4 when divisible
+    n_phys = 1 + b * pages  # physical page 0 is the trash page
+    ks = jax.random.split(jax.random.key(11), 4)
+    pools = {
+        "k": jax.random.normal(
+            ks[0], (n_phys, page_size, hkv, d), jnp.bfloat16
+        ),
+        "v": jax.random.normal(
+            ks[1], (n_phys, page_size, hkv, d), jnp.bfloat16
+        ),
+    }
+    rng = np.random.default_rng(29)
+    lens = rng.integers(page_size, pages * page_size, b)
+    tables = np.full((b, pages), -1, np.int32)
+    nxt = 1
+    for i in range(b):
+        for j in range(-(-int(lens[i]) // page_size)):
+            tables[i, j] = nxt
+            nxt += 1
+    tables = jnp.asarray(tables)
+    pos = jnp.asarray(lens - 1, jnp.int32)
+    scale = d ** -0.5
+
+    ok = True
+    q1 = jax.random.normal(ks[2], (b, 1, h, d), jnp.bfloat16)
+    for window in (0, 3 * page_size // 2):
+        out_k = pallas_paged.paged_attention(
+            q1, pools, tables, pos, scale=scale, window=window,
+            kv_heads=hkv, variant="decode",
+        )
+        out_r = pallas_paged.paged_attention_reference(
+            q1, pools, tables, pos, scale=scale, window=window,
+            kv_heads=hkv, variant="decode",
+        )
+        ok = ok and close(out_k, out_r, 2e-2)
+    c = 4
+    qc = jax.random.normal(ks[3], (b, c, h, d), jnp.bfloat16)
+    pos_c = pos[:, None] - jnp.arange(c - 1, -1, -1)[None, :]
+    out_k = pallas_paged.paged_attention(
+        qc, pools, tables, pos_c, scale=scale, kv_heads=hkv,
+        variant="chunk",
+    )
+    out_r = pallas_paged.paged_attention_reference(
+        qc, pools, tables, pos_c, scale=scale, kv_heads=hkv,
+        variant="chunk",
+    )
+    ok = ok and close(out_k, out_r, 2e-2)
     return bool(ok)
 
 
@@ -615,7 +684,8 @@ def serving_trajectory_metric(path=None):
 
 def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
               max_len=64, page_size=8, prefill_chunk=8, max_new=8,
-              p99_target_ms=60000.0, seed=0):
+              p99_target_ms=60000.0, seed=0, paged=True,
+              compare_gather=True):
     """Serving throughput: tokens/sec at a fixed p99 latency target.
 
     Drives the continuous-batching engine (dlrover_tpu/serving/) with
@@ -627,7 +697,17 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
     budget, so ``p99_met`` rides along and a p99 regression shows up
     even when tokens/s improves. Also records the paged-KV memory story:
     int8+scales resident bytes vs the bf16 reference geometry (the
-    ≥1.7× reduction the serving docs quote)."""
+    ≥1.7× reduction the serving docs quote).
+
+    Paged-decode evidence (docs/performance.md): ``decode_kernel`` says
+    which attention path ran; ``hbm_traffic_model`` is the analytic
+    bytes-touched-per-decode-token model at this geometry (paged ≈ pages
+    actually held, gather ≈ the full S_max pool; see
+    kv_cache.decode_traffic_bytes); ``phase_split`` divides wall time
+    into jitted step vs host scheduling (plus how often the block table
+    was re-shipped — the dirty-flag counter). With ``compare_gather``
+    a second identically-seeded pass runs the legacy gather engine and
+    ``paged_speedup_vs_gather`` records the measured ratio."""
     import numpy as np
 
     import jax
@@ -641,40 +721,68 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
         vocab_size=128, max_seq=max_len,
     ) if name == "tiny" else get_config(name, max_seq=max_len)
     params = decoder.init(jax.random.key(seed), cfg)
-    srv = GenerationServer(
-        params, cfg, replica="bench", n_slots=n_slots, max_len=max_len,
-        page_size=page_size, mode=mode, prefill_chunk=prefill_chunk,
-    ).start()
-    try:
-        # warmup: pays the prefill-chunk + decode-batch compiles
-        srv.generate([1, 2, 3], 2, timeout=600.0)
-        srv.scheduler.reset_latencies()
-        srv.engine._tokens = 0
-        srv.engine._t0 = None
 
-        rng = np.random.default_rng(seed)
-        lens = rng.integers(2, max(3, max_len - max_new - 1), n_requests)
-        t0 = time.perf_counter()
-        futs = [
-            srv.submit(
-                list(rng.integers(1, cfg.vocab_size, int(n))), max_new
-            ).future
-            for n in lens
-        ]
-        for f in futs:
-            f.result(timeout=600.0)
-        dt = time.perf_counter() - t0
-        lat = srv.scheduler.latency_ms()
-        new_tokens = n_requests * max_new
-    finally:
-        srv.stop()
+    def one_pass(use_paged, bucketing=True):
+        srv = GenerationServer(
+            params, cfg, replica="bench", n_slots=n_slots,
+            max_len=max_len, page_size=page_size, mode=mode,
+            prefill_chunk=prefill_chunk, paged=use_paged,
+            page_bucketing=bucketing,
+        ).start()
+        try:
+            # warmup: pays the prefill-chunk + decode-batch compiles.
+            # A ladder of prompt lengths (…, half, near-max) runs both
+            # jitted steps at every page-walk bucket a timed request
+            # can reach, so bucket recompiles land here, not in the
+            # timed window.
+            for frac in (8, 4, 2, 1):
+                warm_len = max(3, (max_len - max_new) // frac - 2)
+                warm = list(
+                    np.arange(warm_len) % (cfg.vocab_size - 2) + 1
+                )
+                srv.generate(warm, 2, timeout=600.0)
+            srv.scheduler.reset_latencies()
+            srv.engine._tokens = 0
+            srv.engine._t0 = None
+            srv.engine._step_time = 0.0
 
-    geom = srv.engine.geom
+            rng = np.random.default_rng(seed)
+            lens = rng.integers(
+                2, max(3, max_len - max_new - 1), n_requests
+            )
+            t0 = time.perf_counter()
+            futs = [
+                srv.submit(
+                    list(rng.integers(1, cfg.vocab_size, int(n))),
+                    max_new,
+                ).future
+                for n in lens
+            ]
+            for f in futs:
+                f.result(timeout=600.0)
+            dt = time.perf_counter() - t0
+            lat = srv.scheduler.latency_ms()
+            stats = srv.engine.stats()
+            geom = srv.engine.geom
+        finally:
+            srv.stop()
+        tps = n_requests * max_new / dt if dt > 0 else 0.0
+        return tps, dt, lat, stats, geom, lens
+
+    tokens_per_s, dt, lat, eng_stats, geom, lens = one_pass(paged)
+
     bf16_geom = geom._replace(mode="bf16")
     b_int8 = kvc.resident_bytes(geom._replace(mode="int8"))
     b_bf16 = kvc.resident_bytes(bf16_geom)
-    tokens_per_s = new_tokens / dt if dt > 0 else 0.0
-    return {
+    # analytic HBM model at this run's steady state: every slot busy,
+    # holding the pages for an average-length finished request
+    avg_total = float(np.mean(lens)) + max_new
+    pages_held = n_slots * math.ceil(avg_total / page_size)
+    paged_step = kvc.decode_traffic_bytes(geom, pages_held, n_slots, True)
+    gather_step = kvc.decode_traffic_bytes(
+        geom, pages_held, n_slots, False
+    )
+    record = {
         "metric": f"serve_tokens_per_s[{cfg.name},{mode},{n_slots}slots]",
         "value": round(tokens_per_s, 2),
         "unit": "new_tokens_per_sec",
@@ -685,6 +793,19 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
         "p99_met": lat["p99"] <= p99_target_ms,
         "n_requests": n_requests,
         "max_new_tokens": max_new,
+        "decode_kernel": eng_stats["decode_kernel"],
+        "phase_split": {
+            "wall_s": round(dt, 4),
+            "step_time_s": round(eng_stats["step_time_s"], 4),
+            "host_time_s": round(eng_stats["host_time_s"], 4),
+            "table_ships": eng_stats["table_ships"],
+        },
+        "hbm_traffic_model": {
+            "pages_held": pages_held,
+            "paged_bytes_per_token": paged_step // n_slots,
+            "gather_bytes_per_token": gather_step // n_slots,
+            "model_reduction": round(gather_step / paged_step, 2),
+        },
         "kv_cache": {
             "mode": mode,
             "page_size": page_size,
@@ -694,6 +815,22 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
             "reduction_vs_bf16": round(b_bf16 / b_int8, 3),
         },
     }
+    if compare_gather and paged:
+        # two baselines: the post-PR gather fallback (pages-held
+        # bucketed width) and the pre-PR engine it replaced (full
+        # S_max-wide gather+scatter every step)
+        g_tps = one_pass(False)[0]
+        legacy_tps = one_pass(False, bucketing=False)[0]
+        record["gather_tokens_per_s"] = round(g_tps, 2)
+        record["legacy_gather_tokens_per_s"] = round(legacy_tps, 2)
+        record["paged_speedup_vs_gather"] = (
+            round(tokens_per_s / g_tps, 3) if g_tps > 0 else None
+        )
+        record["paged_speedup_vs_legacy"] = (
+            round(tokens_per_s / legacy_tps, 3) if legacy_tps > 0
+            else None
+        )
+    return record
 
 
 def run_config(name, batch, seq, remat, steps=30, warmup=3,
@@ -967,7 +1104,10 @@ def main():
     if len(sys.argv) >= 2 and sys.argv[1] in ("serve", "--serve"):
         mode = sys.argv[2] if len(sys.argv) > 2 else "int8"
         n_requests = int(sys.argv[3]) if len(sys.argv) > 3 else 8
-        record = run_serve(mode=mode, n_requests=n_requests)
+        max_len = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+        record = run_serve(
+            mode=mode, n_requests=n_requests, max_len=max_len
+        )
         out = os.environ.get("DLROVER_TPU_SERVE_ARTIFACT_OUT")
         if out:
             with open(out, "w") as f:

@@ -111,6 +111,7 @@ class KernelCapabilities:
 
     flash_attention: bool  # Pallas flash attention kernels usable
     fused_norm: bool       # Pallas fused norm/residual kernels usable
+    paged_attention: bool  # fused paged-decode kernel usable (serving)
     fp8_native: bool       # native fp8 MXU dots (else bf16 upcast)
     interpret: bool        # kernels run in Pallas interpret mode
 
@@ -124,11 +125,11 @@ def kernel_capabilities(interpret=None) -> KernelCapabilities:
     rest is module lookups — so callers needn't cache the table and
     env-flipping tests see fresh answers.
     """
-    from dlrover_tpu.ops import pallas_attention, pallas_norm
+    from dlrover_tpu.ops import pallas_attention, pallas_norm, pallas_paged
 
     if interpret is None:
-        # both kernel modules seed from the same env var; norm's copy
-        # is authoritative for defaulting
+        # the kernel modules all seed from the same env var; norm's
+        # copy is authoritative for defaulting
         interpret = pallas_norm.INTERPRET
     ctx = detect_device_context()
     # one Pallas-usability predicate for both kernel families: pltpu
@@ -139,6 +140,7 @@ def kernel_capabilities(interpret=None) -> KernelCapabilities:
     return KernelCapabilities(
         flash_attention=pallas_ok,
         fused_norm=pallas_ok,
+        paged_attention=pallas_paged.kernels_available(interpret),
         fp8_native=ctx.supports_fp8,
         interpret=bool(interpret) and not on_tpu,
     )

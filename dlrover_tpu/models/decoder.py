@@ -28,7 +28,7 @@ from jax.ad_checkpoint import checkpoint_policies as cp
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models.config import ModelConfig
-from dlrover_tpu.ops import pallas_norm
+from dlrover_tpu.ops import pallas_norm, pallas_paged
 from dlrover_tpu.ops.attention import _repeat_kv, mha_reference
 from dlrover_tpu.parallel import sharding as shd
 
@@ -1412,3 +1412,188 @@ def prefill_chunk(
     if cfg.mup_base_width and cfg.tie_embeddings:
         logits = logits * (cfg.mup_base_width / cfg.d_model)
     return logits, {"k": new_k, "v": new_v}
+
+
+# ---------------------------------------------------------------------------
+# Paged decode: block-table pools in, block-table pools out
+# ---------------------------------------------------------------------------
+
+
+def _paged_guards(cfg: ModelConfig, fn: str):
+    if not cfg.causal:
+        raise ValueError(f"{fn} requires a causal model")
+    if cfg.prefix_lm:
+        raise ValueError(
+            f"{fn} is causal-only: paged serving prefills causally in "
+            "chunks, which can never build a prefix-LM cache — use the "
+            "contiguous prefill() path"
+        )
+    if getattr(cfg, "pp_interleave", 1) > 1:
+        raise ValueError(
+            f"{fn} scans layers in storage order; use forward() paths "
+            "for interleave-stacked checkpoints"
+        )
+
+
+def decode_step_paged(
+    params: Params,
+    tokens: jax.Array,        # [B] int32 — token at position ``pos``
+    pools: Dict,              # layer-leading page pools (bf16 or int8)
+    block_tables: jax.Array,  # [B, max_pages] int32, -1 = unassigned
+    pos: jax.Array,           # [B] int32 per-slot positions
+    valid: jax.Array,         # [B] bool — invalid lanes write the trash page
+    cfg: ModelConfig,
+    *,
+    max_pages=None,
+    interpret=None,
+) -> Tuple[jax.Array, Dict]:
+    """``decode_step`` over the serving tier's paged pools directly.
+
+    The gather/scatter round trip is gone: each layer commits the new
+    token's K/V row straight into its page cell (encode-on-write in
+    int8 mode) and attends with ``ops.pallas_paged.paged_attention`` —
+    no `[L, B, S_max, ...]` contiguous cache exists anywhere in the
+    traced step, so per-token K/V traffic is O(pages held), not
+    O(table width). ``max_pages`` (static) bounds the page walk to the
+    host-known maximum pages any slot holds.
+
+    bf16 pools on the reference dispatch reproduce ``decode_step`` over
+    a ``kv_cache.gather`` view **bitwise** (pinned by the serving
+    engine's greedy-parity tests): both paths see the same committed
+    rows plus the same freshly-written row, and pages past a slot's
+    position contribute exact zeros through the f32 softmax.
+
+    Returns (logits [B, V] f32, updated pools).
+    """
+    _paged_guards(cfg, "decode_step_paged")
+    dt = jnp.dtype(cfg.dtype)
+    b = tokens.shape[0]
+    pos = jnp.asarray(pos)
+    if pos.ndim != 1:
+        raise ValueError("decode_step_paged is per-slot: pos must be [B]")
+    positions = pos[:, None].astype(jnp.int32)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    valid = jnp.asarray(valid)
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)[:, None, :]
+    x = x.astype(dt)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+    rope = (
+        _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        if cfg.pos == "rope"
+        else None
+    )
+    scale = 1.0 if cfg.mup_base_width else cfg.head_dim**-0.5
+
+    def layer_fn(carry, inp):
+        x = carry
+        layer, pools_l = inp
+        ln1 = layer["ln1"]
+        h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+        q, k, v = _project_qkv(
+            h, layer, cfg, positions, mup_full_scale=True, rope=rope
+        )
+        # write-before-attend, mirroring decode_step's update order
+        pools_l = pallas_paged.write_page_rows(
+            pools_l, tables, positions, valid[:, None], k, v
+        )
+        attn = pallas_paged.paged_attention(
+            q, pools_l, tables, pos, scale=scale, window=cfg.attn_window,
+            kv_heads=cfg.kv_heads, max_pages=max_pages, variant="decode",
+            interpret=interpret,
+        ).reshape(b, 1, cfg.n_head * cfg.head_dim)
+        attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
+        x = _cache_layer_tail(x, attn_out, layer, cfg)
+        return x, pools_l
+
+    x, new_pools = jax.lax.scan(layer_fn, x, (params["layers"], pools))
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits, new_pools
+
+
+def prefill_chunk_paged(
+    params: Params,
+    tokens: jax.Array,        # [B, C] int32 — one prompt chunk per slot
+    pools: Dict,              # layer-leading page pools (bf16 or int8)
+    block_tables: jax.Array,  # [B, max_pages] int32
+    start: jax.Array,         # [B] int32 chunk start positions
+    chunk_len: jax.Array,     # [B] int32 valid tokens in each chunk
+    cfg: ModelConfig,
+    *,
+    max_pages=None,
+    interpret=None,
+) -> Tuple[jax.Array, Dict]:
+    """``prefill_chunk`` over paged pools: chunk K/V rows commit
+    straight to their page cells (rows past ``chunk_len`` route to the
+    trash page) and queries attend through the paged kernel — the
+    C-query twin of ``decode_step_paged``, same no-contiguous-cache
+    contract. Returns (logits [B, C, V] f32, updated pools)."""
+    _paged_guards(cfg, "prefill_chunk_paged")
+    dt = jnp.dtype(cfg.dtype)
+    b, c = tokens.shape
+    start = jnp.asarray(start, jnp.int32)
+    if start.ndim == 0:
+        start = jnp.broadcast_to(start, (b,))
+    positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(c)[None, :] < jnp.asarray(chunk_len)[:, None]
+    tables = jnp.asarray(block_tables, jnp.int32)
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+    nh, hd = cfg.n_head, cfg.head_dim
+    scale = 1.0 if cfg.mup_base_width else hd**-0.5
+    rope = (
+        _rope_tables(positions, hd, cfg.rope_theta)
+        if cfg.pos == "rope"
+        else None
+    )
+
+    def layer_fn(carry, inp):
+        x = carry
+        layer, pools_l = inp
+        ln1 = layer["ln1"]
+        h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+        q, k, v = _project_qkv(
+            h, layer, cfg, positions, mup_full_scale=True, rope=rope
+        )
+        pools_l = pallas_paged.write_page_rows(
+            pools_l, tables, positions, valid, k, v
+        )
+        attn = pallas_paged.paged_attention(
+            q, pools_l, tables, positions, scale=scale,
+            window=cfg.attn_window, kv_heads=cfg.kv_heads,
+            max_pages=max_pages, variant="chunk", interpret=interpret,
+        ).reshape(b, c, nh * hd)
+        attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
+        x = _cache_layer_tail(x, attn_out, layer, cfg)
+        return x, pools_l
+
+    x, new_pools = jax.lax.scan(layer_fn, x, (params["layers"], pools))
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits, new_pools

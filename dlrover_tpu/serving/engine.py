@@ -19,6 +19,24 @@ int32 per slot to the host. Sampling (per-request temperature, top-k)
 needs per-slot rng plumbing through the fixed batch and is a documented
 follow-on in docs/serving.md.
 
+Two decode kernels share the loop (``paged`` ctor flag):
+
+- **paged** (default) — ``decoder.decode_step_paged`` /
+  ``prefill_chunk_paged``: steps are ``pools → paged step → pools``.
+  K/V rows commit straight to their page cells and attention walks the
+  block table (``ops/pallas_paged.py``), so no contiguous
+  ``[L, B, S_max, ...]`` cache is ever materialized and per-token KV
+  traffic is O(pages held). The page walk is bounded by a power-of-two
+  bucket of the max pages any slot holds (a STATIC jit arg — a handful
+  of compiles over a slot's lifetime, each reading less of the table).
+- **gather** (``paged=False``) — the original
+  gather → decode → scatter round trip, kept as the parity reference
+  (bf16 outputs are bitwise identical between the two).
+
+The block-table device array is re-shipped only when the allocator
+reports a mutation (``consume_dirty``) — steady-state decode steps
+reuse the cached device copy.
+
 Alignment invariant: the slot capacity ``S_max`` must be a multiple of
 ``prefill_chunk``. Chunk starts are always multiples of the chunk width,
 and ``lax.dynamic_slice`` CLAMPS out-of-bounds starts — an unaligned
@@ -65,12 +83,16 @@ class ServingEngine:
         mode: str = "int8",
         prefill_chunk: int = 8,
         slack_pages: int = 0,
+        paged: bool = True,
+        page_bucketing: bool = True,
     ):
         self.params = params
         self.cfg = cfg
         self.scheduler = scheduler
         self.n_slots = n_slots
         self.prefill_chunk = prefill_chunk
+        self.paged = bool(paged)
+        self.page_bucketing = bool(page_bucketing)
         self.geom = kvc.make_geometry(
             cfg, n_slots=n_slots, max_len=max_len, page_size=page_size,
             mode=mode, slack_pages=slack_pages,
@@ -87,64 +109,104 @@ class ServingEngine:
         self.slots: List[Optional[_Slot]] = [None] * n_slots
         self._tokens = 0
         self._t0: Optional[float] = None
+        self._tables_dev = None   # cached device block tables
+        self._table_ships = 0     # host→device table transfers
+        self._step_time = 0.0     # wall seconds inside jitted steps
 
         geom = self.geom
         chunk_w = prefill_chunk
         # buffer donation is a no-op (with a warning) on the CPU backend
         donate = (1,) if jax.default_backend() != "cpu" else ()
 
-        def decode_fn(params, pools, tables, tokens, pos, valid):
-            """One token for every slot: gather pages → decode_step →
-            scatter the new K/V row back (invalid lanes → trash page)."""
-            views = kvc.gather(pools, tables, geom)
-            logits, new_cache = decoder.decode_step(
-                params, tokens, views, pos, cfg, prefilled=True
-            )
-            take = jax.vmap(
-                lambda c, p: jax.lax.dynamic_slice_in_dim(
-                    c, p, 1, axis=1
-                )[:, 0],
-                in_axes=(1, 0),
-                out_axes=1,
-            )
-            rows_k = take(new_cache["k"], pos)[:, :, None]
-            rows_v = take(new_cache["v"], pos)[:, :, None]
-            pools = kvc.write_rows(
-                pools, tables, pos[:, None], valid[:, None],
-                rows_k, rows_v, geom,
-            )
-            return jnp.argmax(logits, -1).astype(jnp.int32), pools
+        if paged:
 
-        def chunk_fn(params, pools, tables, tokens, start, chunk_len):
-            """One prefill chunk for ONE slot (batch dim kept at 1):
-            gather → prefill_chunk → scatter the chunk's K/V rows →
-            argmax at the last VALID position (only meaningful on the
-            final chunk, where it is token 0 of the continuation)."""
-            views = kvc.gather(pools, tables, geom)
-            logits, new_cache = decoder.prefill_chunk(
-                params, tokens, views, start, cfg
-            )
-            take = jax.vmap(
-                lambda c, s: jax.lax.dynamic_slice_in_dim(
-                    c, s, chunk_w, axis=1
-                ),
-                in_axes=(1, 0),
-                out_axes=1,
-            )
-            rows_k = take(new_cache["k"], start)
-            rows_v = take(new_cache["v"], start)
-            positions = start[:, None] + jnp.arange(chunk_w, dtype=jnp.int32)
-            valid = jnp.arange(chunk_w)[None, :] < chunk_len[:, None]
-            pools = kvc.write_rows(
-                pools, tables, positions, valid, rows_k, rows_v, geom,
-            )
-            last = jnp.take_along_axis(
-                logits, (chunk_len - 1)[:, None, None], axis=1
-            )[:, 0]
-            return jnp.argmax(last, -1).astype(jnp.int32), pools
+            def decode_fn(params, pools, tables, tokens, pos, valid,
+                          max_pages):
+                """One token for every slot, pools → pools: rows commit
+                straight to page cells, attention walks the block table
+                (no contiguous-cache gather anywhere in the trace)."""
+                logits, pools = decoder.decode_step_paged(
+                    params, tokens, pools, tables, pos, valid, cfg,
+                    max_pages=max_pages,
+                )
+                return jnp.argmax(logits, -1).astype(jnp.int32), pools
 
-        self._decode_fn = jax.jit(decode_fn, donate_argnums=donate)
-        self._chunk_fn = jax.jit(chunk_fn, donate_argnums=donate)
+            def chunk_fn(params, pools, tables, tokens, start, chunk_len,
+                         max_pages):
+                """One prefill chunk for ONE slot (batch dim kept at 1),
+                pools → pools; argmax at the last VALID position (only
+                meaningful on the final chunk, where it is token 0 of
+                the continuation)."""
+                logits, pools = decoder.prefill_chunk_paged(
+                    params, tokens, pools, tables, start, chunk_len, cfg,
+                    max_pages=max_pages,
+                )
+                last = jnp.take_along_axis(
+                    logits, (chunk_len - 1)[:, None, None], axis=1
+                )[:, 0]
+                return jnp.argmax(last, -1).astype(jnp.int32), pools
+
+        else:
+
+            def decode_fn(params, pools, tables, tokens, pos, valid,
+                          max_pages):
+                """One token for every slot: gather pages → decode_step →
+                scatter the new K/V row back (invalid lanes → trash page).
+                The parity reference for the paged kernel; the gather is
+                sliced to ``max_pages`` held pages."""
+                views = kvc.gather(pools, tables, geom, max_pages=max_pages)
+                logits, new_cache = decoder.decode_step(
+                    params, tokens, views, pos, cfg, prefilled=True
+                )
+                take = jax.vmap(
+                    lambda c, p: jax.lax.dynamic_slice_in_dim(
+                        c, p, 1, axis=1
+                    )[:, 0],
+                    in_axes=(1, 0),
+                    out_axes=1,
+                )
+                rows_k = take(new_cache["k"], pos)[:, :, None]
+                rows_v = take(new_cache["v"], pos)[:, :, None]
+                pools = kvc.write_rows(
+                    pools, tables, pos[:, None], valid[:, None],
+                    rows_k, rows_v, geom,
+                )
+                return jnp.argmax(logits, -1).astype(jnp.int32), pools
+
+            def chunk_fn(params, pools, tables, tokens, start, chunk_len,
+                         max_pages):
+                """Gather-mode prefill chunk (see decode_fn above)."""
+                views = kvc.gather(pools, tables, geom, max_pages=max_pages)
+                logits, new_cache = decoder.prefill_chunk(
+                    params, tokens, views, start, cfg
+                )
+                take = jax.vmap(
+                    lambda c, s: jax.lax.dynamic_slice_in_dim(
+                        c, s, chunk_w, axis=1
+                    ),
+                    in_axes=(1, 0),
+                    out_axes=1,
+                )
+                rows_k = take(new_cache["k"], start)
+                rows_v = take(new_cache["v"], start)
+                positions = (
+                    start[:, None] + jnp.arange(chunk_w, dtype=jnp.int32)
+                )
+                valid = jnp.arange(chunk_w)[None, :] < chunk_len[:, None]
+                pools = kvc.write_rows(
+                    pools, tables, positions, valid, rows_k, rows_v, geom,
+                )
+                last = jnp.take_along_axis(
+                    logits, (chunk_len - 1)[:, None, None], axis=1
+                )[:, 0]
+                return jnp.argmax(last, -1).astype(jnp.int32), pools
+
+        self._decode_fn = jax.jit(
+            decode_fn, donate_argnums=donate, static_argnums=(6,)
+        )
+        self._chunk_fn = jax.jit(
+            chunk_fn, donate_argnums=donate, static_argnums=(6,)
+        )
 
     # ---- queries ---------------------------------------------------------
 
@@ -163,10 +225,43 @@ class ServingEngine:
             "free_pages": self.alloc.free_pages,
             "tokens_generated": self._tokens,
             "tokens_per_s": self._tokens / dt if dt > 0 else 0.0,
+            "decode_kernel": "paged" if self.paged else "gather",
+            "table_ships": self._table_ships,
+            "step_time_s": self._step_time,
+            "host_time_s": max(0.0, dt - self._step_time),
         }
 
     def resident_kv_bytes(self) -> int:
         return kvc.resident_bytes(self.geom)
+
+    # ---- device-side inputs ----------------------------------------------
+
+    def _device_tables(self):
+        """The block tables as a device array, re-shipped only when the
+        allocator mutated since the last ship (the dirty flag) — a
+        steady-state decode step reuses the cached copy instead of
+        paying a host→device transfer per step."""
+        if self.alloc.consume_dirty() or self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self.alloc.block_tables())
+            self._table_ships += 1
+        return self._tables_dev
+
+    def _pages_bucket(self) -> int:
+        """STATIC page-walk width for the jitted steps: the next power
+        of two ≥ the max pages any slot holds, floored at 4 so tiny
+        geometries don't churn compiles. Bounds the attention walk (and
+        the gather reference's width) by what is actually resident
+        while keeping recompiles to a handful over a slot's lifetime."""
+        held = max(
+            (self.alloc.slot_pages(i) for i in range(self.n_slots)),
+            default=1,
+        )
+        b = 4
+        while b < held:
+            b *= 2
+        if not self.page_bucketing:  # ablation: legacy full-pool width
+            return self.geom.max_pages_per_slot
+        return min(b, self.geom.max_pages_per_slot)
 
     # ---- the step loop ---------------------------------------------------
 
@@ -251,16 +346,20 @@ class ServingEngine:
             clen = min(self.prefill_chunk, p - s.n_prefilled)
             chunk = np.zeros(self.prefill_chunk, np.int32)
             chunk[:clen] = s.prompt[s.n_prefilled:s.n_prefilled + clen]
-            tables = jnp.asarray(self.alloc.block_tables()[i:i + 1])
+            tables = self._device_tables()[i:i + 1]
+            t0 = time.monotonic()
             tok0, self.pools = self._chunk_fn(
                 self.params, self.pools, tables,
                 jnp.asarray(chunk[None]),
                 jnp.asarray([s.n_prefilled], jnp.int32),
                 jnp.asarray([clen], jnp.int32),
+                self._pages_bucket(),
             )
+            tok0 = np.asarray(tok0)
+            self._step_time += time.monotonic() - t0
             s.n_prefilled += clen
             if s.n_prefilled == p:
-                s.generated = [int(np.asarray(tok0)[0])]
+                s.generated = [int(tok0[0])]
                 s.phase = "decode"
                 self.scheduler.record_first_token(s.req)
                 self._tokens += 1
@@ -282,12 +381,14 @@ class ServingEngine:
             tokens[i] = s.generated[-1]
             pos[i] = len(s.prompt) + len(s.generated) - 1
             valid[i] = True
+        t0 = time.monotonic()
         tok, self.pools = self._decode_fn(
-            self.params, self.pools,
-            jnp.asarray(self.alloc.block_tables()),
+            self.params, self.pools, self._device_tables(),
             jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(valid),
+            self._pages_bucket(),
         )
         tok = np.asarray(tok)
+        self._step_time += time.monotonic() - t0
         for i in live:
             self.slots[i].generated.append(int(tok[i]))
             self._tokens += 1

@@ -125,20 +125,80 @@ def resident_bytes(geom: PageGeometry) -> int:
     return 2 * rows * (g.row_elems + 4 * g.n_blocks)
 
 
-def gather(pools: Dict, block_tables: jax.Array, geom: PageGeometry) -> Dict:
+def stored_row_bytes(geom: PageGeometry) -> int:
+    """Stored bytes of one token's K+V row (payload + int8 scales)."""
+    g = geom
+    if g.mode == "bf16":
+        return 2 * g.row_elems * jnp.dtype(g.dtype).itemsize
+    return 2 * (g.row_elems + 4 * g.n_blocks)
+
+
+def decode_traffic_bytes(
+    geom: PageGeometry, pages_held: int, n_slots: int, paged: bool
+) -> int:
+    """KV HBM bytes one decode step touches under each kernel — the
+    bench's per-token traffic model (``bench.py serve``).
+
+    - ``paged``: every layer reads only the ``pages_held`` pages the
+      whole batch holds and writes one row per slot::
+
+          L · (pages_held · page_size + B) · stored_row_bytes
+
+    - gather: every layer reads the FULL ``B · max_pages`` table width
+      from the pools, materializes the dequantized compute-dtype copy
+      (one write of ``B · S_max`` dense rows), re-reads it in
+      attention, and scatters the new row back::
+
+          L · B · S_max · (stored_row_bytes + 2 · dense_row_bytes)
+          + L · B · stored_row_bytes
+
+    Model, not measurement: it counts page/row payload traffic and
+    ignores Q/O activations (identical under both kernels) — the point
+    is the asymptotic split, O(pages held) vs O(table width).
+    """
+    g = geom
+    rb = stored_row_bytes(g)
+    dense = 2 * g.row_elems * jnp.dtype(g.dtype).itemsize
+    if paged:
+        return g.n_layers * (pages_held * g.page_size + n_slots) * rb
+    smax = g.max_len
+    return g.n_layers * n_slots * (smax * (rb + 2 * dense) + rb)
+
+
+def gather(
+    pools: Dict,
+    block_tables: jax.Array,
+    geom: PageGeometry,
+    *,
+    max_pages: int = None,
+) -> Dict:
     """Materialize per-slot contiguous caches from the page pools.
 
     ``block_tables`` [B, max_pages] int32 (-1 = unassigned → trash page)
-    → ``{"k","v"}`` [L, B, S_max, Hkv, D] in the model compute dtype,
-    the exact layout ``decoder.decode_step`` scans. Unassigned/garbage
-    positions carry finite trash values; callers mask by slot position.
+    → ``{"k","v"}`` [L, B, W·page_size, Hkv, D] in the model compute
+    dtype, the exact layout ``decoder.decode_step`` scans. Unassigned/
+    garbage positions carry finite trash values; callers mask by slot
+    position.
+
+    ``max_pages`` (static under jit) slices the gather to the first
+    ``max_pages`` table entries — the host knows how many pages any
+    slot actually holds, and pages are assigned in logical order, so
+    the dropped tail is all ``-1``-clamped trash. Every reader masks
+    by position, and masked slots contribute exact zeros through the
+    f32 softmax, so a narrower gather is bitwise-invisible — it just
+    stops touching (and dequantizing, in int8 mode) the whole table
+    width.
     """
-    t = jnp.maximum(block_tables, 0)
     g = geom
+    tables = (
+        block_tables if max_pages is None else block_tables[:, :max_pages]
+    )
+    t = jnp.maximum(tables, 0)
     b = block_tables.shape[0]
+    width = t.shape[1] * g.page_size
 
     def _shape(x):
-        return x.reshape(g.n_layers, b, g.max_len, g.kv_heads, g.head_dim)
+        return x.reshape(g.n_layers, b, width, g.kv_heads, g.head_dim)
 
     if g.mode == "bf16":
         return {"k": _shape(pools["k"][:, t]), "v": _shape(pools["v"][:, t])}
@@ -209,6 +269,9 @@ class PageAllocator:
             (n_slots, geom.max_pages_per_slot), -1, np.int32
         )
         self._n_pages = np.zeros(n_slots, np.int32)
+        # set by every table mutation; the engine consumes it to re-ship
+        # the device copy only when something actually changed
+        self._dirty = True
 
     # ---- queries ---------------------------------------------------------
 
@@ -234,6 +297,15 @@ class PageAllocator:
         not alias a buffer ``evict``/``ensure`` mutates mid-step)."""
         return self._tables.copy()
 
+    def consume_dirty(self) -> bool:
+        """True exactly once after any table mutation since the last
+        call (admit/grow/evict). Lets the engine skip the per-step
+        host-to-device block-table transfer on the (common) steps where
+        no slot changed shape."""
+        d = self._dirty
+        self._dirty = False
+        return d
+
     # ---- transitions -----------------------------------------------------
 
     def admit(self, slot: int, n_tokens: int) -> bool:
@@ -257,6 +329,7 @@ class PageAllocator:
         for i in range(have, need):
             self._tables[slot, i] = self._free.pop()
         self._n_pages[slot] = need
+        self._dirty = True
         return True
 
     def evict(self, slot: int) -> int:
@@ -266,4 +339,6 @@ class PageAllocator:
             self._free.append(int(self._tables[slot, i]))
         self._tables[slot, :] = -1
         self._n_pages[slot] = 0
+        if n:
+            self._dirty = True
         return n

@@ -249,6 +249,50 @@ def test_process_spawning_fault_tests_are_slow():
     )
 
 
+def _imports_pallas_paged(tree) -> bool:
+    """Module-level import of the paged-attention kernel module."""
+    mod_name = "dlrover_tpu.ops.pallas_paged"
+    for node in tree.body:  # module level only, by design
+        if isinstance(node, ast.Import):
+            if any(
+                a.name == mod_name or a.name.startswith(mod_name + ".")
+                for a in node.names
+            ):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == mod_name or mod.startswith(mod_name + "."):
+                return True
+            if mod == "dlrover_tpu.ops" and any(
+                a.name == "pallas_paged" for a in node.names
+            ):
+                return True
+    return False
+
+
+def test_pallas_paged_importers_are_interpret_units_or_slow():
+    """Direct ``ops.pallas_paged`` consumers outside the interpret-mode
+    kernel unit files (``test_pallas*``) are serving integration tests:
+    they drive jitted decode loops over page pools, which belongs in
+    the slow tier. The interpret-mode unit files stay in tier-1 — they
+    are the cheap CPU-executable coverage of the kernel bodies."""
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        if path.name.startswith("test_pallas"):
+            continue  # interpret-mode kernel unit files
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if not _imports_pallas_paged(tree) or _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if not _fn_slow_marked(fn):
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "ops.pallas_paged importers outside interpret-mode unit files "
+        "must be slow-marked (add @pytest.mark.slow or a module "
+        "pytestmark):\n" + "\n".join(rogue)
+    )
+
+
 def _imports_serving_e2e(tree) -> bool:
     """Module-level import of the serving SERVER or REPLICA layer —
     both spin background serve threads and jit-compile the decode

@@ -1,0 +1,372 @@
+"""Paged attention parity (ops/pallas_paged.py).
+
+Two oracles pin the paged decode path:
+
+1. ``paged_attention_reference`` vs the DENSE cached attention the
+   gather engine runs (``decoder._cached_attention`` /
+   ``_chunk_cached_attention`` over a full ``kv_cache.gather``) —
+   BITWISE in bf16 and int8 alike: the reference gathers only the pages
+   the block table names, and the masked tail contributes exact zeros
+   through the f32 softmax. This is the argument that lets the engine
+   keep its greedy-pin bitwise guarantee through the paged path.
+2. The fused kernel (interpret mode, CPU-executable) vs that reference
+   — float tolerance (online softmax reassociates the reduction), over
+   the full matrix: bf16/int8 pools, GQA, sliding window, decode and
+   chunk variants, ragged lengths crossing page boundaries.
+
+Plus the allocator-facing pieces: ``write_page_rows`` must scatter
+bitwise-identically to ``kv_cache.write_rows``, and parity must hold on
+FRAGMENTED tables (random admit/evict traces leave physical pages
+shuffled and interleaved across slots).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models import decoder  # noqa: E402
+from dlrover_tpu.models.config import get_config  # noqa: E402
+from dlrover_tpu.ops import pallas_paged  # noqa: E402
+from dlrover_tpu.serving import kv_cache as kvc  # noqa: E402
+
+
+def _cfg(**kw):
+    base = dict(
+        n_layer=2, d_model=32, d_ff=64, n_head=4, vocab_size=32, max_seq=64
+    )
+    base.update(kw)
+    return get_config("tiny", **base)
+
+
+# slot lengths chosen to cross page boundaries every way page_size=4
+# allows: 9 = 2 full pages + 1 row, 14 = 3 full + 2, 3 = one partial page
+_LENS = (9, 14, 3)
+
+
+def _setup(mode, *, lens=_LENS, page_size=4, max_len=32, cfg=None, seed=0):
+    """Pools holding random K/V rows for ``lens`` tokens per slot."""
+    cfg = cfg or _cfg()
+    n_slots = len(lens)
+    geom = kvc.make_geometry(
+        cfg, n_slots=n_slots, max_len=max_len, page_size=page_size,
+        mode=mode,
+    )
+    alloc = kvc.PageAllocator(geom, n_slots)
+    for i, n in enumerate(lens):
+        assert alloc.admit(i, n)
+    pools = kvc.init_pools(geom)
+    tables = jnp.asarray(alloc.block_tables())
+    c = max(lens)
+    shape = (cfg.n_layer, n_slots, c, cfg.kv_heads, cfg.head_dim)
+    ks = jax.random.split(jax.random.key(seed), 2)
+    dt = jnp.dtype(cfg.dtype)
+    k = jax.random.normal(ks[0], shape).astype(dt)
+    v = jax.random.normal(ks[1], shape).astype(dt)
+    positions = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32),
+                                 (n_slots, c))
+    valid = jnp.asarray(np.arange(c)[None, :] < np.asarray(lens)[:, None])
+    pools = kvc.write_rows(pools, tables, positions, valid, k, v, geom)
+    return cfg, geom, alloc, pools, tables
+
+
+def _layer(pools, layer):
+    return {key: arr[layer] for key, arr in pools.items()}
+
+
+def _q(cfg, b, c, seed=7):
+    return jax.random.normal(
+        jax.random.key(seed), (b, c, cfg.n_head, cfg.head_dim)
+    ).astype(jnp.dtype(cfg.dtype))
+
+
+# ---------------------------------------------------------------------------
+# reference vs the dense gather path — the bitwise oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+@pytest.mark.parametrize("window", [0, 6])
+def test_reference_matches_dense_decode_bitwise(mode, window):
+    cfg, geom, _, pools, tables = _setup(mode, cfg=_cfg(attn_window=window))
+    b, h, d = len(_LENS), cfg.n_head, cfg.head_dim
+    q = _q(cfg, b, 1)
+    pos = jnp.asarray(np.asarray(_LENS) - 1, jnp.int32)
+    dense = kvc.gather(pools, tables, geom)
+    for layer in range(cfg.n_layer):
+        ref = pallas_paged.paged_attention_reference(
+            q, _layer(pools, layer), tables, pos, scale=d ** -0.5,
+            window=window, kv_heads=cfg.kv_heads,
+        )
+        oracle = decoder._cached_attention(
+            q, dense["k"][layer], dense["v"][layer], pos, cfg
+        ).reshape(b, 1, h, d)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(oracle))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_reference_matches_dense_chunk_bitwise(mode):
+    cfg, geom, _, pools, tables = _setup(mode, lens=(9, 14, 6))
+    b, c = 3, 4
+    q = _q(cfg, b, c)
+    # the last c tokens of each slot — queries at ragged depths
+    pos = (
+        jnp.asarray([8, 13, 5], jnp.int32)[:, None]
+        - jnp.arange(c - 1, -1, -1, dtype=jnp.int32)[None, :]
+    )
+    dense = kvc.gather(pools, tables, geom)
+    for layer in range(cfg.n_layer):
+        ref = pallas_paged.paged_attention_reference(
+            q, _layer(pools, layer), tables, pos,
+            scale=cfg.head_dim ** -0.5, kv_heads=cfg.kv_heads,
+            variant="chunk",
+        )
+        oracle = decoder._chunk_cached_attention(
+            q, dense["k"][layer], dense["v"][layer], pos, cfg,
+            cfg.head_dim ** -0.5,
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(oracle))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_partial_walk_max_pages_bitwise(mode):
+    """Slicing the walk to the pages actually held (4 of 8 here) is
+    invisible: the dropped tail is all -1-clamped trash that the
+    position mask zeroes exactly."""
+    cfg, geom, alloc, pools, tables = _setup(mode)
+    held = max(alloc.slot_pages(i) for i in range(len(_LENS)))
+    assert held < geom.max_pages_per_slot
+    q = _q(cfg, len(_LENS), 1)
+    pos = jnp.asarray(np.asarray(_LENS) - 1, jnp.int32)
+    full = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, pos, scale=cfg.head_dim ** -0.5,
+        kv_heads=cfg.kv_heads,
+    )
+    part = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, pos, scale=cfg.head_dim ** -0.5,
+        kv_heads=cfg.kv_heads, max_pages=held,
+    )
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(part))
+
+
+def test_reference_matches_dense_gqa_bitwise():
+    cfg = _cfg(n_kv_head=2)
+    cfg2, geom, _, pools, tables = _setup("bf16", cfg=cfg)
+    assert cfg2.kv_heads == 2 and cfg2.n_head == 4
+    b = len(_LENS)
+    q = _q(cfg, b, 1)
+    pos = jnp.asarray(np.asarray(_LENS) - 1, jnp.int32)
+    dense = kvc.gather(pools, tables, geom)
+    ref = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, pos, scale=cfg.head_dim ** -0.5,
+        kv_heads=cfg.kv_heads,
+    )
+    oracle = decoder._cached_attention(
+        q, dense["k"][0], dense["v"][0], pos, cfg
+    ).reshape(b, 1, cfg.n_head, cfg.head_dim)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(oracle))
+
+
+def test_random_admit_evict_trace_fragmented_parity():
+    """After a random admit/grow/evict trace the physical pages behind
+    each slot are shuffled and interleaved — parity with the dense
+    gather must not depend on pages being contiguous or ascending."""
+    cfg = _cfg()
+    geom = kvc.make_geometry(
+        cfg, n_slots=4, max_len=24, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 4)
+    rng = np.random.default_rng(3)
+    lens = [0, 0, 0, 0]
+    for _ in range(60):
+        slot = int(rng.integers(4))
+        if lens[slot] == 0:
+            n = int(rng.integers(1, geom.max_len + 1))
+            if alloc.can_admit(n):
+                alloc.admit(slot, n)
+                lens[slot] = n
+        elif rng.random() < 0.4:
+            alloc.evict(slot)
+            lens[slot] = 0
+        else:
+            n = min(geom.max_len, lens[slot] + int(rng.integers(0, 5)))
+            if alloc.ensure(slot, n):
+                lens[slot] = n
+    assert any(lens), "trace left no live slot"
+    # physical layout really is fragmented after the trace
+    live_rows = alloc.block_tables()[[i for i in range(4) if lens[i]]]
+    phys = [int(p) for row in live_rows for p in row if p >= 0]
+    assert phys != sorted(phys) or len(phys) != max(phys) - min(phys) + 1
+
+    pools = kvc.init_pools(geom)
+    tables = jnp.asarray(alloc.block_tables())
+    c = max(max(lens), 1)
+    shape = (cfg.n_layer, 4, c, cfg.kv_heads, cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    k = jax.random.normal(jax.random.key(5), shape).astype(dt)
+    v = jax.random.normal(jax.random.key(6), shape).astype(dt)
+    positions = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32), (4, c))
+    valid = jnp.asarray(np.arange(c)[None, :] < np.asarray(lens)[:, None])
+    pools = kvc.write_rows(pools, tables, positions, valid, k, v, geom)
+
+    q = _q(cfg, 4, 1)
+    pos = jnp.asarray(np.maximum(np.asarray(lens) - 1, 0), jnp.int32)
+    dense = kvc.gather(pools, tables, geom)
+    ref = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, pos, scale=cfg.head_dim ** -0.5,
+        kv_heads=cfg.kv_heads,
+    )
+    oracle = decoder._cached_attention(
+        q, dense["k"][0], dense["v"][0], pos, cfg
+    ).reshape(4, 1, cfg.n_head, cfg.head_dim)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(oracle))
+
+
+# ---------------------------------------------------------------------------
+# the fused kernel, interpret mode (CPU-executable)
+# ---------------------------------------------------------------------------
+
+
+def _skip_unless_interpretable():
+    if not pallas_paged.kernels_available(True):
+        pytest.skip("pallas tpu backend not importable")
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+@pytest.mark.parametrize("window", [0, 6])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_kernel_decode_matches_reference(mode, window, gqa):
+    _skip_unless_interpretable()
+    cfg = _cfg(attn_window=window, n_kv_head=2 if gqa else None)
+    cfg, geom, _, pools, tables = _setup(mode, cfg=cfg)
+    q = _q(cfg, len(_LENS), 1)
+    pos = jnp.asarray(np.asarray(_LENS) - 1, jnp.int32)
+    kw = dict(scale=cfg.head_dim ** -0.5, window=window,
+              kv_heads=cfg.kv_heads)
+    out_k = pallas_paged.paged_attention(
+        q, _layer(pools, 0), tables, pos, interpret=True, **kw
+    )
+    out_r = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, pos, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_k, np.float32), np.asarray(out_r, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_kernel_chunk_matches_reference(mode):
+    _skip_unless_interpretable()
+    cfg, geom, _, pools, tables = _setup(mode, lens=(9, 14, 6))
+    c = 4
+    q = _q(cfg, 3, c)
+    pos = (
+        jnp.asarray([8, 13, 5], jnp.int32)[:, None]
+        - jnp.arange(c - 1, -1, -1, dtype=jnp.int32)[None, :]
+    )
+    kw = dict(scale=cfg.head_dim ** -0.5, kv_heads=cfg.kv_heads,
+              variant="chunk")
+    out_k = pallas_paged.paged_attention(
+        q, _layer(pools, 0), tables, pos, interpret=True, **kw
+    )
+    out_r = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, pos, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_k, np.float32), np.asarray(out_r, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_kernel_partial_walk_matches_full(mode="bf16"):
+    _skip_unless_interpretable()
+    cfg, geom, alloc, pools, tables = _setup(mode)
+    held = max(alloc.slot_pages(i) for i in range(len(_LENS)))
+    q = _q(cfg, len(_LENS), 1)
+    pos = jnp.asarray(np.asarray(_LENS) - 1, jnp.int32)
+    kw = dict(scale=cfg.head_dim ** -0.5, kv_heads=cfg.kv_heads)
+    full = pallas_paged.paged_attention(
+        q, _layer(pools, 0), tables, pos, interpret=True, **kw
+    )
+    part = pallas_paged.paged_attention(
+        q, _layer(pools, 0), tables, pos, interpret=True,
+        max_pages=held, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(full, np.float32), np.asarray(part, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# dispatch, capability table, write parity
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_falls_to_reference_off_tpu():
+    """With interpret forced off on CPU the op IS the reference —
+    bitwise, which is what lets the serving engine keep its bf16
+    greedy pin on the CPU test backend."""
+    cfg, geom, _, pools, tables = _setup("bf16")
+    q = _q(cfg, len(_LENS), 1)
+    pos = jnp.asarray(np.asarray(_LENS) - 1, jnp.int32)
+    kw = dict(scale=cfg.head_dim ** -0.5, kv_heads=cfg.kv_heads)
+    out = pallas_paged.paged_attention(
+        q, _layer(pools, 0), tables, pos, interpret=False, **kw
+    )
+    ref = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, pos, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_capability_table_gates_on_interpret():
+    from dlrover_tpu.accelerate.device_context import kernel_capabilities
+
+    caps_on = kernel_capabilities(interpret=True)
+    caps_off = kernel_capabilities(interpret=False)
+    if pallas_paged.pltpu is None:
+        assert not caps_on.paged_attention
+    else:
+        assert caps_on.paged_attention
+    if not jax.default_backend() == "tpu":
+        assert not caps_off.paged_attention
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_write_page_rows_matches_write_rows(mode):
+    """The per-layer scan twin scatters bitwise-identically to the
+    [L, ...] kv_cache.write_rows — same phys/offset math, same trash
+    routing, same int8 encode."""
+    cfg = _cfg()
+    geom = kvc.make_geometry(
+        cfg, n_slots=3, max_len=32, page_size=4, mode=mode
+    )
+    alloc = kvc.PageAllocator(geom, 3)
+    for i, n in enumerate(_LENS):
+        assert alloc.admit(i, n)
+    tables = jnp.asarray(alloc.block_tables())
+    c = 2
+    shape = (cfg.n_layer, 3, c, cfg.kv_heads, cfg.head_dim)
+    k = jax.random.normal(jax.random.key(8), shape).astype(jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(9), shape).astype(jnp.bfloat16)
+    positions = jnp.asarray([[0, 5], [3, 13], [1, 2]], jnp.int32)
+    valid = jnp.asarray([[True, True], [True, True], [True, False]])
+
+    full = kvc.write_rows(
+        kvc.init_pools(geom), tables, positions, valid, k, v, geom
+    )
+    ref_pools = kvc.init_pools(geom)
+    layers = []
+    for layer in range(cfg.n_layer):
+        layers.append(pallas_paged.write_page_rows(
+            _layer(ref_pools, layer), tables, positions, valid,
+            k[layer], v[layer],
+        ))
+    for key in full:
+        stacked = jnp.stack([lay[key] for lay in layers])
+        np.testing.assert_array_equal(
+            np.asarray(full[key]), np.asarray(stacked)
+        )

@@ -185,6 +185,59 @@ def test_invalid_lanes_hit_trash_page_only():
         assert (pool_k[:, page] == 0).all(), page
 
 
+def test_consume_dirty_true_once_per_mutation():
+    geom = kvc.make_geometry(
+        _cfg(), n_slots=2, max_len=16, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    assert alloc.consume_dirty()       # fresh tables must ship once
+    assert not alloc.consume_dirty()   # ...and only once
+    assert alloc.admit(0, 5)
+    assert alloc.consume_dirty()
+    assert not alloc.consume_dirty()
+    assert alloc.ensure(0, 6)          # covered already: no new page
+    assert not alloc.consume_dirty()
+    assert alloc.ensure(0, 9)          # grows by a page
+    assert alloc.consume_dirty()
+    assert alloc.evict(1) == 0         # empty slot: nothing changed
+    assert not alloc.consume_dirty()
+    assert alloc.evict(0) == 3
+    assert alloc.consume_dirty()
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_partial_gather_bitwise_equals_sliced_full(mode):
+    """gather(max_pages=W) must equal the first W·page_size positions
+    of the full gather BITWISE — held pages are a table prefix, so the
+    narrower gather only drops -1-clamped trash."""
+    cfg = _cfg()
+    geom = kvc.make_geometry(
+        cfg, n_slots=2, max_len=32, page_size=4, mode=mode
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    assert alloc.admit(0, 9) and alloc.admit(1, 14)
+    pools = kvc.init_pools(geom)
+    tables = jnp.asarray(alloc.block_tables())
+    L, B, C = cfg.n_layer, 2, 14
+    shape = (L, B, C, cfg.kv_heads, cfg.head_dim)
+    k = jax.random.normal(jax.random.key(11), shape).astype(jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(12), shape).astype(jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
+    valid = jnp.asarray(
+        np.arange(C)[None, :] < np.asarray([9, 14])[:, None]
+    )
+    pools = kvc.write_rows(pools, tables, positions, valid, k, v, geom)
+    held = max(alloc.slot_pages(0), alloc.slot_pages(1))
+    full = kvc.gather(pools, tables, geom)
+    part = kvc.gather(pools, tables, geom, max_pages=held)
+    width = held * geom.page_size
+    for key in ("k", "v"):
+        np.testing.assert_array_equal(
+            np.asarray(part[key]),
+            np.asarray(full[key][:, :, :width]),
+        )
+
+
 def test_resident_bytes_reduction_vs_bf16():
     for d_model, n_head in ((32, 4), (64, 4), (128, 8)):
         cfg = _cfg(d_model=d_model, n_head=n_head)
@@ -194,6 +247,21 @@ def test_resident_bytes_reduction_vs_bf16():
         g16 = g8._replace(mode="bf16")
         ratio = kvc.resident_bytes(g16) / kvc.resident_bytes(g8)
         assert ratio >= 1.7, (d_model, ratio)
+
+
+def test_decode_traffic_model_asymptotics():
+    """The bench's HBM model: paged traffic scales with pages held and
+    stays below the gather cost, which is O(S_max) and independent of
+    what is actually resident."""
+    geom = kvc.make_geometry(
+        _cfg(), n_slots=4, max_len=256, page_size=8, mode="int8"
+    )
+    few = kvc.decode_traffic_bytes(geom, 8, 4, paged=True)
+    many = kvc.decode_traffic_bytes(geom, 64, 4, paged=True)
+    gather = kvc.decode_traffic_bytes(geom, 8, 4, paged=False)
+    assert 0 < few < many < gather
+    # gather cost ignores pages_held entirely — full table width
+    assert gather == kvc.decode_traffic_bytes(geom, 64, 4, paged=False)
 
 
 def test_kv_block_size_divides_rows():
