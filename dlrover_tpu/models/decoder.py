@@ -28,7 +28,7 @@ from jax.ad_checkpoint import checkpoint_policies as cp
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models.config import ModelConfig
-from dlrover_tpu.ops import pallas_norm, pallas_paged
+from dlrover_tpu.ops import pallas_norm, pallas_paged, quant
 from dlrover_tpu.ops.attention import _repeat_kv, mha_reference
 from dlrover_tpu.parallel import sharding as shd
 
@@ -1287,6 +1287,44 @@ def decode_step(
     return logits, {"k": new_k, "v": new_v}
 
 
+def _verify_cached_attention(q, ck, cv, positions, cfg: ModelConfig):
+    """q:[B,C,H,D] over PER-QUERY caches ck/cv:[B,C,Smax,Hkv,D]; query
+    ci attends keys ≤ positions[b, ci] — with ``_cached_attention``'s
+    EXACT op placement, batched over C query rows.
+
+    This is the speculative-decoding verify attention. It deliberately
+    does NOT reuse ``_chunk_cached_attention``: that one mirrors
+    ``mha_reference`` (repeat-kv, probs cast to q.dtype before PV),
+    which at bf16 differs from the decode math by ~1e-3 — enough to
+    break the greedy spec-on bitwise pin. Here the grouped-head einsum
+    keeps probs f32 through PV per query row, so each row's output is
+    bitwise what a sequential ``decode_step`` at that position produces
+    (pinned by tests/test_serving_spec.py). The cache carries a query
+    axis because each query must see a DIFFERENT mix of raw vs
+    as-committed chunk rows (``verify_chunk``)."""
+    b, c, h, d = q.shape
+    smax, hkv = ck.shape[2], ck.shape[3]
+    groups = h // hkv
+    qg = q.reshape(b, c, hkv, groups, d)
+    scale = d**-0.5
+    if cfg.mup_base_width:
+        scale = 1.0  # 1/d folded into q by the caller, matching forward
+    s = jnp.einsum(
+        "bckgd,bcskd->bckgs",
+        qg.astype(jnp.float32),
+        ck.astype(jnp.float32),
+    ) * scale
+    kpos = jnp.arange(smax)
+    mask = kpos[None, None, :] <= positions[:, :, None]  # [B, C, Smax]
+    if cfg.attn_window:
+        mask = mask & (kpos[None, None, :] > positions[:, :, None]
+                       - cfg.attn_window)
+    s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bckgs,bcskd->bckgd", p, cv.astype(jnp.float32))
+    return out.reshape(b, c, h * d).astype(q.dtype)
+
+
 def _chunk_cached_attention(q, ck, cv, positions, cfg: ModelConfig, scale):
     """q:[B,C,H,D] over cached ck/cv:[B,Smax,Hkv,D]; query ci attends
     keys ≤ positions[b, ci].
@@ -1597,3 +1635,212 @@ def prefill_chunk_paged(
     if cfg.mup_base_width and cfg.tie_embeddings:
         logits = logits * (cfg.mup_base_width / cfg.d_model)
     return logits, new_pools
+
+
+# ---------------------------------------------------------------------------
+# Speculative-decoding verify step
+# ---------------------------------------------------------------------------
+
+
+def verify_chunk(
+    params: Params,
+    tokens: jax.Array,  # [B, C] int32 — [last committed token, drafts...]
+    cache: Dict,
+    start: jax.Array,   # [B] int32 — position of the chunk's first row
+    cfg: ModelConfig,
+    as_committed=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Target-model logits for C candidate positions per slot, each row
+    BITWISE what a sequential ``decode_step`` at that position returns.
+
+    The speculative-decoding verify primitive over a dense cache: row 0
+    is the last committed token (its K/V row was deliberately left
+    unwritten by the previous step, exactly as ``decode_step`` leaves
+    it), rows 1..C-1 are draft tokens. Nothing is written into
+    ``cache`` — each query row gets its OWN key/value view in which
+    chunk rows before it appear AS COMMITTED (``as_committed``: e.g.
+    the engine's int8 pool round-trip) while its own row stays raw,
+    exactly the mix a sequential gather→decode→commit loop would see
+    at that position. Rows sit at their true cache indices, so the
+    f32 reductions run in the sequential order and every query runs
+    the decode-variant attention math (``_verify_cached_attention``) —
+    NOT the chunk/prefill math, whose bf16 precision placement differs
+    by ~1e-3 and would break the greedy spec-on pin. Row i's logits
+    predict position start+i+1.
+
+    Returns (logits [B, C, V] f32,
+             chunk_k [L, B, C, Hkv, D], chunk_v [L, B, C, Hkv, D] —
+             RAW rows; the caller commits the ACCEPTED prefix to the
+             pools, which re-applies the commit encoding).
+    """
+    _paged_guards(cfg, "verify_chunk")
+    dt = jnp.dtype(cfg.dtype)
+    b, c = tokens.shape
+    start = jnp.asarray(start, jnp.int32)
+    if start.ndim == 0:
+        start = jnp.broadcast_to(start, (b,))
+    positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+    rope = (
+        _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        if cfg.pos == "rope"
+        else None
+    )
+    s_len = cache["k"].shape[2]
+    # rel[b, s] = chunk index living at cache slot s (clipped; the
+    # in_chunk/own masks gate where the gathered rows actually apply)
+    rel = jnp.arange(s_len, dtype=jnp.int32)[None, :] - start[:, None]
+    relc = jnp.clip(rel, 0, c - 1)
+    in_chunk = ((rel >= 0) & (rel < c))[..., None, None]      # [B,S,1,1]
+    own = (
+        rel[:, None, :] == jnp.arange(c, dtype=jnp.int32)[None, :, None]
+    )[..., None, None]                                        # [B,C,S,1,1]
+    pick = jax.vmap(lambda rows, idx: rows[idx])  # [C,..],[S] -> [S,..]
+
+    def layer_fn(carry, inp):
+        x = carry
+        layer, ck, cv = inp
+        ln1 = layer["ln1"]
+        h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+        q, k, v = _project_qkv(
+            h, layer, cfg, positions, mup_full_scale=True, rope=rope
+        )
+        kc = (k if as_committed is None else as_committed(k)).astype(
+            ck.dtype
+        )
+        vc = (v if as_committed is None else as_committed(v)).astype(
+            cv.dtype
+        )
+        # per-query views: committed prefix from the cache, earlier
+        # chunk rows as-committed, the query's own row raw — all at
+        # their true slot indices (sequential reduction order)
+        base_k = jnp.where(in_chunk, pick(kc, relc), ck)      # [B,S,..]
+        base_v = jnp.where(in_chunk, pick(vc, relc), cv)
+        raw_k = pick(k.astype(ck.dtype), relc)
+        raw_v = pick(v.astype(cv.dtype), relc)
+        ck_q = jnp.where(own, raw_k[:, None], base_k[:, None])
+        cv_q = jnp.where(own, raw_v[:, None], base_v[:, None])
+        attn = _verify_cached_attention(q, ck_q, cv_q, positions, cfg)
+        attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
+        x = _cache_layer_tail(x, attn_out, layer, cfg)
+        return x, (k, v)
+
+    x, (chunk_k, chunk_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits, chunk_k, chunk_v
+
+
+def verify_chunk_paged(
+    params: Params,
+    tokens: jax.Array,        # [B, C] int32 — [last token, drafts...]
+    pools: Dict,              # layer-leading page pools (READ-ONLY here)
+    block_tables: jax.Array,  # [B, max_pages] int32
+    start: jax.Array,         # [B] int32 — position of the chunk's row 0
+    cfg: ModelConfig,
+    *,
+    max_pages=None,
+    interpret=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``verify_chunk`` over paged pools with DEFERRED writes.
+
+    Nothing is written: chunk K/V ride into the paged attention as
+    in-flight extra keys (``variant="verify"``) and come back stacked
+    per layer so the caller can commit ONLY the accepted prefix after
+    the acceptance rule runs — the page-commit invariant (rejected
+    draft rows never reach the pools, so encode-on-write int8 needs no
+    rollback). In int8 mode the in-flight rows are round-tripped
+    through the page quantizer first, so a draft row sees exactly the
+    values it would have as a committed row and acceptance math is
+    independent of commit timing.
+
+    Returns (logits [B, C, V] f32,
+             chunk_k [L, B, C, Hkv, D], chunk_v [L, B, C, Hkv, D]).
+    """
+    _paged_guards(cfg, "verify_chunk_paged")
+    dt = jnp.dtype(cfg.dtype)
+    b, c = tokens.shape
+    start = jnp.asarray(start, jnp.int32)
+    if start.ndim == 0:
+        start = jnp.broadcast_to(start, (b,))
+    positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    tables = jnp.asarray(block_tables, jnp.int32)
+    int8_pool = "k" not in pools
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0).astype(dt)
+    if cfg.pos == "learned":
+        x = x + jnp.take(
+            params["pos_embed"]["table"], positions, axis=0
+        ).astype(dt)
+    nh, hd = cfg.n_head, cfg.head_dim
+    scale = 1.0 if cfg.mup_base_width else hd**-0.5
+    rope = (
+        _rope_tables(positions, hd, cfg.rope_theta)
+        if cfg.pos == "rope"
+        else None
+    )
+
+    def _as_committed(rows, pools_l):
+        """What this K/V row would read back as AFTER a commit: int8
+        pages round-trip through the block quantizer; bf16 pages adopt
+        the pool dtype (a no-op at the default compute dtype)."""
+        if int8_pool:
+            blk = pools_l["k_q"].shape[-1]
+            qv, sc = quant.kv_encode_rows(
+                rows.reshape(b, c, cfg.kv_heads * hd), blk
+            )
+            return quant.kv_decode_rows(qv, sc, dt).reshape(
+                b, c, cfg.kv_heads, hd
+            )
+        return rows.astype(pools_l["k"].dtype)
+
+    def layer_fn(carry, inp):
+        x = carry
+        layer, pools_l = inp
+        ln1 = layer["ln1"]
+        h = _norm(x, ln1["scale"], ln1.get("bias"), cfg.norm)
+        q, k, v = _project_qkv(
+            h, layer, cfg, positions, mup_full_scale=True, rope=rope
+        )
+        attn = pallas_paged.paged_attention(
+            q, pools_l, tables, positions, scale=scale,
+            window=cfg.attn_window, kv_heads=cfg.kv_heads,
+            max_pages=max_pages, variant="verify", interpret=interpret,
+            extra_k=_as_committed(k, pools_l),
+            extra_v=_as_committed(v, pools_l),
+        ).reshape(b, c, nh * hd)
+        attn_out = attn @ layer["attn"]["wo"].astype(x.dtype)
+        x = _cache_layer_tail(x, attn_out, layer, cfg)
+        return x, (k, v)
+
+    x, (chunk_k, chunk_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], pools)
+    )
+    fn = params["final_norm"]
+    x = _norm(x, fn["scale"], fn.get("bias"), cfg.norm)
+    if cfg.tie_embeddings:
+        w_out = params["embed"]["tokens"].T
+    else:
+        w_out = params["lm_head"]["w"]
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, w_out.astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    if cfg.mup_base_width and cfg.tie_embeddings:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits, chunk_k, chunk_v

@@ -30,6 +30,62 @@ def init_kv_cache(cfg, batch: int, max_len: int, dtype=None):
     return decoder.init_kv_cache(cfg, batch, max_len, dtype=dtype)
 
 
+def warp_logits(logits, temperature, top_k=0, top_p=1.0):
+    """Temperature → top-k → top-p logit warp, applied in that order.
+
+    ``logits`` is ``[..., V]`` float32; the parameters are scalars (or
+    0-d arrays — vmap over rows for per-request values). Disabled
+    warpers are exact no-ops: ``top_k=0`` and ``top_p>=1`` leave the
+    temperature-scaled logits bitwise untouched, so the default call is
+    identical to the historical ``logits / temperature``. The caller
+    guarantees ``temperature > 0`` (greedy bypasses the warp entirely).
+
+    Masked entries become ``-inf`` — ``jax.random.categorical`` assigns
+    them zero probability, so the draw distribution is the renormalized
+    truncation of softmax(logits/temperature). This ONE function is
+    shared by the offline sampler and the serving engine's fused
+    in-step sampler, which is what makes the engine-vs-offline sampled
+    pin (tests/test_serving_sampling.py) possible at all.
+    """
+    x = logits / temperature
+    v = x.shape[-1]
+    k = jnp.asarray(top_k, jnp.int32)
+    srt = jnp.sort(x, axis=-1)[..., ::-1]  # descending
+    kth = jnp.take_along_axis(
+        srt,
+        jnp.broadcast_to(jnp.clip(k, 1, v) - 1, x.shape[:-1])[..., None],
+        axis=-1,
+    )
+    x = jnp.where((k > 0) & (x < kth), -jnp.inf, x)
+    p = jnp.asarray(top_p, jnp.float32)
+    # nucleus over the top-k-filtered distribution: smallest sorted
+    # prefix whose probability mass reaches p (-inf entries sort last
+    # and carry zero mass, so they can never be "kept")
+    srt = jnp.sort(x, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    exclusive = jnp.cumsum(probs, axis=-1) - probs
+    n_keep = jnp.maximum((exclusive < p).sum(-1), 1)
+    pth = jnp.take_along_axis(srt, (n_keep - 1)[..., None], axis=-1)
+    return jnp.where((p < 1.0) & (x < pth), -jnp.inf, x)
+
+
+def draw_token(logits, key, temperature, top_k=0, top_p=1.0):
+    """Draw one token per row of ``logits`` ([..., V] f32).
+
+    ``temperature == 0`` selects the argmax — the SAME op the greedy
+    engine runs, so a greedy request through the sampling path stays
+    bitwise identical to the pinned greedy engine. The sampled branch
+    draws ``categorical(key, warp_logits(...))``; both branches are
+    computed and selected elementwise so per-row temperatures can mix
+    greedy and sampled requests in one fused step.
+    """
+    t = jnp.asarray(temperature, jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    warped = warp_logits(logits, jnp.where(t > 0, t, 1.0), top_k, top_p)
+    sampled = jax.random.categorical(key, warped, axis=-1)
+    return jnp.where(t > 0, sampled, greedy_tok).astype(jnp.int32)
+
+
 def sample(
     params,
     cfg,
@@ -43,6 +99,8 @@ def sample(
     use_cache: bool = True,
     prompt_lens: Optional[jax.Array] = None,  # [B] int32 true lengths
     kv_cache: Optional[dict] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Sample continuations; returns [B, P + max_new_tokens].
 
@@ -114,7 +172,7 @@ def sample(
     ):
         return _sample_cached(
             params, cfg, prompts, max_new_tokens, rng, temperature,
-            pad_id, prefix, kv_cache,
+            pad_id, prefix, kv_cache, top_k, top_p,
         )
     if kv_cache is not None:
         raise ValueError(
@@ -138,7 +196,8 @@ def sample(
         )[:, 0, :]
         if temperature > 0.0:
             tok = jax.random.categorical(
-                jax.random.fold_in(rng, i), step_logits / temperature
+                jax.random.fold_in(rng, i),
+                warp_logits(step_logits, temperature, top_k, top_p),
             )
         else:
             tok = jnp.argmax(step_logits, axis=-1)
@@ -153,7 +212,7 @@ def sample(
 
 def _sample_cached(
     params, cfg, prompts, max_new_tokens, rng, temperature, pad_id,
-    prefix, kv_cache=None,
+    prefix, kv_cache=None, top_k=0, top_p=1.0,
 ):
     """Prefill + incremental decode: one batch forward fills the KV
     cache for the whole prompt (prefix-LM masking included), then the
@@ -190,7 +249,8 @@ def _sample_cached(
     def draw(step_logits, i):
         if temperature > 0.0:
             return jax.random.categorical(
-                jax.random.fold_in(rng, i), step_logits / temperature
+                jax.random.fold_in(rng, i),
+                warp_logits(step_logits, temperature, top_k, top_p),
             )
         return jnp.argmax(step_logits, axis=-1)
 

@@ -251,7 +251,14 @@ class ServingRecord:
     over the scheduler's sliding window; ``tokens_per_s`` is the
     engine's decode throughput since its first step. ``re_admitted``
     counts failover re-admissions this replica ABSORBED from dead
-    peers (serving/replica.py ReplicaRouter)."""
+    peers (serving/replica.py ReplicaRouter).
+
+    Speculative decoding (engine ``spec_k > 0``): ``draft_tokens`` /
+    ``accepted_tokens`` are lifetime counts of drafts proposed to and
+    accepted by the verify step; ``spec_accept_rate`` is their ratio
+    (0 with speculation off). Recordings from builds that predate
+    these fields replay fine — ``from_json`` fills missing fields from
+    the dataclass defaults."""
 
     replica: str = ""
     active_slots: int = 0
@@ -262,6 +269,9 @@ class ServingRecord:
     tokens_per_s: float = 0.0
     p50_ms: float = 0.0
     p99_ms: float = 0.0
+    draft_tokens: int = 0
+    accepted_tokens: int = 0
+    spec_accept_rate: float = 0.0
     ts: float = 0.0
 
 
@@ -318,6 +328,9 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("serving_p50_ms", "p50_ms"),
         ("serving_p99_ms", "p99_ms"),
         ("serving_queue_depth", "queue_depth"),
+        ("serving_draft_tokens", "draft_tokens"),
+        ("serving_accepted_tokens", "accepted_tokens"),
+        ("serving_spec_accept_rate", "spec_accept_rate"),
     ],
 }
 _COUNTER_MAP: Dict[str, str] = {

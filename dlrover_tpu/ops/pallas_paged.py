@@ -163,26 +163,41 @@ def paged_attention_reference(
     q,                  # [B, C, H, D] (decode: C == 1)
     pools,              # per-LAYER pool slices (bf16 or int8 keys)
     block_tables,       # [B, max_pages] int32, -1 = unassigned
-    positions,          # decode: [B] (or scalar); chunk: [B, C]
+    positions,          # decode: [B] (or scalar); chunk/verify: [B, C]
     *,
     scale,
     window: int = 0,
     kv_heads=None,
     max_pages=None,
     variant: str = "decode",
+    extra_k=None,       # verify: in-flight chunk K rows [B, C, Hkv, D]
+    extra_v=None,
 ):
     """Paged attention via a pages-held-only gather + the dense cached
     attention, op for op.
 
-    ``variant`` selects which dense reference to replicate — the two
-    differ in precision placement (decode keeps probs f32 through the
-    PV einsum; chunk casts probs to q.dtype first, mirroring
+    ``variant`` selects which dense reference to replicate — decode and
+    chunk differ in precision placement (decode keeps probs f32 through
+    the PV einsum; chunk casts probs to q.dtype first, mirroring
     ``mha_reference``) and must not be mixed or bf16 bitwise parity
     breaks. Output `[B, C, H, D]` in q.dtype. Masked/garbage pages
     (trash, beyond a slot's length) contribute exact zeros through the
     f32 softmax, so slicing the walk to ``max_pages`` held pages is
     invisible to the math — the same argument as the engine's dense
     parity pin.
+
+    ``variant="verify"`` is the speculative-decoding verify step: the C
+    queries are the draft chunk, whose K/V rows (``extra_k``/``extra_v``,
+    at positions ``positions`` themselves) are IN-FLIGHT — appended as
+    extra keys after the committed pages instead of written to the
+    pools, so rejected draft rows never touch page storage. Per query
+    it runs the DECODE variant's math (grouped heads, probs f32 through
+    PV): committed keys mask at ``kpos < positions[:, 0]`` (pool cells
+    at chunk positions may hold a previous tenant's stale rows) and
+    in-flight key i serves query j iff i <= j. The nonzero softmax
+    lanes are the same values in the same order as sequential
+    write-then-attend decode steps, so bf16 verify logits are bitwise
+    equal to the spec-off decode path (pinned by the serving tests).
     """
     b, c, h, d = q.shape
     k, v = gather_pages(pools, block_tables, kv_heads=kv_heads,
@@ -190,6 +205,43 @@ def paged_attention_reference(
     s_len = k.shape[1]
     hkv = k.shape[2]
     kpos = jnp.arange(s_len)
+    if variant == "verify":
+        if extra_k is None or extra_v is None:
+            raise ValueError("verify variant needs extra_k/extra_v rows")
+        positions = jnp.asarray(positions)
+        if positions.ndim != 2:
+            raise ValueError("verify variant needs per-query positions "
+                             "[B, C]")
+        start = positions[:, 0]
+        groups = h // hkv
+        qg = q.reshape(b, c, hkv, groups, d)
+        kf = jnp.concatenate(
+            [k.astype(jnp.float32), extra_k.astype(jnp.float32)], axis=1
+        )
+        vf = jnp.concatenate(
+            [v.astype(jnp.float32), extra_v.astype(jnp.float32)], axis=1
+        )
+        # key positions: committed rows at their cell index, in-flight
+        # rows at the chunk positions
+        key_pos = jnp.concatenate(
+            [jnp.broadcast_to(kpos, (b, s_len)), positions], axis=1
+        )
+        committed = jnp.concatenate(
+            [jnp.ones((b, s_len), bool), jnp.zeros((b, c), bool)], axis=1
+        )
+        mask = key_pos[:, None, :] <= positions[:, :, None]
+        mask = mask & (~committed | (key_pos < start[:, None]))[:, None, :]
+        if window:
+            mask = mask & (
+                key_pos[:, None, :] > positions[:, :, None] - window
+            )
+        s = jnp.einsum(
+            "bckgd,bskd->bckgs", qg.astype(jnp.float32), kf
+        ) * scale
+        s = jnp.where(mask[:, :, None, None, :], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bckgs,bskd->bckgd", p, vf)
+        return out.reshape(b, c, h, d).astype(q.dtype)
     if variant == "decode":
         if c != 1:
             raise ValueError("decode variant takes a single query (C=1)")
@@ -260,6 +312,7 @@ def _paged_kernel(
     n_q,
     int8,
     out_dtype,
+    verify=False,
 ):
     """Fold one physical page into every query row of one slot.
 
@@ -269,8 +322,23 @@ def _paged_kernel(
     flash-style running (max, sum, acc) state per kv head. The page
     walk is the ONLY K/V traffic: nothing the width of the block table
     is ever materialized.
+
+    ``verify=True`` is the speculative-decoding verify step: the grid
+    grows one extra column (B, W+1) whose last program folds the
+    IN-FLIGHT draft-chunk K/V block (an extra VMEM operand, never
+    resident in the pools) instead of a page; committed pages mask at
+    ``kpos < start`` so stale rows at chunk positions are invisible,
+    and in-flight key i serves query row j iff i <= j (causal within
+    the chunk).
     """
-    if int8:
+    if verify:
+        if int8:
+            (kq_ref, ks_ref, vq_ref, vs_ref, ink_ref, inv_ref,
+             o_ref, m_scr, l_scr, acc_scr) = refs
+        else:
+            (k_ref, v_ref, ink_ref, inv_ref,
+             o_ref, m_scr, l_scr, acc_scr) = refs
+    elif int8:
         kq_ref, ks_ref, vq_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
     else:
         k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
@@ -294,7 +362,54 @@ def _paged_kernel(
     max_pos = pos_ref[b, c - 1]
     min_pos = pos_ref[b, 0]
 
-    page_ok = jnp.logical_and(tab_ref[b, j] >= 0, j * page_size <= max_pos)
+    def _fold_block(k, v, allowed):
+        """Advance the running (max, sum, acc) state by one key block
+        ``k``/``v`` [rows, hkv, d] under mask ``allowed`` [n_q, rows]."""
+        for kh in range(hkv):
+            # row order: q is [C, H, D] with H = hkv*groups kv-major, so
+            # kv head kh owns columns [kh*groups, (kh+1)*groups) of H
+            # for every chunk row c → gather those into [c*groups, d].
+            # ``allowed`` is (c, g)-major too (masks depend only on the
+            # chunk row), so it serves every head unchanged.
+            q_h = q_ref[0, :, kh * groups:(kh + 1) * groups, :]
+            q_h = q_h.reshape(c * groups, d).astype(jnp.float32)
+            k_h = k[:, kh, :].astype(jnp.float32)  # [rows, d]
+            v_h = v[:, kh, :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q_h, k_h,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [c·g, rows]
+            s = jnp.where(allowed, s, NEG_INF)
+            m_prev = m_scr[kh][:, :1]
+            l_prev = l_scr[kh][:, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            # zero masked probs explicitly: an all-masked page would
+            # otherwise contribute exp(NEG_INF - NEG_INF) = 1 per lane
+            p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v_h,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[kh] = acc_scr[kh] * alpha + pv
+            m_scr[kh] = jnp.broadcast_to(m_new, m_scr[kh].shape)
+            l_scr[kh] = jnp.broadcast_to(l_new, l_scr[kh].shape)
+
+    # the last grid column of a verify walk is the in-flight block, not
+    # a page — clamp the table read so it never indexes out of bounds
+    tab_w = tab_ref.shape[1]
+    jt = jnp.minimum(j, tab_w - 1)
+    page_ok = jnp.logical_and(tab_ref[b, jt] >= 0, j * page_size <= max_pos)
+    if verify:
+        # committed pages only hold usable rows BELOW the chunk start
+        # (cells at chunk positions may be a previous tenant's stale
+        # rows); the in-flight column handles the rest
+        page_ok = jnp.logical_and(page_ok, j * page_size < min_pos)
+        page_ok = jnp.logical_and(page_ok, j < nj - 1)
     if window:
         # page overlaps [min_pos - window + 1, max_pos]
         page_ok = jnp.logical_and(
@@ -321,43 +436,27 @@ def _paged_kernel(
             + j * page_size
         )
         allowed = kpos <= pos_rows[:, None]
+        if verify:
+            allowed = jnp.logical_and(allowed, kpos < min_pos)
         if window:
             allowed = jnp.logical_and(
                 allowed, kpos > pos_rows[:, None] - window
             )
-        for kh in range(hkv):
-            # row order: q is [C, H, D] with H = hkv*groups kv-major, so
-            # kv head kh owns columns [kh*groups, (kh+1)*groups) of H
-            # for every chunk row c → gather those into [c*groups, d].
-            # ``allowed`` is (c, g)-major too (masks depend only on the
-            # chunk row), so it serves every head unchanged.
-            q_h = q_ref[0, :, kh * groups:(kh + 1) * groups, :]
-            q_h = q_h.reshape(c * groups, d).astype(jnp.float32)
-            k_h = k[:, kh, :].astype(jnp.float32)  # [ps, d]
-            v_h = v[:, kh, :].astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q_h, k_h,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [c·g, ps]
-            s = jnp.where(allowed, s, NEG_INF)
-            m_prev = m_scr[kh][:, :1]
-            l_prev = l_scr[kh][:, :1]
-            m_cur = jnp.max(s, axis=1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            # zero masked probs explicitly: an all-masked page would
-            # otherwise contribute exp(NEG_INF - NEG_INF) = 1 per lane
-            p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
-            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-            pv = jax.lax.dot_general(
-                p, v_h,
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            acc_scr[kh] = acc_scr[kh] * alpha + pv
-            m_scr[kh] = jnp.broadcast_to(m_new, m_scr[kh].shape)
-            l_scr[kh] = jnp.broadcast_to(l_new, l_scr[kh].shape)
+        _fold_block(k, v, allowed)
+
+    if verify:
+
+        @pl.when(j == nj - 1)
+        def _fold_inflight():
+            kpos_in = jnp.stack(
+                [pos_ref[b, i] for i in range(c)]
+            )  # [C] int32 — the chunk positions themselves
+            allowed = kpos_in[None, :] <= pos_rows[:, None]  # [n_q, C]
+            if window:
+                allowed = jnp.logical_and(
+                    allowed, kpos_in[None, :] > pos_rows[:, None] - window
+                )
+            _fold_block(ink_ref[0], inv_ref[0], allowed)
 
     @pl.when(j == nj - 1)
     def _finish():
@@ -371,12 +470,13 @@ def _paged_kernel(
 
 
 def _paged_call(q, pools, tables, positions, *, scale, window, kv_heads,
-                variant, interpret):
+                variant, interpret, extra_k=None, extra_v=None):
     mode, ps, hkv, d = _pool_info(pools, kv_heads)
     b, c, h, _ = q.shape
     groups = h // hkv
     w = tables.shape[1]
     n_q = c * groups
+    verify = variant == "verify"
 
     kernel = functools.partial(
         _paged_kernel,
@@ -388,33 +488,58 @@ def _paged_call(q, pools, tables, positions, *, scale, window, kv_heads,
         n_q=n_q,
         int8=(mode == "int8"),
         out_dtype=q.dtype,
+        verify=verify,
     )
 
+    # a verify walk has one extra grid column (the in-flight block) —
+    # clamp the table read in every index map so it stays in bounds
+    jw = w - 1
     q_spec = pl.BlockSpec((1, c, h, d), lambda i, j, tab, pos: (i, 0, 0, 0))
     if mode == "bf16":
         pool_args = (pools["k"], pools["v"])
         pool_specs = [
-            pl.BlockSpec((1, ps, hkv, d),
-                         lambda i, j, tab, pos: (jnp.maximum(tab[i, j], 0),
-                                                 0, 0, 0))
+            pl.BlockSpec(
+                (1, ps, hkv, d),
+                lambda i, j, tab, pos: (
+                    jnp.maximum(tab[i, jnp.minimum(j, jw)], 0), 0, 0, 0
+                ),
+            )
             for _ in range(2)
         ]
     else:
         nb, blk = pools["k_q"].shape[-2:]
         pool_args = (pools["k_q"], pools["k_scale"],
                      pools["v_q"], pools["v_scale"])
-        qspec = pl.BlockSpec((1, ps, nb, blk),
-                             lambda i, j, tab, pos: (jnp.maximum(tab[i, j], 0),
-                                                     0, 0, 0))
-        sspec = pl.BlockSpec((1, ps, nb),
-                             lambda i, j, tab, pos: (jnp.maximum(tab[i, j], 0),
-                                                     0, 0))
+        qspec = pl.BlockSpec(
+            (1, ps, nb, blk),
+            lambda i, j, tab, pos: (
+                jnp.maximum(tab[i, jnp.minimum(j, jw)], 0), 0, 0, 0
+            ),
+        )
+        sspec = pl.BlockSpec(
+            (1, ps, nb),
+            lambda i, j, tab, pos: (
+                jnp.maximum(tab[i, jnp.minimum(j, jw)], 0), 0, 0
+            ),
+        )
         pool_specs = [qspec, sspec, qspec, sspec]
+
+    extra_args = ()
+    extra_specs = []
+    if verify:
+        if extra_k is None or extra_v is None:
+            raise ValueError("verify variant needs extra_k/extra_v rows")
+        extra_args = (extra_k, extra_v)
+        extra_specs = [
+            pl.BlockSpec((1, c, hkv, d),
+                         lambda i, j, tab, pos: (i, 0, 0, 0))
+            for _ in range(2)
+        ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, w),
-        in_specs=[q_spec] + pool_specs,
+        grid=(b, w + 1) if verify else (b, w),
+        in_specs=[q_spec] + pool_specs + extra_specs,
         out_specs=pl.BlockSpec((1, c, h, d),
                                lambda i, j, tab, pos: (i, 0, 0, 0)),
         scratch_shapes=[
@@ -436,7 +561,7 @@ def _paged_call(q, pools, tables, positions, *, scale, window, kv_heads,
         out_shape=jax.ShapeDtypeStruct((b, c, h, d), q.dtype),
         compiler_params=compiler_params,
         interpret=interpret,
-    )(tables, positions, q, *pool_args)
+    )(tables, positions, q, *pool_args, *extra_args)
     return out
 
 
@@ -452,6 +577,8 @@ def paged_attention(
     max_pages=None,
     variant: str = "decode",
     interpret=None,
+    extra_k=None,
+    extra_v=None,
 ):
     """Paged attention over block-table KV pools — fused when it can be.
 
@@ -462,12 +589,18 @@ def paged_attention(
     online softmax, so it matches the reference to float tolerance, not
     bitwise — CPU serving keeps bitwise pins because CPU dispatch IS
     the reference.
+
+    ``variant="verify"`` (speculative decoding) additionally takes the
+    draft chunk's in-flight ``extra_k``/``extra_v`` rows [B, C, Hkv, D];
+    they are folded as keys WITHOUT ever touching the pools, so a
+    rejected draft row leaves no trace in page storage.
     """
     interpret = INTERPRET if interpret is None else interpret
     if pltpu is None or not (_on_tpu() or interpret):
         return paged_attention_reference(
             q, pools, block_tables, positions, scale=scale, window=window,
             kv_heads=kv_heads, max_pages=max_pages, variant=variant,
+            extra_k=extra_k, extra_v=extra_v,
         )
     tables = (
         block_tables if max_pages is None else block_tables[:, :max_pages]
@@ -485,5 +618,5 @@ def paged_attention(
     return _paged_call(
         q, pools, jnp.asarray(tables, jnp.int32), pos, scale=scale,
         window=window, kv_heads=kv_heads, variant=variant,
-        interpret=interpret,
+        interpret=interpret, extra_k=extra_k, extra_v=extra_v,
     )

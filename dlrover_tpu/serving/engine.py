@@ -12,12 +12,32 @@ mask. Each step is:
 3. prefill one chunk: ONE slot advances its prompt by ``prefill_chunk``
    tokens per engine step (chunked prefill — long prompts interleave
    with decode instead of stalling the whole batch);
-4. decode: one token for every decoding slot in a single jitted call.
+4. decode: one token for every decoding slot in a single jitted call —
+   or, with speculative decoding enabled (``spec_k > 0``), one VERIFY
+   chunk that can commit up to ``spec_k + 1`` tokens per slot per step.
 
-Greedy decoding only: the argmax lives in-graph so each step ships one
-int32 per slot to the host. Sampling (per-request temperature, top-k)
-needs per-slot rng plumbing through the fixed batch and is a documented
-follow-on in docs/serving.md.
+Per-request sampling is first-class: every ``Request`` carries
+``SamplingParams(temperature, top_k, top_p, seed)`` and the fused
+in-step sampler draws ``categorical(warp_logits(...))`` with a per-slot
+threefry key folded by ABSOLUTE buffer position — deterministic given
+the seed and stable across admit/evict reordering and router failover
+re-admission (a re-prefilled request re-derives the identical draws).
+``temperature=0`` stays the in-graph argmax, bitwise identical to the
+historical greedy engine.
+
+Speculative decoding (``spec_k``, prompt-lookup drafts by default):
+each decoding slot proposes up to ``spec_k`` continuation tokens from
+an n-gram suffix match over its own history (no second model — the
+``DraftModel`` hook accepts one), and one jitted verify step scores
+``[last token, drafts...]`` against the paged cache with DEFERRED K/V
+writes. Acceptance is gumbel-coupled rejection sampling: position j's
+target token is drawn exactly as the sequential sampler would draw it,
+a draft survives iff it EQUALS that draw, and the first mismatch emits
+the target draw — so the output stream is token-for-token the
+spec-off stream (exactly the target-model distribution; greedy is the
+temperature=0 case). Only the accepted prefix of chunk K/V rows is
+committed to the pools — rejected draft rows never reach page storage,
+so encode-on-write int8 needs no rollback.
 
 Two decode kernels share the loop (``paged`` ctor flag):
 
@@ -46,15 +66,60 @@ rows. ``__init__`` enforces it.
 
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dlrover_tpu.models import decoder
+from dlrover_tpu.models import decoder, generate
+from dlrover_tpu.ops import pallas_paged, quant
 from dlrover_tpu.serving import kv_cache as kvc
-from dlrover_tpu.serving.scheduler import Request, Scheduler
+from dlrover_tpu.serving.scheduler import AdmissionError, Request, Scheduler
+
+
+class DraftModel:
+    """Draft-token proposer hook for speculative decoding.
+
+    ``propose(history, k)`` returns up to ``k`` candidate continuation
+    tokens for a slot whose committed stream is ``history``
+    (prompt + generated so far). Runs on the host between jitted steps;
+    returning ``[]`` makes the slot fall back to plain decode for that
+    step. Acceptance is handled by the engine's verify step, so a
+    proposer can be arbitrarily wrong without affecting the output
+    distribution — only the accept rate."""
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        raise NotImplementedError
+
+
+class PromptLookupDraft(DraftModel):
+    """Prompt-lookup (n-gram) drafting — no second model.
+
+    Finds the most recent EARLIER occurrence of the history's trailing
+    n-gram (longest first, ``max_ngram`` down to ``min_ngram``) and
+    proposes the tokens that followed it. Input-grounded workloads
+    (summarization, code edits, retrieval) repeat long prompt spans
+    verbatim, which is exactly what this matches."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError("need max_ngram >= min_ngram >= 1")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        hist = [int(t) for t in history]
+        if k <= 0 or len(hist) < 2:
+            return []
+        top = min(self.max_ngram, len(hist) - 1)
+        for n in range(top, self.min_ngram - 1, -1):
+            pat = hist[-n:]
+            for i in range(len(hist) - n - 1, -1, -1):
+                if hist[i:i + n] == pat:
+                    # i + n <= len-1, so there is always >= 1 token here
+                    return hist[i + n:i + n + k]
+        return []
 
 
 @dataclass
@@ -64,6 +129,7 @@ class _Slot:
     req: Request
     phase: str                  # "prefill" | "decode"
     prompt: np.ndarray          # int32 [P]
+    key_data: np.ndarray        # uint32 [2] — threefry key for sampling
     n_prefilled: int = 0
     generated: List[int] = field(default_factory=list)
 
@@ -85,6 +151,8 @@ class ServingEngine:
         slack_pages: int = 0,
         paged: bool = True,
         page_bucketing: bool = True,
+        spec_k: int = 0,
+        draft: Optional[DraftModel] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -93,6 +161,10 @@ class ServingEngine:
         self.prefill_chunk = prefill_chunk
         self.paged = bool(paged)
         self.page_bucketing = bool(page_bucketing)
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self.spec_k = int(spec_k)
+        self.draft = draft if draft is not None else PromptLookupDraft()
         self.geom = kvc.make_geometry(
             cfg, n_slots=n_slots, max_len=max_len, page_size=page_size,
             mode=mode, slack_pages=slack_pages,
@@ -112,16 +184,86 @@ class ServingEngine:
         self._tables_dev = None   # cached device block tables
         self._table_ships = 0     # host→device table transfers
         self._step_time = 0.0     # wall seconds inside jitted steps
+        self._draft_tokens = 0    # drafts proposed to the verify step
+        self._accepted_tokens = 0  # drafts that survived acceptance
 
         geom = self.geom
         chunk_w = prefill_chunk
+
+        def _draw_rows(logits, keys, draw_pos, temp, top_k, top_p):
+            """Fused per-slot sampler: one token per row of ``logits``
+            [B, V], drawn with ``fold_in(slot key, absolute position of
+            the token being drawn)`` — the SAME stream the offline
+            ``generate.sample`` consumes, which is what pins engine
+            sampling against the single-request reference. Greedy rows
+            (temperature 0) take the bitwise-pinned argmax."""
+            base = jax.random.wrap_key_data(keys)
+            draw_keys = jax.vmap(jax.random.fold_in)(base, draw_pos)
+            return jax.vmap(generate.draw_token)(
+                logits, draw_keys, temp, top_k, top_p
+            )
+
+        def _accept_and_emit(logits, tokens, start, valid, n_draft,
+                             keys, temp, top_k, top_p):
+            """Gumbel-coupled rejection sampling over a verify chunk.
+
+            Row j's logits predict position start+j+1; its target token
+            is drawn exactly as the sequential sampler at that position
+            would draw it. Draft d_j (chunk row j) survives iff it
+            EQUALS the target draw from row j-1, acceptance stops at
+            the first mismatch, and the mismatching position emits the
+            target draw itself — so the emitted stream is bitwise the
+            spec-off stream, and in distribution it is exactly the
+            target model's (standard rejection-sampling guarantee for a
+            deterministic proposer). Returns (targets [B, C], n_emit
+            [B], commit mask [B, C] covering rows 0..n_accepted)."""
+            b, c = tokens.shape
+            positions = (
+                start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+            )
+            base = jax.random.wrap_key_data(keys)
+            draw_keys = jax.vmap(
+                lambda kk, ps: jax.vmap(
+                    lambda p: jax.random.fold_in(kk, p)
+                )(ps)
+            )(base, positions + 1)
+            draw = jax.vmap(
+                jax.vmap(
+                    generate.draw_token, in_axes=(0, 0, None, None, None)
+                )
+            )
+            tgt = draw(logits, draw_keys, temp, top_k, top_p)
+            drafts = tokens[:, 1:]
+            draft_ok = jnp.arange(c - 1)[None, :] < n_draft[:, None]
+            match = (drafts == tgt[:, :-1]) & draft_ok
+            n_acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(1)
+            commit = (
+                jnp.arange(c)[None, :] <= n_acc[:, None]
+            ) & valid[:, None]
+            return tgt, n_acc + 1, commit
+
+        def _as_committed_rows(rows):
+            """What a chunk K/V row [B, C, Hkv, D] reads back as AFTER
+            a pool commit — the int8 block codec round-trip (bf16
+            pools: identity). Keeps gather-mode verify acceptance math
+            independent of commit timing."""
+            if geom.mode == "bf16":
+                return rows
+            lead = rows.shape[:2]
+            qv, sc = quant.kv_encode_rows(
+                rows.reshape(*lead, geom.row_elems), geom.kv_block
+            )
+            return quant.kv_decode_rows(qv, sc, rows.dtype).reshape(
+                rows.shape
+            )
+
         # buffer donation is a no-op (with a warning) on the CPU backend
         donate = (1,) if jax.default_backend() != "cpu" else ()
 
         if paged:
 
             def decode_fn(params, pools, tables, tokens, pos, valid,
-                          max_pages):
+                          keys, temp, top_k, top_p, max_pages):
                 """One token for every slot, pools → pools: rows commit
                 straight to page cells, attention walks the block table
                 (no contiguous-cache gather anywhere in the trace)."""
@@ -129,14 +271,15 @@ class ServingEngine:
                     params, tokens, pools, tables, pos, valid, cfg,
                     max_pages=max_pages,
                 )
-                return jnp.argmax(logits, -1).astype(jnp.int32), pools
+                tok = _draw_rows(logits, keys, pos + 1, temp, top_k, top_p)
+                return tok, pools
 
             def chunk_fn(params, pools, tables, tokens, start, chunk_len,
-                         max_pages):
+                         keys, temp, top_k, top_p, max_pages):
                 """One prefill chunk for ONE slot (batch dim kept at 1),
-                pools → pools; argmax at the last VALID position (only
-                meaningful on the final chunk, where it is token 0 of
-                the continuation)."""
+                pools → pools; token 0 of the continuation drawn at the
+                last VALID position (only meaningful on the final
+                chunk)."""
                 logits, pools = decoder.prefill_chunk_paged(
                     params, tokens, pools, tables, start, chunk_len, cfg,
                     max_pages=max_pages,
@@ -144,12 +287,45 @@ class ServingEngine:
                 last = jnp.take_along_axis(
                     logits, (chunk_len - 1)[:, None, None], axis=1
                 )[:, 0]
-                return jnp.argmax(last, -1).astype(jnp.int32), pools
+                tok = _draw_rows(
+                    last, keys, start + chunk_len, temp, top_k, top_p
+                )
+                return tok, pools
+
+            def verify_fn(params, pools, tables, tokens, start, valid,
+                          n_draft, keys, temp, top_k, top_p, max_pages):
+                """Speculative verify for every decoding slot: chunk =
+                [last token, drafts...]; K/V writes are DEFERRED — the
+                paged attention folds the in-flight rows as extra keys,
+                and only rows 0..n_accepted commit to the pools after
+                the acceptance rule runs. Rejected draft rows never
+                reach page storage."""
+                logits, ck, cv = decoder.verify_chunk_paged(
+                    params, tokens, pools, tables, start, cfg,
+                    max_pages=max_pages,
+                )
+                tgt, n_emit, commit = _accept_and_emit(
+                    logits, tokens, start, valid, n_draft,
+                    keys, temp, top_k, top_p,
+                )
+                c = tokens.shape[1]
+                positions = (
+                    start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+                )
+
+                def wr(_, inp):
+                    pools_l, k_l, v_l = inp
+                    return None, pallas_paged.write_page_rows(
+                        pools_l, tables, positions, commit, k_l, v_l
+                    )
+
+                _, pools = jax.lax.scan(wr, None, (pools, ck, cv))
+                return tgt, n_emit, pools
 
         else:
 
             def decode_fn(params, pools, tables, tokens, pos, valid,
-                          max_pages):
+                          keys, temp, top_k, top_p, max_pages):
                 """One token for every slot: gather pages → decode_step →
                 scatter the new K/V row back (invalid lanes → trash page).
                 The parity reference for the paged kernel; the gather is
@@ -171,10 +347,11 @@ class ServingEngine:
                     pools, tables, pos[:, None], valid[:, None],
                     rows_k, rows_v, geom,
                 )
-                return jnp.argmax(logits, -1).astype(jnp.int32), pools
+                tok = _draw_rows(logits, keys, pos + 1, temp, top_k, top_p)
+                return tok, pools
 
             def chunk_fn(params, pools, tables, tokens, start, chunk_len,
-                         max_pages):
+                         keys, temp, top_k, top_p, max_pages):
                 """Gather-mode prefill chunk (see decode_fn above)."""
                 views = kvc.gather(pools, tables, geom, max_pages=max_pages)
                 logits, new_cache = decoder.prefill_chunk(
@@ -199,13 +376,45 @@ class ServingEngine:
                 last = jnp.take_along_axis(
                     logits, (chunk_len - 1)[:, None, None], axis=1
                 )[:, 0]
-                return jnp.argmax(last, -1).astype(jnp.int32), pools
+                tok = _draw_rows(
+                    last, keys, start + chunk_len, temp, top_k, top_p
+                )
+                return tok, pools
+
+            def verify_fn(params, pools, tables, tokens, start, valid,
+                          n_draft, keys, temp, top_k, top_p, max_pages):
+                """Gather-mode verify: no write into the view — each
+                chunk row rides as a per-query key (earlier rows
+                as-committed through the pool codec, own row raw, the
+                sequential loop's exact mix), then only the accepted
+                prefix of RAW rows commits back to the pools. Rejected
+                draft rows still never reach page storage."""
+                c = tokens.shape[1]
+                views = kvc.gather(pools, tables, geom, max_pages=max_pages)
+                logits, rows_k, rows_v = decoder.verify_chunk(
+                    params, tokens, views, start, cfg,
+                    as_committed=_as_committed_rows,
+                )
+                tgt, n_emit, commit = _accept_and_emit(
+                    logits, tokens, start, valid, n_draft,
+                    keys, temp, top_k, top_p,
+                )
+                positions = (
+                    start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+                )
+                pools = kvc.write_rows(
+                    pools, tables, positions, commit, rows_k, rows_v, geom,
+                )
+                return tgt, n_emit, pools
 
         self._decode_fn = jax.jit(
-            decode_fn, donate_argnums=donate, static_argnums=(6,)
+            decode_fn, donate_argnums=donate, static_argnums=(10,)
         )
         self._chunk_fn = jax.jit(
-            chunk_fn, donate_argnums=donate, static_argnums=(6,)
+            chunk_fn, donate_argnums=donate, static_argnums=(10,)
+        )
+        self._verify_fn = jax.jit(
+            verify_fn, donate_argnums=donate, static_argnums=(11,)
         )
 
     # ---- queries ---------------------------------------------------------
@@ -229,6 +438,13 @@ class ServingEngine:
             "table_ships": self._table_ships,
             "step_time_s": self._step_time,
             "host_time_s": max(0.0, dt - self._step_time),
+            "spec_k": self.spec_k,
+            "draft_tokens": self._draft_tokens,
+            "accepted_tokens": self._accepted_tokens,
+            "spec_accept_rate": (
+                self._accepted_tokens / self._draft_tokens
+                if self._draft_tokens else 0.0
+            ),
         }
 
     def resident_kv_bytes(self) -> int:
@@ -273,7 +489,10 @@ class ServingEngine:
         if self._t0 is None and any(self.slots):
             self._t0 = time.monotonic()
         worked = self._prefill_one() or worked
-        worked = self._decode_batch() or worked
+        if self.spec_k:
+            worked = self._spec_batch() or worked
+        else:
+            worked = self._decode_batch() or worked
         return worked
 
     def drain(self, timeout: float = 120.0) -> None:
@@ -284,19 +503,23 @@ class ServingEngine:
             if time.monotonic() > deadline:
                 raise TimeoutError("engine did not drain in time")
 
+    @staticmethod
+    def _slot_done(s: _Slot) -> bool:
+        req = s.req
+        return len(s.generated) >= req.max_new_tokens or (
+            req.eos_id is not None
+            and bool(s.generated)
+            and s.generated[-1] == req.eos_id
+        )
+
     def _finish_and_evict(self) -> bool:
         worked = False
         for i, s in enumerate(self.slots):
             if s is None or s.phase != "decode":
                 continue
-            req = s.req
-            done = len(s.generated) >= req.max_new_tokens or (
-                req.eos_id is not None
-                and s.generated
-                and s.generated[-1] == req.eos_id
-            )
-            if not done:
+            if not self._slot_done(s):
                 continue
+            req = s.req
             self.scheduler.complete(
                 req, [int(t) for t in s.prompt] + s.generated
             )
@@ -324,10 +547,28 @@ class ServingEngine:
             if req is None:
                 return worked
             if req.total_tokens > self.geom.max_len:
-                self.scheduler.fail(req, ValueError(
+                self.scheduler.fail(req, AdmissionError(
                     f"request {req.rid} needs {req.total_tokens} tokens "
                     f"> slot capacity {self.geom.max_len}"
                 ))
+                continue
+            # validate sampling params HERE so a poisoned request fails
+            # its own future instead of raising in the step-loop thread
+            try:
+                req.sampling.validate()
+                key_data = np.asarray(
+                    jax.random.key_data(
+                        jax.random.key(int(req.sampling.seed))
+                    )
+                )
+            except Exception as exc:  # noqa: BLE001 — poisoned objects
+                err = exc if isinstance(exc, AdmissionError) else (
+                    AdmissionError(
+                        f"request {req.rid} has invalid sampling "
+                        f"params: {exc}"
+                    )
+                )
+                self.scheduler.fail(req, err)
                 continue
             # reserve the FULL prompt+generation footprint up front so a
             # decoding slot can never deadlock waiting for pages
@@ -335,8 +576,32 @@ class ServingEngine:
             self.slots[idx] = _Slot(
                 req=req, phase="prefill",
                 prompt=np.asarray(req.prompt, np.int32),
+                key_data=key_data,
             )
             worked = True
+
+    def _sampling_arrays(self, lanes):
+        """Per-lane sampling inputs for the jitted steps: threefry key
+        data, temperature, top_k, top_p. Idle lanes carry defaults
+        (greedy, zero key) so their — masked — draws are well-defined."""
+        n = len(lanes)
+        keys = np.zeros((n, 2), np.uint32)
+        temp = np.zeros(n, np.float32)
+        top_k = np.zeros(n, np.int32)
+        top_p = np.ones(n, np.float32)
+        for j, i in enumerate(lanes):
+            s = self.slots[i]
+            if s is None:
+                continue
+            keys[j] = s.key_data
+            sp = s.req.sampling
+            temp[j] = sp.temperature
+            top_k[j] = sp.top_k
+            top_p[j] = sp.top_p
+        return (
+            jnp.asarray(keys), jnp.asarray(temp),
+            jnp.asarray(top_k), jnp.asarray(top_p),
+        )
 
     def _prefill_one(self) -> bool:
         for i, s in enumerate(self.slots):
@@ -353,6 +618,7 @@ class ServingEngine:
                 jnp.asarray(chunk[None]),
                 jnp.asarray([s.n_prefilled], jnp.int32),
                 jnp.asarray([clen], jnp.int32),
+                *self._sampling_arrays([i]),
                 self._pages_bucket(),
             )
             tok0 = np.asarray(tok0)
@@ -367,9 +633,13 @@ class ServingEngine:
         return False
 
     def _decode_batch(self) -> bool:
+        # a slot can complete within the step that finishes its prefill
+        # (max_new=1, or EOS on the prefill token): it must not decode
+        # an extra token before the next _finish_and_evict sees it
         live = [
             i for i, s in enumerate(self.slots)
             if s is not None and s.phase == "decode"
+            and not self._slot_done(s)
         ]
         if not live:
             return False
@@ -385,6 +655,7 @@ class ServingEngine:
         tok, self.pools = self._decode_fn(
             self.params, self.pools, self._device_tables(),
             jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(valid),
+            *self._sampling_arrays(range(self.n_slots)),
             self._pages_bucket(),
         )
         tok = np.asarray(tok)
@@ -392,4 +663,69 @@ class ServingEngine:
         for i in live:
             self.slots[i].generated.append(int(tok[i]))
             self._tokens += 1
+        return True
+
+    def _spec_batch(self) -> bool:
+        """Speculative variant of ``_decode_batch``: every decoding slot
+        contributes a verify chunk ``[last token, drafts..., pad]`` and
+        the jitted verify step commits 1..spec_k+1 tokens per slot.
+        Falls back to plain decode on steps where NO slot has a draft
+        (the verify chunk would just be a wider decode)."""
+        live = [
+            i for i, s in enumerate(self.slots)
+            if s is not None and s.phase == "decode"
+            and not self._slot_done(s)
+        ]
+        if not live:
+            return False
+        c = self.spec_k + 1
+        tokens = np.zeros((self.n_slots, c), np.int32)
+        start = np.zeros(self.n_slots, np.int32)
+        valid = np.zeros(self.n_slots, bool)
+        n_draft = np.zeros(self.n_slots, np.int32)
+        for i in live:
+            s = self.slots[i]
+            # never draft past the request's budget: the LAST emitted
+            # token must be the one that hits max_new_tokens, so drafts
+            # beyond remaining-1 could commit K/V rows the allocator
+            # never reserved. k_eff keeps every commit inside the
+            # admission footprint.
+            remaining = s.req.max_new_tokens - len(s.generated)
+            k_eff = max(0, min(self.spec_k, remaining - 1))
+            drafts = list(
+                self.draft.propose(
+                    list(s.prompt) + s.generated, k_eff
+                )
+            )[:k_eff]
+            tokens[i, 0] = s.generated[-1]
+            tokens[i, 1:1 + len(drafts)] = drafts
+            start[i] = len(s.prompt) + len(s.generated) - 1
+            valid[i] = True
+            n_draft[i] = len(drafts)
+        if not n_draft.any():
+            return self._decode_batch()
+        t0 = time.monotonic()
+        tgt, n_emit, self.pools = self._verify_fn(
+            self.params, self.pools, self._device_tables(),
+            jnp.asarray(tokens), jnp.asarray(start), jnp.asarray(valid),
+            jnp.asarray(n_draft),
+            *self._sampling_arrays(range(self.n_slots)),
+            self._pages_bucket(),
+        )
+        tgt = np.asarray(tgt)
+        n_emit = np.asarray(n_emit)
+        self._step_time += time.monotonic() - t0
+        for i in live:
+            s = self.slots[i]
+            n = int(n_emit[i])
+            self._draft_tokens += int(n_draft[i])
+            self._accepted_tokens += n - 1
+            for j in range(n):
+                s.generated.append(int(tgt[i, j]))
+                self._tokens += 1
+                if len(s.generated) >= s.req.max_new_tokens or (
+                    s.req.eos_id is not None
+                    and s.generated[-1] == s.req.eos_id
+                ):
+                    break
         return True

@@ -157,7 +157,8 @@ class ReplicaRouter:
         return [r for r in self.replicas if r.alive]
 
     def submit(
-        self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0
+        self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0,
+        sampling=None,
     ) -> Request:
         with self._lock:
             live = self._live()
@@ -166,7 +167,8 @@ class ReplicaRouter:
             replica = live[self._rr % len(live)]
             self._rr += 1
             req = replica.submit(
-                prompt, max_new_tokens, eos_id=eos_id, priority=priority
+                prompt, max_new_tokens, eos_id=eos_id, priority=priority,
+                sampling=sampling,
             )
             entry = _Entry(req, replica)
             req.future.add_done_callback(self._mark_done(entry))

@@ -22,8 +22,46 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 
-class AdmissionError(RuntimeError):
-    """Queue at capacity — the caller should back off and retry."""
+class AdmissionError(ValueError):
+    """The request cannot be admitted: queue at capacity (back off and
+    retry), or invalid parameters (fix the request). Subclasses
+    ValueError so pre-existing callers catching ValueError on the
+    future still work."""
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode policy carried on the ``Request``.
+
+    ``temperature=0`` is greedy (the engine's pinned bitwise path);
+    ``temperature>0`` samples ``categorical(warp_logits(...))`` with a
+    per-slot threefry key derived from ``seed`` — deterministic given
+    the seed and STABLE across admit/evict reordering and router
+    failover re-admission, because every draw folds in the absolute
+    buffer position of the token being drawn rather than any engine
+    step counter. ``top_k=0`` / ``top_p=1.0`` disable those warps.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def validate(self) -> None:
+        """Raise ``AdmissionError`` on out-of-domain parameters. The
+        engine calls this at ADMISSION (not submit) so a poisoned
+        request fails its own future instead of killing the step-loop
+        thread."""
+        if not (self.temperature >= 0.0):  # catches NaN too
+            raise AdmissionError(
+                f"temperature must be >= 0, got {self.temperature}"
+            )
+        if self.top_k < 0:
+            raise AdmissionError(f"top_k must be >= 0, got {self.top_k}")
+        if not (0.0 < self.top_p <= 1.0):  # catches NaN too
+            raise AdmissionError(
+                f"top_p must be in (0, 1], got {self.top_p}"
+            )
 
 
 @dataclass
@@ -39,6 +77,7 @@ class Request:
     submit_t: float = 0.0
     first_token_t: float = 0.0  # 0 until the prefill emits token 0
     done_t: float = 0.0
+    sampling: SamplingParams = field(default_factory=SamplingParams)
     future: Future = field(default_factory=Future)
 
     @property
@@ -81,6 +120,7 @@ class Scheduler:
         max_new_tokens: int,
         eos_id: Optional[int] = None,
         priority: int = 0,
+        sampling: Optional[SamplingParams] = None,
     ) -> Request:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -98,6 +138,7 @@ class Scheduler:
                 priority=int(priority),
                 arrival=arrival,
                 submit_t=time.monotonic(),
+                sampling=sampling or SamplingParams(),
             )
             heapq.heappush(
                 self._heap,
@@ -199,6 +240,9 @@ class Scheduler:
             tokens_per_s=float(es.get("tokens_per_s", 0.0)),
             p50_ms=round(lat["p50"], 3),
             p99_ms=round(lat["p99"], 3),
+            draft_tokens=int(es.get("draft_tokens", 0)),
+            accepted_tokens=int(es.get("accepted_tokens", 0)),
+            spec_accept_rate=float(es.get("spec_accept_rate", 0.0)),
         )
         if self.hub is not None:
             self.hub.publish(rec)

@@ -17,7 +17,9 @@ import threading
 import time
 
 from dlrover_tpu.serving.engine import ServingEngine
-from dlrover_tpu.serving.scheduler import Request, Scheduler
+from dlrover_tpu.serving.scheduler import (
+    Request, SamplingParams, Scheduler,
+)
 
 
 class GenerationServer:
@@ -90,7 +92,8 @@ class GenerationServer:
     # ---- intake ----------------------------------------------------------
 
     def submit(
-        self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0
+        self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0,
+        sampling: SamplingParams | None = None,
     ) -> Request:
         if len(prompt) + max_new_tokens > self.engine.max_len:
             raise ValueError(
@@ -98,19 +101,23 @@ class GenerationServer:
                 f"exceeds slot capacity {self.engine.max_len}"
             )
         return self.scheduler.submit(
-            prompt, max_new_tokens, eos_id=eos_id, priority=priority
+            prompt, max_new_tokens, eos_id=eos_id, priority=priority,
+            sampling=sampling,
         )
 
     def re_admit(self, req: Request) -> None:
         """Failover intake: requeue another replica's in-flight request
         under its original admission ticket (generation restarts from
-        the prompt — live-page migration is the documented follow-on)."""
+        the prompt — live-page migration is the documented follow-on).
+        ``req.sampling`` rides along, and position-indexed draws make
+        the re-prefilled continuation identical to the original."""
         self.scheduler.re_admit(req)
 
     def generate(
-        self, prompt, max_new_tokens: int, eos_id=None, timeout: float = 120.0
+        self, prompt, max_new_tokens: int, eos_id=None,
+        timeout: float = 120.0, sampling: SamplingParams | None = None,
     ):
         """Blocking convenience: submit and wait for the full sequence."""
         return self.submit(
-            prompt, max_new_tokens, eos_id=eos_id
+            prompt, max_new_tokens, eos_id=eos_id, sampling=sampling
         ).future.result(timeout)

@@ -33,6 +33,7 @@ def _run(args, timeout, env_extra=None):
     )
 
 
+@pytest.mark.slow  # tier-1 budget: full subprocess bench run; schema readers stay fast
 def test_bench_single_tiny_emits_schema():
     out = _run(
         ["--single", "tiny", "2", "64", "none"],
@@ -52,6 +53,7 @@ def test_bench_single_tiny_emits_schema():
     assert rec["sentinel_overhead_frac"] is not None
 
 
+@pytest.mark.slow  # tier-1 budget: full subprocess bench run; schema readers stay fast
 def test_bench_single_block_k_mode():
     """Fused-block bench (block_k>1): same schema as block_k=1, plus the
     block fields, so the k=8-vs-k=1 host-overhead comparison stays
@@ -80,6 +82,7 @@ def test_bench_aux_modes_cpu_safe():
     assert json.loads(out.stdout.strip().splitlines()[-1]) == {}
 
 
+@pytest.mark.slow  # tier-1 budget: full subprocess bench run; schema readers stay fast
 def test_bench_single_save_qkv_offload_recipe():
     """The promoted gpt2 remat policy runs end-to-end on CPU (offload
     residency is a no-op there; the policy/plumbing is what's smoked)."""
@@ -285,6 +288,16 @@ def test_bench_serve_mode_emits_schema():
         rec["kv_cache"]["resident_bytes_int8"]
         < rec["kv_cache"]["resident_bytes_bf16"]
     )
+    # the speculative arm rode along: spec-on throughput at the same
+    # p99 target plus the measured acceptance rate (reported honestly —
+    # no assertion that spec wins on the CPU test backend)
+    spec = rec["speculative"]
+    assert spec["spec_k"] > 0
+    assert spec["tokens_per_s"] > 0
+    assert spec["draft_tokens"] > 0
+    assert 0.0 <= spec["accept_rate"] <= 1.0
+    assert spec["accepted_tokens"] <= spec["draft_tokens"]
+    assert spec["speedup_vs_specoff"] > 0
 
 
 def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
@@ -312,6 +325,23 @@ def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
     monkeypatch.setenv("DLROVER_TPU_SERVE_ARTIFACT", str(p))
     assert bench.serving_trajectory_metric()["serve_tokens_per_s"] == \
         pytest.approx(123.4)
+    # a spec-bearing artifact projects the speculative headline too
+    pspec = tmp_path / "SERVE_spec.json"
+    pspec.write_text(json.dumps({
+        "serve_tokens_per_s": 123.4,
+        "serve_p99_ms": 80.5,
+        "p99_target_ms": 200.0,
+        "p99_met": True,
+        "speculative": {
+            "spec_k": 3, "tokens_per_s": 150.0, "accept_rate": 0.62,
+            "speedup_vs_specoff": 1.21, "draft_tokens": 90,
+            "accepted_tokens": 56, "p99_ms": 70.0, "p99_met": True,
+        },
+    }))
+    got_spec = bench.serving_trajectory_metric(str(pspec))
+    assert got_spec["spec_tokens_per_s"] == pytest.approx(150.0)
+    assert got_spec["spec_accept_rate"] == pytest.approx(0.62)
+    assert got_spec["spec_speedup_vs_specoff"] == pytest.approx(1.21)
     # missing/corrupt/unmeasured artifacts degrade to None
     assert bench.serving_trajectory_metric(
         str(tmp_path / "nope.json")

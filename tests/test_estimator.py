@@ -456,6 +456,7 @@ def test_estimator_trains_checkpoints_and_prunes(tmp_path):
         s1.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: prunes/outage keep fast estimator coverage
 def test_train_and_evaluate_exports_best(tmp_path):
     s0 = _start_server()
     try:
@@ -487,6 +488,7 @@ def test_train_and_evaluate_exports_best(tmp_path):
         s0.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: prunes/outage keep fast estimator coverage
 def test_estimator_resume_from_latest(tmp_path):
     s0 = _start_server()
     try:
@@ -811,6 +813,7 @@ def test_checkpoint_saver_hook_incremental_cadence():
     ]
 
 
+@pytest.mark.slow  # tier-1 budget: prunes/outage keep fast estimator coverage
 def test_estimator_incremental_restore(tmp_path):
     """A delta saved after the last full checkpoint restores forward to
     the delta step: fresh estimator resumes at step 10 from dir ckpt-8
@@ -1067,6 +1070,7 @@ def test_brain_weight_clear_reaches_trainers():
         s1.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: prunes/outage keep fast estimator coverage
 def test_evaluator_role_watches_checkpoints(tmp_path):
     """A separate evaluator-role estimator (task_type='evaluator', not
     chief) watches the model_dir, evaluates each new checkpoint, and
@@ -1135,6 +1139,7 @@ def test_file_reader_string_columns(tmp_path):
         )._batch(["x"])
 
 
+@pytest.mark.slow  # tier-1 budget: prunes/outage keep fast estimator coverage
 def test_estimator_executor_env_cluster_and_resume(tmp_path, monkeypatch):
     """EstimatorExecutor end to end: cluster spec injected via env (the
     set_tf_config path), train_and_evaluate, then a RESTARTED executor

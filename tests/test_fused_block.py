@@ -152,7 +152,13 @@ def _run(tmp_path, block_k, max_steps=13, save_interval=6,
     return trainer, rec, state
 
 
-@pytest.mark.parametrize("block_k", [3, 5, 8, 13, 64])
+# tier-1 budget: block_k=3 exercises the auto-shrink boundary logic on
+# the fast tier; the other widths re-prove the same property and ride
+# the slow tier
+@pytest.mark.parametrize(
+    "block_k",
+    [3] + [pytest.param(k, marks=pytest.mark.slow) for k in (5, 8, 13, 64)],
+)
 def test_blockwise_cadences_match_stepwise(tmp_path, block_k):
     # 13 steps, save every 6, log every 4: none of these divide the
     # block sizes, so every boundary requires the auto-shrink
@@ -169,6 +175,7 @@ def test_blockwise_cadences_match_stepwise(tmp_path, block_k):
         assert fused.losses[s] == base.losses[s]
 
 
+@pytest.mark.slow  # tier-1 budget: trainer covers these cadence/exhaustion paths fast
 def test_blockwise_eval_cadence_and_final_partial_block(tmp_path):
     _, rec, state = _run(
         tmp_path, 4, max_steps=10, save_interval=0, eval_interval=5,
@@ -178,6 +185,7 @@ def test_blockwise_eval_cadence_and_final_partial_block(tmp_path):
     assert int(state["step"]) == 10
 
 
+@pytest.mark.slow  # tier-1 budget: trainer covers these cadence/exhaustion paths fast
 def test_blockwise_data_exhaustion_runs_partial_block(tmp_path):
     # 10 batches with block_k=4: final block is a partial (2-step) one;
     # every consumed batch must become exactly one step

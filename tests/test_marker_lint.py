@@ -293,6 +293,81 @@ def test_pallas_paged_importers_are_interpret_units_or_slow():
     )
 
 
+# ---------------------------------------------------------------------------
+# tier-1 duration ledger
+# ---------------------------------------------------------------------------
+# Tests measured >= ~9s on the tier-1 backend whose property is already
+# covered by a faster sibling were moved to the slow tier to keep the
+# suite inside its 870s budget (measured: the pre-rebalance fast tier
+# ran ~1077s). This ledger pins that decision: each entry must exist
+# AND must not collect under ``-m 'not slow'``. Removing a mark without
+# updating the ledger is a hard failure; deleting/renaming the test
+# fails the existence check so the ledger can't rot silently.
+_SLOW_LEDGER = [
+    "test_bench_smoke.py::test_bench_single_tiny_emits_schema",
+    "test_bench_smoke.py::test_bench_single_block_k_mode",
+    "test_bench_smoke.py::test_bench_single_save_qkv_offload_recipe",
+    "test_fused_block.py::test_blockwise_cadences_match_stepwise[5]",
+    "test_fused_block.py::test_blockwise_cadences_match_stepwise[8]",
+    "test_fused_block.py::test_blockwise_cadences_match_stepwise[13]",
+    "test_fused_block.py::test_blockwise_cadences_match_stepwise[64]",
+    "test_fused_block.py::test_blockwise_eval_cadence_and_final_partial_block",
+    "test_fused_block.py::test_blockwise_data_exhaustion_runs_partial_block",
+    "test_estimator.py::test_train_and_evaluate_exports_best",
+    "test_estimator.py::test_estimator_resume_from_latest",
+    "test_estimator.py::test_estimator_incremental_restore",
+    "test_estimator.py::test_evaluator_role_watches_checkpoints",
+    "test_estimator.py::test_estimator_executor_env_cluster_and_resume",
+    "test_sentinels.py::test_sentinels_add_no_device_to_host_transfers",
+    "test_watchdog.py::test_nan_drill_end_to_end",
+    "test_trainer.py::test_elastic_remesh_resume",
+    "test_trainer.py::test_prefetch_to_device_preserves_stream",
+    "test_model.py::test_sharded_init_and_step",
+    "test_moe.py::test_train_step_threads_jitter_rng",
+    "test_moe.py::test_ragged_no_truncation_under_imbalance",
+    "test_elastic.py::test_restart_hits_persistent_compile_cache",
+    "test_rl.py::test_dpo_trainer_shifts_preference",
+    "test_sparse_serving.py::test_server_crash_failover_without_migration",
+]
+
+
+def _collected_ids(extra_args):
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", str(_TESTS), "-q",
+            "--collect-only", "-p", "no:cacheprovider",
+            "--continue-on-collection-errors", *extra_args,
+        ],
+        capture_output=True, text=True, cwd=str(_TESTS.parent),
+        timeout=300,
+    )
+    return {
+        line.strip().split("::", 1)[0].rsplit("/", 1)[-1]
+        + "::" + line.strip().split("::", 1)[1]
+        for line in out.stdout.splitlines()
+        if "::" in line and not line.startswith(" ")
+    }
+
+
+def test_slow_ledger_entries_exist_and_stay_out_of_tier1():
+    everything = _collected_ids([])
+    fast = _collected_ids(["-m", "not slow"])
+    missing = [t for t in _SLOW_LEDGER if t not in everything]
+    assert not missing, (
+        "slow-ledger entries no longer exist (renamed/deleted test? "
+        "update _SLOW_LEDGER):\n" + "\n".join(missing)
+    )
+    leaked = [t for t in _SLOW_LEDGER if t in fast]
+    assert not leaked, (
+        "tier-1 budget regression: these heavyweight tests lost their "
+        "slow mark and collect into the fast tier again:\n"
+        + "\n".join(leaked)
+    )
+
+
 def _imports_serving_e2e(tree) -> bool:
     """Module-level import of the serving SERVER or REPLICA layer —
     both spin background serve threads and jit-compile the decode

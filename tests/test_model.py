@@ -56,6 +56,7 @@ def test_logical_axes_match_params():
                 assert len(a) == p.ndim
 
 
+@pytest.mark.slow  # tier-1 budget: sharded paths pinned fast by HLO tests
 def test_sharded_init_and_step(mesh):
     cfg = get_config("tiny")
     opt = make_optimizer(learning_rate=1e-3, warmup_steps=2, decay_steps=10)

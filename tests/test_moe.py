@@ -186,6 +186,7 @@ def test_ragged_matches_dense_at_high_capacity():
     assert np.isfinite(float(aux["moe_z_loss"]))
 
 
+@pytest.mark.slow  # tier-1 budget: core routing/dispatch moe pins stay fast
 def test_ragged_no_truncation_under_imbalance():
     """All tokens routed to ONE expert: the capacity path drops most of
     them; the ragged path must process every token (the grouped-GEMM
@@ -349,6 +350,7 @@ def test_pipeline_rejects_moe_aux_and_alltoall():
         )
 
 
+@pytest.mark.slow  # tier-1 budget: core routing/dispatch moe pins stay fast
 def test_train_step_threads_jitter_rng(ep_mesh):
     """Two identical steps at different step counts must see different
     jitter noise (the rng is folded with the step counter)."""

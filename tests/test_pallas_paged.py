@@ -370,3 +370,125 @@ def test_write_page_rows_matches_write_rows(mode):
         np.testing.assert_array_equal(
             np.asarray(full[key]), np.asarray(stacked)
         )
+
+
+# ---------------------------------------------------------------------------
+# verify variant — speculative-decoding chunk over in-flight extra keys
+# ---------------------------------------------------------------------------
+
+
+def _verify_inputs(cfg, lens, c, seed=11):
+    """Chunk queries + in-flight K/V rows starting at each slot's last
+    committed position (row 0 = the unwritten last token, exactly the
+    engine's verify layout)."""
+    b = len(lens)
+    start = jnp.asarray(np.asarray(lens) - 1, jnp.int32)
+    positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    dt = jnp.dtype(cfg.dtype)
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(
+        ks[0], (b, c, cfg.n_head, cfg.head_dim)
+    ).astype(dt)
+    ink = jax.random.normal(
+        ks[1], (b, c, cfg.kv_heads, cfg.head_dim)
+    ).astype(dt)
+    inv = jax.random.normal(
+        ks[2], (b, c, cfg.kv_heads, cfg.head_dim)
+    ).astype(dt)
+    return q, ink, inv, positions, start
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+@pytest.mark.parametrize("window", [0, 6])
+def test_reference_verify_matches_dense_bitwise(mode, window):
+    """The in-flight-extras formulation is bitwise the dense per-query
+    attention with the chunk rows sitting IN PLACE in the cache: masked
+    lanes contribute exact zeros, so moving the chunk rows to appended
+    key slots never changes the f32 accumulation order of the nonzero
+    terms."""
+    cfg, geom, _, pools, tables = _setup(
+        mode, cfg=_cfg(attn_window=window)
+    )
+    c = 4
+    q, ink, inv, positions, start = _verify_inputs(cfg, _LENS, c)
+    dense = kvc.gather(pools, tables, geom)
+    for layer in range(cfg.n_layer):
+        ref = pallas_paged.paged_attention_reference(
+            q, _layer(pools, layer), tables, positions,
+            scale=cfg.head_dim ** -0.5, window=window,
+            kv_heads=cfg.kv_heads, variant="verify",
+            extra_k=ink, extra_v=inv,
+        )
+        # dense per-query oracle: chunk rows written in place at their
+        # true indices, identical view for every query
+        ck, cv = dense["k"][layer], dense["v"][layer]
+        upd = jax.vmap(
+            lambda cc, u, p: jax.lax.dynamic_update_slice_in_dim(
+                cc, u, p, axis=0
+            )
+        )
+        ck = upd(ck, ink.astype(ck.dtype), start)
+        cv = upd(cv, inv.astype(cv.dtype), start)
+        b = len(_LENS)
+        ck_q = jnp.broadcast_to(ck[:, None], (b, c) + ck.shape[1:])
+        cv_q = jnp.broadcast_to(cv[:, None], (b, c) + cv.shape[1:])
+        oracle = decoder._verify_cached_attention(
+            q, ck_q, cv_q, positions, cfg
+        ).reshape(b, c, cfg.n_head, cfg.head_dim)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(oracle))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+@pytest.mark.parametrize("window", [0, 6])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_kernel_verify_matches_reference(mode, window, gqa):
+    _skip_unless_interpretable()
+    cfg = _cfg(attn_window=window, n_kv_head=2 if gqa else None)
+    cfg, geom, _, pools, tables = _setup(mode, cfg=cfg)
+    c = 4
+    q, ink, inv, positions, _ = _verify_inputs(cfg, _LENS, c)
+    kw = dict(scale=cfg.head_dim ** -0.5, window=window,
+              kv_heads=cfg.kv_heads, variant="verify",
+              extra_k=ink, extra_v=inv)
+    out_k = pallas_paged.paged_attention(
+        q, _layer(pools, 0), tables, positions, interpret=True, **kw
+    )
+    out_r = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, positions, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_k, np.float32), np.asarray(out_r, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_verify_stale_pool_rows_at_chunk_positions_ignored(mode="bf16"):
+    """Pool cells at positions >= start may hold a previous tenant's
+    rows (pages are not zeroed on free); the verify mask must read the
+    in-flight rows there, never the stale cells."""
+    cfg, geom, _, pools, tables = _setup(mode)
+    c = 4
+    q, ink, inv, positions, start = _verify_inputs(cfg, _LENS, c)
+    out1 = pallas_paged.paged_attention_reference(
+        q, _layer(pools, 0), tables, positions,
+        scale=cfg.head_dim ** -0.5, kv_heads=cfg.kv_heads,
+        variant="verify", extra_k=ink, extra_v=inv,
+    )
+    # poison every pool cell at the chunk positions with garbage
+    garbage = jnp.full(
+        (cfg.n_layer, len(_LENS), c, cfg.kv_heads, cfg.head_dim), 37.0,
+        jnp.dtype(cfg.dtype),
+    )
+    valid = jnp.ones((len(_LENS), c), bool)
+    pois = kvc.write_rows(
+        pools, tables,
+        jnp.asarray(np.asarray(_LENS))[:, None] - 1
+        + jnp.arange(c, dtype=jnp.int32)[None, :],
+        valid, garbage, garbage, geom,
+    )
+    out2 = pallas_paged.paged_attention_reference(
+        q, _layer(pois, 0), tables, positions,
+        scale=cfg.head_dim ** -0.5, kv_heads=cfg.kv_heads,
+        variant="verify", extra_k=ink, extra_v=inv,
+    )
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))

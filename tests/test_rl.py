@@ -540,6 +540,7 @@ def test_dpo_loss_prefers_chosen():
     assert float(stats["reward_margin"]) > 0
 
 
+@pytest.mark.slow  # tier-1 budget: engine/logit pins keep fast rl coverage
 def test_dpo_trainer_shifts_preference():
     """Offline preference pairs: chosen responses are TARGET tokens,
     rejected are OTHER. After DPO steps the actor must assign TARGET a

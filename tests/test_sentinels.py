@@ -318,6 +318,7 @@ def _data_iter(seed=0):
         }
 
 
+@pytest.mark.slow  # tier-1 budget: HLO transfer audit; detection pins stay fast
 def test_sentinels_add_no_device_to_host_transfers(
     tmp_path, monkeypatch
 ):

@@ -135,7 +135,9 @@ def _decode_hlo(eng, max_pages):
     return eng._decode_fn.lower(
         eng.params, eng.pools, tables,
         jnp.zeros(b, jnp.int32), jnp.zeros(b, jnp.int32),
-        jnp.zeros(b, bool), max_pages,
+        jnp.zeros(b, bool), jnp.zeros((b, 2), jnp.uint32),
+        jnp.zeros(b, jnp.float32), jnp.zeros(b, jnp.int32),
+        jnp.ones(b, jnp.float32), max_pages,
     ).as_text()
 
 

@@ -1,0 +1,267 @@
+"""Speculative decoding pins (serving/engine.py spec path).
+
+The tentpole guarantee: with ``spec_k > 0`` the engine's OUTPUT STREAM
+is bitwise the spec-off stream — greedy spec-on equals offline
+``generate.greedy`` on both kernel paths, and int8 spec-on equals int8
+spec-off (commit-timing independence: the verify step shows a query its
+own chunk row raw and earlier rows as-committed, exactly like the
+sequential loop). Acceptance only moves throughput, never the math:
+an oracle draft accepts everything, an always-wrong draft accepts
+nothing, and in both cases the emitted tokens are identical. Rejected
+draft rows NEVER reach the KV pools — pool cells beyond the committed
+length stay byte-identical across a verify step.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models import decoder, generate  # noqa: E402
+from dlrover_tpu.models.config import get_config  # noqa: E402
+from dlrover_tpu.serving.engine import (  # noqa: E402
+    DraftModel,
+    PromptLookupDraft,
+    ServingEngine,
+)
+from dlrover_tpu.serving.scheduler import Scheduler  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+        vocab_size=32, max_seq=64,
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    # repetitive prompts: prompt-lookup finds trailing n-grams, so the
+    # accept-rate is non-trivially exercised (not just all-reject)
+    prompts = [
+        [1, 2, 3, 1, 2, 3, 1],
+        [5, 6, 5, 6, 5, 6, 5, 6, 5],
+        [7, 8, 9, 7, 8],
+    ]
+    max_new = [8, 6, 7]
+    refs = [
+        [
+            int(t)
+            for t in np.asarray(
+                generate.greedy(
+                    params, cfg, jnp.asarray([p], jnp.int32), m
+                )[0]
+            )
+        ]
+        for p, m in zip(prompts, max_new)
+    ]
+    return cfg, params, prompts, max_new, refs
+
+
+def _serve_all(cfg, params, prompts, max_new, mode, paged, spec_k,
+               draft=None):
+    sched = Scheduler(replica="spec")
+    eng = ServingEngine(
+        params, cfg, sched, n_slots=2, max_len=32, page_size=4,
+        mode=mode, prefill_chunk=4, paged=paged, spec_k=spec_k,
+        draft=draft,
+    )
+    reqs = [sched.submit(p, m) for p, m in zip(prompts, max_new)]
+    eng.drain(timeout=600)
+    outs = [r.future.result(timeout=5) for r in reqs]
+    return eng, outs
+
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_greedy_spec_on_bitwise_equal_greedy(setup, paged):
+    """Spec-on greedy == offline per-request greedy, bitwise, with
+    mixed-length concurrent requests on both kernel paths."""
+    cfg, params, prompts, max_new, refs = setup
+    eng, outs = _serve_all(
+        cfg, params, prompts, max_new, "bf16", paged, spec_k=3
+    )
+    assert outs == refs
+    st = eng.stats()
+    # drafting actually happened (repetitive prompts guarantee
+    # proposals) and the bookkeeping is coherent
+    assert st["spec_k"] == 3 and st["draft_tokens"] > 0
+    assert 0 <= st["accepted_tokens"] <= st["draft_tokens"]
+    assert st["tokens_generated"] == sum(max_new)
+    # drained clean: no slot leaks pages regardless of accept pattern
+    assert eng.active_slots() == 0
+    assert eng.alloc.free_pages == eng.geom.n_pages - 1
+
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_int8_spec_on_equals_spec_off(setup, paged):
+    """Quantized mode: spec-on must still equal spec-off BITWISE —
+    the verify step reproduces the sequential loop's commit timing
+    (earlier chunk rows seen post-codec, own row raw)."""
+    cfg, params, prompts, max_new, _ = setup
+    _, off = _serve_all(
+        cfg, params, prompts, max_new, "int8", paged, spec_k=0
+    )
+    _, on = _serve_all(
+        cfg, params, prompts, max_new, "int8", paged, spec_k=3
+    )
+    assert on == off
+
+
+class _OracleDraft(DraftModel):
+    """Proposes the true greedy continuation (looked up from the
+    reference sequences) — every draft token must be accepted."""
+
+    def __init__(self, refs):
+        self.refs = [list(r) for r in refs]
+
+    def propose(self, history, k):
+        hist = [int(t) for t in history]
+        for ref in self.refs:
+            if ref[: len(hist)] == hist:
+                return ref[len(hist): len(hist) + k]
+        return []
+
+
+class _WrongDraft(DraftModel):
+    """Proposes a constant token chosen OUTSIDE the reference
+    continuations — every draft token must be rejected."""
+
+    def __init__(self, token):
+        self.token = int(token)
+
+    def propose(self, history, k):
+        return [self.token] * k
+
+
+def _unused_token(refs, prompts, vocab):
+    used = {t for r in refs for t in r}
+    for t in range(vocab - 1, 0, -1):
+        if t not in used:
+            return t
+    raise AssertionError("tiny vocab saturated; enlarge it")
+
+
+def test_oracle_draft_accepts_everything(setup):
+    cfg, params, prompts, max_new, refs = setup
+    eng, outs = _serve_all(
+        cfg, params, prompts, max_new, "bf16", True, spec_k=3,
+        draft=_OracleDraft(refs),
+    )
+    assert outs == refs
+    st = eng.stats()
+    assert st["draft_tokens"] > 0
+    assert st["accepted_tokens"] == st["draft_tokens"]
+    assert st["spec_accept_rate"] == 1.0
+
+
+def test_wrong_draft_rejects_everything_same_output(setup):
+    cfg, params, prompts, max_new, refs = setup
+    bad = _unused_token(refs, prompts, cfg.vocab_size)
+    eng, outs = _serve_all(
+        cfg, params, prompts, max_new, "bf16", True, spec_k=3,
+        draft=_WrongDraft(bad),
+    )
+    assert outs == refs  # guaranteed >= 1 token of progress per step
+    st = eng.stats()
+    assert st["draft_tokens"] > 0 and st["accepted_tokens"] == 0
+    assert st["spec_accept_rate"] == 0.0
+
+
+def test_rejected_draft_rows_never_reach_pools(setup):
+    """The deferred-write invariant, observed directly: across a verify
+    step with all drafts rejected, every pool cell of the slot BEYOND
+    the newly committed row is byte-identical to before the step, and
+    the slot's page reservation never grows."""
+    cfg, params, prompts, max_new, refs = setup
+    prompt, m, ref = prompts[0], max_new[0], refs[0]
+    bad = _unused_token([ref], [prompt], cfg.vocab_size)
+    sched = Scheduler(replica="spec-inv")
+    eng = ServingEngine(
+        params, cfg, sched, n_slots=1, max_len=32, page_size=4,
+        mode="bf16", prefill_chunk=4, paged=True, spec_k=3,
+        draft=_WrongDraft(bad),
+    )
+    r = sched.submit(prompt, m)
+    # admit + prefill, then stop at the first decode boundary
+    while eng.slots[0] is None or eng.slots[0].phase != "decode":
+        assert eng.step()
+    ps = eng.geom.page_size
+    total = len(prompt) + m
+    pages0 = eng.alloc.slot_pages(0)
+
+    def cell(pools, pos):
+        table = eng.alloc.block_tables()[0]
+        return {
+            n: np.asarray(a[:, table[pos // ps], pos % ps])
+            for n, a in pools.items()
+        }
+
+    while eng.slots[0] is not None:
+        n_before = len(eng.slots[0].generated)
+        if n_before >= m:
+            eng.step()  # final eviction only, no token progress
+            break
+        frontier = len(prompt) + n_before  # first not-yet-written row
+        pre = [cell(eng.pools, p) for p in range(frontier, total)]
+        assert eng.step()
+        s = eng.slots[0]
+        n_after = len(s.generated) if s is not None else m
+        # all-wrong drafts: exactly one token of progress per step,
+        # so rows past the single committed one were verify scratch
+        assert n_after == n_before + 1
+        assert eng.alloc.slot_pages(0) == pages0
+        post = [cell(eng.pools, p) for p in range(frontier, total)]
+        for pos, (a, b) in enumerate(zip(pre[1:], post[1:])):
+            for name in a:
+                np.testing.assert_array_equal(
+                    a[name], b[name],
+                    err_msg=f"rejected draft leaked into pool row "
+                            f"{frontier + 1 + pos} ({name})",
+                )
+    assert r.future.result(timeout=5) == ref
+
+
+def test_prompt_lookup_draft_unit():
+    d = PromptLookupDraft(max_ngram=3, min_ngram=1)
+    # trailing [1,2,3] recurs earlier; propose what followed it
+    assert d.propose([1, 2, 3, 9, 8, 1, 2, 3], 2) == [9, 8]
+    # longest n-gram wins over shorter, more recent matches
+    assert d.propose([5, 1, 2, 3, 7, 2, 3, 1, 2, 3], 1) == [7]
+    # most recent earlier occurrence preferred within one n
+    assert d.propose([4, 6, 4, 5, 4], 1) == [5]
+    # no recurrence → no proposal; k caps the continuation
+    assert d.propose([1, 2, 3, 4, 5], 3) == []
+    assert d.propose([1, 2, 1, 2, 1], 8) == [2, 1]
+    assert d.propose([1, 2, 3], 0) == []
+    assert d.propose([], 4) == []
+    with pytest.raises(ValueError):
+        PromptLookupDraft(max_ngram=0)
+
+
+def test_spec_counters_flow_to_serving_record(setup):
+    cfg, params, prompts, max_new, _ = setup
+    eng, _ = _serve_all(
+        cfg, params, prompts, max_new, "bf16", True, spec_k=3
+    )
+    sched = Scheduler(replica="spec-rec")
+    rec = sched.publish(eng.stats())
+    assert rec.draft_tokens == eng.stats()["draft_tokens"] > 0
+    assert rec.accepted_tokens == eng.stats()["accepted_tokens"]
+    assert rec.spec_accept_rate == pytest.approx(
+        eng.stats()["spec_accept_rate"]
+    )
+
+
+def test_spec_with_max_new_one_falls_back_to_decode(setup):
+    """k_eff = min(spec_k, remaining - 1): a 1-token request never
+    drafts (nothing to speculate past the last token) and still matches
+    the reference."""
+    cfg, params, prompts, _, _ = setup
+    p = prompts[0]
+    ref = [
+        int(t) for t in np.asarray(
+            generate.greedy(params, cfg, jnp.asarray([p], jnp.int32), 1)[0]
+        )
+    ]
+    eng, outs = _serve_all(cfg, params, [p], [1], "bf16", True, spec_k=3)
+    assert outs == [ref]
+    assert eng.stats()["draft_tokens"] == 0

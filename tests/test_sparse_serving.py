@@ -154,6 +154,7 @@ def test_deepfm_trains_and_survives_rebalance(two_servers):
     model.dense_params = None  # model.close() would close demb twice
 
 
+@pytest.mark.slow  # tier-1 budget: crash drills live on the slow tier
 def test_server_crash_failover_without_migration(two_servers):
     """Unplanned PS death: the dead server cannot export, so workers
     adopt the survivor ring with migrate=False — lookups keep working,

@@ -66,6 +66,34 @@ def test_from_json_rejects_unknown_record():
         telemetry.from_json('{"r": "NoSuchRecord", "d": {}}')
 
 
+def test_old_serving_recordings_replay_with_defaults():
+    """Recordings taken BEFORE the speculative-decoding fields existed
+    must still replay: ``from_json`` fills absent fields from dataclass
+    defaults, so healthcheck replay of an old JSONL never KeyErrors."""
+    old_line = json.dumps({
+        "r": "ServingRecord",
+        "d": {
+            "replica": "replica-0", "active_slots": 2, "queue_depth": 1,
+            "admitted": 9, "completed": 7, "re_admitted": 0,
+            "tokens_per_s": 123.5, "p50_ms": 10.0, "p99_ms": 40.0,
+            "ts": 1700000000.0,
+        },
+    })
+    rec = telemetry.from_json(old_line)
+    assert isinstance(rec, telemetry.ServingRecord)
+    assert rec.completed == 7 and rec.tokens_per_s == 123.5
+    # spec fields default cleanly
+    assert rec.draft_tokens == 0
+    assert rec.accepted_tokens == 0
+    assert rec.spec_accept_rate == 0.0
+    # and a new-style line round-trips the spec fields losslessly
+    new = telemetry.ServingRecord(
+        replica="r", draft_tokens=12, accepted_tokens=8,
+        spec_accept_rate=8 / 12,
+    )
+    assert telemetry.from_json(new.to_json()) == new
+
+
 # ---------------------------------------------------------------------------
 # zero-cost when off (tier-1 overhead guard)
 # ---------------------------------------------------------------------------

@@ -157,6 +157,7 @@ def test_trainer_data_exhaustion_stops_cleanly(tmp_path):
     assert int(state["step"]) == 4
 
 
+@pytest.mark.slow  # tier-1 budget: resume/elastic covered fast elsewhere
 def test_elastic_remesh_resume(tmp_path, monkeypatch):
     """The elastic hard path (SURVEY §7): train on one mesh, lose the
     cluster, restore the SAME checkpoint onto a DIFFERENT mesh (new
@@ -202,6 +203,7 @@ def test_elastic_remesh_resume(tmp_path, monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 budget: resume/elastic covered fast elsewhere
 def test_prefetch_to_device_preserves_stream(tmp_path):
     """Prefetched batches arrive in order, device-placed, value-equal;
     a prefetching Trainer computes the SAME losses as a direct one

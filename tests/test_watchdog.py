@@ -455,6 +455,7 @@ def _drill_pieces(monkeypatch, tmp_path, node_id=1):
     return cfg, mesh, poison_loss, data
 
 
+@pytest.mark.slow  # tier-1 budget: e2e drill; unit NaN paths stay fast
 def test_nan_drill_end_to_end(monkeypatch, tmp_path):
     """The acceptance drill: one rank hits NaN grads at step 4 → the
     sentinel trips in-graph, the watchdog classifies an AnomalyRecord
