@@ -685,13 +685,125 @@ def serving_trajectory_metric(path=None):
         out["spec_tokens_per_s"] = spec.get("tokens_per_s")
         out["spec_accept_rate"] = spec.get("accept_rate")
         out["spec_speedup_vs_specoff"] = spec.get("speedup_vs_specoff")
+    if artifact.get("migration_recovery_s") is not None:
+        # serving-tier fault-tolerance headline: kill → first
+        # post-migration token, plus the compute migrating saved over
+        # the re-prefill failover it replaced
+        out["migration_recovery_s"] = artifact["migration_recovery_s"]
+        migr = artifact.get("migration") or {}
+        out["migration_path"] = migr.get("path")
+        out["migration_tokens_saved"] = migr.get(
+            "tokens_saved_vs_reprefill"
+        )
     return out
+
+
+def _measure_migration(params, cfg, *, n_slots, max_len, page_size,
+                       mode, prefill_chunk, seed):
+    """Serving-tier recovery number: kill 1 of 2 replicas mid-decode
+    and time from the kill to the FIRST post-migration token on the
+    survivor (the serving analogue of the training drill's
+    ``recovery_s``). Rides on the live KV-page migration path
+    (serving/migration.py); ``tokens_saved_vs_reprefill`` is the
+    prefill+decode compute the migration did NOT redo — the token
+    savings of migrating over the old re-prefill failover. Returns
+    None when the workload finished before a mid-stream kill landed."""
+    import numpy as np
+
+    from dlrover_tpu.serving.migration import ServingMigrator
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    kw = dict(
+        n_slots=n_slots, max_len=max_len, page_size=page_size, mode=mode,
+        prefill_chunk=prefill_chunk, idle_sleep=0.001,
+    )
+    max_new = max(8, min(16, max_len // 4))
+    rng = np.random.default_rng(seed)
+    alpha = min(9, cfg.vocab_size)
+    prompts = [
+        list(rng.integers(1, alpha, int(rng.integers(3, 10))))
+        for _ in range(4)
+    ]
+    r0 = ServingReplica("bench-m0", params, cfg, node_id=0, **kw)
+    r1 = ServingReplica("bench-m1", params, cfg, node_id=1, **kw)
+    r0.start()
+    r1.start()
+    try:
+        router = ReplicaRouter([r0, r1], migrator=ServingMigrator())
+
+        def mid(rep, want):
+            slots = [s for s in rep.server.engine.slots if s is not None]
+            return len(slots) == want and all(
+                s.phase == "decode" and s.generated
+                and not s.req.future.done()
+                for s in slots
+            )
+
+        # Park the victim's loop from the start and step its engine by
+        # hand to a pinned mid-decode state — the warm decode rate is
+        # far too fast to catch a mid-stream window by wall clock.
+        t_kill = None
+        gen_at_kill = {}
+        with r1.server.paused() as eng:
+            reqs = [router.submit(p, max_new) for p in prompts]
+            # the survivor's own half finishes first (warming its jit)
+            # so the recovery window times migration, not compilation
+            for r in (reqs[0], reqs[2]):
+                r.future.result(timeout=300)
+            for _ in range(50):
+                if mid(r1, 2):
+                    break
+                eng.step()
+            if mid(r1, 2):
+                gen_at_kill = {
+                    s.req.rid: len(s.generated)
+                    for s in eng.slots if s is not None
+                }
+                t_kill = time.perf_counter()
+                r1.kill()
+        if t_kill is None:
+            return None
+        deadline = time.monotonic() + 300
+        router.poll()
+        report = router.reports[-1]
+        t_first = None
+        while t_first is None and time.monotonic() < deadline:
+            for s in list(r0.server.engine.slots):
+                if (
+                    s is not None
+                    and s.req.rid in gen_at_kill
+                    and len(s.generated) > gen_at_kill[s.req.rid]
+                ):
+                    t_first = time.perf_counter()
+                    break
+            else:
+                if any(
+                    r.future.done() for r in reqs if r.rid in gen_at_kill
+                ):
+                    t_first = time.perf_counter()
+                else:
+                    time.sleep(0.0005)
+        router.wait_all(timeout=600)
+        return {
+            "migration_recovery_s": (
+                round(t_first - t_kill, 4) if t_first else None
+            ),
+            "path": report.path,
+            "migrated": len(report.placements),
+            "re_prefilled": len(report.re_prefilled),
+            "bytes_moved": report.bytes_moved,
+            "tokens_saved_vs_reprefill": report.tokens_saved,
+        }
+    finally:
+        r0.stop()
+        r1.kill()
 
 
 def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
               max_len=64, page_size=8, prefill_chunk=8, max_new=8,
               p99_target_ms=60000.0, seed=0, paged=True,
-              compare_gather=True, spec_k=3, compare_spec=True):
+              compare_gather=True, spec_k=3, compare_spec=True,
+              measure_migration=True):
     """Serving throughput: tokens/sec at a fixed p99 latency target.
 
     Drives the continuous-batching engine (dlrover_tpu/serving/) with
@@ -885,6 +997,16 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
                 if tokens_per_s > 0 else None
             ),
         }
+    if measure_migration:
+        migr = _measure_migration(
+            params, cfg, n_slots=n_slots, max_len=max_len,
+            page_size=page_size, mode=mode, prefill_chunk=prefill_chunk,
+            seed=seed,
+        )
+        record["migration"] = migr
+        record["migration_recovery_s"] = (
+            migr.get("migration_recovery_s") if migr else None
+        )
     return record
 
 

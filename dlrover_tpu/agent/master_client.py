@@ -199,6 +199,30 @@ class MasterClient:
         )
         return resp if resp else msgs.ReshardPlanResponse()
 
+    def report_serving_eviction(
+        self,
+        replica: str,
+        in_flight: int = 0,
+        deadline_s: float = 10.0,
+        reason: str = "",
+    ) -> bool:
+        """Announce a departing serving replica; the master answers
+        future ``get_serving_reshard`` polls with a page-migration
+        directive."""
+        return self._t.report(
+            msgs.ServingEvictionNotice(
+                node_id=self.node_id,
+                replica=replica,
+                in_flight=int(in_flight),
+                deadline_s=deadline_s,
+                reason=reason,
+            )
+        )
+
+    def get_serving_reshard(self) -> msgs.ServingReshardDirective:
+        resp = self._t.get(msgs.ServingReshardRequest(node_id=self.node_id))
+        return resp if resp else msgs.ServingReshardDirective()
+
     def report_network_check_result(
         self, elapsed_time: float, succeeded: bool
     ) -> bool:

@@ -321,6 +321,39 @@ class ReshardPlanResponse:
     reason: str = ""
 
 
+@message
+class ServingEvictionNotice:
+    """Serving variant of :class:`EvictionNotice`: a replica (or the
+    router observing its death) announces a serving replica leaving —
+    planned drain or detected eviction — with its in-flight request
+    count, so the master can issue a page-migration directive."""
+
+    node_id: int = 0
+    replica: str = ""
+    in_flight: int = 0
+    deadline_s: float = 10.0     # page-transfer grace window
+    reason: str = ""
+
+
+@message
+class ServingReshardRequest:
+    node_id: int = 0
+
+
+@message
+class ServingReshardDirective:
+    """The master's serving-reshard directive (versioned like
+    :class:`ReshardPlanResponse`; 0 = none pending): migrate the
+    victim's held KV pages onto ``survivors`` within ``deadline_s``,
+    degrading to re-prefill past the deadline."""
+
+    version: int = 0
+    victim: str = ""
+    survivors: List[str] = field(default_factory=list)
+    deadline_s: float = 10.0
+    reason: str = ""
+
+
 # ---------------------------------------------------------------------------
 # Data sharding (reference: task_manager.py + sharding/client.py)
 # ---------------------------------------------------------------------------

@@ -9,14 +9,23 @@ evict / slow-peer / torn-donation behaviour without monkeypatching.
 
 Kinds:
 
-- ``kill``           raise :class:`InjectedKill` at the point (hard stop)
-- ``evict``          mark a rank as evicted; ``evicted_ranks()`` feeds the
-                     reshard plan — no exception raised
-- ``slow_peer``      sleep ``delay_s`` at the point (deadline-budget tests)
-- ``torn_donation``  raise :class:`TornDonation` (partial shard transfer)
+- ``kill``             raise :class:`InjectedKill` at the point (hard stop)
+- ``evict``            mark a rank as evicted; ``evicted_ranks()`` feeds the
+                       reshard plan — no exception raised
+- ``slow_peer``        sleep ``delay_s`` at the point (deadline-budget tests)
+- ``torn_donation``    raise :class:`TornDonation` (partial shard transfer)
+- ``drop_page``        raise :class:`DroppedPage` (a KV page frame lost
+                       mid-migration; TornDonation subclass, so the
+                       serving migrator's retry/fallback ladder covers it)
+- ``stall_migration``  sleep ``delay_s`` inside a serving-migration phase
+                       (drives the phase machine over its budget)
 
-``times`` bounds how often a spec fires (-1 = unlimited), so a transient
-fault (fires once, then the retry succeeds) is ``times=1``.
+Serving injection points are namespaced ``serving.<phase>`` (detect /
+plan / reserve / transfer / resume) with ``rank`` = replica index, so
+``kill`` composes at replica scope too. ``times`` bounds how often a
+spec fires (-1 = unlimited), so a transient fault (fires once, then the
+retry succeeds) is ``times=1``. The full ``DLROVER_TPU_FAULTS`` grammar
+is documented in docs/fault_drills.md.
 """
 
 import threading
@@ -28,11 +37,22 @@ from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
 
-KINDS = ("kill", "evict", "slow_peer", "torn_donation")
+KINDS = (
+    "kill",
+    "evict",
+    "slow_peer",
+    "torn_donation",
+    "drop_page",
+    "stall_migration",
+)
 
 
 class TornDonation(RuntimeError):
     """A shard donation was interrupted mid-transfer."""
+
+
+class DroppedPage(TornDonation):
+    """A KV page frame was lost during a serving migration transfer."""
 
 
 class InjectedKill(RuntimeError):
@@ -107,8 +127,10 @@ class FaultInjector:
             logger.warning(
                 "fault injected: %s at %s (rank=%d)", s.kind, point, rank
             )
-            if s.kind == "slow_peer":
+            if s.kind in ("slow_peer", "stall_migration"):
                 time.sleep(s.delay_s)
+            elif s.kind == "drop_page":
+                raise DroppedPage(f"page dropped at {point} (rank={rank})")
             elif s.kind == "torn_donation":
                 raise TornDonation(f"torn donation at {point} (rank={rank})")
             elif s.kind == "kill":
@@ -119,6 +141,11 @@ def parse_faults(text: str) -> List[FaultSpec]:
     """Parse ``"kind:key=val:key=val;kind2:..."`` into specs.
 
     Example: ``"torn_donation:point=donation:times=1;slow_peer:delay_s=2"``.
+
+    Strict: any malformed clause — unknown kind, a ``key=value`` pair
+    with no ``=``, an unknown key, or an unparseable value — raises
+    ``ValueError`` naming the clause. A fault drill with a typo'd spec
+    must fail loudly at startup, not silently run without the fault.
     """
     specs: List[FaultSpec] = []
     for chunk in text.split(";"):
@@ -126,15 +153,42 @@ def parse_faults(text: str) -> List[FaultSpec]:
         if not chunk:
             continue
         parts = chunk.split(":")
+        if parts[0] not in KINDS:
+            raise ValueError(
+                f"malformed fault clause {chunk!r}: unknown kind "
+                f"{parts[0]!r}; one of {KINDS}"
+            )
         kw: Dict[str, object] = {}
         for part in parts[1:]:
-            k, _, v = part.partition("=")
+            k, sep, v = part.partition("=")
+            if not sep or not k:
+                raise ValueError(
+                    f"malformed fault clause {chunk!r}: expected key=value, "
+                    f"got {part!r}"
+                )
             if k in ("rank", "times"):
-                kw[k] = int(v)
+                try:
+                    kw[k] = int(v)
+                except ValueError:
+                    raise ValueError(
+                        f"malformed fault clause {chunk!r}: {k} must be an "
+                        f"integer, got {v!r}"
+                    ) from None
             elif k == "delay_s":
-                kw[k] = float(v)
-            else:
+                try:
+                    kw[k] = float(v)
+                except ValueError:
+                    raise ValueError(
+                        f"malformed fault clause {chunk!r}: delay_s must be "
+                        f"a float, got {v!r}"
+                    ) from None
+            elif k == "point":
                 kw[k] = v
+            else:
+                raise ValueError(
+                    f"malformed fault clause {chunk!r}: unknown key {k!r}; "
+                    f"one of ('point', 'rank', 'delay_s', 'times')"
+                )
         specs.append(FaultSpec(parts[0], **kw))
     return specs
 

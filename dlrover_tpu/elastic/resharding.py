@@ -232,7 +232,13 @@ def reshard_train_state(
 
 @dataclass
 class PhaseBudgets:
-    """Per-phase deadline budgets (seconds) for the failover state machine."""
+    """Per-phase deadline budgets (seconds) for the failover state machine.
+
+    The training ladder runs detect/replan/migrate/rebuild/first_step;
+    the serving KV-page migrator (serving/migration.py) reuses this
+    machine with detect/plan/reserve/transfer/resume. Unknown phase
+    names fall back to 60 s, so the two ladders share one budget type.
+    """
 
     detect_s: float = 15.0
     replan_s: float = 15.0
@@ -240,6 +246,11 @@ class PhaseBudgets:
     rebuild_s: float = 120.0
     first_step_s: float = 120.0
     fallback_s: float = 300.0
+    # serving-migration phases
+    plan_s: float = 15.0
+    reserve_s: float = 20.0
+    transfer_s: float = 60.0
+    resume_s: float = 60.0
 
     def for_phase(self, name: str) -> float:
         return float(getattr(self, f"{name}_s", 60.0))

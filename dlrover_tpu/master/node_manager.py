@@ -104,6 +104,11 @@ class JobManager:
         # populate with master.event_callback.NodeEventCallback objects
         self.event_callbacks: List[Any] = []
         self.cluster_context: Any = None  # set by the master (ClusterContext)
+        # serving-tier reshard directive (serving/migration.py): the
+        # KV-page analogue of RendezvousManager._reshard — versioned,
+        # monotonic, one pending directive at a time
+        self._serving_reshard_version = 0
+        self._serving_reshard: Optional[Dict] = None
         self._init_nodes()
 
     def _init_nodes(self):
@@ -330,6 +335,60 @@ class JobManager:
         flow through the same machinery — but live outside the train
         rendezvous, so job completion never waits on them."""
         return self.nodes_of_type(NodeType.SERVING)
+
+    # ---- serving reshard (KV-page migration directives) ------------------
+
+    def plan_serving_reshard(
+        self,
+        victim: str,
+        survivors: Optional[List[str]] = None,
+        deadline_s: float = 10.0,
+        reason: str = "",
+    ) -> int:
+        """Issue a serving-reshard directive: migrate the victim
+        replica's held KV pages onto the survivors within the deadline
+        (degrading to re-prefill past it). ``survivors`` defaults to
+        every other running serving replica. Returns the directive
+        version (monotonic, starts at 1)."""
+        from dlrover_tpu.observability.tracing import get_tracer
+
+        if survivors is None:
+            survivors = [
+                n.name
+                for n in self.serving_nodes()
+                if n.name and n.name != victim and not n.is_exited()
+            ]
+        with self._lock:
+            self._serving_reshard_version += 1
+            self._serving_reshard = {
+                "version": self._serving_reshard_version,
+                "victim": victim,
+                "survivors": sorted(survivors),
+                "deadline_s": float(deadline_s),
+                "reason": reason,
+            }
+            version = self._serving_reshard_version
+        get_tracer().instant(
+            "failover.serving_reshard_plan",
+            version=version,
+            victim=victim,
+            survivors=len(survivors),
+        )
+        logger.info(
+            "serving reshard directive v%d: victim=%s survivors=%s (%s)",
+            version,
+            victim,
+            sorted(survivors),
+            reason or "eviction",
+        )
+        return version
+
+    def get_serving_reshard(self) -> Dict:
+        """The pending serving directive, or ``{"version": 0}``."""
+        with self._lock:
+            if self._serving_reshard is None:
+                return {"version": 0}
+            return dict(self._serving_reshard)
 
     def all_workers_exited(self) -> bool:
         with self._lock:

@@ -262,6 +262,28 @@ class MasterServicer:
             )
         return True
 
+    def _report_serving_eviction(self, m: msgs.ServingEvictionNotice) -> bool:
+        """A serving replica is leaving (planned drain or detected
+        eviction): issue the page-migration directive so survivors adopt
+        its in-flight requests' live KV pages instead of re-prefilling."""
+        if self.job_manager is None:
+            return False
+        version = self.job_manager.plan_serving_reshard(
+            m.replica, deadline_s=m.deadline_s, reason=m.reason
+        )
+        if self.telemetry_hub is not None and self.telemetry_hub.enabled:
+            self.telemetry_hub.publish(
+                telemetry.ElasticEvent(
+                    kind="serving_eviction_notice",
+                    node_id=m.node_id,
+                    detail=(
+                        f"v{version} victim={m.replica} "
+                        f"in_flight={m.in_flight} {m.reason}"
+                    ).strip(),
+                )
+            )
+        return True
+
     def _report_kv(self, m: msgs.KeyValuePair) -> bool:
         if self.kv_store:
             self.kv_store.set(m.key, m.value)
@@ -323,6 +345,7 @@ class MasterServicer:
         "GlobalStepRecord": _report_global_step,
         "NetworkCheckResult": _report_network_check,
         "EvictionNotice": _report_eviction,
+        "ServingEvictionNotice": _report_serving_eviction,
         "KeyValuePair": _report_kv,
         "SyncJoin": _report_sync_join,
         "CheckpointStepSync": _report_ckpt_step,
@@ -395,6 +418,20 @@ class MasterServicer:
             dp_old=plan["dp_old"],
             dp_new=plan["dp_new"],
             lost_ranks=list(plan["lost_ranks"]),
+            deadline_s=plan["deadline_s"],
+            reason=plan["reason"],
+        )
+
+    def _get_serving_reshard(self, m: msgs.ServingReshardRequest):
+        if self.job_manager is None:
+            return msgs.ServingReshardDirective()
+        plan = self.job_manager.get_serving_reshard()
+        if not plan.get("version"):
+            return msgs.ServingReshardDirective()
+        return msgs.ServingReshardDirective(
+            version=plan["version"],
+            victim=plan["victim"],
+            survivors=list(plan["survivors"]),
             deadline_s=plan["deadline_s"],
             reason=plan["reason"],
         )
@@ -511,6 +548,7 @@ class MasterServicer:
         "CommWorldRequest": _get_comm_world,
         "NetworkCheckStatusRequest": _get_network_status,
         "ReshardPlanRequest": _get_reshard_plan,
+        "ServingReshardRequest": _get_serving_reshard,
         "NumNodesWaitingRequest": _get_num_nodes_waiting,
         "TaskRequest": _get_task,
         "ShardCheckpointRequest": _get_shard_ckpt,

@@ -258,7 +258,13 @@ class ServingRecord:
     accepted by the verify step; ``spec_accept_rate`` is their ratio
     (0 with speculation off). Recordings from builds that predate
     these fields replay fine — ``from_json`` fills missing fields from
-    the dataclass defaults."""
+    the dataclass defaults.
+
+    Migration robustness (serving/migration.py): ``migrated_in`` /
+    ``migrated_out`` are lifetime counts of requests this engine
+    imported/exported as live KV pages; ``shed`` counts queued new
+    admissions failed with a retry-after hint to protect a migration
+    under page pressure."""
 
     replica: str = ""
     active_slots: int = 0
@@ -272,6 +278,9 @@ class ServingRecord:
     draft_tokens: int = 0
     accepted_tokens: int = 0
     spec_accept_rate: float = 0.0
+    shed: int = 0
+    migrated_in: int = 0
+    migrated_out: int = 0
     ts: float = 0.0
 
 
@@ -331,6 +340,9 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("serving_draft_tokens", "draft_tokens"),
         ("serving_accepted_tokens", "accepted_tokens"),
         ("serving_spec_accept_rate", "spec_accept_rate"),
+        ("serving_shed", "shed"),
+        ("serving_migrated_in", "migrated_in"),
+        ("serving_migrated_out", "migrated_out"),
     ],
 }
 _COUNTER_MAP: Dict[str, str] = {

@@ -10,9 +10,15 @@ Layers (bottom up):
 - ``scheduler``  — threaded request queue: priority by arrival,
   admission control, p50/p99 latency accounting → ``ServingRecord``.
 - ``server``     — the threaded frontend owning the engine loop.
+- ``migration``  — live KV-page migration: a drained/evicted replica's
+  held pages (int8 payloads + scales, block-table order, position and
+  sampling state) transfer to survivors that reserved the footprint;
+  decode resumes mid-stream bitwise, degrading to re-prefill on torn
+  or over-deadline transfers.
 - ``replica``    — elastic integration: replicas register with the
-  master like trainer nodes; a router re-admits an evicted replica's
-  in-flight requests on survivors.
+  master like trainer nodes; a router migrates an evicted replica's
+  in-flight requests to survivors (re-admitting when migration is
+  unavailable).
 
 Import submodules directly (``from dlrover_tpu.serving import engine``)
 — this package init stays import-light so allocator/scheduler unit

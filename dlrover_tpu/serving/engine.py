@@ -66,7 +66,7 @@ rows. ``__init__`` enforces it.
 
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -179,6 +179,7 @@ class ServingEngine:
         self.alloc = kvc.PageAllocator(self.geom, n_slots)
         self.pools = kvc.init_pools(self.geom)
         self.slots: List[Optional[_Slot]] = [None] * n_slots
+        self.draining = False     # planned drain: stop admitting new work
         self._tokens = 0
         self._t0: Optional[float] = None
         self._tables_dev = None   # cached device block tables
@@ -186,6 +187,9 @@ class ServingEngine:
         self._step_time = 0.0     # wall seconds inside jitted steps
         self._draft_tokens = 0    # drafts proposed to the verify step
         self._accepted_tokens = 0  # drafts that survived acceptance
+        self._prefill_tokens = 0  # prompt tokens run through the chunk fn
+        self._migrated_in = 0     # requests adopted as live KV pages
+        self._migrated_out = 0    # requests donated as live KV pages
 
         geom = self.geom
         chunk_w = prefill_chunk
@@ -445,6 +449,11 @@ class ServingEngine:
                 self._accepted_tokens / self._draft_tokens
                 if self._draft_tokens else 0.0
             ),
+            # migration accounting: the drill's zero-re-prefill assertion
+            # reads prefill_tokens before/after a failover
+            "prefill_tokens": self._prefill_tokens,
+            "migrated_in": self._migrated_in,
+            "migrated_out": self._migrated_out,
         }
 
     def resident_kv_bytes(self) -> int:
@@ -530,6 +539,8 @@ class ServingEngine:
 
     def _admit(self) -> bool:
         worked = False
+        if self.draining:
+            return worked
         while True:
             try:
                 idx = self.slots.index(None)
@@ -580,6 +591,103 @@ class ServingEngine:
             )
             worked = True
 
+    # ---- live KV-page migration (serving/migration.py) -------------------
+
+    def export_pages(self, i: int) -> Dict[str, np.ndarray]:
+        """Host copies of the physical pages slot ``i`` holds, in
+        LOGICAL order — the donor half of a live migration. Pages ship
+        exactly as stored (int8 payloads + per-block f32 scales, or
+        bf16 rows), so the survivor's continuation attends to
+        bitwise-identical cache state. Read-only: the slot keeps its
+        pages until :meth:`release_slot`, so a torn transfer can
+        re-snapshot."""
+        n = self.alloc.slot_pages(i)
+        phys = [int(p) for p in self.alloc.block_tables()[i, :n]]
+        return {k: np.asarray(v[:, phys]) for k, v in self.pools.items()}
+
+    def release_slot(self, i: int) -> None:
+        """Drop a slot whose request migrated out: free its pages
+        without resolving the request's future (the survivor owns the
+        request now)."""
+        if self.slots[i] is None:
+            return
+        self.alloc.evict(i)
+        self.slots[i] = None
+        self._migrated_out += 1
+
+    def import_slot(
+        self,
+        req: Request,
+        pages: Dict[str, np.ndarray],
+        *,
+        phase: str,
+        n_prefilled: int,
+        generated: Sequence[int],
+        reserved_tag: Optional[str] = None,
+    ) -> int:
+        """Adopt a migrated request mid-stream into a free slot.
+
+        Commits the pages reserved under ``reserved_tag`` (or admits a
+        fresh footprint when None), scatters the donated page payloads
+        verbatim into those physical pages, and rebuilds the lane
+        exactly where the donor stopped — same absolute positions, same
+        generated prefix, sampling key re-derived from the request's
+        seed. Because every sampling draw folds in the absolute buffer
+        position, the continuation emits the never-evicted stream.
+
+        Raises ``AdmissionError`` (with a retry-after hint) when no lane
+        is free, and ``ValueError`` on a footprint/geometry mismatch —
+        both leave the caller on the re-prefill fallback ladder.
+        """
+        try:
+            idx = self.slots.index(None)
+        except ValueError:
+            raise AdmissionError(
+                f"no free slot for migrated request {req.rid}",
+                retry_after_s=self.scheduler.retry_after_hint(),
+            ) from None
+        if set(pages) != set(self.pools):
+            raise ValueError(
+                f"migrated pages carry pools {sorted(pages)}; this engine "
+                f"stores {sorted(self.pools)} (mode={self.geom.mode})"
+            )
+        if reserved_tag is not None:
+            phys = self.alloc.commit_migration(reserved_tag, idx)
+        else:
+            if not self.alloc.can_admit(req.total_tokens):
+                raise AdmissionError(
+                    f"no pages for migrated request {req.rid}",
+                    retry_after_s=self.scheduler.retry_after_hint(),
+                )
+            self.alloc.admit(idx, req.total_tokens)
+            n = self.alloc.slot_pages(idx)
+            phys = [int(p) for p in self.alloc.block_tables()[idx, :n]]
+        n_held = next(iter(pages.values())).shape[1]
+        if n_held != len(phys):
+            self.alloc.evict(idx)
+            raise ValueError(
+                f"migrated request {req.rid} holds {n_held} pages but the "
+                f"reservation covers {len(phys)} — geometry mismatch"
+            )
+        tgt = jnp.asarray(phys, jnp.int32)
+        for k, v in self.pools.items():
+            self.pools[k] = v.at[:, tgt].set(jnp.asarray(pages[k], v.dtype))
+        key_data = np.asarray(
+            jax.random.key_data(jax.random.key(int(req.sampling.seed)))
+        )
+        self.slots[idx] = _Slot(
+            req=req,
+            phase=phase,
+            prompt=np.asarray(req.prompt, np.int32),
+            key_data=key_data,
+            n_prefilled=int(n_prefilled),
+            generated=[int(t) for t in generated],
+        )
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        self._migrated_in += 1
+        return idx
+
     def _sampling_arrays(self, lanes):
         """Per-lane sampling inputs for the jitted steps: threefry key
         data, temperature, top_k, top_p. Idle lanes carry defaults
@@ -624,6 +732,7 @@ class ServingEngine:
             tok0 = np.asarray(tok0)
             self._step_time += time.monotonic() - t0
             s.n_prefilled += clen
+            self._prefill_tokens += clen
             if s.n_prefilled == p:
                 s.generated = [int(tok0[0])]
                 s.phase = "decode"

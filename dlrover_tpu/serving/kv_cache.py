@@ -26,13 +26,14 @@ is garbage by construction and every reader masks it by slot position.
 
 Host side (``PageAllocator``) is plain numpy + a free list — the engine
 ships ``block_tables()`` into jit each step. Device side (``gather`` /
-``write_rows``) is pure jnp so it fuses into the decode step. The
-follow-on (documented in docs/serving.md, not blocking): migrating live
-pages between replicas over the PR 8 resharding wire instead of
-re-prefilling on failover.
+``write_rows``) is pure jnp so it fuses into the decode step. Live
+page migration between replicas (``serving/migration.py``) holds its
+survivor-side footprint through the allocator's named reservations
+(``reserve_for_migration`` / ``commit_migration`` / ``abort_migration``)
+so an in-flight transfer can never lose its landing pages to admission.
 """
 
-from typing import Dict, NamedTuple
+from typing import Dict, List, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -257,7 +258,13 @@ class PageAllocator:
     - a physical page is assigned to at most one (slot, logical) cell;
     - page 0 (trash) is never handed out;
     - ``evict`` returns every page the slot held to the free list;
-    - free + assigned is a partition of pages 1..n_pages-1.
+    - free + assigned + reserved is a partition of pages 1..n_pages-1.
+
+    Reservations are the migration footprint hold: pages moved from the
+    free list into a named bucket, invisible to ``can_admit``/``ensure``
+    until ``commit_migration`` assigns them to a slot or
+    ``abort_migration`` returns them. Mutations are not locked — callers
+    serialize through the engine thread (or ``GenerationServer.paused()``).
     """
 
     def __init__(self, geom: PageGeometry, n_slots: int):
@@ -265,6 +272,7 @@ class PageAllocator:
         self.n_slots = n_slots
         # pop() yields ascending physical pages — deterministic layouts
         self._free = list(range(geom.n_pages - 1, TRASH_PAGE, -1))
+        self._reserved: Dict[str, List[int]] = {}
         self._tables = np.full(
             (n_slots, geom.max_pages_per_slot), -1, np.int32
         )
@@ -291,6 +299,14 @@ class PageAllocator:
 
     def slot_pages(self, slot: int) -> int:
         return int(self._n_pages[slot])
+
+    @property
+    def reserved_pages(self) -> int:
+        return sum(len(p) for p in self._reserved.values())
+
+    def reservation(self, tag: str) -> Tuple[int, ...]:
+        """The physical pages held under ``tag`` (empty if none)."""
+        return tuple(self._reserved.get(tag, ()))
 
     def block_tables(self) -> np.ndarray:
         """The live [n_slots, max_pages] table (copy — jit inputs must
@@ -342,3 +358,41 @@ class PageAllocator:
         if n:
             self._dirty = True
         return n
+
+    # ---- migration reservations ------------------------------------------
+
+    def reserve_for_migration(self, tag: str, n_tokens: int) -> bool:
+        """Hold the full page footprint for an incoming migrated request
+        under ``tag``. False (state unchanged) when the free list cannot
+        cover it — the migrator sheds/backs off and retries."""
+        if tag in self._reserved:
+            raise ValueError(f"migration tag {tag!r} already reserved")
+        need = self.pages_needed(n_tokens)
+        if need > self.geom.max_pages_per_slot or need > len(self._free):
+            return False
+        self._reserved[tag] = [self._free.pop() for _ in range(need)]
+        return True
+
+    def commit_migration(self, tag: str, slot: int) -> List[int]:
+        """Assign the reservation's pages to an EMPTY slot's table row,
+        in reservation order (logical page i → reserved page i). Returns
+        the physical pages so the importer can scatter payloads."""
+        if tag not in self._reserved:
+            raise KeyError(f"no migration reservation {tag!r}")
+        if self._n_pages[slot]:
+            raise ValueError(f"slot {slot} already holds pages")
+        pages = self._reserved.pop(tag)
+        for i, p in enumerate(pages):
+            self._tables[slot, i] = p
+        self._n_pages[slot] = len(pages)
+        if pages:
+            self._dirty = True
+        return list(pages)
+
+    def abort_migration(self, tag: str) -> int:
+        """Return a reservation's pages to the free list (torn transfer,
+        fallback to re-prefill). Missing tag is a no-op — abort must be
+        safe to call from any phase's unwind."""
+        pages = self._reserved.pop(tag, [])
+        self._free.extend(pages)
+        return len(pages)

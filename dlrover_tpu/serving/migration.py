@@ -1,0 +1,543 @@
+"""Live KV-page migration between serving replicas.
+
+The serving tier's answer to the training tier's canonical-coordinate
+donation (elastic/resharding.py): on a planned drain or detected
+eviction, each victim request's *held KV pages* — int8 payload pages +
+per-block f32 scales (the ``ops/quant.py`` block encode the pools store,
+shipped verbatim) or bf16 rows, plus block-table order, position and
+sampling state — transfer to a survivor that has RESERVED the same page
+footprint, and the survivor resumes mid-decode at the original
+position. Because every sampling draw folds in the absolute buffer
+position (PR 13), the migrated continuation is bitwise the never-evicted
+stream; nothing re-prefills.
+
+Phase machine (reusing :class:`~dlrover_tpu.elastic.resharding.LiveResharder`
+under per-phase :class:`PhaseBudgets`):
+
+1. **detect**   — halt the victim (planned drain stops its loop; a kill
+   already did), inventory its in-flight slots and queued requests.
+2. **plan**     — obtain a versioned serving-reshard directive (master
+   ``ServingEvictionNotice``/``ServingReshardDirective`` flow when a
+   client is attached, a local monotonic version otherwise) and assign
+   each victim request to the survivor with the most free pages.
+3. **reserve**  — hold each request's full footprint on its survivor via
+   ``PageAllocator.reserve_for_migration`` under ``server.paused()``.
+   Overload-graceful: when pages are short, shed the survivor's
+   lowest-priority queued NEW admissions (never re-admitted ones) with a
+   retry-after-carrying ``AdmissionError``, back off with jitter, and
+   retry inside the phase budget — a failover storm degrades p99
+   instead of collapsing the loop.
+4. **transfer** — snapshot each slot read-only on the donor, encode to
+   the checksummed wire blob, decode on the survivor side. A truncated
+   or corrupt blob raises :class:`TornPageTransfer` (a ``TornDonation``,
+   so the resharder retries it with backoff before falling back).
+5. **resume**   — commit the reservation into a free survivor slot and
+   rebuild the lane exactly where the donor stopped
+   (``ServingEngine.import_slot``), then release the donor slot.
+
+Ladder semantics: a torn or over-deadline migration degrades to the
+re-prefill path (abort reservations, ``re_admit`` every non-resumed
+request under its original ticket) — NEVER to a lost request. The final
+``reshard_recovery`` telemetry event carries ``path=live|fallback``.
+
+Fault injection points: ``serving.detect`` / ``serving.plan`` /
+``serving.reserve`` / ``serving.transfer`` / ``serving.resume`` with
+``rank`` = the acting replica's node_id (donor for detect/plan/transfer,
+survivor for reserve/resume); see docs/fault_drills.md for the grammar.
+
+This module deliberately does not import ``serving.replica`` — victim /
+survivors are duck-typed (``.name``, ``.node_id``, ``.server``), so the
+router can depend on the migrator without a cycle.
+"""
+
+import itertools
+import json
+import struct
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.comm import _backoff_delay
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.elastic.faults import (
+    FaultInjector,
+    TornDonation,
+    get_injector,
+)
+from dlrover_tpu.elastic.resharding import (
+    LiveResharder,
+    MigrationError,
+    PhaseBudgets,
+    ReshardOutcome,
+)
+from dlrover_tpu.serving.scheduler import AdmissionError, Request
+
+logger = get_logger(__name__)
+
+_MAGIC = b"DTKV1\n"
+_local_directive = itertools.count(1)
+
+
+class TornPageTransfer(TornDonation):
+    """A page blob arrived truncated or corrupt (checksum/shape
+    mismatch). Retryable: the donor snapshot is read-only, so the
+    resharder re-runs the transfer phase before degrading."""
+
+
+@dataclass
+class RequestSnapshot:
+    """Everything a survivor needs to resume one request mid-decode.
+
+    ``pages`` maps pool key → host array ``[L, n_held, page_size, ...]``
+    in LOGICAL page order, exactly as stored (int8 payloads + f32
+    scales, or bf16 rows) — shipping the stored representation verbatim
+    is what makes the continuation bitwise. The geometry fingerprint
+    fields let the survivor refuse an incompatible donor (different
+    page_size/mode/shape) and fall back to re-prefill instead of
+    importing garbage.
+
+    The ``Request`` OBJECT travels in-process alongside the snapshot
+    (its future must resolve for the original caller); the metadata
+    here duplicates what a cross-host receiver would need to rebuild
+    one.
+    """
+
+    rid: str
+    prompt: List[int]
+    generated: List[int]
+    n_prefilled: int
+    phase: str                   # "prefill" | "decode"
+    max_new_tokens: int
+    seed: int
+    # geometry fingerprint
+    mode: str
+    page_size: int
+    n_layers: int
+    kv_heads: int
+    head_dim: int
+    kv_block: int
+    pages: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def n_pages(self) -> int:
+        if not self.pages:
+            return 0
+        return next(iter(self.pages.values())).shape[1]
+
+    @property
+    def tokens_resident(self) -> int:
+        """Tokens of compute a re-prefill would redo (the savings)."""
+        return self.n_prefilled + len(self.generated)
+
+
+def geometry_fingerprint(geom) -> Dict[str, Any]:
+    return {
+        "mode": geom.mode,
+        "page_size": geom.page_size,
+        "n_layers": geom.n_layers,
+        "kv_heads": geom.kv_heads,
+        "head_dim": geom.head_dim,
+        "kv_block": geom.kv_block,
+    }
+
+
+def snapshot_slot(engine, i: int) -> RequestSnapshot:
+    """Read-only donor-side snapshot of slot ``i`` (engine halted or
+    paused). Safe to call repeatedly — a torn transfer re-snapshots."""
+    s = engine.slots[i]
+    if s is None:
+        raise ValueError(f"slot {i} is empty")
+    return RequestSnapshot(
+        rid=s.req.rid,
+        prompt=[int(t) for t in s.prompt],
+        generated=list(s.generated),
+        n_prefilled=int(s.n_prefilled),
+        phase=s.phase,
+        max_new_tokens=int(s.req.max_new_tokens),
+        seed=int(s.req.sampling.seed),
+        pages=engine.export_pages(i),
+        **geometry_fingerprint(engine.geom),
+    )
+
+
+# ------------------------------------------------------------------ wire
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 & friends register with numpy via ml_dtypes (jax dep)
+        import jax.numpy as jnp
+
+        return np.dtype(getattr(jnp, name))
+
+
+def encode_snapshot(snap: RequestSnapshot) -> bytes:
+    """Serialize to the migration wire blob: JSON header (metadata +
+    per-array dtype/shape manifest + payload CRC) followed by the raw
+    page bytes. int8 pools ship payload pages + per-block f32 scales
+    exactly as the ``ops/quant.py`` block encode stored them."""
+    keys = sorted(snap.pages)
+    payload = b"".join(
+        np.ascontiguousarray(snap.pages[k]).tobytes() for k in keys
+    )
+    header = json.dumps({
+        "meta": {
+            "rid": snap.rid,
+            "prompt": snap.prompt,
+            "generated": snap.generated,
+            "n_prefilled": snap.n_prefilled,
+            "phase": snap.phase,
+            "max_new_tokens": snap.max_new_tokens,
+            "seed": snap.seed,
+            "mode": snap.mode,
+            "page_size": snap.page_size,
+            "n_layers": snap.n_layers,
+            "kv_heads": snap.kv_heads,
+            "head_dim": snap.head_dim,
+            "kv_block": snap.kv_block,
+        },
+        "arrays": [
+            {
+                "key": k,
+                "dtype": snap.pages[k].dtype.name,
+                "shape": list(snap.pages[k].shape),
+            }
+            for k in keys
+        ],
+        "payload_len": len(payload),
+        "crc": zlib.crc32(payload),
+    }).encode()
+    return _MAGIC + struct.pack("<I", len(header)) + header + payload
+
+
+def decode_snapshot(data: bytes) -> RequestSnapshot:
+    """Parse and VERIFY a wire blob. Any truncation, bad magic, length
+    or CRC mismatch raises :class:`TornPageTransfer` — the retryable
+    torn-transfer signal, never a silent partial import."""
+    try:
+        if data[: len(_MAGIC)] != _MAGIC:
+            raise TornPageTransfer("bad magic — not a migration blob")
+        off = len(_MAGIC)
+        (hlen,) = struct.unpack("<I", data[off:off + 4])
+        off += 4
+        raw = data[off:off + hlen]
+        if len(raw) != hlen:
+            raise TornPageTransfer("truncated header")
+        header = json.loads(raw)
+        off += hlen
+        payload = data[off:]
+        if len(payload) != header["payload_len"]:
+            raise TornPageTransfer(
+                f"truncated payload: {len(payload)} of "
+                f"{header['payload_len']} bytes"
+            )
+        if zlib.crc32(payload) != header["crc"]:
+            raise TornPageTransfer("payload checksum mismatch")
+        pages: Dict[str, np.ndarray] = {}
+        pos = 0
+        for spec in header["arrays"]:
+            dt = _np_dtype(spec["dtype"])
+            shape = tuple(spec["shape"])
+            n = dt.itemsize * int(np.prod(shape))
+            pages[spec["key"]] = np.frombuffer(
+                payload[pos:pos + n], dtype=dt
+            ).reshape(shape)
+            pos += n
+        m = header["meta"]
+        return RequestSnapshot(pages=pages, **m)
+    except TornPageTransfer:
+        raise
+    except Exception as e:  # struct/json/shape errors are torn too
+        raise TornPageTransfer(f"undecodable migration blob: {e}") from e
+
+
+# ------------------------------------------------------------ phase machine
+
+
+@dataclass
+class MigrationReport:
+    """What one :meth:`ServingMigrator.migrate` call did."""
+
+    outcome: ReshardOutcome
+    placements: Dict[str, str]        # rid → survivor name (live-migrated)
+    re_prefilled: Dict[str, str]      # rid → survivor name (fallback tier)
+    re_routed: Dict[str, str]         # queued-only rids → survivor name
+    directive_version: int = 0
+    bytes_moved: int = 0
+    tokens_saved: int = 0             # prefill+decode compute not redone
+
+    @property
+    def path(self) -> str:
+        return self.outcome.path
+
+
+class _Assignment:
+    """One victim request's migration state across phases."""
+
+    __slots__ = ("slot", "req", "survivor", "snap", "reserved", "resumed")
+
+    def __init__(self, slot: int, req: Request, survivor):
+        self.slot = slot
+        self.req = req
+        self.survivor = survivor
+        self.snap: Optional[RequestSnapshot] = None
+        self.reserved = False
+        self.resumed = False
+
+
+class ServingMigrator:
+    """Drives one victim's drain/eviction through the migration ladder.
+
+    ``master_client`` (optional, any object with
+    ``report_serving_eviction``/``get_serving_reshard``) threads the
+    directive through the master; without one the migrator versions
+    directives locally — the in-process drill path.
+    """
+
+    def __init__(
+        self,
+        budgets: Optional[PhaseBudgets] = None,
+        faults: Optional[FaultInjector] = None,
+        master_client=None,
+        retries: int = 2,
+        shed_per_attempt: int = 2,
+        reserve_attempts: int = 6,
+    ):
+        self.budgets = budgets or PhaseBudgets()
+        self.faults = faults if faults is not None else get_injector()
+        self.master_client = master_client
+        self.retries = retries
+        self.shed_per_attempt = shed_per_attempt
+        self.reserve_attempts = reserve_attempts
+
+    # ---- phases (each closes over one migration's context) ---------------
+
+    def migrate(self, victim, survivors: Sequence) -> MigrationReport:
+        """Move every in-flight request off ``victim`` onto
+        ``survivors``; queued-but-never-admitted requests are re-routed
+        (nothing to migrate). Never raises for torn/over-deadline
+        transfers — those degrade to re-prefill; an ``InjectedKill``
+        (replica-scope kill drill) propagates."""
+        survivors = [s for s in survivors if s.server.alive or s is victim]
+        if not survivors or all(s is victim for s in survivors):
+            raise ValueError("migration needs at least one live survivor")
+        survivors = [s for s in survivors if s is not victim]
+
+        ctx: Dict[str, Any] = {
+            "assignments": [],      # List[_Assignment]
+            "queued": [],           # List[Request]
+            "version": 0,
+            "bytes": 0,
+            "placements": {},
+            "re_prefilled": {},
+            "re_routed": {},
+            "tokens_saved": 0,
+        }
+        rr = itertools.count()
+
+        def detect(_prev):
+            self.faults.at("serving.detect", rank=victim.node_id)
+            srv = victim.server
+            ctx["reason"] = "drain" if srv.alive else "evict"
+            if srv.alive:
+                # planned drain: stop admitting, then halt the loop at a
+                # step boundary — in-HBM pool state survives the stop
+                srv.begin_drain()
+                srv.stop()
+            eng = srv.engine
+            in_flight = [
+                (i, s.req)
+                for i, s in enumerate(eng.slots)
+                if s is not None and not s.req.future.done()
+            ]
+            while True:
+                q = srv.scheduler.pop_next()
+                if q is None:
+                    break
+                ctx["queued"].append(q)
+            if not in_flight and not ctx["queued"]:
+                return ctx
+            return {"in_flight": in_flight}
+
+        def plan(detected):
+            self.faults.at("serving.plan", rank=victim.node_id)
+            in_flight = (detected or {}).get("in_flight", [])
+            if self.master_client is not None:
+                self.master_client.report_serving_eviction(
+                    victim.name,
+                    in_flight=len(in_flight),
+                    deadline_s=self.budgets.transfer_s,
+                    reason=ctx.get("reason", "evict"),
+                )
+                directive = self.master_client.get_serving_reshard()
+                ctx["version"] = int(directive.version)
+            else:
+                ctx["version"] = next(_local_directive)
+            # most-free-pages-first placement, debited as we assign
+            headroom = {
+                id(s): s.server.engine.alloc.free_pages for s in survivors
+            }
+            for slot, req in in_flight:
+                tgt = max(survivors, key=lambda s: headroom[id(s)])
+                headroom[id(tgt)] -= tgt.server.engine.alloc.pages_needed(
+                    req.total_tokens
+                )
+                ctx["assignments"].append(_Assignment(slot, req, tgt))
+            return ctx["assignments"]
+
+        def reserve(assignments):
+            t0 = time.monotonic()
+            budget = self.budgets.for_phase("reserve")
+            for a in assignments:
+                self.faults.at("serving.reserve", rank=a.survivor.node_id)
+                sched = a.survivor.server.scheduler
+                for attempt in range(self.reserve_attempts):
+                    with a.survivor.server.paused() as eng:
+                        a.reserved = eng.alloc.reserve_for_migration(
+                            a.req.rid, a.req.total_tokens
+                        )
+                    if a.reserved:
+                        break
+                    # overload-graceful: shed the survivor's lowest-
+                    # priority queued NEW admissions (never re-admits),
+                    # then jittered backoff while running slots retire
+                    shed = sched.shed_lowest(
+                        count=self.shed_per_attempt,
+                        below_priority=a.req.priority,
+                    )
+                    remaining = budget - (time.monotonic() - t0)
+                    if remaining <= 0:
+                        break
+                    time.sleep(min(_backoff_delay(attempt), remaining))
+                    logger.info(
+                        "reserve retry %d for %s on %s (shed %d)",
+                        attempt + 1, a.req.rid, a.survivor.name, len(shed),
+                    )
+                if not a.reserved:
+                    raise MigrationError(
+                        f"survivor {a.survivor.name} cannot reserve "
+                        f"{a.req.total_tokens} tokens for {a.req.rid} "
+                        f"within the {budget:.1f}s reserve budget"
+                    )
+            return assignments
+
+        def transfer(assignments):
+            eng = victim.server.engine
+            ctx["bytes"] = 0
+            for a in assignments:
+                snap = snapshot_slot(eng, a.slot)
+                blob = encode_snapshot(snap)
+                self.faults.at("serving.transfer", rank=victim.node_id)
+                a.snap = decode_snapshot(blob)
+                ctx["bytes"] += len(blob)
+            return assignments
+
+        def resume(assignments):
+            for a in assignments:
+                self.faults.at("serving.resume", rank=a.survivor.node_id)
+                snap = a.snap
+                try:
+                    self._check_geometry(snap, a.survivor.server.engine)
+                    with a.survivor.server.paused() as eng:
+                        eng.import_slot(
+                            a.req,
+                            snap.pages,
+                            phase=snap.phase,
+                            n_prefilled=snap.n_prefilled,
+                            generated=snap.generated,
+                            reserved_tag=a.req.rid,
+                        )
+                except (AdmissionError, ValueError, KeyError) as e:
+                    # per-request ladder: this one re-prefills, the rest
+                    # of the batch still migrates live
+                    logger.warning(
+                        "resume of %s on %s degraded to re-prefill: %s",
+                        a.req.rid, a.survivor.name, e,
+                    )
+                    with a.survivor.server.paused() as eng:
+                        eng.alloc.abort_migration(a.req.rid)
+                    a.survivor.server.re_admit(a.req)
+                    ctx["re_prefilled"][a.req.rid] = a.survivor.name
+                else:
+                    a.resumed = True
+                    ctx["placements"][a.req.rid] = a.survivor.name
+                    ctx["tokens_saved"] += snap.tokens_resident
+                victim.server.engine.release_slot(a.slot)
+            self._route_queued(ctx, survivors, rr)
+            return assignments
+
+        def fallback(exc):
+            """The re-prefill tier: abort every reservation, re-admit
+            every non-resumed in-flight request under its original
+            ticket. No request is lost; none is duplicated (resumed
+            ones keep their survivor slot)."""
+            for a in ctx["assignments"]:
+                if a.resumed:
+                    continue
+                with a.survivor.server.paused() as eng:
+                    eng.alloc.abort_migration(a.req.rid)
+                a.survivor.server.re_admit(a.req)
+                ctx["re_prefilled"][a.req.rid] = a.survivor.name
+            self._route_queued(ctx, survivors, rr)
+            return ctx["assignments"]
+
+        resharder = LiveResharder(
+            budgets=self.budgets,
+            faults=self.faults,
+            retries=self.retries,
+        )
+        outcome = resharder.execute(
+            [
+                ("detect", detect),
+                ("plan", plan),
+                ("reserve", reserve),
+                ("transfer", transfer),
+                ("resume", resume),
+            ],
+            fallback=fallback,
+        )
+        report = MigrationReport(
+            outcome=outcome,
+            placements=dict(ctx["placements"]),
+            re_prefilled=dict(ctx["re_prefilled"]),
+            re_routed=dict(ctx["re_routed"]),
+            directive_version=ctx["version"],
+            bytes_moved=ctx["bytes"],
+            tokens_saved=ctx["tokens_saved"],
+        )
+        logger.info(
+            "migration of %s: path=%s live=%d fallback=%d re_routed=%d "
+            "v%d %.0f bytes, %d tokens saved, %.3fs",
+            victim.name, report.path, len(report.placements),
+            len(report.re_prefilled), len(report.re_routed),
+            report.directive_version, report.bytes_moved,
+            report.tokens_saved, outcome.recovery_s,
+        )
+        return report
+
+    # ---- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _check_geometry(snap: RequestSnapshot, engine) -> None:
+        want = geometry_fingerprint(engine.geom)
+        got = {k: getattr(snap, k) for k in want}
+        if got != want:
+            raise ValueError(
+                f"donor geometry {got} incompatible with survivor {want}"
+            )
+
+    @staticmethod
+    def _route_queued(ctx, survivors, rr) -> None:
+        """Queued-but-never-admitted victim requests re-route round-robin
+        (original tickets; nothing resident to migrate). Idempotent —
+        drains ctx['queued'] so resume and fallback can both call it."""
+        while ctx["queued"]:
+            req = ctx["queued"].pop(0)
+            tgt = survivors[next(rr) % len(survivors)]
+            tgt.server.re_admit(req)
+            ctx["re_routed"][req.rid] = tgt.name

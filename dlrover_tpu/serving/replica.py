@@ -11,12 +11,15 @@ rendezvous.
 
 ``ReplicaRouter`` is the client-side elastic story: round-robin
 dispatch over live replicas, and on replica death (``poll``) every
-in-flight request of the dead replica is RE-ADMITTED on a survivor
-under its original admission ticket — exactly once, no lost and no
-duplicated requests (the failover drill in tests/test_serving_replica.py
-pins this). Re-admitted requests re-prefill from the prompt on the
-survivor; migrating their live KV pages over the resharding wire
-instead is the documented follow-on (docs/serving.md).
+in-flight request of the dead replica moves to a survivor — exactly
+once, no lost and no duplicated requests (the failover drills in
+tests/test_serving_replica.py and tests/test_serving_migration.py pin
+this). With a ``ServingMigrator`` attached the move is a LIVE KV-page
+migration (serving/migration.py): the survivor adopts the victim's
+pages and resumes mid-decode with zero re-prefilled prompt tokens,
+bitwise-identical output. Without one — or when the migrator itself
+degrades — requests are re-admitted under their original ticket and
+re-prefill from the prompt (docs/serving.md describes the ladder).
 """
 
 import json
@@ -139,13 +142,19 @@ class ReplicaRouter:
     future at most once even if a race double-delivers.
     """
 
-    def __init__(self, replicas: List[ServingReplica]):
+    def __init__(
+        self,
+        replicas: List[ServingReplica],
+        migrator=None,
+    ):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = list(replicas)
+        self.migrator = migrator  # ServingMigrator or None (re-admit path)
         self._entries: List[_Entry] = []
         self._rr = 0
         self._lock = threading.Lock()
+        self.reports: List = []   # MigrationReports, drill introspection
 
     def _mark_done(self, entry: _Entry):
         def _cb(_future):
@@ -158,7 +167,7 @@ class ReplicaRouter:
 
     def submit(
         self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0,
-        sampling=None,
+        sampling=None, deadline_s=None,
     ) -> Request:
         with self._lock:
             live = self._live()
@@ -168,7 +177,7 @@ class ReplicaRouter:
             self._rr += 1
             req = replica.submit(
                 prompt, max_new_tokens, eos_id=eos_id, priority=priority,
-                sampling=sampling,
+                sampling=sampling, deadline_s=deadline_s,
             )
             entry = _Entry(req, replica)
             req.future.add_done_callback(self._mark_done(entry))
@@ -176,11 +185,13 @@ class ReplicaRouter:
         return req
 
     def poll(self) -> int:
-        """Failover sweep: re-admit every incomplete request whose
-        replica died onto a survivor. Returns how many moved."""
+        """Failover sweep: move every incomplete request whose replica
+        died onto a survivor — live page migration when a migrator is
+        attached, re-admission otherwise. Returns how many moved."""
         with self._lock:
             live = self._live()
             moved = 0
+            migrated_victims = set()
             for entry in self._entries:
                 if entry.done or entry.replica.alive:
                     continue
@@ -188,34 +199,90 @@ class ReplicaRouter:
                     raise RuntimeError(
                         "all serving replicas died with requests in flight"
                     )
-                survivor = live[self._rr % len(live)]
-                self._rr += 1
-                logger.info(
-                    "re-admitting %s from dead replica %s onto %s",
-                    entry.req.rid, entry.replica.name, survivor.name,
-                )
-                survivor.server.re_admit(entry.req)
-                entry.replica = survivor
-                moved += 1
+                victim = entry.replica
+                if (
+                    self.migrator is not None
+                    and id(victim) not in migrated_victims
+                ):
+                    migrated_victims.add(id(victim))
+                    moved += self._migrate_victim(victim, live)
+                if not entry.replica.alive:
+                    # no migrator, or this request slipped past one
+                    # (e.g. completed-but-unresolved slot): re-admit
+                    survivor = live[self._rr % len(live)]
+                    self._rr += 1
+                    logger.info(
+                        "re-admitting %s from dead replica %s onto %s",
+                        entry.req.rid, victim.name, survivor.name,
+                    )
+                    survivor.server.re_admit(entry.req)
+                    entry.replica = survivor
+                    moved += 1
             return moved
+
+    def _migrate_victim(self, victim, live) -> int:
+        """Drive one dead/drained replica through the migrator and
+        repoint every entry it placed. Caller holds ``_lock``."""
+        report = self.migrator.migrate(victim, live)
+        self.reports.append(report)
+        by_name = {r.name: r for r in live}
+        placed = {}
+        placed.update(report.placements)
+        placed.update(report.re_prefilled)
+        placed.update(report.re_routed)
+        moved = 0
+        for entry in self._entries:
+            if entry.done or entry.replica is not victim:
+                continue
+            survivor_name = placed.get(entry.req.rid)
+            if survivor_name in by_name:
+                entry.replica = by_name[survivor_name]
+                moved += 1
+        logger.info(
+            "migrated replica %s: path=%s live=%d re_prefilled=%d",
+            victim.name, report.path, len(report.placements),
+            len(report.re_prefilled),
+        )
+        return moved
 
     def wait_all(self, timeout: float = 120.0) -> List:
         """Poll for failovers while gathering every outstanding result
-        (submission order). Raises on per-request failure or timeout."""
+        (submission order). Raises on per-request failure or timeout.
+
+        Waits in jittered-backoff slices (``comm._backoff_delay``, capped
+        at attempt 3 ≈ 4 s so a mid-wait replica death is still noticed
+        promptly) instead of a fixed 50 ms spin — one slow straggler no
+        longer costs a poll storm. Deadlines are per-request: a request
+        carrying ``deadline_s`` must finish within that budget of its
+        OWN submit time; the ``timeout`` argument bounds the rest
+        relative to this call."""
         import concurrent.futures
         import time
 
-        deadline = time.monotonic() + timeout
+        from dlrover_tpu.common.comm import _backoff_delay
+
+        t_start = time.monotonic()
         with self._lock:
             entries = list(self._entries)
         results = []
         for entry in entries:
+            req = entry.req
+            if req.deadline_s is not None:
+                deadline = req.submit_t + req.deadline_s
+            else:
+                deadline = t_start + timeout
+            attempt = 0
             while True:
                 self.poll()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and not req.future.done():
+                    raise concurrent.futures.TimeoutError(
+                        f"request {req.rid} missed its deadline"
+                    )
+                wait = min(_backoff_delay(min(attempt, 3)), max(remaining, 0.0))
                 try:
-                    results.append(entry.req.future.result(timeout=0.05))
+                    results.append(req.future.result(timeout=wait))
                     break
                 except concurrent.futures.TimeoutError:
-                    if time.monotonic() > deadline:
-                        raise
+                    attempt += 1
         return results

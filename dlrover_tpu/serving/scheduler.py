@@ -23,10 +23,18 @@ from typing import List, Optional
 
 
 class AdmissionError(ValueError):
-    """The request cannot be admitted: queue at capacity (back off and
-    retry), or invalid parameters (fix the request). Subclasses
-    ValueError so pre-existing callers catching ValueError on the
-    future still work."""
+    """The request cannot be admitted: queue at capacity or shed under
+    migration pressure (back off ``retry_after_s`` and retry), or
+    invalid parameters (fix the request). Subclasses ValueError so
+    pre-existing callers catching ValueError on the future still work.
+
+    ``retry_after_s`` is the scheduler's deadline-aware hint — estimated
+    queue drain time from the recent completion rate, 0.0 when the
+    error is not load-related (invalid parameters)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 @dataclass(frozen=True)
@@ -77,6 +85,8 @@ class Request:
     submit_t: float = 0.0
     first_token_t: float = 0.0  # 0 until the prefill emits token 0
     done_t: float = 0.0
+    deadline_s: Optional[float] = None  # wall budget from submit_t, if any
+    re_admits: int = 0          # >0 marks preempted/migrated — never shed
     sampling: SamplingParams = field(default_factory=SamplingParams)
     future: Future = field(default_factory=Future)
 
@@ -108,9 +118,11 @@ class Scheduler:
         self.replica = replica
         self._latencies_ms: List[float] = []
         self._max_latencies = max_latencies
+        self._done_ts: List[float] = []  # recent completion times, for hints
         self.admitted = 0
         self.completed = 0
         self.re_admitted = 0
+        self.shed = 0
 
     # ---- intake ----------------------------------------------------------
 
@@ -121,13 +133,15 @@ class Scheduler:
         eos_id: Optional[int] = None,
         priority: int = 0,
         sampling: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         with self._lock:
             if len(self._heap) >= self.max_queue:
                 raise AdmissionError(
-                    f"queue at capacity ({self.max_queue}); retry later"
+                    f"queue at capacity ({self.max_queue}); retry later",
+                    retry_after_s=self._retry_after_locked(),
                 )
             arrival = next(self._ticket)
             req = Request(
@@ -138,6 +152,7 @@ class Scheduler:
                 priority=int(priority),
                 arrival=arrival,
                 submit_t=time.monotonic(),
+                deadline_s=deadline_s,
                 sampling=sampling or SamplingParams(),
             )
             heapq.heappush(
@@ -151,13 +166,74 @@ class Scheduler:
         """Re-queue a preempted/failed-over request under its ORIGINAL
         (priority, arrival) ticket — it outranks later arrivals. The
         admission-control bound is deliberately not applied: the request
-        was already admitted once."""
+        was already admitted once. Marks the request shed-exempt."""
         with self._lock:
+            req.re_admits += 1
             heapq.heappush(
                 self._heap,
                 (req.priority, req.arrival, next(self._seq), req),
             )
             self.re_admitted += 1
+
+    # ---- overload degradation --------------------------------------------
+
+    def _retry_after_locked(self) -> float:
+        """Estimated queue drain time from the recent completion rate —
+        the ``AdmissionError.retry_after_s`` hint. Caller holds _lock."""
+        depth = len(self._heap)
+        ts = self._done_ts
+        if len(ts) >= 2 and ts[-1] > ts[0]:
+            rate = (len(ts) - 1) / (ts[-1] - ts[0])
+            est = (depth + 1) / rate
+        else:
+            est = 1.0
+        return min(30.0, max(0.05, est))
+
+    def retry_after_hint(self) -> float:
+        with self._lock:
+            return self._retry_after_locked()
+
+    def shed_lowest(
+        self,
+        count: int = 1,
+        below_priority: Optional[int] = None,
+    ) -> List[Request]:
+        """Shed up to ``count`` of the LOWEST-priority queued new
+        admissions: fail their futures with a retry-after-carrying
+        ``AdmissionError`` so callers back off instead of hammering a
+        replica absorbing a failover. Never sheds a re-admitted request
+        (``re_admits > 0`` — it already paid for its place once, and
+        shedding it would turn a migration fallback into a lost
+        request). ``below_priority`` restricts victims to strictly
+        lower-priority (numerically greater) classes, so migration
+        admission never sheds traffic it doesn't outrank."""
+        with self._lock:
+            cands = [
+                t
+                for t in self._heap
+                if t[-1].re_admits == 0 and not t[-1].future.done()
+            ]
+            if below_priority is not None:
+                cands = [t for t in cands if t[0] > below_priority]
+            cands.sort(reverse=True)  # worst (priority, arrival) first
+            victims = cands[: max(int(count), 0)]
+            if victims:
+                drop = {id(t[-1]) for t in victims}
+                self._heap = [t for t in self._heap if id(t[-1]) not in drop]
+                heapq.heapify(self._heap)
+                self.shed += len(victims)
+            hint = self._retry_after_locked()
+        shed = [t[-1] for t in victims]
+        for req in shed:
+            self.fail(
+                req,
+                AdmissionError(
+                    f"{req.rid} shed under migration pressure; "
+                    f"retry after {hint:.2f}s",
+                    retry_after_s=hint,
+                ),
+            )
+        return shed
 
     # ---- engine side -----------------------------------------------------
 
@@ -192,6 +268,9 @@ class Scheduler:
             self._latencies_ms.append((req.done_t - req.submit_t) * 1e3)
             if len(self._latencies_ms) > self._max_latencies:
                 del self._latencies_ms[: -self._max_latencies]
+            self._done_ts.append(req.done_t)
+            if len(self._done_ts) > 256:
+                del self._done_ts[:-256]
         if not req.future.done():
             req.future.set_result(output)
 
@@ -243,6 +322,9 @@ class Scheduler:
             draft_tokens=int(es.get("draft_tokens", 0)),
             accepted_tokens=int(es.get("accepted_tokens", 0)),
             spec_accept_rate=float(es.get("spec_accept_rate", 0.0)),
+            shed=self.shed,
+            migrated_in=int(es.get("migrated_in", 0)),
+            migrated_out=int(es.get("migrated_out", 0)),
         )
         if self.hub is not None:
             self.hub.publish(rec)

@@ -9,10 +9,17 @@ when fully idle instead of spinning.
 
 ``kill`` stops the loop abruptly WITHOUT resolving in-flight futures —
 that is the eviction drill: a replica dying mid-stream leaves its
-requests dangling until ``ReplicaRouter.poll`` re-admits them on a
-survivor (serving/replica.py).
+requests dangling until ``ReplicaRouter.poll`` migrates their live KV
+pages to a survivor, or re-admits them when migration is unavailable
+(serving/replica.py, serving/migration.py).
+
+``paused()`` is the migration-side concurrency contract: the engine's
+pools/allocator/slots are only ever mutated on the loop thread, so a
+migrator that needs to reserve pages or import a slot parks the loop at
+a step boundary first and gets exclusive access for the duration.
 """
 
+import contextlib
 import threading
 import time
 
@@ -46,6 +53,9 @@ class GenerationServer:
         self.idle_sleep = idle_sleep
         self._stop_evt = threading.Event()
         self._thread: threading.Thread | None = None
+        self._pause_lock = threading.Lock()   # serializes paused() users
+        self._pause_req = threading.Event()   # ask the loop to park
+        self._pause_ack = threading.Event()   # loop parked at a boundary
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -76,9 +86,45 @@ class GenerationServer:
         router's failover path picks them up."""
         self.stop()
 
+    @contextlib.contextmanager
+    def paused(self, timeout: float = 30.0):
+        """Exclusive engine access at a step boundary.
+
+        Parks the loop thread (it acknowledges between steps), yields,
+        then resumes it. When the loop is dead (killed replica — the
+        migration donor case) this is a pass-through: the caller already
+        has exclusive access. Ack timeout falls through rather than
+        deadlocking a migration on a wedged loop."""
+        with self._pause_lock:
+            if not self.alive:
+                yield self.engine
+                return
+            self._pause_ack.clear()
+            self._pause_req.set()
+            self._pause_ack.wait(timeout)
+            try:
+                yield self.engine
+            finally:
+                self._pause_req.clear()
+
+    def begin_drain(self) -> None:
+        """Planned drain: stop admitting queued work so in-flight slots
+        finish or migrate out; the queue itself is re-routed by the
+        caller (ReplicaRouter / migrator)."""
+        self.engine.draining = True
+
     def _loop(self) -> None:
         last_pub = time.monotonic()
         while not self._stop_evt.is_set():
+            if self._pause_req.is_set():
+                # re-ack every tick: a second paused() user can clear
+                # the ack and re-raise the request before this thread
+                # observes the gap between them — still parked at the
+                # same step boundary, so acking again is always valid
+                while self._pause_req.is_set() and not self._stop_evt.is_set():
+                    self._pause_ack.set()
+                    time.sleep(0.001)
+                continue
             worked = self.engine.step()
             now = time.monotonic()
             if now - last_pub >= self.publish_every:
@@ -94,6 +140,7 @@ class GenerationServer:
     def submit(
         self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0,
         sampling: SamplingParams | None = None,
+        deadline_s: float | None = None,
     ) -> Request:
         if len(prompt) + max_new_tokens > self.engine.max_len:
             raise ValueError(
@@ -102,13 +149,13 @@ class GenerationServer:
             )
         return self.scheduler.submit(
             prompt, max_new_tokens, eos_id=eos_id, priority=priority,
-            sampling=sampling,
+            sampling=sampling, deadline_s=deadline_s,
         )
 
     def re_admit(self, req: Request) -> None:
-        """Failover intake: requeue another replica's in-flight request
-        under its original admission ticket (generation restarts from
-        the prompt — live-page migration is the documented follow-on).
+        """Re-prefill failover intake — the migration ladder's fallback
+        tier: requeue another replica's in-flight request under its
+        original admission ticket; generation restarts from the prompt.
         ``req.sampling`` rides along, and position-indexed draws make
         the re-prefilled continuation identical to the original."""
         self.scheduler.re_admit(req)

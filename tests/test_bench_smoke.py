@@ -273,7 +273,7 @@ def test_bench_serve_mode_emits_schema():
     tokens/sec at a fixed p99 target plus the paged-KV memory story.
     The headline fields must be present AND measured (non-None), and
     the int8 geometry must beat bf16 residency by >= 1.7x."""
-    out = _run(["serve", "int8", "4"], timeout=420)
+    out = _run(["serve", "int8", "4"], timeout=540)
     assert out.returncode == 0, out.stderr[-800:]
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["unit"] == "new_tokens_per_sec"
@@ -298,6 +298,17 @@ def test_bench_serve_mode_emits_schema():
     assert 0.0 <= spec["accept_rate"] <= 1.0
     assert spec["accepted_tokens"] <= spec["draft_tokens"]
     assert spec["speedup_vs_specoff"] > 0
+    # the migration drill rode along: kill → first post-migration token
+    # on the survivor via the live page-migration path, with the token
+    # savings over the re-prefill failover it replaced
+    migr = rec["migration"]
+    assert migr is not None, "migration drill never reached mid-stream"
+    assert migr["path"] == "live"
+    assert migr["migrated"] == 2 and migr["re_prefilled"] == 0
+    assert migr["bytes_moved"] > 0
+    assert migr["tokens_saved_vs_reprefill"] > 0
+    assert rec["migration_recovery_s"] is not None
+    assert rec["migration_recovery_s"] > 0
 
 
 def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
@@ -342,6 +353,21 @@ def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
     assert got_spec["spec_tokens_per_s"] == pytest.approx(150.0)
     assert got_spec["spec_accept_rate"] == pytest.approx(0.62)
     assert got_spec["spec_speedup_vs_specoff"] == pytest.approx(1.21)
+    # a migration-bearing artifact projects the recovery headline too
+    pmig = tmp_path / "SERVE_mig.json"
+    pmig.write_text(json.dumps({
+        "serve_tokens_per_s": 99.0,
+        "serve_p99_ms": 70.0,
+        "migration_recovery_s": 0.42,
+        "migration": {
+            "path": "live", "migrated": 2, "re_prefilled": 0,
+            "bytes_moved": 4096, "tokens_saved_vs_reprefill": 17,
+        },
+    }))
+    got_mig = bench.serving_trajectory_metric(str(pmig))
+    assert got_mig["migration_recovery_s"] == pytest.approx(0.42)
+    assert got_mig["migration_path"] == "live"
+    assert got_mig["migration_tokens_saved"] == 17
     # missing/corrupt/unmeasured artifacts degrade to None
     assert bench.serving_trajectory_metric(
         str(tmp_path / "nope.json")

@@ -328,6 +328,14 @@ _SLOW_LEDGER = [
     "test_elastic.py::test_restart_hits_persistent_compile_cache",
     "test_rl.py::test_dpo_trainer_shifts_preference",
     "test_sparse_serving.py::test_server_crash_failover_without_migration",
+    # serving migration drills: two replica servers (four jit compiles)
+    # plus a mid-stream kill each — far past the tier-1 budget
+    "test_serving_migration.py::test_migration_drill_zero_reprefill_bitwise",
+    "test_serving_migration.py::"
+    "test_faulted_migration_degrades_to_reprefill[torn]",
+    "test_serving_migration.py::"
+    "test_faulted_migration_degrades_to_reprefill[stall]",
+    "test_serving_migration.py::test_wait_all_backoff_with_slow_straggler",
 ]
 
 
@@ -365,6 +373,51 @@ def test_slow_ledger_entries_exist_and_stay_out_of_tier1():
         "tier-1 budget regression: these heavyweight tests lost their "
         "slow mark and collect into the fast tier again:\n"
         + "\n".join(leaked)
+    )
+
+
+def _imports_serving_migration(tree) -> bool:
+    """Module-level import of the live KV-page migration layer."""
+    mod_name = "dlrover_tpu.serving.migration"
+    for node in tree.body:  # module level only, by design
+        if isinstance(node, ast.Import):
+            if any(
+                a.name == mod_name or a.name.startswith(mod_name + ".")
+                for a in node.names
+            ):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == mod_name or mod.startswith(mod_name + "."):
+                return True
+            if mod == "dlrover_tpu.serving" and any(
+                a.name == "migration" for a in node.names
+            ):
+                return True
+    return False
+
+
+def test_serving_migration_importers_are_unit_file_or_slow():
+    """``serving.migration`` consumers outside its own unit-test file
+    (``test_serving_migration.py``) are failover drills: they stand up
+    replica pairs, kill one mid-stream, and migrate live pages — slow
+    tier by construction. The unit file keeps the cheap wire-format
+    coverage in tier-1; everyone else must be slow-marked so a new
+    drill can't silently blow the 870s budget."""
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        if path.name == "test_serving_migration.py":
+            continue  # the unit-test file: fast wire coverage lives here
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if not _imports_serving_migration(tree) or _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if not _fn_slow_marked(fn):
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "serving.migration importers outside its unit-test file must be "
+        "slow-marked (add @pytest.mark.slow or a module pytestmark):\n"
+        + "\n".join(rogue)
     )
 
 

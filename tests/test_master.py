@@ -10,6 +10,7 @@ import pytest
 
 from dlrover_tpu.common.constants import (
     NodeStatus,
+    NodeType,
     RendezvousName,
 )
 from dlrover_tpu.agent.master_client import MasterClient
@@ -656,3 +657,34 @@ def test_eviction_notice_issues_reshard_directive(master):
 
     # an eviction that would leave no survivors is refused
     assert not c0.report_eviction([0, 1], dp_size=2)
+
+
+def test_serving_eviction_issues_page_migration_directive(master):
+    """The serving twin of the eviction flow: a replica's departure is
+    reported over the wire and the master answers subsequent polls with
+    a versioned page-migration directive naming victim + survivors."""
+    clients = []
+    for nid in (10, 11, 12):
+        c = MasterClient(master.addr, node_id=nid)
+        c.register_node(node_type=NodeType.SERVING)
+        clients.append(c)
+    c10, c11, c12 = clients
+
+    assert c10.get_serving_reshard().version == 0  # none pending
+
+    assert c10.report_serving_eviction(
+        "serving-11", in_flight=2, deadline_s=3.0, reason="evict"
+    )
+    d = c12.get_serving_reshard()
+    assert d.version == 1
+    assert d.victim == "serving-11"
+    # survivors default to every OTHER registered serving replica
+    assert d.survivors == ["serving-10", "serving-12"]
+    assert d.deadline_s == 3.0 and d.reason == "evict"
+
+    # directives version monotonically, latest wins
+    assert c10.report_serving_eviction("serving-12", reason="drain")
+    d2 = c10.get_serving_reshard()
+    assert d2.version == 2 and d2.victim == "serving-12"
+    for c in clients:
+        c.close()

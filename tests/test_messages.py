@@ -107,3 +107,21 @@ def test_roundtrip_reshard_messages():
     assert out == r and out.lost_ranks == [6, 7]
     req = msgs.ReshardPlanRequest(node_id=1, node_rank=1)
     assert msgs.deserialize(msgs.serialize(req)) == req
+
+
+def test_roundtrip_serving_reshard_messages():
+    n = msgs.ServingEvictionNotice(
+        node_id=1, replica="rep-1", in_flight=3, deadline_s=2.5,
+        reason="drain",
+    )
+    assert msgs.deserialize(msgs.serialize(n)) == n
+    d = msgs.ServingReshardDirective(
+        version=2, victim="rep-1", survivors=["rep-0", "rep-2"],
+        deadline_s=2.5, reason="drain",
+    )
+    out = msgs.deserialize(msgs.serialize(d))
+    assert out == d and out.survivors == ["rep-0", "rep-2"]
+    # version 0 is the none-pending sentinel the client polls against
+    assert msgs.ServingReshardDirective().version == 0
+    req = msgs.ServingReshardRequest(node_id=4)
+    assert msgs.deserialize(msgs.serialize(req)) == req

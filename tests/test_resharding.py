@@ -236,6 +236,42 @@ def test_parse_faults_rejects_unknown_kind():
         faults.parse_faults("meteor_strike:rank=1")
 
 
+def test_parse_faults_names_the_bad_clause():
+    """Strict grammar: every malformed clause raises ValueError NAMING
+    the clause — a typo'd drill spec must fail loudly at startup, not
+    silently run without the fault."""
+    cases = [
+        "meteor_strike:rank=1",               # unknown kind
+        "kill:rank",                          # key with no =
+        "kill:=3",                            # empty key
+        "kill:rank=three",                    # non-int rank
+        "slow_peer:delay_s=soon",             # non-float delay
+        "kill:color=red",                     # unknown key
+        "kill:times=1.5",                     # non-int times
+    ]
+    for bad in cases:
+        with pytest.raises(ValueError) as ei:
+            faults.parse_faults(f"evict:rank=1;{bad}")
+        # the message names the offending clause, not just "bad input"
+        assert bad in str(ei.value), bad
+
+
+def test_parse_faults_serving_kinds():
+    specs = faults.parse_faults(
+        "drop_page:point=serving.transfer:times=1;"
+        "stall_migration:point=serving.transfer:delay_s=0.3;"
+        "kill:point=serving.resume:rank=1"
+    )
+    assert [s.kind for s in specs] == ["drop_page", "stall_migration", "kill"]
+    inj = faults.FaultInjector()
+    inj.install(specs[0])
+    with pytest.raises(faults.DroppedPage):
+        inj.at("serving.transfer", rank=0)
+    inj.at("serving.transfer", rank=0)  # times=1: exhausted
+    # DroppedPage is a TornDonation — the migrator's retry ladder covers it
+    assert issubclass(faults.DroppedPage, faults.TornDonation)
+
+
 def test_injector_times_and_scoping():
     inj = faults.FaultInjector()
     inj.install(faults.FaultSpec("torn_donation", point="donation", times=1))

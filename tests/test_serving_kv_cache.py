@@ -1,11 +1,12 @@
 """Paged KV cache invariants (serving/kv_cache.py).
 
-Property test over random admit/grow/evict traces: the allocator never
-double-assigns a physical page, never hands out the trash page, and
-eviction returns the slot's full page set — free + assigned stays a
-partition of pages 1..n_pages-1 at every step. Device-side: bf16 pages
-round-trip bitwise, int8 pages round-trip within the per-block scale
-bound, and the int8 geometry's resident bytes beat bf16 by ≥1.7×.
+Property test over random admit/grow/evict/reserve/commit/abort traces:
+the allocator never double-assigns a physical page, never hands out the
+trash page, and eviction returns the slot's full page set — free +
+assigned + migration-reserved stays a partition of pages 1..n_pages-1
+at every step. Device-side: bf16 pages round-trip bitwise, int8 pages
+round-trip within the per-block scale bound, and the int8 geometry's
+resident bytes beat bf16 by ≥1.7×.
 """
 
 import numpy as np
@@ -28,18 +29,22 @@ def _cfg(**kw):
 
 
 def _check_partition(alloc, geom):
-    """free + assigned must partition pages 1..n_pages-1, trash excluded."""
+    """free + assigned + reserved must partition pages 1..n_pages-1,
+    trash excluded."""
     assigned = [
         int(p)
         for row in alloc._tables
         for p in row
         if p >= 0
     ]
-    assert len(assigned) == len(set(assigned)), "double-assigned page"
-    assert kvc.TRASH_PAGE not in assigned, "trash page handed out"
+    reserved = [int(p) for ps in alloc._reserved.values() for p in ps]
+    held = assigned + reserved
+    assert len(held) == len(set(held)), "double-assigned page"
+    assert kvc.TRASH_PAGE not in held, "trash page handed out"
     universe = set(range(1, geom.n_pages))
-    assert set(assigned) | set(alloc._free) == universe
-    assert set(assigned) & set(alloc._free) == set()
+    assert set(held) | set(alloc._free) == universe
+    assert set(held) & set(alloc._free) == set()
+    assert alloc.reserved_pages == len(reserved)
 
 
 def test_allocator_random_trace_property():
@@ -49,9 +54,13 @@ def test_allocator_random_trace_property():
     alloc = kvc.PageAllocator(geom, 4)
     rng = np.random.default_rng(0)
     held = [0, 0, 0, 0]  # tokens covered per slot
+    reservations = {}    # tag -> n_tokens reserved for migration
+    tag_seq = 0
     for _ in range(400):
         slot = int(rng.integers(0, 4))
-        op = rng.choice(["admit", "grow", "evict"])
+        op = rng.choice(
+            ["admit", "grow", "evict", "reserve", "commit", "abort"]
+        )
         if op == "admit" and held[slot] == 0:
             n = int(rng.integers(1, geom.max_len + 5))
             before = alloc.free_pages
@@ -79,11 +88,64 @@ def test_allocator_random_trace_property():
             assert freed == n_pages
             held[slot] = 0
             assert alloc.slot_pages(slot) == 0
+        elif op == "reserve":
+            tag = f"mig-{tag_seq}"
+            tag_seq += 1
+            n = int(rng.integers(1, geom.max_len + 5))
+            before = alloc.free_pages
+            ok = alloc.reserve_for_migration(tag, n)
+            assert ok == (
+                alloc.pages_needed(n) <= geom.max_pages_per_slot
+                and alloc.pages_needed(n) <= before
+            )
+            if ok:
+                reservations[tag] = n
+                assert len(alloc.reservation(tag)) == alloc.pages_needed(n)
+            else:
+                # failed reservation must not leak pages or leave a tag
+                assert alloc.free_pages == before
+                assert alloc.reservation(tag) == ()
+        elif op == "commit" and reservations and held[slot] == 0:
+            tag = next(iter(reservations))
+            n = reservations.pop(tag)
+            pages = alloc.commit_migration(tag, slot)
+            assert len(pages) == alloc.pages_needed(n)
+            assert alloc.slot_pages(slot) == len(pages)
+            held[slot] = n
+        elif op == "abort" and reservations:
+            tag = next(iter(reservations))
+            n = reservations.pop(tag)
+            before = alloc.free_pages
+            freed = alloc.abort_migration(tag)
+            assert freed == alloc.pages_needed(n)
+            assert alloc.free_pages == before + freed
         _check_partition(alloc, geom)
-    # drain: after evicting everything the free list is whole again
+    # drain: after aborting/evicting everything the free list is whole
+    for tag in list(reservations):
+        alloc.abort_migration(tag)
     for s in range(4):
         alloc.evict(s)
     assert alloc.free_pages == geom.n_pages - 1
+    assert alloc.reserved_pages == 0
+    _check_partition(alloc, geom)
+
+
+def test_reserve_commit_abort_edges():
+    geom = kvc.make_geometry(
+        _cfg(), n_slots=2, max_len=16, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    assert alloc.reserve_for_migration("a", 9)
+    with pytest.raises(ValueError):        # duplicate tag
+        alloc.reserve_for_migration("a", 1)
+    with pytest.raises(KeyError):          # unknown tag
+        alloc.commit_migration("ghost", 0)
+    assert alloc.admit(0, 5)
+    with pytest.raises(ValueError):        # occupied slot
+        alloc.commit_migration("a", 0)
+    pages = alloc.commit_migration("a", 1)
+    assert len(pages) == alloc.pages_needed(9) == alloc.slot_pages(1)
+    assert alloc.abort_migration("ghost") == 0   # abort is idempotent
     _check_partition(alloc, geom)
 
 

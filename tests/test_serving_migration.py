@@ -1,0 +1,344 @@
+"""Live KV-page migration (serving/migration.py).
+
+Fast tier: the wire blob round-trips every pool dtype bitwise and any
+truncation/corruption raises ``TornPageTransfer`` (never a silent
+partial import).
+
+Slow tier — the acceptance drills:
+
+- KILL 1 of 2 replicas mid-stream with sampled requests in flight on
+  both: the survivor adopts the victim's pages and resumes mid-decode
+  with ZERO re-prefilled prompt tokens (``stats()["prefill_tokens"]``
+  does not move) and every output bitwise equal to the never-evicted
+  ``generate.sample`` stream. No lost, no duplicated request.
+- Injected ``drop_page`` / ``stall_migration`` faults degrade to the
+  re-prefill tier: ``reshard_recovery path=fallback`` on the telemetry
+  hub, outputs still bitwise (position-indexed sampling), nothing lost
+  or duplicated.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.elastic import faults  # noqa: E402
+from dlrover_tpu.elastic.resharding import PhaseBudgets  # noqa: E402
+from dlrover_tpu.observability import telemetry  # noqa: E402
+from dlrover_tpu.serving import migration as mig  # noqa: E402
+from dlrover_tpu.serving.scheduler import SamplingParams  # noqa: E402
+
+# ------------------------------------------------------------------ wire
+
+
+def _snap(mode="int8"):
+    rng = np.random.default_rng(7)
+    if mode == "int8":
+        pages = {
+            "k_q": rng.integers(-127, 128, (2, 3, 4, 4, 8)).astype(np.int8),
+            "k_scale": rng.random((2, 3, 4, 4)).astype(np.float32),
+            "v_q": rng.integers(-127, 128, (2, 3, 4, 4, 8)).astype(np.int8),
+            "v_scale": rng.random((2, 3, 4, 4)).astype(np.float32),
+        }
+    else:
+        arr = jnp.asarray(
+            rng.standard_normal((2, 3, 4, 4, 8)), jnp.bfloat16
+        )
+        pages = {"k": np.asarray(arr), "v": np.asarray(arr) * 0 + 1}
+    return mig.RequestSnapshot(
+        rid="rep-1/r3", prompt=[5, 6, 7], generated=[8, 9],
+        n_prefilled=3, phase="decode", max_new_tokens=6, seed=11,
+        mode=mode, page_size=4, n_layers=2, kv_heads=4, head_dim=8,
+        kv_block=8, pages=pages,
+    )
+
+
+@pytest.mark.parametrize("mode", ["int8", "bf16"])
+def test_wire_roundtrip_bitwise(mode):
+    snap = _snap(mode)
+    out = mig.decode_snapshot(mig.encode_snapshot(snap))
+    assert out.rid == snap.rid
+    assert out.prompt == snap.prompt and out.generated == snap.generated
+    assert out.n_prefilled == 3 and out.phase == "decode"
+    assert out.seed == snap.seed and out.mode == mode
+    assert out.n_pages == 3
+    assert out.tokens_resident == 5  # prefill + generated compute saved
+    assert set(out.pages) == set(snap.pages)
+    for k in snap.pages:
+        assert out.pages[k].dtype == snap.pages[k].dtype
+        np.testing.assert_array_equal(
+            np.asarray(out.pages[k], np.float32),
+            np.asarray(snap.pages[k], np.float32),
+        )
+
+
+def test_torn_blobs_raise_not_partial_import():
+    blob = mig.encode_snapshot(_snap())
+    cases = {
+        "truncated payload": blob[:-7],
+        "truncated header": blob[: len(b"DTKV1\n") + 10],
+        "bad magic": b"XX" + blob,
+        "garbage": b"\x00" * 64,
+    }
+    for name, bad in cases.items():
+        with pytest.raises(mig.TornPageTransfer):
+            mig.decode_snapshot(bad)
+
+
+def test_bit_flip_in_payload_fails_checksum():
+    blob = bytearray(mig.encode_snapshot(_snap()))
+    blob[-3] ^= 0x40  # flip one payload bit
+    with pytest.raises(mig.TornPageTransfer, match="checksum"):
+        mig.decode_snapshot(bytes(blob))
+
+
+def test_dropped_page_is_retryable_by_the_ladder():
+    # both torn-transfer signals sit under TornDonation, the resharder's
+    # default retryable — a transient tear retries before falling back
+    assert issubclass(mig.TornPageTransfer, faults.TornDonation)
+    assert issubclass(faults.DroppedPage, faults.TornDonation)
+
+
+# ------------------------------------------------------------ acceptance
+
+
+_SERVER_KW = dict(
+    n_slots=4, max_len=32, page_size=4, mode="bf16", prefill_chunk=4,
+    idle_sleep=0.001,
+)
+
+
+@pytest.fixture(scope="module")
+def drill():
+    from dlrover_tpu.models import decoder, generate
+    from dlrover_tpu.models.config import get_config
+
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+        vocab_size=32, max_seq=64,
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    prompts = [[2, 3, 4, 2, 3], [9, 10, 9, 10], [5, 6, 7], [11, 3, 7, 1]]
+    max_new = [14, 14, 14, 14]
+    sps = [
+        SamplingParams(temperature=0.9, top_k=5, top_p=0.9, seed=i + 1)
+        for i in range(4)
+    ]
+    refs = [
+        [
+            int(t)
+            for t in np.asarray(
+                generate.sample(
+                    params, cfg, jnp.asarray([p], jnp.int32), m,
+                    rng=jax.random.key(sp.seed),
+                    temperature=sp.temperature, top_k=sp.top_k,
+                    top_p=sp.top_p,
+                )[0]
+            )
+        ]
+        for p, m, sp in zip(prompts, max_new, sps)
+    ]
+    return cfg, params, prompts, max_new, sps, refs
+
+
+@pytest.fixture
+def hub_events():
+    telemetry.reset_hub()
+    hub = telemetry.configure_hub()
+    events = []
+    hub.subscribe(events.append)
+    yield events
+    telemetry.reset_hub()
+
+
+def _mid_stream(rep, want):
+    """Every slot decoding with ≥1 generated token and unresolved."""
+    eng = rep.server.engine
+    slots = [s for s in eng.slots if s is not None]
+    return len(slots) == want and all(
+        s.phase == "decode"
+        and len(s.generated) >= 1
+        and not s.req.future.done()
+        for s in slots
+    )
+
+
+def _run_kill_drill(drill, migrator):
+    """Shared body: 2 replicas, 4 sampled requests (2 each), kill one
+    mid-stream, fail over through ``migrator``, gather everything.
+
+    The victim's loop is parked from the start and its engine stepped
+    BY HAND to a pinned mid-decode state before the kill — the jitted
+    decode rate (ms per token once warm) is far too fast to catch a
+    2-slot mid-stream window by polling wall clock."""
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    cfg, params, prompts, max_new, sps, refs = drill
+    r0 = ServingReplica("mig-0", params, cfg, node_id=0, **_SERVER_KW)
+    r1 = ServingReplica("mig-1", params, cfg, node_id=1, **_SERVER_KW)
+    r0.start()
+    r1.start()
+    try:
+        router = ReplicaRouter([r0, r1], migrator=migrator)
+        with r1.server.paused() as eng1:
+            reqs = [
+                router.submit(p, m, sampling=sp)
+                for p, m, sp in zip(prompts, max_new, sps)
+            ]
+            # round-robin put requests 1 and 3 on the victim r1
+            assert [e.replica.name for e in router._entries] == [
+                "mig-0", "mig-1", "mig-0", "mig-1",
+            ]
+            # drive the parked victim to mid-stream: both slots in
+            # decode with >= 1 sampled token and unresolved futures
+            for _ in range(50):
+                if _mid_stream(r1, 2):
+                    break
+                eng1.step()
+            assert _mid_stream(r1, 2), "victim never reached mid-stream"
+            r1.kill()
+        assert not r1.alive and r0.alive
+        # the survivor finishes its own two requests first, so its
+        # prefill counter is final before the failover lands on it
+        for r in (reqs[0], reqs[2]):
+            r.future.result(timeout=300)
+        base_prefill = r0.server.engine.stats()["prefill_tokens"]
+        moved = router.poll()
+        report = router.reports[-1]
+        outs = router.wait_all(timeout=600)
+        return r0, r1, reqs, outs, report, base_prefill, moved, refs
+    finally:
+        r0.stop()
+        r1.kill()
+
+
+@pytest.mark.slow
+def test_migration_drill_zero_reprefill_bitwise(drill, hub_events):
+    migrator = mig.ServingMigrator()
+    r0, r1, reqs, outs, report, base_prefill, moved, refs = _run_kill_drill(
+        drill, migrator
+    )
+    # the live path carried both victim requests; nothing degraded
+    assert report.path == "live"
+    assert len(report.placements) == 2 and moved == 2
+    assert report.re_prefilled == {} and report.re_routed == {}
+    assert report.directive_version >= 1
+    assert report.bytes_moved > 0
+    assert report.tokens_saved >= 2 * (3 + 1)  # ≥ prompt+1 per request
+
+    s0, s1 = r0.server.engine.stats(), r1.server.engine.stats()
+    # ZERO re-prefilled prompt tokens: the survivor's prefill counter
+    # did not move across the failover
+    assert s0["prefill_tokens"] == base_prefill
+    assert s0["migrated_in"] == 2 and s1["migrated_out"] == 2
+
+    # bitwise equal to the never-evicted stream, every request
+    assert outs == refs
+    # no lost request, no duplicate: 4 completions, all on the survivor,
+    # none through the re-admit (re-prefill) path
+    assert r0.server.scheduler.completed == 4
+    assert r1.server.scheduler.completed == 0
+    assert r0.server.scheduler.re_admitted == 0
+    assert all(r.future.done() for r in reqs)
+    # telemetry: the ladder closed on the live path
+    recovery = [e for e in hub_events if e.kind == "reshard_recovery"]
+    assert recovery and "path=live" in recovery[-1].detail
+
+
+def _fault_migrator(kind):
+    inj = faults.FaultInjector()
+    if kind == "torn":
+        # every transfer attempt tears: retries exhaust, then fallback
+        inj.install(
+            faults.FaultSpec("drop_page", point="serving.transfer")
+        )
+        budgets = PhaseBudgets()
+    else:
+        # stall the transfer past its (tiny) budget: deadline exceeded
+        inj.install(
+            faults.FaultSpec(
+                "stall_migration", point="serving.transfer", delay_s=0.3
+            )
+        )
+        budgets = PhaseBudgets(transfer_s=0.05)
+    return mig.ServingMigrator(budgets=budgets, faults=inj, retries=1)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["torn", "stall"])
+def test_faulted_migration_degrades_to_reprefill(drill, hub_events, kind):
+    migrator = _fault_migrator(kind)
+    r0, r1, reqs, outs, report, base_prefill, moved, refs = _run_kill_drill(
+        drill, migrator
+    )
+    # the ladder degraded: no live placement, both requests re-prefilled
+    assert report.path == "fallback"
+    assert report.placements == {}
+    assert len(report.re_prefilled) == 2 and moved == 2
+
+    s0 = r0.server.engine.stats()
+    assert s0["migrated_in"] == 0
+    assert s0["prefill_tokens"] > base_prefill  # prompts were redone
+
+    # degradation is invisible in the output: position-indexed sampling
+    # makes the re-prefilled continuation bitwise too
+    assert outs == refs
+    # no lost, no duplicated request
+    assert r0.server.scheduler.completed == 4
+    assert r0.server.scheduler.re_admitted == 2
+    assert all(r.future.done() for r in reqs)
+    # the survivor holds no leaked reservation pages
+    assert r0.server.engine.alloc.reserved_pages == 0
+    recovery = [e for e in hub_events if e.kind == "reshard_recovery"]
+    assert recovery and "path=fallback" in recovery[-1].detail
+
+
+@pytest.mark.slow
+def test_wait_all_backoff_with_slow_straggler(drill):
+    """Regression for the router's 50 ms busy-spin: with one straggler
+    still decoding, ``wait_all`` polls with jittered backoff — the poll
+    count stays far below the old spin's (duration / 50 ms) — and a
+    per-request ``deadline_s`` tighter than the straggler's runtime
+    raises instead of waiting forever."""
+    import concurrent.futures
+
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    cfg, params, prompts, max_new, sps, refs = drill
+    rep = ServingReplica("strag-0", params, cfg, node_id=0, **_SERVER_KW)
+    rep.start()
+    try:
+        router = ReplicaRouter([rep])
+        quick = router.submit(prompts[0], 2)
+        slow = router.submit(prompts[1], 14)
+        polls = {"n": 0}
+        orig = router.poll
+
+        def counting_poll():
+            polls["n"] += 1
+            return orig()
+
+        router.poll = counting_poll
+        t0 = time.monotonic()
+        outs = router.wait_all(timeout=600)
+        waited = time.monotonic() - t0
+        assert len(outs) == 2
+        assert quick.future.done() and slow.future.done()
+        # jittered backoff, not a 50 ms spin: the old loop would have
+        # polled ~ waited/0.05 times; the backoff loop stays well under
+        spin_polls = max(waited / 0.05, 1.0)
+        assert polls["n"] < spin_polls / 2, (polls["n"], waited)
+
+        # per-request deadline: tighter than the work, raises promptly.
+        # The server is parked so the (now jit-warm, ms-fast) request
+        # cannot win the race against its own 1 ms deadline.
+        with rep.server.paused():
+            doomed = router.submit(prompts[2], 14, deadline_s=0.001)
+            with pytest.raises(concurrent.futures.TimeoutError):
+                router.wait_all(timeout=600)
+            assert not doomed.future.done()
+    finally:
+        rep.stop()

