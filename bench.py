@@ -680,6 +680,14 @@ def serving_trajectory_metric(path=None):
         "p99_target_ms": artifact.get("p99_target_ms"),
         "p99_met": artifact.get("p99_met"),
     }
+    # phase-latency axes (histogram-backed benches only — older
+    # artifacts predate them, so project only when present)
+    for key in (
+        "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms",
+        "queue_wait_p99_ms",
+    ):
+        if artifact.get(key) is not None:
+            out[key] = artifact[key]
     spec = artifact.get("speculative")
     if spec:
         out["spec_tokens_per_s"] = spec.get("tokens_per_s")
@@ -911,7 +919,7 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
             for f in futs:
                 f.result(timeout=600.0)
             dt = time.perf_counter() - t0
-            lat = srv.scheduler.latency_ms()
+            lat = srv.scheduler.latency_summary()
             stats = srv.engine.stats()
             geom = srv.engine.geom
         finally:
@@ -941,6 +949,14 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
         "serve_p99_ms": round(lat["p99"], 2),
         "p99_target_ms": p99_target_ms,
         "p99_met": lat["p99"] <= p99_target_ms,
+        # per-phase latency from the scheduler's log-bucketed
+        # histograms (observability/histogram.py) — TTFT/TPOT are the
+        # interactive-serving SLO axes e2e alone can't resolve
+        "ttft_p50_ms": round(lat["ttft_p50_ms"], 2),
+        "ttft_p99_ms": round(lat["ttft_p99_ms"], 2),
+        "tpot_p50_ms": round(lat["tpot_p50_ms"], 2),
+        "tpot_p99_ms": round(lat["tpot_p99_ms"], 2),
+        "queue_wait_p99_ms": round(lat["queue_wait_p99_ms"], 2),
         "n_requests": n_requests,
         "max_new_tokens": max_new,
         "decode_kernel": eng_stats["decode_kernel"],

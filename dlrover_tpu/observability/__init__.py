@@ -18,6 +18,10 @@ merged timeline covers train step → checkpoint → failover across the
 worker, agent and master processes.
 """
 
+from dlrover_tpu.observability.histogram import (
+    LatencyHistogram,
+    merge_histograms,
+)
 from dlrover_tpu.observability.loss_spike import LossSpikeDetector
 from dlrover_tpu.observability.numeric import (
     GradSanitizer,
@@ -95,6 +99,9 @@ __all__ = [
     "OverlapDriftRecord",
     "StragglerRecord",
     "ResourceRecord",
+    # latency histograms
+    "LatencyHistogram",
+    "merge_histograms",
     # tracing
     "Tracer",
     "NullTracer",

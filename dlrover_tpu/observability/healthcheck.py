@@ -43,9 +43,11 @@ def diagnose(records: List, world: int = 0) -> Dict:
     """Correlate a record stream into a diagnosis dict.
 
     Keys: ``steps`` (count / last step / last loss), ``anomalies``
-    (per-kind: first bad step, failing ranks, verdict, captures),
-    ``numeric_events``, ``elastic_events``, ``summaries`` (recorded
-    HealthSummary lines), ``healthy``.
+    (per-kind: first bad step, failing ranks, verdict, captures,
+    breaching replicas for serving kinds), ``numeric_events``,
+    ``elastic_events``, ``summaries`` (recorded HealthSummary lines),
+    ``serving`` (per-replica latest window + fleet percentiles merged
+    from the recorded histogram envelopes), ``healthy``.
     """
     by_type: Dict[str, List] = {}
     for rec in records:
@@ -87,7 +89,12 @@ def diagnose(records: List, world: int = 0) -> Dict:
             "verdict": summary.verdict if summary else "",
             "captures": sorted({r.capture for r in recs if r.capture}),
             "detail": first.detail,
+            "replicas": sorted(
+                {r.replica for r in recs if getattr(r, "replica", "")}
+            ),
         }
+
+    serving = _serving_section(by_type)
 
     steps = by_type.get("StepRecord", [])
     step_info = {}
@@ -124,8 +131,61 @@ def diagnose(records: List, world: int = 0) -> Dict:
             }
             for s in by_type.get("HealthSummary", [])
         ],
+        "serving": serving,
         "healthy": not anomalies,
     }
+
+
+def _serving_section(by_type: Dict[str, List]) -> Dict:
+    """Roll ServingRecord lines into per-replica windows + fleet
+    percentiles.
+
+    The LAST record per replica wins (counters are lifetime, the
+    percentiles describe the latest window). Fleet percentiles are
+    merged from each replica's recorded ``hists`` envelope — exact
+    bucket-count addition, never averaging of per-replica percentiles.
+    """
+    recs = by_type.get("ServingRecord", [])
+    if not recs:
+        return {}
+    latest: Dict[str, object] = {}
+    for rec in recs:  # file order == write order; last one wins
+        latest[rec.replica] = rec
+    replicas = {}
+    for name in sorted(latest):
+        r = latest[name]
+        dropped = r.shed + r.rejected + r.timed_out + r.poisoned
+        replicas[name] = {
+            "completed": r.completed,
+            "admitted": r.admitted,
+            "dropped": dropped,
+            "p99_ms": r.p99_ms,
+            "ttft_p99_ms": r.ttft_p99_ms,
+            "tpot_p99_ms": r.tpot_p99_ms,
+            "queue_wait_p99_ms": r.queue_wait_p99_ms,
+            "tokens_per_s": r.tokens_per_s,
+        }
+    fleet = {}
+    try:
+        from dlrover_tpu.observability.histogram import (
+            LatencyHistogram, merge_histograms,
+        )
+
+        per_phase: Dict[str, List] = {}
+        for r in latest.values():
+            if not r.hists:
+                continue
+            for phase, env in json.loads(r.hists).items():
+                per_phase.setdefault(phase, []).append(
+                    LatencyHistogram.from_dict(env)
+                )
+        for phase, hists in sorted(per_phase.items()):
+            merged = merge_histograms(hists)
+            if merged is not None and merged.n:
+                fleet[phase] = merged.summary()
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+        pass  # torn/foreign envelope: per-replica view still stands
+    return {"replicas": replicas, "fleet": fleet}
 
 
 def format_report(diag: Dict) -> str:
@@ -137,6 +197,22 @@ def format_report(diag: Dict) -> str:
             "(loss {last_loss:.4f})".format(**diag["steps"])
         )
     lines.append(f"world: {diag['world'] or 'unknown'} rank(s)")
+    serving = diag.get("serving") or {}
+    if serving:
+        lines.append("")
+        lines.append("serving replicas:")
+        for name, info in serving["replicas"].items():
+            lines.append(
+                f"  {name}: completed {info['completed']}/"
+                f"{info['admitted']} admitted, dropped {info['dropped']}; "
+                f"p99 {info['p99_ms']:.1f}ms "
+                f"ttft_p99 {info['ttft_p99_ms']:.1f}ms"
+            )
+        for phase, s in serving.get("fleet", {}).items():
+            lines.append(
+                f"  fleet {phase}: p50 {s['p50']:.1f}ms "
+                f"p99 {s['p99']:.1f}ms (n={s['n']})"
+            )
     if diag["healthy"]:
         lines.append("no anomalies recorded — run looks healthy")
         return "\n".join(lines)
@@ -148,6 +224,10 @@ def format_report(diag: Dict) -> str:
             f"first bad step {info['first_step']}; "
             f"failing rank(s) {ranks}"
         )
+        if info.get("replicas"):
+            lines.append(
+                "  breaching replica(s): " + ",".join(info["replicas"])
+            )
         if info["verdict"]:
             lines.append(f"  verdict: {info['verdict']}")
         if info["detail"]:

@@ -213,8 +213,12 @@ class AnomalyRecord:
 
     ``kind`` is one of observability.watchdog.ANOMALY_KINDS
     (nan_grads | loss_spike | fp8_saturation | step_time_regression |
-    straggler).  ``capture`` is the path of the triggered-capture
-    artifact when the rate limiter granted one, else ""."""
+    straggler) or watchdog.SERVING_ANOMALY_KINDS (slo_breach |
+    ttft_regression | spec_accept_collapse | shed_storm |
+    migration_fallback).  ``capture`` is the path of the
+    triggered-capture artifact when the rate limiter granted one, else
+    "".  ``replica`` names the serving replica for serving kinds
+    ("" for training anomalies)."""
 
     kind: str = ""
     step: int = -1
@@ -222,6 +226,7 @@ class AnomalyRecord:
     value: float = 0.0
     detail: str = ""
     capture: str = ""
+    replica: str = ""
     ts: float = 0.0
 
 
@@ -264,7 +269,23 @@ class ServingRecord:
     ``migrated_out`` are lifetime counts of requests this engine
     imported/exported as live KV pages; ``shed`` counts queued new
     admissions failed with a retry-after hint to protect a migration
-    under page pressure."""
+    under page pressure.
+
+    Phase latencies (observability/histogram.py): ``ttft_*`` is
+    time-to-first-token (submit → first emitted token), ``tpot_*`` is
+    time-per-output-token (mean inter-token ms within a request),
+    ``queue_wait_p99_ms`` is enqueue → engine admission.  ``hists`` is
+    the JSON-encoded envelope of all four per-phase histograms
+    ({"e2e","ttft","tpot","queue_wait"} → LatencyHistogram.to_dict()) —
+    a *string* field so the record stays scalar-only on the wire; the
+    router/master parse it to merge fleet percentiles from counts
+    rather than averaging per-replica percentiles.
+
+    Drop accounting (goodput vs offered load): ``rejected`` counts
+    admission failures (queue at capacity + oversize requests),
+    ``timed_out`` counts per-request deadline expiries, ``poisoned``
+    counts requests failed for invalid sampling parameters; together
+    with ``shed`` every dropped request is in exactly one counter."""
 
     replica: str = ""
     active_slots: int = 0
@@ -281,6 +302,15 @@ class ServingRecord:
     shed: int = 0
     migrated_in: int = 0
     migrated_out: int = 0
+    ttft_p50_ms: float = 0.0
+    ttft_p99_ms: float = 0.0
+    tpot_p50_ms: float = 0.0
+    tpot_p99_ms: float = 0.0
+    queue_wait_p99_ms: float = 0.0
+    rejected: int = 0
+    timed_out: int = 0
+    poisoned: int = 0
+    hists: str = ""
     ts: float = 0.0
 
 
@@ -343,6 +373,14 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("serving_shed", "shed"),
         ("serving_migrated_in", "migrated_in"),
         ("serving_migrated_out", "migrated_out"),
+        ("serving_ttft_p50_ms", "ttft_p50_ms"),
+        ("serving_ttft_p99_ms", "ttft_p99_ms"),
+        ("serving_tpot_p50_ms", "tpot_p50_ms"),
+        ("serving_tpot_p99_ms", "tpot_p99_ms"),
+        ("serving_queue_wait_p99_ms", "queue_wait_p99_ms"),
+        ("serving_rejected", "rejected"),
+        ("serving_timed_out", "timed_out"),
+        ("serving_poisoned", "poisoned"),
     ],
 }
 _COUNTER_MAP: Dict[str, str] = {

@@ -184,6 +184,26 @@ class Tracer:
     def end(self, span: Span, **extra) -> float:
         return span.end(**extra)
 
+    def complete_span(self, name: str, t0_mono: float, **args) -> float:
+        """Emit a complete ("X") event back-dated to a monotonic start.
+
+        For intervals whose start was stamped before a span could be
+        opened — e.g. queue wait, measured from ``Request.submit_t``
+        (taken on the submitting user thread) to admission (on the
+        engine loop thread). Returns the duration in seconds."""
+        now = time.monotonic()
+        dur_s = max(0.0, now - t0_mono)
+        self._record(
+            {
+                "name": name,
+                "ph": "X",
+                "ts": self._now_us() - dur_s * 1e6,
+                "dur": dur_s * 1e6,
+                "args": args,
+            }
+        )
+        return dur_s
+
     def instant(self, name: str, **args) -> None:
         """A zero-duration marker event."""
         self._record(
@@ -265,6 +285,9 @@ class NullTracer:
     begin = span
 
     def end(self, span, **extra) -> float:
+        return 0.0
+
+    def complete_span(self, name: str, t0_mono: float, **args) -> float:
         return 0.0
 
     def instant(self, name: str, **args) -> None:

@@ -73,6 +73,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dlrover_tpu.models import decoder, generate
+from dlrover_tpu.observability.tracing import get_tracer
 from dlrover_tpu.ops import pallas_paged, quant
 from dlrover_tpu.serving import kv_cache as kvc
 from dlrover_tpu.serving.scheduler import AdmissionError, Request, Scheduler
@@ -132,6 +133,7 @@ class _Slot:
     key_data: np.ndarray        # uint32 [2] — threefry key for sampling
     n_prefilled: int = 0
     generated: List[int] = field(default_factory=list)
+    span: object = None         # open "serving.decode" trace span, if any
 
 
 class ServingEngine:
@@ -459,6 +461,41 @@ class ServingEngine:
     def resident_kv_bytes(self) -> int:
         return kvc.resident_bytes(self.geom)
 
+    def observability_snapshot(self) -> dict:
+        """The state the serving watchdog freezes into a capture
+        artifact when an SLO anomaly fires: the engine's wall-time
+        phase split, the scheduler's depth + drop counters, and the
+        PageAllocator's occupancy — enough to tell 'engine got slow'
+        from 'queue backed up' from 'out of pages'."""
+        es = self.stats()
+        return {
+            "phase_split": {
+                "step_time_s": round(es["step_time_s"], 4),
+                "host_time_s": round(es["host_time_s"], 4),
+                "table_ships": es["table_ships"],
+            },
+            "scheduler": {
+                "queue_depth": self.scheduler.queue_depth(),
+                "admitted": self.scheduler.admitted,
+                "completed": self.scheduler.completed,
+                "shed": self.scheduler.shed,
+                "rejected": self.scheduler.rejected,
+                "timed_out": self.scheduler.timed_out,
+                "poisoned": self.scheduler.poisoned,
+            },
+            "allocator": {
+                "free_pages": self.alloc.free_pages,
+                "reserved_pages": self.alloc.reserved_pages,
+                "n_pages": self.geom.n_pages,
+                "pages_per_slot": [
+                    self.alloc.slot_pages(i) for i in range(self.n_slots)
+                ],
+            },
+            "active_slots": es["active_slots"],
+            "tokens_per_s": round(es["tokens_per_s"], 2),
+            "spec_accept_rate": round(es["spec_accept_rate"], 4),
+        }
+
     # ---- device-side inputs ----------------------------------------------
 
     def _device_tables(self):
@@ -529,6 +566,9 @@ class ServingEngine:
             if not self._slot_done(s):
                 continue
             req = s.req
+            if s.span is not None:
+                s.span.end(tokens=len(s.generated), reason="completed")
+                s.span = None
             self.scheduler.complete(
                 req, [int(t) for t in s.prompt] + s.generated
             )
@@ -558,6 +598,7 @@ class ServingEngine:
             if req is None:
                 return worked
             if req.total_tokens > self.geom.max_len:
+                self.scheduler.count_rejected()
                 self.scheduler.fail(req, AdmissionError(
                     f"request {req.rid} needs {req.total_tokens} tokens "
                     f"> slot capacity {self.geom.max_len}"
@@ -579,6 +620,7 @@ class ServingEngine:
                         f"params: {exc}"
                     )
                 )
+                self.scheduler.count_poisoned()
                 self.scheduler.fail(req, err)
                 continue
             # reserve the FULL prompt+generation footprint up front so a
@@ -589,6 +631,14 @@ class ServingEngine:
                 prompt=np.asarray(req.prompt, np.int32),
                 key_data=key_data,
             )
+            self.scheduler.record_admitted(req)
+            tr = get_tracer()
+            if tr.enabled:
+                tr.instant(
+                    "serving.admit", rid=req.rid,
+                    replica=self.scheduler.replica, slot=idx,
+                    re_admits=req.re_admits,
+                )
             worked = True
 
     # ---- live KV-page migration (serving/migration.py) -------------------
@@ -609,8 +659,12 @@ class ServingEngine:
         """Drop a slot whose request migrated out: free its pages
         without resolving the request's future (the survivor owns the
         request now)."""
-        if self.slots[i] is None:
+        s = self.slots[i]
+        if s is None:
             return
+        if s.span is not None:
+            s.span.end(tokens=len(s.generated), reason="migrated_out")
+            s.span = None
         self.alloc.evict(i)
         self.slots[i] = None
         self._migrated_out += 1
@@ -675,7 +729,7 @@ class ServingEngine:
         key_data = np.asarray(
             jax.random.key_data(jax.random.key(int(req.sampling.seed)))
         )
-        self.slots[idx] = _Slot(
+        slot = _Slot(
             req=req,
             phase=phase,
             prompt=np.asarray(req.prompt, np.int32),
@@ -683,6 +737,13 @@ class ServingEngine:
             n_prefilled=int(n_prefilled),
             generated=[int(t) for t in generated],
         )
+        tr = get_tracer()
+        if tr.enabled and phase == "decode":
+            slot.span = tr.begin(
+                "serving.decode", rid=req.rid,
+                replica=self.scheduler.replica, slot=idx, resumed=True,
+            )
+        self.slots[idx] = slot
         if self._t0 is None:
             self._t0 = time.monotonic()
         self._migrated_in += 1
@@ -720,6 +781,14 @@ class ServingEngine:
             chunk = np.zeros(self.prefill_chunk, np.int32)
             chunk[:clen] = s.prompt[s.n_prefilled:s.n_prefilled + clen]
             tables = self._device_tables()[i:i + 1]
+            tr = get_tracer()
+            sp = None
+            if tr.enabled:
+                sp = tr.begin(
+                    "serving.prefill_chunk", rid=s.req.rid,
+                    replica=self.scheduler.replica, slot=i,
+                    start=s.n_prefilled, tokens=clen,
+                )
             t0 = time.monotonic()
             tok0, self.pools = self._chunk_fn(
                 self.params, self.pools, tables,
@@ -731,6 +800,8 @@ class ServingEngine:
             )
             tok0 = np.asarray(tok0)
             self._step_time += time.monotonic() - t0
+            if sp is not None:
+                sp.end()
             s.n_prefilled += clen
             self._prefill_tokens += clen
             if s.n_prefilled == p:
@@ -738,6 +809,13 @@ class ServingEngine:
                 s.phase = "decode"
                 self.scheduler.record_first_token(s.req)
                 self._tokens += 1
+                if tr.enabled:
+                    # the long occupancy span: first token → finish or
+                    # migrate-out; the survivor re-opens it resumed=True
+                    s.span = tr.begin(
+                        "serving.decode", rid=s.req.rid,
+                        replica=self.scheduler.replica, slot=i,
+                    )
             return True
         return False
 
@@ -813,6 +891,14 @@ class ServingEngine:
             n_draft[i] = len(drafts)
         if not n_draft.any():
             return self._decode_batch()
+        tr = get_tracer()
+        sp = None
+        if tr.enabled:
+            sp = tr.begin(
+                "serving.spec_verify", replica=self.scheduler.replica,
+                n_live=len(live), drafts=int(n_draft.sum()),
+                rids=",".join(self.slots[i].req.rid for i in live),
+            )
         t0 = time.monotonic()
         tgt, n_emit, self.pools = self._verify_fn(
             self.params, self.pools, self._device_tables(),
@@ -824,6 +910,8 @@ class ServingEngine:
         tgt = np.asarray(tgt)
         n_emit = np.asarray(n_emit)
         self._step_time += time.monotonic() - t0
+        if sp is not None:
+            sp.end(emitted=int(n_emit.sum()))
         for i in live:
             s = self.slots[i]
             n = int(n_emit[i])

@@ -73,6 +73,7 @@ from dlrover_tpu.elastic.resharding import (
     PhaseBudgets,
     ReshardOutcome,
 )
+from dlrover_tpu.observability.tracing import get_tracer
 from dlrover_tpu.serving.scheduler import AdmissionError, Request
 
 logger = get_logger(__name__)
@@ -428,17 +429,33 @@ class ServingMigrator:
 
         def transfer(assignments):
             eng = victim.server.engine
+            tr = get_tracer()
             ctx["bytes"] = 0
             for a in assignments:
+                sp = None
+                if tr.enabled:
+                    sp = tr.begin(
+                        "serving.migrate_transfer", rid=a.req.rid,
+                        victim=victim.name, survivor=a.survivor.name,
+                    )
                 snap = snapshot_slot(eng, a.slot)
                 blob = encode_snapshot(snap)
                 self.faults.at("serving.transfer", rank=victim.node_id)
                 a.snap = decode_snapshot(blob)
                 ctx["bytes"] += len(blob)
+                if sp is not None:
+                    sp.end(bytes=len(blob))
             return assignments
 
         def resume(assignments):
+            tr = get_tracer()
             for a in assignments:
+                sp = None
+                if tr.enabled:
+                    sp = tr.begin(
+                        "serving.migrate_resume", rid=a.req.rid,
+                        victim=victim.name, survivor=a.survivor.name,
+                    )
                 self.faults.at("serving.resume", rank=a.survivor.node_id)
                 snap = a.snap
                 try:
@@ -463,10 +480,14 @@ class ServingMigrator:
                         eng.alloc.abort_migration(a.req.rid)
                     a.survivor.server.re_admit(a.req)
                     ctx["re_prefilled"][a.req.rid] = a.survivor.name
+                    if sp is not None:
+                        sp.end(path="re_prefill")
                 else:
                     a.resumed = True
                     ctx["placements"][a.req.rid] = a.survivor.name
                     ctx["tokens_saved"] += snap.tokens_resident
+                    if sp is not None:
+                        sp.end(path="live")
                 victim.server.engine.release_slot(a.slot)
             self._route_queued(ctx, survivors, rr)
             return assignments

@@ -146,15 +146,48 @@ class ReplicaRouter:
         self,
         replicas: List[ServingReplica],
         migrator=None,
+        watchdog=None,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = list(replicas)
         self.migrator = migrator  # ServingMigrator or None (re-admit path)
+        # optional ServingWatchdog: fed every MigrationReport so a run
+        # of fallback outcomes classifies as ``migration_fallback``
+        self.watchdog = watchdog
         self._entries: List[_Entry] = []
         self._rr = 0
         self._lock = threading.Lock()
         self.reports: List = []   # MigrationReports, drill introspection
+
+    # ---- fleet latency rollup -------------------------------------------
+
+    def fleet_histograms(self) -> Dict:
+        """Merge every replica's per-phase latency histograms
+        bucket-by-bucket (observability/histogram.py) — dead replicas
+        included, their schedulers outlive the serve loop. Because the
+        bucket boundaries are fixed by geometry, the merged counts are
+        IDENTICAL to histogramming the concatenated raw samples: fleet
+        percentiles come from counts, never from averaging per-replica
+        percentiles."""
+        from dlrover_tpu.observability.histogram import merge_histograms
+        from dlrover_tpu.serving.scheduler import LATENCY_PHASES
+
+        per = [r.server.scheduler.histograms() for r in self.replicas]
+        out = {}
+        for k in LATENCY_PHASES:
+            merged = merge_histograms(p[k] for p in per)
+            if merged is not None:
+                out[k] = merged
+        return out
+
+    def fleet_latency_ms(self) -> dict:
+        """Fleet end-to-end percentiles in the scheduler's
+        ``{p50, p99, n}`` shape, from the merged histogram."""
+        hists = self.fleet_histograms()
+        if "e2e" not in hists:
+            return {"p50": 0.0, "p99": 0.0, "n": 0}
+        return hists["e2e"].summary()
 
     def _mark_done(self, entry: _Entry):
         def _cb(_future):
@@ -225,6 +258,8 @@ class ReplicaRouter:
         repoint every entry it placed. Caller holds ``_lock``."""
         report = self.migrator.migrate(victim, live)
         self.reports.append(report)
+        if self.watchdog is not None:
+            self.watchdog.observe_migration(report, replica=victim.name)
         by_name = {r.name: r for r in live}
         placed = {}
         placed.update(report.placements)
@@ -276,6 +311,7 @@ class ReplicaRouter:
                 self.poll()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 and not req.future.done():
+                    entry.replica.server.scheduler.count_timed_out()
                     raise concurrent.futures.TimeoutError(
                         f"request {req.rid} missed its deadline"
                     )

@@ -11,15 +11,37 @@ Re-admission (``re_admit``) keeps a request's ORIGINAL arrival ticket:
 a request bumped by allocator pressure or replica failover re-enters
 ahead of later arrivals instead of going to the back of the line — the
 elastic story's no-starvation guarantee.
+
+Latency accounting is four mergeable log-bucketed histograms
+(observability/histogram.py), one per phase:
+
+- ``e2e``       — submit → complete, the classic request latency;
+- ``ttft``      — submit → first emitted token (prefill + queue);
+- ``tpot``      — mean inter-token ms within one request (decode pace);
+- ``queue_wait``— (re-)enqueue → engine admission.
+
+Histograms replace the old truncating flat list: O(1) record, no
+window bias under sustained load, and the router/master merge replica
+histograms bucket-by-bucket so fleet percentiles are computed from
+counts, never from averaged per-replica percentiles. Every dropped
+request lands in exactly one of ``shed`` / ``rejected`` /
+``timed_out`` / ``poisoned`` so goodput vs offered load is computable.
 """
 
 import heapq
 import itertools
+import json
 import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+from dlrover_tpu.observability.histogram import LatencyHistogram
+from dlrover_tpu.observability.tracing import get_tracer
+
+#: phase keys of the scheduler's latency histograms, in envelope order
+LATENCY_PHASES = ("e2e", "ttft", "tpot", "queue_wait")
 
 
 class AdmissionError(ValueError):
@@ -83,6 +105,7 @@ class Request:
     priority: int = 0
     arrival: int = 0            # admission ticket, stable across re-admits
     submit_t: float = 0.0
+    last_enqueue_t: float = 0.0  # refreshed on re-admit (queue-wait base)
     first_token_t: float = 0.0  # 0 until the prefill emits token 0
     done_t: float = 0.0
     deadline_s: Optional[float] = None  # wall budget from submit_t, if any
@@ -116,13 +139,20 @@ class Scheduler:
         self.max_queue = max_queue
         self.hub = hub
         self.replica = replica
-        self._latencies_ms: List[float] = []
+        # max_latencies is kept for signature compatibility only: the
+        # histograms are O(1)-bounded by geometry, not by sample count
         self._max_latencies = max_latencies
+        self._hists: Dict[str, LatencyHistogram] = {
+            k: LatencyHistogram() for k in LATENCY_PHASES
+        }
         self._done_ts: List[float] = []  # recent completion times, for hints
         self.admitted = 0
         self.completed = 0
         self.re_admitted = 0
         self.shed = 0
+        self.rejected = 0   # admission failures: capacity + oversize
+        self.timed_out = 0  # per-request deadline expiries
+        self.poisoned = 0   # invalid sampling parameters
 
     # ---- intake ----------------------------------------------------------
 
@@ -139,11 +169,13 @@ class Scheduler:
             raise ValueError("max_new_tokens must be >= 1")
         with self._lock:
             if len(self._heap) >= self.max_queue:
+                self.rejected += 1
                 raise AdmissionError(
                     f"queue at capacity ({self.max_queue}); retry later",
                     retry_after_s=self._retry_after_locked(),
                 )
             arrival = next(self._ticket)
+            now = time.monotonic()
             req = Request(
                 rid=f"{self.replica}/r{arrival}",
                 prompt=[int(t) for t in prompt],
@@ -151,7 +183,8 @@ class Scheduler:
                 eos_id=eos_id,
                 priority=int(priority),
                 arrival=arrival,
-                submit_t=time.monotonic(),
+                submit_t=now,
+                last_enqueue_t=now,
                 deadline_s=deadline_s,
                 sampling=sampling or SamplingParams(),
             )
@@ -169,11 +202,18 @@ class Scheduler:
         was already admitted once. Marks the request shed-exempt."""
         with self._lock:
             req.re_admits += 1
+            req.last_enqueue_t = time.monotonic()
             heapq.heappush(
                 self._heap,
                 (req.priority, req.arrival, next(self._seq), req),
             )
             self.re_admitted += 1
+        tr = get_tracer()
+        if tr.enabled:
+            tr.instant(
+                "serving.re_admit", rid=req.rid, replica=self.replica,
+                re_admits=req.re_admits,
+            )
 
     # ---- overload degradation --------------------------------------------
 
@@ -240,34 +280,100 @@ class Scheduler:
     def pop_next(self, can_admit=None) -> Optional[Request]:
         """Pop the highest-priority request, or None when empty or when
         ``can_admit(req)`` rejects the head (head-of-line admission:
-        lower-ranked requests never jump a head waiting on pages)."""
+        lower-ranked requests never jump a head waiting on pages).
+        Requests whose wall deadline already expired in the queue are
+        failed fast (counted ``timed_out``) instead of burning slot
+        time on an answer nobody is waiting for."""
+        expired: List[Request] = []
+        got: Optional[Request] = None
         with self._lock:
+            now = time.monotonic()
             while self._heap:
                 req = self._heap[0][-1]
                 if req.future.cancelled():
                     heapq.heappop(self._heap)
                     continue
+                if (
+                    req.deadline_s is not None
+                    and now - req.submit_t > req.deadline_s
+                ):
+                    heapq.heappop(self._heap)
+                    self.timed_out += 1
+                    expired.append(req)
+                    continue
                 if can_admit is not None and not can_admit(req):
-                    return None
+                    break
                 heapq.heappop(self._heap)
-                return req
-        return None
+                got = req
+                break
+        for req in expired:
+            self.fail(
+                req,
+                AdmissionError(
+                    f"{req.rid} deadline ({req.deadline_s}s) expired "
+                    f"in queue"
+                ),
+            )
+        return got
+
+    def record_admitted(self, req: Request) -> None:
+        """Engine-side admission hook: close the queue-wait interval
+        (enqueue → admission) into the histogram and the trace."""
+        t0 = req.last_enqueue_t or req.submit_t
+        wait_ms = max(0.0, (time.monotonic() - t0) * 1e3)
+        with self._lock:
+            self._hists["queue_wait"].record(wait_ms)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.complete_span(
+                "serving.queue_wait", t0, rid=req.rid,
+                replica=self.replica, priority=req.priority,
+            )
+
+    def count_rejected(self) -> None:
+        """An admission-rejected request (engine oversize check)."""
+        with self._lock:
+            self.rejected += 1
+
+    def count_poisoned(self) -> None:
+        """A request failed for invalid sampling parameters."""
+        with self._lock:
+            self.poisoned += 1
+
+    def count_timed_out(self) -> None:
+        """A request that missed its wall deadline outside the queue
+        (the router's waiter observed the expiry)."""
+        with self._lock:
+            self.timed_out += 1
 
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._heap)
 
     def record_first_token(self, req: Request) -> None:
+        """Stamp TTFT once per request — a re-prefilled failover does
+        not reset the clock the user has been watching since submit."""
+        if req.first_token_t:
+            return
         req.first_token_t = time.monotonic()
+        with self._lock:
+            self._hists["ttft"].record(
+                max(0.0, (req.first_token_t - req.submit_t) * 1e3)
+            )
 
     def complete(self, req: Request, output) -> None:
         """Resolve a request exactly once and record its latency."""
         req.done_t = time.monotonic()
         with self._lock:
             self.completed += 1
-            self._latencies_ms.append((req.done_t - req.submit_t) * 1e3)
-            if len(self._latencies_ms) > self._max_latencies:
-                del self._latencies_ms[: -self._max_latencies]
+            self._hists["e2e"].record((req.done_t - req.submit_t) * 1e3)
+            # inter-token pace: mean decode-token spacing after token 0
+            n_new = len(output) - len(req.prompt) if output else 0
+            if req.first_token_t and n_new >= 2:
+                self._hists["tpot"].record(
+                    max(0.0, req.done_t - req.first_token_t)
+                    / (n_new - 1) * 1e3
+                )
             self._done_ts.append(req.done_t)
             if len(self._done_ts) > 256:
                 del self._done_ts[:-256]
@@ -280,26 +386,38 @@ class Scheduler:
 
     # ---- accounting ------------------------------------------------------
 
-    @staticmethod
-    def _percentile(sorted_vals: List[float], q: float) -> float:
-        if not sorted_vals:
-            return 0.0
-        idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-        return sorted_vals[idx]
+    def histograms(self) -> Dict[str, LatencyHistogram]:
+        """Consistent copies of the per-phase histograms, keyed by
+        ``LATENCY_PHASES`` — what the router/master merge for fleet
+        percentiles."""
+        with self._lock:
+            return {k: h.copy() for k, h in self._hists.items()}
 
     def latency_ms(self) -> dict:
+        """End-to-end latency percentiles, in the historical
+        ``{p50, p99, n}`` shape — now backed by the histogram, so no
+        window truncation and no per-call sort."""
         with self._lock:
-            vals = sorted(self._latencies_ms)
-        return {
-            "p50": self._percentile(vals, 0.50),
-            "p99": self._percentile(vals, 0.99),
-            "n": len(vals),
-        }
+            return self._hists["e2e"].summary()
+
+    def latency_summary(self) -> dict:
+        """Flat per-phase percentile summary (the bench/record shape)."""
+        h = self.histograms()
+        out = h["e2e"].summary()
+        out.update(
+            ttft_p50_ms=h["ttft"].percentile(50.0),
+            ttft_p99_ms=h["ttft"].percentile(99.0),
+            tpot_p50_ms=h["tpot"].percentile(50.0),
+            tpot_p99_ms=h["tpot"].percentile(99.0),
+            queue_wait_p99_ms=h["queue_wait"].percentile(99.0),
+        )
+        return out
 
     def reset_latencies(self) -> None:
         """Drop warmup samples (compile time) before a timed window."""
         with self._lock:
-            self._latencies_ms.clear()
+            for h in self._hists.values():
+                h.clear()
 
     def publish(self, engine_stats: Optional[dict] = None):
         """Emit one ``ServingRecord`` on the hub; returns the record
@@ -307,7 +425,8 @@ class Scheduler:
         themselves)."""
         from dlrover_tpu.observability.telemetry import ServingRecord
 
-        lat = self.latency_ms()
+        hists = self.histograms()
+        lat = hists["e2e"].summary()
         es = engine_stats or {}
         rec = ServingRecord(
             replica=self.replica,
@@ -325,6 +444,20 @@ class Scheduler:
             shed=self.shed,
             migrated_in=int(es.get("migrated_in", 0)),
             migrated_out=int(es.get("migrated_out", 0)),
+            ttft_p50_ms=round(hists["ttft"].percentile(50.0), 3),
+            ttft_p99_ms=round(hists["ttft"].percentile(99.0), 3),
+            tpot_p50_ms=round(hists["tpot"].percentile(50.0), 3),
+            tpot_p99_ms=round(hists["tpot"].percentile(99.0), 3),
+            queue_wait_p99_ms=round(
+                hists["queue_wait"].percentile(99.0), 3
+            ),
+            rejected=self.rejected,
+            timed_out=self.timed_out,
+            poisoned=self.poisoned,
+            hists=json.dumps(
+                {k: hists[k].to_dict() for k in LATENCY_PHASES},
+                sort_keys=True,
+            ),
         )
         if self.hub is not None:
             self.hub.publish(rec)

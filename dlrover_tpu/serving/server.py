@@ -23,6 +23,7 @@ import contextlib
 import threading
 import time
 
+from dlrover_tpu.observability.tracing import get_tracer
 from dlrover_tpu.serving.engine import ServingEngine
 from dlrover_tpu.serving.scheduler import (
     Request, SamplingParams, Scheduler,
@@ -42,6 +43,7 @@ class GenerationServer:
         max_queue: int = 256,
         publish_every: float = 0.5,
         idle_sleep: float = 0.002,
+        watchdog=None,
         **engine_kw,
     ):
         self.replica = replica
@@ -49,6 +51,12 @@ class GenerationServer:
             max_queue=max_queue, hub=hub, replica=replica
         )
         self.engine = ServingEngine(params, cfg, self.scheduler, **engine_kw)
+        # optional SLO watchdog (observability/watchdog.ServingWatchdog):
+        # observed per published record; its capture snapshot defaults
+        # to this engine's frozen observability state
+        self.watchdog = watchdog
+        if watchdog is not None and watchdog.snapshot_fn is None:
+            watchdog.snapshot_fn = self.engine.observability_snapshot
         self.publish_every = publish_every
         self.idle_sleep = idle_sleep
         self._stop_evt = threading.Event()
@@ -128,12 +136,26 @@ class GenerationServer:
             worked = self.engine.step()
             now = time.monotonic()
             if now - last_pub >= self.publish_every:
-                self.scheduler.publish(self.engine.stats())
+                self._publish()
                 last_pub = now
             if not worked:
                 self._stop_evt.wait(self.idle_sleep)
         # final snapshot so short-lived servers still leave telemetry
-        self.scheduler.publish(self.engine.stats())
+        self._publish()
+
+    def _publish(self) -> None:
+        stats = self.engine.stats()
+        rec = self.scheduler.publish(stats)
+        if self.watchdog is not None:
+            self.watchdog.observe(rec)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.counter(
+                f"serving.occupancy.{self.replica}",
+                active_slots=stats["active_slots"],
+                queue_depth=rec.queue_depth,
+                free_pages=stats["free_pages"],
+            )
 
     # ---- intake ----------------------------------------------------------
 

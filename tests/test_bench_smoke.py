@@ -282,6 +282,13 @@ def test_bench_serve_mode_emits_schema():
     assert rec["serve_p99_ms"] is not None
     assert rec["serve_p99_ms"] >= rec["serve_p50_ms"] > 0
     assert rec["p99_target_ms"] > 0
+    # per-phase latency axes, measured from the scheduler's log-bucketed
+    # histograms: TTFT/TPOT resolve the interactive SLO story that the
+    # e2e percentile alone can't
+    assert rec["ttft_p99_ms"] >= rec["ttft_p50_ms"] > 0
+    assert rec["tpot_p99_ms"] >= rec["tpot_p50_ms"] > 0
+    assert rec["queue_wait_p99_ms"] >= 0
+    assert rec["ttft_p99_ms"] <= rec["serve_p99_ms"]
     assert rec["kv_cache"]["mode"] == "int8"
     assert rec["kv_cache"]["reduction_vs_bf16"] >= 1.7
     assert (
@@ -353,6 +360,21 @@ def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
     assert got_spec["spec_tokens_per_s"] == pytest.approx(150.0)
     assert got_spec["spec_accept_rate"] == pytest.approx(0.62)
     assert got_spec["spec_speedup_vs_specoff"] == pytest.approx(1.21)
+    # a phase-latency-bearing artifact projects the ttft/tpot axes;
+    # older artifacts (the minimal one above) simply omit them
+    pphase = tmp_path / "SERVE_phase.json"
+    pphase.write_text(json.dumps({
+        "serve_tokens_per_s": 123.4,
+        "serve_p99_ms": 80.5,
+        "ttft_p50_ms": 12.0, "ttft_p99_ms": 30.0,
+        "tpot_p50_ms": 2.5, "tpot_p99_ms": 4.0,
+        "queue_wait_p99_ms": 1.5,
+    }))
+    got_phase = bench.serving_trajectory_metric(str(pphase))
+    assert got_phase["ttft_p99_ms"] == pytest.approx(30.0)
+    assert got_phase["tpot_p50_ms"] == pytest.approx(2.5)
+    assert got_phase["queue_wait_p99_ms"] == pytest.approx(1.5)
+    assert "ttft_p99_ms" not in got  # old artifacts stay exact-shape
     # a migration-bearing artifact projects the recovery headline too
     pmig = tmp_path / "SERVE_mig.json"
     pmig.write_text(json.dumps({

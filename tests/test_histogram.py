@@ -1,0 +1,188 @@
+"""LatencyHistogram unit tests (observability/histogram.py).
+
+The load-bearing property is EXACT mergeability: because the bucket
+index of a value is a pure function of (value, geometry), merging
+per-replica histograms and histogramming the concatenated raw samples
+yield identical counts — fleet percentiles from counts, never from
+averaging per-replica percentiles. Everything here is pure host-side
+Python; no jax import.
+"""
+
+import json
+import math
+import random
+
+import pytest
+
+from dlrover_tpu.observability.histogram import (
+    LatencyHistogram,
+    merge_histograms,
+)
+
+
+def _samples(seed, n, lo=0.01, hi=5000.0):
+    rng = random.Random(seed)
+    # log-uniform spread across the whole range plus edge values
+    out = [math.exp(rng.uniform(math.log(lo), math.log(hi)))
+           for _ in range(n)]
+    out += [0.0, lo, hi, 1e-9]
+    return out
+
+
+def test_bucket_index_is_deterministic_and_monotone():
+    h = LatencyHistogram()
+    prev = -1
+    for v in sorted(_samples(0, 500)):
+        idx = h.bucket_index(v)
+        assert idx == h.bucket_index(v)  # pure function of value
+        assert idx >= prev               # monotone in the value
+        prev = idx
+
+
+def test_bucket_mid_lands_in_own_bucket():
+    h = LatencyHistogram()
+    for v in _samples(1, 200):
+        idx = h.bucket_index(v)
+        assert h.bucket_index(h.bucket_mid(idx)) == idx
+
+
+def test_relative_error_bound():
+    """Each value's bucket midpoint is within 2**-(sub_bits+1) relative
+    error — the advertised resolution of the geometry."""
+    h = LatencyHistogram(sub_bits=5)
+    bound = 2.0 ** -(h.sub_bits + 1) + 1e-12
+    for v in _samples(2, 500, lo=0.01):
+        if v <= h.min_value:
+            continue
+        mid = h.bucket_mid(h.bucket_index(v))
+        assert abs(mid - v) / v <= bound
+
+
+@pytest.mark.parametrize("n_parts", [2, 3, 7])
+def test_merge_of_parts_equals_histogram_of_concat(n_parts):
+    """THE mergeability property: splitting a sample stream across
+    replicas and merging their histograms gives bucket counts
+    identical to one histogram over the concatenated stream."""
+    samples = _samples(3, 2000)
+    parts = [LatencyHistogram() for _ in range(n_parts)]
+    whole = LatencyHistogram()
+    for i, v in enumerate(samples):
+        parts[i % n_parts].record(v)
+        whole.record(v)
+    merged = merge_histograms(parts)
+    assert merged.counts == whole.counts
+    assert merged.n == whole.n
+    assert merged.vmin == whole.vmin and merged.vmax == whole.vmax
+    assert merged.total == pytest.approx(whole.total)
+    for q in (1, 25, 50, 90, 99, 99.9):
+        assert merged.percentile(q) == whole.percentile(q)
+    # inputs untouched
+    assert sum(p.n for p in parts) == whole.n
+
+
+def test_merge_rejects_geometry_mismatch():
+    a = LatencyHistogram(sub_bits=5)
+    b = LatencyHistogram(sub_bits=6)
+    with pytest.raises(ValueError, match="geometry"):
+        a.merge(b)
+    c = LatencyHistogram(min_value=1e-6)
+    with pytest.raises(ValueError, match="geometry"):
+        merge_histograms([a, c])
+
+
+def test_merge_of_empty_iterable_is_none():
+    assert merge_histograms([]) is None
+    assert merge_histograms(iter([])) is None
+
+
+def test_percentiles_against_sorted_samples():
+    """Histogram percentiles track exact nearest-rank percentiles of
+    the raw samples within the geometry's relative error bound."""
+    samples = _samples(4, 5000, lo=0.1, hi=1000.0)
+    h = LatencyHistogram()
+    for v in samples:
+        h.record(v)
+    srt = sorted(samples)
+    bound = 2.0 ** -(h.sub_bits + 1) + 1e-9
+    for q in (10, 50, 90, 99):
+        exact = srt[max(0, math.ceil(q / 100 * len(srt)) - 1)]
+        got = h.percentile(q)
+        assert abs(got - exact) <= max(bound * exact, h.min_value)
+    # percentiles are monotone in q
+    ps = [h.percentile(q) for q in (1, 10, 50, 90, 99, 100)]
+    assert ps == sorted(ps)
+
+
+def test_percentile_clamped_to_observed_range():
+    h = LatencyHistogram()
+    h.record(7.0)
+    # a single sample: every percentile IS that sample, not the bucket
+    # midpoint (which could exceed it)
+    for q in (0, 50, 100):
+        assert h.percentile(q) == 7.0
+    assert h.summary() == {"p50": 7.0, "p99": 7.0, "n": 1}
+
+
+def test_empty_and_degenerate_values():
+    h = LatencyHistogram()
+    assert h.percentile(99) == 0.0
+    assert h.summary() == {"p50": 0.0, "p99": 0.0, "n": 0}
+    h.record(float("nan"))           # dropped, not poisoning the stats
+    assert h.n == 0
+    h.record(-5.0)                   # clamps into bucket 0
+    h.record(0.0)
+    assert h.n == 2
+    assert h.percentile(50) == 0.0   # clamped to the observed range
+
+
+def test_wire_roundtrip_is_lossless():
+    h = LatencyHistogram()
+    for v in _samples(5, 1000):
+        h.record(v)
+    back = LatencyHistogram.from_json(h.to_json())
+    assert back.counts == h.counts
+    assert back.n == h.n
+    assert back.geometry() == h.geometry()
+    assert back.vmin == h.vmin and back.vmax == h.vmax
+    assert back.total == h.total
+    # envelope survives a generic JSON hop (string bucket keys)
+    doc = json.loads(h.to_json())
+    assert all(isinstance(k, str) for k in doc["counts"])
+    # empty histogram round-trips too (inf min/max encoded as None)
+    e = LatencyHistogram.from_json(LatencyHistogram().to_json())
+    assert e.n == 0 and e.vmin == math.inf and e.vmax == -math.inf
+
+
+def test_clear_resets_to_empty():
+    h = LatencyHistogram()
+    for v in _samples(6, 50):
+        h.record(v)
+    h.clear()
+    assert h.n == 0 and not h.counts
+    assert h.summary() == {"p50": 0.0, "p99": 0.0, "n": 0}
+
+
+def test_copy_is_independent():
+    h = LatencyHistogram()
+    h.record(3.0)
+    c = h.copy()
+    c.record(9.0)
+    assert h.n == 1 and c.n == 2
+
+
+def test_merged_p99_differs_from_averaged_p99():
+    """Why histograms exist: the fleet p99 computed from counts is NOT
+    the mean of per-replica p99s when load is skewed."""
+    fast, slow = LatencyHistogram(), LatencyHistogram()
+    for _ in range(990):
+        fast.record(1.0)
+    for _ in range(10):
+        fast.record(2.0)
+    for _ in range(100):
+        slow.record(1000.0)
+    merged = merge_histograms([fast, slow])
+    averaged = (fast.percentile(99) + slow.percentile(99)) / 2.0
+    true_p99 = merged.percentile(99)
+    # ~9% of merged traffic is slow → true p99 is in the slow mass
+    assert true_p99 > 900.0
+    assert abs(averaged - true_p99) > 300.0

@@ -336,6 +336,12 @@ _SLOW_LEDGER = [
     "test_serving_migration.py::"
     "test_faulted_migration_degrades_to_reprefill[stall]",
     "test_serving_migration.py::test_wait_all_backoff_with_slow_straggler",
+    # serving observability drills: replica pairs with tracing on and
+    # an injected stall — same two-compiles-plus-kill cost profile
+    "test_serving_observability.py::"
+    "test_tracing_drill_merged_trace_has_rid_span_chain",
+    "test_serving_observability.py::"
+    "test_slo_breach_drill_capture_and_healthcheck_naming",
 ]
 
 
@@ -443,6 +449,53 @@ def _imports_serving_e2e(tree) -> bool:
             ):
                 return True
     return False
+
+
+def _fn_imports_serving_e2e(fn) -> bool:
+    """Function-BODY import of serving.server/replica (the drill idiom:
+    import inside the test so tier-1 collection stays light)."""
+    e2e = ("dlrover_tpu.serving.server", "dlrover_tpu.serving.replica")
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Import):
+            if any(
+                a.name == m or a.name.startswith(m + ".")
+                for a in node.names
+                for m in e2e
+            ):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if any(mod == m or mod.startswith(m + ".") for m in e2e):
+                return True
+            if mod == "dlrover_tpu.serving" and any(
+                a.name in ("server", "replica") for a in node.names
+            ):
+                return True
+    return False
+
+
+def test_serving_e2e_function_imports_are_slow():
+    """A test that imports serving.server/replica INSIDE its body is
+    still an e2e serving drill — the function-level import dodges the
+    module-level rule below but pays the same background-thread +
+    two-jit-compiles cost at run time. Such tests must carry ``slow``
+    themselves (helpers shared by several drills are exempt; the drills
+    calling them are what collect)."""
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if _fn_slow_marked(fn):
+                continue
+            if _fn_imports_serving_e2e(fn):
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "function-level serving server/replica imports in non-slow "
+        "tests (add @pytest.mark.slow, or a module-level pytestmark):\n"
+        + "\n".join(rogue)
+    )
 
 
 def test_serving_e2e_tests_are_slow():

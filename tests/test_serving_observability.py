@@ -1,0 +1,622 @@
+"""Serving-tier observability (PR: lifecycle tracing + SLO watchdog).
+
+Fast tier — pure host-side units, no serving.server/replica import at
+module scope:
+
+- ``ServingWatchdog`` gate classification (slo_breach, ttft_regression,
+  spec_accept_collapse, shed_storm, migration_fallback), edge-trigger /
+  re-arm semantics, and the immediately-written capture artifact
+  (engine phase split + allocator occupancy via ``snapshot_fn``).
+- ``healthcheck`` replay of a serving flight recorder: per-replica
+  window + fleet percentiles merged from the recorded histogram
+  envelopes, exit 1 on an SLO breach naming the breaching replica,
+  torn-line tolerance.
+- Zero-cost-when-off tracing: the NullTracer's ``complete_span`` is a
+  pinned no-op (tracemalloc-guarded), and the scheduler emits spans
+  only when a real tracer is installed.
+
+Slow tier — the acceptance drills:
+
+- 2 replicas with tracing on, kill one mid-decode: the merged Chrome
+  trace contains the victim request's span chain (queue wait → prefill
+  chunks → decode → migration transfer → resume on the survivor)
+  correlated by ``rid``; the router's fleet histogram merge equals the
+  by-hand merge of per-replica histograms.
+- An injected stall on one replica breaches the p99 SLO: the watchdog
+  fires a serving AnomalyRecord, writes a capture with phase split +
+  allocator occupancy, and the offline healthcheck replay names the
+  breaching replica with exit code 1.
+"""
+
+import json
+import time
+import tracemalloc
+
+import pytest
+
+from dlrover_tpu.common.constants import GraftEnv
+from dlrover_tpu.observability import healthcheck, telemetry, tracing
+from dlrover_tpu.observability.histogram import LatencyHistogram
+from dlrover_tpu.observability.telemetry import configure_hub, reset_hub
+from dlrover_tpu.observability.watchdog import (
+    SERVING_ANOMALY_KINDS,
+    ServingWatchdog,
+    ServingWatchdogConfig,
+)
+from dlrover_tpu.serving.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _fresh_bus():
+    reset_hub()
+    tracing.reset_tracer()
+    yield
+    reset_hub()
+    tracing.reset_tracer()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _rec(**kw):
+    base = dict(replica="rep-0", completed=20, p99_ms=10.0)
+    base.update(kw)
+    return telemetry.ServingRecord(**base)
+
+
+def _watchdog(tmp_path=None, clock=None, **cfg_kw):
+    if tmp_path is not None:
+        cfg_kw.setdefault("capture_dir", str(tmp_path / "caps"))
+    cfg = ServingWatchdogConfig(node_id=3, **cfg_kw)
+    return ServingWatchdog(cfg, clock=clock or FakeClock())
+
+
+# ---------------------------------------------------------------------------
+# gate classification + edge-trigger semantics
+# ---------------------------------------------------------------------------
+
+
+def test_serving_kinds_disjoint_from_training_kinds():
+    from dlrover_tpu.observability.watchdog import ANOMALY_KINDS
+
+    assert not set(SERVING_ANOMALY_KINDS) & set(ANOMALY_KINDS)
+
+
+def test_slo_breach_edge_triggers_and_rearms():
+    wd = _watchdog(p99_target_ms=100.0, min_completed=8)
+    # breach fires exactly once while sustained
+    assert [a.kind for a in wd.observe(_rec(p99_ms=150.0))] == ["slo_breach"]
+    assert wd.observe(_rec(p99_ms=180.0)) == []
+    # clearing re-arms the gate; the next breach fires again
+    assert wd.observe(_rec(p99_ms=50.0)) == []
+    fired = wd.observe(_rec(p99_ms=140.0))
+    assert [a.kind for a in fired] == ["slo_breach"]
+    assert len(wd.anomalies) == 2
+    a = wd.anomalies[0]
+    assert a.replica == "rep-0" and a.node_id == 3
+    assert a.value == 150.0 and "target=100" in a.detail
+
+
+def test_min_completed_suppresses_noise_and_zero_target_disables():
+    wd = _watchdog(p99_target_ms=100.0, min_completed=8)
+    # 3 completions is noise, however bad the percentile looks
+    assert wd.observe(_rec(completed=3, p99_ms=9999.0)) == []
+    # target 0 disables the gate entirely
+    off = _watchdog()  # all latency targets default 0
+    assert off.observe(_rec(p99_ms=1e9, ttft_p99_ms=1e9)) == []
+
+
+def test_ttft_regression_gate():
+    wd = _watchdog(ttft_target_ms=50.0, min_completed=4)
+    out = wd.observe(_rec(completed=5, ttft_p99_ms=80.0))
+    assert [a.kind for a in out] == ["ttft_regression"]
+    assert "ttft_p99=80" in out[0].detail
+
+
+def test_spec_accept_collapse_needs_enough_drafts():
+    wd = _watchdog(min_accept_rate=0.2, min_draft_tokens=64)
+    # too few drafts to judge
+    assert wd.observe(_rec(draft_tokens=10, spec_accept_rate=0.01)) == []
+    out = wd.observe(_rec(draft_tokens=200, spec_accept_rate=0.05))
+    assert [a.kind for a in out] == ["spec_accept_collapse"]
+    # healthy accept rate never fires
+    assert wd.observe(_rec(draft_tokens=500, spec_accept_rate=0.8)) == []
+
+
+def test_shed_storm_fires_on_drop_delta_not_lifetime_total():
+    wd = _watchdog(shed_storm_drops=8)
+    # first observation only sets the baseline — a replica restarted
+    # with a big lifetime counter must not instantly alarm
+    assert wd.observe(_rec(shed=100, rejected=50)) == []
+    # +3 new drops: under the storm threshold
+    assert wd.observe(_rec(shed=102, rejected=51)) == []
+    # +10 new drops in one window: storm
+    out = wd.observe(_rec(shed=110, rejected=53))
+    assert [a.kind for a in out] == ["shed_storm"]
+    assert "new_drops=10" in out[0].detail
+    # flat counters re-arm; the next burst fires again
+    assert wd.observe(_rec(shed=110, rejected=53)) == []
+    out = wd.observe(_rec(shed=110, rejected=53, timed_out=9))
+    assert [a.kind for a in out] == ["shed_storm"]
+
+
+def test_migration_fallback_fires_on_streak_and_live_resets():
+    wd = _watchdog(fallback_storm=2)
+
+    def rep(path):
+        return type("R", (), {"path": path, "re_prefilled": {"x": "s"}})()
+
+    assert wd.observe_migration(rep("fallback"), replica="rep-1") is None
+    a = wd.observe_migration(rep("fallback"), replica="rep-1")
+    assert a is not None and a.kind == "migration_fallback"
+    assert a.replica == "rep-1" and "consecutive_fallbacks=2" in a.detail
+    # a live migration resets the streak AND re-arms the gate
+    assert wd.observe_migration(rep("live"), replica="rep-1") is None
+    assert wd.observe_migration(rep("fallback"), replica="rep-1") is None
+    assert (
+        wd.observe_migration(rep("fallback"), replica="rep-1").kind
+        == "migration_fallback"
+    )
+
+
+def test_anomalies_publish_on_the_hub():
+    hub = configure_hub()
+    seen = []
+    hub.subscribe(seen.append, types=("AnomalyRecord",))
+    wd = _watchdog(p99_target_ms=100.0)
+    wd.observe(_rec(p99_ms=500.0, replica="rep-9"))
+    assert len(seen) == 1
+    assert seen[0].kind == "slo_breach" and seen[0].replica == "rep-9"
+    # survives the wire like every other record
+    back = telemetry.from_json(seen[0].to_json())
+    assert back.replica == "rep-9"
+
+
+# ---------------------------------------------------------------------------
+# triggered capture: immediate write, engine snapshot, storm budget
+# ---------------------------------------------------------------------------
+
+
+def test_capture_written_immediately_with_engine_snapshot(tmp_path):
+    snap = {
+        "phase_split": {"step_time_s": 1.2, "host_time_s": 0.3,
+                        "table_ships": 4},
+        "allocator": {"free_pages": 2, "reserved_pages": 1, "n_pages": 16},
+        "scheduler": {"queue_depth": 7},
+    }
+    wd = _watchdog(tmp_path, p99_target_ms=100.0)
+    wd.snapshot_fn = lambda: snap
+    (a,) = wd.observe(_rec(p99_ms=250.0, replica="rep-2/x"))
+    assert a.capture and "rep-2_x" in a.capture and "slo_breach" in a.capture
+    with open(a.capture) as f:
+        doc = json.load(f)
+    assert doc["anomaly"]["kind"] == "slo_breach"
+    assert doc["anomaly"]["replica"] == "rep-2/x"
+    assert doc["engine"]["phase_split"]["step_time_s"] == 1.2
+    assert doc["engine"]["allocator"]["free_pages"] == 2
+    assert doc["record"]["p99_ms"] == 250.0  # the breaching window rides
+
+
+def test_capture_survives_snapshot_failure(tmp_path):
+    wd = _watchdog(tmp_path, p99_target_ms=100.0)
+    wd.snapshot_fn = lambda: 1 / 0
+    (a,) = wd.observe(_rec(p99_ms=250.0))
+    with open(a.capture) as f:
+        doc = json.load(f)
+    assert "error" in doc["engine"]  # capture landed anyway
+
+
+def test_capture_rate_limit_and_budget(tmp_path):
+    clock = FakeClock()
+    wd = _watchdog(
+        tmp_path, clock=clock, p99_target_ms=100.0, ttft_target_ms=10.0,
+        min_capture_interval_s=60.0, max_captures=2,
+    )
+    (a1,) = wd.observe(_rec(p99_ms=200.0))
+    assert a1.capture  # first breach captures
+    clock.t = 1.0
+    (a2,) = wd.observe(_rec(p99_ms=200.0, ttft_p99_ms=50.0))
+    assert a2.kind == "ttft_regression"
+    assert a2.capture == ""  # classified, but capture rate-limited
+    clock.t = 100.0
+    wd.observe(_rec(p99_ms=50.0, ttft_p99_ms=1.0))  # clear both gates
+    (a3,) = wd.observe(_rec(p99_ms=200.0))
+    assert a3.capture  # budget slot 2 of 2
+    clock.t = 300.0
+    wd.observe(_rec(p99_ms=50.0))
+    (a4,) = wd.observe(_rec(p99_ms=200.0))
+    assert a4.capture == ""  # lifetime budget exhausted
+    assert wd._captures_used == 2
+
+
+def test_no_capture_dir_means_classify_only(tmp_path):
+    wd = _watchdog(p99_target_ms=100.0)  # no capture_dir
+    (a,) = wd.observe(_rec(p99_ms=200.0))
+    assert a.capture == ""
+    assert not list(tmp_path.iterdir())
+
+
+# ---------------------------------------------------------------------------
+# healthcheck replay of a serving flight recorder
+# ---------------------------------------------------------------------------
+
+
+def _serving_hists(ms_samples):
+    hists = {k: LatencyHistogram() for k in
+             ("e2e", "ttft", "tpot", "queue_wait")}
+    for v in ms_samples:
+        hists["e2e"].record(v)
+        hists["ttft"].record(v / 4)
+    return json.dumps({k: h.to_dict() for k, h in hists.items()},
+                      sort_keys=True)
+
+
+def _write_serving_flight(path, breach=True):
+    hub = configure_hub(jsonl_path=str(path))
+    hub.publish(telemetry.ServingRecord(
+        replica="srv-0", completed=20, admitted=22, shed=1, rejected=1,
+        p99_ms=40.0, ttft_p99_ms=10.0,
+        hists=_serving_hists([10.0] * 19 + [40.0]),
+    ))
+    hub.publish(telemetry.ServingRecord(
+        replica="srv-1", completed=20, admitted=20,
+        p99_ms=900.0, ttft_p99_ms=200.0,
+        hists=_serving_hists([20.0] * 15 + [900.0] * 5),
+    ))
+    if breach:
+        hub.publish(telemetry.AnomalyRecord(
+            kind="slo_breach", step=2, node_id=1, value=900.0,
+            detail="p99=900ms target=250ms n=20", replica="srv-1",
+            capture="/caps/capture_serving2_srv-1_slo_breach.json",
+        ))
+    reset_hub()
+
+
+def test_healthcheck_serving_replay_names_breaching_replica(tmp_path):
+    path = tmp_path / "serving.jsonl"
+    _write_serving_flight(path)
+    # torn tail + foreign line: replay must skip, not crash
+    with open(path, "a") as f:
+        f.write('{"not": "ours"}\n{"r": "ServingRecord", "d": {"re')
+
+    diag = healthcheck.diagnose(healthcheck.load_records(str(path)))
+    assert not diag["healthy"]
+    info = diag["anomalies"]["slo_breach"]
+    assert info["replicas"] == ["srv-1"]
+    assert info["captures"] == [
+        "/caps/capture_serving2_srv-1_slo_breach.json"
+    ]
+    srv = diag["serving"]
+    assert set(srv["replicas"]) == {"srv-0", "srv-1"}
+    assert srv["replicas"]["srv-1"]["p99_ms"] == 900.0
+    assert srv["replicas"]["srv-0"]["dropped"] == 2
+    # fleet percentiles come from the MERGED envelopes: 40 samples,
+    # 5 of them at ~900ms → fleet p99 sits in the slow mass
+    assert srv["fleet"]["e2e"]["n"] == 40
+    assert srv["fleet"]["e2e"]["p99"] > 800.0
+
+    report = healthcheck.format_report(diag)
+    assert "breaching replica(s): srv-1" in report
+    assert "serving replicas:" in report
+    assert "fleet e2e:" in report
+
+
+def test_healthcheck_serving_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.jsonl"
+    _write_serving_flight(bad)
+    assert healthcheck.main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "srv-1" in out and "slo_breach" in out
+
+    ok = tmp_path / "ok.jsonl"
+    _write_serving_flight(ok, breach=False)
+    assert healthcheck.main([str(ok)]) == 0
+    out = capsys.readouterr().out
+    assert "healthy" in out and "serving replicas:" in out
+
+    # --json mode stays serializable with the serving section attached
+    assert healthcheck.main([str(bad), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["serving"]["fleet"]["e2e"]["n"] == 40
+    assert doc["anomalies"]["slo_breach"]["replicas"] == ["srv-1"]
+
+
+def test_healthcheck_tolerates_torn_hists_envelope(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    hub = configure_hub(jsonl_path=str(path))
+    hub.publish(telemetry.ServingRecord(
+        replica="srv-0", completed=5, p99_ms=10.0, hists='{"e2e": {"bro'
+    ))
+    reset_hub()
+    diag = healthcheck.diagnose(healthcheck.load_records(str(path)))
+    # the per-replica view stands even when the envelope is torn
+    assert diag["serving"]["replicas"]["srv-0"]["p99_ms"] == 10.0
+    assert diag["serving"]["fleet"] == {}
+
+
+# ---------------------------------------------------------------------------
+# tracing: zero-cost when off, scheduler spans when on
+# ---------------------------------------------------------------------------
+
+
+def test_null_tracer_complete_span_is_pinned_noop(monkeypatch):
+    monkeypatch.delenv(GraftEnv.TRACE_DIR, raising=False)
+    tr = tracing.get_tracer()
+    assert not tr.enabled
+    assert tr.complete_span("serving.queue_wait", time.monotonic()) == 0.0
+    t0 = time.monotonic()
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    for _ in range(2000):
+        t = tracing.get_tracer()
+        if t.enabled:  # the guard every serving call site uses
+            pytest.fail("tracer must stay disabled without configuration")
+        t.complete_span("serving.queue_wait", t0)
+    grown = tracemalloc.get_traced_memory()[0] - base
+    tracemalloc.stop()
+    assert grown < 4096, f"disabled-tracer hot path retained {grown}B"
+    assert tr.events() == []
+
+
+def test_scheduler_emits_no_spans_with_tracing_off(monkeypatch):
+    monkeypatch.delenv(GraftEnv.TRACE_DIR, raising=False)
+    s = Scheduler(replica="quiet")
+    r = s.submit([1, 2], 2)
+    s.record_admitted(s.pop_next())
+    s.re_admit(r)
+    assert tracing.get_tracer().events() == []
+
+
+def test_scheduler_emits_rid_correlated_spans_with_tracing_on():
+    tr = tracing.configure_tracer("serving-test", force=True)
+    assert tr.enabled
+    s = Scheduler(replica="loud")
+    r = s.submit([1, 2], 2)
+    s.record_admitted(s.pop_next())
+    s.re_admit(r)
+    events = tr.events()
+    qw = [e for e in events if e["name"] == "serving.queue_wait"]
+    assert len(qw) == 1 and qw[0]["ph"] == "X"
+    assert qw[0]["args"]["rid"] == r.rid
+    assert qw[0]["args"]["replica"] == "loud"
+    ra = [e for e in events if e["name"] == "serving.re_admit"]
+    assert len(ra) == 1 and ra[0]["ph"] == "i"
+    assert ra[0]["args"]["rid"] == r.rid
+
+
+def test_complete_span_backdates_to_interval_start():
+    tr = tracing.configure_tracer("serving-test", force=True)
+    t0 = time.monotonic() - 0.05  # interval started 50 ms ago
+    dur = tr.complete_span("serving.queue_wait", t0, rid="x/r0")
+    assert 0.04 < dur < 5.0
+    (ev,) = [e for e in tr.events() if e["name"] == "serving.queue_wait"]
+    assert ev["dur"] == pytest.approx(dur * 1e6)
+    # the event's start sits ~dur before its emission time
+    assert ev["ts"] + ev["dur"] <= tr._now_us() + 1e3
+
+
+# ---------------------------------------------------------------------------
+# slow acceptance drills
+# ---------------------------------------------------------------------------
+
+
+_SERVER_KW = dict(
+    n_slots=4, max_len=32, page_size=4, mode="bf16", prefill_chunk=4,
+    idle_sleep=0.001,
+)
+
+
+def _mid_stream(rep, want):
+    eng = rep.server.engine
+    slots = [s for s in eng.slots if s is not None]
+    return len(slots) == want and all(
+        s.phase == "decode"
+        and len(s.generated) >= 1
+        and not s.req.future.done()
+        for s in slots
+    )
+
+
+def _tiny_setup():
+    jax = pytest.importorskip("jax")
+    from dlrover_tpu.models import decoder
+    from dlrover_tpu.models.config import get_config
+
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+        vocab_size=32, max_seq=64,
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.mark.slow
+def test_tracing_drill_merged_trace_has_rid_span_chain(tmp_path):
+    """Kill one of two replicas mid-decode with tracing on: the merged
+    trace holds the victim request's whole life, correlated by rid —
+    queue wait → prefill chunks → decode occupancy on the victim →
+    migration transfer → live resume → decode on the survivor."""
+    from dlrover_tpu.serving import migration as mig
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+    from dlrover_tpu.serving.scheduler import SamplingParams
+
+    cfg, params = _tiny_setup()
+    trace_dir = tmp_path / "traces"
+    tracing.configure_tracer(
+        "serving-drill", trace_dir=str(trace_dir), force=True
+    )
+    prompts = [[2, 3, 4, 2, 3], [9, 10, 9, 10], [5, 6, 7], [11, 3, 7, 1]]
+    sps = [
+        SamplingParams(temperature=0.9, top_k=5, top_p=0.9, seed=i + 1)
+        for i in range(4)
+    ]
+    r0 = ServingReplica("obs-0", params, cfg, node_id=0, **_SERVER_KW)
+    r1 = ServingReplica("obs-1", params, cfg, node_id=1, **_SERVER_KW)
+    r0.start()
+    r1.start()
+    try:
+        router = ReplicaRouter([r0, r1], migrator=mig.ServingMigrator())
+        with r1.server.paused() as eng1:
+            reqs = [
+                router.submit(p, 14, sampling=sp)
+                for p, sp in zip(prompts, sps)
+            ]
+            victim_rids = sorted(
+                e.req.rid for e in router._entries if e.replica is r1
+            )
+            assert len(victim_rids) == 2
+            for _ in range(50):
+                if _mid_stream(r1, 2):
+                    break
+                eng1.step()
+            assert _mid_stream(r1, 2), "victim never reached mid-stream"
+            r1.kill()
+        moved = router.poll()
+        assert moved == 2
+        outs = router.wait_all(timeout=600)
+        assert len(outs) == 4 and all(r.future.done() for r in reqs)
+
+        # fleet rollup: the router's merged histograms ARE the by-hand
+        # merge of per-replica histograms — same counts, same p99
+        from dlrover_tpu.observability.histogram import merge_histograms
+
+        fleet = router.fleet_histograms()
+        manual = merge_histograms(
+            s.histograms()["e2e"]
+            for s in (r0.server.scheduler, r1.server.scheduler)
+        )
+        assert fleet["e2e"].counts == manual.counts
+        assert fleet["e2e"].n == 4  # every request exactly once
+        assert router.fleet_latency_ms() == manual.summary()
+    finally:
+        r0.stop()
+        r1.kill()
+        tracing.reset_tracer()  # close the trace file before merging
+
+    events = tracing.merge_trace_dir(str(trace_dir))
+    rid = victim_rids[0]
+
+    def spans(name):
+        return sorted(
+            (
+                e for e in events
+                if e.get("name") == name
+                and e.get("args", {}).get("rid") == rid
+            ),
+            key=lambda e: e["ts"],
+        )
+
+    qw = spans("serving.queue_wait")
+    assert len(qw) == 1 and qw[0]["args"]["replica"] == "obs-1"
+    pf = spans("serving.prefill_chunk")
+    assert pf and all(e["args"]["replica"] == "obs-1" for e in pf)
+    dec = spans("serving.decode")
+    victim_dec = [e for e in dec if e["args"]["replica"] == "obs-1"]
+    survivor_dec = [e for e in dec if e["args"]["replica"] == "obs-0"]
+    assert len(victim_dec) == 1
+    assert victim_dec[0]["args"]["reason"] == "migrated_out"
+    assert len(survivor_dec) == 1
+    assert survivor_dec[0]["args"]["resumed"] is True
+    assert survivor_dec[0]["args"]["reason"] == "completed"
+    xfer = spans("serving.migrate_transfer")
+    assert len(xfer) == 1
+    assert xfer[0]["args"]["victim"] == "obs-1"
+    assert xfer[0]["args"]["survivor"] == "obs-0"
+    assert xfer[0]["args"]["bytes"] > 0
+    res = spans("serving.migrate_resume")
+    assert len(res) == 1 and res[0]["args"]["path"] == "live"
+
+    # contiguous chain: each stage starts no earlier than the previous
+    assert qw[0]["ts"] <= pf[0]["ts"] <= victim_dec[0]["ts"]
+    assert victim_dec[0]["ts"] <= xfer[0]["ts"] <= res[0]["ts"]
+    assert res[0]["ts"] <= survivor_dec[0]["ts"] + survivor_dec[0]["dur"]
+
+    # admit markers correlate the same rid on BOTH replicas (admitted
+    # on the victim, re-imported on the survivor is a decode span, so
+    # exactly one admit instant)
+    admits = [
+        e for e in events
+        if e.get("name") == "serving.admit"
+        and e.get("args", {}).get("rid") == rid
+    ]
+    assert len(admits) == 1 and admits[0]["args"]["replica"] == "obs-1"
+    # occupancy counters flowed from the publish loop
+    assert any(
+        e.get("name", "").startswith("serving.occupancy.") for e in events
+    )
+
+
+@pytest.mark.slow
+def test_slo_breach_drill_capture_and_healthcheck_naming(tmp_path):
+    """Stall one of two replicas so its p99 breaches the SLO: the
+    watchdog fires ONE serving AnomalyRecord for the stalled replica,
+    writes a capture carrying the engine phase split + allocator
+    occupancy, and the offline healthcheck replay names the breaching
+    replica with exit code 1."""
+    from dlrover_tpu.serving.replica import ServingReplica
+
+    cfg, params = _tiny_setup()
+    flight = tmp_path / "flight.jsonl"
+    hub = configure_hub(jsonl_path=str(flight))
+    wds = {
+        name: ServingWatchdog(ServingWatchdogConfig(
+            node_id=i, capture_dir=str(tmp_path / "caps"),
+            p99_target_ms=500.0, min_completed=2,
+            min_capture_interval_s=0.0,
+        ))
+        for i, name in enumerate(["slo-0", "slo-1"])
+    }
+    reps = {
+        name: ServingReplica(
+            name, params, cfg, node_id=i, hub=hub,
+            watchdog=wds[name], publish_every=1000.0, **_SERVER_KW,
+        ).start()
+        for i, name in enumerate(["slo-0", "slo-1"])
+    }
+    try:
+        # warm the jit caches so compile time doesn't skew either p99
+        for rep in reps.values():
+            rep.generate([2, 3, 4], 4, timeout=600.0)
+            rep.server.scheduler.reset_latencies()
+        # inject the stall: every engine step on slo-1 drags 150 ms
+        eng1 = reps["slo-1"].server.engine
+        orig_step = eng1.step
+
+        def stalled_step():
+            time.sleep(0.15)
+            return orig_step()
+
+        eng1.step = stalled_step
+        futs = []
+        for rep in reps.values():
+            for seed in (1, 2, 3):
+                futs.append(rep.submit([2, 3, 4, seed], 6).future)
+        for f in futs:
+            f.result(timeout=600.0)
+    finally:
+        for rep in reps.values():
+            rep.stop()  # final publish → watchdog observes the window
+        reset_hub()
+
+    assert [a.kind for a in wds["slo-1"].anomalies] == ["slo_breach"]
+    assert wds["slo-0"].anomalies == []
+    a = wds["slo-1"].anomalies[0]
+    assert a.replica == "slo-1" and a.value > 500.0
+    with open(a.capture) as f:
+        doc = json.load(f)
+    # the capture freezes WHY: phase split + allocator occupancy
+    assert doc["engine"]["phase_split"]["step_time_s"] >= 0.0
+    assert doc["engine"]["allocator"]["n_pages"] > 0
+    assert doc["engine"]["allocator"]["free_pages"] >= 0
+    assert doc["record"]["replica"] == "slo-1"
+
+    assert healthcheck.main([str(flight)]) == 1
+    diag = healthcheck.diagnose(healthcheck.load_records(str(flight)))
+    assert diag["anomalies"]["slo_breach"]["replicas"] == ["slo-1"]
+    assert diag["serving"]["replicas"]["slo-1"]["p99_ms"] > 500.0
+    assert diag["serving"]["replicas"]["slo-0"]["p99_ms"] < 500.0

@@ -161,3 +161,106 @@ def test_publish_reports_shed_and_migration_counters():
     rec = s.publish({"migrated_in": 2, "migrated_out": 1})
     assert rec.shed == 1
     assert rec.migrated_in == 2 and rec.migrated_out == 1
+
+
+# ---- histogram-backed latency accounting (PR: serving observability) ----
+
+
+def test_percentiles_stable_under_more_than_window_load():
+    """Regression: the old flat list truncated to the newest 4096
+    samples, so a long tail recorded early was silently forgotten. The
+    histogram keeps every sample: p99 over 10k records must still see
+    the early outliers."""
+    s = Scheduler()
+    # a 2% slow tail first, then 9800 fast ones — more than the old
+    # window, which would have evicted every slow sample
+    for ms in [500.0] * 200 + [1.0] * 9800:
+        with s._lock:
+            s._hists["e2e"].record(ms)
+    lat = s.latency_ms()
+    assert lat["n"] == 10000
+    assert lat["p50"] < 5.0          # bulk is fast
+    assert lat["p99"] > 400.0        # the early tail is NOT forgotten
+
+
+def test_deadline_expired_in_queue_fails_fast_and_counts_timed_out():
+    s = Scheduler()
+    r = s.submit([1], 4, deadline_s=0.0)
+    live = s.submit([2], 4)
+    import time as _t
+
+    _t.sleep(0.002)  # let the zero-budget deadline lapse
+    got = s.pop_next()
+    assert got is live               # expired head skipped, not served
+    assert s.timed_out == 1
+    with pytest.raises(AdmissionError, match="deadline"):
+        r.future.result(timeout=1)
+
+
+def test_drop_counters_and_publish_fields():
+    s = Scheduler(max_queue=1)
+    s.submit([1], 1)
+    with pytest.raises(AdmissionError):
+        s.submit([2], 1)             # capacity → rejected
+    s.count_rejected()               # engine oversize path
+    s.count_poisoned()
+    s.count_timed_out()
+    rec = s.publish()
+    assert rec.rejected == 2
+    assert rec.poisoned == 1
+    assert rec.timed_out == 1
+
+
+def test_record_admitted_fills_queue_wait_histogram():
+    s = Scheduler()
+    r = s.submit([1], 4)
+    popped = s.pop_next()
+    s.record_admitted(popped)
+    h = s.histograms()
+    assert h["queue_wait"].n == 1
+    assert r.last_enqueue_t > 0
+
+
+def test_latency_summary_has_per_phase_keys():
+    s = Scheduler()
+    r = s.submit([1, 2], 2)
+    s.record_admitted(s.pop_next())
+    s.record_first_token(r)
+    s.complete(r, [1, 2, 3, 4])      # 2 new tokens → TPOT sample
+    out = s.latency_summary()
+    for key in (
+        "p50", "p99", "n", "ttft_p50_ms", "ttft_p99_ms",
+        "tpot_p50_ms", "tpot_p99_ms", "queue_wait_p99_ms",
+    ):
+        assert key in out
+    assert out["n"] == 1
+    h = s.histograms()
+    assert h["ttft"].n == 1 and h["tpot"].n == 1
+
+
+def test_ttft_survives_re_prefill_failover():
+    """A re-prefilled failover must NOT reset the TTFT clock the user
+    has been watching since submit — record_first_token is once-only."""
+    s = Scheduler()
+    r = s.submit([1], 4)
+    s.record_first_token(r)
+    first = r.first_token_t
+    s.record_first_token(r)          # failover re-emits token 0
+    assert r.first_token_t == first
+    assert s.histograms()["ttft"].n == 1
+
+
+def test_publish_hists_envelope_merges_back_exactly():
+    from dlrover_tpu.observability.histogram import LatencyHistogram
+    import json as _json
+
+    s = Scheduler()
+    for i in range(1, 40):
+        r = s.submit([1], 1)
+        s.complete(r, [1, 2])
+    rec = s.publish()
+    env = _json.loads(rec.hists)
+    assert set(env) == {"e2e", "ttft", "tpot", "queue_wait"}
+    back = LatencyHistogram.from_dict(env["e2e"])
+    assert back.n == s.histograms()["e2e"].n
+    assert back.summary() == s.latency_ms()

@@ -86,10 +86,19 @@ def test_old_serving_recordings_replay_with_defaults():
     assert rec.draft_tokens == 0
     assert rec.accepted_tokens == 0
     assert rec.spec_accept_rate == 0.0
-    # and a new-style line round-trips the spec fields losslessly
+    # phase-latency / drop-counter / histogram-envelope fields (the
+    # serving-observability additions) default cleanly too
+    assert rec.ttft_p99_ms == 0.0 and rec.tpot_p50_ms == 0.0
+    assert rec.queue_wait_p99_ms == 0.0
+    assert rec.rejected == 0 and rec.timed_out == 0 and rec.poisoned == 0
+    assert rec.hists == ""
+    # and a new-style line round-trips the new fields losslessly
     new = telemetry.ServingRecord(
         replica="r", draft_tokens=12, accepted_tokens=8,
-        spec_accept_rate=8 / 12,
+        spec_accept_rate=8 / 12, ttft_p50_ms=5.0, ttft_p99_ms=11.0,
+        tpot_p50_ms=1.5, tpot_p99_ms=2.0, queue_wait_p99_ms=0.3,
+        rejected=2, timed_out=1, poisoned=1,
+        hists='{"e2e": {"v": 1}}',
     )
     assert telemetry.from_json(new.to_json()) == new
 
