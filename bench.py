@@ -703,6 +703,15 @@ def serving_trajectory_metric(path=None):
         out["migration_tokens_saved"] = migr.get(
             "tokens_saved_vs_reprefill"
         )
+    pfx = artifact.get("prefix")
+    if pfx:
+        # prefix-sharing headline: how much of the hot-prefix trace the
+        # radix index absorbed, and the one-copy memory win it bought
+        out["prefix_hit_rate"] = pfx.get("prefix_hit_rate")
+        out["prefill_tokens_saved"] = pfx.get("prefill_tokens_saved")
+        out["resident_bytes_dedup_ratio"] = pfx.get(
+            "resident_bytes_dedup_ratio"
+        )
     return out
 
 
@@ -807,11 +816,114 @@ def _measure_migration(params, cfg, *, n_slots, max_len, page_size,
         r1.kill()
 
 
+def _measure_hot_prefix(params, cfg, *, n_slots, max_len, page_size,
+                        mode, prefill_chunk, seed, k_prompts=3,
+                        n_requests=12, max_new=4):
+    """Hot-prefix trace: a Zipf-ish mix of ``k_prompts`` shared system
+    prompts × unique suffixes, run twice at the same seed — prefix
+    sharing on vs off. The sharing-on arm should admit most requests
+    through the radix index (prefix_hit_rate), skip the shared pages'
+    prefill compute (prefill_tokens_saved, prefill-chunk reduction) and
+    hold one physical copy of each hot prefix (resident dedup ratio);
+    ``bitwise_equal_vs_sharing_off`` pins that the savings cost zero
+    output fidelity. Donor requests (one per system prompt) are kept
+    decoding through the trace so their pages stay referenced — the
+    index drops a page the moment its last holder evicts."""
+    import numpy as np
+
+    from dlrover_tpu.serving.server import GenerationServer
+
+    rng = np.random.default_rng(seed)
+    alpha = min(9, cfg.vocab_size)
+    sys_len = max_len // 2
+    systems = [
+        list(rng.integers(1, alpha, sys_len)) for _ in range(k_prompts)
+    ]
+    # Zipf-ish popularity: system prompt j drawn with p ∝ 1/(j+1)
+    w = np.array([1.0 / (j + 1) for j in range(k_prompts)])
+    picks = rng.choice(k_prompts, size=n_requests, p=w / w.sum())
+    suffixes = [
+        list(rng.integers(1, alpha, int(rng.integers(3, page_size + 3))))
+        for _ in range(n_requests)
+    ]
+    # park each donor on a near-max budget and keep a few slots free
+    # beyond them, so every donor outlives the whole trace — a donor
+    # evicting mid-trace drops its pages from the index and turns the
+    # rest of its followers into cold misses
+    n_slots = max(n_slots, k_prompts + 3)
+    donor_new = max_len - sys_len - 2
+
+    def arm(sharing):
+        srv = GenerationServer(
+            params, cfg, replica=f"bench-px-{int(sharing)}",
+            n_slots=n_slots, max_len=max_len, page_size=page_size,
+            mode=mode, prefill_chunk=prefill_chunk,
+            prefix_sharing=sharing, idle_sleep=0.001,
+        ).start()
+        try:
+            eng = srv.engine
+            srv.generate(list(np.arange(sys_len) % 4 + 1), 2,
+                         timeout=600.0)  # eats both jit compiles
+            eng._prefill_chunks = 0
+            eng._prefix_hits = 0
+            eng._prefix_misses = 0
+            eng._prefill_tokens_saved = 0
+            eng._cow_pages = 0
+            eng._peak_dedup = 1.0
+            base_prefill = eng.stats()["prefill_tokens"]
+            donors = [
+                srv.submit(s + [alpha + 1 + j], donor_new)
+                for j, s in enumerate(systems)
+            ]
+            # wait until every donor's prompt is committed (and, with
+            # sharing on, interned) before the trace lands — otherwise
+            # the first wave of followers admits cold alongside them
+            need = sum(sys_len + 1 for _ in systems)
+            deadline = time.monotonic() + 300
+            while (
+                eng.stats()["prefill_tokens"] - base_prefill < need
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+            futs = [
+                srv.submit(systems[p] + suffixes[i], max_new)
+                for i, p in enumerate(picks)
+            ]
+            outs = [f.future.result(timeout=600.0) for f in futs]
+            outs += [d.future.result(timeout=600.0) for d in donors]
+            st = eng.stats()
+        finally:
+            srv.stop()
+        return outs, st
+
+    outs_on, st_on = arm(True)
+    outs_off, st_off = arm(False)
+    chunks_on = st_on["prefill_chunks"]
+    chunks_off = st_off["prefill_chunks"]
+    return {
+        "k_prompts": k_prompts,
+        "n_requests": n_requests,
+        "prefix_hit_rate": round(st_on["prefix_hit_rate"], 4),
+        "prefix_hits": st_on["prefix_hits"],
+        "prefill_tokens_saved": st_on["prefill_tokens_saved"],
+        "cow_pages": st_on["cow_pages"],
+        "resident_bytes_dedup_ratio": round(
+            st_on["peak_dedup_ratio"], 3
+        ),
+        "prefill_chunks_sharing_on": chunks_on,
+        "prefill_chunks_sharing_off": chunks_off,
+        "prefill_chunk_reduction": (
+            round(chunks_off / chunks_on, 2) if chunks_on else None
+        ),
+        "bitwise_equal_vs_sharing_off": outs_on == outs_off,
+    }
+
+
 def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
               max_len=64, page_size=8, prefill_chunk=8, max_new=8,
               p99_target_ms=60000.0, seed=0, paged=True,
               compare_gather=True, spec_k=3, compare_spec=True,
-              measure_migration=True):
+              measure_migration=True, measure_prefix=True):
     """Serving throughput: tokens/sec at a fixed p99 latency target.
 
     Drives the continuous-batching engine (dlrover_tpu/serving/) with
@@ -844,7 +956,13 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
     arm would measure only verify overhead. ``speedup_vs_specoff``
     is reported as measured: on CPU the batched verify step often
     does NOT beat plain decode (the crossover needs accelerator
-    batch economics), and the artifact says so honestly."""
+    batch economics), and the artifact says so honestly.
+
+    With ``measure_prefix`` a hot-prefix trace (Zipf-ish mix of shared
+    system prompts × unique suffixes) runs twice at the same seed —
+    prefix sharing on vs off — and records the hit rate, the prefill
+    compute the radix index absorbed, the resident dedup ratio, and a
+    bitwise-equality flag under ``"prefix"``."""
     import numpy as np
 
     import jax
@@ -1022,6 +1140,12 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
         record["migration"] = migr
         record["migration_recovery_s"] = (
             migr.get("migration_recovery_s") if migr else None
+        )
+    if measure_prefix:
+        record["prefix"] = _measure_hot_prefix(
+            params, cfg, n_slots=n_slots, max_len=max_len,
+            page_size=page_size, mode=mode, prefill_chunk=prefill_chunk,
+            seed=seed,
         )
     return record
 

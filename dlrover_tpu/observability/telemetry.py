@@ -310,6 +310,14 @@ class ServingRecord:
     rejected: int = 0
     timed_out: int = 0
     poisoned: int = 0
+    # prefix sharing (serving/prefix.py): hit rate over sharing-on
+    # admissions, prompt tokens whose prefill was skipped, live radix
+    # index size in pages, and resident-bytes dedup (slot cells per
+    # unique physical page). Defaults replay pre-sharing recordings.
+    prefix_hit_rate: float = 0.0
+    prefill_tokens_saved: int = 0
+    trie_pages: int = 0
+    dedup_ratio: float = 1.0
     hists: str = ""
     ts: float = 0.0
 
@@ -381,6 +389,10 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("serving_rejected", "rejected"),
         ("serving_timed_out", "timed_out"),
         ("serving_poisoned", "poisoned"),
+        ("serving_prefix_hit_rate", "prefix_hit_rate"),
+        ("serving_prefill_tokens_saved", "prefill_tokens_saved"),
+        ("serving_trie_pages", "trie_pages"),
+        ("serving_dedup_ratio", "dedup_ratio"),
     ],
 }
 _COUNTER_MAP: Dict[str, str] = {

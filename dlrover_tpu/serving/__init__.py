@@ -3,8 +3,13 @@
 Layers (bottom up):
 
 - ``kv_cache``   — paged KV cache: block-table allocator over fixed-size
-  pages, int8 storage with per-block scales (``ops/quant.py`` encode) or
+  pages (refcounted, so committed prefix pages can back several slots),
+  int8 storage with per-block scales (``ops/quant.py`` encode) or
   a bf16 reference mode, gather/write helpers that run inside jit.
+- ``prefix``     — host-side radix index over committed KV pages:
+  interned as chunked prefill commits full prompt pages, consulted at
+  admission to map a hot prefix's pages copy-on-write into a new slot
+  (zero prefill compute for the matched run, one physical copy).
 - ``engine``     — the continuous-batching decode loop: fixed decode
   slots, admit/evict at step boundaries, chunked prefill.
 - ``scheduler``  — threaded request queue: priority by arrival,

@@ -57,6 +57,19 @@ The block-table device array is re-shipped only when the allocator
 reports a mutation (``consume_dirty``) — steady-state decode steps
 reuse the cached device copy.
 
+Prefix sharing (``prefix_sharing=True``): committed prompt pages are
+interned into a radix index (``serving/prefix.py``) as chunked prefill
+fills them, and admission consults the index — on a hit the new slot's
+block-table prefix maps the SAME physical pages (refcounted in the
+allocator), prefill resumes at the first divergent chunk boundary, and
+a partially-matched tail page is copy-on-write duplicated before the
+slot may write into it. Shared pages are read-only through both decode
+kernels for free: attention reads via block tables, and every write the
+engine issues lands at positions ≥ the resume point, which the plan
+keeps strictly above the shared pages. ``admission_lookahead`` lets the
+scheduler admit a later request whose (prefix-discounted) footprint
+fits past a blocked cold head-of-line request.
+
 Alignment invariant: the slot capacity ``S_max`` must be a multiple of
 ``prefill_chunk``. Chunk starts are always multiples of the chunk width,
 and ``lax.dynamic_slice`` CLAMPS out-of-bounds starts — an unaligned
@@ -76,6 +89,7 @@ from dlrover_tpu.models import decoder, generate
 from dlrover_tpu.observability.tracing import get_tracer
 from dlrover_tpu.ops import pallas_paged, quant
 from dlrover_tpu.serving import kv_cache as kvc
+from dlrover_tpu.serving import prefix as prefix_mod
 from dlrover_tpu.serving.scheduler import AdmissionError, Request, Scheduler
 
 
@@ -134,6 +148,7 @@ class _Slot:
     n_prefilled: int = 0
     generated: List[int] = field(default_factory=list)
     span: object = None         # open "serving.decode" trace span, if any
+    interned_pages: int = 0     # full prompt pages already in the trie
 
 
 class ServingEngine:
@@ -155,6 +170,8 @@ class ServingEngine:
         page_bucketing: bool = True,
         spec_k: int = 0,
         draft: Optional[DraftModel] = None,
+        prefix_sharing: bool = False,
+        admission_lookahead: int = 0,
     ):
         self.params = params
         self.cfg = cfg
@@ -180,6 +197,14 @@ class ServingEngine:
             )
         self.alloc = kvc.PageAllocator(self.geom, n_slots)
         self.pools = kvc.init_pools(self.geom)
+        self.prefix_sharing = bool(prefix_sharing)
+        self.admission_lookahead = int(admission_lookahead)
+        self.trie: Optional[prefix_mod.PrefixIndex] = None
+        if self.prefix_sharing:
+            self.trie = prefix_mod.PrefixIndex(page_size)
+            # pages whose refcount hits zero leave the index atomically
+            # with their free-list return
+            self.alloc.on_free = self.trie.drop_pages
         self.slots: List[Optional[_Slot]] = [None] * n_slots
         self.draining = False     # planned drain: stop admitting new work
         self._tokens = 0
@@ -190,8 +215,14 @@ class ServingEngine:
         self._draft_tokens = 0    # drafts proposed to the verify step
         self._accepted_tokens = 0  # drafts that survived acceptance
         self._prefill_tokens = 0  # prompt tokens run through the chunk fn
+        self._prefill_chunks = 0  # chunk_fn invocations (the compute unit)
         self._migrated_in = 0     # requests adopted as live KV pages
         self._migrated_out = 0    # requests donated as live KV pages
+        self._prefix_hits = 0     # admissions that mapped shared pages
+        self._prefix_misses = 0   # sharing-on admissions with no usable hit
+        self._prefill_tokens_saved = 0  # prompt tokens skipped via hits
+        self._cow_pages = 0       # tail pages copy-on-write duplicated
+        self._peak_dedup = 1.0    # peak Σ slot cells / unique pages
 
         geom = self.geom
         chunk_w = prefill_chunk
@@ -454,9 +485,36 @@ class ServingEngine:
             # migration accounting: the drill's zero-re-prefill assertion
             # reads prefill_tokens before/after a failover
             "prefill_tokens": self._prefill_tokens,
+            "prefill_chunks": self._prefill_chunks,
             "migrated_in": self._migrated_in,
             "migrated_out": self._migrated_out,
+            # prefix sharing: hit rate over sharing-on admissions, prompt
+            # tokens whose prefill was skipped, COW duplications, live
+            # trie size, and the dedup ratio (slot cells per unique
+            # physical page — 1.0 means nothing is shared)
+            "prefix_hit_rate": (
+                self._prefix_hits / (self._prefix_hits + self._prefix_misses)
+                if (self._prefix_hits + self._prefix_misses) else 0.0
+            ),
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "prefill_tokens_saved": self._prefill_tokens_saved,
+            "cow_pages": self._cow_pages,
+            "trie_pages": (
+                self.trie.n_pages if self.trie is not None else 0
+            ),
+            "dedup_ratio": self.dedup_ratio(),
+            "peak_dedup_ratio": self._peak_dedup,
         }
+
+    def dedup_ratio(self) -> float:
+        """Σ slot cells / unique assigned pages — how many logical pages
+        each resident physical page serves (resident-bytes dedup)."""
+        unique = self.alloc.unique_assigned_pages
+        if not unique:
+            return 1.0
+        cells = sum(self.alloc.slot_pages(i) for i in range(self.n_slots))
+        return cells / unique
 
     def resident_kv_bytes(self) -> int:
         return kvc.resident_bytes(self.geom)
@@ -494,6 +552,20 @@ class ServingEngine:
             "active_slots": es["active_slots"],
             "tokens_per_s": round(es["tokens_per_s"], 2),
             "spec_accept_rate": round(es["spec_accept_rate"], 4),
+            # trie stats ride along so a watchdog capture can tell
+            # "out of pages" from "dedup regressed" (hot prefixes
+            # falling out of the index under churn)
+            "prefix": {
+                "sharing": self.prefix_sharing,
+                "hit_rate": round(es["prefix_hit_rate"], 4),
+                "trie_pages": es["trie_pages"],
+                "trie": (
+                    self.trie.stats() if self.trie is not None else {}
+                ),
+                "dedup_ratio": round(es["dedup_ratio"], 4),
+                "prefill_tokens_saved": es["prefill_tokens_saved"],
+                "cow_pages": es["cow_pages"],
+            },
         }
 
     # ---- device-side inputs ----------------------------------------------
@@ -577,6 +649,19 @@ class ServingEngine:
             worked = True
         return worked
 
+    def _prefix_plan(self, req) -> Optional["prefix_mod.AdmissionPlan"]:
+        """The admission recipe for ``req`` under prefix sharing: which
+        committed pages its prompt can map, where prefill resumes. None
+        when sharing is off or the trie has no usable match."""
+        if self.trie is None:
+            return None
+        match = self.trie.lookup(req.prompt)
+        if not match.pages and not match.tail_tokens:
+            return None
+        return prefix_mod.plan_admission(
+            match, len(req.prompt), self.geom.page_size, self.prefill_chunk
+        )
+
     def _admit(self) -> bool:
         worked = False
         if self.draining:
@@ -592,9 +677,17 @@ class ServingEngine:
                 # (they would block the head of the line forever)
                 if req.total_tokens > self.geom.max_len:
                     return True
-                return self.alloc.can_admit(req.total_tokens)
+                # hit-aware footprint: read-only shared prefix pages are
+                # mapped, not drawn from the free list — a hot-prefix
+                # request can fit where a cold one of the same length
+                # cannot (COW pages are fresh and get no discount)
+                plan = self._prefix_plan(req)
+                n_shared = len(plan.shared) if plan else 0
+                return self.alloc.can_admit(req.total_tokens, n_shared)
 
-            req = self.scheduler.pop_next(can)
+            req = self.scheduler.pop_next(
+                can, lookahead=self.admission_lookahead
+            )
             if req is None:
                 return worked
             if req.total_tokens > self.geom.max_len:
@@ -624,12 +717,35 @@ class ServingEngine:
                 self.scheduler.fail(req, err)
                 continue
             # reserve the FULL prompt+generation footprint up front so a
-            # decoding slot can never deadlock waiting for pages
-            self.alloc.admit(idx, req.total_tokens)
+            # decoding slot can never deadlock waiting for pages; on a
+            # prefix hit the matched prefix maps existing pages instead
+            # of drawing fresh ones, and prefill resumes at the plan's
+            # chunk-aligned resume point
+            plan = self._prefix_plan(req)
+            resume = 0
+            if plan is not None:
+                self.alloc.admit_shared(
+                    idx, req.total_tokens, plan.prefix_pages
+                )
+                for logical, _src in plan.cow:
+                    pair = self.alloc.cow_page(idx, logical)
+                    if pair is not None:
+                        self._copy_page(*pair)
+                        self._cow_pages += 1
+                resume = plan.resume
+                self._prefix_hits += 1
+                self._prefill_tokens_saved += resume
+            else:
+                self.alloc.admit(idx, req.total_tokens)
+                if self.prefix_sharing:
+                    self._prefix_misses += 1
+            self._peak_dedup = max(self._peak_dedup, self.dedup_ratio())
             self.slots[idx] = _Slot(
                 req=req, phase="prefill",
                 prompt=np.asarray(req.prompt, np.int32),
                 key_data=key_data,
+                n_prefilled=resume,
+                interned_pages=len(plan.shared) if plan else 0,
             )
             self.scheduler.record_admitted(req)
             tr = get_tracer()
@@ -637,9 +753,32 @@ class ServingEngine:
                 tr.instant(
                     "serving.admit", rid=req.rid,
                     replica=self.scheduler.replica, slot=idx,
-                    re_admits=req.re_admits,
+                    re_admits=req.re_admits, prefix_resume=resume,
                 )
             worked = True
+
+    # ---- prefix sharing helpers ------------------------------------------
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        """Copy one physical page's payload across every pool array —
+        the device half of a COW duplication (all layers, one page)."""
+        for k, v in self.pools.items():
+            self.pools[k] = v.at[:, dst].set(v[:, src])
+
+    def _intern_full_pages(self, i: int, s: _Slot) -> None:
+        """Index the slot's newly COMMITTED full prompt pages. Only
+        pages that are pure prompt — ``(j+1)*page_size <= len(prompt)``
+        — and fully prefilled are eligible: a page carrying generated
+        tokens (or an uncommitted tail) is not a reusable prefix."""
+        if self.trie is None:
+            return
+        ps = self.geom.page_size
+        full = min(int(s.n_prefilled), len(s.prompt)) // ps
+        if full <= s.interned_pages:
+            return
+        row = self.alloc.block_tables()[i]
+        self.trie.intern(s.prompt, full, row)
+        s.interned_pages = full
 
     # ---- live KV-page migration (serving/migration.py) -------------------
 
@@ -744,6 +883,11 @@ class ServingEngine:
                 replica=self.scheduler.replica, slot=idx, resumed=True,
             )
         self.slots[idx] = slot
+        # re-intern the imported prompt pages: the survivor's trie has
+        # never seen them (sharing structure does not travel the wire —
+        # the donor ships private payload copies), so future hot-prefix
+        # requests on this replica can share them
+        self._intern_full_pages(idx, slot)
         if self._t0 is None:
             self._t0 = time.monotonic()
         self._migrated_in += 1
@@ -804,6 +948,8 @@ class ServingEngine:
                 sp.end()
             s.n_prefilled += clen
             self._prefill_tokens += clen
+            self._prefill_chunks += 1
+            self._intern_full_pages(i, s)
             if s.n_prefilled == p:
                 s.generated = [int(tok0[0])]
                 s.phase = "decode"

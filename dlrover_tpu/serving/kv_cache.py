@@ -31,9 +31,18 @@ page migration between replicas (``serving/migration.py``) holds its
 survivor-side footprint through the allocator's named reservations
 (``reserve_for_migration`` / ``commit_migration`` / ``abort_migration``)
 so an in-flight transfer can never lose its landing pages to admission.
+
+Committed pages are shareable: every physical page carries a refcount,
+``admit_shared`` maps a prefix of another slot's pages into a new slot's
+table row (rc+1, zero prefill compute for those pages), ``cow_page``
+gives a slot a private copy of a shared page before it may write into
+it, and ``evict`` decrements — a page returns to the free list only at
+rc==0. The radix index that decides WHICH pages a new prompt can share
+lives in ``serving/prefix.py``; this module only enforces the refcount
+discipline.
 """
 
-from typing import Dict, List, NamedTuple, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -220,10 +229,12 @@ def write_rows(
 ) -> Dict:
     """Scatter token K/V rows into their slots' pages (jit-side).
 
-    Distinct live (slot, position) pairs always map to distinct
-    (page, offset) cells because the allocator never double-assigns a
-    page; only trash-page lanes may collide, and those are garbage by
-    contract."""
+    Distinct live (slot, position) pairs that WRITE always map to
+    distinct (page, offset) cells: the allocator hands a fresh page to
+    exactly one slot, and a shared page (rc > 1, prefix sharing) is
+    read-only by contract — the engine COW-duplicates it before any
+    sharer may write past the committed prefix. Only trash-page lanes
+    may collide, and those are garbage by construction."""
     g = geom
     page_idx = positions // g.page_size
     offs = positions % g.page_size
@@ -255,16 +266,24 @@ class PageAllocator:
     Invariants (pinned by the property test in
     tests/test_serving_kv_cache.py):
 
-    - a physical page is assigned to at most one (slot, logical) cell;
+    - every physical page's refcount equals the number of (slot, logical)
+      table cells mapping it — 1 for a private page, >1 when prefix
+      sharing maps one committed page into several slots;
     - page 0 (trash) is never handed out;
-    - ``evict`` returns every page the slot held to the free list;
-    - free + assigned + reserved is a partition of pages 1..n_pages-1.
+    - ``evict`` decrements each held page's refcount and frees only the
+      pages that reach rc==0 (sharers keep the rest alive);
+    - free + assigned-unique (rc ≥ 1) + reserved is a partition of pages
+      1..n_pages-1.
 
     Reservations are the migration footprint hold: pages moved from the
     free list into a named bucket, invisible to ``can_admit``/``ensure``
     until ``commit_migration`` assigns them to a slot or
     ``abort_migration`` returns them. Mutations are not locked — callers
     serialize through the engine thread (or ``GenerationServer.paused()``).
+
+    ``on_free`` (optional) fires with the list of physical pages whose
+    refcount just hit zero — the prefix index hangs its invalidation off
+    this so a recycled page can never be offered as a prefix hit.
     """
 
     def __init__(self, geom: PageGeometry, n_slots: int):
@@ -277,9 +296,17 @@ class PageAllocator:
             (n_slots, geom.max_pages_per_slot), -1, np.int32
         )
         self._n_pages = np.zeros(n_slots, np.int32)
+        # per-physical-page refcount: number of (slot, logical) cells
+        # mapping the page. Free and reserved pages sit at 0.
+        self._rc = np.zeros(geom.n_pages, np.int32)
         # set by every table mutation; the engine consumes it to re-ship
         # the device copy only when something actually changed
         self._dirty = True
+        # cached host-side snapshot for block_tables(); invalidated by
+        # the same mutations that set _dirty (but cleared independently:
+        # consume_dirty() must not force the next block_tables() to copy)
+        self._snap: Optional[np.ndarray] = None
+        self.on_free: Optional[Callable[[List[int]], None]] = None
 
     # ---- queries ---------------------------------------------------------
 
@@ -290,15 +317,28 @@ class PageAllocator:
     def pages_needed(self, n_tokens: int) -> int:
         return -(-max(int(n_tokens), 0) // self.geom.page_size)
 
-    def can_admit(self, n_tokens: int) -> bool:
+    def can_admit(self, n_tokens: int, n_shared: int = 0) -> bool:
+        """True when a slot covering ``n_tokens`` fits. ``n_shared``
+        discounts prefix pages that would be MAPPED rather than drawn
+        from the free list (a prefix hit's read-only shared pages —
+        COW'd tail pages are fresh allocations and get no discount)."""
         need = self.pages_needed(n_tokens)
         return (
             need <= self.geom.max_pages_per_slot
-            and need <= len(self._free)
+            and need - min(int(n_shared), need) <= len(self._free)
         )
 
     def slot_pages(self, slot: int) -> int:
         return int(self._n_pages[slot])
+
+    def refcount(self, page: int) -> int:
+        return int(self._rc[page])
+
+    @property
+    def unique_assigned_pages(self) -> int:
+        """Distinct physical pages held by any slot — the denominator of
+        the dedup ratio (Σ slot cells / unique pages)."""
+        return int(np.count_nonzero(self._rc))
 
     @property
     def reserved_pages(self) -> int:
@@ -309,9 +349,17 @@ class PageAllocator:
         return tuple(self._reserved.get(tag, ()))
 
     def block_tables(self) -> np.ndarray:
-        """The live [n_slots, max_pages] table (copy — jit inputs must
-        not alias a buffer ``evict``/``ensure`` mutates mid-step)."""
-        return self._tables.copy()
+        """A host-side snapshot of the [n_slots, max_pages] table.
+
+        The snapshot is cached between mutations: the common steady
+        state (no admit/grow/evict this step) returns the SAME array
+        without re-copying. Mutations write ``self._tables`` and drop
+        the cache, so a previously returned snapshot never aliases a
+        buffer ``evict``/``ensure`` mutates mid-step — callers may hand
+        it to jit or keep it across steps."""
+        if self._snap is None:
+            self._snap = self._tables.copy()
+        return self._snap
 
     def consume_dirty(self) -> bool:
         """True exactly once after any table mutation since the last
@@ -343,20 +391,94 @@ class PageAllocator:
         if grow > len(self._free):
             return False
         for i in range(have, need):
-            self._tables[slot, i] = self._free.pop()
+            p = self._free.pop()
+            self._tables[slot, i] = p
+            self._rc[p] = 1
         self._n_pages[slot] = need
         self._dirty = True
+        self._snap = None
         return True
 
+    def admit_shared(
+        self, slot: int, n_tokens: int, prefix_pages: Sequence[int]
+    ) -> bool:
+        """Admit an EMPTY slot covering ``n_tokens``, mapping logical
+        pages 0..len(prefix_pages)-1 onto EXISTING physical pages
+        (rc+1 each — a prefix hit) and drawing the remainder fresh.
+        False (state unchanged) when the free list cannot cover the
+        unshared suffix. Shared pages are read-only for this slot until
+        ``cow_page`` gives it a private copy."""
+        if self._n_pages[slot]:
+            raise ValueError(f"slot {slot} already holds pages")
+        need = self.pages_needed(n_tokens)
+        shared = list(prefix_pages)
+        if len(shared) > need:
+            raise ValueError(
+                f"prefix ({len(shared)} pages) exceeds footprint ({need})"
+            )
+        if need > self.geom.max_pages_per_slot:
+            return False
+        if need - len(shared) > len(self._free):
+            return False
+        for p in shared:  # validate BEFORE mutating — no partial maps
+            if not (TRASH_PAGE < p < self.geom.n_pages) or self._rc[p] < 1:
+                raise ValueError(f"prefix page {p} is not live")
+        for i, p in enumerate(shared):
+            self._tables[slot, i] = p
+            self._rc[p] += 1
+        for i in range(len(shared), need):
+            p = self._free.pop()
+            self._tables[slot, i] = p
+            self._rc[p] = 1
+        self._n_pages[slot] = need
+        if need:
+            self._dirty = True
+            self._snap = None
+        return True
+
+    def cow_page(self, slot: int, logical: int) -> Optional[Tuple[int, int]]:
+        """Give ``slot`` a private copy of its ``logical`` page before it
+        writes into it. No-op (returns None) when the page is already
+        private (rc==1). Otherwise pops a fresh page, remaps the cell,
+        and returns ``(src, dst)`` physical pages — the caller copies the
+        pool payload device-side. Raises when the free list is empty:
+        the admission footprint must already have accounted for the COW
+        page (``can_admit`` gives shared discounts only to read-only
+        prefix pages)."""
+        if not 0 <= logical < int(self._n_pages[slot]):
+            raise ValueError(f"slot {slot} has no logical page {logical}")
+        src = int(self._tables[slot, logical])
+        if self._rc[src] == 1:
+            return None
+        if not self._free:
+            raise RuntimeError("cow_page: free list empty (footprint bug)")
+        dst = self._free.pop()
+        self._tables[slot, logical] = dst
+        self._rc[src] -= 1
+        self._rc[dst] = 1
+        self._dirty = True
+        self._snap = None
+        return src, dst
+
     def evict(self, slot: int) -> int:
-        """Free every page the slot holds; returns the count freed."""
+        """Release every page the slot holds (rc−1 each; pages reaching
+        rc==0 return to the free list); returns the CELL count released
+        — the slot's logical footprint, not the pages actually freed."""
         n = int(self._n_pages[slot])
+        freed: List[int] = []
         for i in range(n):
-            self._free.append(int(self._tables[slot, i]))
+            p = int(self._tables[slot, i])
+            self._rc[p] -= 1
+            if self._rc[p] == 0:
+                self._free.append(p)
+                freed.append(p)
         self._tables[slot, :] = -1
         self._n_pages[slot] = 0
         if n:
             self._dirty = True
+            self._snap = None
+        if freed and self.on_free is not None:
+            self.on_free(freed)
         return n
 
     # ---- migration reservations ------------------------------------------
@@ -384,9 +506,11 @@ class PageAllocator:
         pages = self._reserved.pop(tag)
         for i, p in enumerate(pages):
             self._tables[slot, i] = p
+            self._rc[p] = 1
         self._n_pages[slot] = len(pages)
         if pages:
             self._dirty = True
+            self._snap = None
         return list(pages)
 
     def abort_migration(self, tag: str) -> int:

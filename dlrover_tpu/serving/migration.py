@@ -40,6 +40,16 @@ re-prefill path (abort reservations, ``re_admit`` every non-resumed
 request under its original ticket) — NEVER to a lost request. The final
 ``reshard_recovery`` telemetry event carries ``path=live|fallback``.
 
+Prefix sharing (refcounted pages) composes without special cases here:
+``export_pages`` ships a slot's pages BY VALUE, so two victim requests
+sharing prefix pages each carry a private copy and land independently;
+the survivor's ``import_slot`` re-interns imported full prompt pages so
+the hot prefix is immediately shareable again, and releasing the donor
+slots goes through the refcounted evict — shared pages decrement once
+per holder and return to the free list exactly once (the shared-pages
+drill in tests/test_serving_prefix.py pins both allocators' refcount
+conservation at drill end).
+
 Fault injection points: ``serving.detect`` / ``serving.plan`` /
 ``serving.reserve`` / ``serving.transfer`` / ``serving.resume`` with
 ``rank`` = the acting replica's node_id (donor for detect/plan/transfer,

@@ -277,13 +277,25 @@ class Scheduler:
 
     # ---- engine side -----------------------------------------------------
 
-    def pop_next(self, can_admit=None) -> Optional[Request]:
+    def pop_next(
+        self, can_admit=None, lookahead: int = 0
+    ) -> Optional[Request]:
         """Pop the highest-priority request, or None when empty or when
         ``can_admit(req)`` rejects the head (head-of-line admission:
         lower-ranked requests never jump a head waiting on pages).
         Requests whose wall deadline already expired in the queue are
         failed fast (counted ``timed_out``) instead of burning slot
-        time on an answer nobody is waiting for."""
+        time on an answer nobody is waiting for.
+
+        ``lookahead > 0`` relaxes strict head-of-line when the head is
+        BLOCKED: up to ``lookahead`` requests behind it are offered to
+        ``can_admit`` in heap order and the first admissible one is
+        popped. With a hit-aware ``can_admit`` (prefix sharing) this
+        lets a cheap hot-prefix request — whose resident prefix pages
+        cost nothing from the free list — run instead of idling a slot
+        behind an expensive cold request. The head keeps its ticket and
+        is re-offered first on every later call, so it is delayed only
+        while it cannot run anyway — never starved by the jumpers."""
         expired: List[Request] = []
         got: Optional[Request] = None
         with self._lock:
@@ -302,6 +314,10 @@ class Scheduler:
                     expired.append(req)
                     continue
                 if can_admit is not None and not can_admit(req):
+                    if lookahead > 0:
+                        got = self._pop_lookahead_locked(
+                            can_admit, lookahead, now
+                        )
                     break
                 heapq.heappop(self._heap)
                 got = req
@@ -315,6 +331,28 @@ class Scheduler:
                 ),
             )
         return got
+
+    def _pop_lookahead_locked(
+        self, can_admit, lookahead: int, now: float
+    ) -> Optional[Request]:
+        """Scan up to ``lookahead`` requests behind a blocked head (heap
+        order) and pop the first one ``can_admit`` accepts. Cancelled /
+        expired candidates are skipped in place — the head pass owns
+        their bookkeeping. Caller holds ``_lock``."""
+        for t in heapq.nsmallest(lookahead + 1, self._heap)[1:]:
+            req = t[-1]
+            if req.future.cancelled() or req.future.done():
+                continue
+            if (
+                req.deadline_s is not None
+                and now - req.submit_t > req.deadline_s
+            ):
+                continue
+            if can_admit(req):
+                self._heap.remove(t)
+                heapq.heapify(self._heap)
+                return req
+        return None
 
     def record_admitted(self, req: Request) -> None:
         """Engine-side admission hook: close the queue-wait interval
@@ -454,6 +492,10 @@ class Scheduler:
             rejected=self.rejected,
             timed_out=self.timed_out,
             poisoned=self.poisoned,
+            prefix_hit_rate=float(es.get("prefix_hit_rate", 0.0)),
+            prefill_tokens_saved=int(es.get("prefill_tokens_saved", 0)),
+            trie_pages=int(es.get("trie_pages", 0)),
+            dedup_ratio=float(es.get("dedup_ratio", 1.0)),
             hists=json.dumps(
                 {k: hists[k].to_dict() for k in LATENCY_PHASES},
                 sort_keys=True,

@@ -323,6 +323,7 @@ def test_comm_perf_test_reports_bandwidth():
     assert len(res2) == 2
 
 
+@pytest.mark.slow
 def test_prewarm_produces_the_exact_step_executable(tmp_path, monkeypatch):
     """Re-mesh pre-warming (SURVEY §7's 'pre-compile async where
     possible'): AOT-lowering the train step for a candidate world must

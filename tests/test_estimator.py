@@ -430,6 +430,7 @@ def test_ring_snapshot_interchanges_with_local(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_estimator_trains_checkpoints_and_prunes(tmp_path):
     s0, s1 = _start_server(), _start_server()
     try:

@@ -208,6 +208,7 @@ class _FlagAt(Callback):
             setattr(control, self._flag, True)
 
 
+@pytest.mark.slow
 def test_mid_block_save_flag_honored_at_next_boundary(tmp_path):
     # drain of block [1..5] sees step 3 raise should_save while block
     # [6..10] is in flight: the save must land at a block end (10 or
@@ -223,6 +224,7 @@ def test_mid_block_save_flag_honored_at_next_boundary(tmp_path):
     assert trainer.checkpointer.latest_committed_step() >= rec.saves[0]
 
 
+@pytest.mark.slow
 def test_mid_block_stop_flag_stops_at_boundary(tmp_path):
     _, rec, state = _run(
         tmp_path, 5, max_steps=100, save_interval=0,

@@ -68,6 +68,7 @@ def test_grads_match_reference(v, block_v):
     np.testing.assert_allclose(fdw, rdw, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_loss_fn_fused_matches_unfused():
     """End-to-end: decoder.loss_fn with fused_ce on vs off (f32)."""
     import dataclasses
@@ -93,6 +94,7 @@ def test_loss_fn_fused_matches_unfused():
     )
 
 
+@pytest.mark.slow
 def test_fused_ce_under_tp_mesh_falls_back():
     """On a tp>1 mesh loss_fn must take the unfused (vocab-parallel)
     path and still produce the same loss as fused on a single device."""

@@ -38,6 +38,7 @@ def test_init_kv_cache_pinned_to_decoder():
     assert c["k"].dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_external_cache_rollout_bitwise_identical():
     cfg = _cfg()
     params = decoder.init(jax.random.key(0), cfg)

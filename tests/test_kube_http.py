@@ -368,6 +368,7 @@ def test_watch_streams_resume_and_410(api_server):
         next(iter(api.watch(kind="Pod", since_rv=1)))
 
 
+@pytest.mark.slow
 def test_reconcile_loop_over_real_http_client(api_server):
     """The keystone swap: the SAME PodWatcher + JobManager + SliceScaler
     wiring as test_kube.py's end-to-end loop, with every API call going
@@ -582,6 +583,7 @@ def test_watch_passes_opaque_rvs_through_and_skips_bookmarks(api_server):
     assert opaque, f"no opaque resume tokens seen: {server.seen_watch_rvs}"
 
 
+@pytest.mark.slow
 def test_pod_watcher_survives_410_by_relisting(api_server):
     """The full resume-by-relist loop: a watch whose rv fell out of the
     server's history window (410 Gone) must not kill the PodWatcher —

@@ -75,6 +75,7 @@ def test_sharded_init_and_step(mesh):
     assert int(state["step"]) == 4
 
 
+@pytest.mark.slow
 def test_offload_attn_remat_matches_no_remat():
     """remat='offload_attn' (selective activation offload to pinned
     host) must not change gradients."""
@@ -111,6 +112,7 @@ def test_save_qkv_offload_matches_save_qkv():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_remat_dtype_cast_close_to_full_precision():
     """remat_dtype='bfloat16' narrows only the SAVED residuals; grads
     stay close to the uncast policy (storage round-trip noise only)."""
@@ -269,6 +271,7 @@ def test_streamed_offload_adamw_matches_resident(mesh):
     )
 
 
+@pytest.mark.slow
 def test_streamed_offload_serializes_leaf_transfers(mesh):
     """Structural proof of the working-set bound: the compiled step's
     HLO chains every moment leaf through opt-barriers, so leaf i+1's

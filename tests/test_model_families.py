@@ -15,6 +15,7 @@ from dlrover_tpu.ops.attention import mha_reference
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_parallel_residual_forward_and_grads():
     cfg = get_config("tiny-neox")
     params = decoder.init(jax.random.key(0), cfg)
@@ -175,6 +176,7 @@ def test_glm_loss_and_grads_with_prefix_batch():
     )
 
 
+@pytest.mark.slow
 def test_glm_forward_on_sequence_parallel_mesh():
     """GLM + ring/ulysses: prefix-LM logits on an sp mesh match the
     single-device reference path."""
@@ -353,6 +355,7 @@ def test_window_decode_matches_forward():
     )
 
 
+@pytest.mark.slow
 def test_window_forward_on_sequence_parallel_mesh():
     """Decoder-level window wiring through BOTH sp paths: logits on an
     sp mesh match the single-device reference path (the window crosses
@@ -398,6 +401,7 @@ def test_mixtral_style_config():
     assert float(aux["moe_lb_loss"]) > 0.0  # router aux losses collected
 
 
+@pytest.mark.slow
 def test_glm_sample_runs_uncached():
     from dlrover_tpu.models.generate import greedy
 

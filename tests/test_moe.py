@@ -127,6 +127,7 @@ def test_switch_decoder_forward_finite():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_alltoall_matches_dense_dispatch(ep_mesh):
     """The explicit shard_map all-to-all path must compute the same output
     as the dense-einsum path (same gating, same experts)."""
@@ -222,6 +223,7 @@ def test_ragged_no_truncation_under_imbalance():
     )
 
 
+@pytest.mark.slow
 def test_ragged_sharded_matches_local():
     """shard_map'd ragged path (dp×tp token/width sharding) ≡ unsharded."""
     mesh = build_mesh(MeshConfig(dp=2, tp=2, fsdp=2))

@@ -30,6 +30,7 @@ def test_infshape_classification():
     assert InfShape((1024, 1024), (64, 64)).fan_in_mult == 16.0
 
 
+@pytest.mark.slow
 def test_zip_infshapes_on_decoder_params():
     cfg = get_config("tiny", d_model=256, d_ff=1024, mup_base_width=64,
                      n_layer=2)

@@ -208,6 +208,7 @@ def test_metrics_export_http():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_runtime_timer_samples_real_op_breakdown(tmp_path):
     import jax
     import jax.numpy as jnp

@@ -428,6 +428,7 @@ def test_deployment_probes_match_health_server():
     assert cont["readinessProbe"]["httpGet"]["path"] == "/readyz"
 
 
+@pytest.mark.slow
 def test_operator_entrypoint_main_loop_over_http():
     """Drive the REAL entrypoint body (argparse → RealKubeApi →
     election → controller) against the wire-level API server from

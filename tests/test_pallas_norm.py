@@ -139,6 +139,7 @@ def test_unknown_kind_raises():
         pallas_norm.norm(x, jnp.ones((8,)), None, "batchnorm")
 
 
+@pytest.mark.slow
 def test_decoder_fused_norm_matches_unfused():
     """End-to-end: a tiny decoder forward+grad with cfg.fused_norm=True
     (kernels in interpret mode) matches the default jnp build within

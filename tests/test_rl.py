@@ -131,6 +131,7 @@ def test_generate_shapes_and_greedy_determinism():
     np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompts))
 
 
+@pytest.mark.slow
 def test_model_engine_roles_and_update():
     cfg = _cfg()
     eng = ModelEngine(cfg, learning_rate=1e-2)
@@ -206,6 +207,7 @@ def test_ppo_increases_rewarded_token_probability():
     assert np.mean(scores[-3:]) > np.mean(scores[:3]), scores
 
 
+@pytest.mark.slow
 def test_cached_generation_matches_uncached_greedy():
     """decode_step + KV cache must reproduce full-prefix greedy decoding
     token for token."""
@@ -225,6 +227,7 @@ def test_cached_generation_matches_uncached_greedy():
     np.testing.assert_array_equal(np.asarray(cached), np.asarray(uncached))
 
 
+@pytest.mark.slow
 def test_prefix_lm_cached_matches_full():
     """Prefill builds the prefix-LM cache (bidirectional prompt K/V),
     so cached greedy decode must match the full-recompute path token
@@ -248,6 +251,7 @@ def test_prefix_lm_cached_matches_full():
     np.testing.assert_array_equal(np.asarray(cached), np.asarray(uncached))
 
 
+@pytest.mark.slow
 def test_prompt_lens_bound_the_bidirectional_prefix():
     """Ragged prefix-LM batches: per-sequence prompt_lens keep pad
     tokens out of the bidirectional prefix (ADVICE round-1 finding) and
@@ -292,6 +296,7 @@ def test_prompt_lens_bound_the_bidirectional_prefix():
     )
 
 
+@pytest.mark.slow
 def test_cached_rollout_speedup():
     """Prefill+decode must beat full-prefix recompute on rollout
     throughput (VERDICT round-1 item: batched RL rollouts ride the
@@ -347,6 +352,7 @@ def test_cached_generation_gqa_and_learned_pos():
     np.testing.assert_array_equal(np.asarray(cached), np.asarray(uncached))
 
 
+@pytest.mark.slow
 def test_decode_step_logits_match_forward():
     cfg = _cfg(n_layer=1)
     params = decoder.init(jax.random.key(5), cfg)
@@ -398,6 +404,7 @@ def test_model_engine_weight_sharing_accounting():
     assert eng2.weight_sets() > 2.8  # actor(+ref alias), critic, reward
 
 
+@pytest.mark.slow
 def test_rollout_reads_training_actor_buffers(tmp_path):
     """The rollout path must consume the SAME actor arrays the train
     step updates — no inference copy (the storage sharing the

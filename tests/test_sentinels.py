@@ -179,6 +179,7 @@ def _poison_loss(cfg, mesh):
     return loss_fn
 
 
+@pytest.mark.slow
 def test_replicated_sentinels_detect_injected_nan():
     cfg = _cfg()
     mesh = build_mesh(MeshConfig(dp=8))

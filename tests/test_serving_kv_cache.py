@@ -1,13 +1,16 @@
 """Paged KV cache invariants (serving/kv_cache.py).
 
-Property test over random admit/grow/evict/reserve/commit/abort traces:
-the allocator never double-assigns a physical page, never hands out the
-trash page, and eviction returns the slot's full page set — free +
-assigned + migration-reserved stays a partition of pages 1..n_pages-1
-at every step. Device-side: bf16 pages round-trip bitwise, int8 pages
-round-trip within the per-block scale bound, and the int8 geometry's
-resident bytes beat bf16 by ≥1.7×.
+Property test over random admit/grow/evict/share/cow/reserve/commit/
+abort traces: refcount conservation — every physical page's rc equals
+the number of (slot, logical) table cells mapping it — the trash page
+is never handed out, eviction decrements and frees only rc==0 pages,
+and free + assigned-unique + migration-reserved stays a partition of
+pages 1..n_pages-1 at every step. Device-side: bf16 pages round-trip
+bitwise, int8 pages round-trip within the per-block scale bound, and
+the int8 geometry's resident bytes beat bf16 by ≥1.7×.
 """
+
+from collections import Counter
 
 import numpy as np
 import pytest
@@ -29,22 +32,28 @@ def _cfg(**kw):
 
 
 def _check_partition(alloc, geom):
-    """free + assigned + reserved must partition pages 1..n_pages-1,
+    """Refcount conservation + partition: every page's rc equals the
+    number of (slot, logical) cells mapping it, and free +
+    assigned-unique (rc ≥ 1) + reserved partitions pages 1..n_pages-1,
     trash excluded."""
-    assigned = [
-        int(p)
-        for row in alloc._tables
-        for p in row
-        if p >= 0
-    ]
+    cells = Counter(
+        int(p) for row in alloc._tables for p in row if p >= 0
+    )
+    for page in range(geom.n_pages):
+        assert alloc.refcount(page) == cells.get(page, 0), page
     reserved = [int(p) for ps in alloc._reserved.values() for p in ps]
-    held = assigned + reserved
-    assert len(held) == len(set(held)), "double-assigned page"
-    assert kvc.TRASH_PAGE not in held, "trash page handed out"
+    assigned = set(cells)
+    assert kvc.TRASH_PAGE not in assigned, "trash page handed out"
+    assert kvc.TRASH_PAGE not in reserved, "trash page reserved"
+    assert len(reserved) == len(set(reserved)), "double-reserved page"
+    assert not assigned & set(reserved), "reserved page is mapped"
+    free = set(alloc._free)
+    assert len(alloc._free) == len(free), "duplicate free-list entry"
     universe = set(range(1, geom.n_pages))
-    assert set(held) | set(alloc._free) == universe
-    assert set(held) & set(alloc._free) == set()
+    assert assigned | set(reserved) | free == universe
+    assert not free & assigned and not free & set(reserved)
     assert alloc.reserved_pages == len(reserved)
+    assert alloc.unique_assigned_pages == len(assigned)
 
 
 def test_allocator_random_trace_property():
@@ -52,6 +61,13 @@ def test_allocator_random_trace_property():
         _cfg(), n_slots=4, max_len=40, page_size=4, mode="int8"
     )
     alloc = kvc.PageAllocator(geom, 4)
+    # on_free discipline: fires only for pages whose rc hit zero, and
+    # those pages must be back on the free list when it fires
+    def _on_free(pages):
+        for p in pages:
+            assert alloc.refcount(p) == 0
+            assert p in alloc._free
+    alloc.on_free = _on_free
     rng = np.random.default_rng(0)
     held = [0, 0, 0, 0]  # tokens covered per slot
     reservations = {}    # tag -> n_tokens reserved for migration
@@ -59,7 +75,8 @@ def test_allocator_random_trace_property():
     for _ in range(400):
         slot = int(rng.integers(0, 4))
         op = rng.choice(
-            ["admit", "grow", "evict", "reserve", "commit", "abort"]
+            ["admit", "grow", "evict", "share", "cow",
+             "reserve", "commit", "abort"]
         )
         if op == "admit" and held[slot] == 0:
             n = int(rng.integers(1, geom.max_len + 5))
@@ -83,11 +100,63 @@ def test_allocator_random_trace_property():
                 assert alloc.free_pages == before_free
                 assert alloc.slot_pages(slot) == before_pages
         elif op == "evict":
+            # with sharing live this is the RELEASE op: rc−1 per cell,
+            # only rc==0 pages return to the free list — a sharer's
+            # eviction must never free a sharee's pages
             n_pages = alloc.slot_pages(slot)
+            shared_out = sum(
+                1
+                for p in alloc._tables[slot, :n_pages]
+                if alloc.refcount(int(p)) > 1
+            )
+            before_free = alloc.free_pages
             freed = alloc.evict(slot)
-            assert freed == n_pages
+            assert freed == n_pages  # cell count, sharing-invisible
+            assert alloc.free_pages == before_free + n_pages - shared_out
             held[slot] = 0
             assert alloc.slot_pages(slot) == 0
+        elif op == "share" and held[slot] == 0:
+            donors = [
+                d for d in range(4) if d != slot and alloc.slot_pages(d)
+            ]
+            if not donors:
+                continue
+            donor = donors[int(rng.integers(0, len(donors)))]
+            m = int(rng.integers(1, alloc.slot_pages(donor) + 1))
+            prefix = [int(p) for p in alloc.block_tables()[donor, :m]]
+            n = int(rng.integers(m * geom.page_size, geom.max_len + 5))
+            before = alloc.free_pages
+            rc_before = [alloc.refcount(p) for p in prefix]
+            need = alloc.pages_needed(n)
+            ok = alloc.admit_shared(slot, n, prefix)
+            assert ok == (
+                need <= geom.max_pages_per_slot
+                and need - m <= before
+            )
+            if ok:
+                held[slot] = n
+                assert alloc.free_pages == before - (need - m)
+                for p, rc in zip(prefix, rc_before):
+                    assert alloc.refcount(p) == rc + 1
+            else:
+                assert alloc.free_pages == before
+                for p, rc in zip(prefix, rc_before):
+                    assert alloc.refcount(p) == rc
+        elif op == "cow" and held[slot] > 0:
+            logical = int(rng.integers(0, alloc.slot_pages(slot)))
+            src = int(alloc.block_tables()[slot, logical])
+            if alloc.refcount(src) == 1:
+                assert alloc.cow_page(slot, logical) is None
+            elif alloc.free_pages == 0:
+                with pytest.raises(RuntimeError):
+                    alloc.cow_page(slot, logical)
+            else:
+                rc_src = alloc.refcount(src)
+                got_src, dst = alloc.cow_page(slot, logical)
+                assert got_src == src
+                assert alloc.refcount(src) == rc_src - 1
+                assert alloc.refcount(dst) == 1
+                assert int(alloc.block_tables()[slot, logical]) == dst
         elif op == "reserve":
             tag = f"mig-{tag_seq}"
             tag_seq += 1
@@ -265,6 +334,63 @@ def test_consume_dirty_true_once_per_mutation():
     assert not alloc.consume_dirty()
     assert alloc.evict(0) == 3
     assert alloc.consume_dirty()
+
+
+def test_block_tables_snapshot_cached_until_mutation():
+    """The common no-mutation step must not pay a full-array copy:
+    ``block_tables()`` returns the SAME snapshot until the allocator
+    mutates, and an old snapshot never aliases the live buffer."""
+    geom = kvc.make_geometry(
+        _cfg(), n_slots=2, max_len=16, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 2)
+    t1 = alloc.block_tables()
+    assert alloc.block_tables() is t1        # cached, no re-copy
+    assert alloc.admit(0, 5)
+    t2 = alloc.block_tables()
+    assert t2 is not t1                      # mutation invalidates
+    assert (t1 == -1).all()                  # old snapshot frozen
+    assert alloc.block_tables() is t2
+    # the cache is independent of consume_dirty: the engine draining
+    # the dirty flag must not force the next block_tables() to copy
+    assert alloc.consume_dirty()
+    assert alloc.block_tables() is t2
+    assert alloc.evict(0) == 2
+    t3 = alloc.block_tables()
+    assert t3 is not t2 and int(t2[0, 0]) >= 0
+    # cow + shared admission invalidate too (table cells change)
+    assert alloc.admit(0, 5)
+    row = [int(p) for p in alloc.block_tables()[0, :1]]
+    t4 = alloc.block_tables()
+    assert alloc.admit_shared(1, 4, row)
+    assert alloc.block_tables() is not t4
+    t5 = alloc.block_tables()
+    assert alloc.cow_page(1, 0) is not None
+    assert alloc.block_tables() is not t5
+
+
+def test_share_and_cow_edges():
+    geom = kvc.make_geometry(
+        _cfg(), n_slots=3, max_len=16, page_size=4, mode="bf16"
+    )
+    alloc = kvc.PageAllocator(geom, 3)
+    assert alloc.admit(0, 16)
+    row = [int(p) for p in alloc.block_tables()[0]]
+    with pytest.raises(ValueError):   # occupied slot
+        alloc.admit_shared(0, 8, row[:1])
+    with pytest.raises(ValueError):   # prefix longer than footprint
+        alloc.admit_shared(1, 4, row[:3])
+    with pytest.raises(ValueError):   # trash page is never shareable
+        alloc.admit_shared(1, 8, [kvc.TRASH_PAGE])
+    free = alloc.free_pages
+    with pytest.raises(ValueError):   # dead page is not shareable
+        alloc.admit_shared(1, 8, [alloc._free[-1]])
+    assert alloc.admit_shared(1, 16, row)   # full-row share: no fresh
+    assert alloc.free_pages == free
+    assert all(alloc.refcount(p) == 2 for p in row)
+    with pytest.raises(ValueError):   # no such logical page
+        alloc.cow_page(2, 0)
+    _check_partition(alloc, geom)
 
 
 @pytest.mark.parametrize("mode", ["bf16", "int8"])

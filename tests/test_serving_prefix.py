@@ -1,0 +1,521 @@
+"""Copy-on-write prefix sharing (serving/prefix.py + refcounted pages).
+
+Fast tier: radix-index units (intern/lookup/drop, keep-first
+collisions, subtree drop on free), admission-plan math (chunk-aligned
+resume, single COW tail, whole-prompt clamp), and the tentpole parity
+bar — a request admitted after a prefix hit emits the BITWISE token
+stream of the same request cold, across {bf16, int8} × {paged, gather}
+× {spec on, off}; eviction of the sharer never perturbs the sharee
+(pool-cell byte identity under refcounts). Hit-aware admission: a
+cheap hot-prefix request is admitted past a cold head blocked on
+pages (``admission_lookahead``), and the head is never starved.
+
+Telemetry: engine stats → ServingRecord carries prefix_hit_rate /
+prefill_tokens_saved / trie_pages / dedup_ratio, and recordings from
+builds that predate those fields replay via dataclass defaults (the
+same forward-compat pin speculative decoding shipped with).
+
+Slow tier: the migration drill with shared pages in flight — donor and
+sharer migrate off a killed replica, the survivor re-interns, and the
+allocator invariants (refcount conservation, partition, no double-free)
+hold on both sides at drill end.
+"""
+
+import json
+from collections import Counter
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models import decoder, generate  # noqa: E402
+from dlrover_tpu.models.config import get_config  # noqa: E402
+from dlrover_tpu.observability import telemetry  # noqa: E402
+from dlrover_tpu.serving.engine import ServingEngine  # noqa: E402
+from dlrover_tpu.serving.prefix import (  # noqa: E402
+    PrefixIndex, PrefixMatch, plan_admission,
+)
+from dlrover_tpu.serving.scheduler import Scheduler  # noqa: E402
+
+
+# ------------------------------------------------------------- trie units
+
+
+def test_trie_intern_lookup_partial_tail():
+    trie = PrefixIndex(4)
+    toks = list(range(1, 13))
+    assert trie.intern(toks, 3, np.array([5, 6, 7])) == 3
+    m = trie.lookup(toks)
+    assert m.pages == (5, 6, 7) and m.tail_tokens == 0
+    assert m.matched_tokens(4) == 12
+    # diverge inside page 1: full match on page 0, 2-token tail on 6
+    m2 = trie.lookup(toks[:6] + [99, 99, 98])
+    assert m2.pages == (5,)
+    assert m2.tail_page == 6 and m2.tail_tokens == 2
+    # no shared prefix at all
+    miss = trie.lookup([31, 30, 29, 28])
+    assert miss.pages == () and miss.tail_page is None
+    # prompt shorter than one page can still tail-match
+    m3 = trie.lookup(toks[:3])
+    assert m3.pages == () and m3.tail_page == 5 and m3.tail_tokens == 3
+
+
+def test_trie_keep_first_on_collision():
+    trie = PrefixIndex(2)
+    trie.intern([1, 2, 3, 4], 2, np.array([5, 6]))
+    # a second slot committing the same runs does NOT rebind the nodes
+    assert trie.intern([1, 2, 3, 4], 2, np.array([9, 10])) == 0
+    assert trie.lookup([1, 2, 3, 4]).pages == (5, 6)
+    # ...but a divergent second page forks a new node under the shared
+    # first page
+    assert trie.intern([1, 2, 7, 7], 2, np.array([9, 10])) == 1
+    assert trie.lookup([1, 2, 7, 7]).pages == (5, 10)
+    assert trie.n_pages == 3
+
+
+def test_trie_drop_removes_subtree():
+    trie = PrefixIndex(2)
+    trie.intern([1, 2, 3, 4, 5, 6], 3, np.array([5, 6, 7]))
+    trie.intern([1, 2, 8, 8], 2, np.array([5, 9]))
+    assert trie.n_pages == 4
+    # dropping a leaf leaves the rest reachable
+    assert trie.drop_pages([7]) == 1
+    assert trie.lookup([1, 2, 3, 4, 5, 6]).pages == (5, 6)
+    # dropping the shared root page takes every deeper prefix with it
+    assert trie.drop_pages([5]) == 3
+    assert trie.n_pages == 0
+    assert trie.lookup([1, 2, 3, 4]).pages == ()
+    # dropping an unindexed page is a no-op
+    assert trie.drop_pages([5, 42]) == 0
+    assert trie.stats()["dropped_total"] == 4
+
+
+# ------------------------------------------------------------ plan math
+
+
+def test_plan_full_match_shares_aligned_prefix():
+    # 12 matched of a 16-token prompt, chunk 4: resume at 12, three
+    # pages shared read-only, no COW (resume page-aligned)
+    m = PrefixMatch((5, 6, 7), None, 0)
+    plan = plan_admission(m, 16, 4, 4)
+    assert plan.shared == (5, 6, 7) and plan.cow == ()
+    assert plan.resume == 12 and plan.matched_tokens == 12
+    assert plan.prefix_pages == (5, 6, 7)
+
+
+def test_plan_partial_tail_cows_one_page():
+    # 6 matched of an 8-token prompt, chunk 2: resume at 6, page 0
+    # shared, page 1 (half-committed) COW'd
+    m = PrefixMatch((5,), 6, 2)
+    plan = plan_admission(m, 8, 4, 2)
+    assert plan.shared == (5,)
+    assert plan.cow == ((1, 6),)
+    assert plan.resume == 6
+    assert plan.prefix_pages == (5, 6)
+
+
+def test_plan_whole_prompt_match_clamps_resume():
+    # the ENTIRE prompt is committed: resume must land strictly inside
+    # the prompt (the last token re-runs for the first-token logits).
+    # chunk 4: resume 8→7→4, page-aligned, so page 1 is discarded —
+    # recomputing it whole beats copying it
+    m = PrefixMatch((5, 6), None, 0)
+    plan = plan_admission(m, 8, 4, 4)
+    assert plan.resume == 4
+    assert plan.shared == (5,) and plan.cow == ()
+    # chunk 2: resume 8→7→6 lands INSIDE page 1, turning the fully
+    # matched page into the COW page
+    plan2 = plan_admission(m, 8, 4, 2)
+    assert plan2.resume == 6
+    assert plan2.shared == (5,) and plan2.cow == ((1, 6),)
+    # chunk wider than the whole usable prefix: resume 0 → no plan
+    assert plan_admission(m, 8, 4, 8) is None
+
+
+def test_plan_miss_and_tiny_matches_return_none():
+    assert plan_admission(PrefixMatch((), None, 0), 8, 4, 4) is None
+    # a 2-token tail match floors to resume 0 under chunk 4
+    assert plan_admission(PrefixMatch((), 6, 2), 8, 4, 4) is None
+
+
+# ------------------------------------------------------- engine parity
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+        vocab_size=32, max_seq=64,
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    rng = np.random.default_rng(1)
+    prefix = list(rng.integers(1, 32, size=12))
+    donor_p = prefix + [3, 4]
+    foll_p = prefix + [9, 8, 7]
+    refs = {
+        "donor": [int(t) for t in np.asarray(generate.greedy(
+            params, cfg, jnp.asarray([donor_p], jnp.int32), 16)[0])],
+        "foll": [int(t) for t in np.asarray(generate.greedy(
+            params, cfg, jnp.asarray([foll_p], jnp.int32), 5)[0])],
+    }
+    return cfg, params, donor_p, foll_p, refs
+
+
+def _engine(cfg, params, *, sharing=True, lookahead=0, replica="px",
+            **kw):
+    sched = Scheduler(replica=replica)
+    base = dict(
+        n_slots=2, max_len=32, page_size=4, mode="bf16",
+        prefill_chunk=4, prefix_sharing=sharing,
+        admission_lookahead=lookahead,
+    )
+    base.update(kw)
+    return sched, ServingEngine(params, cfg, sched, **base)
+
+
+def _commit_donor(eng, sched, donor_p, max_new=16):
+    """Admit the donor alone and step until its prompt is fully
+    committed (decode phase) so its pages are interned and shareable."""
+    rd = sched.submit(donor_p, max_new)
+    for _ in range(40):
+        eng.step()
+        s = next((s for s in eng.slots if s is not None), None)
+        if s is not None and s.phase == "decode":
+            return rd
+    raise AssertionError("donor never reached decode")
+
+
+def _check_alloc(alloc, geom):
+    """Refcount conservation + partition (mirrors the kv_cache property
+    checker) — the no-leak/no-double-free bar at drill end."""
+    cells = Counter(
+        int(p) for row in alloc._tables for p in row if p >= 0
+    )
+    for page in range(geom.n_pages):
+        assert alloc.refcount(page) == cells.get(page, 0), page
+    reserved = [int(p) for ps in alloc._reserved.values() for p in ps]
+    free = set(alloc._free)
+    assert len(alloc._free) == len(free)
+    assert set(cells) | set(reserved) | free == set(
+        range(1, geom.n_pages)
+    )
+    assert not free & set(cells) and not free & set(reserved)
+
+
+def _parity_case(setup, mode, paged, spec_k):
+    """The tentpole parity bar: the follower admitted after a prefix hit
+    (12 of its 15 prompt tokens mapped from the donor's pages) emits
+    the exact cold stream — and spends ONE prefill chunk where cold
+    spends four."""
+    cfg, params, donor_p, foll_p, refs = setup
+    sched, eng = _engine(cfg, params, mode=mode, paged=paged,
+                         spec_k=spec_k, replica=f"px-{mode}")
+    rd = _commit_donor(eng, sched, donor_p)
+    chunks_before = eng.stats()["prefill_chunks"]
+    rf = sched.submit(foll_p, 5)
+    eng.drain(timeout=600)
+    out_d, out_f = rd.future.result(5), rf.future.result(5)
+    st = eng.stats()
+    if mode == "bf16":
+        assert out_d == refs["donor"] and out_f == refs["foll"]
+    else:
+        # int8 is lossy vs the bf16 offline reference; its hit-vs-cold
+        # parity is pinned in test_int8_hit_equals_int8_cold_stream
+        assert len(out_f) == len(refs["foll"])
+    assert st["prefix_hits"] == 1 and st["prefill_tokens_saved"] == 12
+    assert st["prefill_chunks"] - chunks_before == 1  # cold pays 4
+    assert st["prefix_hit_rate"] == 0.5  # the donor was the one miss
+    # drained: every page freed, every trie entry dropped with it
+    assert eng.alloc.free_pages == eng.geom.n_pages - 1
+    assert st["trie_pages"] == 0
+    _check_alloc(eng.alloc, eng.geom)
+    return sched, eng
+
+
+def test_prefix_hit_fast_pin(setup):
+    """Tier-1 pin of the core hit path (bf16/paged/spec-off — one jit
+    compile) plus the telemetry flow; the full {mode} × {kernel} ×
+    {spec} matrix and the byte-identity/COW/lookahead drills run on the
+    slow tier (one engine compile each — see _SLOW_LEDGER)."""
+    sched, eng = _parity_case(setup, "bf16", True, 0)
+    rec = sched.publish(eng.stats())
+    assert rec.prefix_hit_rate == 0.5
+    assert rec.prefill_tokens_saved == 12
+    assert rec.trie_pages == 0 and rec.dedup_ratio == 1.0
+    assert telemetry.from_json(rec.to_json()).prefill_tokens_saved == 12
+    snap = eng.observability_snapshot()
+    assert snap["prefix"]["sharing"] is True
+    assert snap["prefix"]["hit_rate"] == 0.5
+    assert snap["prefix"]["prefill_tokens_saved"] == 12
+    assert "interned_total" in snap["prefix"]["trie"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+@pytest.mark.parametrize("paged", [True, False])
+@pytest.mark.parametrize("spec_k", [0, 3])
+def test_prefix_hit_stream_bitwise_equals_cold(setup, mode, paged, spec_k):
+    _parity_case(setup, mode, paged, spec_k)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("paged", [True, False])
+def test_int8_hit_equals_int8_cold_stream(setup, paged):
+    """int8 parity is pinned hit-vs-cold (both through the quantized
+    engine): the shared pages hold the SAME int8 payloads + scales a
+    cold prefill would commit, so the streams are bitwise equal."""
+    cfg, params, donor_p, foll_p, _ = setup
+    outs = {}
+    for sharing in (True, False):
+        sched, eng = _engine(cfg, params, sharing=sharing, mode="int8",
+                             paged=paged, replica=f"i8-{sharing}")
+        rd = _commit_donor(eng, sched, donor_p)
+        rf = sched.submit(foll_p, 5)
+        eng.drain(timeout=600)
+        outs[sharing] = (rd.future.result(5), rf.future.result(5))
+        assert eng.stats()["prefix_hits"] == (1 if sharing else 0)
+    assert outs[True] == outs[False]
+
+
+@pytest.mark.slow
+def test_sharer_eviction_never_perturbs_sharee(setup):
+    """Donor completes and evicts while the sharee is mid-decode: the
+    shared pool cells must stay byte-identical (rc holds them live) and
+    the sharee's stream stays the cold stream."""
+    cfg, params, donor_p, foll_p, refs = setup
+    sched, eng = _engine(cfg, params)
+    rd = _commit_donor(eng, sched, donor_p, max_new=4)
+    # donor is freshly decoding on a 4-token budget; admit the sharee
+    rf = sched.submit(foll_p, 5)
+    while not any(
+        s is not None and s.req is rf for s in eng.slots
+    ):
+        eng.step()
+    slot_f = next(
+        i for i, s in enumerate(eng.slots) if s is not None and s.req is rf
+    )
+    shared_phys = [
+        int(p) for p in eng.alloc.block_tables()[slot_f, :3]
+    ]
+    assert all(eng.alloc.refcount(p) == 2 for p in shared_phys)
+    before = {
+        k: np.asarray(v[:, shared_phys]) for k, v in eng.pools.items()
+    }
+    # run the donor to completion + eviction; the sharee keeps decoding
+    while any(
+        s is not None and s.req is rd for s in eng.slots
+    ) or not rd.future.done():
+        eng.step()
+    assert rd.future.result(5) == refs["donor"][:len(donor_p) + 4]
+    # donor gone, sharee still maps the pages — now rc 1, bytes intact
+    assert all(eng.alloc.refcount(p) == 1 for p in shared_phys)
+    after = {
+        k: np.asarray(v[:, shared_phys]) for k, v in eng.pools.items()
+    }
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k])
+    eng.drain(timeout=600)
+    assert rf.future.result(5) == refs["foll"]
+    assert eng.alloc.free_pages == eng.geom.n_pages - 1
+
+
+@pytest.mark.slow
+def test_cow_tail_page_isolates_writes(setup):
+    """A follower whose prompt EQUALS the donor's (whole-prompt match,
+    chunk 2 → resume 14 strides into page 3) COWs that page before
+    re-running its last chunk — the donor's copy must not move a byte."""
+    cfg, params, donor_p, _, _ = setup
+    prompt = donor_p + [1, 2]  # 16 tokens = 4 full committed pages
+    refs = {
+        m: [int(t) for t in np.asarray(generate.greedy(
+            params, cfg, jnp.asarray([prompt], jnp.int32), m)[0])]
+        for m in (12, 5)
+    }
+    sched, eng = _engine(cfg, params, prefill_chunk=2)
+    rd = _commit_donor(eng, sched, prompt, max_new=12)
+    donor_slot = next(
+        i for i, s in enumerate(eng.slots) if s is not None
+    )
+    donor_phys = [
+        int(p) for p in eng.alloc.block_tables()[donor_slot, :4]
+    ]
+    donor_bytes = {
+        k: np.asarray(v[:, donor_phys]) for k, v in eng.pools.items()
+    }
+    rf = sched.submit(prompt, 5)
+    eng.drain(timeout=600)
+    st = eng.stats()
+    assert rf.future.result(5) == refs[5]
+    assert rd.future.result(5) == refs[12]
+    assert st["cow_pages"] == 1 and st["prefix_hits"] == 1
+    assert st["prefill_tokens_saved"] == 14  # resume 14 of 16
+    # the donor's page bytes never moved (writes went to the COW copy);
+    # eviction doesn't scrub pools, so post-drain bytes still tell
+    for k, v in eng.pools.items():
+        np.testing.assert_array_equal(
+            donor_bytes[k], np.asarray(v[:, donor_phys])
+        )
+
+
+@pytest.mark.slow
+def test_hit_aware_lookahead_admits_past_blocked_cold_head(setup):
+    """A cold request blocked on pages must not idle the slot when a
+    hot-prefix request behind it fits via its shared-page discount —
+    and the cold head still runs (keeps its ticket) once pages free."""
+    cfg, params, donor_p, foll_p, refs = setup
+    sched, eng = _engine(cfg, params, lookahead=2)
+    rd = _commit_donor(eng, sched, donor_p)  # holds 8 of 16 pages
+    # squeeze the free list to 3 pages so a cold 20-token request (5
+    # pages) blocks while the hot one (5 pages, 3 shared) fits
+    assert eng.alloc.reserve_for_migration("squeeze", 20)
+    cold = sched.submit(list(np.arange(1, 18) % 31 + 1), 3)
+    hot = sched.submit(foll_p, 5)
+    for _ in range(12):
+        eng.step()
+    # the hot request jumped the blocked head and finished; cold waits
+    assert hot.future.done() and hot.future.result(5) == refs["foll"]
+    assert not cold.future.done()
+    assert sched.queue_depth() == 1
+    assert not rd.future.done()  # donor still decoding throughout
+    # pages return → the head is admitted (never starved)
+    eng.alloc.abort_migration("squeeze")
+    eng.drain(timeout=600)
+    assert len(cold.future.result(5)) == 20
+    st = eng.stats()
+    assert st["prefix_hits"] == 1
+    assert eng.alloc.free_pages == eng.geom.n_pages - 1
+
+
+@pytest.mark.slow
+def test_lookahead_zero_preserves_head_of_line(setup):
+    """Default admission (lookahead 0) stays strict head-of-line even
+    with sharing on: the hot request waits behind the blocked head."""
+    cfg, params, donor_p, foll_p, refs = setup
+    sched, eng = _engine(cfg, params, lookahead=0)
+    _commit_donor(eng, sched, donor_p)
+    assert eng.alloc.reserve_for_migration("squeeze", 20)
+    cold = sched.submit(list(np.arange(1, 18) % 31 + 1), 3)
+    hot = sched.submit(foll_p, 5)
+    for _ in range(8):
+        eng.step()
+    assert not hot.future.done() and not cold.future.done()
+    assert sched.queue_depth() == 2
+    eng.alloc.abort_migration("squeeze")
+    eng.drain(timeout=600)
+    assert hot.future.result(5) == refs["foll"]
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_pre_sharing_recordings_replay_via_defaults():
+    """A ServingRecord serialized BEFORE prefix sharing existed (no
+    prefix fields in its JSON) must rehydrate with the dataclass
+    defaults — the same forward-compat pin speculative decoding set."""
+    rec = telemetry.ServingRecord(replica="old", completed=3)
+    obj = json.loads(rec.to_json())
+    for f in ("prefix_hit_rate", "prefill_tokens_saved", "trie_pages",
+              "dedup_ratio"):
+        del obj["d"][f]
+    back = telemetry.from_json(json.dumps(obj))
+    assert back.completed == 3
+    assert back.prefix_hit_rate == 0.0
+    assert back.prefill_tokens_saved == 0
+    assert back.trie_pages == 0
+    assert back.dedup_ratio == 1.0
+
+
+@pytest.mark.slow
+def test_sharing_off_engine_reports_inert_prefix_stats(setup):
+    cfg, params, donor_p, foll_p, _ = setup
+    sched, eng = _engine(cfg, params, sharing=False)
+    _commit_donor(eng, sched, donor_p)
+    rf = sched.submit(foll_p, 5)
+    eng.drain(timeout=600)
+    rf.future.result(5)
+    st = eng.stats()
+    assert st["prefix_hits"] == 0 and st["prefix_misses"] == 0
+    assert st["prefix_hit_rate"] == 0.0 and st["trie_pages"] == 0
+    assert eng.observability_snapshot()["prefix"]["sharing"] is False
+
+
+# ------------------------------------------------------ migration drill
+
+
+@pytest.mark.slow
+def test_migration_drill_with_shared_pages_in_flight(setup):
+    """Kill a replica whose two slots SHARE prefix pages mid-decode;
+    the survivor (sharing on) adopts both via live migration, outputs
+    stay bitwise, and the allocator invariants hold on both replicas —
+    no refcount leak, no double-free."""
+    from dlrover_tpu.serving import migration as mig
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    cfg, params, donor_p, foll_p, refs = setup
+    kw = dict(
+        n_slots=4, max_len=32, page_size=4, mode="bf16",
+        prefill_chunk=4, prefix_sharing=True, idle_sleep=0.001,
+    )
+    r0 = ServingReplica("px-0", params, cfg, node_id=0, **kw)
+    r1 = ServingReplica("px-1", params, cfg, node_id=1, **kw)
+    r0.start()
+    r1.start()
+    try:
+        router = ReplicaRouter([r0, r1], migrator=mig.ServingMigrator())
+        with r1.server.paused() as eng1:
+            # round-robin lands the pads on r0, donor + sharer on the
+            # parked victim r1
+            pad = router.submit(donor_p, 1)
+            rd = router.submit(donor_p, 16)
+            pad2 = router.submit(donor_p, 1)
+            assert [e.replica.name for e in router._entries] == [
+                "px-0", "px-1", "px-0",
+            ]
+            # hand-step the victim: the donor commits its prompt pages,
+            # then the sharer is submitted and hits
+            for _ in range(40):
+                eng1.step()
+                s = next(
+                    (s for s in eng1.slots if s is not None), None
+                )
+                if s is not None and s.phase == "decode":
+                    break
+            rf = router.submit(foll_p, 5)
+            for _ in range(40):
+                eng1.step()
+                live = [s for s in eng1.slots if s is not None]
+                if len(live) == 2 and all(
+                    s.phase == "decode" and len(s.generated) >= 1
+                    and not s.req.future.done()
+                    for s in live
+                ):
+                    break
+            st1 = eng1.stats()
+            assert st1["prefix_hits"] == 1, "sharer never hit"
+            assert st1["dedup_ratio"] > 1.0, "no pages shared in flight"
+            r1.kill()
+        assert not r1.alive and r0.alive
+        pad.future.result(timeout=300)
+        pad2.future.result(timeout=300)
+        moved = router.poll()
+        outs = [
+            rd.future.result(timeout=600),
+            rf.future.result(timeout=600),
+        ]
+        assert moved == 2
+        assert outs[0] == refs["donor"] and outs[1] == refs["foll"]
+        eng0 = r0.server.engine
+        assert eng0.stats()["migrated_in"] == 2
+        with r0.server.paused():
+            assert eng0.stats()["trie_pages"] == 0  # all drained
+            _check_alloc(eng0.alloc, eng0.geom)
+            assert eng0.alloc.free_pages == eng0.geom.n_pages - 1
+        # the victim's allocator balances too: the migrator's
+        # release_slot of two sharers double-frees nothing
+        _check_alloc(eng1.alloc, eng1.geom)
+        assert eng1.alloc.free_pages == eng1.geom.n_pages - 1
+    finally:
+        r0.stop()
+        r1.kill()

@@ -58,6 +58,7 @@ def test_trainer_trains_and_checkpoints(tmp_path):
     assert any(f.endswith(".pack") for f in os.listdir(step_dir))
 
 
+@pytest.mark.slow
 def test_trainer_resumes_from_checkpoint(tmp_path, monkeypatch):
     cfg = _cfg()
     mesh = build_mesh(MeshConfig(dp=2, fsdp=4))
@@ -135,6 +136,7 @@ def test_trainer_eval_only_counts_eval_steps(tmp_path):
     assert m["batches"] == 3.0
 
 
+@pytest.mark.slow
 def test_trainer_data_exhaustion_stops_cleanly(tmp_path):
     cfg = _cfg()
     args = TrainerArgs(
@@ -277,6 +279,7 @@ def test_trainer_reports_model_info(tmp_path):
     assert client.model_info["seq_len"] == cfg.max_seq
 
 
+@pytest.mark.slow
 def test_trainer_drives_auto_accelerate_plan(tmp_path):
     """auto_accelerate → Trainer integration: the plan's lowering
     (step builder + state initializer) drives the high-level loop
@@ -307,6 +310,7 @@ def test_trainer_drives_auto_accelerate_plan(tmp_path):
     assert t._eval_fn is res.eval_step
 
 
+@pytest.mark.slow
 def test_trainer_callbacks_fire_and_log_lr(tmp_path):
     import json
 
@@ -369,6 +373,7 @@ def test_trainer_callbacks_fire_and_log_lr(tmp_path):
     assert train_recs[0]["learning_rate"] > 0
 
 
+@pytest.mark.slow
 def test_trainer_early_stopping_and_control_flags(tmp_path):
     from dlrover_tpu.train.callbacks import Callback, EarlyStoppingCallback
 
