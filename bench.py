@@ -712,6 +712,26 @@ def serving_trajectory_metric(path=None):
         out["resident_bytes_dedup_ratio"] = pfx.get(
             "resident_bytes_dedup_ratio"
         )
+    dis = artifact.get("disagg")
+    if dis:
+        # disaggregation headline: how much the prefill/decode split
+        # shields stream decode pace from a concurrent prompt burst
+        # (>1 = split is better), plus the handoff tax it pays for it
+        out["disagg_interference_win"] = dis.get(
+            "tpot_p99_interference_win"
+        )
+        out["disagg_tpot_burst_p99_ms"] = (dis.get("disagg") or {}).get(
+            "tpot_burst_p99_ms"
+        )
+        out["unified_tpot_burst_p99_ms"] = (
+            dis.get("unified") or {}
+        ).get("tpot_burst_p99_ms")
+        out["disagg_handoff_ms_p99"] = (dis.get("disagg") or {}).get(
+            "handoff_ms_p99"
+        )
+        out["disagg_tokens_per_s"] = (dis.get("disagg") or {}).get(
+            "tokens_per_s"
+        )
     return out
 
 
@@ -919,11 +939,160 @@ def _measure_hot_prefix(params, cfg, *, n_slots, max_len, page_size,
     }
 
 
+def _measure_disagg(params, cfg, *, n_slots, max_len, page_size, mode,
+                    prefill_chunk, max_new, seed, n_streams=3, n_burst=6):
+    """Prompt-burst interference: the same seeded trace served by one
+    unified replica vs a 1-prefill + 1-decode split (serving/disagg.py).
+
+    ``n_streams`` short-prompt requests reach steady decode first, then
+    ``n_burst`` prompt-heavy requests land at once. On the unified
+    engine every burst admission steals ``prefill_chunk``-token steps
+    from the streams' decode cadence — their inter-token p99 inflates;
+    on the split fleet the decode replica never runs a cold prefill, so
+    the streams' pace holds while the prefill pool absorbs the burst.
+    ``tpot_burst_p99_ms`` is measured over the STREAM requests only
+    (the interference number); ``handoff_ms_p99`` is the decode
+    replica's first-fragment→commit latency; fleet tokens/s and e2e
+    p99 ride along. ``bitwise_equal_vs_unified`` pins that the split
+    changed the transport schedule, not the numerics — both arms run
+    the same ``prefill_chunk`` (chunk width changes reduction order)."""
+    import numpy as np
+
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+    from dlrover_tpu.serving.scheduler import SamplingParams
+
+    rng = np.random.default_rng(seed)
+    alpha = min(9, cfg.vocab_size)
+    stream_new = max(8, max_new)
+    burst_len = max(prefill_chunk * 2, max_len // 2)
+    stream_prompts = [
+        list(rng.integers(1, alpha, 4)) for _ in range(n_streams)
+    ]
+    burst_prompts = [
+        list(rng.integers(1, alpha, burst_len)) for _ in range(n_burst)
+    ]
+    sps = [
+        SamplingParams(temperature=0.8, top_k=8, seed=31 + i)
+        for i in range(n_streams + n_burst)
+    ]
+    kw = dict(
+        n_slots=n_slots, max_len=max_len, page_size=page_size, mode=mode,
+        prefill_chunk=prefill_chunk, idle_sleep=0.001,
+    )
+
+    def arm(roles):
+        reps = [
+            ServingReplica(
+                f"bench-dg{i}-{role}", params, cfg, node_id=i,
+                role=role, **kw,
+            ).start()
+            for i, role in enumerate(roles)
+        ]
+        router = ReplicaRouter(reps)
+        try:
+            # warmup ladder (same idea as one_pass): pays the prefill +
+            # decode compiles at EVERY page-walk bucket a timed request
+            # can reach, on every engine in the fleet — plus, on the
+            # split arm, the staged-import path. A single warmup length
+            # leaves bucket recompiles in the timed window, where they
+            # stall the coordinator's paused() handshake for seconds.
+            n_warm = 0
+            for frac in (8, 4, 2, 1):
+                warm_len = max(3, (max_len - 3) // frac - 2)
+                router.submit(
+                    list(np.arange(warm_len) % 4 + 1), 3
+                )
+                n_warm += 1
+            router.wait_all(timeout=600.0)
+            for r in reps:
+                r.server.scheduler.reset_latencies()
+            decode_eng = next(
+                (r.server.engine for r in reps if r.role == "decode"),
+                reps[0].server.engine,
+            )
+            t0 = time.perf_counter()
+            streams = [
+                router.submit(p, stream_new, sampling=sp)
+                for p, sp in zip(stream_prompts, sps)
+            ]
+            # the burst lands only once every stream is PACING — decode
+            # slots live, first token out — so the tpot window measures
+            # interference, not prefill ordering
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                router.poll()
+                pacing = sum(
+                    1 for s in decode_eng.slots
+                    if s is not None and s.phase == "decode" and s.generated
+                )
+                if pacing >= n_streams or all(
+                    s.future.done() for s in streams
+                ):
+                    break
+                time.sleep(0.002)
+            burst = [
+                router.submit(p, max_new, sampling=sp)
+                for p, sp in zip(burst_prompts, sps[n_streams:])
+            ]
+            outs = router.wait_all(timeout=600.0)[n_warm:]  # drop warmup
+            dt = time.perf_counter() - t0
+            tpots = [
+                (r.done_t - r.first_token_t) / (stream_new - 1) * 1e3
+                for r in streams
+                if r.first_token_t and r.done_t and stream_new >= 2
+            ]
+            hists = router.fleet_histograms()
+            stats = [r.server.engine.stats() for r in reps]
+            out = {
+                "ttft_p50_ms": round(hists["ttft"].percentile(50.0), 2),
+                "ttft_p99_ms": round(hists["ttft"].percentile(99.0), 2),
+                "tpot_burst_p99_ms": round(
+                    float(np.percentile(tpots, 99)), 2
+                ) if tpots else None,
+                "p99_ms": round(hists["e2e"].percentile(99.0), 2),
+                "tokens_per_s": round(
+                    (n_streams * stream_new + n_burst * max_new) / dt, 2
+                ) if dt > 0 else 0.0,
+            }
+            if len(roles) > 1:
+                out["handoffs"] = sum(s["handoffs_in"] for s in stats)
+                out["handoff_bytes"] = sum(
+                    s["handoff_bytes"] for s in stats
+                )
+                if "handoff" in hists and hists["handoff"].n:
+                    out["handoff_ms_p99"] = round(
+                        hists["handoff"].percentile(99.0), 2
+                    )
+            return outs, out
+        finally:
+            router.close()
+            for r in reps:
+                r.stop()
+
+    outs_uni, uni = arm(["unified"])
+    outs_dis, dis = arm(["prefill", "decode"])
+    win = None
+    if uni.get("tpot_burst_p99_ms") and dis.get("tpot_burst_p99_ms"):
+        win = round(
+            uni["tpot_burst_p99_ms"] / dis["tpot_burst_p99_ms"], 3
+        )
+    return {
+        "n_streams": n_streams,
+        "n_burst": n_burst,
+        "burst_prompt_len": burst_len,
+        "unified": uni,
+        "disagg": dis,
+        "tpot_p99_interference_win": win,
+        "bitwise_equal_vs_unified": outs_uni == outs_dis,
+    }
+
+
 def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
               max_len=64, page_size=8, prefill_chunk=8, max_new=8,
               p99_target_ms=60000.0, seed=0, paged=True,
               compare_gather=True, spec_k=3, compare_spec=True,
-              measure_migration=True, measure_prefix=True):
+              measure_migration=True, measure_prefix=True,
+              measure_disagg=True):
     """Serving throughput: tokens/sec at a fixed p99 latency target.
 
     Drives the continuous-batching engine (dlrover_tpu/serving/) with
@@ -962,7 +1131,12 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
     system prompts × unique suffixes) runs twice at the same seed —
     prefix sharing on vs off — and records the hit rate, the prefill
     compute the radix index absorbed, the resident dedup ratio, and a
-    bitwise-equality flag under ``"prefix"``."""
+    bitwise-equality flag under ``"prefix"``.
+
+    With ``measure_disagg`` the same seeded trace runs unified vs a
+    1-prefill + 1-decode split under a concurrent prompt burst and
+    records the stream-decode interference number (tpot p99), handoff
+    latency/bytes, and a bitwise flag under ``"disagg"``."""
     import numpy as np
 
     import jax
@@ -1146,6 +1320,12 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
             params, cfg, n_slots=n_slots, max_len=max_len,
             page_size=page_size, mode=mode, prefill_chunk=prefill_chunk,
             seed=seed,
+        )
+    if measure_disagg:
+        record["disagg"] = _measure_disagg(
+            params, cfg, n_slots=n_slots, max_len=max_len,
+            page_size=page_size, mode=mode, prefill_chunk=prefill_chunk,
+            max_new=max_new, seed=seed,
         )
     return record
 

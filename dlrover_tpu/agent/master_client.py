@@ -40,6 +40,7 @@ class MasterClient:
         slice_id: str = "",
         slice_index: int = 0,
         restart_count: int = 0,
+        role: str = "",
     ) -> msgs.NodeRegisterResponse:
         meta = msgs.NodeMeta(
             node_type=node_type,
@@ -53,6 +54,7 @@ class MasterClient:
             tpu_type=tpu_type,
             slice_id=slice_id,
             slice_index=slice_index,
+            role=role,
         )
         resp = self._t.get(
             msgs.NodeRegisterRequest(meta=meta, restart_count=restart_count)

@@ -107,6 +107,9 @@ class NodeMeta:
     tpu_type: str = ""
     slice_id: str = ""
     slice_index: int = 0
+    # serving nodes only: "prefill" | "decode" | "unified" pool tag so
+    # the master can scale a disaggregated fleet's pools independently
+    role: str = ""
 
 
 @message

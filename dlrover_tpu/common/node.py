@@ -98,6 +98,9 @@ class Node:
         self.is_released = False
         self.paral_config: Dict = {}
         self.host_addr: str = ""
+        # serving nodes: "prefill" | "decode" | "unified" pool tag
+        # (empty for train-plane nodes)
+        self.role: str = ""
 
     # ---- status helpers -------------------------------------------------
 

@@ -160,6 +160,8 @@ class JobManager:
                     node.name = f"{meta.node_type}-{node.id}"
                 node.type = meta.node_type
             node.host_addr = meta.host_addr
+            if getattr(meta, "role", ""):
+                node.role = meta.role
             node.config_resource = NodeResource(
                 tpu_chips=meta.local_chips, tpu_type=meta.tpu_type
             )
@@ -329,12 +331,17 @@ class JobManager:
         with self._lock:
             return [n for n in self._nodes.values() if n.type == node_type]
 
-    def serving_nodes(self) -> List[Node]:
+    def serving_nodes(self, role: Optional[str] = None) -> List[Node]:
         """Generation-serving replicas (serving/replica.py). They register
         like trainer nodes — heartbeats, failure detection and eviction
         flow through the same machinery — but live outside the train
-        rendezvous, so job completion never waits on them."""
-        return self.nodes_of_type(NodeType.SERVING)
+        rendezvous, so job completion never waits on them. ``role``
+        filters a disaggregated fleet to one pool ("prefill" /
+        "decode" / "unified") so each can be scaled independently."""
+        nodes = self.nodes_of_type(NodeType.SERVING)
+        if role is None:
+            return nodes
+        return [n for n in nodes if n.role == role]
 
     # ---- serving reshard (KV-page migration directives) ------------------
 
@@ -348,14 +355,25 @@ class JobManager:
         """Issue a serving-reshard directive: migrate the victim
         replica's held KV pages onto the survivors within the deadline
         (degrading to re-prefill past it). ``survivors`` defaults to
-        every other running serving replica. Returns the directive
-        version (monotonic, starts at 1)."""
+        every other running serving replica IN THE VICTIM'S POOL when
+        the victim registered with a role (a decode replica's pages
+        must land on decode peers — a prefill-role survivor would park
+        them with no decode step to run them) and to the whole fleet
+        otherwise. Returns the directive version (monotonic, starts
+        at 1)."""
         from dlrover_tpu.observability.tracing import get_tracer
 
         if survivors is None:
+            victim_role = next(
+                (n.role for n in self.serving_nodes() if n.name == victim),
+                "",
+            )
+            pool = self.serving_nodes(
+                victim_role if victim_role in ("prefill", "decode") else None
+            )
             survivors = [
                 n.name
-                for n in self.serving_nodes()
+                for n in pool
                 if n.name and n.name != victim and not n.is_exited()
             ]
         with self._lock:

@@ -164,7 +164,25 @@ def _serving_section(by_type: Dict[str, List]) -> Dict:
             "tpot_p99_ms": r.tpot_p99_ms,
             "queue_wait_p99_ms": r.queue_wait_p99_ms,
             "tokens_per_s": r.tokens_per_s,
+            # pre-disaggregation recordings replay via the dataclass
+            # defaults: role "unified", zero handoffs
+            "role": getattr(r, "role", "unified") or "unified",
+            "handoffs_in": getattr(r, "handoffs_in", 0),
+            "handoffs_out": getattr(r, "handoffs_out", 0),
+            "handoff_ms_p99": getattr(r, "handoff_ms_p99", 0.0),
         }
+    roles: Dict[str, Dict] = {}
+    for info in replicas.values():
+        agg = roles.setdefault(info["role"], {
+            "replicas": 0,
+            "ttft_p99_ms": 0.0,
+            "tpot_p99_ms": 0.0,
+            "p99_ms": 0.0,
+            "handoff_ms_p99": 0.0,
+        })
+        agg["replicas"] += 1
+        for k in ("ttft_p99_ms", "tpot_p99_ms", "p99_ms", "handoff_ms_p99"):
+            agg[k] = max(agg[k], info[k])
     fleet = {}
     try:
         from dlrover_tpu.observability.histogram import (
@@ -185,7 +203,22 @@ def _serving_section(by_type: Dict[str, List]) -> Dict:
                 fleet[phase] = merged.summary()
     except (json.JSONDecodeError, KeyError, TypeError, ValueError):
         pass  # torn/foreign envelope: per-replica view still stands
-    return {"replicas": replicas, "fleet": fleet}
+    return {"replicas": replicas, "fleet": fleet, "roles": roles}
+
+
+def _slow_role(serving: Dict, kind: str) -> str:
+    """On a latency breach in a DISAGGREGATED fleet, name the pool to
+    look at: the split decouples the axes, so a TTFT breach points at
+    the worst-TTFT role (prefill pool undersized / handoff stalled)
+    and an e2e/TPOT breach at the worst-pace role. Empty when the
+    fleet has fewer than two roles — there is nothing to choose."""
+    roles = serving.get("roles") or {}
+    if len(roles) < 2:
+        return ""
+    metric = "ttft_p99_ms" if kind == "ttft_regression" else "tpot_p99_ms"
+    if all(info[metric] <= 0.0 for info in roles.values()):
+        metric = "p99_ms"
+    return max(roles, key=lambda role: roles[role][metric])
 
 
 def format_report(diag: Dict) -> str:
@@ -202,11 +235,30 @@ def format_report(diag: Dict) -> str:
         lines.append("")
         lines.append("serving replicas:")
         for name, info in serving["replicas"].items():
+            role = info.get("role", "unified")
+            role_tag = f" [{role}]" if role != "unified" else ""
+            handoff = ""
+            if info.get("handoffs_in") or info.get("handoffs_out"):
+                handoff = (
+                    f"; handoffs in/out {info['handoffs_in']}/"
+                    f"{info['handoffs_out']} "
+                    f"(p99 {info['handoff_ms_p99']:.1f}ms)"
+                )
             lines.append(
-                f"  {name}: completed {info['completed']}/"
+                f"  {name}{role_tag}: completed {info['completed']}/"
                 f"{info['admitted']} admitted, dropped {info['dropped']}; "
                 f"p99 {info['p99_ms']:.1f}ms "
-                f"ttft_p99 {info['ttft_p99_ms']:.1f}ms"
+                f"ttft_p99 {info['ttft_p99_ms']:.1f}ms{handoff}"
+            )
+        roles = serving.get("roles") or {}
+        if len(roles) > 1:
+            lines.append(
+                "  roles: " + ", ".join(
+                    f"{role}×{info['replicas']} "
+                    f"(ttft_p99 {info['ttft_p99_ms']:.1f}ms "
+                    f"tpot_p99 {info['tpot_p99_ms']:.1f}ms)"
+                    for role, info in sorted(roles.items())
+                )
             )
         for phase, s in serving.get("fleet", {}).items():
             lines.append(
@@ -228,6 +280,10 @@ def format_report(diag: Dict) -> str:
             lines.append(
                 "  breaching replica(s): " + ",".join(info["replicas"])
             )
+        if kind in ("ttft_regression", "slo_breach"):
+            slow = _slow_role(serving, kind)
+            if slow:
+                lines.append(f"  slow role: {slow}")
         if info["verdict"]:
             lines.append(f"  verdict: {info['verdict']}")
         if info["detail"]:

@@ -275,11 +275,19 @@ class ServingRecord:
     time-to-first-token (submit → first emitted token), ``tpot_*`` is
     time-per-output-token (mean inter-token ms within a request),
     ``queue_wait_p99_ms`` is enqueue → engine admission.  ``hists`` is
-    the JSON-encoded envelope of all four per-phase histograms
-    ({"e2e","ttft","tpot","queue_wait"} → LatencyHistogram.to_dict()) —
+    the JSON-encoded envelope of all the per-phase histograms
+    (``scheduler.LATENCY_PHASES`` → LatencyHistogram.to_dict()) —
     a *string* field so the record stays scalar-only on the wire; the
     router/master parse it to merge fleet percentiles from counts
     rather than averaging per-replica percentiles.
+
+    Disaggregated serving (serving/disagg.py): ``role`` is this
+    replica's pool ("prefill" | "decode" | "unified");
+    ``handoffs_in`` / ``handoffs_out`` are lifetime counts of
+    prefill→decode streaming handoffs this engine received/shipped,
+    ``handoff_bytes`` the wire bytes they moved, ``handoff_ms_p99``
+    the receiving-side first-fragment→commit latency. Recordings from
+    builds predating the split replay with the defaults (unified, 0).
 
     Drop accounting (goodput vs offered load): ``rejected`` counts
     admission failures (queue at capacity + oversize requests),
@@ -318,6 +326,11 @@ class ServingRecord:
     prefill_tokens_saved: int = 0
     trie_pages: int = 0
     dedup_ratio: float = 1.0
+    role: str = "unified"
+    handoffs_in: int = 0
+    handoffs_out: int = 0
+    handoff_bytes: int = 0
+    handoff_ms_p99: float = 0.0
     hists: str = ""
     ts: float = 0.0
 
@@ -393,6 +406,10 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("serving_prefill_tokens_saved", "prefill_tokens_saved"),
         ("serving_trie_pages", "trie_pages"),
         ("serving_dedup_ratio", "dedup_ratio"),
+        ("serving_handoffs_in", "handoffs_in"),
+        ("serving_handoffs_out", "handoffs_out"),
+        ("serving_handoff_bytes", "handoff_bytes"),
+        ("serving_handoff_ms_p99", "handoff_ms_p99"),
     ],
 }
 _COUNTER_MAP: Dict[str, str] = {

@@ -1,0 +1,544 @@
+"""Disaggregated prefill/decode serving: the streaming handoff wire.
+
+Chunked prefill steals decode steps on a unified replica — every
+admitted prompt burns ``prefill_chunk``-token steps on the decode
+critical path, coupling TTFT to TPOT. The split (ROADMAP 1(b)):
+prefill-role engines run chunked prefill ONLY and ship each finished
+prompt to a decode-role engine whose step is pure batched decode. The
+transport is the PR 14 migration wire — ``RequestSnapshot`` →
+checksummed blob → ``import_slot`` — reused verbatim; the only new
+things are a *schedule* (fragments stream per committed chunk, overlap
+with the next chunk's compute) and a ``page_start`` offset in the
+snapshot meta.
+
+One handoff, happy path::
+
+    prefill engine loop                    coordinator worker
+    -------------------                    ------------------
+    chunk 0 commits ──sink──▶ frag[0,k)──▶ reserve on decode replica
+    chunk 1 commits ──sink──▶ frag[k,m)──▶ stage_pages (idempotent)
+    ...                                    ...
+    last chunk + token 0 ──▶ final frag ─▶ stage + import_slot(commit)
+    slot parks phase="handoff"             repoint router, release donor
+
+Because the pages ship exactly as stored and every sampling draw folds
+in the absolute position (PR 13), the decode continuation is bitwise
+the unified stream — PROVIDED both fleets run the same
+``prefill_chunk`` (chunk width changes reduction order).
+
+Exactly-once under faults — the coordinator lock guards a per-request
+``committed``/``cancelled`` pair:
+
+- torn fragment (``TornPageTransfer``) → re-export the same logical
+  range from the donor (committed pages are immutable) up to
+  ``retries`` times, then degrade: abort the reservation, release the
+  donor slot, re-dispatch under the ORIGINAL ticket through the
+  router's prefill pool.
+- dead decode target pre-commit → restart the whole stream on another
+  decode replica (staging is offset-addressed, so a replay is a
+  harmless rewrite).
+- dead prefill donor → ``resolve_dead_donor``: a committed handoff
+  returns its owner (the router repoints, no re-admit); an in-flight
+  one is cancelled atomically and the router re-dispatches.
+- ``local_done`` (prompt finished at its first token) → cancel any
+  fragments already streamed, abort the reservation.
+
+Lock protocol (deadlock-free by construction): router lock → coordinator
+lock is the only compound order; the donor sink takes ONLY the
+coordinator lock; the worker never holds the coordinator lock while
+pausing a PREFILL replica (whose loop thread runs the sink) or while
+taking the router lock. Decode-replica pauses under the coordinator
+lock are safe — decode loops touch neither lock (their bounce lane is
+a lock-free deque the router drains).
+
+Fault point: ``serving.handoff`` (rank = donor node_id), checked before
+each fragment decode — ``drop_page``/``torn_donation`` specs drive the
+torn-stream drills in tests/test_serving_disagg.py.
+"""
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.comm import _backoff_delay
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.elastic.faults import (
+    FaultInjector,
+    TornDonation,
+    get_injector,
+)
+from dlrover_tpu.observability.tracing import get_tracer
+from dlrover_tpu.serving.migration import (
+    RequestSnapshot,
+    ServingMigrator,
+    decode_snapshot,
+    encode_snapshot,
+    geometry_fingerprint,
+)
+from dlrover_tpu.serving.scheduler import AdmissionError
+
+logger = get_logger(__name__)
+
+
+class HandoffError(RuntimeError):
+    """A handoff cannot proceed (no decode capacity, donor slot gone):
+    the coordinator degrades it to re-prefill — never a lost request."""
+
+
+def snapshot_fragment(
+    engine, i: int, s, start: int, stop: int, *, final: bool
+) -> RequestSnapshot:
+    """One streaming-handoff fragment: pages ``[start, stop)`` of slot
+    ``i`` plus the resume metadata. Mid-stream fragments carry
+    ``phase="prefill"`` and no generated tokens; the final fragment
+    carries the full resume state (``phase="decode"``, token 0) so the
+    receiver commits straight into a decode lane."""
+    return RequestSnapshot(
+        rid=s.req.rid,
+        prompt=[int(t) for t in s.prompt],
+        generated=list(s.generated) if final else [],
+        n_prefilled=int(s.n_prefilled) if final else 0,
+        phase="decode" if final else "prefill",
+        max_new_tokens=int(s.req.max_new_tokens),
+        seed=int(s.req.sampling.seed),
+        page_start=int(start),
+        pages=engine.export_pages(i, start, stop),
+        **geometry_fingerprint(engine.geom),
+    )
+
+
+class _Handoff:
+    """One request's prefill→decode transfer state."""
+
+    __slots__ = (
+        "rid", "req", "donor", "slot", "target", "reserved", "shipped",
+        "committed", "cancelled", "t0", "bytes", "fragments",
+    )
+
+    def __init__(self, rid, req, donor, slot):
+        self.rid = rid
+        self.req = req
+        self.donor = donor          # ServingReplica (prefill role)
+        self.slot = slot            # donor slot index
+        self.target = None          # ServingReplica (decode role)
+        self.reserved = False
+        self.shipped = 0            # logical pages exported so far
+        self.committed = False
+        self.cancelled = False
+        self.t0 = time.monotonic()
+        self.bytes = 0
+        self.fragments = 0
+
+
+class HandoffCoordinator:
+    """Streams finished prompts from the prefill pool into decode-pool
+    reservations and commits them exactly once.
+
+    The donor side runs on each prefill engine's loop thread (the
+    ``handoff_sink`` hook — export + encode only, no blocking calls);
+    a single daemon worker thread does everything with latency or
+    locks in it: reservation, CRC verify, staging, commit, degrade.
+    """
+
+    def __init__(
+        self,
+        prefill_pool: List,
+        decode_pool: List,
+        *,
+        router=None,
+        faults: Optional[FaultInjector] = None,
+        streaming: bool = True,
+        reserve_attempts: int = 6,
+        retries: int = 1,
+        shed_per_attempt: int = 2,
+    ):
+        self.prefill_pool = list(prefill_pool)
+        self.decode_pool = list(decode_pool)
+        self.router = router
+        self.faults = faults if faults is not None else get_injector()
+        self.streaming = streaming
+        self.reserve_attempts = reserve_attempts
+        self.retries = retries
+        self.shed_per_attempt = shed_per_attempt
+        self._lock = threading.Lock()
+        self._by_rid: Dict[str, _Handoff] = {}
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._disabled = False
+        self.degraded = 0           # handoffs that fell to re-prefill
+        self.completed = 0          # handoffs committed
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "HandoffCoordinator":
+        for rep in self.prefill_pool:
+            rep.server.engine.handoff_sink = self._make_sink(rep)
+        self._thread = threading.Thread(
+            target=self._worker_loop, name="handoff-coordinator", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, join: bool = True) -> None:
+        self._stop_evt.set()
+        if join and self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(
+                1 for h in self._by_rid.values()
+                if not h.committed and not h.cancelled
+            )
+
+    # ---- donor side (prefill engine loop thread) -------------------------
+
+    def _make_sink(self, rep):
+        def sink(i, s, event):
+            if self._disabled:
+                return
+            rid = s.req.rid
+            if event == "local_done":
+                self._q.put(("cancel", rid, None))
+                return
+            if event == "chunk" and not self.streaming:
+                return
+            eng = rep.server.engine
+            with self._lock:
+                h = self._by_rid.get(rid)
+                if h is None:
+                    h = _Handoff(rid, s.req, rep, i)
+                    self._by_rid[rid] = h
+                if h.cancelled:
+                    return
+                start = h.shipped
+            if event == "chunk":
+                # only FULL pages are immutable mid-prompt; a partial
+                # tail page still collects rows from later chunks
+                stop = s.n_prefilled // eng.geom.page_size
+                final = False
+            else:  # "done" — slot just parked in phase="handoff"
+                stop = eng.alloc.slot_pages(i)
+                final = True
+            if stop <= start and not final:
+                return
+            snap = snapshot_fragment(eng, i, s, start, stop, final=final)
+            blob = encode_snapshot(snap)
+            with self._lock:
+                h.shipped = max(h.shipped, stop)
+            self._q.put(("frag", rid, (blob, start, stop, final)))
+        return sink
+
+    # ---- worker side -----------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                kind, rid, payload = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                if kind == "cancel":
+                    self._handle_cancel(rid)
+                elif kind == "restart":
+                    self._handle_restart(rid)
+                else:
+                    self._handle_fragment(rid, *payload)
+            except Exception as e:  # noqa: BLE001 — degrade, never wedge
+                logger.warning("handoff of %s degraded: %s", rid, e)
+                self._degrade(rid)
+
+    def _get(self, rid: str) -> Optional[_Handoff]:
+        with self._lock:
+            h = self._by_rid.get(rid)
+            if h is None or h.cancelled or h.committed:
+                return None
+            return h
+
+    def _handle_fragment(self, rid, blob, start, stop, final) -> None:
+        h = self._get(rid)
+        if h is None:
+            return
+        if h.target is None:
+            self._reserve(h)
+        snap = self._decode_with_retry(h, blob, start, stop, final)
+        if snap is None:
+            return  # cancelled under our feet
+        ServingMigrator._check_geometry(snap, h.target.server.engine)
+        with h.target.server.paused() as eng:
+            eng.stage_pages(rid, snap.page_start, snap.pages)
+        h.bytes += len(blob)
+        h.fragments += 1
+        h.donor.server.engine.note_handoff_bytes(len(blob))
+        if final:
+            self._commit(h, snap)
+
+    def _decode_with_retry(self, h, blob, start, stop, final):
+        """Verify a fragment blob; a torn one is re-exported from the
+        donor (committed pages are immutable, so the re-snapshot is the
+        same bytes) up to ``retries`` times."""
+        for attempt in range(self.retries + 1):
+            try:
+                self.faults.at("serving.handoff", rank=h.donor.node_id)
+                return decode_snapshot(blob)
+            except TornDonation as e:
+                if attempt >= self.retries:
+                    raise
+                logger.info(
+                    "torn handoff fragment for %s (attempt %d): %s — "
+                    "re-exporting pages [%d, %d)",
+                    h.rid, attempt + 1, e, start, stop,
+                )
+                with h.donor.server.paused() as eng:
+                    s = eng.slots[h.slot]
+                    if s is None or s.req.rid != h.rid:
+                        raise HandoffError(
+                            f"donor slot for {h.rid} gone mid-retry"
+                        ) from e
+                    snap = snapshot_fragment(
+                        eng, h.slot, s, start, stop, final=final
+                    )
+                blob = encode_snapshot(snap)
+        return None  # unreachable
+
+    def _pick_target(self):
+        live = [r for r in self.decode_pool if r.alive]
+        if not live:
+            return None
+        return max(live, key=lambda r: r.server.engine.alloc.free_pages)
+
+    def _reserve(self, h: _Handoff) -> None:
+        """Hold the request's FULL footprint (prompt + generation) on
+        the least-loaded live decode replica; page pressure sheds the
+        target's lowest-priority queued new admissions and backs off,
+        same ladder as the failover migrator."""
+        for attempt in range(self.reserve_attempts):
+            tgt = self._pick_target()
+            if tgt is None:
+                raise HandoffError(
+                    f"no live decode replica for {h.rid}"
+                )
+            with tgt.server.paused() as eng:
+                ok = eng.alloc.reserve_for_migration(
+                    h.rid, h.req.total_tokens
+                )
+            if ok:
+                h.target = tgt
+                h.reserved = True
+                return
+            tgt.server.scheduler.shed_lowest(
+                count=self.shed_per_attempt, below_priority=h.req.priority
+            )
+            self._stop_evt.wait(_backoff_delay(attempt))
+        raise HandoffError(
+            f"no decode replica could reserve {h.req.total_tokens} tokens "
+            f"for {h.rid} in {self.reserve_attempts} attempts"
+        )
+
+    def _commit(self, h: _Handoff, snap: RequestSnapshot) -> None:
+        """Flip ownership: import the staged reservation into a decode
+        lane. Atomic against cancellation (coordinator lock); a full
+        lane table retries with backoff — the reservation already holds
+        the pages, only a slot index is awaited."""
+        t_resume = time.monotonic()
+        for attempt in range(self.reserve_attempts):
+            with self._lock:
+                if h.cancelled:
+                    break
+                try:
+                    with h.target.server.paused() as eng:
+                        eng.import_slot(
+                            h.req,
+                            None,
+                            phase="decode",
+                            n_prefilled=snap.n_prefilled,
+                            generated=snap.generated,
+                            reserved_tag=h.rid,
+                            handoff=True,
+                        )
+                    h.committed = True
+                except AdmissionError:
+                    pass  # no free lane yet — back off below
+            if h.committed or h.cancelled:
+                break
+            self._stop_evt.wait(_backoff_delay(attempt))
+        if not h.committed:
+            if not h.cancelled:
+                raise HandoffError(
+                    f"no free decode lane for {h.rid} on {h.target.name}"
+                )
+            self._abort_reservation(h)
+            return
+        # --- success path, all outside the coordinator lock ---
+        dt_ms = (time.monotonic() - h.t0) * 1e3
+        h.target.server.scheduler.record_handoff_ms(dt_ms)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.complete_span(
+                "serving.handoff_transfer", h.t0, rid=h.rid,
+                donor=h.donor.name, target=h.target.name,
+                bytes=h.bytes, fragments=h.fragments,
+            )
+            tr.complete_span(
+                "serving.handoff_resume", t_resume, rid=h.rid,
+                replica=h.target.name, n_prefilled=snap.n_prefilled,
+            )
+        if self.router is not None:
+            self.router._repoint(h.rid, h.target)
+        with h.donor.server.paused() as eng:
+            s = eng.slots[h.slot] if h.slot < len(eng.slots) else None
+            if s is not None and s.req.rid == h.rid:
+                eng.release_slot(h.slot, reason="handoff_out")
+        with self._lock:
+            self._by_rid.pop(h.rid, None)
+        self.completed += 1
+        logger.info(
+            "handoff %s: %s → %s, %d fragments, %d bytes, %.1f ms",
+            h.rid, h.donor.name, h.target.name, h.fragments, h.bytes, dt_ms,
+        )
+
+    def _handle_cancel(self, rid: str) -> None:
+        """The prompt finished locally on the prefill replica
+        (max_new=1 / instant EOS): unwind any fragments already
+        streamed."""
+        with self._lock:
+            h = self._by_rid.pop(rid, None)
+            if h is None or h.committed:
+                return
+            h.cancelled = True
+        self._abort_reservation(h)
+
+    def _handle_restart(self, rid: str) -> None:
+        """Replay a stream whose decode target died pre-commit onto a
+        fresh target: re-export everything shipped so far from the
+        donor (readable even off a dead donor — kill halts the loop,
+        the pools stay) and run it through the normal fragment path.
+        Staging is offset-addressed, so overlap with late original
+        fragments is a harmless rewrite."""
+        h = self._get(rid)
+        if h is None:
+            return
+        with h.donor.server.paused() as eng:
+            s = eng.slots[h.slot] if h.slot < len(eng.slots) else None
+            if s is None or s.req.rid != rid:
+                return  # slot already released/completed
+            final = s.phase == "handoff"
+            if final:
+                stop = eng.alloc.slot_pages(h.slot)
+            else:
+                stop = s.n_prefilled // eng.geom.page_size
+            snap = snapshot_fragment(eng, h.slot, s, 0, stop, final=final)
+        blob = encode_snapshot(snap)
+        with self._lock:
+            h.shipped = max(h.shipped, stop)
+        self._handle_fragment(rid, blob, 0, stop, final)
+
+    def _abort_reservation(self, h: _Handoff) -> None:
+        if h.target is None or not h.reserved:
+            return
+        with h.target.server.paused() as eng:
+            try:
+                eng.alloc.abort_migration(h.rid)
+            except KeyError:
+                pass
+        h.reserved = False
+
+    def _degrade(self, rid: str) -> None:
+        """The re-prefill tier: abort the reservation, release the donor
+        slot, hand the request back to the router under its ORIGINAL
+        ticket. The request is never lost (the router re-dispatches or,
+        with no router, the donor re-queues it) and never duplicated
+        (cancelled-before-commit is atomic)."""
+        with self._lock:
+            h = self._by_rid.pop(rid, None)
+            if h is None or h.committed:
+                return
+            h.cancelled = True
+        self._abort_reservation(h)
+        with h.donor.server.paused() as eng:
+            s = eng.slots[h.slot] if h.slot < len(eng.slots) else None
+            if s is not None and s.req.rid == rid:
+                eng.release_slot(h.slot, reason="handoff_abort")
+        self.degraded += 1
+        if self.router is not None:
+            self.router.redispatch(h.req)
+        else:
+            h.donor.server.re_admit(h.req)
+
+    # ---- failover hooks (called by ReplicaRouter.poll) -------------------
+
+    def resolve_dead_donor(self, rid: str):
+        """Exactly-once resolution for a request whose PREFILL replica
+        died: returns the decode replica that already owns it (handoff
+        committed — repoint, do NOT re-admit) or None after atomically
+        cancelling any in-flight transfer (caller re-dispatches; a
+        worker mid-commit observes ``cancelled`` and aborts)."""
+        with self._lock:
+            h = self._by_rid.get(rid)
+            if h is None:
+                return None
+            if h.committed:
+                return h.target
+            h.cancelled = True
+            self._by_rid.pop(rid, None)
+        self._abort_reservation(h)
+        self.degraded += 1
+        return None
+
+    def on_replica_dead(self, rep) -> int:
+        """A DECODE replica died: every uncommitted handoff targeting it
+        restarts on a surviving decode replica (the donor still holds
+        the pages). Returns how many restarts were queued."""
+        restart = []
+        with self._lock:
+            for h in self._by_rid.values():
+                if h.target is rep and not h.committed and not h.cancelled:
+                    h.target = None
+                    h.reserved = False  # dead allocator — nothing to abort
+                    restart.append(h.rid)
+        for rid in restart:
+            self._q.put(("restart", rid, None))
+        return len(restart)
+
+    def collapse(self) -> List:
+        """Fold the fleet back to unified — the last rung of the
+        degradation ladder, taken when either pool has no live member.
+        In-flight transfers are cancelled and every occupied prefill
+        slot is released for re-prefill: a prefill-role slot holds a
+        PROMPT-ONLY page footprint, so it cannot decode in place —
+        its request must re-admit with the full footprint under its
+        original ticket. Returns those orphaned ``Request``s; the
+        caller (``ReplicaRouter``) re-dispatches them onto the
+        now-unified fleet. The coordinator never takes the router lock
+        here — collapse is called under it."""
+        with self._lock:
+            self._disabled = True
+            pending = [
+                h for h in self._by_rid.values() if not h.committed
+            ]
+            for h in pending:
+                h.cancelled = True
+            self._by_rid.clear()
+        for h in pending:
+            if h.target is not None and h.target.alive:
+                self._abort_reservation(h)
+        orphans = []
+        for rep in self.prefill_pool:
+            with rep.server.paused() as eng:
+                eng.handoff_sink = None
+                eng.role = "unified"
+                for i, s in enumerate(eng.slots):
+                    if s is not None and not s.req.future.done():
+                        orphans.append(s.req)
+                        eng.release_slot(i, reason="handoff_abort")
+        for rep in self.decode_pool:
+            with rep.server.paused() as eng:
+                eng.role = "unified"
+        self.degraded += len(orphans)
+        # no join: the worker may be waiting on the router lock the
+        # caller holds — it observes the stop event and exits on its own
+        self.stop(join=False)
+        return orphans

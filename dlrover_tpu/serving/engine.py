@@ -70,6 +70,26 @@ keeps strictly above the shared pages. ``admission_lookahead`` lets the
 scheduler admit a later request whose (prefix-discounted) footprint
 fits past a blocked cold head-of-line request.
 
+Disaggregated prefill/decode (``role`` ctor flag, serving/disagg.py):
+
+- ``unified`` (default) — today's engine, bitwise-unchanged.
+- ``prefill`` — chunked prefill ONLY: every prefill slot advances one
+  chunk per step (large effective chunk) and the decode/spec batch is
+  never traced. Admission reserves a PROMPT-ONLY footprint (the
+  generation pages live on the decode replica), and a completed prompt
+  leaves through ``handoff_sink`` — fired after each committed chunk
+  (``"chunk"``, streaming page shipment overlapped with the next
+  chunk's compute), at completion (``"done"``), or when the request
+  finishes at its first token with nothing to hand off
+  (``"local_done"``).
+- ``decode`` — pure batched decode: raw prompts are never
+  chunk-prefilled. Work arrives as handoffs (``import_slot`` with a
+  staged reservation) or as prefix-affinity admissions whose radix-index
+  plan covers all but ``affinity_suffix_max`` trailing prompt tokens
+  (the short divergent suffix is the only prefill this engine runs). A
+  popped request whose plan degraded is parked on ``bounced`` for the
+  router to re-dispatch through the prefill pool.
+
 Alignment invariant: the slot capacity ``S_max`` must be a multiple of
 ``prefill_chunk``. Chunk starts are always multiples of the chunk width,
 and ``lax.dynamic_slice`` CLAMPS out-of-bounds starts — an unaligned
@@ -78,6 +98,7 @@ rows. ``__init__`` enforces it.
 """
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -142,7 +163,7 @@ class _Slot:
     """Host-side state of one decode lane."""
 
     req: Request
-    phase: str                  # "prefill" | "decode"
+    phase: str                  # "prefill" | "decode" | "handoff"
     prompt: np.ndarray          # int32 [P]
     key_data: np.ndarray        # uint32 [2] — threefry key for sampling
     n_prefilled: int = 0
@@ -172,7 +193,27 @@ class ServingEngine:
         draft: Optional[DraftModel] = None,
         prefix_sharing: bool = False,
         admission_lookahead: int = 0,
+        role: str = "unified",
+        affinity_suffix_max: Optional[int] = None,
     ):
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill' or 'decode', got {role!r}"
+            )
+        self.role = role
+        # disaggregation hook (serving/disagg.py): a prefill-role engine
+        # calls sink(slot_idx, slot, event) with event "chunk" after
+        # every committed chunk, "done" at prefill completion, and
+        # "local_done" when the request finished at its first token
+        self.handoff_sink = None
+        # decode-role bounce lane: popped requests whose prefix-affinity
+        # plan no longer qualifies park here for the router to re-dispatch
+        # through the prefill pool — a decode-role engine never
+        # chunk-prefills a cold prompt
+        self.bounced: deque = deque()
+        if affinity_suffix_max is None:
+            affinity_suffix_max = 2 * prefill_chunk if role == "decode" else 0
+        self.affinity_suffix_max = int(affinity_suffix_max)
         self.params = params
         self.cfg = cfg
         self.scheduler = scheduler
@@ -218,6 +259,10 @@ class ServingEngine:
         self._prefill_chunks = 0  # chunk_fn invocations (the compute unit)
         self._migrated_in = 0     # requests adopted as live KV pages
         self._migrated_out = 0    # requests donated as live KV pages
+        self._handoffs_in = 0     # disagg handoffs committed into a slot
+        self._handoffs_out = 0    # prefilled requests released downstream
+        self._handoff_bytes = 0   # wire bytes shipped/staged (both roles)
+        self._affinity_bounced = 0  # decode-role pops with a degraded plan
         self._prefix_hits = 0     # admissions that mapped shared pages
         self._prefix_misses = 0   # sharing-on admissions with no usable hit
         self._prefill_tokens_saved = 0  # prompt tokens skipped via hits
@@ -488,6 +533,14 @@ class ServingEngine:
             "prefill_chunks": self._prefill_chunks,
             "migrated_in": self._migrated_in,
             "migrated_out": self._migrated_out,
+            # disaggregation: replica role and handoff accounting
+            # (serving/disagg.py mutates the byte counter from its pump
+            # thread — telemetry-grade, not a synchronization point)
+            "role": self.role,
+            "handoffs_in": self._handoffs_in,
+            "handoffs_out": self._handoffs_out,
+            "handoff_bytes": self._handoff_bytes,
+            "affinity_bounced": self._affinity_bounced,
             # prefix sharing: hit rate over sharing-on admissions, prompt
             # tokens whose prefill was skipped, COW duplications, live
             # trie size, and the dedup ratio (slot cells per unique
@@ -555,6 +608,20 @@ class ServingEngine:
             # trie stats ride along so a watchdog capture can tell
             # "out of pages" from "dedup regressed" (hot prefixes
             # falling out of the index under churn)
+            # disaggregation: which role this replica plays and how many
+            # requests are parked mid-handoff (phase "handoff" = prefill
+            # finished, pages still streaming to the decode replica)
+            "handoff": {
+                "role": self.role,
+                "handoffs_in": es["handoffs_in"],
+                "handoffs_out": es["handoffs_out"],
+                "handoff_bytes": es["handoff_bytes"],
+                "pending": sum(
+                    1 for s in self.slots
+                    if s is not None and s.phase == "handoff"
+                ),
+                "affinity_bounced": es["affinity_bounced"],
+            },
             "prefix": {
                 "sharing": self.prefix_sharing,
                 "hit_rate": round(es["prefix_hit_rate"], 4),
@@ -606,6 +673,11 @@ class ServingEngine:
         worked = self._admit() or worked
         if self._t0 is None and any(self.slots):
             self._t0 = time.monotonic()
+        if self.role == "prefill":
+            # prefill-only replica: EVERY prefill slot advances one chunk
+            # per step (large effective chunk) and the decode/spec batch
+            # is never traced — finished prompts leave via handoff_sink
+            return self._prefill_all() or worked
         worked = self._prefill_one() or worked
         if self.spec_k:
             worked = self._spec_batch() or worked
@@ -662,6 +734,16 @@ class ServingEngine:
             match, len(req.prompt), self.geom.page_size, self.prefill_chunk
         )
 
+    def _footprint_tokens(self, req) -> int:
+        """Tokens of page footprint an admission reserves. A
+        prefill-role engine holds PROMPT-ONLY pages — generated tokens'
+        K/V rows are written on the decode replica, so reserving them
+        here would halve the prefill pool's concurrency for nothing.
+        (The sampled first token is drawn from logits, never written.)"""
+        if self.role == "prefill":
+            return len(req.prompt)
+        return req.total_tokens
+
     def _admit(self) -> bool:
         worked = False
         if self.draining:
@@ -682,8 +764,14 @@ class ServingEngine:
                 # request can fit where a cold one of the same length
                 # cannot (COW pages are fresh and get no discount)
                 plan = self._prefix_plan(req)
+                if self.role == "decode" and not prefix_mod.affinity_ok(
+                    plan, len(req.prompt), self.affinity_suffix_max
+                ):
+                    return True  # popped to BOUNCE — takes no pages
                 n_shared = len(plan.shared) if plan else 0
-                return self.alloc.can_admit(req.total_tokens, n_shared)
+                return self.alloc.can_admit(
+                    self._footprint_tokens(req), n_shared
+                )
 
             req = self.scheduler.pop_next(
                 can, lookahead=self.admission_lookahead
@@ -717,15 +805,28 @@ class ServingEngine:
                 self.scheduler.fail(req, err)
                 continue
             # reserve the FULL prompt+generation footprint up front so a
-            # decoding slot can never deadlock waiting for pages; on a
-            # prefix hit the matched prefix maps existing pages instead
-            # of drawing fresh ones, and prefill resumes at the plan's
+            # decoding slot can never deadlock waiting for pages (a
+            # prefill-role engine reserves prompt-only: the generation
+            # pages live on the decode replica); on a prefix hit the
+            # matched prefix maps existing pages instead of drawing
+            # fresh ones, and prefill resumes at the plan's
             # chunk-aligned resume point
             plan = self._prefix_plan(req)
+            if self.role == "decode" and not prefix_mod.affinity_ok(
+                plan, len(req.prompt), self.affinity_suffix_max
+            ):
+                # the plan the router saw degraded (donor pages churned
+                # out of the trie): bounce for re-dispatch through the
+                # prefill pool rather than chunk-prefilling a cold
+                # prompt here
+                self._affinity_bounced += 1
+                self.bounced.append(req)
+                worked = True
+                continue
             resume = 0
             if plan is not None:
                 self.alloc.admit_shared(
-                    idx, req.total_tokens, plan.prefix_pages
+                    idx, self._footprint_tokens(req), plan.prefix_pages
                 )
                 for logical, _src in plan.cow:
                     pair = self.alloc.cow_page(idx, logical)
@@ -736,7 +837,7 @@ class ServingEngine:
                 self._prefix_hits += 1
                 self._prefill_tokens_saved += resume
             else:
-                self.alloc.admit(idx, req.total_tokens)
+                self.alloc.admit(idx, self._footprint_tokens(req))
                 if self.prefix_sharing:
                     self._prefix_misses += 1
             self._peak_dedup = max(self._peak_dedup, self.dedup_ratio())
@@ -782,43 +883,100 @@ class ServingEngine:
 
     # ---- live KV-page migration (serving/migration.py) -------------------
 
-    def export_pages(self, i: int) -> Dict[str, np.ndarray]:
+    def export_pages(
+        self, i: int, start: int = 0, stop: Optional[int] = None
+    ) -> Dict[str, np.ndarray]:
         """Host copies of the physical pages slot ``i`` holds, in
         LOGICAL order — the donor half of a live migration. Pages ship
         exactly as stored (int8 payloads + per-block f32 scales, or
         bf16 rows), so the survivor's continuation attends to
-        bitwise-identical cache state. Read-only: the slot keeps its
-        pages until :meth:`release_slot`, so a torn transfer can
+        bitwise-identical cache state. ``start``/``stop`` slice the
+        logical page range (a streaming handoff ships only the pages
+        the last chunk committed). Read-only: the slot keeps its pages
+        until :meth:`release_slot`, so a torn transfer can
         re-snapshot."""
         n = self.alloc.slot_pages(i)
-        phys = [int(p) for p in self.alloc.block_tables()[i, :n]]
+        if stop is None:
+            stop = n
+        if not 0 <= start <= stop <= n:
+            raise ValueError(
+                f"page range [{start}, {stop}) outside the {n} pages "
+                f"slot {i} holds"
+            )
+        phys = [int(p) for p in self.alloc.block_tables()[i, start:stop]]
         return {k: np.asarray(v[:, phys]) for k, v in self.pools.items()}
 
-    def release_slot(self, i: int) -> None:
-        """Drop a slot whose request migrated out: free its pages
-        without resolving the request's future (the survivor owns the
-        request now)."""
+    def stage_pages(
+        self, tag: str, page_start: int, pages: Dict[str, np.ndarray]
+    ) -> None:
+        """Scatter streamed handoff payloads into the physical pages of
+        migration reservation ``tag`` BEFORE it commits — the decode
+        side of a streaming handoff warms its reservation fragment by
+        fragment, so ``import_slot(..., pages=None)`` at the end only
+        rebuilds host state. Reserved pages are off the free list and
+        in no block table, so no jitted step can read them; writes are
+        idempotent per logical range (a restarted stream re-stages the
+        same payloads into the same cells). Call under
+        ``server.paused()`` — pool arrays are swapped."""
+        phys = self.alloc.reservation(tag)
+        if not phys:
+            raise KeyError(f"no migration reservation {tag!r}")
+        if set(pages) != set(self.pools):
+            raise ValueError(
+                f"staged pages carry pools {sorted(pages)}; this engine "
+                f"stores {sorted(self.pools)} (mode={self.geom.mode})"
+            )
+        n = next(iter(pages.values())).shape[1]
+        if n == 0:
+            return
+        if page_start + n > len(phys):
+            raise ValueError(
+                f"fragment pages [{page_start}, {page_start + n}) exceed "
+                f"the {len(phys)}-page reservation {tag!r}"
+            )
+        tgt = jnp.asarray(phys[page_start:page_start + n], jnp.int32)
+        for k, v in self.pools.items():
+            self.pools[k] = v.at[:, tgt].set(jnp.asarray(pages[k], v.dtype))
+
+    def note_handoff_bytes(self, n: int) -> None:
+        """Account wire bytes a handoff shipped from/into this engine
+        (the coordinator encodes off-thread, so the engine cannot see
+        the blob sizes itself)."""
+        self._handoff_bytes += int(n)
+
+    def release_slot(self, i: int, *, reason: str = "migrated_out") -> None:
+        """Drop a slot whose request moved out: free its pages without
+        resolving the request's future (whoever owns the request now
+        finishes it). ``reason`` is ``"migrated_out"`` (failover
+        migration), ``"handoff_out"`` (committed prefill→decode
+        handoff) or ``"handoff_abort"`` (degraded handoff — the request
+        re-prefills elsewhere, so neither success counter moves)."""
         s = self.slots[i]
         if s is None:
             return
         if s.span is not None:
-            s.span.end(tokens=len(s.generated), reason="migrated_out")
+            s.span.end(tokens=len(s.generated), reason=reason)
             s.span = None
         self.alloc.evict(i)
         self.slots[i] = None
-        self._migrated_out += 1
+        if reason == "handoff_out":
+            self._handoffs_out += 1
+        elif reason == "migrated_out":
+            self._migrated_out += 1
 
     def import_slot(
         self,
         req: Request,
-        pages: Dict[str, np.ndarray],
+        pages: Optional[Dict[str, np.ndarray]],
         *,
         phase: str,
         n_prefilled: int,
         generated: Sequence[int],
         reserved_tag: Optional[str] = None,
+        handoff: bool = False,
     ) -> int:
-        """Adopt a migrated request mid-stream into a free slot.
+        """Adopt a migrated (or handed-off) request mid-stream into a
+        free slot.
 
         Commits the pages reserved under ``reserved_tag`` (or admits a
         fresh footprint when None), scatters the donated page payloads
@@ -828,10 +986,20 @@ class ServingEngine:
         seed. Because every sampling draw folds in the absolute buffer
         position, the continuation emits the never-evicted stream.
 
+        ``pages=None`` (requires ``reserved_tag``) commits a reservation
+        whose payloads were already streamed in via :meth:`stage_pages`
+        — the final fragment of a streaming handoff only flips host
+        state, no device scatter.
+
         Raises ``AdmissionError`` (with a retry-after hint) when no lane
         is free, and ``ValueError`` on a footprint/geometry mismatch —
         both leave the caller on the re-prefill fallback ladder.
         """
+        if pages is None and reserved_tag is None:
+            raise ValueError(
+                "import_slot(pages=None) needs a reserved_tag whose pages "
+                "were staged via stage_pages"
+            )
         try:
             idx = self.slots.index(None)
         except ValueError:
@@ -839,7 +1007,7 @@ class ServingEngine:
                 f"no free slot for migrated request {req.rid}",
                 retry_after_s=self.scheduler.retry_after_hint(),
             ) from None
-        if set(pages) != set(self.pools):
+        if pages is not None and set(pages) != set(self.pools):
             raise ValueError(
                 f"migrated pages carry pools {sorted(pages)}; this engine "
                 f"stores {sorted(self.pools)} (mode={self.geom.mode})"
@@ -855,16 +1023,17 @@ class ServingEngine:
             self.alloc.admit(idx, req.total_tokens)
             n = self.alloc.slot_pages(idx)
             phys = [int(p) for p in self.alloc.block_tables()[idx, :n]]
-        n_held = next(iter(pages.values())).shape[1]
-        if n_held != len(phys):
-            self.alloc.evict(idx)
-            raise ValueError(
-                f"migrated request {req.rid} holds {n_held} pages but the "
-                f"reservation covers {len(phys)} — geometry mismatch"
-            )
-        tgt = jnp.asarray(phys, jnp.int32)
-        for k, v in self.pools.items():
-            self.pools[k] = v.at[:, tgt].set(jnp.asarray(pages[k], v.dtype))
+        if pages is not None:
+            n_held = next(iter(pages.values())).shape[1]
+            if n_held > len(phys):
+                self.alloc.evict(idx)
+                raise ValueError(
+                    f"migrated request {req.rid} holds {n_held} pages but "
+                    f"the reservation covers {len(phys)} — geometry mismatch"
+                )
+            tgt = jnp.asarray(phys[:n_held], jnp.int32)
+            for k, v in self.pools.items():
+                self.pools[k] = v.at[:, tgt].set(jnp.asarray(pages[k], v.dtype))
         key_data = np.asarray(
             jax.random.key_data(jax.random.key(int(req.sampling.seed)))
         )
@@ -890,7 +1059,10 @@ class ServingEngine:
         self._intern_full_pages(idx, slot)
         if self._t0 is None:
             self._t0 = time.monotonic()
-        self._migrated_in += 1
+        if handoff:
+            self._handoffs_in += 1
+        else:
+            self._migrated_in += 1
         return idx
 
     def _sampling_arrays(self, lanes):
@@ -920,50 +1092,97 @@ class ServingEngine:
         for i, s in enumerate(self.slots):
             if s is None or s.phase != "prefill":
                 continue
-            p = len(s.prompt)
-            clen = min(self.prefill_chunk, p - s.n_prefilled)
-            chunk = np.zeros(self.prefill_chunk, np.int32)
-            chunk[:clen] = s.prompt[s.n_prefilled:s.n_prefilled + clen]
-            tables = self._device_tables()[i:i + 1]
-            tr = get_tracer()
-            sp = None
-            if tr.enabled:
-                sp = tr.begin(
-                    "serving.prefill_chunk", rid=s.req.rid,
-                    replica=self.scheduler.replica, slot=i,
-                    start=s.n_prefilled, tokens=clen,
-                )
-            t0 = time.monotonic()
-            tok0, self.pools = self._chunk_fn(
-                self.params, self.pools, tables,
-                jnp.asarray(chunk[None]),
-                jnp.asarray([s.n_prefilled], jnp.int32),
-                jnp.asarray([clen], jnp.int32),
-                *self._sampling_arrays([i]),
-                self._pages_bucket(),
-            )
-            tok0 = np.asarray(tok0)
-            self._step_time += time.monotonic() - t0
-            if sp is not None:
-                sp.end()
-            s.n_prefilled += clen
-            self._prefill_tokens += clen
-            self._prefill_chunks += 1
-            self._intern_full_pages(i, s)
-            if s.n_prefilled == p:
-                s.generated = [int(tok0[0])]
-                s.phase = "decode"
-                self.scheduler.record_first_token(s.req)
-                self._tokens += 1
-                if tr.enabled:
-                    # the long occupancy span: first token → finish or
-                    # migrate-out; the survivor re-opens it resumed=True
-                    s.span = tr.begin(
-                        "serving.decode", rid=s.req.rid,
-                        replica=self.scheduler.replica, slot=i,
-                    )
+            self._prefill_slot(i, s)
             return True
         return False
+
+    def _prefill_all(self) -> bool:
+        """Prefill-role stepping: every prefill slot advances one chunk
+        this step — with no decode batch to interleave with, there is
+        nothing to yield to."""
+        todo = [
+            (i, s) for i, s in enumerate(self.slots)
+            if s is not None and s.phase == "prefill"
+        ]
+        for i, s in todo:
+            self._prefill_slot(i, s)
+        return bool(todo)
+
+    def _prefill_slot(self, i: int, s: _Slot) -> None:
+        """Advance one slot by one prefill chunk (all roles share this
+        body; the roles differ only in where a finished prompt goes)."""
+        p = len(s.prompt)
+        clen = min(self.prefill_chunk, p - s.n_prefilled)
+        chunk = np.zeros(self.prefill_chunk, np.int32)
+        chunk[:clen] = s.prompt[s.n_prefilled:s.n_prefilled + clen]
+        tables = self._device_tables()[i:i + 1]
+        tr = get_tracer()
+        sp = None
+        if tr.enabled:
+            sp = tr.begin(
+                "serving.prefill_chunk", rid=s.req.rid,
+                replica=self.scheduler.replica, slot=i,
+                start=s.n_prefilled, tokens=clen,
+            )
+        t0 = time.monotonic()
+        tok0, self.pools = self._chunk_fn(
+            self.params, self.pools, tables,
+            jnp.asarray(chunk[None]),
+            jnp.asarray([s.n_prefilled], jnp.int32),
+            jnp.asarray([clen], jnp.int32),
+            *self._sampling_arrays([i]),
+            self._pages_bucket(),
+        )
+        tok0 = np.asarray(tok0)
+        self._step_time += time.monotonic() - t0
+        if sp is not None:
+            sp.end()
+        s.n_prefilled += clen
+        self._prefill_tokens += clen
+        self._prefill_chunks += 1
+        self._intern_full_pages(i, s)
+        if s.n_prefilled < p:
+            if self.role == "prefill" and self.handoff_sink is not None:
+                # streaming handoff: the chunk just committed may have
+                # filled whole pages — ship them now, overlapped with
+                # the next chunk's compute
+                self.handoff_sink(i, s, "chunk")
+            return
+        s.generated = [int(tok0[0])]
+        self.scheduler.record_first_token(s.req)
+        self._tokens += 1
+        if self.role == "prefill":
+            if self._slot_done(s):
+                # finished at its first token (max_new=1, or EOS drawn):
+                # nothing to decode downstream — complete locally and
+                # cancel any fragments already streamed
+                self.scheduler.complete(
+                    s.req, [int(t) for t in s.prompt] + s.generated
+                )
+                self.alloc.evict(i)
+                self.slots[i] = None
+                if self.handoff_sink is not None:
+                    self.handoff_sink(i, s, "local_done")
+                return
+            if self.handoff_sink is None:
+                raise RuntimeError(
+                    f"prefill-role engine finished {s.req.rid} with no "
+                    "handoff sink attached — wire a HandoffCoordinator "
+                    "(serving/disagg.py) or run role='unified'"
+                )
+            # park until the decode replica commits; the coordinator
+            # releases the slot (release_slot) after the handoff lands
+            s.phase = "handoff"
+            self.handoff_sink(i, s, "done")
+            return
+        s.phase = "decode"
+        if tr.enabled:
+            # the long occupancy span: first token → finish or
+            # migrate-out; the survivor re-opens it resumed=True
+            s.span = tr.begin(
+                "serving.decode", rid=s.req.rid,
+                replica=self.scheduler.replica, slot=i,
+            )
 
     def _decode_batch(self) -> bool:
         # a slot can complete within the step that finishes its prefill

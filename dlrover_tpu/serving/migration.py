@@ -130,6 +130,10 @@ class RequestSnapshot:
     kv_heads: int
     head_dim: int
     kv_block: int
+    # logical index of the first page in ``pages`` — a whole-slot
+    # migration ships 0; a streaming-handoff fragment ships the offset
+    # its chunk committed at (serving/disagg.py)
+    page_start: int = 0
     pages: Dict[str, np.ndarray] = field(default_factory=dict)
 
     @property
@@ -205,6 +209,7 @@ def encode_snapshot(snap: RequestSnapshot) -> bytes:
             "phase": snap.phase,
             "max_new_tokens": snap.max_new_tokens,
             "seed": snap.seed,
+            "page_start": snap.page_start,
             "mode": snap.mode,
             "page_size": snap.page_size,
             "n_layers": snap.n_layers,
@@ -318,6 +323,7 @@ class ServingMigrator:
         retries: int = 2,
         shed_per_attempt: int = 2,
         reserve_attempts: int = 6,
+        re_admit=None,
     ):
         self.budgets = budgets or PhaseBudgets()
         self.faults = faults if faults is not None else get_injector()
@@ -325,6 +331,20 @@ class ServingMigrator:
         self.retries = retries
         self.shed_per_attempt = shed_per_attempt
         self.reserve_attempts = reserve_attempts
+        # ``re_admit(req, survivor) -> str`` override for the fallback
+        # ladder's raw re-admission. A disaggregated router installs a
+        # role-aware version here: a decode-only survivor must never be
+        # handed an un-prefilled request (it would chunk-prefill it and
+        # recreate the interference the split removed), so the override
+        # re-dispatches through the prefill pool and returns the name of
+        # the replica that actually took the ticket.
+        self.re_admit = re_admit
+
+    def _re_admit(self, req: Request, survivor) -> str:
+        if self.re_admit is not None:
+            return self.re_admit(req, survivor)
+        survivor.server.re_admit(req)
+        return survivor.name
 
     # ---- phases (each closes over one migration's context) ---------------
 
@@ -488,8 +508,9 @@ class ServingMigrator:
                     )
                     with a.survivor.server.paused() as eng:
                         eng.alloc.abort_migration(a.req.rid)
-                    a.survivor.server.re_admit(a.req)
-                    ctx["re_prefilled"][a.req.rid] = a.survivor.name
+                    ctx["re_prefilled"][a.req.rid] = self._re_admit(
+                        a.req, a.survivor
+                    )
                     if sp is not None:
                         sp.end(path="re_prefill")
                 else:
@@ -512,8 +533,9 @@ class ServingMigrator:
                     continue
                 with a.survivor.server.paused() as eng:
                     eng.alloc.abort_migration(a.req.rid)
-                a.survivor.server.re_admit(a.req)
-                ctx["re_prefilled"][a.req.rid] = a.survivor.name
+                ctx["re_prefilled"][a.req.rid] = self._re_admit(
+                    a.req, a.survivor
+                )
             self._route_queued(ctx, survivors, rr)
             return ctx["assignments"]
 
@@ -562,13 +584,11 @@ class ServingMigrator:
                 f"donor geometry {got} incompatible with survivor {want}"
             )
 
-    @staticmethod
-    def _route_queued(ctx, survivors, rr) -> None:
+    def _route_queued(self, ctx, survivors, rr) -> None:
         """Queued-but-never-admitted victim requests re-route round-robin
         (original tickets; nothing resident to migrate). Idempotent —
         drains ctx['queued'] so resume and fallback can both call it."""
         while ctx["queued"]:
             req = ctx["queued"].pop(0)
             tgt = survivors[next(rr) % len(survivors)]
-            tgt.server.re_admit(req)
-            ctx["re_routed"][req.rid] = tgt.name
+            ctx["re_routed"][req.rid] = self._re_admit(req, tgt)

@@ -230,3 +230,19 @@ def plan_admission(
     if not shared and not cow:
         return None
     return AdmissionPlan(shared, cow, resume, matched)
+
+
+def affinity_ok(
+    plan: Optional[AdmissionPlan], prompt_len: int, max_suffix: int
+) -> bool:
+    """Whether a prefix hit is strong enough for a decode-role replica
+    to admit the request directly — the shared pages are already
+    resident, so only the divergent suffix (``prompt_len - resume``
+    tokens) needs local prefill, and that must stay under
+    ``max_suffix`` or the decode fleet re-inherits the chunked-prefill
+    interference the prefill/decode split exists to remove."""
+    return (
+        plan is not None
+        and plan.resume > 0
+        and prompt_len - plan.resume <= max_suffix
+    )

@@ -20,6 +20,23 @@ pages and resumes mid-decode with zero re-prefilled prompt tokens,
 bitwise-identical output. Without one — or when the migrator itself
 degrades — requests are re-admitted under their original ticket and
 re-prefill from the prompt (docs/serving.md describes the ladder).
+
+Disaggregated fleets (serving/disagg.py): when the replica set carries
+both ``prefill``- and ``decode``-role engines the router grows a
+dispatch layer — new requests go to the least-loaded live prefill
+replica and stream to the decode pool through a
+:class:`~dlrover_tpu.serving.disagg.HandoffCoordinator`; a request
+whose prompt hits a prefix already RESIDENT on a decode replica's trie
+skips the prefill fleet entirely (only the divergent suffix prefills
+on the decode replica — the cross-replica placement residual of
+ROADMAP 1(a)). Failover is role-aware: a dead prefill replica's
+requests re-dispatch on the prefill pool (committed handoffs just
+repoint), a dead decode replica's slots live-migrate to decode
+survivors via the PR 14 ladder, and when either pool empties the
+fleet collapses to ``unified`` — a one-replica "fleet" therefore
+silently runs today's engine. A decode-role replica is never handed a
+raw un-prefilled request on re-admission (it would chunk-prefill it
+and recreate the interference the split removed).
 """
 
 import json
@@ -62,6 +79,10 @@ class ServingReplica:
     def alive(self) -> bool:
         return self.server.alive
 
+    @property
+    def role(self) -> str:
+        return self.server.role
+
     def start(self) -> "ServingReplica":
         self.server.start()
         if self.master_addr:
@@ -70,10 +91,19 @@ class ServingReplica:
             self._client = MasterClient(
                 self.master_addr, node_id=self.node_id
             )
-            self._client.register_node(node_type=NodeType.SERVING)
+            # role-tagged registration: the master's node manager keeps
+            # the prefill and decode pools distinguishable so
+            # plan_serving_reshard can scale them independently
+            self._client.register_node(
+                node_type=NodeType.SERVING, role=self.role
+            )
             self._client.kv_store_set(
                 ADDR_KV_PREFIX + self.name,
-                json.dumps({"name": self.name, "node_id": self.node_id}),
+                json.dumps({
+                    "name": self.name,
+                    "node_id": self.node_id,
+                    "role": self.role,
+                }),
             )
         return self
 
@@ -132,14 +162,16 @@ class _Entry:
 
 
 class ReplicaRouter:
-    """Round-robin request router with exactly-once failover.
+    """Request router with exactly-once failover and, on a role-typed
+    fleet, the prefill/decode dispatch layer.
 
-    Requests fan out over live replicas. ``poll`` detects dead replicas
-    and re-admits their incomplete requests on survivors under the
-    ORIGINAL admission ticket (the ``Request`` object travels — its
-    future resolves wherever the survivor finishes it). Completed
-    entries are never resubmitted; ``Scheduler.complete`` resolves each
-    future at most once even if a race double-delivers.
+    Requests fan out over live replicas (round-robin when unified;
+    least-loaded-prefill or prefix-affinity-decode when disaggregated).
+    ``poll`` detects dead replicas and moves their incomplete requests
+    to survivors under the ORIGINAL admission ticket (the ``Request``
+    object travels — its future resolves wherever the survivor finishes
+    it). Completed entries are never resubmitted; ``Scheduler.complete``
+    resolves each future at most once even if a race double-delivers.
     """
 
     def __init__(
@@ -147,6 +179,8 @@ class ReplicaRouter:
         replicas: List[ServingReplica],
         migrator=None,
         watchdog=None,
+        faults=None,
+        streaming: bool = True,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -156,9 +190,50 @@ class ReplicaRouter:
         # of fallback outcomes classifies as ``migration_fallback``
         self.watchdog = watchdog
         self._entries: List[_Entry] = []
+        self._by_rid: Dict[str, _Entry] = {}
         self._rr = 0
-        self._lock = threading.Lock()
+        # reentrant: the migrator's role-aware re_admit override runs
+        # while poll already holds the lock, and must also work when a
+        # drill drives the migrator directly with no lock held
+        self._lock = threading.RLock()
         self.reports: List = []   # MigrationReports, drill introspection
+
+        self.prefill_pool = [r for r in self.replicas if r.role == "prefill"]
+        self.decode_pool = [r for r in self.replicas if r.role == "decode"]
+        self.disaggregated = bool(self.prefill_pool) and bool(
+            self.decode_pool
+        )
+        self.coordinator = None
+        self._dead_seen: set = set()
+        if self.disaggregated:
+            from dlrover_tpu.serving.disagg import HandoffCoordinator
+
+            self.coordinator = HandoffCoordinator(
+                self.prefill_pool,
+                self.decode_pool,
+                router=self,
+                faults=faults,
+                streaming=streaming,
+            ).start()
+            if self.migrator is not None:
+                # satellite fix: the migrator's fallback must never hand
+                # a decode-only survivor a raw un-prefilled request
+                self.migrator.re_admit = self._role_aware_re_admit
+        else:
+            # one-sided or one-replica "fleet": silently run unified —
+            # a lone prefill replica would park every prompt forever,
+            # a lone decode replica would bounce every cold prompt
+            for r in self.replicas:
+                if r.role != "unified":
+                    logger.warning(
+                        "replica %s has role=%s but the fleet has no "
+                        "%s counterpart — running unified",
+                        r.name, r.role,
+                        "decode" if r.role == "prefill" else "prefill",
+                    )
+                    r.server.engine.role = "unified"
+            self.prefill_pool = []
+            self.decode_pool = []
 
     # ---- fleet latency rollup -------------------------------------------
 
@@ -206,8 +281,11 @@ class ReplicaRouter:
             live = self._live()
             if not live:
                 raise RuntimeError("no live serving replicas")
-            replica = live[self._rr % len(live)]
-            self._rr += 1
+            if self.disaggregated:
+                replica = self._dispatch_target(prompt)
+            else:
+                replica = live[self._rr % len(live)]
+                self._rr += 1
             req = replica.submit(
                 prompt, max_new_tokens, eos_id=eos_id, priority=priority,
                 sampling=sampling, deadline_s=deadline_s,
@@ -215,15 +293,210 @@ class ReplicaRouter:
             entry = _Entry(req, replica)
             req.future.add_done_callback(self._mark_done(entry))
             self._entries.append(entry)
+            self._by_rid[req.rid] = entry
         return req
+
+    # ---- disaggregated dispatch ------------------------------------------
+
+    def _dispatch_target(self, prompt) -> ServingReplica:
+        """Where a new request starts. Prefix affinity first: if a
+        decode replica's radix index holds a resident prefix covering
+        all but an ``affinity_suffix_max`` suffix of the prompt, the
+        request skips the prefill fleet — shared pages map in place and
+        only the divergent suffix prefills there. Otherwise the
+        least-loaded live prefill replica takes it (the engine
+        re-checks the plan at admission and bounces if the donor pages
+        churned out meanwhile). Caller holds the lock."""
+        from dlrover_tpu.serving import prefix as prefix_mod
+
+        tokens = [int(t) for t in prompt]
+        best, best_resume = None, 0
+        for r in self.decode_pool:
+            if not r.alive:
+                continue
+            eng = r.server.engine
+            if eng.trie is None:
+                continue
+            match = eng.trie.lookup(tokens)
+            if not match.pages and not match.tail_tokens:
+                continue
+            plan = prefix_mod.plan_admission(
+                match, len(tokens), eng.geom.page_size, eng.prefill_chunk
+            )
+            if (
+                prefix_mod.affinity_ok(
+                    plan, len(tokens), eng.affinity_suffix_max
+                )
+                and plan.resume > best_resume
+            ):
+                best, best_resume = r, plan.resume
+        if best is not None:
+            logger.info(
+                "prefix-affinity dispatch to %s (%d resident tokens)",
+                best.name, best_resume,
+            )
+            return best
+        live_prefill = [r for r in self.prefill_pool if r.alive]
+        if not live_prefill:
+            self._collapse_locked()
+            live = self._live()
+            if not live:
+                raise RuntimeError("no live serving replicas")
+            r = live[self._rr % len(live)]
+            self._rr += 1
+            return r
+        return min(
+            live_prefill,
+            key=lambda r: r.server.scheduler.queue_depth()
+            + sum(s is not None for s in r.server.engine.slots),
+        )
+
+    def _repoint(self, rid: str, replica: ServingReplica) -> None:
+        """A committed handoff moved ``rid``'s ownership; track it so
+        failover sweeps watch the right replica."""
+        with self._lock:
+            entry = self._by_rid.get(rid)
+            if entry is not None:
+                entry.replica = replica
+
+    def redispatch(self, req: Request) -> str:
+        """Degraded-handoff / affinity-bounce intake: requeue ``req``
+        under its original ticket on a replica that can PREFILL it,
+        and repoint its entry. Returns the receiving replica's name."""
+        with self._lock:
+            tgt = self._re_admit_target()
+            tgt.server.re_admit(req)
+            entry = self._by_rid.get(req.rid)
+            if entry is not None:
+                entry.replica = tgt
+            return tgt.name
+
+    def _re_admit_target(self) -> ServingReplica:
+        """A live replica that accepts raw (un-prefilled) requests —
+        never a decode-role one. When only decode replicas survive,
+        collapse the fleet so they can. Caller holds the lock."""
+        cand = [r for r in self._live() if r.role != "decode"]
+        if not cand:
+            self._collapse_locked()
+            cand = self._live()
+        if not cand:
+            raise RuntimeError("no live serving replicas")
+        r = cand[self._rr % len(cand)]
+        self._rr += 1
+        return r
+
+    def _role_aware_re_admit(self, req: Request, survivor) -> str:
+        """Installed as the migrator's ``re_admit`` override on a
+        disaggregated fleet (satellite fix): the fallback ladder's raw
+        re-admissions route through the prefill pool instead of the
+        decode survivor the migrator happened to pick."""
+        if survivor.role != "decode":
+            survivor.server.re_admit(req)
+            with self._lock:
+                entry = self._by_rid.get(req.rid)
+                if entry is not None:
+                    entry.replica = survivor
+            return survivor.name
+        return self.redispatch(req)
+
+    def _drain_bounced(self) -> int:
+        """Decode-role engines bounce admissions whose affinity plan
+        degraded between dispatch and admission (lock-free deque — the
+        engine loop must never wait on the router). Re-dispatch them
+        through the prefill pool. Caller holds the lock."""
+        n = 0
+        for r in self.decode_pool:
+            bounced = r.server.engine.bounced
+            while bounced:
+                try:
+                    req = bounced.popleft()
+                except IndexError:
+                    break
+                tgt = self._re_admit_target()
+                tgt.server.re_admit(req)
+                entry = self._by_rid.get(req.rid)
+                if entry is not None:
+                    entry.replica = tgt
+                logger.info(
+                    "affinity bounce: %s re-dispatched from %s to %s",
+                    req.rid, r.name, tgt.name,
+                )
+                n += 1
+        return n
+
+    def _collapse_locked(self) -> None:
+        """Runtime degradation: one pool has no live member, so the
+        split cannot function — fold every surviving engine back to
+        ``unified`` and re-dispatch the requests the collapse orphaned
+        (prefill-role slots hold prompt-only footprints and cannot
+        decode in place). Caller holds the lock."""
+        if not self.disaggregated:
+            return
+        logger.warning(
+            "collapsing disaggregated fleet to unified "
+            "(prefill live=%d decode live=%d)",
+            sum(r.alive for r in self.prefill_pool),
+            sum(r.alive for r in self.decode_pool),
+        )
+        self.disaggregated = False
+        coord, self.coordinator = self.coordinator, None
+        if self.migrator is not None:
+            self.migrator.re_admit = None
+        orphans = coord.collapse() if coord is not None else []
+        self.prefill_pool = []
+        self.decode_pool = []
+        live = self._live()
+        for req in orphans:
+            if not live:
+                raise RuntimeError(
+                    "all serving replicas died with requests in flight"
+                )
+            tgt = live[self._rr % len(live)]
+            self._rr += 1
+            tgt.server.re_admit(req)
+            entry = self._by_rid.get(req.rid)
+            if entry is not None:
+                entry.replica = tgt
+
+    def close(self) -> None:
+        """Stop the handoff coordinator's worker thread (no-op on a
+        unified fleet)."""
+        with self._lock:
+            coord, self.coordinator = self.coordinator, None
+        if coord is not None:
+            coord.stop()
+
+    # ---- failover --------------------------------------------------------
 
     def poll(self) -> int:
         """Failover sweep: move every incomplete request whose replica
         died onto a survivor — live page migration when a migrator is
-        attached, re-admission otherwise. Returns how many moved."""
+        attached, re-admission otherwise. On a disaggregated fleet the
+        sweep is role-aware: dead-prefill requests re-dispatch on the
+        prefill pool (committed handoffs just repoint to their decode
+        owner), dead-decode slots migrate to decode survivors, and an
+        emptied pool collapses the fleet to unified. Returns how many
+        requests moved."""
         with self._lock:
-            live = self._live()
             moved = 0
+            if self.disaggregated:
+                moved += self._drain_bounced()
+                if self.coordinator is not None:
+                    for r in self.decode_pool:
+                        if not r.alive and id(r) not in self._dead_seen:
+                            self._dead_seen.add(id(r))
+                            n = self.coordinator.on_replica_dead(r)
+                            if n:
+                                logger.info(
+                                    "dead decode replica %s: %d in-flight "
+                                    "handoffs restarting elsewhere",
+                                    r.name, n,
+                                )
+                if not any(r.alive for r in self.decode_pool) or not any(
+                    r.alive for r in self.prefill_pool
+                ):
+                    self._collapse_locked()
+            live = self._live()
             migrated_victims = set()
             for entry in self._entries:
                 if entry.done or entry.replica.alive:
@@ -233,17 +506,43 @@ class ReplicaRouter:
                         "all serving replicas died with requests in flight"
                     )
                 victim = entry.replica
+                if self.disaggregated and victim in self.prefill_pool:
+                    owner = self.coordinator.resolve_dead_donor(
+                        entry.req.rid
+                    )
+                    if owner is not None and owner.alive:
+                        # the handoff committed before the donor died —
+                        # the decode replica owns the stream; re-admitting
+                        # would duplicate it
+                        entry.replica = owner
+                        moved += 1
+                        continue
+                    survivor = self._re_admit_target()
+                    logger.info(
+                        "re-dispatching %s from dead prefill replica %s "
+                        "onto %s", entry.req.rid, victim.name, survivor.name,
+                    )
+                    survivor.server.re_admit(entry.req)
+                    entry.replica = survivor
+                    moved += 1
+                    continue
                 if (
                     self.migrator is not None
                     and id(victim) not in migrated_victims
                 ):
                     migrated_victims.add(id(victim))
-                    moved += self._migrate_victim(victim, live)
+                    survivors = (
+                        [r for r in self.decode_pool if r.alive]
+                        if self.disaggregated and victim in self.decode_pool
+                        else live
+                    )
+                    if survivors:
+                        moved += self._migrate_victim(victim, survivors)
                 if not entry.replica.alive:
                     # no migrator, or this request slipped past one
-                    # (e.g. completed-but-unresolved slot): re-admit
-                    survivor = live[self._rr % len(live)]
-                    self._rr += 1
+                    # (e.g. completed-but-unresolved slot): re-admit on a
+                    # replica that can prefill it
+                    survivor = self._re_admit_target()
                     logger.info(
                         "re-admitting %s from dead replica %s onto %s",
                         entry.req.rid, victim.name, survivor.name,

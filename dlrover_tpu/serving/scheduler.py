@@ -41,7 +41,7 @@ from dlrover_tpu.observability.histogram import LatencyHistogram
 from dlrover_tpu.observability.tracing import get_tracer
 
 #: phase keys of the scheduler's latency histograms, in envelope order
-LATENCY_PHASES = ("e2e", "ttft", "tpot", "queue_wait")
+LATENCY_PHASES = ("e2e", "ttft", "tpot", "queue_wait", "handoff")
 
 
 class AdmissionError(ValueError):
@@ -399,6 +399,14 @@ class Scheduler:
                 max(0.0, (req.first_token_t - req.submit_t) * 1e3)
             )
 
+    def record_handoff_ms(self, ms: float) -> None:
+        """One prefill→decode handoff's wire time (first fragment export
+        to reservation commit), recorded on the RECEIVING replica's
+        scheduler so the decode pool's handoff_ms_p99 is the admission
+        latency its streams actually pay."""
+        with self._lock:
+            self._hists["handoff"].record(max(0.0, ms))
+
     def complete(self, req: Request, output) -> None:
         """Resolve a request exactly once and record its latency."""
         req.done_t = time.monotonic()
@@ -496,6 +504,11 @@ class Scheduler:
             prefill_tokens_saved=int(es.get("prefill_tokens_saved", 0)),
             trie_pages=int(es.get("trie_pages", 0)),
             dedup_ratio=float(es.get("dedup_ratio", 1.0)),
+            role=str(es.get("role", "unified")),
+            handoffs_in=int(es.get("handoffs_in", 0)),
+            handoffs_out=int(es.get("handoffs_out", 0)),
+            handoff_bytes=int(es.get("handoff_bytes", 0)),
+            handoff_ms_p99=round(hists["handoff"].percentile(99.0), 3),
             hists=json.dumps(
                 {k: hists[k].to_dict() for k in LATENCY_PHASES},
                 sort_keys=True,

@@ -26,7 +26,7 @@ import time
 from dlrover_tpu.observability.tracing import get_tracer
 from dlrover_tpu.serving.engine import ServingEngine
 from dlrover_tpu.serving.scheduler import (
-    Request, SamplingParams, Scheduler,
+    AdmissionError, Request, SamplingParams, Scheduler,
 )
 
 
@@ -174,12 +174,29 @@ class GenerationServer:
             sampling=sampling, deadline_s=deadline_s,
         )
 
+    @property
+    def role(self) -> str:
+        """This replica's pool in a disaggregated fleet:
+        ``"prefill"`` | ``"decode"`` | ``"unified"``."""
+        return self.engine.role
+
     def re_admit(self, req: Request) -> None:
         """Re-prefill failover intake — the migration ladder's fallback
         tier: requeue another replica's in-flight request under its
         original admission ticket; generation restarts from the prompt.
         ``req.sampling`` rides along, and position-indexed draws make
-        the re-prefilled continuation identical to the original."""
+        the re-prefilled continuation identical to the original.
+
+        Refused on a decode-role replica: a raw re-admission means a
+        full chunked prefill on the decode critical path — exactly the
+        interference the prefill/decode split removes. Role-aware
+        callers (ReplicaRouter's migrator override) route the ticket
+        through the prefill pool instead."""
+        if self.engine.role == "decode":
+            raise AdmissionError(
+                f"decode-role replica {self.replica} cannot re-prefill "
+                f"{req.rid} — route it through the prefill pool"
+            )
         self.scheduler.re_admit(req)
 
     def generate(

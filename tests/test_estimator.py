@@ -1182,6 +1182,8 @@ def test_estimator_executor_env_cluster_and_resume(tmp_path, monkeypatch):
         s0.stop()
 
 
+@pytest.mark.slow  # tier-1 budget: spawns a live master (~7s);
+# outage handling also rides the slow estimator e2e drills
 def test_estimator_survives_master_outage(tmp_path):
     """Every master touchpoint (global-step report, model info, the
     failover poll) degrades to a warning when the master dies mid-run —

@@ -433,6 +433,49 @@ _SLOW_LEDGER = [
     "test_kube_http.py::test_pod_watcher_survives_410_by_relisting",
     "test_kube_http.py::test_reconcile_loop_over_real_http_client",
     "test_operator.py::test_operator_entrypoint_main_loop_over_http",
+    # third budget rebalance (PR 17): the new fast additions are tiny,
+    # but the full fast tier measured 915s wall against the 870s budget
+    # on the 1-cpu box. The four heaviest remaining fast tests (58s +
+    # 35s + 23s + 22s, each a coarse double-compile or full-Trainer
+    # composition with a faster tier-1 sibling) moved to the slow tier.
+    "test_model.py::test_logical_axes_match_params",
+    "test_model.py::test_save_qkv_offload_matches_save_qkv",
+    "test_model.py::test_remat_matches_no_remat",
+    "test_observability.py::test_runtime_timer_in_trainer",
+    "test_model.py::test_moe_forward",
+    "test_model_families.py::test_glm_loss_and_grads_with_prefix_batch",
+    "test_model_families.py::test_flash_kernel_window_matches_reference",
+    "test_trainer.py::test_trainer_loss_decreases",
+    "test_sentinels.py::test_fused_block_sentinels_are_stacked",
+    "test_estimator.py::test_estimator_survives_master_outage",
+    # disaggregated prefill/decode drills (PR 17): every entry stands up
+    # a role-typed fleet (two-plus jit compiles) against a unified
+    # reference replica; the affinity-gate property keeps fast units in
+    # the same file.
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[0-True-bf16]",
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[0-True-int8]",
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[0-False-bf16]",
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[0-False-int8]",
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[3-True-bf16]",
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[3-True-int8]",
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[3-False-bf16]",
+    "test_serving_disagg.py::"
+    "test_disagg_bitwise_parity_matrix[3-False-int8]",
+    "test_serving_disagg.py::test_one_shot_handoff_parity",
+    "test_serving_disagg.py::test_torn_fragment_retries_and_stays_bitwise",
+    "test_serving_disagg.py::test_torn_beyond_retries_degrades_to_reprefill",
+    "test_serving_disagg.py::"
+    "test_mid_stream_prefill_kill_cancels_or_repoints_exactly_once",
+    "test_serving_disagg.py::test_mid_stream_decode_kill_collapses_to_unified",
+    "test_serving_disagg.py::"
+    "test_prefix_affinity_skips_prefill_and_stale_plan_bounces",
 ]
 
 
@@ -522,7 +565,11 @@ def _imports_serving_e2e(tree) -> bool:
     """Module-level import of the serving SERVER or REPLICA layer —
     both spin background serve threads and jit-compile the decode
     engine. Engine/scheduler/kv_cache unit imports stay fast."""
-    e2e = ("dlrover_tpu.serving.server", "dlrover_tpu.serving.replica")
+    e2e = (
+        "dlrover_tpu.serving.server",
+        "dlrover_tpu.serving.replica",
+        "dlrover_tpu.serving.disagg",
+    )
     for node in tree.body:  # module level only, by design
         if isinstance(node, ast.Import):
             if any(
@@ -536,7 +583,8 @@ def _imports_serving_e2e(tree) -> bool:
             if any(mod == m or mod.startswith(m + ".") for m in e2e):
                 return True
             if mod == "dlrover_tpu.serving" and any(
-                a.name in ("server", "replica") for a in node.names
+                a.name in ("server", "replica", "disagg")
+                for a in node.names
             ):
                 return True
     return False
@@ -545,7 +593,11 @@ def _imports_serving_e2e(tree) -> bool:
 def _fn_imports_serving_e2e(fn) -> bool:
     """Function-BODY import of serving.server/replica (the drill idiom:
     import inside the test so tier-1 collection stays light)."""
-    e2e = ("dlrover_tpu.serving.server", "dlrover_tpu.serving.replica")
+    e2e = (
+        "dlrover_tpu.serving.server",
+        "dlrover_tpu.serving.replica",
+        "dlrover_tpu.serving.disagg",
+    )
     for node in ast.walk(fn):
         if isinstance(node, ast.Import):
             if any(
@@ -559,7 +611,8 @@ def _fn_imports_serving_e2e(fn) -> bool:
             if any(mod == m or mod.startswith(m + ".") for m in e2e):
                 return True
             if mod == "dlrover_tpu.serving" and any(
-                a.name in ("server", "replica") for a in node.names
+                a.name in ("server", "replica", "disagg")
+                for a in node.names
             ):
                 return True
     return False

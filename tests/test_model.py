@@ -36,6 +36,9 @@ def test_forward_shapes():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow  # tier-1 budget: three full model inits (~58s); the
+# tree-structure property is exercised fast by every sharded HLO test
+# that consumes logical_axes
 def test_logical_axes_match_params():
     for name in ("tiny", "gpt2-124m", "tiny-moe"):
         cfg = get_config(name, n_layer=2)
@@ -92,6 +95,8 @@ def test_offload_attn_remat_matches_no_remat():
         )
 
 
+@pytest.mark.slow  # tier-1 budget: double value_and_grad compile (~35s);
+# the offload path keeps fast coverage via the HLO transfer sentinels
 def test_save_qkv_offload_matches_save_qkv():
     """remat='save_qkv_offload' pins the SAME residual set as save_qkv —
     only the residency differs — so on CPU (where Host space aliases
@@ -214,6 +219,8 @@ def test_grad_accum_matches_full_batch(mesh):
     )
 
 
+@pytest.mark.slow  # tier-1 budget: sharded MoE forward compile
+# (~18s); MoE numerics are pinned fast throughout test_moe.py
 def test_moe_forward(mesh):
     cfg = get_config("tiny-moe")
     params = decoder.init(jax.random.key(0), cfg)
@@ -224,6 +231,8 @@ def test_moe_forward(mesh):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow  # tier-1 budget: double grad compile (~22s); remat
+# parity siblings (offload, dtype-cast) already run on the slow tier
 def test_remat_matches_no_remat():
     cfg = get_config("tiny")
     cfg_r = get_config("tiny", remat="full")

@@ -151,6 +151,8 @@ def test_glm_forward_uses_prefix():
         decoder.forward(params, tokens, cfg)
 
 
+@pytest.mark.slow  # tier-1 budget: grad compile (~13s); the glm
+# prefix mask itself is pinned fast by test_glm_forward_uses_prefix
 def test_glm_loss_and_grads_with_prefix_batch():
     cfg = get_config("tiny-glm")
     params = decoder.init(jax.random.key(0), cfg)
@@ -276,6 +278,8 @@ def test_mha_reference_window_mask():
     )
 
 
+@pytest.mark.slow  # tier-1 budget: kernel-path compile (~9s); the
+# window mask keeps fast coverage via test_window_decode_matches_forward
 def test_flash_kernel_window_matches_reference(monkeypatch):
     """Pallas kernels (interpret) with a window crossing block
     boundaries: forward and backward against the masked reference."""

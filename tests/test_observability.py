@@ -236,6 +236,8 @@ def test_runtime_timer_samples_real_op_breakdown(tmp_path):
     assert "dlrover_tpu_kernel_time_us" in text and 'op="' in text
 
 
+@pytest.mark.slow  # tier-1 budget: full Trainer loop (~23s); the timer
+# itself is pinned fast by the forced-one-shot unit below
 def test_runtime_timer_in_trainer(tmp_path):
     """profile_interval wires the timer around the live train step."""
     import numpy as np

@@ -228,6 +228,8 @@ def test_sentinels_off_adds_no_keys():
     assert not any(k.startswith("sent_") for k in m)
 
 
+@pytest.mark.slow  # tier-1 budget: fused-block compile (~10s);
+# sentinel keys are pinned fast by the per-step sentinel tests
 def test_fused_block_sentinels_are_stacked():
     cfg = _cfg()
     mesh = build_mesh(MeshConfig(dp=8))

@@ -1,0 +1,447 @@
+"""Disaggregated prefill/decode serving (serving/disagg.py + router).
+
+Fast tier: the ``affinity_ok`` dispatch gate is a pure function — unit
+coverage lives here. The ``page_start`` wire field rides the migration
+codec and is covered in test_serving_migration.py (the wire unit file).
+
+Slow tier — the acceptance drills:
+
+- PARITY MATRIX: the same sampled workload served by a 1-prefill +
+  1-decode fleet is bitwise equal to one unified replica at the same
+  seeds across {bf16, int8} × {paged, gather} × {spec on, off} — cold
+  prompts; the prefix-HIT arm is the affinity drill below — plus a
+  one-shot (non-streaming) handoff arm. Both fleets run the same
+  ``prefill_chunk`` (chunk width changes the reduction order).
+- TORN FRAGMENTS: an injected ``drop_page`` at ``serving.handoff``
+  re-exports the same immutable pages and stays bitwise; past the
+  retry budget the handoff degrades to re-prefill under the ORIGINAL
+  ticket — nothing lost, nothing duplicated, still bitwise.
+- MID-STREAM KILLS: killing the prefill donor with fragments in
+  flight cancels-or-repoints exactly once (committed handoffs keep
+  their decode owner; uncommitted ones re-prefill on the pool);
+  killing the only decode replica collapses the fleet to unified and
+  every parked prompt re-admits under its original ticket.
+- PREFIX AFFINITY: a prompt whose prefix is resident on the decode
+  replica skips the prefill fleet entirely (suffix-only local
+  prefill); a plan that went stale before admission bounces back to
+  the router and re-routes through the prefill pool.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dlrover_tpu.elastic import faults  # noqa: E402
+from dlrover_tpu.serving.prefix import (  # noqa: E402
+    AdmissionPlan,
+    affinity_ok,
+)
+from dlrover_tpu.serving.scheduler import SamplingParams  # noqa: E402
+
+# -------------------------------------------------------- affinity gate
+
+
+def _plan(resume):
+    return AdmissionPlan(
+        shared=(), cow=(), resume=resume, matched_tokens=resume
+    )
+
+
+def test_affinity_requires_a_resident_prefix():
+    assert not affinity_ok(None, 10, 8)          # radix miss
+    assert not affinity_ok(_plan(0), 10, 8)      # matched < one chunk
+
+
+def test_affinity_bounds_the_local_suffix():
+    # the decode replica only prefills the divergent suffix locally;
+    # past max_suffix it would re-inherit chunked-prefill interference
+    assert affinity_ok(_plan(8), 10, 8)          # 2-token suffix
+    assert affinity_ok(_plan(8), 16, 8)          # suffix == budget
+    assert not affinity_ok(_plan(8), 17, 8)      # one past: bounce
+    assert not affinity_ok(_plan(4), 12, 0)      # zero budget, any suffix
+
+
+# ----------------------------------------------------------- drill rig
+
+
+_SERVER_KW = dict(
+    n_slots=4, max_len=32, page_size=4, prefill_chunk=4,
+    idle_sleep=0.001,
+)
+
+
+@pytest.fixture(scope="module")
+def drill():
+    from dlrover_tpu.models import decoder
+    from dlrover_tpu.models.config import get_config
+
+    cfg = get_config(
+        "tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+        vocab_size=32, max_seq=64,
+    )
+    params = decoder.init(jax.random.key(0), cfg)
+    prompts = [[2, 3, 4, 2, 3], [9, 10, 9, 10], [5, 6, 7], [11, 3, 7, 1]]
+    max_new = [10, 10, 10, 10]
+    sps = [
+        SamplingParams(temperature=0.9, top_k=5, top_p=0.9, seed=i + 1)
+        for i in range(4)
+    ]
+    return cfg, params, prompts, max_new, sps
+
+
+def _serve(drill, roles, *, router_kw=None, server_kw=None,
+           before_wait=None):
+    """Stand up a role-typed fleet, run the drill workload, tear down.
+
+    Returns everything the assertions need, gathered BEFORE teardown
+    (``router.close`` drops the coordinator)."""
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    cfg, params, prompts, max_new, sps = drill
+    kw = dict(_SERVER_KW, mode="bf16")
+    kw.update(server_kw or {})
+    reps = [
+        ServingReplica(
+            f"dg{i}-{role}", params, cfg, node_id=i, role=role, **kw
+        ).start()
+        for i, role in enumerate(roles)
+    ]
+    router = ReplicaRouter(reps, **(router_kw or {}))
+    try:
+        reqs = [
+            router.submit(p, m, sampling=sp)
+            for p, m, sp in zip(prompts, max_new, sps)
+        ]
+        if before_wait is not None:
+            before_wait(router, reps, reqs)
+        outs = router.wait_all(timeout=600)
+        coord = router.coordinator
+        return {
+            "outs": outs,
+            "reqs": reqs,
+            "stats": {r.name: r.server.engine.stats() for r in reps},
+            "completed": {
+                r.name: r.server.scheduler.completed for r in reps
+            },
+            "degraded": coord.degraded if coord else 0,
+            "handoffs_done": coord.completed if coord else 0,
+            "disaggregated": router.disaggregated,
+            "reserved": {
+                r.name: r.server.engine.alloc.reserved_pages
+                for r in reps
+            },
+        }
+    finally:
+        router.close()
+        for r in reps:
+            r.stop()
+
+
+# ------------------------------------------------------- parity matrix
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+@pytest.mark.parametrize("paged", [True, False])
+@pytest.mark.parametrize("spec_k", [0, 3])
+def test_disagg_bitwise_parity_matrix(drill, mode, paged, spec_k):
+    skw = dict(mode=mode, paged=paged, spec_k=spec_k)
+    uni = _serve(drill, ["unified"], server_kw=skw)
+    dis = _serve(drill, ["prefill", "decode"], server_kw=skw)
+    # the split changed the transport schedule, not the numerics
+    assert dis["outs"] == uni["outs"]
+    assert dis["disaggregated"]
+    pre, dec = dis["stats"]["dg0-prefill"], dis["stats"]["dg1-decode"]
+    assert pre["handoffs_out"] == 4 and dec["handoffs_in"] == 4
+    assert pre["handoff_bytes"] > 0
+    assert dis["degraded"] == 0 and dis["handoffs_done"] == 4
+    # every request completed exactly once, on the decode side; the
+    # decode engine never ran a cold prefill
+    assert dis["completed"] == {"dg0-prefill": 0, "dg1-decode": 4}
+    assert dec["prefill_tokens"] == 0
+    assert all(v == 0 for v in dis["reserved"].values())
+
+
+@pytest.mark.slow
+def test_one_shot_handoff_parity(drill):
+    """streaming=False: the whole snapshot ships as ONE fragment at
+    prefill completion — the fallback wire schedule is bitwise too."""
+    uni = _serve(drill, ["unified"])
+    dis = _serve(
+        drill, ["prefill", "decode"],
+        router_kw=dict(streaming=False),
+    )
+    assert dis["outs"] == uni["outs"]
+    assert dis["handoffs_done"] == 4 and dis["degraded"] == 0
+    assert dis["completed"]["dg1-decode"] == 4
+
+
+# ------------------------------------------------------ torn fragments
+
+
+@pytest.mark.slow
+def test_torn_fragment_retries_and_stays_bitwise(drill):
+    uni = _serve(drill, ["unified"])
+    inj = faults.FaultInjector()
+    # one transient tear: the retry re-exports the same immutable
+    # committed pages from the donor and the stream proceeds
+    inj.install(
+        faults.FaultSpec("drop_page", point="serving.handoff", times=1)
+    )
+    dis = _serve(
+        drill, ["prefill", "decode"], router_kw=dict(faults=inj),
+    )
+    assert dis["outs"] == uni["outs"]
+    assert dis["degraded"] == 0 and dis["handoffs_done"] == 4
+    assert all(v == 0 for v in dis["reserved"].values())
+
+
+@pytest.mark.slow
+def test_torn_beyond_retries_degrades_to_reprefill(drill):
+    uni = _serve(drill, ["unified"])
+    inj = faults.FaultInjector()
+    # retries=1 → two decode attempts per fragment: two tears exhaust
+    # exactly one handoff, whose re-dispatch then runs fault-free
+    inj.install(
+        faults.FaultSpec("drop_page", point="serving.handoff", times=2)
+    )
+    dis = _serve(
+        drill, ["prefill", "decode"], router_kw=dict(faults=inj),
+    )
+    # degradation is invisible in the output: position-indexed sampling
+    # makes the re-prefilled continuation bitwise too
+    assert dis["outs"] == uni["outs"]
+    assert dis["degraded"] >= 1
+    # no lost, no duplicated request
+    assert sum(dis["completed"].values()) == 4
+    assert all(r.future.done() for r in dis["reqs"])
+    assert all(v == 0 for v in dis["reserved"].values())
+
+
+# ----------------------------------------------------- mid-stream kills
+
+
+def _wait(cond, timeout=60.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.mark.slow
+def test_mid_stream_prefill_kill_cancels_or_repoints_exactly_once(drill):
+    """Kill the prefill donor with one handoff committed and one still
+    streaming: the committed request keeps its decode owner (repoint,
+    no re-prefill); the in-flight one cancels atomically and re-admits
+    on the surviving prefill replica under its original ticket."""
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    cfg, params, prompts, max_new, sps = drill
+    kw = dict(_SERVER_KW, mode="bf16")
+    uni = _serve(drill, ["unified"])
+    reps = [
+        ServingReplica(
+            name, params, cfg, node_id=i, role=role, **kw
+        ).start()
+        for i, (name, role) in enumerate([
+            ("dgk0-prefill", "prefill"),
+            ("dgk1-prefill", "prefill"),
+            ("dgk2-decode", "decode"),
+        ])
+    ]
+    p0, p1, d = reps
+    router = ReplicaRouter(reps)
+    try:
+        # park BOTH prefill loops so dispatch is deterministic
+        # (least-loaded with a stable tie-break alternates) and the
+        # victim can be hand-stepped to a pinned mid-stream state
+        with p0.server.paused() as eng0, p1.server.paused():
+            reqs = [
+                router.submit(p, m, sampling=sp)
+                for p, m, sp in zip(prompts, max_new, sps)
+            ]
+            assert [e.replica.name for e in router._entries] == [
+                "dgk0-prefill", "dgk1-prefill",
+                "dgk0-prefill", "dgk1-prefill",
+            ]
+            # one hand step: prompt[2] (3 tokens, one chunk) finishes
+            # prefill and its handoff commits; prompt[0] (5 tokens,
+            # two chunks) ships its first full page and stays mid-prefill
+            eng0.step()
+            # the commit's donor-side slot release wants OUR held pause
+            # lock, so the coordinator's `completed` counter is wedged;
+            # the commit point itself is the decode-side import, and the
+            # coordinator lock serializes it against the dead-donor
+            # resolution below — wait on that
+            assert _wait(
+                lambda: d.server.engine.stats()["handoffs_in"] >= 1
+            ), "first handoff never committed"
+            assert router.coordinator.pending() >= 1
+            degraded0 = router.coordinator.degraded
+            p0.kill()
+        coord = router.coordinator
+        router.poll()
+        outs = router.wait_all(timeout=600)
+        assert outs == uni["outs"]
+        assert all(r.future.done() for r in reqs)
+        # exactly one in-flight handoff cancelled → re-prefilled; the
+        # committed one repointed without touching a prefill engine
+        assert coord.degraded == degraded0 + 1
+        # the survivor re-prefilled the cancelled request
+        assert p1.server.engine.stats()["handoffs_out"] == 3
+        assert d.server.engine.stats()["handoffs_in"] == 4
+        assert d.server.scheduler.completed == 4
+        assert d.server.engine.alloc.reserved_pages == 0
+    finally:
+        router.close()
+        for r in reps:
+            r.stop()
+
+
+@pytest.mark.slow
+def test_mid_stream_decode_kill_collapses_to_unified(drill):
+    """Kill the ONLY decode replica with fragments in flight (the
+    coordinator is wedged against the held pause, so nothing has
+    committed): the pool empties, the fleet collapses to unified, and
+    every parked prompt re-admits on the ex-prefill replica under its
+    original ticket — no lost, no duplicated request, still bitwise."""
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    cfg, params, prompts, max_new, sps = drill
+    kw = dict(_SERVER_KW, mode="bf16")
+    uni = _serve(drill, ["unified"])
+    p = ServingReplica(
+        "dgc0-prefill", params, cfg, node_id=0, role="prefill", **kw
+    ).start()
+    d = ServingReplica(
+        "dgc1-decode", params, cfg, node_id=1, role="decode", **kw
+    ).start()
+    router = ReplicaRouter([p, d])
+    try:
+        # hold the decode pause across submission: fragments stream
+        # from the prefill engine but staging blocks on the pause
+        # lock, so the kill lands with every handoff mid-flight
+        with d.server.paused():
+            reqs = [
+                router.submit(pr, m, sampling=sp)
+                for pr, m, sp in zip(prompts, max_new, sps)
+            ]
+            assert _wait(
+                lambda: p.server.engine.stats()["prefill_tokens"] > 0
+            ), "prefill never started"
+            d.kill()
+        router.poll()
+        assert not router.disaggregated  # pool emptied → collapsed
+        assert p.server.engine.role == "unified"
+        outs = router.wait_all(timeout=600)
+        assert outs == uni["outs"]
+        assert all(r.future.done() for r in reqs)
+        # everything finished on the collapsed survivor; the dead
+        # decode replica completed nothing
+        assert p.server.scheduler.completed == 4
+        assert d.server.scheduler.completed == 0
+    finally:
+        router.close()
+        p.stop()
+        d.stop()
+
+
+# ------------------------------------------------------ prefix affinity
+
+
+@pytest.mark.slow
+def test_prefix_affinity_skips_prefill_and_stale_plan_bounces(drill):
+    """A prompt whose prefix is resident on the decode replica
+    dispatches there directly — the prefill fleet is never touched and
+    only the suffix prefills locally. A plan that goes stale between
+    dispatch and admission (resident pages dropped) bounces back to
+    the router, which re-routes it through the prefill pool."""
+    from dlrover_tpu.serving import prefix as prefix_mod
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+
+    cfg, params, _, _, _ = drill
+    kw = dict(
+        _SERVER_KW, mode="bf16", prefix_sharing=True, max_len=64,
+    )
+    hot = [3, 5, 2, 7, 4, 6, 1, 8, 2, 5, 3, 9]  # 3 full pages
+    # interned pages die with their LAST SHARER (the trie drops freed
+    # pages) — the seeder's long decode keeps the prefix resident while
+    # the followers dispatch, exactly the production hot-prefix shape
+    jobs = [
+        (hot, 40),           # A: cold — prefill fleet, seeds the trie
+        (hot + [13], 20),    # B: 1-token suffix — affinity hit
+        (hot + [14], 8),     # C: stale plan — bounced, re-routed
+    ]
+    sps = [
+        SamplingParams(temperature=0.9, top_k=5, top_p=0.9, seed=i + 21)
+        for i in range(3)
+    ]
+
+    def run_unified():
+        rep = ServingReplica(
+            "aff-uni", params, cfg, node_id=9, role="unified", **kw
+        ).start()
+        try:
+            return [
+                rep.server.generate(
+                    pr, m, sampling=sp, timeout=600.0
+                )
+                for (pr, m), sp in zip(jobs, sps)
+            ]
+        finally:
+            rep.stop()
+
+    refs = run_unified()
+    p = ServingReplica(
+        "aff-prefill", params, cfg, node_id=0, role="prefill", **kw
+    ).start()
+    d = ServingReplica(
+        "aff-decode", params, cfg, node_id=1, role="decode", **kw
+    ).start()
+    router = ReplicaRouter([p, d])
+    try:
+        ra = router.submit(jobs[0][0], jobs[0][1], sampling=sps[0])
+        # A seeds through the pool: once the handoff lands, the prompt
+        # pages are interned on the decode replica and stay resident
+        # for as long as A (or any later sharer) holds them
+        assert _wait(
+            lambda: p.server.engine.stats()["handoffs_out"] == 1
+            and d.server.engine.stats()["trie_pages"] >= 3
+        ), "seeder handoff never landed on the decode replica"
+
+        # B: dispatched while A is still decoding — the resident prefix
+        # routes it straight to the decode replica
+        rb = router.submit(jobs[1][0], jobs[1][1], sampling=sps[1])
+        assert router._by_rid[rb.rid].replica is d  # skipped the fleet
+        assert _wait(
+            lambda: d.server.engine.stats()["prefix_hits"] >= 1
+        ), "affinity admission never shared the resident pages"
+        sp_, sd = p.server.engine.stats(), d.server.engine.stats()
+        assert sp_["handoffs_out"] == 1       # prefill fleet untouched
+        assert sd["prefill_tokens"] <= len(jobs[1][0])  # suffix only
+
+        # C: dispatch decides on the resident prefix, then the plan
+        # goes stale before the engine admits (paused across both)
+        with d.server.paused() as eng:
+            rc = router.submit(jobs[2][0], jobs[2][1], sampling=sps[2])
+            assert router._by_rid[rc.rid].replica is d
+            eng.trie = prefix_mod.PrefixIndex(eng.geom.page_size)
+            eng.alloc.on_free = eng.trie.drop_pages
+        assert _wait(
+            lambda: d.server.engine.stats()["affinity_bounced"] >= 1
+        ), "stale plan never bounced"
+        router.poll()  # drains the bounce lane, re-routes
+        assert router.wait_all(timeout=600) == refs
+        assert d.server.engine.stats()["affinity_bounced"] == 1
+        # the re-route went through the prefill pool this time
+        assert p.server.engine.stats()["handoffs_out"] == 2
+        assert all(r.future.done() for r in (ra, rb, rc))
+    finally:
+        router.close()
+        p.stop()
+        d.stop()

@@ -75,6 +75,28 @@ def test_wire_roundtrip_bitwise(mode):
         )
 
 
+def test_page_start_rides_the_wire_and_defaults_to_zero():
+    # disaggregated prefill→decode streaming ships PARTIAL snapshots:
+    # page_start addresses where this fragment's pages land in the
+    # target's reservation. One-shot blobs (and every pre-PR recording)
+    # decode to the default 0 — the old wire is a prefix of the new.
+    snap = _snap("bf16")
+    assert mig.decode_snapshot(mig.encode_snapshot(snap)).page_start == 0
+
+    frag = mig.RequestSnapshot(
+        rid=snap.rid, prompt=snap.prompt, generated=[],
+        n_prefilled=0, phase="prefill",
+        max_new_tokens=snap.max_new_tokens, seed=snap.seed,
+        mode=snap.mode, page_size=snap.page_size,
+        n_layers=snap.n_layers, kv_heads=snap.kv_heads,
+        head_dim=snap.head_dim, kv_block=snap.kv_block,
+        page_start=2, pages={k: v[:, :1] for k, v in snap.pages.items()},
+    )
+    out = mig.decode_snapshot(mig.encode_snapshot(frag))
+    assert out.page_start == 2 and out.phase == "prefill"
+    assert out.n_pages == 1
+
+
 def test_torn_blobs_raise_not_partial_import():
     blob = mig.encode_snapshot(_snap())
     cases = {

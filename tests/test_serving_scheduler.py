@@ -260,7 +260,7 @@ def test_publish_hists_envelope_merges_back_exactly():
         s.complete(r, [1, 2])
     rec = s.publish()
     env = _json.loads(rec.hists)
-    assert set(env) == {"e2e", "ttft", "tpot", "queue_wait"}
+    assert set(env) == {"e2e", "ttft", "tpot", "queue_wait", "handoff"}
     back = LatencyHistogram.from_dict(env["e2e"])
     assert back.n == s.histograms()["e2e"].n
     assert back.summary() == s.latency_ms()

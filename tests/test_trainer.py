@@ -92,6 +92,8 @@ def test_trainer_resumes_from_checkpoint(tmp_path, monkeypatch):
     assert int(s2["step"]) == 9
 
 
+@pytest.mark.slow  # tier-1 budget: full training loop (~9s); the
+# fast e2e representative is test_trainer_trains_and_checkpoints
 def test_trainer_loss_decreases(tmp_path):
     cfg = _cfg()
     mesh = build_mesh(MeshConfig(dp=8))
