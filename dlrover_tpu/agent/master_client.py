@@ -225,6 +225,35 @@ class MasterClient:
         resp = self._t.get(msgs.ServingReshardRequest(node_id=self.node_id))
         return resp if resp else msgs.ServingReshardDirective()
 
+    def report_serving_scale(
+        self,
+        role: str,
+        direction: str,
+        n_before: int,
+        n_after: int,
+        signal: str = "",
+        reason: str = "",
+    ) -> bool:
+        """Announce one autoscaler scale decision; the master versions
+        it as a serving-scale directive (``get_serving_scale``)."""
+        return self._t.report(
+            msgs.ServingScaleNotice(
+                node_id=self.node_id,
+                role=role,
+                direction=direction,
+                n_before=int(n_before),
+                n_after=int(n_after),
+                signal=signal,
+                reason=reason,
+            )
+        )
+
+    def get_serving_scale(self, role: str = "") -> msgs.ServingScaleDirective:
+        resp = self._t.get(
+            msgs.ServingScaleRequest(node_id=self.node_id, role=role)
+        )
+        return resp if resp else msgs.ServingScaleDirective()
+
     def report_network_check_result(
         self, elapsed_time: float, succeeded: bool
     ) -> bool:

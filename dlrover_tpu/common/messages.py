@@ -357,6 +357,39 @@ class ServingReshardDirective:
     reason: str = ""
 
 
+@message
+class ServingScaleNotice:
+    """The serving autoscaler announces one scale decision so the
+    master can version it and track the fleet's target sizes — the
+    serving analogue of a trainer ScalePlan submission."""
+
+    node_id: int = 0
+    role: str = "unified"        # prefill | decode | unified
+    direction: str = ""          # out | in
+    n_before: int = 0
+    n_after: int = 0
+    signal: str = ""             # breach signal that drove the decision
+    reason: str = ""
+
+
+@message
+class ServingScaleRequest:
+    node_id: int = 0
+    role: str = ""               # "" = any role's latest directive
+
+
+@message
+class ServingScaleDirective:
+    """The master's serving-scale directive (versioned like
+    :class:`ServingReshardDirective`; 0 = none pending): bring the
+    ``role`` pool to ``target`` live replicas."""
+
+    version: int = 0
+    role: str = "unified"
+    target: int = 0
+    reason: str = ""
+
+
 # ---------------------------------------------------------------------------
 # Data sharding (reference: task_manager.py + sharding/client.py)
 # ---------------------------------------------------------------------------

@@ -109,6 +109,11 @@ class JobManager:
         # monotonic, one pending directive at a time
         self._serving_reshard_version = 0
         self._serving_reshard: Optional[Dict] = None
+        # serving-tier scale directives (master/serving_autoscaler.py):
+        # versioned per decision, latest directive kept PER ROLE so
+        # prefill and decode pools scale independently
+        self._serving_scale_version = 0
+        self._serving_scale: Dict[str, Dict] = {}
         self._init_nodes()
 
     def _init_nodes(self):
@@ -407,6 +412,56 @@ class JobManager:
             if self._serving_reshard is None:
                 return {"version": 0}
             return dict(self._serving_reshard)
+
+    # ---- serving scale (SLO-driven autoscaler directives) ----------------
+
+    def plan_serving_scale(
+        self, role: str, target: int, reason: str = ""
+    ) -> int:
+        """Version one autoscaler decision: bring the ``role`` pool to
+        ``target`` live replicas. The latest directive is kept per role
+        (a prefill scale-out never clobbers a pending decode scale-in)
+        but versions draw from one monotonic counter, so the fleet-wide
+        decision ORDER is still total. Returns the version (starts
+        at 1)."""
+        from dlrover_tpu.observability.tracing import get_tracer
+
+        with self._lock:
+            self._serving_scale_version += 1
+            version = self._serving_scale_version
+            self._serving_scale[role] = {
+                "version": version,
+                "role": role,
+                "target": int(target),
+                "reason": reason,
+            }
+        get_tracer().instant(
+            "serving.scale_plan",
+            version=version,
+            role=role,
+            target=int(target),
+        )
+        logger.info(
+            "serving scale directive v%d: role=%s target=%d (%s)",
+            version, role, int(target), reason or "slo",
+        )
+        return version
+
+    def get_serving_scale(self, role: str = "") -> Dict:
+        """The latest scale directive for ``role`` — or, with no role,
+        the newest across all roles. ``{"version": 0}`` when none."""
+        with self._lock:
+            if role:
+                d = self._serving_scale.get(role)
+                return dict(d) if d else {"version": 0}
+            if not self._serving_scale:
+                return {"version": 0}
+            return dict(
+                max(
+                    self._serving_scale.values(),
+                    key=lambda d: d["version"],
+                )
+            )
 
     def all_workers_exited(self) -> bool:
         with self._lock:

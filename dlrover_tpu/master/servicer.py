@@ -284,6 +284,28 @@ class MasterServicer:
             )
         return True
 
+    def _report_serving_scale(self, m: msgs.ServingScaleNotice) -> bool:
+        """The serving autoscaler reports one scale decision: version
+        it as a serving-scale directive and surface it on the elastic
+        event stream, same shape as the eviction path."""
+        if self.job_manager is None:
+            return False
+        version = self.job_manager.plan_serving_scale(
+            m.role, m.n_after, reason=m.reason or m.signal
+        )
+        if self.telemetry_hub is not None and self.telemetry_hub.enabled:
+            self.telemetry_hub.publish(
+                telemetry.ElasticEvent(
+                    kind="serving_scale_notice",
+                    node_id=m.node_id,
+                    detail=(
+                        f"v{version} role={m.role} {m.direction} "
+                        f"{m.n_before}->{m.n_after} {m.signal}"
+                    ).strip(),
+                )
+            )
+        return True
+
     def _report_kv(self, m: msgs.KeyValuePair) -> bool:
         if self.kv_store:
             self.kv_store.set(m.key, m.value)
@@ -346,6 +368,7 @@ class MasterServicer:
         "NetworkCheckResult": _report_network_check,
         "EvictionNotice": _report_eviction,
         "ServingEvictionNotice": _report_serving_eviction,
+        "ServingScaleNotice": _report_serving_scale,
         "KeyValuePair": _report_kv,
         "SyncJoin": _report_sync_join,
         "CheckpointStepSync": _report_ckpt_step,
@@ -433,6 +456,19 @@ class MasterServicer:
             victim=plan["victim"],
             survivors=list(plan["survivors"]),
             deadline_s=plan["deadline_s"],
+            reason=plan["reason"],
+        )
+
+    def _get_serving_scale(self, m: msgs.ServingScaleRequest):
+        if self.job_manager is None:
+            return msgs.ServingScaleDirective()
+        plan = self.job_manager.get_serving_scale(m.role)
+        if not plan.get("version"):
+            return msgs.ServingScaleDirective()
+        return msgs.ServingScaleDirective(
+            version=plan["version"],
+            role=plan["role"],
+            target=plan["target"],
             reason=plan["reason"],
         )
 
@@ -549,6 +585,7 @@ class MasterServicer:
         "NetworkCheckStatusRequest": _get_network_status,
         "ReshardPlanRequest": _get_reshard_plan,
         "ServingReshardRequest": _get_serving_reshard,
+        "ServingScaleRequest": _get_serving_scale,
         "NumNodesWaitingRequest": _get_num_nodes_waiting,
         "TaskRequest": _get_task,
         "ShardCheckpointRequest": _get_shard_ckpt,

@@ -95,6 +95,7 @@ def diagnose(records: List, world: int = 0) -> Dict:
         }
 
     serving = _serving_section(by_type)
+    scale_decisions = _scale_section(by_type)
 
     steps = by_type.get("StepRecord", [])
     step_info = {}
@@ -132,7 +133,45 @@ def diagnose(records: List, world: int = 0) -> Dict:
             for s in by_type.get("HealthSummary", [])
         ],
         "serving": serving,
+        "scale_decisions": scale_decisions,
         "healthy": not anomalies,
+    }
+
+
+def _scale_section(by_type: Dict[str, List]) -> Dict:
+    """Replay ``ScaleDecisionRecord`` lines into WHY the fleet is its
+    current size: the full decision trail in write order, per-role
+    final pool sizes, and the worst observed reaction time. Recordings
+    that predate autoscaling contain no such lines and replay as
+    ``{}`` — absence means "no decisions", not an error."""
+    recs = by_type.get("ScaleDecisionRecord", [])
+    if not recs:
+        return {}
+    trail = []
+    final_size: Dict[str, int] = {}
+    worst_reaction = 0.0
+    for r in recs:  # file order == write order
+        trail.append({
+            "role": r.role,
+            "direction": r.direction,
+            "signal": r.signal,
+            "value": r.value,
+            "target": r.target,
+            "n_before": r.n_before,
+            "n_after": r.n_after,
+            "version": r.version,
+            "reaction_s": r.reaction_s,
+            "replica": r.replica,
+            "reason": r.reason,
+        })
+        if r.direction:  # clear records don't resize the pool
+            final_size[r.role] = r.n_after
+        worst_reaction = max(worst_reaction, r.reaction_s)
+    return {
+        "decisions": trail,
+        "n_scaled": sum(1 for d in trail if d["direction"]),
+        "final_size": final_size,
+        "worst_reaction_s": worst_reaction,
     }
 
 
@@ -264,6 +303,23 @@ def format_report(diag: Dict) -> str:
             lines.append(
                 f"  fleet {phase}: p50 {s['p50']:.1f}ms "
                 f"p99 {s['p99']:.1f}ms (n={s['n']})"
+            )
+    scale = diag.get("scale_decisions") or {}
+    if scale:
+        lines.append("")
+        lines.append(
+            f"autoscale: {scale['n_scaled']} scale decision(s), "
+            f"worst reaction {scale['worst_reaction_s']:.2f}s"
+        )
+        for role, n in sorted(scale["final_size"].items()):
+            lines.append(f"  {role} pool: {n} replica(s) final")
+        for d in scale["decisions"][:20]:
+            arrow = d["direction"] or "clear"
+            who = f" [{d['replica']}]" if d["replica"] else ""
+            lines.append(
+                f"  v{d['version']} {arrow} {d['role']} "
+                f"{d['n_before']}→{d['n_after']}: {d['signal']} "
+                f"({d['reason']}){who}"
             )
     if diag["healthy"]:
         lines.append("no anomalies recorded — run looks healthy")

@@ -31,7 +31,7 @@ import json
 import math
 from typing import Dict, Iterable, Optional
 
-__all__ = ["LatencyHistogram", "merge_histograms"]
+__all__ = ["LatencyHistogram", "histogram_delta", "merge_histograms"]
 
 
 class LatencyHistogram:
@@ -220,4 +220,42 @@ def merge_histograms(
             out = h.copy()
         else:
             out.merge(h)
+    return out
+
+
+def histogram_delta(
+    cur: LatencyHistogram, prev: Optional[LatencyHistogram]
+) -> LatencyHistogram:
+    """The WINDOW between two snapshots of one lifetime histogram:
+    ``cur``'s bucket counts minus ``prev``'s. Scheduler histograms only
+    reset on ``reset_latencies``, so a controller that must judge *this
+    window's* p99 (the serving autoscaler's hysteresis-clear check)
+    subtracts its previous snapshot instead of letting minutes of
+    healthy history mask a fresh breach — or a cleared breach.
+
+    The exactness property carries over: counts of the window equal
+    counts of the raw samples recorded between the snapshots. The one
+    approximation is the clamp range — vmin/vmax of the WINDOW are not
+    recoverable from the snapshots, so ``cur``'s lifetime extremes are
+    used and window percentiles inherit lifetime clamping. ``prev`` of
+    None (first window) returns a copy of ``cur``. Raises on geometry
+    mismatch, same as ``merge``."""
+    if prev is None:
+        return cur.copy()
+    if prev.geometry() != cur.geometry():
+        raise ValueError(
+            f"histogram geometry mismatch: {prev.geometry()} vs "
+            f"{cur.geometry()}"
+        )
+    out = LatencyHistogram(
+        min_value=cur.min_value, sub_bits=cur.sub_bits
+    )
+    for idx, c in cur.counts.items():
+        d = c - prev.counts.get(idx, 0)
+        if d > 0:
+            out.counts[idx] = d
+    out.n = max(0, cur.n - prev.n)
+    out.total = max(0.0, cur.total - prev.total)
+    out.vmin = cur.vmin
+    out.vmax = cur.vmax
     return out

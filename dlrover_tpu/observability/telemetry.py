@@ -335,6 +335,37 @@ class ServingRecord:
     ts: float = 0.0
 
 
+@telemetry_record
+class ScaleDecisionRecord:
+    """One serving-autoscaler decision (master/serving_autoscaler.py).
+
+    ``direction`` is "out" (a warm replica joined ``role``'s pool) or
+    "in" (the least-loaded member drained via live migration and
+    detached); ``signal`` names the gate that drove it (slo_breach |
+    ttft_regression | out_of_pages | queue_depth | shed_storm | clear |
+    planned), with ``value`` the measured reading against ``target``.
+    ``reaction_s`` is the breach-edge → decision-applied latency (the
+    control-loop half of the bench's breach → p99-restored headline);
+    ``version`` is the master's serving-scale directive version (0 when
+    the scaler versioned locally). ``replica`` names the joiner
+    (scale-out) or the drained victim (scale-in). Recordings that
+    predate autoscaling simply contain no lines of this type — the
+    healthcheck replay treats absence as "no decisions"."""
+
+    role: str = "unified"
+    direction: str = ""
+    signal: str = ""
+    value: float = 0.0
+    target: float = 0.0
+    n_before: int = 0
+    n_after: int = 0
+    version: int = 0
+    reaction_s: float = 0.0
+    replica: str = ""
+    reason: str = ""
+    ts: float = 0.0
+
+
 # ---- sinks ----------------------------------------------------------------
 
 
@@ -411,6 +442,10 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("serving_handoff_bytes", "handoff_bytes"),
         ("serving_handoff_ms_p99", "handoff_ms_p99"),
     ],
+    "ScaleDecisionRecord": [
+        ("autoscale_pool_size", "n_after"),
+        ("autoscale_reaction_s", "reaction_s"),
+    ],
 }
 _COUNTER_MAP: Dict[str, str] = {
     "ElasticEvent": "elastic_events_total",
@@ -420,6 +455,7 @@ _COUNTER_MAP: Dict[str, str] = {
     "AnomalyRecord": "anomaly_records_total",
     "HealthSummary": "health_summaries_total",
     "ServingRecord": "serving_records_total",
+    "ScaleDecisionRecord": "scale_decisions_total",
 }
 
 

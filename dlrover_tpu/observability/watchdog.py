@@ -375,6 +375,31 @@ class ServingWatchdog:
         self._last_drops: Optional[int] = None
         self._fallback_streak = 0
         self._n_obs = 0
+        # gate-edge subscribers (``subscribe``); the empty-list fast
+        # path keeps ``_edge`` allocation-free when nobody listens
+        self._subscribers: List = []
+
+    # ---- gate-edge subscription ------------------------------------------
+
+    def subscribe(self, fn) -> None:
+        """Deliver every gate EDGE to ``fn(kind, breaching, record)`` —
+        both the transition INTO breach (``breaching=True``) and the
+        clear (``breaching=False``), with the ServingRecord that flipped
+        the gate (None for migration-path gates). This is how the
+        serving autoscaler closes the watchdog → ScalePlan loop without
+        polling capture artifacts; with no subscribers the hook costs
+        one truthiness check per gate evaluation. A subscriber raising
+        is logged and never breaks classification."""
+        self._subscribers.append(fn)
+
+    def _notify(self, kind: str, breaching: bool, rec) -> None:
+        for fn in self._subscribers:
+            try:
+                fn(kind, breaching, rec)
+            except Exception:  # noqa: BLE001 — observers never break gates
+                logger.exception(
+                    "watchdog gate subscriber failed on %s edge", kind
+                )
 
     # ---- classification --------------------------------------------------
 
@@ -442,7 +467,10 @@ class ServingWatchdog:
         capture."""
         if getattr(report, "path", "live") == "live":
             self._fallback_streak = 0
-            self._breached["migration_fallback"] = False
+            if self._breached.get("migration_fallback"):
+                self._breached["migration_fallback"] = False
+                if self._subscribers:
+                    self._notify("migration_fallback", False, None)
             return None
         self._fallback_streak += 1
         out: List[telemetry.AnomalyRecord] = []
@@ -465,7 +493,11 @@ class ServingWatchdog:
     ) -> None:
         was = self._breached.get(kind, False)
         self._breached[kind] = breaching
-        if not breaching or was:
+        if breaching == was:
+            return
+        if self._subscribers:
+            self._notify(kind, breaching, rec)
+        if not breaching:
             return
         out.append(self._anomaly(kind, rec, value=value, detail=detail,
                                  replica=replica))

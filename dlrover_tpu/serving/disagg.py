@@ -488,6 +488,40 @@ class HandoffCoordinator:
         self.degraded += 1
         return None
 
+    # ---- elastic membership (serving autoscaler) -------------------------
+
+    def attach_prefill(self, rep) -> None:
+        """Scale-out: a warm prefill replica joins the donor pool and
+        gets its handoff sink installed, mirroring ``start()``."""
+        with self._lock:
+            if rep in self.prefill_pool:
+                return
+            self.prefill_pool.append(rep)
+        rep.server.engine.handoff_sink = self._make_sink(rep)
+
+    def attach_decode(self, rep) -> None:
+        """Scale-out: a warm decode replica becomes a handoff target
+        (``_pick_target`` sees it on the next reservation)."""
+        with self._lock:
+            if rep not in self.decode_pool:
+                self.decode_pool.append(rep)
+
+    def detach(self, rep) -> None:
+        """Scale-in: stop targeting/sourcing ``rep`` for NEW handoffs.
+        Handoffs it is already donating keep streaming until the
+        caller's drain completes — the sink stays installed, and a
+        stopped loop simply stops calling it. Uncommitted handoffs
+        TARGETING a detached decode replica restart elsewhere, same as
+        the death path (the donor still holds the pages)."""
+        with self._lock:
+            if rep in self.prefill_pool:
+                self.prefill_pool.remove(rep)
+            was_decode = rep in self.decode_pool
+            if was_decode:
+                self.decode_pool.remove(rep)
+        if was_decode:
+            self.on_replica_dead(rep)
+
     def on_replica_dead(self, rep) -> int:
         """A DECODE replica died: every uncommitted handoff targeting it
         restarts on a surviving decode replica (the donor still holds

@@ -41,6 +41,7 @@ and recreate the interference the split removed).
 
 import json
 import threading
+import time
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import NodeType
@@ -149,6 +150,30 @@ def discover_replicas(client, names) -> Optional[Dict[str, dict]]:
     return out
 
 
+def refresh_discovery(client, names, known=None) -> Dict[str, dict]:
+    """Incremental discovery for a LIVE fleet: resolve whichever of
+    ``names`` have registered since ``known`` was built and return only
+    the new entries (name → registration payload).
+
+    ``discover_replicas`` enforces the all-or-nothing startup rule — a
+    router must never adopt a partial initial set. Scale-out breaks
+    that premise on purpose: the autoscaler launches replicas one at a
+    time, and each becomes routable the moment its
+    ``serving_replica_addr_<name>`` key lands, while the rest of the
+    candidate roster stays pending without deferring anybody. Callers
+    fold the result into their ``known`` map and call again on the
+    next refresh tick."""
+    known = known or {}
+    out: Dict[str, dict] = {}
+    for name in names:
+        if name in known:
+            continue
+        raw = client.kv_store_get(ADDR_KV_PREFIX + name)
+        if raw:
+            out[name] = json.loads(raw)
+    return out
+
+
 class _Entry:
     """Router-side view of one request: which replica holds it and
     whether its result already landed."""
@@ -205,6 +230,11 @@ class ReplicaRouter:
         )
         self.coordinator = None
         self._dead_seen: set = set()
+        # replicas removed ON PURPOSE (scale-in): drained, detached from
+        # every pool, and invisible to the failover sweep — a detached
+        # replica's stopped loop must never read as a death and trigger
+        # a spurious migration or a collapse-to-unified
+        self._detached: set = set()
         if self.disaggregated:
             from dlrover_tpu.serving.disagg import HandoffCoordinator
 
@@ -271,7 +301,25 @@ class ReplicaRouter:
         return _cb
 
     def _live(self) -> List[ServingReplica]:
-        return [r for r in self.replicas if r.alive]
+        return [
+            r for r in self.replicas
+            if r.alive and id(r) not in self._detached
+        ]
+
+    def live_replicas(self, role: Optional[str] = None) -> List[
+        "ServingReplica"
+    ]:
+        """Routable replicas (live and not detached), optionally
+        filtered to one role pool — the autoscaler's fleet view."""
+        with self._lock:
+            live = self._live()
+        if role is None:
+            return live
+        return [r for r in live if r.role == role]
+
+    def is_detached(self, replica: "ServingReplica") -> bool:
+        with self._lock:
+            return id(replica) in self._detached
 
     def submit(
         self, prompt, max_new_tokens: int, eos_id=None, priority: int = 0,
@@ -458,6 +506,183 @@ class ReplicaRouter:
             if entry is not None:
                 entry.replica = tgt
 
+    # ---- elastic fleet membership (serving autoscaler) -------------------
+
+    def add_replica(self, replica: ServingReplica) -> None:
+        """Attach a warm (already-started) replica to the live fleet —
+        the autoscaler's scale-out path. On a disaggregated fleet the
+        replica joins its role pool and the handoff coordinator starts
+        targeting/sourcing it immediately; a role-typed replica joining
+        a UNIFIED fleet folds to unified, mirroring ``__init__``'s
+        one-sided-fleet rule. Idempotent for an already-member replica."""
+        if not replica.alive:
+            raise ValueError(
+                f"cannot attach replica {replica.name}: not alive"
+            )
+        with self._lock:
+            if replica in self.replicas and not self.is_detached(replica):
+                return
+            # a re-attached replica sheds its detached/dead history:
+            # the failover sweep should watch it again
+            self._detached.discard(id(replica))
+            self._dead_seen.discard(id(replica))
+            if replica not in self.replicas:
+                self.replicas.append(replica)
+            if self.disaggregated:
+                if replica.role == "prefill":
+                    if replica not in self.prefill_pool:
+                        self.prefill_pool.append(replica)
+                    if self.coordinator is not None:
+                        self.coordinator.attach_prefill(replica)
+                elif replica.role == "decode":
+                    if replica not in self.decode_pool:
+                        self.decode_pool.append(replica)
+                    if self.coordinator is not None:
+                        self.coordinator.attach_decode(replica)
+                # a unified joiner on a split fleet serves only failover
+                # re-admissions (it is in no dispatch pool) — harmless
+            elif replica.role != "unified":
+                logger.warning(
+                    "replica %s joins a unified fleet with role=%s — "
+                    "running unified", replica.name, replica.role,
+                )
+                replica.server.engine.role = "unified"
+            # work stealing: queued-but-unadmitted requests rebalance
+            # onto the joiner, so a scale-out relieves the very backlog
+            # that triggered it instead of only absorbing FUTURE
+            # arrivals. Decode-role joiners steal nothing — a raw
+            # un-prefilled request must never land on one.
+            stolen = 0
+            if replica.role != "decode":
+                donors = [
+                    r for r in self._live()
+                    if r is not replica
+                    and (
+                        not self.disaggregated or r.role == replica.role
+                    )
+                ]
+                while donors:
+                    src = max(
+                        donors,
+                        key=lambda r: r.server.scheduler.queue_depth(),
+                    )
+                    if (
+                        src.server.scheduler.queue_depth()
+                        <= replica.server.scheduler.queue_depth() + 1
+                    ):
+                        break
+                    q = src.server.scheduler.pop_next()
+                    if q is None:
+                        break
+                    replica.server.re_admit(q)
+                    entry = self._by_rid.get(q.rid)
+                    if entry is not None:
+                        entry.replica = replica
+                    stolen += 1
+            logger.info(
+                "scale-out: attached replica %s (role=%s), fleet=%d "
+                "live, %d queued request(s) rebalanced",
+                replica.name, replica.role, len(self._live()), stolen,
+            )
+
+    def remove_replica(
+        self,
+        replica: ServingReplica,
+        *,
+        reason: str = "scale_in",
+        drain_timeout_s: float = 30.0,
+    ):
+        """Planned scale-in: drain ``replica`` and detach it from the
+        fleet with zero lost or duplicated requests. Decode/unified
+        victims evacuate over the live-migration wire (the migrator's
+        detect phase sees the victim ALIVE → ``begin_drain`` + stop at
+        a step boundary → pages move to pool peers, zero re-prefilled
+        prompt tokens). Prefill victims drain cooperatively: queued
+        prompts re-dispatch on the pool, in-flight handoffs finish
+        streaming, then the loop stops. Either way the replica ends
+        ``detached`` — never counted dead, never migrated again, never
+        collapsing the fleet. Returns the MigrationReport when the
+        live path ran, else None. Raises ValueError when the victim is
+        the last live member of its pool."""
+        with self._lock:
+            if id(replica) in self._detached or replica not in self.replicas:
+                return None
+            in_prefill = (
+                self.disaggregated and replica in self.prefill_pool
+            )
+            if in_prefill:
+                peers = [
+                    r for r in self.prefill_pool
+                    if r.alive and r is not replica
+                ]
+            elif self.disaggregated and replica in self.decode_pool:
+                peers = [
+                    r for r in self.decode_pool
+                    if r.alive and r is not replica
+                ]
+            else:
+                peers = [r for r in self._live() if r is not replica]
+            if not peers:
+                raise ValueError(
+                    f"cannot scale in {replica.name}: last live member "
+                    "of its pool"
+                )
+            # detach FIRST: no new dispatch lands on the victim, and the
+            # failover sweep must never read the drained loop as a death
+            self._detached.add(id(replica))
+            self._dead_seen.add(id(replica))
+            if replica in self.prefill_pool:
+                self.prefill_pool.remove(replica)
+            if replica in self.decode_pool:
+                self.decode_pool.remove(replica)
+            if self.coordinator is not None:
+                self.coordinator.detach(replica)
+            if not in_prefill and self.migrator is not None:
+                self._migrate_victim(replica, peers)
+                logger.info(
+                    "scale-in: detached replica %s via live migration "
+                    "(reason=%s)", replica.name, reason,
+                )
+                return self.reports[-1]
+            # cooperative drain (prefill victim, or no migrator): stop
+            # admitting, re-route the queue under original tickets
+            replica.server.begin_drain()
+            while True:
+                q = replica.server.scheduler.pop_next()
+                if q is None:
+                    break
+                tgt = self._re_admit_target()
+                tgt.server.re_admit(q)
+                entry = self._by_rid.get(q.rid)
+                if entry is not None:
+                    entry.replica = tgt
+        # wait OUTSIDE the lock for in-flight slots to finish (prefill
+        # slots hand off and repoint via the coordinator's commit path)
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            eng = replica.server.engine
+            if not any(
+                s is not None and not s.req.future.done()
+                for s in eng.slots
+            ):
+                break
+            time.sleep(0.005)
+        replica.server.stop()
+        with self._lock:
+            # anything still parked on the victim (drain deadline hit)
+            # re-admits from the prompt — degraded but never lost
+            for entry in self._entries:
+                if entry.done or entry.replica is not replica:
+                    continue
+                tgt = self._re_admit_target()
+                tgt.server.re_admit(entry.req)
+                entry.replica = tgt
+        logger.info(
+            "scale-in: detached replica %s via cooperative drain "
+            "(reason=%s)", replica.name, reason,
+        )
+        return None
+
     def close(self) -> None:
         """Stop the handoff coordinator's worker thread (no-op on a
         unified fleet)."""
@@ -529,6 +754,11 @@ class ReplicaRouter:
                 if (
                     self.migrator is not None
                     and id(victim) not in migrated_victims
+                    # a detached victim was already evacuated by
+                    # remove_replica; a straggler entry here just
+                    # re-admits below instead of re-running a migration
+                    # against the drained engine
+                    and id(victim) not in self._detached
                 ):
                     migrated_victims.add(id(victim))
                     survivors = (

@@ -43,6 +43,7 @@ class GenerationServer:
         max_queue: int = 256,
         publish_every: float = 0.5,
         idle_sleep: float = 0.002,
+        step_period_s: float = 0.0,
         watchdog=None,
         **engine_kw,
     ):
@@ -59,6 +60,13 @@ class GenerationServer:
             watchdog.snapshot_fn = self.engine.observability_snapshot
         self.publish_every = publish_every
         self.idle_sleep = idle_sleep
+        # minimum wall time per WORKED step (0 = run free). Benches and
+        # drills that model a multi-host fleet on one machine set this
+        # to pace each replica like a fixed-rate accelerator host —
+        # otherwise co-located engine loops share the same cores and
+        # adding a "replica" adds no capacity, inverting every
+        # scale-out comparison the fleet tier wants to make.
+        self.step_period_s = step_period_s
         self._stop_evt = threading.Event()
         self._thread: threading.Thread | None = None
         self._pause_lock = threading.Lock()   # serializes paused() users
@@ -133,7 +141,12 @@ class GenerationServer:
                     self._pause_ack.set()
                     time.sleep(0.001)
                 continue
+            t_step = time.monotonic()
             worked = self.engine.step()
+            if worked and self.step_period_s > 0.0:
+                rem = self.step_period_s - (time.monotonic() - t_step)
+                if rem > 0:
+                    self._stop_evt.wait(rem)
             now = time.monotonic()
             if now - last_pub >= self.publish_every:
                 self._publish()

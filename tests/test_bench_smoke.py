@@ -390,6 +390,29 @@ def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
     assert got_mig["migration_recovery_s"] == pytest.approx(0.42)
     assert got_mig["migration_path"] == "live"
     assert got_mig["migration_tokens_saved"] == 17
+    # an autoscale-bearing artifact projects the SLO-goodput headline;
+    # pre-autoscaler artifacts simply lack the block and replay with
+    # the exact shape pinned above
+    pasc = tmp_path / "SERVE_asc.json"
+    pasc.write_text(json.dumps({
+        "serve_tokens_per_s": 99.0,
+        "serve_p99_ms": 70.0,
+        "autoscale": {
+            "p99_target_ms": 120.0,
+            "fleet_tokens_per_s_at_p99": 150.0,
+            "autoscale_reaction_s": 0.31,
+            "scale_decisions": 1,
+            "goodput_win_vs_pinned1": 2.1,
+            "bitwise_equal_vs_static2": True,
+        },
+    }))
+    got_asc = bench.serving_trajectory_metric(str(pasc))
+    assert got_asc["fleet_tokens_per_s_at_p99"] == pytest.approx(150.0)
+    assert got_asc["autoscale_reaction_s"] == pytest.approx(0.31)
+    assert got_asc["scale_decisions"] == 1
+    assert got_asc["autoscale_goodput_win"] == pytest.approx(2.1)
+    assert "fleet_tokens_per_s_at_p99" not in got  # old-artifact replay
+    assert "scale_decisions" not in got
     # missing/corrupt/unmeasured artifacts degrade to None
     assert bench.serving_trajectory_metric(
         str(tmp_path / "nope.json")

@@ -476,6 +476,15 @@ _SLOW_LEDGER = [
     "test_serving_disagg.py::test_mid_stream_decode_kill_collapses_to_unified",
     "test_serving_disagg.py::"
     "test_prefix_affinity_skips_prefill_and_stale_plan_bounces",
+    # SLO-driven autoscaling drills (PR 18): live fleets (two-plus jit
+    # compiles apiece) driven through scale-out, live-drain scale-in,
+    # and oscillating load; the decision logic keeps fast pure units in
+    # the same file (synthetic signals + fake clock, no replicas).
+    "test_serving_autoscale.py::test_burst_scale_out_restores_p99_bitwise",
+    "test_serving_autoscale.py::"
+    "test_scale_in_drains_live_zero_loss_and_detached_is_not_dead",
+    "test_serving_autoscale.py::"
+    "test_live_oscillating_load_one_decision_per_cooldown",
 ]
 
 
@@ -639,6 +648,44 @@ def test_serving_e2e_function_imports_are_slow():
         "function-level serving server/replica imports in non-slow "
         "tests (add @pytest.mark.slow, or a module-level pytestmark):\n"
         + "\n".join(rogue)
+    )
+
+
+def _fn_references(fn, names):
+    """Subset of ``names`` referenced anywhere in a function body —
+    bare names and attribute accesses both count."""
+    found = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in names:
+            found.add(node.id)
+        elif isinstance(node, ast.Attribute) and node.attr in names:
+            found.add(node.attr)
+    return found
+
+
+def test_autoscaler_fleet_drills_are_slow():
+    """A test referencing BOTH ``ServingAutoScaler`` and
+    ``ServingReplica`` is an autoscaling FLEET drill: it stands up live
+    replicas (a jit compile plus a background loop apiece) and drives
+    the scale loop against them — slow tier by construction. The scale
+    loop's pure decision units (synthetic signal dicts + a fake clock,
+    ``evaluate()`` only) reference no replica class and stay in tier-1,
+    which is the whole point of keeping ``evaluate`` pure."""
+    targets = {"ServingAutoScaler", "ServingReplica"}
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if _fn_slow_marked(fn):
+                continue
+            if _fn_references(fn, targets) == targets:
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "autoscaler fleet drills (ServingAutoScaler + ServingReplica) "
+        "must be slow-marked (add @pytest.mark.slow or a module "
+        "pytestmark):\n" + "\n".join(rogue)
     )
 
 

@@ -1,0 +1,600 @@
+"""SLO-driven serving autoscaling (master/serving_autoscaler.py).
+
+Fast tier: the scale loop's PURE decision logic — ``evaluate()`` driven
+by synthetic signal dicts and a fake clock (breach detection priority,
+role attribution, hysteresis latch + clear, cooldown, min/max bounds,
+shrink ladder), the watchdog ``subscribe`` gate-edge hook, the
+histogram delta-window arithmetic, and the master-plane versioning
+plumbing. None of it stands up a replica.
+
+Slow tier: the fleet drills. A seeded burst against a 1-replica fleet
+breaches, the scaler attaches a warm spare at runtime, p99 restores,
+and the outputs are bitwise equal to an always-2 fleet; a planned
+scale-in drains the least-loaded victim over the live-migration wire
+with zero lost, zero duplicated, zero re-prefilled requests — and the
+detached victim is never re-counted dead by the failover sweep; an
+oscillating load makes at most one actionable decision per cooldown
+window even through repeated breach/clear episodes.
+"""
+
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dlrover_tpu.master.serving_autoscaler import (  # noqa: E402
+    SCALE_SIGNALS,
+    ServingAutoScaler,
+    ServingScalerConfig,
+)
+from dlrover_tpu.observability import telemetry  # noqa: E402
+from dlrover_tpu.observability.histogram import (  # noqa: E402
+    LatencyHistogram,
+    histogram_delta,
+)
+from dlrover_tpu.observability.watchdog import (  # noqa: E402
+    ServingWatchdog,
+    ServingWatchdogConfig,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakeRouter:
+    """Just enough router for the pure decision paths: ``evaluate``
+    with synthetic signals never touches it, and ``apply`` without a
+    provision_fn only records."""
+
+    disaggregated = False
+
+    def live_replicas(self, role=None):
+        return []
+
+
+def _scaler(clock=None, **cfg_kw):
+    cfg_kw.setdefault("p99_target_ms", 100.0)
+    cfg_kw.setdefault("min_window_n", 4)
+    cfg_kw.setdefault("cooldown_s", 10.0)
+    return ServingAutoScaler(
+        FakeRouter(), ServingScalerConfig(**cfg_kw),
+        clock=clock or FakeClock(),
+    )
+
+
+def _sig(role="unified", n=16, p99=50.0, ttft=0.0, tpot=0.0, queue=0,
+         occ=0.0, n_replicas=1):
+    return {"roles": {role: {
+        "n": n, "p99_ms": p99, "ttft_p99_ms": ttft, "tpot_p99_ms": tpot,
+        "queue_depth": queue, "new_drops": 0, "occupancy": occ,
+        "n_replicas": n_replicas,
+    }}}
+
+
+# ---------------------------------------------------------------------------
+# breach detection, bounds, cooldown
+# ---------------------------------------------------------------------------
+
+
+def test_slo_breach_scales_out_with_reaction_clock():
+    clock = FakeClock()
+    sc = _scaler(clock)
+    clock.t = 5.0
+    d = sc.evaluate(_sig(p99=150.0))
+    assert d is not None
+    assert (d["direction"], d["role"], d["signal"]) == (
+        "out", "unified", "slo_breach"
+    )
+    assert (d["n_before"], d["n_after"]) == (1, 2)
+    # breach first seen at this evaluation → reaction clock starts here
+    assert d["reaction_s"] == 0.0
+    rec = sc.apply(d)
+    assert rec.direction == "out" and rec.n_after == 2
+    assert sc.decisions == [rec]
+
+
+def test_at_max_replicas_breach_latches_but_no_decision():
+    sc = _scaler(max_replicas=2)
+    assert sc.evaluate(_sig(p99=150.0, n_replicas=2)) is None
+    assert sc._latched == {"unified": "slo_breach"}
+
+
+def test_cooldown_suppresses_second_scale_out():
+    clock = FakeClock()
+    sc = _scaler(clock, max_replicas=4, cooldown_s=10.0)
+    d = sc.evaluate(_sig(p99=150.0))
+    sc.apply(d)
+    clock.t = 5.0  # inside the cooldown window: breach persists, no act
+    assert sc.evaluate(_sig(p99=150.0, n_replicas=2)) is None
+    clock.t = 11.0  # window over: the sustained breach may act again
+    d2 = sc.evaluate(_sig(p99=150.0, n_replicas=2))
+    assert d2 is not None and d2["n_after"] == 3
+
+
+def test_signal_priority_pages_over_latency():
+    sc = _scaler()
+    d = sc.evaluate(_sig(p99=150.0, occ=0.99))
+    assert d["signal"] == "out_of_pages"
+    assert SCALE_SIGNALS[0] == "out_of_pages"
+
+
+def test_thin_window_cannot_judge_latency_percentiles():
+    sc = _scaler(min_window_n=8)
+    assert sc.evaluate(_sig(p99=150.0, n=3)) is None
+
+
+# ---------------------------------------------------------------------------
+# hysteresis latch, clear, shrink ladder
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_band_stays_latched_then_clears():
+    clock = FakeClock()
+    sc = _scaler(clock, max_replicas=2)
+    clock.t = 1.0
+    sc.apply(sc.evaluate(_sig(p99=150.0)))
+    # 90 > 80 = target × clear_frac: inside the band, still latched
+    clock.t = 2.0
+    assert sc.evaluate(_sig(p99=90.0, n_replicas=2)) is None
+    assert sc._latched == {"unified": "slo_breach"}
+    # 70 ≤ 80: the latch clears and the restore clock stops
+    clock.t = 4.5
+    d = sc.evaluate(_sig(p99=70.0, n_replicas=2))
+    assert d["signal"] == "clear" and d["direction"] == ""
+    assert sc.last_restore_s == pytest.approx(3.5)  # breach@1.0 → 4.5
+    assert not sc._latched
+
+
+def test_shrink_after_consecutive_clear_windows():
+    clock = FakeClock()
+    sc = _scaler(clock, shrink_after_clear=3, cooldown_s=1.0)
+    for i in range(2):
+        clock.t = 10.0 + i
+        assert sc.evaluate(_sig(p99=20.0, n_replicas=2)) is None
+    clock.t = 14.0  # third consecutive clear window: shrink fires
+    d = sc.evaluate(_sig(p99=20.0, n_replicas=2))
+    assert (d["direction"], d["signal"]) == ("in", "planned")
+    assert (d["n_before"], d["n_after"]) == (2, 1)
+
+
+def test_never_shrinks_below_min_or_while_gate_open():
+    clock = FakeClock()
+    sc = _scaler(clock, shrink_after_clear=1, cooldown_s=0.0)
+    clock.t = 100.0
+    # at the floor: clear windows accumulate but never go below min
+    for i in range(5):
+        clock.t += 1.0
+        assert sc.evaluate(_sig(p99=20.0, n_replicas=1)) is None
+    # an open watchdog gate vetoes the shrink even above the floor
+    sc._on_gate("slo_breach", True, None)
+    clock.t += 1.0
+    assert sc.evaluate(_sig(p99=20.0, n_replicas=2)) is None
+
+
+def test_oscillating_signals_one_decision_per_cooldown_window():
+    """Hysteresis + cooldown: a trace that flaps around the target
+    every tick produces at most ONE actionable decision per cooldown
+    window, not one per oscillation."""
+    clock = FakeClock()
+    sc = _scaler(clock, max_replicas=8, cooldown_s=10.0,
+                 shrink_after_clear=2)
+    n_dec = 0
+    for i in range(100):  # 25s of 0.25s ticks, p99 flapping 150 ↔ 70
+        clock.t = i * 0.25
+        p99 = 150.0 if i % 2 == 0 else 70.0
+        d = sc.evaluate(_sig(p99=p99, n_replicas=1 + n_dec))
+        if d is not None and d["direction"]:
+            n_dec += 1
+            sc._last_decision_t[d["role"]] = clock.t
+    # 25s / 10s cooldown → at most 3 windows can act
+    assert n_dec <= 3
+
+
+# ---------------------------------------------------------------------------
+# role attribution (disaggregated fleets)
+# ---------------------------------------------------------------------------
+
+
+def _two_roles(**over):
+    roles = {
+        "prefill": dict(n=16, p99_ms=40.0, ttft_p99_ms=30.0,
+                        tpot_p99_ms=0.0, queue_depth=1, new_drops=0,
+                        occupancy=0.3, n_replicas=1),
+        "decode": dict(n=16, p99_ms=40.0, ttft_p99_ms=0.0,
+                       tpot_p99_ms=5.0, queue_depth=1, new_drops=0,
+                       occupancy=0.3, n_replicas=1),
+    }
+    for role, kv in over.items():
+        roles[role].update(kv)
+    return {"roles": roles}
+
+
+def test_ttft_breach_attributes_to_prefill_pool():
+    sc = _scaler(p99_target_ms=0.0, ttft_target_ms=20.0)
+    d = sc.evaluate(_two_roles(prefill={"ttft_p99_ms": 80.0}))
+    assert (d["role"], d["signal"]) == ("prefill", "ttft_regression")
+
+
+def test_tpot_breach_attributes_to_decode_pool():
+    sc = _scaler(p99_target_ms=0.0, tpot_target_ms=4.0)
+    d = sc.evaluate(_two_roles(decode={"tpot_p99_ms": 9.0}))
+    assert (d["role"], d["signal"]) == ("decode", "tpot_breach")
+
+
+def test_out_of_pages_attributes_to_most_occupied_pool():
+    sc = _scaler(p99_target_ms=0.0)
+    d = sc.evaluate(_two_roles(decode={"occupancy": 0.97}))
+    assert (d["role"], d["signal"]) == ("decode", "out_of_pages")
+
+
+def test_queue_depth_attributes_to_deepest_pool():
+    sc = _scaler(p99_target_ms=0.0, queue_depth_high=4)
+    d = sc.evaluate(_two_roles(prefill={"queue_depth": 9}))
+    assert (d["role"], d["signal"]) == ("prefill", "queue_depth")
+
+
+def test_per_role_bounds_override_scalars():
+    sc = _scaler(p99_target_ms=0.0, queue_depth_high=4,
+                 max_replicas=4, role_max={"prefill": 1})
+    d = sc.evaluate(_two_roles(prefill={"queue_depth": 9}))
+    assert d is None  # prefill pinned at 1 despite the fleet-wide 4
+    assert sc._latched == {"prefill": "queue_depth"}
+
+
+# ---------------------------------------------------------------------------
+# watchdog gate-edge subscription (satellite: ServingWatchdog.subscribe)
+# ---------------------------------------------------------------------------
+
+
+def _rec(**kw):
+    base = dict(replica="rep-0", completed=20, p99_ms=10.0)
+    base.update(kw)
+    return telemetry.ServingRecord(**base)
+
+
+def test_watchdog_subscribe_delivers_both_edges():
+    wd = ServingWatchdog(ServingWatchdogConfig(p99_target_ms=100.0))
+    seen = []
+    wd.subscribe(lambda kind, breaching, rec: seen.append(
+        (kind, breaching, rec.replica if rec is not None else None)
+    ))
+    wd.observe(_rec(p99_ms=150.0))  # breach edge
+    wd.observe(_rec(p99_ms=150.0))  # sustained: NOT an edge
+    wd.observe(_rec(p99_ms=50.0))   # clear edge
+    assert seen == [
+        ("slo_breach", True, "rep-0"),
+        ("slo_breach", False, "rep-0"),
+    ]
+
+
+def test_watchdog_without_subscribers_still_classifies():
+    wd = ServingWatchdog(ServingWatchdogConfig(p99_target_ms=100.0))
+    assert [a.kind for a in wd.observe(_rec(p99_ms=150.0))] == [
+        "slo_breach"
+    ]
+
+
+def test_raising_subscriber_never_breaks_classification():
+    wd = ServingWatchdog(ServingWatchdogConfig(p99_target_ms=100.0))
+
+    def boom(kind, breaching, rec):
+        raise RuntimeError("observer bug")
+
+    wd.subscribe(boom)
+    assert [a.kind for a in wd.observe(_rec(p99_ms=150.0))] == [
+        "slo_breach"
+    ]
+
+
+def test_gate_edge_starts_the_reaction_clock():
+    """A breach the watchdog saw FIRST is timed from its edge, not the
+    scaler's next tick."""
+    clock = FakeClock()
+    sc = _scaler(clock)
+    wd = ServingWatchdog(
+        ServingWatchdogConfig(p99_target_ms=100.0), clock=clock
+    )
+    wd.subscribe(sc._on_gate)
+    clock.t = 2.0
+    wd.observe(_rec(p99_ms=150.0))  # edge at t=2
+    clock.t = 3.5
+    d = sc.evaluate(_sig(p99=150.0))
+    assert d["reaction_s"] == pytest.approx(1.5)
+
+
+# ---------------------------------------------------------------------------
+# versioning + telemetry record
+# ---------------------------------------------------------------------------
+
+
+class FakePlanner:
+    def __init__(self):
+        self.calls = []
+        self.v = 41
+
+    def plan_serving_scale(self, role, target, reason=""):
+        self.calls.append((role, target, reason))
+        self.v += 1
+        return self.v
+
+
+def test_decisions_version_through_the_master_plane():
+    planner = FakePlanner()
+    sc = ServingAutoScaler(
+        FakeRouter(), ServingScalerConfig(p99_target_ms=100.0,
+                                          min_window_n=4),
+        job_manager=planner, clock=FakeClock(),
+    )
+    rec = sc.apply(sc.evaluate(_sig(p99=150.0)))
+    assert rec.version == 42
+    assert planner.calls == [("unified", 2, "slo_breach 150>100")]
+    # clear decisions are telemetry-only: no directive, version 0
+    rec2 = sc.apply(sc.evaluate(_sig(p99=10.0, n_replicas=2)))
+    assert rec2.signal == "clear" and rec2.version == 0
+    assert len(planner.calls) == 1
+
+
+def test_job_manager_plan_serving_scale_is_monotonic_per_role():
+    from dlrover_tpu.master.node_manager import JobManager
+
+    jm = JobManager(num_workers=1)
+    v1 = jm.plan_serving_scale("prefill", 2, reason="ttft")
+    v2 = jm.plan_serving_scale("decode", 3, reason="tpot")
+    assert v2 == v1 + 1
+    assert jm.get_serving_scale("prefill")["target"] == 2
+    assert jm.get_serving_scale("decode")["version"] == v2
+    # newest across roles when unspecified; unknown role is empty
+    assert jm.get_serving_scale()["role"] == "decode"
+    assert jm.get_serving_scale("nope") == {"version": 0}
+
+
+def test_scale_decision_record_roundtrip_and_replay():
+    rec = telemetry.ScaleDecisionRecord(
+        role="decode", direction="out", signal="tpot_breach",
+        value=9.0, target=4.0, n_before=1, n_after=2, version=7,
+        reaction_s=0.31, replica="spare-0", reason="tpot 9>4", ts=1.0,
+    )
+    back = telemetry.from_json(rec.to_json())
+    assert back == rec
+    # healthcheck replay: the scale trail names why the fleet is its size
+    from dlrover_tpu.observability.healthcheck import _scale_section
+
+    sect = _scale_section({"ScaleDecisionRecord": [rec]})
+    assert sect["n_scaled"] == 1
+    assert sect["final_size"] == {"decode": 2}
+    assert sect["worst_reaction_s"] == pytest.approx(0.31)
+    assert _scale_section({}) == {}  # pre-autoscaler recordings
+
+
+def test_histogram_delta_is_a_window_not_a_lifetime():
+    prev = LatencyHistogram()
+    for v in (10.0, 10.0, 10.0, 10.0):
+        prev.record(v)
+    cur = prev.copy()
+    for v in (500.0, 500.0):
+        cur.record(v)
+    win = histogram_delta(cur, prev)
+    assert win.n == 2
+    assert win.percentile(99.0) > 400.0  # the fresh breach, unmasked
+    assert cur.percentile(50.0) < 20.0   # ...which the lifetime hides
+    assert histogram_delta(cur, None).n == cur.n
+    with pytest.raises(ValueError):
+        histogram_delta(cur, LatencyHistogram(sub_bits=3))
+
+
+# ---------------------------------------------------------------------------
+# fleet drills (slow tier): live scale-out / scale-in / oscillation
+# ---------------------------------------------------------------------------
+
+
+def _drill_fleet(n, cfg, params, kw, prefix="as"):
+    from dlrover_tpu.serving.replica import ReplicaRouter, ServingReplica
+    from dlrover_tpu.serving.migration import ServingMigrator
+
+    reps = [
+        ServingReplica(f"{prefix}-{i}", params, cfg, node_id=i,
+                       **kw).start()
+        for i in range(n)
+    ]
+    return reps, ReplicaRouter(reps, migrator=ServingMigrator())
+
+
+def _warm(router, max_len, import_np):
+    np = import_np
+    n_warm = 0
+    for frac in (4, 2, 1):
+        router.submit(list(np.arange(max(3, (max_len - 3) // frac - 2))
+                           % 4 + 1), 3)
+        n_warm += 1
+    router.wait_all(timeout=600.0)
+    return n_warm
+
+
+@pytest.mark.slow
+def test_burst_scale_out_restores_p99_bitwise():
+    """Drill (a): a burst against a 1-replica fleet breaches, the
+    scaler attaches a pre-warmed spare at runtime, the latched breach
+    clears (p99 restored), and every output is bitwise equal to the
+    same trace on an always-2 fleet."""
+    import numpy as np
+
+    from dlrover_tpu.models import decoder
+    from dlrover_tpu.models.config import get_config
+    from dlrover_tpu.serving.replica import ServingReplica
+
+    cfg = get_config("tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+                     vocab_size=32, max_seq=64)
+    params = decoder.init(jax.random.key(0), cfg)
+    # paced like a fixed-rate host so the burst actually queues (see
+    # GenerationServer.step_period_s) and the breach window is real
+    kw = dict(n_slots=2, max_len=32, page_size=4, mode="bf16",
+              prefill_chunk=4, idle_sleep=0.001, step_period_s=0.02)
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(1, 32, size=5)) for _ in range(10)]
+
+    def run(n_start, autoscale):
+        reps, router = _drill_fleet(n_start, cfg, params, kw)
+        spare = scaler = None
+        try:
+            n_warm = _warm(router, 32, np)
+            if autoscale:
+                spare = ServingReplica(
+                    "as-spare", params, cfg, node_id=9, **kw
+                ).start()
+                spare.server.generate(list(np.arange(20) % 4 + 1), 3,
+                                      timeout=600.0)
+                scaler = ServingAutoScaler(
+                    router,
+                    ServingScalerConfig(
+                        queue_depth_high=2, cooldown_s=1.0,
+                        max_replicas=2, shrink_after_clear=10**6,
+                        interval_s=0.02,
+                    ),
+                    provision_fn=lambda role: spare,
+                ).start()
+            reqs = [router.submit(p, 6) for p in prompts]
+            outs = router.wait_all(timeout=600.0)[n_warm:]
+            if scaler is not None:
+                deadline = time.monotonic() + 10.0
+                while (time.monotonic() < deadline
+                       and scaler.last_restore_s <= 0.0):
+                    time.sleep(0.02)
+                scaler.stop()
+            return outs, router, scaler, reqs
+        finally:
+            if scaler is not None:
+                scaler.stop()
+            router.close()
+            for r in reps + ([spare] if spare is not None else []):
+                r.stop()
+
+    refs, _, _, _ = run(2, False)
+    outs, router, scaler, reqs = run(1, True)
+    assert outs == refs  # scaling changed WHERE, never WHAT
+    out_decs = [d for d in scaler.decisions if d.direction == "out"]
+    assert len(out_decs) == 1
+    assert out_decs[0].signal == "queue_depth"
+    assert out_decs[0].n_after == 2
+    # the breach latched at the burst and cleared after the scale-out:
+    # that edge pair IS "p99 restored" as the fleet measured it
+    assert scaler.last_restore_s > 0.0
+    assert all(r.future.done() for r in reqs)
+
+
+@pytest.mark.slow
+def test_scale_in_drains_live_zero_loss_and_detached_is_not_dead():
+    """Drill (b) + the ``detached`` regression: a planned scale-in
+    mid-decode evacuates the victim over the live-migration wire (zero
+    lost, zero duplicated, zero re-prefilled), and the detached victim
+    is never re-counted dead — no spurious failover migration fires."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from dlrover_tpu.models import decoder, generate
+    from dlrover_tpu.models.config import get_config
+
+    cfg = get_config("tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+                     vocab_size=32, max_seq=64)
+    params = decoder.init(jax.random.key(0), cfg)
+    # paced steps keep the victim MID-decode at the remove_replica call
+    # (an unpaced tiny engine finishes the whole trace in milliseconds);
+    # 4 slots so the survivor has room to IMPORT the victim's two live
+    # slots next to its own two — that is what keeps the drain on the
+    # live wire instead of the re-prefill fallback
+    kw = dict(n_slots=4, max_len=32, page_size=4, mode="bf16",
+              prefill_chunk=4, idle_sleep=0.001, step_period_s=0.05)
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(1, 32, size=n)) for n in (3, 7, 5, 9)]
+    max_new = [14, 12, 14, 12]
+    refs = [
+        [int(t) for t in np.asarray(generate.greedy(
+            params, cfg, jnp.asarray([p], jnp.int32), m)[0])]
+        for p, m in zip(prompts, max_new)
+    ]
+
+    reps, router = _drill_fleet(2, cfg, params, kw)
+    try:
+        n_warm = _warm(router, 32, np)
+        reqs = [router.submit(p, m) for p, m in zip(prompts, max_new)]
+        time.sleep(0.4)  # paced engines are now mid-decode
+        victim = reps[1]
+        report = router.remove_replica(victim, reason="autoscale")
+        assert report is not None and report.path == "live"
+        assert report.placements  # live-migrated in-flight slots
+        assert report.re_prefilled == {}  # zero re-prefill on scale-in
+        # detached ≠ dead: the failover sweep must not touch the victim
+        assert router.is_detached(victim)
+        assert not victim.server.alive  # drained and stopped
+        n_reports = len(router.reports)
+        assert router.poll() == 0
+        assert len(router.reports) == n_reports  # no spurious migration
+        assert router.live_replicas() == [reps[0]]
+        outs = router.wait_all(timeout=600.0)[n_warm:]
+    finally:
+        router.close()
+        for r in reps:
+            r.stop()
+
+    assert outs == refs  # zero lost, and bitwise through the drain
+    # zero duplicated: every request completed exactly once fleet-wide
+    done = sum(r.server.scheduler.completed for r in reps) - n_warm
+    assert done == len(refs)
+    # zero re-prefilled: the survivor never re-admitted a drained slot
+    assert reps[0].server.scheduler.re_admitted == 0
+
+
+@pytest.mark.slow
+def test_live_oscillating_load_one_decision_per_cooldown():
+    """Drill (c): repeated burst/drain episodes against a live fleet.
+    With the breach latched and the cooldown window open, the scaler
+    makes at most ONE actionable decision per window no matter how
+    often the queue signal flaps."""
+    import numpy as np
+
+    from dlrover_tpu.models import decoder
+    from dlrover_tpu.models.config import get_config
+    from dlrover_tpu.serving.replica import ServingReplica
+
+    cfg = get_config("tiny", n_layer=2, d_model=32, d_ff=64, n_head=4,
+                     vocab_size=32, max_seq=64)
+    params = decoder.init(jax.random.key(0), cfg)
+    kw = dict(n_slots=2, max_len=32, page_size=4, mode="bf16",
+              prefill_chunk=4, idle_sleep=0.001)
+    rng = np.random.default_rng(11)
+    reps, router = _drill_fleet(1, cfg, params, kw)
+    spare = ServingReplica("as-sp", params, cfg, node_id=9, **kw).start()
+    scaler = ServingAutoScaler(
+        router,
+        ServingScalerConfig(
+            queue_depth_high=2, cooldown_s=60.0, max_replicas=2,
+            shrink_after_clear=10**6,
+        ),
+        provision_fn=lambda role: spare,
+    )
+    try:
+        _warm(router, 32, np)
+        spare.server.generate(list(np.arange(20) % 4 + 1), 3,
+                              timeout=600.0)
+        for _ in range(3):  # three burst → drain oscillations
+            for _ in range(6):
+                router.submit(list(rng.integers(1, 32, size=4)), 4)
+            for _ in range(10):
+                scaler.step()
+                time.sleep(0.02)
+            router.wait_all(timeout=600.0)
+            for _ in range(3):
+                scaler.step()
+        n_dec = sum(1 for d in scaler.decisions if d.direction)
+        assert n_dec == 1  # one 60s cooldown window covers the drill
+        assert len(router.live_replicas()) == 2
+    finally:
+        scaler.stop()
+        router.close()
+        for r in reps + [spare]:
+            r.stop()
