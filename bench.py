@@ -747,6 +747,95 @@ def serving_trajectory_metric(path=None):
     return out
 
 
+# fixed per-step host overhead fraction at the hand-tuned batch, for the
+# CPU-side MFU model in the tuned arm: smaller planned batches run more
+# (shorter) steps per token, so the fixed dispatch cost is a larger
+# fraction of each. ~1% matches the measured host_dispatch_us_per_step
+# share at the flagship shape.
+_TUNED_DISPATCH_FRAC = 0.01
+
+# the reference chip the cold-start plan is modeled against when the
+# bench itself runs on CPU — the flagship _ATTEMPTS ladder was hand-tuned
+# for a 16 GiB v5e, so that is the shape the planner must reproduce
+_TUNED_REFERENCE_CHIP = "v5e"
+
+
+def tuned_arm_metric(name, batch, seq, remat, device_kind=""):
+    """The brain's cold-start plan vs this hand-tuned config, plus the
+    live-refinement reaction time — the ``tuned`` arm of the record.
+
+    Two numbers close the telemetry→config loop into the trajectory
+    file:
+
+    - ``cold_start_mfu_frac`` — modeled MFU of the zero-config plan as
+      a fraction of the hand-tuned row's, CPU-modeled from the remat
+      FLOP-expansion ladder (``_FLOP_EXPANSION``: recompute is executed
+      MXU work MFU does not credit) and a fixed per-step dispatch
+      overhead that scales inversely with batch. 1.0 when the planner
+      reproduces the hand recipe exactly.
+    - ``reaction_s`` — wall seconds for a ``BrainTuner`` fed a
+      synthetic mid-run overlap-drift regression to emit a versioned
+      revision (the changed knob rides along), measured in-process on
+      the same plan.
+
+    Never raises: a planner failure records ``{"error": ...}`` so the
+    bench row survives a brain regression.
+    """
+    try:
+        from dlrover_tpu.cluster import brain
+        from dlrover_tpu.models import get_config
+
+        cfg = get_config(
+            name, max_seq=seq, remat=remat, param_dtype="bfloat16"
+        )
+        kind = device_kind if "TPU" in device_kind.upper() else ""
+        kind = kind or _TUNED_REFERENCE_CHIP
+        plan = brain.ColdStartPlanner().plan(
+            cfg, n_devices=1, seq=seq, device_kind=kind
+        )
+        exp_hand = _FLOP_EXPANSION.get(remat, 1.0)
+        exp_plan = _FLOP_EXPANSION.get(plan.remat or remat, 1.0)
+        b_plan = plan.batch_size or batch
+        o_hand = _TUNED_DISPATCH_FRAC
+        o_plan = _TUNED_DISPATCH_FRAC * batch / max(1, b_plan)
+        mfu_frac = (exp_hand * (1.0 + o_hand)) / (
+            exp_plan * (1.0 + o_plan)
+        )
+        tuner = brain.BrainTuner(plan, cooldown_s=0.0)
+        t0 = time.perf_counter()
+        for _ in range(tuner._drift_patience):
+            tuner.on_record(
+                brain.telemetry.OverlapDriftRecord(
+                    planned_exposed_us=100.0,
+                    measured_collective_us=200.0,
+                    drift_us=100.0,
+                    drift_frac=1.0,
+                )
+            )
+        reaction_s = time.perf_counter() - t0
+        rev = tuner.revisions[-1] if tuner.revisions else None
+        return {
+            "planned": {
+                "batch": b_plan,
+                "remat": plan.remat or remat,
+                "block_k": plan.block_k,
+                "comm_bucket_mb": plan.comm_bucket_mb,
+                "update_sharding": plan.update_sharding,
+                "comm_wire_dtype": plan.comm_wire_dtype,
+            },
+            "hand": {"batch": batch, "remat": remat},
+            "match": (plan.remat or remat) == remat
+            and b_plan == batch,
+            "cold_start_mfu_frac": round(mfu_frac, 4),
+            "modeled_chip": kind,
+            "reaction_s": round(reaction_s, 4),
+            "reaction_knob": rev.knob if rev else "",
+            "reaction_version": rev.version if rev else 0,
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _measure_migration(params, cfg, *, n_slots, max_len, page_size,
                        mode, prefill_chunk, seed):
     """Serving-tier recovery number: kill 1 of 2 replicas mid-decode
@@ -1746,6 +1835,13 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         # the serving half: tokens/s at fixed p99 from the last
         # `bench.py serve` artifact (None until serving has been benched)
         "serving": serving_trajectory_metric(),
+        # the brain's cold-start plan for this shape vs the hand-tuned
+        # row above, plus the live-refinement reaction time (in-process
+        # drill; see tuned_arm_metric)
+        "tuned": tuned_arm_metric(
+            name, batch, seq, remat,
+            device_kind=getattr(dev, "device_kind", ""),
+        ),
     }
 
 

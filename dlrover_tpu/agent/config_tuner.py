@@ -3,13 +3,20 @@
 Reference: dlrover/python/elastic_agent/config/paral_config_tuner.py:30 —
 polls the master for a ParallelConfig and writes it where the
 ElasticDataLoader picks it up (dataloader.py load_config).
+
+The polled doc now carries two independently-versioned payloads: the
+dataloader config (``version``) and the brain's latest tuning
+directive (``tuning`` / ``tuning_version`` — a cluster/brain.py
+TuningPlan as a plain dict). The tuner gates on the version PAIR so a
+dataloader re-config and a tuning revision never mask each other.
 """
 
 import json
 import os
 import threading
-from typing import Optional
+from typing import Optional, Set, Tuple
 
+from dlrover_tpu.common.comm import _backoff_delay
 from dlrover_tpu.common.constants import GraftEnv
 from dlrover_tpu.common.log import get_logger
 
@@ -32,7 +39,12 @@ class ParalConfigTuner:
         self._interval_s = interval_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._last_version = -1
+        self._last_versions: Tuple[int, int] = (-1, -1)
+        # warn-once-per-reason + backoff state: a master that is down
+        # for an hour must not emit 120 identical tracebacks at a fixed
+        # cadence (the update_sharding warn-once pattern)
+        self._warned_reasons: Set[str] = set()
+        self._fail_streak = 0
 
     def start(self):
         self._thread = threading.Thread(
@@ -44,27 +56,70 @@ class ParalConfigTuner:
         self._stop.set()
 
     def _loop(self):
-        while not self._stop.wait(self._interval_s):
+        while True:
+            delay = self._interval_s
+            if self._fail_streak:
+                # consecutive failures: jittered exponential backoff on
+                # top of the base cadence so a fleet of tuners doesn't
+                # hammer a recovering master in lockstep
+                delay += _backoff_delay(min(self._fail_streak, 6) - 1)
+            if self._stop.wait(delay):
+                return
             self.poll_once()
+
+    def _note_failure(self, exc: BaseException) -> None:
+        self._fail_streak += 1
+        reason = f"{type(exc).__name__}: {exc}"
+        if reason not in self._warned_reasons:
+            self._warned_reasons.add(reason)
+            logger.warning(
+                "parallel config poll failed (%s); repeats of this "
+                "reason logged at debug",
+                reason,
+                exc_info=True,
+            )
+        else:
+            logger.debug(
+                "parallel config poll failed again (%s), streak %d",
+                reason,
+                self._fail_streak,
+            )
 
     def poll_once(self) -> bool:
         try:
             cfg = self._client.get_parallel_config()
-        except Exception:  # noqa: BLE001
-            logger.warning("parallel config poll failed", exc_info=True)
+        except Exception as e:  # noqa: BLE001
+            self._note_failure(e)
             return False
-        if cfg.version == self._last_version:
+        self._fail_streak = 0
+        tuning_version = getattr(cfg, "tuning_version", 0)
+        versions = (cfg.version, tuning_version)
+        if versions == self._last_versions:
             return False
-        self._last_version = cfg.version
+        self._last_versions = versions
         doc = {
             "version": cfg.version,
             "batch_size": cfg.batch_size,
             "num_workers": cfg.num_workers,
             "grad_accum_steps": cfg.grad_accum_steps,
         }
+        tuning_json = getattr(cfg, "tuning_json", "")
+        if tuning_json:
+            try:
+                doc["tuning"] = json.loads(tuning_json)
+                doc["tuning_version"] = tuning_version
+            except json.JSONDecodeError:
+                logger.warning(
+                    "dropping malformed tuning directive v%d",
+                    tuning_version,
+                )
         tmp = self.config_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, self.config_path)
-        logger.info("wrote parallel config v%d: %s", cfg.version, doc)
+        logger.info(
+            "wrote parallel config v%d (tuning v%d)",
+            cfg.version,
+            tuning_version,
+        )
         return True

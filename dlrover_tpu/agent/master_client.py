@@ -254,6 +254,25 @@ class MasterClient:
         )
         return resp if resp else msgs.ServingScaleDirective()
 
+    def report_tuning_plan(
+        self, plan_json: str, signal: str = "", reason: str = ""
+    ) -> bool:
+        """Announce one brain tuning plan/revision; the master versions
+        it as a tuning directive (``get_tuning`` and the
+        ``ParallelConfig`` poll both serve it)."""
+        return self._t.report(
+            msgs.TuningPlanNotice(
+                node_id=self.node_id,
+                plan_json=plan_json,
+                signal=signal,
+                reason=reason,
+            )
+        )
+
+    def get_tuning(self) -> msgs.TuningPlanDirective:
+        resp = self._t.get(msgs.TuningPlanRequest(node_id=self.node_id))
+        return resp if resp else msgs.TuningPlanDirective()
+
     def report_network_check_result(
         self, elapsed_time: float, succeeded: bool
     ) -> bool:

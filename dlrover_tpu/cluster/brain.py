@@ -12,13 +12,27 @@ historical jobs of the same kind, and running-job adjustment from
 observed throughput/memory — behind the ResourceOptimizer interface the
 master already consumes, so LocalHeuristicOptimizer and BrainService are
 drop-in alternatives.
+
+The auto-tuner half closes the telemetry→config loop the reference
+Brain closes with resource plans, but over *performance* knobs:
+:class:`ColdStartPlanner` derives a versioned :class:`TuningPlan`
+(remat policy / batch size / comm buckets / wire dtype /
+update_sharding / block_k) from only the model shape + mesh, and
+:class:`BrainTuner` refines it live from telemetry-hub records —
+overlap drift → re-bucket, fp8 amax saturation → wider wire, OOM →
+remat/batch ladder, serving accept-rate/TTFT/occupancy/table-ship
+curves → spec_k / prefill_chunk / page bucketing / slot count.
+Revisions version through the master (``plan_tuning``, the same
+directive pattern as ``plan_serving_scale``) and reach trainers via the
+``ParalConfigTuner`` poll path. Knob→signal table and the revision
+ladders: docs/performance.md, lever 11 ("Auto-tuning").
 """
 
 import json
 import os
 import threading
 import time
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, replace
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import get_logger
@@ -26,17 +40,27 @@ from dlrover_tpu.master.resource_optimizer import (
     ResourceOptimizer,
     ResourcePlan,
 )
+from dlrover_tpu.observability import telemetry
+from dlrover_tpu.observability.telemetry import telemetry_record
 
 logger = get_logger(__name__)
 
 
-@dataclass
+@telemetry_record
 class JobMetrics:
-    """One observation of a running job (reference: brain.proto JobMetrics)."""
+    """One observation of a running job (reference: brain.proto JobMetrics).
 
-    job_name: str
+    A registered telemetry record (scalar fields only, lossless
+    envelope) so the schema lint covers it and healthcheck can replay
+    brain inputs next to tuning decisions. ``timestamp`` is stamped by
+    :meth:`MetricsStore.append` when left 0 (the old
+    ``default_factory=time.time`` behavior, moved out of the schema so
+    the round-trip stays value-stable); ``ts`` is the hub's publish
+    stamp."""
+
+    job_name: str = ""
     job_kind: str = ""            # user-declared workload family
-    timestamp: float = field(default_factory=time.time)
+    timestamp: float = 0.0
     worker_num: int = 0
     steps_per_sec: float = 0.0
     samples_per_sec: float = 0.0
@@ -44,6 +68,45 @@ class JobMetrics:
     host_mem_used_bytes: int = 0
     finished: bool = False
     oom: bool = False
+    ts: float = 0.0
+
+
+@telemetry_record
+class TuningPlan:
+    """One versioned tuning directive — the cold-start plan or a live
+    revision of one knob.
+
+    Sentinel convention: ``""`` (strings), ``0`` (counts/sizes) and
+    ``-1`` (``spec_k``/``page_bucketing``, where 0 is meaningful) mean
+    "leave that knob alone", so a revision carries exactly the knob it
+    changed and replaying a recording reconstructs the knob trail
+    without guessing. ``origin`` is ``cold_start`` (full plan) or
+    ``revision``; a revision also names the ``knob`` it moved and the
+    telemetry ``signal`` that drove it. Versions are minted by the
+    master (``JobManager.plan_tuning``) when wired, else locally by the
+    tuner. See docs/performance.md lever 11 for the knob→signal table.
+    """
+
+    version: int = 0
+    origin: str = "cold_start"     # cold_start | revision
+    signal: str = ""               # telemetry signal behind a revision
+    knob: str = ""                 # the knob a revision changed
+    reason: str = ""
+    # train knobs
+    block_k: int = 1               # fused train steps per dispatch
+    remat: str = ""                # rematerialisation policy; "" = leave
+    batch_size: int = 0            # per-chip micro batch; 0 = leave
+    grad_accum_steps: int = 0      # 0 = leave
+    comm_bucket_mb: float = 0.0    # ZeRO exchange bucket; 0 = leave
+    comm_wire_dtype: str = ""      # ICI collective wire dtype; "" = leave
+    comm_wire_dtype_dcn: str = ""  # cross-slice override; "" = none
+    update_sharding: str = ""      # "" leave | off | zero1 | zero2
+    # serving knobs
+    spec_k: int = -1               # speculative draft length; -1 = leave
+    prefill_chunk: int = 0         # 0 = leave
+    page_bucketing: int = -1       # -1 leave | 0 off | 1 on
+    n_slots: int = 0               # engine batch slots; 0 = leave
+    ts: float = 0.0
 
 
 class BaseMetricsStore:
@@ -78,6 +141,8 @@ class MetricsStore(BaseMetricsStore):
                         continue
 
     def append(self, m: JobMetrics):
+        if not m.timestamp:
+            m.timestamp = time.time()
         with self._lock:
             self._rows.append(m)
             if self._path:
@@ -352,6 +417,553 @@ def _algo_hot_ps(svc: BrainService, stats: Dict) -> ResourcePlan:
         sorted(hot),
     )
     return plan
+
+
+# ---------------------------------------------------------------------------
+# Auto-tuner: cold-start planning + live refinement (ROADMAP item 2).
+#
+# This module must stay importable on a bare host (no jax): the memory
+# model and the bandwidth/bucket model are small local replicas of the
+# analyser/bench formulas, calibrated against the measured flagship
+# shape (llama-1.4b b1×s8192 → save_qkv on a 16 GB chip, matching the
+# hand-tuned bench config), instead of imports of jax-heavy modules.
+# ---------------------------------------------------------------------------
+
+# cheapest-first remat ladder: each step trades more recompute for a
+# smaller residual set (models/config.py remat docstring); the OOM
+# ladder in BrainTuner descends it left→right.
+REMAT_LADDER = (
+    "none",
+    "save_dots",
+    "save_qkv_gate",
+    "save_qkv",
+    "save_attn",
+    "full",
+)
+# activation bytes ≈ tokens × d_model × 2 (bf16) × n_layer × scale:
+# the per-layer residual multiple each policy keeps live. "none" keeps
+# the full ×12 working set (analyser.py's non-remat multiple); "full"
+# keeps one boundary tensor per layer.
+_ACT_SCALE = {
+    "none": 12.0,
+    "save_dots": 8.0,
+    "save_qkv_gate": 5.0,
+    "save_qkv": 3.0,
+    "save_attn": 2.0,
+    "full": 1.0,
+}
+# analyser.py's tables, replicated so the planner stays jax-free
+_OPT_SLOTS = {"adamw": 2, "adam": 2, "agd": 3, "sgd": 1, "lion": 1}
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+# bench.py's ICI bandwidth table (GB/s per link direction)
+_ICI_GBPS = {
+    "v4": 300.0,
+    "v5 lite": 400.0,
+    "v5e": 400.0,
+    "v5p": 800.0,
+    "v6 lite": 900.0,
+    "v6e": 900.0,
+    "v7": 1200.0,
+    "cpu": 10.0,
+}
+_DEVICE_HBM_GB = {"v5p": 95.0, "v5 lite": 16.0, "v5e": 16.0, "v6": 32.0,
+                  "v4": 32.0}
+
+
+def _ici_gbps(device_kind: str = "") -> float:
+    kind = (device_kind or "").lower()
+    for key, val in _ICI_GBPS.items():
+        if key in kind:
+            return val
+    return 400.0
+
+
+def _device_hbm_bytes(device_kind: str = "") -> float:
+    kind = (device_kind or "").lower()
+    for key, gb in _DEVICE_HBM_GB.items():
+        if key in kind:
+            return gb * 1e9
+    return 16e9
+
+
+def _suggest_bucket_mb(total_grad_bytes, device_kind="", launch_us=5.0,
+                       grad_accum=1, update_mode=""):
+    """Faithful replica of ``bench.suggest_bucket_mb`` (bench is an
+    entry script, not a library the brain may import): smallest bucket
+    whose wire time dominates launch latency, but ≥ 4 buckets in
+    flight, clamped to [1, 64] MB."""
+    gbps = _ici_gbps(device_kind)
+    passes = grad_accum if (update_mode == "zero2" and grad_accum > 1) else 1
+    min_bytes = 4.0 * launch_us * passes * gbps * 1e3
+    mb = max(1.0, min_bytes / 2**20)
+    mb = min(mb, max(1.0, total_grad_bytes / 4 / 2**20))
+    return round(min(mb, 64.0), 2)
+
+
+def estimate_hbm_bytes(
+    cfg,
+    batch_per_chip: int,
+    seq: int,
+    remat: str,
+    param_shards: int = 1,
+    optimizer: str = "adamw",
+    state_dtype: str = "bfloat16",
+) -> float:
+    """Peak-HBM estimate for one chip running ``cfg`` at this shape.
+
+    Model states = params f32 + optimizer slots at ``state_dtype``;
+    gradients are donated/transient (no persistent term — the bench's
+    measured steady state, not analyser.py's conservative worst case,
+    which rejects the flagship shape at every remat). The logits term
+    honors fused CE: with ``cfg.fused_ce`` only one ``ce_block_v``-wide
+    f32 chunk is ever live. ×1.05 slack for fragmentation/workspace.
+    """
+    n = float(cfg.num_params())
+    slots = _OPT_SLOTS.get(optimizer, 2)
+    state_b = _DTYPE_BYTES.get(state_dtype or "float32", 4)
+    model_states = (n * 4.0 + n * slots * state_b) / max(1, param_shards)
+    tokens = float(batch_per_chip) * float(seq)
+    act = tokens * cfg.d_model * 2.0 * cfg.n_layer * _ACT_SCALE.get(
+        remat, 12.0
+    )
+    if getattr(cfg, "fused_ce", False):
+        logits = tokens * cfg.ce_block_v * 4.0
+    else:
+        logits = tokens * cfg.vocab_size * 4.0
+    return (model_states + act + logits) * 1.05
+
+
+class ColdStartPlanner:
+    """Zero-config plan from only the model shape + mesh.
+
+    Picks the largest per-chip batch whose cheapest-fitting remat
+    policy stays under the HBM budget, then derives the comm knobs from
+    the same bandwidth model the bench plans with: bucket size from
+    ``_suggest_bucket_mb``, f32 wire inside a slice (bitwise-safe
+    default) with an int8 override across DCN, ZeRO mode from the mesh
+    (zero2 when the exchange amortizes over grad accumulation)."""
+
+    def __init__(
+        self,
+        hbm_fraction: float = 0.92,
+        target_tokens_per_chip: int = 8192,
+    ):
+        self.hbm_fraction = hbm_fraction
+        self.target_tokens_per_chip = target_tokens_per_chip
+
+    def plan(
+        self,
+        cfg,
+        mesh=None,
+        n_devices: int = 1,
+        seq: int = 0,
+        device_kind: str = "",
+        hbm_bytes: float = 0.0,
+        grad_accum: int = 1,
+        optimizer: str = "adamw",
+        state_dtype: str = "bfloat16",
+    ) -> "TuningPlan":
+        seq = int(seq or getattr(cfg, "max_seq", 1024))
+        hbm = float(hbm_bytes or _device_hbm_bytes(device_kind))
+        budget = hbm * self.hbm_fraction
+        if mesh is None:
+            sizes = {"dp": max(1, n_devices), "pp": 1, "ep": 1, "fsdp": 1,
+                     "sp": 1, "tp": 1}
+            num_slices = 1
+        elif isinstance(mesh, dict):
+            sizes = {k: int(mesh.get(k, 1)) for k in
+                     ("dp", "pp", "ep", "fsdp", "sp", "tp")}
+            num_slices = int(mesh.get("num_slices", 1))
+        else:
+            sizes = mesh.resolved_sizes(n_devices)
+            num_slices = getattr(mesh, "num_slices", 1)
+        param_shards = sizes["fsdp"] * sizes["tp"] * sizes["pp"]
+
+        batch, remat, fits = 1, "full", False
+        start = max(1, self.target_tokens_per_chip // seq)
+        for b in range(start, 0, -1):
+            for r in REMAT_LADDER:
+                if estimate_hbm_bytes(
+                    cfg, b, seq, r,
+                    param_shards=param_shards,
+                    optimizer=optimizer,
+                    state_dtype=state_dtype,
+                ) <= budget:
+                    batch, remat, fits = b, r, True
+                    break
+            if fits:
+                break
+
+        n = float(cfg.num_params())
+        update_sharding = ""
+        if sizes["dp"] > 1 and sizes["pp"] == 1:
+            # zero1 shards the update; zero2's per-microbatch
+            # reduce-scatter only pays off when accumulation amortizes
+            # the gathered-param reuse
+            update_sharding = "zero2" if grad_accum > 1 else "zero1"
+        bucket = _suggest_bucket_mb(
+            n * 4.0 / max(1, param_shards),
+            device_kind,
+            grad_accum=grad_accum,
+            update_mode=update_sharding,
+        )
+        # small models at short sequence amortize dispatch overhead by
+        # fusing K train steps into one device call
+        block_k = 8 if (n < 2e8 and seq <= 1024) else 1
+        reason = (
+            f"model={getattr(cfg, 'name', '?')} seq={seq} "
+            f"hbm_gb={hbm / 1e9:.1f} shards={param_shards}"
+        )
+        if not fits:
+            reason += " (no shape fits; emitting minimum)"
+            logger.warning(
+                "cold-start planner: no (batch, remat) fits %s under "
+                "%.1f GB; emitting batch=1 remat=full anyway",
+                getattr(cfg, "name", "?"), budget / 1e9,
+            )
+        return TuningPlan(
+            version=1,
+            origin="cold_start",
+            signal="model_shape",
+            reason=reason,
+            block_k=block_k,
+            remat=remat,
+            batch_size=batch,
+            grad_accum_steps=max(1, grad_accum),
+            comm_bucket_mb=bucket,
+            comm_wire_dtype="float32",
+            comm_wire_dtype_dcn="int8" if num_slices > 1 else "",
+            update_sharding=update_sharding,
+        )
+
+
+def apply_revision(plan, tp: "TuningPlan"):
+    """Fold a :class:`TuningPlan` into an ``AccelerationPlan`` — pure
+    field mapping honoring the leave-alone sentinels, so the trainer
+    can rebuild its step from the revised plan at a step boundary
+    (the ``ElasticTrainer._refresh`` pattern) without a restart."""
+    kw = {}
+    if tp.remat:
+        kw["remat"] = tp.remat
+    if tp.comm_bucket_mb:
+        kw["comm_bucket_mb"] = float(tp.comm_bucket_mb)
+    if tp.comm_wire_dtype:
+        kw["comm_wire_dtype"] = tp.comm_wire_dtype
+    if tp.comm_wire_dtype_dcn:
+        kw["comm_wire_dtype_dcn"] = tp.comm_wire_dtype_dcn
+    if tp.update_sharding:
+        kw["update_sharding"] = (
+            False if tp.update_sharding == "off" else tp.update_sharding
+        )
+    if tp.grad_accum_steps:
+        kw["grad_accum"] = int(tp.grad_accum_steps)
+    return replace(plan, **kw) if kw else plan
+
+
+class BrainTuner:
+    """Live refinement: subscribe to the telemetry hub, turn sustained
+    signals into one-knob :class:`TuningPlan` revisions.
+
+    Ladders (docs/performance.md lever 11):
+
+    * overlap drift (``OverlapDriftRecord.drift_frac`` over threshold
+      for ``drift_patience`` consecutive samples) → double
+      ``comm_bucket_mb``, clamped to [1, 64];
+    * fp8 amax saturation (``AnomalyRecord(kind="fp8_saturation")``) →
+      ascend the wire-dtype ladder int8 → bfloat16 → float32 (the DCN
+      override first when one is set — the narrow wire lives there);
+    * OOM (the bench failure classifier's verdict, via
+      :meth:`on_failure` or an ``AnomalyRecord(kind="oom")``) →
+      descend :data:`REMAT_LADDER`; past ``full``, halve the batch;
+    * serving (``ServingRecord``): accept-rate EWMA high/low →
+      ``spec_k`` ±1; TTFT p99 over target → halve ``prefill_chunk``;
+      full slots with queued work → grow ``n_slots`` (idle → shrink);
+      a rising ``table_ships`` rate (engine ``stats()`` via
+      :meth:`observe_serving_stats`) → enable page bucketing.
+
+    Each revision is versioned through ``report`` (the master's
+    ``plan_tuning`` directive counter) when wired, else a local
+    counter; applied to the held plan; and published back to the hub so
+    the flight recorder / healthcheck can replay the decision trail.
+    A per-knob cooldown keeps the loop from thrashing.
+    """
+
+    WIRE_LADDER = ("int8", "bfloat16", "float32")
+
+    def __init__(
+        self,
+        plan: "TuningPlan",
+        report: Optional[Callable[["TuningPlan"], int]] = None,
+        cooldown_s: float = 30.0,
+        drift_frac_threshold: float = 0.25,
+        drift_patience: int = 3,
+        accept_high: float = 0.8,
+        accept_low: float = 0.4,
+        spec_k_max: int = 8,
+        ttft_target_ms: float = 0.0,
+        prefill_chunk_min: int = 16,
+        occupancy_patience: int = 3,
+        table_ship_budget: int = 4,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.plan = plan
+        self.revisions: List[TuningPlan] = []
+        self._report = report
+        self._version = int(plan.version)
+        self._cooldown_s = cooldown_s
+        self._drift_threshold = drift_frac_threshold
+        self._drift_patience = drift_patience
+        self._accept_high = accept_high
+        self._accept_low = accept_low
+        self._spec_k_max = spec_k_max
+        self._ttft_target_ms = ttft_target_ms
+        self._prefill_chunk_min = prefill_chunk_min
+        self._occupancy_patience = occupancy_patience
+        self._table_ship_budget = table_ship_budget
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_rev_t: Dict[str, float] = {}
+        self._drift_streak = 0
+        self._accept_ewma: Optional[float] = None
+        self._occupancy_streak = 0
+        self._idle_streak = 0
+        self._last_table_ships: Optional[int] = None
+        self._sink = None
+
+    # ---- hub wiring -------------------------------------------------------
+
+    def attach(self, hub):
+        """Subscribe to the signals this tuner consumes; returns the
+        sink (pass to ``hub.remove_sink`` to detach)."""
+        self._sink = hub.subscribe(
+            self.on_record,
+            types=("OverlapDriftRecord", "AnomalyRecord", "ServingRecord"),
+        )
+        return self._sink
+
+    def on_record(self, record) -> None:
+        name = type(record).__name__
+        if name == "OverlapDriftRecord":
+            self._on_drift(record)
+        elif name == "AnomalyRecord":
+            self._on_anomaly(record)
+        elif name == "ServingRecord":
+            self._on_serving(record)
+
+    # ---- train ladders ----------------------------------------------------
+
+    def _on_drift(self, r) -> None:
+        if r.drift_frac <= self._drift_threshold:
+            self._drift_streak = 0
+            return
+        self._drift_streak += 1
+        if self._drift_streak < self._drift_patience:
+            return
+        cur = self.plan.comm_bucket_mb or 4.0
+        new = round(min(64.0, cur * 2.0), 2)
+        if new == cur:
+            return
+        if self._revise(
+            "comm_bucket_mb",
+            signal="overlap_drift",
+            reason=(
+                f"drift_frac={r.drift_frac:.2f} over "
+                f"{self._drift_streak} samples; bucket {cur}→{new} MB"
+            ),
+            comm_bucket_mb=new,
+        ):
+            self._drift_streak = 0
+
+    def _on_anomaly(self, r) -> None:
+        if r.kind == "fp8_saturation":
+            self._widen_wire(r.detail)
+        elif r.kind == "oom":
+            self.on_failure("oom", r.detail)
+
+    def _widen_wire(self, detail: str = "") -> None:
+        # the narrow wire is wherever the plan put it: the DCN override
+        # when one is set, else the ICI dtype
+        if self.plan.comm_wire_dtype_dcn:
+            knob, cur = "comm_wire_dtype_dcn", self.plan.comm_wire_dtype_dcn
+        else:
+            knob, cur = "comm_wire_dtype", self.plan.comm_wire_dtype
+        cur = cur or "float32"
+        try:
+            idx = self.WIRE_LADDER.index(cur)
+        except ValueError:
+            return
+        if idx >= len(self.WIRE_LADDER) - 1:
+            return  # already float32: nothing wider
+        wider = self.WIRE_LADDER[idx + 1]
+        self._revise(
+            knob,
+            signal="fp8_saturation",
+            reason=f"amax saturation; {knob} {cur}→{wider} {detail}".strip(),
+            **{knob: wider},
+        )
+
+    def on_failure(self, kind: str, detail: str = "") -> Optional["TuningPlan"]:
+        """Feed a bench-classifier verdict (oom | compile_error |
+        timeout | error); OOM descends the remat ladder, then the
+        batch."""
+        if kind != "oom":
+            return None
+        cur = self.plan.remat or "none"
+        try:
+            idx = REMAT_LADDER.index(cur)
+        except ValueError:
+            idx = 0
+        if idx < len(REMAT_LADDER) - 1:
+            nxt = REMAT_LADDER[idx + 1]
+            return self._revise(
+                "remat",
+                signal="oom",
+                reason=f"oom; remat {cur}→{nxt} {detail}".strip(),
+                remat=nxt,
+            )
+        batch = self.plan.batch_size
+        if batch > 1:
+            return self._revise(
+                "batch_size",
+                signal="oom",
+                reason=f"oom at remat=full; batch {batch}→{batch // 2}",
+                batch_size=batch // 2,
+            )
+        logger.warning("oom with remat=full batch=1: ladder exhausted")
+        return None
+
+    # ---- serving ladders --------------------------------------------------
+
+    def _on_serving(self, r) -> None:
+        if r.draft_tokens > 0 and self.plan.spec_k >= 0:
+            rate = r.spec_accept_rate
+            self._accept_ewma = (
+                rate
+                if self._accept_ewma is None
+                else 0.7 * self._accept_ewma + 0.3 * rate
+            )
+            k = self.plan.spec_k
+            if self._accept_ewma > self._accept_high and k < self._spec_k_max:
+                self._revise(
+                    "spec_k",
+                    signal="spec_accept_rate",
+                    reason=f"accept ewma {self._accept_ewma:.2f} high; "
+                           f"spec_k {k}→{k + 1}",
+                    spec_k=k + 1,
+                )
+            elif self._accept_ewma < self._accept_low and k > 0:
+                self._revise(
+                    "spec_k",
+                    signal="spec_accept_rate",
+                    reason=f"accept ewma {self._accept_ewma:.2f} low; "
+                           f"spec_k {k}→{k - 1}",
+                    spec_k=k - 1,
+                )
+        if (
+            self._ttft_target_ms
+            and r.ttft_p99_ms > self._ttft_target_ms
+            and self.plan.prefill_chunk > self._prefill_chunk_min
+        ):
+            cur = self.plan.prefill_chunk
+            new = max(self._prefill_chunk_min, cur // 2)
+            self._revise(
+                "prefill_chunk",
+                signal="ttft_p99",
+                reason=f"ttft_p99 {r.ttft_p99_ms:.0f}ms over "
+                       f"{self._ttft_target_ms:.0f}ms; chunk {cur}→{new}",
+                prefill_chunk=new,
+            )
+        if self.plan.n_slots > 0:
+            n = self.plan.n_slots
+            if r.active_slots >= n and r.queue_depth > 0:
+                self._occupancy_streak += 1
+                self._idle_streak = 0
+            elif r.queue_depth == 0 and r.active_slots * 2 <= n:
+                self._idle_streak += 1
+                self._occupancy_streak = 0
+            else:
+                self._occupancy_streak = self._idle_streak = 0
+            grow = max(1, n // 4)
+            if self._occupancy_streak >= self._occupancy_patience:
+                if self._revise(
+                    "n_slots",
+                    signal="occupancy",
+                    reason=f"slots full with queue {r.queue_depth}; "
+                           f"n_slots {n}→{n + grow}",
+                    n_slots=n + grow,
+                ):
+                    self._occupancy_streak = 0
+            elif self._idle_streak >= self._occupancy_patience and n > 1:
+                new = max(1, n - grow)
+                if new != n and self._revise(
+                    "n_slots",
+                    signal="occupancy",
+                    reason=f"≤half slots busy, empty queue; "
+                           f"n_slots {n}→{new}",
+                    n_slots=new,
+                ):
+                    self._idle_streak = 0
+
+    def observe_serving_stats(self, stats: Dict) -> None:
+        """Consume an engine ``stats()`` snapshot for the signals not
+        on ``ServingRecord`` — today the block-table ship rate."""
+        ships = int(stats.get("table_ships", 0))
+        if (
+            self._last_table_ships is not None
+            and ships - self._last_table_ships > self._table_ship_budget
+            and self.plan.page_bucketing != 1
+        ):
+            self._revise(
+                "page_bucketing",
+                signal="table_ships",
+                reason=f"{ships - self._last_table_ships} table ships "
+                       f"since last snapshot; enabling page bucketing",
+                page_bucketing=1,
+            )
+        self._last_table_ships = ships
+
+    # ---- revision machinery -----------------------------------------------
+
+    def _revise(
+        self, knob: str, signal: str, reason: str, **fields
+    ) -> Optional["TuningPlan"]:
+        with self._lock:
+            now = self._clock()
+            last = self._last_rev_t.get(knob)
+            if last is not None and now - last < self._cooldown_s:
+                return None
+            rev = TuningPlan(
+                origin="revision",
+                signal=signal,
+                knob=knob,
+                reason=reason,
+                **fields,
+            )
+            version = 0
+            if self._report is not None:
+                try:
+                    version = int(self._report(rev) or 0)
+                except Exception:  # noqa: BLE001 — master unreachable
+                    logger.warning(
+                        "tuning revision report failed; versioning "
+                        "locally",
+                        exc_info=True,
+                    )
+            if not version:
+                version = self._version + 1
+            self._version = max(self._version, version)
+            rev.version = version
+            self.plan = replace(self.plan, version=version, **fields)
+            self.revisions.append(rev)
+            self._last_rev_t[knob] = now
+        logger.info(
+            "tuning revision v%d: %s (%s) — %s",
+            rev.version, knob, signal, reason,
+        )
+        hub = telemetry.get_hub()
+        if hub.enabled:
+            hub.publish(rev)
+        return rev
 
 
 # ---------------------------------------------------------------------------

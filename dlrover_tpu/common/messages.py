@@ -550,11 +550,44 @@ class ParallelConfig:
     # grad accumulation (elastic trainer keeps global batch fixed)
     grad_accum_steps: int = 1
     version: int = 0
+    # brain tuning directive riding the same poll (cluster/brain.py):
+    # the latest TuningPlan as its asdict JSON, with its own version so
+    # a dataloader re-config and a tuning revision don't mask each
+    # other ("" / 0 = no tuning directive pending)
+    tuning_json: str = ""
+    tuning_version: int = 0
 
 
 @message
 class ParallelConfigRequest:
     node_id: int = 0
+
+
+@message
+class TuningPlanNotice:
+    """The brain tuner announces one cold-start plan or revision so the
+    master can version it (the training analogue of
+    :class:`ServingScaleNotice`)."""
+
+    node_id: int = 0
+    plan_json: str = ""          # TuningPlan asdict JSON
+    signal: str = ""             # telemetry signal that drove it
+    reason: str = ""
+
+
+@message
+class TuningPlanRequest:
+    node_id: int = 0
+
+
+@message
+class TuningPlanDirective:
+    """The master's tuning directive (versioned like
+    :class:`ServingScaleDirective`; 0 = none pending)."""
+
+    version: int = 0
+    plan_json: str = ""
+    reason: str = ""
 
 
 # ---------------------------------------------------------------------------

@@ -111,6 +111,55 @@ class ElasticTrainer:
         self._step_fn = None
         self._refresh()
 
+    def apply_tuning(self, plan) -> bool:
+        """Apply a brain tuning revision at a step boundary.
+
+        ``plan`` is a cluster/brain.py TuningPlan (or its dict form
+        from the ParalConfigTuner doc). A positive ``batch_size``
+        re-derives accumulation at the new micro-batch; any versioned
+        revision forces a step rebuild so builder-side knobs already
+        folded in via ``cluster.brain.apply_revision`` (remat, comm
+        bucket, wire dtype) land in the next trace. Optimizer state is
+        untouched, so the loss curve is continuous — a retune is a
+        rebuild, never a restart. Returns True when a rebuild ran.
+        """
+        from dlrover_tpu.observability import telemetry
+        from dlrover_tpu.observability.tracing import get_tracer
+
+        def knob(name):
+            if isinstance(plan, dict):
+                return plan.get(name, 0)
+            return getattr(plan, name, 0)
+
+        version = int(knob("version") or 0)
+        batch = int(knob("batch_size") or 0)
+        if batch > 0 and batch != self.micro_batch_size:
+            self.micro_batch_size = batch
+        elif not version:
+            return False
+        span = get_tracer().span("brain.tuning_replan", version=version)
+        replicas = max(1, self._data_replicas_fn())
+        per_step = self.micro_batch_size * replicas
+        self.grad_accum = max(
+            1, math.ceil(self.global_batch_size / per_step)
+        )
+        self._replicas = replicas
+        self._step_fn = self._build_step(self.grad_accum)
+        seconds = span.end(grad_accum=self.grad_accum)
+        hub = telemetry.get_hub()
+        if hub.enabled:
+            hub.publish(
+                telemetry.ElasticEvent(
+                    kind="tuning_replan",
+                    seconds=seconds,
+                    detail=(
+                        f"v{version} micro={self.micro_batch_size} "
+                        f"accum={self.grad_accum}"
+                    ),
+                )
+            )
+        return True
+
     def step(self, state, batch):
         self._refresh()
         return self._step_fn(state, batch)

@@ -114,6 +114,11 @@ class JobManager:
         # prefill and decode pools scale independently
         self._serving_scale_version = 0
         self._serving_scale: Dict[str, Dict] = {}
+        # brain tuning directives (cluster/brain.py): one monotonic
+        # version counter, latest plan/revision kept; trainers pick it
+        # up through the ParallelConfig poll (tuning_json field)
+        self._tuning_version = 0
+        self._tuning: Optional[Dict] = None
         self._init_nodes()
 
     def _init_nodes(self):
@@ -462,6 +467,37 @@ class JobManager:
                     key=lambda d: d["version"],
                 )
             )
+
+    # ---- brain tuning directives -----------------------------------------
+
+    def plan_tuning(self, plan_json: str, reason: str = "") -> int:
+        """Version one brain tuning plan/revision (cluster/brain.py
+        TuningPlan as asdict JSON). Same contract as
+        :meth:`plan_serving_scale`: monotonic counter, latest directive
+        wins, trainers poll it via the ParallelConfig path. Returns the
+        version (starts at 1)."""
+        from dlrover_tpu.observability.tracing import get_tracer
+
+        with self._lock:
+            self._tuning_version += 1
+            version = self._tuning_version
+            self._tuning = {
+                "version": version,
+                "plan_json": plan_json,
+                "reason": reason,
+            }
+        get_tracer().instant("brain.tuning_plan", version=version)
+        logger.info(
+            "tuning directive v%d (%s)", version, reason or "brain"
+        )
+        return version
+
+    def get_tuning(self) -> Dict:
+        """The latest tuning directive, or ``{"version": 0}``."""
+        with self._lock:
+            if self._tuning is None:
+                return {"version": 0}
+            return dict(self._tuning)
 
     def all_workers_exited(self) -> bool:
         with self._lock:

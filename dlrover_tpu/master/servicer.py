@@ -306,6 +306,26 @@ class MasterServicer:
             )
         return True
 
+    def _report_tuning_plan(self, m: msgs.TuningPlanNotice) -> bool:
+        """The brain tuner reports one cold-start plan or revision:
+        version it as a tuning directive (trainers pick it up through
+        the ParallelConfig poll) and surface it on the elastic event
+        stream, same shape as the serving-scale path."""
+        if self.job_manager is None:
+            return False
+        version = self.job_manager.plan_tuning(
+            m.plan_json, reason=m.reason or m.signal
+        )
+        if self.telemetry_hub is not None and self.telemetry_hub.enabled:
+            self.telemetry_hub.publish(
+                telemetry.ElasticEvent(
+                    kind="tuning_plan_notice",
+                    node_id=m.node_id,
+                    detail=f"v{version} {m.signal} {m.reason}".strip(),
+                )
+            )
+        return True
+
     def _report_kv(self, m: msgs.KeyValuePair) -> bool:
         if self.kv_store:
             self.kv_store.set(m.key, m.value)
@@ -369,6 +389,7 @@ class MasterServicer:
         "EvictionNotice": _report_eviction,
         "ServingEvictionNotice": _report_serving_eviction,
         "ServingScaleNotice": _report_serving_scale,
+        "TuningPlanNotice": _report_tuning_plan,
         "KeyValuePair": _report_kv,
         "SyncJoin": _report_sync_join,
         "CheckpointStepSync": _report_ckpt_step,
@@ -543,7 +564,27 @@ class MasterServicer:
             self.job_manager.get_node(m.node_id) if self.job_manager else None
         )
         cfg = node.paral_config if node else {}
-        return msgs.ParallelConfig(**cfg) if cfg else msgs.ParallelConfig()
+        out = msgs.ParallelConfig(**cfg) if cfg else msgs.ParallelConfig()
+        # fold the job-level tuning directive into the per-node config
+        # so one poll carries both (the tuner gates on the version PAIR)
+        if self.job_manager is not None:
+            plan = self.job_manager.get_tuning()
+            if plan.get("version"):
+                out.tuning_version = plan["version"]
+                out.tuning_json = plan["plan_json"]
+        return out
+
+    def _get_tuning(self, m: msgs.TuningPlanRequest):
+        if self.job_manager is None:
+            return msgs.TuningPlanDirective()
+        plan = self.job_manager.get_tuning()
+        if not plan.get("version"):
+            return msgs.TuningPlanDirective()
+        return msgs.TuningPlanDirective(
+            version=plan["version"],
+            plan_json=plan["plan_json"],
+            reason=plan["reason"],
+        )
 
     def _get_ps_version(self, m: msgs.PsVersionRequest):
         if not self.ps_service:
@@ -586,6 +627,7 @@ class MasterServicer:
         "ReshardPlanRequest": _get_reshard_plan,
         "ServingReshardRequest": _get_serving_reshard,
         "ServingScaleRequest": _get_serving_scale,
+        "TuningPlanRequest": _get_tuning,
         "NumNodesWaitingRequest": _get_num_nodes_waiting,
         "TaskRequest": _get_task,
         "ShardCheckpointRequest": _get_shard_ckpt,

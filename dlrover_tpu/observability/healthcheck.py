@@ -20,6 +20,7 @@ import sys
 from collections import Counter
 from typing import Dict, List, Optional
 
+import dlrover_tpu.cluster.brain  # noqa: F401 — registers TuningPlan/JobMetrics for replay
 from dlrover_tpu.observability import telemetry
 from dlrover_tpu.observability.watchdog import HealthAggregator
 
@@ -96,6 +97,7 @@ def diagnose(records: List, world: int = 0) -> Dict:
 
     serving = _serving_section(by_type)
     scale_decisions = _scale_section(by_type)
+    tuning = _tuning_section(by_type)
 
     steps = by_type.get("StepRecord", [])
     step_info = {}
@@ -134,7 +136,37 @@ def diagnose(records: List, world: int = 0) -> Dict:
         ],
         "serving": serving,
         "scale_decisions": scale_decisions,
+        "tuning": tuning,
         "healthy": not anomalies,
+    }
+
+
+def _tuning_section(by_type: Dict[str, List]) -> Dict:
+    """Replay ``TuningPlan`` lines into WHY the job runs at its current
+    knobs: the cold-start plan (origin ``cold_start``), then every
+    versioned revision with the signal that triggered it and the knob
+    it moved. Recordings that predate the brain auto-tuner contain no
+    such lines and replay as ``{}`` — absence means "no tuning
+    decisions", not an error."""
+    recs = by_type.get("TuningPlan", [])
+    if not recs:
+        return {}
+    trail = []
+    knobs_moved: Counter = Counter()
+    for r in recs:  # file order == write order
+        trail.append({
+            "version": r.version,
+            "origin": r.origin,
+            "signal": r.signal,
+            "knob": r.knob,
+            "reason": r.reason,
+        })
+        if r.knob:
+            knobs_moved[r.knob] += 1
+    return {
+        "decisions": trail,
+        "n_revisions": sum(1 for d in trail if d["origin"] == "revision"),
+        "knobs_moved": dict(knobs_moved),
     }
 
 
@@ -321,6 +353,24 @@ def format_report(diag: Dict) -> str:
                 f"{d['n_before']}→{d['n_after']}: {d['signal']} "
                 f"({d['reason']}){who}"
             )
+    tuning = diag.get("tuning") or {}
+    if tuning:
+        lines.append("")
+        lines.append(
+            f"brain tuning: {tuning['n_revisions']} revision(s) after "
+            "cold start"
+        )
+        if tuning["knobs_moved"]:
+            lines.append(
+                "  knobs moved: " + ", ".join(
+                    f"{k}×{n}"
+                    for k, n in sorted(tuning["knobs_moved"].items())
+                )
+            )
+        for d in tuning["decisions"][:20]:
+            what = d["knob"] or d["origin"]
+            why = d["signal"] or d["reason"] or d["origin"]
+            lines.append(f"  v{d['version']} {what}: {why}")
     if diag["healthy"]:
         lines.append("no anomalies recorded — run looks healthy")
         return "\n".join(lines)

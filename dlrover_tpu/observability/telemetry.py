@@ -446,6 +446,18 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("autoscale_pool_size", "n_after"),
         ("autoscale_reaction_s", "reaction_s"),
     ],
+    # cluster/brain.py records (registered on brain import)
+    "TuningPlan": [
+        ("tuning_version", "version"),
+        ("tuning_comm_bucket_mb", "comm_bucket_mb"),
+        ("tuning_spec_k", "spec_k"),
+        ("tuning_prefill_chunk", "prefill_chunk"),
+    ],
+    "JobMetrics": [
+        ("brain_steps_per_sec", "steps_per_sec"),
+        ("brain_samples_per_sec", "samples_per_sec"),
+        ("brain_hbm_used_bytes", "hbm_used_bytes"),
+    ],
 }
 _COUNTER_MAP: Dict[str, str] = {
     "ElasticEvent": "elastic_events_total",
@@ -456,6 +468,8 @@ _COUNTER_MAP: Dict[str, str] = {
     "HealthSummary": "health_summaries_total",
     "ServingRecord": "serving_records_total",
     "ScaleDecisionRecord": "scale_decisions_total",
+    "TuningPlan": "tuning_plans_total",
+    "JobMetrics": "brain_job_metrics_total",
 }
 
 
@@ -497,6 +511,7 @@ class MasterSink:
         "NumericEvent",
         "OverlapDriftRecord",
         "PlanRecord",
+        "TuningPlan",
     )
 
     def __init__(self, client, types: Optional[Tuple[str, ...]] = None):

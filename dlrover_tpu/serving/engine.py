@@ -269,8 +269,21 @@ class ServingEngine:
         self._cow_pages = 0       # tail pages copy-on-write duplicated
         self._peak_dedup = 1.0    # peak Σ slot cells / unique pages
 
+        self._slack_pages = int(slack_pages)
+        self._build_step_fns()
+
+    def _build_step_fns(self) -> None:
+        """(Re)build the three jitted step closures from the current
+        geometry + knobs. Called at construction and again by
+        :meth:`retune` when a value a closure captured changes
+        (``prefill_chunk`` is baked into the gather-mode chunk slice;
+        the geometry behind ``n_slots`` shapes everything) — a retune
+        is a closure rebuild at a step boundary, never a process
+        restart, and recompiles lazily on first use."""
         geom = self.geom
-        chunk_w = prefill_chunk
+        cfg = self.cfg
+        paged = self.paged
+        chunk_w = self.prefill_chunk
 
         def _draw_rows(logits, keys, draw_pos, temp, top_k, top_p):
             """Fused per-slot sampler: one token per row of ``logits``
@@ -508,6 +521,112 @@ class ServingEngine:
 
     def active_slots(self) -> int:
         return sum(s is not None for s in self.slots)
+
+    # ---- live retuning ---------------------------------------------------
+
+    def retune(
+        self,
+        *,
+        spec_k: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        page_bucketing: Optional[bool] = None,
+        n_slots: Optional[int] = None,
+    ) -> dict:
+        """Apply a brain tuning revision (cluster/brain.py TuningPlan
+        serving knobs) at a step boundary, without a restart.
+
+        Every knob preserves the bitwise-parity invariants: sampling is
+        keyed by ``fold_in(slot key, absolute position)``, so the token
+        stream is independent of spec_k (spec-on == spec-off), chunk
+        width, page bucketing, and slot count at the same seeds.
+
+        Application classes:
+
+        - ``spec_k`` / ``page_bucketing`` — host-side reads, effective
+          on the next step with no rebuild.
+        - ``prefill_chunk`` — baked into the gather-mode chunk closure,
+          so the step fns are rebuilt. Chunk starts must stay aligned:
+          the new width must divide slot capacity AND every in-flight
+          prefill's resume point; a misaligned request defers the knob
+          (returned under ``"deferred"``) for the caller's next
+          boundary rather than corrupting a live slot.
+        - ``n_slots`` — sizes the geometry, allocator, pools and block
+          tables; applied only when the engine is fully idle (resident
+          KV cannot survive a pool reshape). Busy engines defer.
+
+        Returns ``{"applied": {knob: new}, "deferred": {knob: why}}``.
+        """
+        applied: dict = {}
+        deferred: dict = {}
+        if spec_k is not None:
+            if spec_k < 0:
+                raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+            if int(spec_k) != self.spec_k:
+                self.spec_k = int(spec_k)
+                applied["spec_k"] = self.spec_k
+        if page_bucketing is not None:
+            if bool(page_bucketing) != self.page_bucketing:
+                self.page_bucketing = bool(page_bucketing)
+                # bucket width changed: the cached device tables were
+                # padded to the old bucket
+                self._tables_dev = None
+                applied["page_bucketing"] = self.page_bucketing
+        rebuild = False
+        if n_slots is not None and int(n_slots) != self.n_slots:
+            n_new = int(n_slots)
+            if n_new < 1:
+                raise ValueError(f"n_slots must be >= 1, got {n_new}")
+            if self.active_slots():
+                deferred["n_slots"] = (
+                    f"{self.active_slots()} slots hold live KV; pools "
+                    "cannot reshape under them"
+                )
+            else:
+                g = self.geom
+                self.geom = kvc.make_geometry(
+                    self.cfg, n_slots=n_new, max_len=g.max_len,
+                    page_size=g.page_size, mode=g.mode,
+                    slack_pages=self._slack_pages,
+                )
+                self.alloc = kvc.PageAllocator(self.geom, n_new)
+                self.pools = kvc.init_pools(self.geom)
+                self.slots = [None] * n_new
+                self.n_slots = n_new
+                self._tables_dev = None
+                if self.prefix_sharing:
+                    # shared pages died with the old pools
+                    self.trie = prefix_mod.PrefixIndex(g.page_size)
+                    self.alloc.on_free = self.trie.drop_pages
+                rebuild = True
+                applied["n_slots"] = n_new
+        if prefill_chunk is not None and int(prefill_chunk) != self.prefill_chunk:
+            pc = int(prefill_chunk)
+            if pc < 1:
+                raise ValueError(f"prefill_chunk must be >= 1, got {pc}")
+            if self.geom.max_len % pc:
+                raise ValueError(
+                    f"slot capacity {self.geom.max_len} must be a "
+                    f"multiple of prefill_chunk={pc} (chunk starts are "
+                    "chunk-aligned; dynamic_slice clamps out-of-bounds "
+                    "starts)"
+                )
+            misaligned = [
+                i for i, s in enumerate(self.slots)
+                if s is not None and s.phase == "prefill"
+                and s.n_prefilled % pc
+            ]
+            if misaligned:
+                deferred["prefill_chunk"] = (
+                    f"slots {misaligned} mid-prefill at non-multiples "
+                    f"of {pc}"
+                )
+            else:
+                self.prefill_chunk = pc
+                rebuild = True
+                applied["prefill_chunk"] = pc
+        if rebuild:
+            self._build_step_fns()
+        return {"applied": applied, "deferred": deferred}
 
     def stats(self) -> dict:
         dt = time.monotonic() - self._t0 if self._t0 else 0.0

@@ -423,3 +423,46 @@ def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"serve_tokens_per_s": None}))
     assert bench.serving_trajectory_metric(str(empty)) is None
+    # the tuned arm lives in the TRAIN record, not the serve artifact:
+    # old SERVE_*.json files replay with the exact shapes pinned above
+    # and never grow a "tuned" key
+    assert "tuned" not in got and "tuned" not in got_asc
+
+
+def test_tuned_arm_metric_schema():
+    """The ``tuned`` block of the train record: cold-start plan vs the
+    hand-tuned row (CPU-modeled MFU fraction) plus the live-refinement
+    reaction drill. In-process and cheap — no subprocess bench run."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    got = bench.tuned_arm_metric("tiny", 2, 64, "none")
+    assert "error" not in got, got
+    for key in ("planned", "hand", "match", "cold_start_mfu_frac",
+                "modeled_chip", "reaction_s", "reaction_knob",
+                "reaction_version"):
+        assert key in got, key
+    for key in ("batch", "remat", "block_k", "comm_bucket_mb",
+                "update_sharding", "comm_wire_dtype"):
+        assert key in got["planned"], key
+    assert got["hand"] == {"batch": 2, "remat": "none"}
+    # acceptance bar: the zero-config plan models >= 95% of the
+    # hand-tuned row's MFU
+    assert got["cold_start_mfu_frac"] >= 0.95
+    # off-TPU the plan is modeled against the reference chip the
+    # flagship ladder was hand-tuned for
+    assert got["modeled_chip"] == "v5e"
+    # the synthetic overlap-drift regression produced a versioned
+    # revision, and doing so took real (non-negative) wall time
+    assert got["reaction_knob"] == "comm_bucket_mb"
+    assert got["reaction_version"] >= 1
+    assert got["reaction_s"] >= 0
+    # the flagship shape reproduces the hand recipe exactly
+    flagship = bench.tuned_arm_metric("llama-1.4b", 1, 8192, "save_qkv")
+    assert "error" not in flagship, flagship
+    assert flagship["match"] is True
+    assert flagship["cold_start_mfu_frac"] == pytest.approx(1.0)
+    # a brain regression degrades to an error record, never a raise
+    assert "error" in bench.tuned_arm_metric("no-such-model", 1, 64, "none")

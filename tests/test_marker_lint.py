@@ -485,6 +485,13 @@ _SLOW_LEDGER = [
     "test_scale_in_drains_live_zero_loss_and_detached_is_not_dead",
     "test_serving_autoscale.py::"
     "test_live_oscillating_load_one_decision_per_cooldown",
+    # brain auto-tuner drills (PR 19): each compiles real jitted steps
+    # (a TrainStepBuilder rebuild, or an engine pair for retune parity)
+    # and drives versioned revisions through them; the planner math and
+    # ladder units (synthetic records, injected clock, no jit) stay
+    # tier-1 in the same file.
+    "test_brain_tuner.py::test_tuning_replan_drill_loss_continuity",
+    "test_brain_tuner.py::test_serving_retune_bitwise_parity",
 ]
 
 
@@ -686,6 +693,34 @@ def test_autoscaler_fleet_drills_are_slow():
         "autoscaler fleet drills (ServingAutoScaler + ServingReplica) "
         "must be slow-marked (add @pytest.mark.slow or a module "
         "pytestmark):\n" + "\n".join(rogue)
+    )
+
+
+def test_brain_tuner_e2e_drills_are_slow():
+    """A test referencing ``BrainTuner`` together with a step-building
+    layer (``TrainStepBuilder``) or a live engine (``ServingEngine``)
+    is a telemetry→config loop drill: it compiles real jitted steps
+    and drives versioned revisions through them — slow tier by
+    construction. The tuner's pure ladder units (synthetic records +
+    an injected clock, no jit anywhere) reference neither class and
+    stay in tier-1, which is the whole point of keeping the ladders
+    pure."""
+    engines = {"TrainStepBuilder", "ServingEngine"}
+    rogue = []
+    for path in sorted(_TESTS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if _module_slow_marked(tree):
+            continue
+        for fn in _test_functions(tree):
+            if _fn_slow_marked(fn):
+                continue
+            refs = _fn_references(fn, engines | {"BrainTuner"})
+            if "BrainTuner" in refs and refs & engines:
+                rogue.append(f"{path.name}:{fn.lineno}: {fn.name}")
+    assert not rogue, (
+        "brain tuner e2e drills (BrainTuner + TrainStepBuilder/"
+        "ServingEngine) must be slow-marked (add @pytest.mark.slow or "
+        "a module pytestmark):\n" + "\n".join(rogue)
     )
 
 

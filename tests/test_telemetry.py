@@ -8,6 +8,7 @@ import tracemalloc
 
 import pytest
 
+import dlrover_tpu.cluster.brain  # noqa: F401 — registers TuningPlan/JobMetrics for the schema lint
 from dlrover_tpu.common.constants import GraftEnv
 from dlrover_tpu.observability import telemetry, tracing
 
