@@ -747,6 +747,56 @@ def serving_trajectory_metric(path=None):
     return out
 
 
+def sparse_serving_trajectory_metric(path=None):
+    """The latest SPARSE serving bench's headline numbers, for the train
+    record: QPS at fixed p99 with the tiered hit-rates.
+
+    Same cross-artifact embed as ``serving_trajectory_metric``, but a
+    separate artifact family (``SPARSE_SERVE_*.json``, written by
+    ``bench.py sparse_serve`` with ``DLROVER_TPU_SPARSE_SERVE_ARTIFACT_OUT``)
+    so old ``SERVE_*.json`` artifacts replay byte-for-byte unchanged.
+    Reads ``DLROVER_TPU_SPARSE_SERVE_ARTIFACT``, else the newest
+    ``SPARSE_SERVE_*.json`` beside this file; None when the sparse arm
+    has not been benched."""
+    import glob
+
+    if path is None:
+        path = os.environ.get("DLROVER_TPU_SPARSE_SERVE_ARTIFACT")
+    if path is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        candidates = sorted(
+            glob.glob(os.path.join(here, "SPARSE_SERVE_*.json"))
+        )
+        path = candidates[-1] if candidates else None
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if artifact.get("sparse_qps") is None:
+        return None
+    out = {
+        "sparse_qps": artifact["sparse_qps"],
+        "sparse_p99_ms": artifact.get("sparse_p99_ms"),
+        "sparse_p99_target_ms": artifact.get("sparse_p99_target_ms"),
+        "sparse_p99_met": artifact.get("sparse_p99_met"),
+        "sparse_prefetch_speedup": artifact.get(
+            "sparse_prefetch_speedup"
+        ),
+        "sparse_outputs_exact_equal": artifact.get(
+            "sparse_outputs_exact_equal"
+        ),
+    }
+    tiers = (artifact.get("tiers") or {}).get("prefetch_on") or {}
+    for key in ("hot_hit_rate", "prefetch_coverage",
+                "promote_latency_avg_ms"):
+        if tiers.get(key) is not None:
+            out[f"sparse_{key}"] = tiers[key]
+    return out
+
+
 # fixed per-step host overhead fraction at the hand-tuned batch, for the
 # CPU-side MFU model in the tuned arm: smaller planned batches run more
 # (shorter) steps per token, so the fixed dispatch cost is a larger
@@ -1654,6 +1704,195 @@ def run_serve(name="tiny", n_requests=8, mode="int8", n_slots=4,
     return record
 
 
+class _CalibratedColdStore:
+    """Cold tier with a calibrated per-multi-get stall, modelling a
+    seek-dominated disk / remote store: every batched ``get`` pays one
+    fixed latency regardless of batch size (that amortization is
+    exactly what the lookahead prefetcher buys). Writes pass through
+    unstalled — demotion is off the request path either way."""
+
+    def __init__(self, inner, get_latency_s):
+        self.inner = inner
+        self.get_latency_s = float(get_latency_s)
+        self.width = inner.width
+
+    def get(self, keys):
+        if len(keys):
+            time.sleep(self.get_latency_s)
+        return self.inner.get(keys)
+
+    def put(self, keys, rows, freqs, timestamps):
+        self.inner.put(keys, rows, freqs, timestamps)
+
+    def delete(self, keys):
+        self.inner.delete(keys)
+
+    def flush(self):
+        self.inner.flush()
+
+    def close(self):
+        self.inner.close()
+
+    def __len__(self):
+        return len(self.inner)
+
+
+def run_sparse_serve(n_requests=160, n_fields=8, n_dense=6, emb_dim=16,
+                     id_space=5000, cold_get_latency_ms=8.0,
+                     p99_target_ms=10000.0, seed=0,
+                     prefetch_lookahead=16):
+    """Tiered sparse-embedding serving: request QPS at a fixed p99.
+
+    The recommender scenario (docs/sparse_serving.md): a DeepFM replica
+    scores single requests (``max_batch=1`` — the online-serving
+    arrival model where each request has its own latency budget) whose
+    embedding rows start ENTIRELY in the cold tier behind a calibrated
+    per-multi-get stall. The same seeded trace runs twice — lookahead
+    prefetch OFF (every request faults its rows synchronously, two
+    stalls per request) then ON (the prefetcher peeks the queue and
+    promotes whole lookahead windows off-thread, one stall per window
+    per table) — and the artifact records both QPS-at-p99 numbers, the
+    measured speedup, the tier hit-rate / prefetch-coverage /
+    promotion-latency gauges per arm, and whether the f32 served
+    outputs were exactly equal between the arms (they must be: the
+    tiers move rows, never values)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from dlrover_tpu.models.deepfm import DeepFM, DeepFMConfig
+    from dlrover_tpu.serving.sparse_engine import (
+        SparseServingServer,
+        merged_tier_snapshot,
+        tier_model_tables,
+    )
+    from dlrover_tpu.sparse import GroupAdam
+    from dlrover_tpu.sparse.tiered import TierStats
+
+    far_future = 2**60  # demote-everything cutoff
+    cfg = DeepFMConfig(
+        n_fields=n_fields, n_dense=n_dense, emb_dim=emb_dim,
+        mlp_dims=(32,), seed=seed,
+    )
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(
+        0, id_space, size=(n_requests, n_fields)
+    ).astype(np.int64)
+    dense = rng.normal(size=(n_requests, n_dense)).astype(np.float32)
+    labels = (rng.random(n_requests) < 0.3).astype(np.float32)
+
+    model = DeepFM(cfg, optimizer=GroupAdam(lr=5e-3), dense_lr=5e-3)
+    tmp = tempfile.mkdtemp(prefix="sparse_serve_bench_")
+    try:
+        tiered = tier_model_tables(model, tmp)
+        for _ in range(2):  # create + train every row the trace touches
+            model.train_step(cat, dense, labels)
+        demoted = sum(
+            t.demote_before_timestamp(far_future) for t in tiered
+        )
+        for t in tiered:  # calibrate the cold tier AFTER seeding it
+            t.cold = _CalibratedColdStore(
+                t.cold, cold_get_latency_ms / 1e3
+            )
+
+        def one_pass(prefetch):
+            srv = SparseServingServer(
+                model, cfg, replica="sparse-bench", prefetch=prefetch,
+                prefetch_lookahead=prefetch_lookahead,
+                max_queue=max(1024, 2 * n_requests), max_batch=1,
+            ).start()
+            try:
+                # warmup: first tracing of the eager forward path
+                srv.predict(cat[0], dense[0], timeout=600.0)
+                # restore the fully-cold profile and zero the gauges so
+                # both arms start from the identical tier state
+                for t in tiered:
+                    t.demote_before_timestamp(far_future)
+                    t.stats = TierStats()
+                srv.scheduler.reset_latencies()
+                srv.engine._completed = 0
+                srv.engine._t0 = 0.0
+                t0 = time.perf_counter()
+                futs = [
+                    srv.submit(cat[i], dense[i]).future
+                    for i in range(n_requests)
+                ]
+                scores = np.array(
+                    [f.result(timeout=600.0)[0] for f in futs],
+                    np.float32,
+                )
+                dt = time.perf_counter() - t0
+                lat = srv.scheduler.latency_summary()
+                tiers = merged_tier_snapshot(tiered)
+            finally:
+                srv.stop()
+            qps = n_requests / dt if dt > 0 else 0.0
+            return qps, dt, lat, tiers, scores
+
+        qps_off, dt_off, lat_off, tiers_off, scores_off = one_pass(False)
+        qps_on, dt_on, lat_on, tiers_on, scores_on = one_pass(True)
+    finally:
+        try:
+            model.close()
+        except Exception:  # noqa: BLE001
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    def _tier_block(t):
+        return {
+            "hot_hit_rate": round(float(t["hot_hit_rate"]), 4),
+            "prefetch_coverage": round(
+                float(t["prefetch_coverage"]), 4
+            ),
+            "promote_latency_avg_ms": round(
+                float(t["promote_latency_avg_ms"]), 3
+            ),
+            "cold_faults": int(t["cold_faults"]),
+            "prefetched": int(t["prefetched"]),
+            "hot_rows": int(t["hot_rows"]),
+            "cold_rows": int(t["cold_rows"]),
+        }
+
+    return {
+        "metric": (
+            f"sparse_serve_qps[deepfm{n_fields}x{emb_dim},f32,"
+            f"cold{cold_get_latency_ms:g}ms]"
+        ),
+        "value": round(qps_on, 2),
+        "unit": "requests_per_sec",
+        "sparse_qps": round(qps_on, 2),
+        "sparse_qps_prefetch_off": round(qps_off, 2),
+        "sparse_prefetch_speedup": (
+            round(qps_on / qps_off, 3) if qps_off > 0 else None
+        ),
+        "sparse_p99_ms": round(lat_on["p99"], 2),
+        "sparse_p99_ms_prefetch_off": round(lat_off["p99"], 2),
+        "sparse_p99_target_ms": p99_target_ms,
+        "sparse_p99_met": lat_on["p99"] <= p99_target_ms,
+        "sparse_queue_wait_p99_ms": round(
+            lat_on["queue_wait_p99_ms"], 2
+        ),
+        # the correctness half of the comparison: prefetch moves rows
+        # across tiers, never values — the served scores must match
+        # bitwise between the arms at the same seed
+        "sparse_outputs_exact_equal": bool(
+            np.array_equal(scores_on, scores_off)
+        ),
+        "cold_get_latency_ms": cold_get_latency_ms,
+        "n_requests": n_requests,
+        "demoted_rows": int(demoted),
+        "wall_s": {
+            "prefetch_on": round(dt_on, 4),
+            "prefetch_off": round(dt_off, 4),
+        },
+        "tiers": {
+            "prefetch_on": _tier_block(tiers_on),
+            "prefetch_off": _tier_block(tiers_off),
+        },
+    }
+
+
 def run_config(name, batch, seq, remat, steps=30, warmup=3,
                state_dtype="bfloat16", block_k=1):
     # steps=30: the axon relay's ~100ms host-readback latency is paid
@@ -1835,6 +2074,10 @@ def run_config(name, batch, seq, remat, steps=30, warmup=3,
         # the serving half: tokens/s at fixed p99 from the last
         # `bench.py serve` artifact (None until serving has been benched)
         "serving": serving_trajectory_metric(),
+        # the recommender half: QPS at fixed p99 with tiered hit-rates
+        # from the last `bench.py sparse_serve` artifact (None until the
+        # sparse arm has been benched; old SERVE artifacts are untouched)
+        "sparse_serving": sparse_serving_trajectory_metric(),
         # the brain's cold-start plan for this shape vs the hand-tuned
         # row above, plus the live-refinement reaction time (in-process
         # drill; see tuned_arm_metric)
@@ -1937,6 +2180,20 @@ def main():
             mode=mode, n_requests=n_requests, max_len=max_len
         )
         out = os.environ.get("DLROVER_TPU_SERVE_ARTIFACT_OUT")
+        if out:
+            with open(out, "w") as f:
+                json.dump(record, f)
+        print(json.dumps(record))
+        return
+    if len(sys.argv) >= 2 and sys.argv[1] in (
+        "sparse_serve", "--sparse-serve"
+    ):
+        n_requests = int(sys.argv[2]) if len(sys.argv) > 2 else 160
+        cold_ms = float(sys.argv[3]) if len(sys.argv) > 3 else 8.0
+        record = run_sparse_serve(
+            n_requests=n_requests, cold_get_latency_ms=cold_ms
+        )
+        out = os.environ.get("DLROVER_TPU_SPARSE_SERVE_ARTIFACT_OUT")
         if out:
             with open(out, "w") as f:
                 json.dump(record, f)

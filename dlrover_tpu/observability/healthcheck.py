@@ -96,6 +96,7 @@ def diagnose(records: List, world: int = 0) -> Dict:
         }
 
     serving = _serving_section(by_type)
+    sparse_serving = _sparse_section(by_type)
     scale_decisions = _scale_section(by_type)
     tuning = _tuning_section(by_type)
 
@@ -135,9 +136,56 @@ def diagnose(records: List, world: int = 0) -> Dict:
             for s in by_type.get("HealthSummary", [])
         ],
         "serving": serving,
+        "sparse_serving": sparse_serving,
         "scale_decisions": scale_decisions,
         "tuning": tuning,
         "healthy": not anomalies,
+    }
+
+
+def _sparse_section(by_type: Dict[str, List]) -> Dict:
+    """Roll ``SparseServingRecord`` lines into per-replica tier health:
+    latest window per replica (last record wins — counters are
+    lifetime), plus the fleet's worst hot-hit-rate / prefetch-coverage
+    replica and total PS reshard count. Recordings that predate the
+    sparse serving tier contain no such lines and replay as ``{}`` —
+    absence means "no sparse serving", not an error."""
+    recs = by_type.get("SparseServingRecord", [])
+    if not recs:
+        return {}
+    latest: Dict[str, object] = {}
+    for rec in recs:  # file order == write order; last one wins
+        latest[rec.replica] = rec
+    replicas = {}
+    for name in sorted(latest):
+        r = latest[name]
+        replicas[name] = {
+            "completed": r.completed,
+            "admitted": r.admitted,
+            "qps": r.qps,
+            "p99_ms": r.p99_ms,
+            "hot_hit_rate": r.hot_hit_rate,
+            "prefetch_coverage": r.prefetch_coverage,
+            "promote_latency_avg_ms": r.promote_latency_avg_ms,
+            "cold_faults": r.cold_faults,
+            "prefetched": r.prefetched,
+            "hot_rows": r.hot_rows,
+            "cold_rows": r.cold_rows,
+            "ps_version": r.ps_version,
+            "ps_reshards": r.ps_reshards,
+            "last_reshard_s": r.last_reshard_s,
+        }
+    worst_hit = min(replicas, key=lambda n: replicas[n]["hot_hit_rate"])
+    worst_cov = min(
+        replicas, key=lambda n: replicas[n]["prefetch_coverage"]
+    )
+    return {
+        "replicas": replicas,
+        "worst_hot_hit_replica": worst_hit,
+        "worst_prefetch_coverage_replica": worst_cov,
+        "total_ps_reshards": sum(
+            i["ps_reshards"] for i in replicas.values()
+        ),
     }
 
 
@@ -335,6 +383,27 @@ def format_report(diag: Dict) -> str:
             lines.append(
                 f"  fleet {phase}: p50 {s['p50']:.1f}ms "
                 f"p99 {s['p99']:.1f}ms (n={s['n']})"
+            )
+    sparse = diag.get("sparse_serving") or {}
+    if sparse:
+        lines.append("")
+        lines.append("sparse serving replicas:")
+        for name, info in sparse["replicas"].items():
+            reshard = ""
+            if info["ps_reshards"]:
+                reshard = (
+                    f"; {info['ps_reshards']} PS reshard(s), last "
+                    f"{info['last_reshard_s']:.2f}s "
+                    f"(v{info['ps_version']})"
+                )
+            lines.append(
+                f"  {name}: completed {info['completed']}/"
+                f"{info['admitted']} admitted at {info['qps']:.1f} qps; "
+                f"p99 {info['p99_ms']:.1f}ms; hot hit "
+                f"{info['hot_hit_rate']:.3f}, prefetch coverage "
+                f"{info['prefetch_coverage']:.3f} "
+                f"({info['hot_rows']}/{info['cold_rows']} "
+                f"hot/cold rows){reshard}"
             )
     scale = diag.get("scale_decisions") or {}
     if scale:

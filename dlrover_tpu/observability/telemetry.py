@@ -366,6 +366,62 @@ class ScaleDecisionRecord:
     ts: float = 0.0
 
 
+@telemetry_record
+class SparseServingRecord:
+    """Periodic sparse-serving snapshot (serving/sparse_engine.py).
+
+    The recommendation analog of ``ServingRecord``: one line per
+    publish interval from a replica serving DeepFM predictions over the
+    tiered embedding tier. ``qps`` is completed requests per second
+    since the engine's first step; latency percentiles are the same
+    scheduler histograms the LLM path uses (``hists`` carries the full
+    per-phase envelope for fleet merges).
+
+    Tier gauges (sparse/tiered.py TierStats): ``hot_hit_rate`` is the
+    fraction of gathered keys already resident in the hot KvTable,
+    ``prefetch_coverage`` the fraction of cold promotions done by the
+    lookahead prefetcher instead of synchronously in the request path
+    (1.0 when nothing was cold), ``promote_latency_avg_ms`` the mean
+    cold→hot batch promotion latency, ``cold_faults`` / ``prefetched``
+    / ``demoted`` lifetime key counts, ``hot_rows`` / ``cold_rows``
+    current tier occupancy.
+
+    PS resharding (sparse/server.py + master/elastic_ps.py):
+    ``ps_version`` is the last master server-set version this replica
+    adopted, ``ps_reshards`` how many reshard migrations it executed,
+    ``last_reshard_s`` the most recent pause→resync→resume wall time
+    (the recovery-seconds half of the reshard drill's acceptance bar).
+    Recordings from builds that predate this type simply contain no
+    lines of it — healthcheck replay treats absence as "no sparse
+    serving"."""
+
+    replica: str = ""
+    queue_depth: int = 0
+    admitted: int = 0
+    completed: int = 0
+    re_admitted: int = 0
+    shed: int = 0
+    rejected: int = 0
+    timed_out: int = 0
+    qps: float = 0.0
+    p50_ms: float = 0.0
+    p99_ms: float = 0.0
+    queue_wait_p99_ms: float = 0.0
+    hot_hit_rate: float = 0.0
+    prefetch_coverage: float = 0.0
+    promote_latency_avg_ms: float = 0.0
+    cold_faults: int = 0
+    prefetched: int = 0
+    demoted: int = 0
+    hot_rows: int = 0
+    cold_rows: int = 0
+    ps_version: int = 0
+    ps_reshards: int = 0
+    last_reshard_s: float = 0.0
+    hists: str = ""
+    ts: float = 0.0
+
+
 # ---- sinks ----------------------------------------------------------------
 
 
@@ -446,6 +502,24 @@ _GAUGE_MAP: Dict[str, List[Tuple[str, str]]] = {
         ("autoscale_pool_size", "n_after"),
         ("autoscale_reaction_s", "reaction_s"),
     ],
+    "SparseServingRecord": [
+        ("sparse_serving_qps", "qps"),
+        ("sparse_serving_p50_ms", "p50_ms"),
+        ("sparse_serving_p99_ms", "p99_ms"),
+        ("sparse_serving_queue_depth", "queue_depth"),
+        ("sparse_serving_queue_wait_p99_ms", "queue_wait_p99_ms"),
+        ("sparse_hot_hit_rate", "hot_hit_rate"),
+        ("sparse_prefetch_coverage", "prefetch_coverage"),
+        ("sparse_promote_latency_avg_ms", "promote_latency_avg_ms"),
+        ("sparse_cold_faults", "cold_faults"),
+        ("sparse_prefetched", "prefetched"),
+        ("sparse_demoted", "demoted"),
+        ("sparse_hot_rows", "hot_rows"),
+        ("sparse_cold_rows", "cold_rows"),
+        ("sparse_ps_version", "ps_version"),
+        ("sparse_ps_reshards", "ps_reshards"),
+        ("sparse_last_reshard_s", "last_reshard_s"),
+    ],
     # cluster/brain.py records (registered on brain import)
     "TuningPlan": [
         ("tuning_version", "version"),
@@ -467,6 +541,7 @@ _COUNTER_MAP: Dict[str, str] = {
     "AnomalyRecord": "anomaly_records_total",
     "HealthSummary": "health_summaries_total",
     "ServingRecord": "serving_records_total",
+    "SparseServingRecord": "sparse_serving_records_total",
     "ScaleDecisionRecord": "scale_decisions_total",
     "TuningPlan": "tuning_plans_total",
     "JobMetrics": "brain_job_metrics_total",

@@ -16,6 +16,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 BLOCK = 256
@@ -209,6 +210,30 @@ def kv_decode_rows(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     Dequantizes in f32 then casts to ``dtype`` (the model compute dtype)
     — the per-page dequant that runs INSIDE the jitted decode step."""
     out = _dequant_blocks(q, scale[..., None], 8)
+    *lead, nb, blk = out.shape
+    return out.reshape(*lead, nb * blk).astype(dtype)
+
+
+def kv_encode_rows_np(rows: np.ndarray, block: int):
+    """Host-side ``kv_encode_rows``: numpy in, numpy out.
+
+    Same per-block max/127 scheme, for row stores that live outside jit
+    (the tiered cold/warm tier keeps resident rows in this encoding)."""
+    rows = np.asarray(rows, np.float32)
+    *lead, n = rows.shape
+    if n % block:
+        raise ValueError(f"row width {n} not a multiple of block {block}")
+    blocks = rows.reshape(*lead, n // block, block)
+    scale = np.max(np.abs(blocks), axis=-1, keepdims=True) / 127.0
+    scale = np.maximum(scale, 1e-12)
+    q = np.clip(np.rint(blocks / scale), -127, 127).astype(np.int8)
+    return q, scale[..., 0].astype(np.float32)
+
+
+def kv_decode_rows_np(q: np.ndarray, scale: np.ndarray,
+                      dtype=np.float32) -> np.ndarray:
+    """Inverse of ``kv_encode_rows_np``: ``[..., nb, block]`` → ``[..., n]``."""
+    out = q.astype(np.float32) * scale[..., None]
     *lead, nb, blk = out.shape
     return out.reshape(*lead, nb * blk).astype(dtype)
 

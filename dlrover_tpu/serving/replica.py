@@ -66,12 +66,17 @@ class ServingReplica:
         master_addr: Optional[str] = None,
         node_id: int = 0,
         hub=None,
+        server_cls: type = GenerationServer,
         **server_kw,
     ):
         self.name = name
         self.node_id = node_id
         self.master_addr = master_addr
-        self.server = GenerationServer(
+        # server_cls swaps the front end while keeping the master-plane
+        # plumbing: the sparse recommendation server
+        # (serving/sparse_engine.SparseServingServer) registers through
+        # the same node/KV path, role-tagged "recommend"
+        self.server = server_cls(
             params, cfg, hub=hub, replica=name, **server_kw
         )
         self._client = None

@@ -388,6 +388,19 @@ class Scheduler:
         with self._lock:
             return len(self._heap)
 
+    def peek(self, n: int = 1) -> List[Request]:
+        """Non-destructive head-of-line peek: the next ``n`` requests in
+        pop order, skipping cancelled/resolved entries. The lookahead
+        prefetcher reads queued prompts here to warm caches (tiered
+        embedding rows) before the engine pops them; the queue itself is
+        untouched."""
+        with self._lock:
+            return [
+                t[-1]
+                for t in heapq.nsmallest(max(int(n), 0), self._heap)
+                if not t[-1].future.done()
+            ]
+
     def record_first_token(self, req: Request) -> None:
         """Stamp TTFT once per request — a re-prefilled failover does
         not reset the clock the user has been watching since submit."""

@@ -51,7 +51,9 @@ class GenerationServer:
         self.scheduler = Scheduler(
             max_queue=max_queue, hub=hub, replica=replica
         )
-        self.engine = ServingEngine(params, cfg, self.scheduler, **engine_kw)
+        self.engine = self._build_engine(
+            params, cfg, self.scheduler, **engine_kw
+        )
         # optional SLO watchdog (observability/watchdog.ServingWatchdog):
         # observed per published record; its capture snapshot defaults
         # to this engine's frozen observability state
@@ -72,6 +74,12 @@ class GenerationServer:
         self._pause_lock = threading.Lock()   # serializes paused() users
         self._pause_req = threading.Event()   # ask the loop to park
         self._pause_ack = threading.Event()   # loop parked at a boundary
+
+    def _build_engine(self, params, cfg, scheduler, **engine_kw):
+        """Engine factory hook: subclasses (serving/sparse_engine.py's
+        recommendation server) swap the engine while inheriting the
+        loop, pause protocol, and drain semantics unchanged."""
+        return ServingEngine(params, cfg, scheduler, **engine_kw)
 
     # ---- lifecycle -------------------------------------------------------
 

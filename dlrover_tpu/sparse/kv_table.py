@@ -311,6 +311,11 @@ class SparseOptimizer:
               now_ts: Optional[int] = None) -> int:
         """Apply one update. Duplicate keys must be pre-combined
         (segment-sum) by the caller; EmbeddingCollection does this."""
+        if hasattr(table, "begin_update") and hasattr(table, "hot"):
+            # TieredTable: promote cold rows and fence cross-tier moves
+            # so the native apply below lands on the real hot rows
+            table.begin_update(keys, now_ts)
+            table = table.hot
         if table.n_slots < self.required_slots:
             raise ValueError(
                 f"{self._kind} needs {self.required_slots} slots; table "

@@ -539,10 +539,20 @@ class DistributedEmbedding:
         return moved
 
     def _migrate(self, old_names, new, new_weights) -> int:
+        """Two-phase key-range move, torn-transfer atomic: every
+        owner-changed range is COPIED (export → checksummed-wire import)
+        first, and sources are deleted only after every copy landed. A
+        failure mid-copy raises with all sources intact and the old
+        ring still routing — no row is lost, and a retried
+        ``set_servers`` re-exports the still-authoritative sources
+        (overwriting any partial dst copies with current rows). A
+        failure mid-delete leaves at worst an orphaned src copy behind
+        a ring that already routes to dst."""
         new_names = sorted(new)
         moved_total = 0
         # connect new servers early (they must accept imports)
         all_servers = dict(self._servers, **new)
+        pending_deletes: List[Tuple[str, str, np.ndarray]] = []
         for table in self.specs:
             live: Dict[str, np.ndarray] = {}
             for s in old_names:
@@ -579,8 +589,10 @@ class DistributedEmbedding:
                 self._clients[dst].import_rows(
                     table, karr, rows, freqs, ts
                 )
-                self._client(src).delete(table, karr)
+                pending_deletes.append((src, table, karr))
                 moved_total += len(keys)
+        for src, table, karr in pending_deletes:
+            self._client(src).delete(table, karr)
         return moved_total
 
     def table_width(self, table: str) -> int:

@@ -9,14 +9,25 @@ Here: ``TieredTable`` = hot C++ KvTable (sparse/kv_table.py) + a cold
 tier behind the same narrow interface. Cold keys (stale by timestamp or
 below a frequency floor) are demoted out of RAM; a lookup that misses hot
 faults the rows back in (with their frequency/timestamp history). The
-shipped cold tier is an npz-file store; anything with
+shipped cold tier is an append-logged npz store; anything with
 put/get/delete/keys (e.g. an object store) slots in.
+
+Concurrency model (the promotion-epoch design): the native table takes
+per-shard reader locks, so gathers on resident keys run concurrently
+with no Python lock at all. Only cross-tier moves serialize, and only
+per key: the first thread to fault a key claims it in ``_inflight``;
+racers wait on that key's event and re-check residency, so a hot batch
+of requests for the same cold key costs one disk read, and requests for
+disjoint keys never contend. Demotion claims keys the same way, making
+the move (cold.put → hot.delete) atomic against concurrent faults. Each
+completed cross-tier batch bumps ``promotion_epoch``.
 """
 
 import os
+import struct
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
@@ -43,39 +54,135 @@ class ColdStore:
         raise NotImplementedError
 
 
-class FileColdStore(ColdStore):
-    """npz-backed cold tier: one directory, periodically compacted."""
+# append-log record header: op (P=put, D=delete), key, freq, ts.
+# Puts are followed by width f32 row bytes; a torn tail record (crash
+# mid-append) is detected by the short read and dropped on replay.
+_WAL_HEADER = struct.Struct("<cqII")
 
-    def __init__(self, path: str, width: int, flush_every: int = 1):
-        """``flush_every``: serialize to disk every N mutations (each
-        flush rewrites the whole store — raise this for large cold tiers
-        and call flush() at checkpoint boundaries)."""
+
+class FileColdStore(ColdStore):
+    """npz-backed cold tier with an append log.
+
+    Mutations append fixed-size records to ``wal.log`` (one buffered
+    write per batch); every ``flush_every`` mutation batches the store
+    compacts — base ``cold.npz`` rewritten atomically via tmp+rename,
+    log truncated. Restart replays base + log, so durability no longer
+    requires rewriting the whole npz per mutation.
+
+    ``codec="int8"`` stores resident rows block-scaled int8 (the EQuARX
+    scheme from ops/quant.py) for a ~4x resident-bytes cut; the default
+    ``"f32"`` path is exact. The on-disk base npz stays f32 either way,
+    so stores written by older versions load unchanged.
+    """
+
+    def __init__(self, path: str, width: int, flush_every: int = 256,
+                 codec: str = "f32"):
+        """``flush_every``: compact to the base npz every N mutation
+        batches. Appends between compactions are cheap; call flush() at
+        checkpoint boundaries for a clean base file."""
+        if codec not in ("f32", "int8"):
+            raise ValueError(f"codec must be 'f32' or 'int8', got {codec!r}")
         self.path = path
         self.width = width
         self.flush_every = max(1, flush_every)
+        self.codec = codec
         self._mutations = 0
         os.makedirs(path, exist_ok=True)
         self._lock = threading.Lock()
-        # in-process index over the on-disk rows
-        self._rows: Dict[int, Tuple[np.ndarray, int, int]] = {}
+        if codec == "int8":
+            from dlrover_tpu.ops.quant import kv_block_size
+
+            self._block = kv_block_size(width)
+        else:
+            self._block = 0
+        # in-process index over the on-disk rows:
+        #   f32  -> key: (row f32 [width], freq, ts)
+        #   int8 -> key: (q int8 [nb, block], scale f32 [nb], freq, ts)
+        self._rows: Dict[int, Tuple] = {}
+        self._wal = None
         self._load()
+        self._wal = open(self._wal_file(), "ab")
 
     def _file(self) -> str:
         return os.path.join(self.path, "cold.npz")
 
+    def _wal_file(self) -> str:
+        return os.path.join(self.path, "wal.log")
+
+    # ---- codec ----------------------------------------------------------
+
+    def _encode(self, rows: np.ndarray):
+        """f32 [n, width] → list of per-key stored payloads."""
+        if self.codec == "f32":
+            return [rows[i] for i in range(rows.shape[0])]
+        from dlrover_tpu.ops.quant import kv_encode_rows_np
+
+        q, scale = kv_encode_rows_np(rows, self._block)
+        return [(q[i], scale[i]) for i in range(rows.shape[0])]
+
+    def _decode_batch(self, payloads) -> np.ndarray:
+        """list of stored payloads → f32 [n, width] in one batched call."""
+        if not payloads:
+            return np.empty((0, self.width), np.float32)
+        if self.codec == "f32":
+            return np.stack(payloads)
+        from dlrover_tpu.ops.quant import kv_decode_rows_np
+
+        q = np.stack([p[0] for p in payloads])
+        scale = np.stack([p[1] for p in payloads])
+        return kv_decode_rows_np(q, scale)
+
+    # ---- load / flush ----------------------------------------------------
+
     def _load(self):
         f = self._file()
-        if not os.path.exists(f):
+        if os.path.exists(f):
+            with np.load(f) as z:
+                rows = np.ascontiguousarray(z["values"], np.float32)
+                payloads = self._encode(rows)
+                for key, payload, fr, t in zip(
+                    z["keys"], payloads, z["freqs"], z["ts"]
+                ):
+                    self._rows[int(key)] = (payload, int(fr), int(t))
+        self._replay_wal()
+
+    def _replay_wal(self):
+        w = self._wal_file()
+        if not os.path.exists(w):
             return
-        with np.load(f) as z:
-            for key, row, fr, t in zip(
-                z["keys"], z["values"], z["freqs"], z["ts"]
-            ):
-                self._rows[int(key)] = (row, int(fr), int(t))
+        row_bytes = self.width * 4
+        with open(w, "rb") as fh:
+            data = fh.read()
+        off, n = 0, len(data)
+        applied = 0
+        while off + _WAL_HEADER.size <= n:
+            op, key, fr, t = _WAL_HEADER.unpack_from(data, off)
+            off += _WAL_HEADER.size
+            if op == b"P":
+                if off + row_bytes > n:
+                    break  # torn tail record
+                row = np.frombuffer(
+                    data, np.float32, self.width, off
+                ).copy()
+                off += row_bytes
+                self._rows[int(key)] = (
+                    self._encode(row[None, :])[0], int(fr), int(t)
+                )
+            elif op == b"D":
+                self._rows.pop(int(key), None)
+            else:
+                break  # corrupt record; everything before it applied
+            applied += 1
+        if applied:
+            logger.info("replayed %d cold-store log records", applied)
+
+    def _append_wal(self, chunks: Iterable[bytes]):
+        self._wal.write(b"".join(chunks))
+        self._wal.flush()
 
     def _flush(self):
         keys = np.array(sorted(self._rows), dtype=np.int64)
-        values = np.stack(
+        values = self._decode_batch(
             [self._rows[int(k)][0] for k in keys]
         ) if len(keys) else np.empty((0, self.width), np.float32)
         freqs = np.array(
@@ -86,6 +193,10 @@ class FileColdStore(ColdStore):
         tmp = os.path.join(self.path, "cold_tmp.npz")
         np.savez(tmp, keys=keys, values=values, freqs=freqs, ts=ts)
         os.replace(tmp, self._file())
+        # base now holds everything; a crash before the truncate just
+        # replays already-applied records (puts/deletes are idempotent)
+        self._wal.close()
+        self._wal = open(self._wal_file(), "wb")
 
     def _maybe_flush(self):
         self._mutations += 1
@@ -98,14 +209,31 @@ class FileColdStore(ColdStore):
             self._flush()
             self._mutations = 0
 
-    def put(self, keys, values, freqs, ts) -> None:
+    def close(self):
         with self._lock:
-            for k, row, fr, t in zip(keys, values, freqs, ts):
-                self._rows[int(k)] = (
-                    np.asarray(row, np.float32),
-                    int(fr),
-                    int(t),
+            self._flush()
+            self._wal.close()
+            self._wal = None
+
+    # ---- mutation --------------------------------------------------------
+
+    def put(self, keys, values, freqs, ts) -> None:
+        keys = np.asarray(keys, np.int64)
+        rows = np.ascontiguousarray(values, np.float32).reshape(
+            keys.size, self.width
+        )
+        freqs = np.asarray(freqs, np.uint32)
+        ts = np.asarray(ts, np.uint32)
+        with self._lock:
+            payloads = self._encode(rows)
+            chunks = []
+            for i, k in enumerate(keys.tolist()):
+                self._rows[k] = (payloads[i], int(freqs[i]), int(ts[i]))
+                chunks.append(
+                    _WAL_HEADER.pack(b"P", k, int(freqs[i]), int(ts[i]))
                 )
+                chunks.append(rows[i].tobytes())
+            self._append_wal(chunks)
             self._maybe_flush()
 
     def get(self, keys):
@@ -115,29 +243,98 @@ class FileColdStore(ColdStore):
         freqs = np.zeros(keys.size, np.uint32)
         ts = np.zeros(keys.size, np.uint32)
         with self._lock:
+            hit_idx, payloads = [], []
             for i, k in enumerate(keys.tolist()):
                 hit = self._rows.get(k)
                 if hit is not None:
-                    found[i] = True
-                    values[i], freqs[i], ts[i] = hit
+                    hit_idx.append(i)
+                    payloads.append(hit[0])
+                    freqs[i], ts[i] = hit[1], hit[2]
+            if hit_idx:
+                found[hit_idx] = True
+                values[hit_idx] = self._decode_batch(payloads)
         return found, values, freqs, ts
 
     def delete(self, keys) -> None:
+        keys = np.asarray(keys, np.int64)
         with self._lock:
-            for k in np.asarray(keys, np.int64).tolist():
-                self._rows.pop(k, None)
-            self._maybe_flush()
+            chunks = []
+            for k in keys.tolist():
+                if self._rows.pop(k, None) is not None:
+                    chunks.append(_WAL_HEADER.pack(b"D", k, 0, 0))
+            if chunks:
+                self._append_wal(chunks)
+                self._maybe_flush()
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._rows)
+
+    @property
+    def resident_bytes(self) -> int:
+        """RAM held by row payloads (the codec's measurable win)."""
+        with self._lock:
+            if self.codec == "f32":
+                return sum(p.nbytes for p, _, _ in self._rows.values())
+            return sum(
+                p[0].nbytes + p[1].nbytes for p, _, _ in self._rows.values()
+            )
+
+
+class TierStats:
+    """Cross-tier counters for the serving gauges (all cumulative)."""
+
+    __slots__ = (
+        "_lock", "gathered", "hot_hits", "cold_faults", "prefetched",
+        "inserted", "demoted", "promote_batches", "promote_time_s",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.gathered = 0        # keys seen by gather/scatter
+        self.hot_hits = 0        # keys already resident
+        self.cold_faults = 0     # keys promoted synchronously in-request
+        self.prefetched = 0      # keys promoted by the prefetcher
+        self.inserted = 0        # keys in neither tier (fresh inits)
+        self.demoted = 0
+        self.promote_batches = 0
+        self.promote_time_s = 0.0
+
+    def add(self, **deltas):
+        with self._lock:
+            for name, d in deltas.items():
+                setattr(self, name, getattr(self, name) + d)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            looked_up = max(1, self.gathered)
+            promoted = self.cold_faults + self.prefetched
+            return {
+                "gathered": self.gathered,
+                "hot_hits": self.hot_hits,
+                "cold_faults": self.cold_faults,
+                "prefetched": self.prefetched,
+                "inserted": self.inserted,
+                "demoted": self.demoted,
+                "promote_batches": self.promote_batches,
+                "promote_time_s": self.promote_time_s,
+                "hot_hit_rate": self.hot_hits / looked_up,
+                "prefetch_coverage": (
+                    self.prefetched / promoted if promoted else 1.0
+                ),
+                "promote_latency_avg_ms": (
+                    1e3 * self.promote_time_s / self.promote_batches
+                    if self.promote_batches else 0.0
+                ),
+            }
 
 
 class TieredTable:
     """Hot KvTable + cold store, one lookup surface.
 
     Reference: hybrid_embedding TableManager/EVContext — callers see one
-    table; the manager decides the tier.
+    table; the manager decides the tier. See the module docstring for
+    the promotion-epoch concurrency model.
     """
 
     def __init__(self, table: KvTable, cold: ColdStore):
@@ -152,52 +349,136 @@ class TieredTable:
                 f"{table.width} (= (1 + n_slots) * dim — exported rows "
                 "include optimizer slots)"
             )
-        # one coarse lock: demote/promote are multi-step cross-tier moves;
-        # a concurrent scatter in the middle would be silently lost
-        self._lock = threading.Lock()
+        # guards the per-key claim map and the stale-candidate ring; no
+        # I/O ever runs under it
+        self._fault_lock = threading.Lock()
+        self._inflight: Dict[int, threading.Event] = {}
+        # key -> last touch timestamp seen through this surface. The
+        # incremental-demotion candidate ring: a sweep scans this dict
+        # (O(hot) dict reads, no row I/O) and touches rows only for keys
+        # whose recorded touch is already stale — O(stale) row work
+        # instead of a full-table export.
+        self._candidates: Dict[int, int] = {}
+        self._epoch = 0
+        self.stats = TierStats()
+
+    @property
+    def promotion_epoch(self) -> int:
+        """Bumped once per completed cross-tier batch (promote/demote)."""
+        return self._epoch
 
     # ---- lookups (fault cold rows back into the hot tier) ---------------
 
     def gather_or_insert(self, keys, now_ts: Optional[int] = None):
         keys = np.asarray(keys, np.int64)
-        with self._lock:
-            self._promote_missing(keys, now_ts)
-            return self.hot.gather_or_insert(keys, now_ts=now_ts)
+        self._fault_in(keys, now_ts)
+        rows = self.hot.gather_or_insert(keys, now_ts=now_ts)
+        self._record_touch(keys, now_ts)
+        return rows
 
     def gather_or_zeros(self, keys):
         keys = np.asarray(keys, np.int64)
-        with self._lock:
-            self._promote_missing(keys, None)
-            return self.hot.gather_or_zeros(keys)
+        self._fault_in(keys, None)
+        return self.hot.gather_or_zeros(keys)
 
-    def _promote_missing(self, keys, now_ts):
-        # a key that is in NEITHER tier is genuinely new; one that is only
-        # cold must come back hot with its history intact. "Missing from
-        # hot" = frequency 0 AND timestamp 0: freq alone is not enough
-        # because rows created via insert()/scatter() never bump it, and
-        # overwriting such a fresh row with a stale cold copy loses data
+    def prefetch(self, keys, now_ts: Optional[int] = None) -> int:
+        """Promote any cold ``keys`` ahead of demand (the prefetcher's
+        entry point). Resident keys are a metadata check only; returns
+        the number of rows actually promoted."""
+        keys = np.asarray(keys, np.int64)
+        return self._fault_in(keys, now_ts, prefetch=True)
+
+    def _residency(self, keys):
+        # "missing from hot" = frequency 0 AND timestamp 0: freq alone is
+        # not enough because rows created via insert()/scatter() never
+        # bump it, and overwriting such a fresh row with a stale cold
+        # copy loses data
         freqs = self.hot.frequency(keys)
         ts = self.hot.timestamp(keys)
-        miss = keys[(freqs == 0) & (ts == 0)]
-        if miss.size == 0:
-            return
-        found, values, cfreqs, cts = self.cold.get(miss)
-        if not found.any():
-            return
-        fault = miss[found]
-        self.hot.import_(
-            fault,
-            values[found],
-            cfreqs[found],
-            np.full(
-                fault.size,
-                now_ts if now_ts is not None else int(time.time()),
-                np.uint32,
-            ),
-            mark_dirty=True,
+        return (freqs != 0) | (ts != 0)
+
+    def _fault_in(self, keys, now_ts, prefetch: bool = False) -> int:
+        """Promote the cold subset of ``keys``; first fault per key
+        serializes, racers wait on the claimant's event."""
+        resident = self._residency(keys)
+        if not prefetch:
+            self.stats.add(
+                gathered=int(keys.size), hot_hits=int(resident.sum())
+            )
+        miss = np.unique(keys[~resident])
+        promoted = 0
+        while miss.size:
+            claimed, waiters = [], []
+            with self._fault_lock:
+                for k in miss.tolist():
+                    ev = self._inflight.get(k)
+                    if ev is None:
+                        mine = threading.Event()
+                        self._inflight[k] = mine
+                        claimed.append((k, mine))
+                    else:
+                        waiters.append(ev)
+            if claimed:
+                promoted += self._promote_claimed(claimed, now_ts, prefetch)
+            if not waiters:
+                break
+            for ev in waiters:
+                ev.wait()
+            # a waited-on key was mid-promotion (now resident) or
+            # mid-demotion (now cold: fault it ourselves) — re-check
+            miss = np.unique(miss[~self._residency(miss)])
+        return promoted
+
+    def _promote_claimed(self, claimed, now_ts, prefetch: bool) -> int:
+        """One batched cold multi-get + hot import for claimed keys."""
+        ckeys = np.array([k for k, _ in claimed], np.int64)
+        t0 = time.monotonic()
+        promoted = 0
+        try:
+            found, values, cfreqs, cts = self.cold.get(ckeys)
+            if found.any():
+                fault = ckeys[found]
+                self.hot.import_(
+                    fault,
+                    values[found],
+                    cfreqs[found],
+                    np.full(
+                        fault.size,
+                        now_ts if now_ts is not None else int(time.time()),
+                        np.uint32,
+                    ),
+                    mark_dirty=True,
+                )
+                self.cold.delete(fault)
+                promoted = int(fault.size)
+                # promoted rows enter the touch ring here: frozen
+                # gathers (the serve path) never record touches, and a
+                # key absent from the ring is invisible to the
+                # incremental demotion sweep — it could never spill back
+                self._record_touch(fault, now_ts)
+                logger.debug("promoted %d cold keys", promoted)
+        finally:
+            with self._fault_lock:
+                self._epoch += 1
+                for k, ev in claimed:
+                    self._inflight.pop(k, None)
+                    ev.set()
+        if prefetch:
+            self.stats.add(prefetched=promoted)
+        else:
+            self.stats.add(
+                cold_faults=promoted,
+                inserted=len(claimed) - promoted,
+            )
+        self.stats.add(
+            promote_batches=1, promote_time_s=time.monotonic() - t0
         )
-        self.cold.delete(fault)
-        logger.debug("promoted %d cold keys", fault.size)
+        return promoted
+
+    def _record_touch(self, keys, now_ts):
+        t = now_ts if now_ts is not None else int(time.time())
+        with self._fault_lock:
+            self._candidates.update(dict.fromkeys(keys.tolist(), t))
 
     # ---- demotion (the TTL path, but spill instead of drop) --------------
 
@@ -206,34 +487,115 @@ class TieredTable:
 
         Same predicate as KvTable.delete_before_timestamp (TTL eviction),
         but the rows survive — the hybrid-storage behavior the reference's
-        interface exists for.
+        interface exists for. Incremental: candidates come from the
+        touch ring, so the sweep reads rows for O(stale) keys only; it
+        never exports the hot table.
         """
-        with self._lock:
-            keys, values, freqs, kts = self.hot.export(
-                delta_only=False, clear_dirty=False
+        with self._fault_lock:
+            cand = [k for k, rec in self._candidates.items() if rec < ts]
+        if not cand:
+            return 0
+        karr = np.array(cand, np.int64)
+        # verify against live metadata: keys touched out-of-band (direct
+        # hot-table writes) stay, with the ring re-synced to reality
+        kts = self.hot.timestamp(karr)
+        kfr = self.hot.frequency(karr)
+        resident = (kts != 0) | (kfr != 0)
+        stale_mask = resident & (kts < ts)
+        with self._fault_lock:
+            for k in karr[~resident].tolist():
+                self._candidates.pop(k, None)
+            for k, t in zip(
+                karr[resident & ~stale_mask].tolist(),
+                kts[resident & ~stale_mask].tolist(),
+            ):
+                self._candidates[k] = int(t)
+            # claim the stale keys so concurrent faults wait out the
+            # move; keys already inflight (being promoted right now) are
+            # clearly live — skip them this sweep
+            claimed = []
+            for i in np.flatnonzero(stale_mask).tolist():
+                k = int(karr[i])
+                if k in self._inflight:
+                    stale_mask[i] = False
+                    continue
+                ev = threading.Event()
+                self._inflight[k] = ev
+                claimed.append((k, ev))
+        if not claimed:
+            return 0
+        # re-verify after claiming: a touch that raced the scan above
+        # (scatter records its candidate entry before writing) wins
+        skeys = np.array([k for k, _ in claimed], np.int64)
+        with self._fault_lock:
+            fresh = np.array(
+                [self._candidates.get(int(k), 0) >= ts for k in skeys],
+                bool,
             )
-            stale = kts < ts
-            if not stale.any():
-                return 0
-            self.cold.put(
-                keys[stale], values[stale], freqs[stale], kts[stale]
-            )
-            self.hot.delete(keys[stale])
-        logger.info("demoted %d keys to cold tier", int(stale.sum()))
-        return int(stale.sum())
+        live = skeys[~fresh]
+        try:
+            if live.size:
+                rows = self.hot.gather_full(live)
+                idx = {int(k): i for i, k in enumerate(karr.tolist())}
+                sel = np.array([idx[int(k)] for k in live.tolist()])
+                self.cold.put(live, rows, kfr[sel], kts[sel])
+                self.hot.delete(live)
+        finally:
+            live_set = {int(x) for x in live.tolist()}
+            with self._fault_lock:
+                self._epoch += 1
+                for k, ev in claimed:
+                    if k in live_set:
+                        self._candidates.pop(k, None)
+                    self._inflight.pop(k, None)
+                    ev.set()
+        moved = int(live.size)
+        self.stats.add(demoted=moved)
+        if moved:
+            logger.info("demoted %d keys to cold tier", moved)
+        return moved
 
     # ---- passthroughs -----------------------------------------------------
 
+    def begin_update(self, keys, now_ts: Optional[int] = None) -> np.ndarray:
+        """Make ``keys`` safely writable in the hot tier: promote any
+        cold rows (a cold key's update must land on its real row, not a
+        fresh init row) and wait out in-flight cross-tier moves. The
+        touch is recorded BEFORE writing: a demotion sweep that claims
+        these keys after this point re-reads the ring post-claim, sees
+        them fresh, and backs off — the update cannot be spilled stale.
+        Writers (scatter, the sparse optimizers) call this, then hit
+        ``hot`` directly."""
+        keys = np.asarray(keys, np.int64)
+        self._record_touch(keys, now_ts)
+        while True:
+            self._fault_in(keys, now_ts)
+            with self._fault_lock:
+                pending = [
+                    self._inflight[k]
+                    for k in np.unique(keys).tolist()
+                    if k in self._inflight
+                ]
+            if not pending:
+                break
+            for ev in pending:
+                ev.wait()
+        return keys
+
     def scatter(self, keys, updates, *a, **kw):
-        # promote first: a cold key's gradient update must land on its
-        # real row, not a fresh init row — and without promotion the next
-        # gather would overwrite the update with the stale cold copy
-        with self._lock:
-            self._promote_missing(np.asarray(keys, np.int64), None)
-            return self.hot.scatter(keys, updates, *a, **kw)
+        keys = self.begin_update(keys, kw.get("now_ts"))
+        return self.hot.scatter(keys, updates, *a, **kw)
 
     def __len__(self) -> int:
         return len(self.hot) + len(self.cold)
+
+    def close(self):
+        self.hot.close()
+        flush = getattr(self.cold, "close", None) or getattr(
+            self.cold, "flush", None
+        )
+        if flush is not None:
+            flush()
 
     @property
     def hot_size(self) -> int:

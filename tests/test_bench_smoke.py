@@ -318,6 +318,106 @@ def test_bench_serve_mode_emits_schema():
     assert rec["migration_recovery_s"] > 0
 
 
+@pytest.mark.slow  # tier-1 budget: full subprocess bench run; schema readers stay fast
+def test_bench_sparse_serve_mode_emits_schema():
+    """`bench.py sparse_serve` is the recommender half of the serving
+    trajectory: request QPS at a fixed p99 over the tiered embedding
+    stack, prefetch-on vs prefetch-off at the same seed. The acceptance
+    bar rides in the artifact: the lookahead prefetcher must be worth
+    >= 2x QPS at the calibrated cold-tier profile, and the f32 served
+    outputs must be exactly equal between the arms."""
+    out = _run(["sparse_serve", "80", "8"], timeout=540)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["unit"] == "requests_per_sec"
+    assert rec["sparse_qps"] > 0
+    assert rec["sparse_qps_prefetch_off"] > 0
+    assert rec["sparse_prefetch_speedup"] >= 2.0
+    assert rec["sparse_p99_ms"] > 0
+    assert rec["sparse_p99_target_ms"] > 0
+    assert rec["sparse_p99_met"] is True
+    # correctness half: prefetch moves rows between tiers, never values
+    assert rec["sparse_outputs_exact_equal"] is True
+    tiers = rec["tiers"]
+    on, off = tiers["prefetch_on"], tiers["prefetch_off"]
+    # calibrated profile: the off arm faulted essentially everything in
+    # the request path; the on arm's prefetcher absorbed most of it
+    assert off["cold_faults"] > 0 and off["prefetch_coverage"] == 0.0
+    assert on["prefetched"] > 0
+    assert on["prefetch_coverage"] > 0.5
+    assert on["hot_hit_rate"] > off["hot_hit_rate"]
+    assert 0.0 <= on["hot_hit_rate"] <= 1.0
+    assert on["promote_latency_avg_ms"] >= 0
+    # both arms served the whole trace out of the same row population
+    assert rec["demoted_rows"] > 0
+    assert on["hot_rows"] == off["hot_rows"]
+
+
+def test_sparse_serving_trajectory_metric_reads_artifact(
+    tmp_path, monkeypatch
+):
+    """The train record embeds the last sparse-serving bench's
+    QPS-at-p99 + tier gauges from its own SPARSE_SERVE_*.json artifact
+    family — old SERVE_*.json artifacts replay byte-for-byte unchanged
+    (pinned in test_serving_trajectory_metric_reads_artifact)."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_REPO)
+    p = tmp_path / "SPARSE_SERVE_test.json"
+    p.write_text(json.dumps({
+        "sparse_qps": 310.5,
+        "sparse_p99_ms": 240.0,
+        "sparse_p99_target_ms": 10000.0,
+        "sparse_p99_met": True,
+        "sparse_prefetch_speedup": 7.1,
+        "sparse_outputs_exact_equal": True,
+        "tiers": {"prefetch_on": {
+            "hot_hit_rate": 0.97, "prefetch_coverage": 0.98,
+            "promote_latency_avg_ms": 9.2,
+        }},
+    }))
+    got = bench.sparse_serving_trajectory_metric(str(p))
+    assert got == {
+        "sparse_qps": 310.5,
+        "sparse_p99_ms": 240.0,
+        "sparse_p99_target_ms": 10000.0,
+        "sparse_p99_met": True,
+        "sparse_prefetch_speedup": 7.1,
+        "sparse_outputs_exact_equal": True,
+        "sparse_hot_hit_rate": 0.97,
+        "sparse_prefetch_coverage": 0.98,
+        "sparse_promote_latency_avg_ms": 9.2,
+    }
+    monkeypatch.setenv("DLROVER_TPU_SPARSE_SERVE_ARTIFACT", str(p))
+    assert bench.sparse_serving_trajectory_metric()["sparse_qps"] == \
+        pytest.approx(310.5)
+    # a tiers-less artifact projects only the headline block
+    bare = tmp_path / "SPARSE_SERVE_bare.json"
+    bare.write_text(json.dumps({"sparse_qps": 100.0}))
+    got_bare = bench.sparse_serving_trajectory_metric(str(bare))
+    assert got_bare["sparse_qps"] == pytest.approx(100.0)
+    assert "sparse_hot_hit_rate" not in got_bare
+    # missing/corrupt/unmeasured artifacts degrade to None
+    assert bench.sparse_serving_trajectory_metric(
+        str(tmp_path / "nope.json")
+    ) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bench.sparse_serving_trajectory_metric(str(bad)) is None
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"sparse_qps": None}))
+    assert bench.sparse_serving_trajectory_metric(str(empty)) is None
+    # an old SERVE artifact is NOT a sparse artifact: the reader wants
+    # the sparse headline and degrades to None rather than projecting
+    old_serve = tmp_path / "SERVE_old.json"
+    old_serve.write_text(json.dumps({
+        "serve_tokens_per_s": 123.4, "serve_p99_ms": 80.5,
+    }))
+    assert bench.sparse_serving_trajectory_metric(str(old_serve)) is None
+
+
 def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
     """The train bench record embeds the last serving bench's
     tokens/s-at-p99 (same cross-artifact pattern as the drill metric)."""
@@ -427,6 +527,10 @@ def test_serving_trajectory_metric_reads_artifact(tmp_path, monkeypatch):
     # old SERVE_*.json files replay with the exact shapes pinned above
     # and never grow a "tuned" key
     assert "tuned" not in got and "tuned" not in got_asc
+    # the sparse arm has its OWN artifact family (SPARSE_SERVE_*.json):
+    # old SERVE artifacts replay unchanged and never grow sparse keys
+    for g in (got, got_spec, got_phase, got_mig, got_asc):
+        assert not any(k.startswith("sparse_") for k in g)
 
 
 def test_tuned_arm_metric_schema():

@@ -492,6 +492,13 @@ _SLOW_LEDGER = [
     # tier-1 in the same file.
     "test_brain_tuner.py::test_tuning_replan_drill_loss_continuity",
     "test_brain_tuner.py::test_serving_retune_bitwise_parity",
+    # tiered sparse-serving drills (PR 20): each stands up the
+    # recommendation serving loop (serving.sparse_engine) and, for the
+    # reshard drill, three KvServer processes; the tiered-table,
+    # prefetcher, cold-store and partition-property units in the same
+    # files stay tier-1.
+    "test_sparse_serving.py::test_ps_reshard_drill_mid_traffic",
+    "test_bench_smoke.py::test_bench_sparse_serve_mode_emits_schema",
 ]
 
 
@@ -580,11 +587,14 @@ def test_serving_migration_importers_are_unit_file_or_slow():
 def _imports_serving_e2e(tree) -> bool:
     """Module-level import of the serving SERVER or REPLICA layer —
     both spin background serve threads and jit-compile the decode
-    engine. Engine/scheduler/kv_cache unit imports stay fast."""
+    engine. ``sparse_engine`` counts too: its server runs the same
+    background loop and its drills add multiprocess KvServers on top.
+    Engine/scheduler/kv_cache unit imports stay fast."""
     e2e = (
         "dlrover_tpu.serving.server",
         "dlrover_tpu.serving.replica",
         "dlrover_tpu.serving.disagg",
+        "dlrover_tpu.serving.sparse_engine",
     )
     for node in tree.body:  # module level only, by design
         if isinstance(node, ast.Import):
@@ -599,7 +609,7 @@ def _imports_serving_e2e(tree) -> bool:
             if any(mod == m or mod.startswith(m + ".") for m in e2e):
                 return True
             if mod == "dlrover_tpu.serving" and any(
-                a.name in ("server", "replica", "disagg")
+                a.name in ("server", "replica", "disagg", "sparse_engine")
                 for a in node.names
             ):
                 return True
@@ -607,12 +617,14 @@ def _imports_serving_e2e(tree) -> bool:
 
 
 def _fn_imports_serving_e2e(fn) -> bool:
-    """Function-BODY import of serving.server/replica (the drill idiom:
-    import inside the test so tier-1 collection stays light)."""
+    """Function-BODY import of serving.server/replica/sparse_engine
+    (the drill idiom: import inside the test so tier-1 collection stays
+    light)."""
     e2e = (
         "dlrover_tpu.serving.server",
         "dlrover_tpu.serving.replica",
         "dlrover_tpu.serving.disagg",
+        "dlrover_tpu.serving.sparse_engine",
     )
     for node in ast.walk(fn):
         if isinstance(node, ast.Import):
@@ -627,7 +639,7 @@ def _fn_imports_serving_e2e(fn) -> bool:
             if any(mod == m or mod.startswith(m + ".") for m in e2e):
                 return True
             if mod == "dlrover_tpu.serving" and any(
-                a.name in ("server", "replica", "disagg")
+                a.name in ("server", "replica", "disagg", "sparse_engine")
                 for a in node.names
             ):
                 return True
