@@ -389,17 +389,24 @@ class Scheduler:
             return len(self._heap)
 
     def peek(self, n: int = 1) -> List[Request]:
-        """Non-destructive head-of-line peek: the next ``n`` requests in
-        pop order, skipping cancelled/resolved entries. The lookahead
-        prefetcher reads queued prompts here to warm caches (tiered
-        embedding rows) before the engine pops them; the queue itself is
-        untouched."""
+        """Non-destructive head-of-line peek: the next ``n`` LIVE
+        requests in pop order. Cancelled/resolved entries are skipped
+        without consuming the lookahead budget — the scan walks the heap
+        in sorted order until ``n`` live requests are collected, so a
+        burst of cancellations at the head can't blind the prefetcher to
+        queued work further back. The lookahead prefetcher reads queued
+        prompts here to warm caches (tiered embedding rows) before the
+        engine pops them; the queue itself is untouched."""
+        n = max(int(n), 0)
+        out: List[Request] = []
         with self._lock:
-            return [
-                t[-1]
-                for t in heapq.nsmallest(max(int(n), 0), self._heap)
-                if not t[-1].future.done()
-            ]
+            if n:
+                for t in sorted(self._heap):
+                    if not t[-1].future.done():
+                        out.append(t[-1])
+                        if len(out) == n:
+                            break
+        return out
 
     def record_first_token(self, req: Request) -> None:
         """Stamp TTFT once per request — a re-prefilled failover does

@@ -76,17 +76,25 @@ class FileColdStore(ColdStore):
     """
 
     def __init__(self, path: str, width: int, flush_every: int = 256,
-                 codec: str = "f32"):
+                 codec: str = "f32", fsync_every: int = 0):
         """``flush_every``: compact to the base npz every N mutation
         batches. Appends between compactions are cheap; call flush() at
-        checkpoint boundaries for a clean base file."""
+        checkpoint boundaries for a clean base file.
+
+        ``fsync_every``: os.fsync the log every N append batches. The
+        default 0 never fsyncs — appends survive a process crash (the
+        buffered write reaches the page cache) but a host power loss can
+        drop or tear the tail; replay truncates such a tail, so the loss
+        is bounded to un-synced records, never corruption."""
         if codec not in ("f32", "int8"):
             raise ValueError(f"codec must be 'f32' or 'int8', got {codec!r}")
         self.path = path
         self.width = width
         self.flush_every = max(1, flush_every)
+        self.fsync_every = max(0, fsync_every)
         self.codec = codec
         self._mutations = 0
+        self._unsynced = 0
         os.makedirs(path, exist_ok=True)
         self._lock = threading.Lock()
         if codec == "int8":
@@ -154,7 +162,7 @@ class FileColdStore(ColdStore):
         with open(w, "rb") as fh:
             data = fh.read()
         off, n = 0, len(data)
-        applied = 0
+        applied, good = 0, 0  # good = end of the last fully-applied record
         while off + _WAL_HEADER.size <= n:
             op, key, fr, t = _WAL_HEADER.unpack_from(data, off)
             off += _WAL_HEADER.size
@@ -173,12 +181,29 @@ class FileColdStore(ColdStore):
             else:
                 break  # corrupt record; everything before it applied
             applied += 1
+            good = off
+        if good < n:
+            # cut the torn/corrupt tail from disk, not just from this
+            # replay: __init__ reopens the log for append, and records
+            # landing after the partial bytes would be misparsed on the
+            # NEXT replay (the torn put's row bytes swallow them)
+            with open(w, "r+b") as fh:
+                fh.truncate(good)
+            logger.warning(
+                "cold-store log: dropped %d torn/corrupt tail bytes",
+                n - good,
+            )
         if applied:
             logger.info("replayed %d cold-store log records", applied)
 
     def _append_wal(self, chunks: Iterable[bytes]):
         self._wal.write(b"".join(chunks))
         self._wal.flush()
+        if self.fsync_every:
+            self._unsynced += 1
+            if self._unsynced >= self.fsync_every:
+                os.fsync(self._wal.fileno())
+                self._unsynced = 0
 
     def _flush(self):
         keys = np.array(sorted(self._rows), dtype=np.int64)
@@ -197,6 +222,7 @@ class FileColdStore(ColdStore):
         # replays already-applied records (puts/deletes are idempotent)
         self._wal.close()
         self._wal = open(self._wal_file(), "wb")
+        self._unsynced = 0
 
     def _maybe_flush(self):
         self._mutations += 1
@@ -360,6 +386,11 @@ class TieredTable:
         # instead of a full-table export.
         self._candidates: Dict[int, int] = {}
         self._epoch = 0
+        # bumped by demotion sweeps only: readers snapshot it around the
+        # lock-free hot gather to detect a sweep racing the read (see
+        # gather_or_zeros); promotions don't threaten a resident read,
+        # so they don't bump it and can't cause spurious retries
+        self._demote_epoch = 0
         self.stats = TierStats()
 
     @property
@@ -370,16 +401,47 @@ class TieredTable:
     # ---- lookups (fault cold rows back into the hot tier) ---------------
 
     def gather_or_insert(self, keys, now_ts: Optional[int] = None):
-        keys = np.asarray(keys, np.int64)
-        self._fault_in(keys, now_ts)
-        rows = self.hot.gather_or_insert(keys, now_ts=now_ts)
-        self._record_touch(keys, now_ts)
-        return rows
+        """Train-path gather: cold keys fault in, unseen keys insert
+        fresh init rows. Routed through the begin_update fence (touch
+        recorded BEFORE the hot read) because the insert side effect
+        makes a retry unsafe: a demotion sweep landing between the
+        residency check and the gather would spill the real row, the
+        gather would insert a fresh init row over it, and that init row
+        would later demote over the trained one. The fence makes the
+        sweep's post-claim re-verify see these keys fresh and back off."""
+        keys = self.begin_update(keys, now_ts)
+        return self.hot.gather_or_insert(keys, now_ts=now_ts)
 
     def gather_or_zeros(self, keys):
+        """Read-only gather (the frozen serve path — records no touches,
+        so serving alone never pins keys hot). Readers get the same
+        protection from racing demotions that begin_update gives
+        writers, but optimistically: snapshot the demotion epoch, do the
+        lock-free hot gather, and re-verify — if a sweep completed or
+        holds a claim on these keys across the window, the rows it
+        spilled may have read as zeros, so fault back in and re-gather."""
         keys = np.asarray(keys, np.int64)
-        self._fault_in(keys, None)
-        return self.hot.gather_or_zeros(keys)
+        ukeys = np.unique(keys).tolist()
+        count = True
+        while True:
+            self._fault_in(keys, None, count=count)
+            count = False
+            with self._fault_lock:
+                epoch = self._demote_epoch
+                pending = [
+                    self._inflight[k] for k in ukeys if k in self._inflight
+                ]
+            if pending:
+                for ev in pending:
+                    ev.wait()
+                continue
+            rows = self.hot.gather_or_zeros(keys)
+            with self._fault_lock:
+                stable = self._demote_epoch == epoch and not any(
+                    k in self._inflight for k in ukeys
+                )
+            if stable:
+                return rows
 
     def prefetch(self, keys, now_ts: Optional[int] = None) -> int:
         """Promote any cold ``keys`` ahead of demand (the prefetcher's
@@ -397,11 +459,15 @@ class TieredTable:
         ts = self.hot.timestamp(keys)
         return (freqs != 0) | (ts != 0)
 
-    def _fault_in(self, keys, now_ts, prefetch: bool = False) -> int:
+    def _fault_in(
+        self, keys, now_ts, prefetch: bool = False, count: bool = True
+    ) -> int:
         """Promote the cold subset of ``keys``; first fault per key
-        serializes, racers wait on the claimant's event."""
+        serializes, racers wait on the claimant's event. ``count=False``
+        skips the gather gauges — retry loops re-fault without
+        re-counting the same lookup."""
         resident = self._residency(keys)
-        if not prefetch:
+        if not prefetch and count:
             self.stats.add(
                 gathered=int(keys.size), hot_hits=int(resident.sum())
             )
@@ -544,6 +610,7 @@ class TieredTable:
             live_set = {int(x) for x in live.tolist()}
             with self._fault_lock:
                 self._epoch += 1
+                self._demote_epoch += 1
                 for k, ev in claimed:
                     if k in live_set:
                         self._candidates.pop(k, None)
@@ -568,8 +635,10 @@ class TieredTable:
         ``hot`` directly."""
         keys = np.asarray(keys, np.int64)
         self._record_touch(keys, now_ts)
+        count = True
         while True:
-            self._fault_in(keys, now_ts)
+            self._fault_in(keys, now_ts, count=count)
+            count = False
             with self._fault_lock:
                 pending = [
                     self._inflight[k]

@@ -197,6 +197,23 @@ def test_deadline_expired_in_queue_fails_fast_and_counts_timed_out():
         r.future.result(timeout=1)
 
 
+def test_peek_skips_done_entries_without_spending_lookahead():
+    """Cancelled/resolved entries at the head must not consume the peek
+    budget: with n live requests queued behind k done ones, peek(n)
+    returns all n live requests, in pop order, without popping anything."""
+    s = Scheduler()
+    done = [s.submit([i], 4) for i in range(3)]
+    live = [s.submit([10 + i], 4) for i in range(3)]
+    for r in done:
+        r.future.cancel()
+    got = s.peek(3)
+    assert [r.rid for r in got] == [r.rid for r in live]
+    assert s.queue_depth() == 6  # non-destructive: nothing popped
+    # partial windows and n=0 stay well-behaved
+    assert [r.rid for r in s.peek(100)] == [r.rid for r in live]
+    assert s.peek(0) == []
+
+
 def test_drop_counters_and_publish_fields():
     s = Scheduler(max_queue=1)
     s.submit([1], 1)

@@ -551,6 +551,69 @@ class TestTieredTable:
         assert tiered.demote_before_timestamp(2**60) == 3
         assert tiered.cold_size == 3
 
+    def test_frozen_gather_retries_past_racing_demotion(self, tmp_path):
+        """Read/demote race regression: a sweep running cold.put →
+        hot.delete between the residency check and the lock-free hot
+        read must not turn a trained row into zeros — the reader sees
+        the demotion epoch moved and retries the fault path."""
+        import numpy as np
+
+        tiered, hot, _ = self._tiered(tmp_path)
+        keys = np.array([1, 2, 3], dtype=np.int64)
+        rows = tiered.gather_or_insert(keys, now_ts=100)
+
+        orig = hot.gather_or_zeros
+        fired = []
+
+        def racing_gather(k):
+            # the sweep lands exactly in the race window (first read
+            # only): after _fault_in saw the keys resident, before the
+            # hot gather runs
+            if not fired:
+                fired.append(True)
+                assert tiered.demote_before_timestamp(2**60) == 3
+            return orig(k)
+
+        hot.gather_or_zeros = racing_gather
+        try:
+            out = tiered.gather_or_zeros(keys)
+        finally:
+            hot.gather_or_zeros = orig
+        np.testing.assert_allclose(out, rows, rtol=1e-6)
+        assert tiered.cold_size == 0  # retried fault promoted them back
+
+    def test_train_gather_fences_out_racing_demotion(self, tmp_path):
+        """gather_or_insert's insert side effect can't be fixed by a
+        retry, so it takes the begin_update fence: the touch lands
+        before the hot read, and a sweep racing in re-reads the ring
+        post-claim, sees the keys fresh, and backs off — no fresh init
+        row is inserted over (and later demoted over) the real row."""
+        import numpy as np
+
+        tiered, hot, _ = self._tiered(tmp_path)
+        keys = np.array([1, 2, 3], dtype=np.int64)
+        rows = tiered.gather_or_insert(keys, now_ts=100)
+
+        orig = hot.gather_or_insert
+        moved = []
+
+        def racing_gather(k, now_ts=None):
+            # sweep cutoff beats the keys' OLD touches (100) but not the
+            # in-flight read's touch (300): pre-fence it spilled the
+            # rows and the gather re-inserted fresh init rows over them
+            if not moved:
+                moved.append(tiered.demote_before_timestamp(200))
+            return orig(k, now_ts=now_ts)
+
+        hot.gather_or_insert = racing_gather
+        try:
+            out = tiered.gather_or_insert(keys, now_ts=300)
+        finally:
+            hot.gather_or_insert = orig
+        assert moved == [0]  # the sweep saw fresh touches and backed off
+        np.testing.assert_allclose(out, rows, rtol=1e-6)
+        assert tiered.cold_size == 0
+
     def test_concurrent_faults_promote_each_key_once(self, tmp_path):
         """Promotion-epoch concurrency: N threads faulting the same cold
         keys cost ONE cold read per key — the first fault claims, racers
@@ -697,6 +760,81 @@ class TestTieredTable:
         f3, v3, _, _ = cold3.get(np.array([0, 7, 8], np.int64))
         assert f3.all()
         np.testing.assert_array_equal(v3[1], [7.0, 7.0])
+
+    def test_wal_torn_tail_truncated_before_reappend(self, tmp_path):
+        """Double-crash regression: replay must TRUNCATE a torn tail,
+        not just skip it — __init__ reopens the log for append, so
+        without the truncate new records land after the partial bytes
+        and the NEXT replay misparses them (the torn put's row bytes
+        swallow the following record: garbage row, silent drops)."""
+        import os
+
+        import numpy as np
+
+        from dlrover_tpu.sparse.tiered import FileColdStore, _WAL_HEADER
+
+        path = str(tmp_path / "c")
+        cold = FileColdStore(path, width=2, flush_every=1000)
+        k = np.arange(4, dtype=np.int64)
+        rows = np.arange(8, dtype=np.float32).reshape(4, 2)
+        cold.put(k, rows, np.ones(4, np.uint32), np.ones(4, np.uint32))
+        cold._wal.close()
+        wal = os.path.join(path, "wal.log")
+        good_size = os.path.getsize(wal)
+        # crash mid-append: torn put record (header + half a row)
+        with open(wal, "ab") as fh:
+            fh.write(_WAL_HEADER.pack(b"P", 99, 1, 1) + b"\x00\x00")
+        # unclean restart 1: good records replay, torn tail cut from disk
+        cold2 = FileColdStore(path, width=2, flush_every=1000)
+        assert os.path.getsize(wal) == good_size
+        cold2.put(
+            np.array([7], np.int64),
+            np.full((1, 2), 7.0, np.float32),
+            np.array([1], np.uint32),
+            np.array([1], np.uint32),
+        )
+        cold2._wal.close()
+        # unclean restart 2: the record appended after the crash parses —
+        # no garbage row for key 99, nothing silently dropped
+        cold3 = FileColdStore(path, width=2, flush_every=1000)
+        assert len(cold3) == 5
+        found, vals, _, _ = cold3.get(
+            np.array([0, 1, 2, 3, 7, 99], np.int64)
+        )
+        assert found.tolist() == [True] * 5 + [False]
+        np.testing.assert_array_equal(vals[:4], rows)
+        np.testing.assert_array_equal(vals[4], [7.0, 7.0])
+        # corrupt-record tails (bad opcode) truncate the same way
+        cold3._wal.close()
+        with open(wal, "ab") as fh:
+            fh.write(b"XXXX")
+        pre = os.path.getsize(wal) - 4
+        cold4 = FileColdStore(path, width=2, flush_every=1000)
+        assert os.path.getsize(wal) == pre
+        assert len(cold4) == 5
+
+    def test_wal_fsync_interval(self, tmp_path):
+        """fsync_every syncs the log to disk every N append batches and
+        the synced records replay on restart (smoke for the opt-in
+        power-loss durability knob)."""
+        import numpy as np
+
+        from dlrover_tpu.sparse.tiered import FileColdStore
+
+        cold = FileColdStore(
+            str(tmp_path / "c"), width=2, flush_every=1000, fsync_every=1
+        )
+        cold.put(
+            np.array([1], np.int64),
+            np.array([[1.0, 2.0]], np.float32),
+            np.array([1], np.uint32),
+            np.array([1], np.uint32),
+        )
+        assert cold._unsynced == 0  # batch was synced, counter reset
+        cold2 = FileColdStore(str(tmp_path / "c"), width=2)
+        found, vals, _, _ = cold2.get(np.array([1], np.int64))
+        assert found.all()
+        np.testing.assert_array_equal(vals[0], [1.0, 2.0])
 
 
 class TestLookaheadPrefetcher:
